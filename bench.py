@@ -1,0 +1,80 @@
+"""North-star benchmark: EC encode throughput (k=8, m=3, 1 MiB stripes).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference harness is ``ceph_erasure_code_benchmark`` (SURVEY.md §4.4);
+its binary is unavailable (reference mount empty — SURVEY.md §0), so the
+baseline denominator is this machine's CPU running the same GF(2^8)
+region math through the optimised NumPy table path — measured fresh each
+run and reported via vs_baseline.  BASELINE.md records the protocol.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+K, M = 8, 3
+STRIPE = 1 << 20          # 1 MiB logical stripe
+BATCH = 64                # stripes per launch
+ITERS = 10
+
+
+def _cpu_baseline_gbps(coding, chunk):
+    from ceph_tpu.ops import rs
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(K, chunk), dtype=np.uint8)
+    rs.encode_oracle(coding, data)  # warm
+    n = 3
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rs.encode_oracle(coding, data)
+    dt = time.perf_counter() - t0
+    return (n * K * chunk) / dt / 1e9
+
+
+def main():
+    from ceph_tpu.utils import honor_jax_platforms_env
+    honor_jax_platforms_env()
+    from ceph_tpu.ops import rs
+    from ceph_tpu.ops.gf_jax import GFLinear
+
+    coding = rs.reed_sol_van_matrix(K, M)
+    chunk = STRIPE // K
+
+    import jax
+    enc = GFLinear(coding)
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(BATCH, K, chunk), dtype=np.uint8)
+    darr = jax.device_put(data)
+
+    out = enc(darr)
+    out.block_until_ready()  # compile + warm
+
+    # correctness spot-check against the oracle before timing
+    expect = rs.encode_oracle(coding, data[0])
+    assert np.array_equal(np.asarray(out)[0], expect), "parity mismatch"
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = enc(darr)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    gbps = (ITERS * BATCH * K * chunk) / dt / 1e9
+
+    base = _cpu_baseline_gbps(coding, chunk)
+    print(json.dumps({
+        "metric": "ec_encode_k8m3_1MiB_GBps",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / base, 2),
+    }))
+    print(f"# device={jax.devices()[0].device_kind} batch={BATCH} "
+          f"iters={ITERS} cpu_oracle_baseline={base:.3f} GB/s",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""ceph_tpu — a TPU-native re-design of the capabilities of Ceph.
+
+This is NOT a port of the reference (``alvinsunalvin/ceph``, a fork of
+``ceph/ceph``).  It is a from-scratch framework, architected for JAX / XLA /
+Pallas on TPU, that re-creates the reference's capability surface:
+
+- ``ceph_tpu.ops``      — GF(2^8) arithmetic, Reed-Solomon matrix math,
+  rjenkins hashing, and the CRUSH fixed-point ``ln`` tables, each with a
+  NumPy oracle (bit-exactness standard) and a vectorised JAX form.
+- ``ceph_tpu.ec``       — the erasure-code subsystem: plugin registry,
+  jerasure/isa/lrc/shec/clay-equivalent plugins, and the TPU batch engine
+  (reference: ``src/erasure-code/``).
+- ``ceph_tpu.crush``    — CRUSH placement: map model, rule VM oracle, and
+  the TPU batch mapper (reference: ``src/crush/``).
+- ``ceph_tpu.osd``      — OSDMap analog and the EC backend stripe math
+  (reference: ``src/osd/OSDMap.cc``, ``src/osd/ECUtil.h``).
+- ``ceph_tpu.parallel`` — device-mesh sharding and the multi-chip
+  degraded-read reconstruct path (ICI all-gather).
+- ``ceph_tpu.utils``    — runtime substrate: buffers, versioned encoding,
+  config options, perf counters (reference: ``src/common/``).
+- ``ceph_tpu.tools``    — CLI parity tools: ``ec_bench``, ``osdmaptool``,
+  ``crushtool`` equivalents.
+
+Provenance note: the reference mount was empty during the survey (see
+SURVEY.md §0); compatibility target is "upstream Ceph, vintage unknown".
+Bit-exactness claims in this tree are therefore between the documented
+upstream algorithms (re-implemented independently), the NumPy/C++ oracles
+in this repo, and the TPU kernels — all cross-checked in tests/.
+"""
+
+__version__ = "0.1.0"

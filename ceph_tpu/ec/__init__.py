@@ -1,0 +1,18 @@
+"""Erasure-code subsystem (reference: ``src/erasure-code/``; SURVEY.md §3.6).
+
+Structure mirrors the reference's capability surface, not its code:
+
+- `interface`   — the plugin contract (`ErasureCodeInterface` analog):
+  profile init, chunk-count/size math, encode/decode/minimum_to_decode.
+- `registry`    — named plugin factory (`ErasureCodePluginRegistry` analog;
+  Python entry points instead of dlopen — the native bridge in ``native/``
+  provides the in-process C ABI seam).
+- `jerasure`    — jerasure-equivalent plugin (reed_sol_van, reed_sol_r6_op,
+  cauchy_orig, cauchy_good).
+- `isa`         — ISA-L-equivalent plugin (reed_sol_van, cauchy).
+- `lrc`, `shec` — locally-repairable and shingled codes.
+- `jax_backend` — the TPU batch engine all matrix codes execute on.
+"""
+
+from .interface import ECProfile, ErasureCodeInterface  # noqa: F401
+from .registry import create_erasure_code, list_plugins  # noqa: F401

@@ -1,0 +1,49 @@
+"""ISA-L-equivalent plugin (reference:
+``src/erasure-code/isa/ErasureCodeIsa.{h,cc}`` over the isa-l submodule).
+
+Matrix constructions follow ISA-L's ``gf_gen_rs_matrix`` /
+``gf_gen_cauchy1_matrix`` (see `ceph_tpu.ops.rs`), which differ from
+jerasure's for the same (k, m) — parity bytes are plugin-specific, exactly
+as in the reference (SURVEY.md §3.6 note on per-plugin byte-exactness).
+
+Alignment matches the reference's ``EC_ISA_ADDRESS_ALIGNMENT`` (32 bytes
+per chunk).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import rs
+from .interface import ECError, ECProfile, ErasureCodeInterface
+from .jax_backend import MatrixECEngine
+
+
+class ErasureCodeIsa(ErasureCodeInterface):
+    def __init__(self, profile: ECProfile):
+        self.profile = profile
+        self.k = profile.k
+        self.m = profile.m
+        self.technique = profile.technique or "reed_sol_van"
+        if self.k + self.m > 256:
+            raise ECError("k+m must be <= 256")
+        if self.technique == "reed_sol_van":
+            coding = rs.isa_rs_van_matrix(self.k, self.m)
+        elif self.technique == "cauchy":
+            coding = rs.isa_cauchy_matrix(self.k, self.m)
+        else:
+            raise ECError(f"isa technique {self.technique!r} not supported")
+        self.coding_matrix = coding
+        self.engine = MatrixECEngine(coding, self.k, self.m)
+
+    def get_alignment(self) -> int:
+        # EC_ISA_ADDRESS_ALIGNMENT = 32 bytes per chunk
+        return self.k * 32
+
+    def _encode_chunks(self, data: np.ndarray) -> np.ndarray:
+        return self.engine.encode(data)
+
+    def _decode_chunks(self, chunks, chunk_size, want=None):
+        if len(chunks) < self.k:
+            raise ECError(f"{len(chunks)} chunks < k={self.k}")
+        return self.engine.decode(chunks, chunk_size)

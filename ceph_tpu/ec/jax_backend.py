@@ -1,0 +1,81 @@
+"""The TPU batch engine behind every matrix-based erasure code.
+
+This is where the reference's ``galois_w08_region_multiply`` SIMD loop
+(gf-complete, behind ``src/erasure-code/jerasure``) becomes an MXU matmul:
+stripes are batched to ``[B, k, chunk]`` uint8 and encoded/decoded as one
+GF(2)-bitmatrix ``dot_general`` per launch (see `ceph_tpu.ops.gf_jax`).
+
+Design notes (TPU-first, SURVEY.md §8.3):
+
+- one jit cache entry per (matrix bytes, batch shape) — matrices are tiny
+  and few (k, m, technique), shapes are bucketed by the caller;
+- decode matrices depend on the erasure pattern; they are cached per
+  (erasure tuple) since real clusters see few distinct patterns at a time;
+- everything stays uint8 end-to-end; no host round-trips inside a batch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import rs
+from ..ops.gf_jax import GFLinear
+
+
+class MatrixECEngine:
+    """Executes encode/decode for a fixed [m, k] GF(2^8) coding matrix."""
+
+    def __init__(self, coding: np.ndarray, k: int, m: int):
+        coding = np.asarray(coding, dtype=np.uint8)
+        assert coding.shape == (m, k), (coding.shape, k, m)
+        self.coding = coding
+        self.k, self.m = k, m
+        self._encoder = GFLinear(coding)
+        self._decoders: dict[tuple[int, ...], tuple[GFLinear, list[int]]] = {}
+
+    # -- encode ------------------------------------------------------------
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """[k, chunk] or [B, k, chunk] uint8 -> parity of matching batch shape."""
+        return np.asarray(self._encoder(data))
+
+    def encode_device(self, data) -> jax.Array:
+        """Same, but stays on device (for benchmark/pipeline use)."""
+        return self._encoder(data)
+
+    # -- decode ------------------------------------------------------------
+    def _decoder_for(self, erasures: tuple[int, ...]) -> tuple[GFLinear, list[int]]:
+        entry = self._decoders.get(erasures)
+        if entry is None:
+            dm = rs.decode_matrix(self.coding, self.k, list(erasures))
+            survivors = [i for i in range(self.k + self.m)
+                         if i not in erasures][: self.k]
+            entry = (GFLinear(dm), survivors)
+            self._decoders[erasures] = entry
+        return entry
+
+    def decode(self, chunks: dict[int, np.ndarray],
+               chunk_size: int) -> dict[int, np.ndarray]:
+        """Recover all k+m chunks of one stripe from any >=k survivors."""
+        erasures = tuple(i for i in range(self.k + self.m) if i not in chunks)
+        decoder, survivors = self._decoder_for(erasures)
+        stacked = np.stack([chunks[i] for i in survivors])
+        data = np.asarray(decoder(stacked))
+        out = {i: data[i] for i in range(self.k)}
+        missing_parity = [j for j in range(self.m) if self.k + j not in chunks]
+        if missing_parity:
+            parity = self.encode(data)
+            for j in missing_parity:
+                out[self.k + j] = parity[j]
+        for i, buf in chunks.items():
+            out[i] = np.asarray(buf, dtype=np.uint8)
+        return out
+
+    def decode_batch(self, survivors_data: np.ndarray,
+                     erasures: tuple[int, ...]) -> np.ndarray:
+        """[B, k, chunk] survivor stack (id order) -> [B, k, chunk] data."""
+        decoder, _ = self._decoder_for(erasures)
+        return np.asarray(decoder(survivors_data))

@@ -1,0 +1,56 @@
+"""jerasure-equivalent plugin (reference:
+``src/erasure-code/jerasure/ErasureCodeJerasure.{h,cc}``; SURVEY.md §3.6).
+
+Techniques: ``reed_sol_van`` (default), ``reed_sol_r6_op`` (m must be 2),
+``cauchy_orig``, ``cauchy_good``.  The bit-matrix XOR techniques
+(``liberation``, ``liber8tion``, ``blaum_roth``) are scheduled work; the
+registry rejects them explicitly rather than silently substituting.
+
+All techniques execute on the shared `MatrixECEngine` (MXU path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import rs
+from .interface import ECError, ECProfile, ErasureCodeInterface
+from .jax_backend import MatrixECEngine
+
+
+TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good")
+
+
+class ErasureCodeJerasure(ErasureCodeInterface):
+    def __init__(self, profile: ECProfile):
+        self.profile = profile
+        self.k = profile.k
+        self.m = profile.m
+        self.technique = profile.technique or "reed_sol_van"
+        if self.k < 1 or self.m < 1:
+            raise ECError(f"bad k={self.k} m={self.m}")
+        if self.k + self.m > 256:
+            raise ECError("k+m must be <= 256 for w=8")
+        if self.technique == "reed_sol_van":
+            coding = rs.reed_sol_van_matrix(self.k, self.m)
+        elif self.technique == "reed_sol_r6_op":
+            if self.m != 2:
+                raise ECError("reed_sol_r6_op requires m=2")
+            coding = rs.reed_sol_r6_matrix(self.k)
+        elif self.technique == "cauchy_orig":
+            coding = rs.cauchy_orig_matrix(self.k, self.m)
+        elif self.technique == "cauchy_good":
+            coding = rs.cauchy_good_matrix(self.k, self.m)
+        else:
+            raise ECError(f"jerasure technique {self.technique!r} not supported"
+                          f" (supported: {TECHNIQUES})")
+        self.coding_matrix = coding
+        self.engine = MatrixECEngine(coding, self.k, self.m)
+
+    def _encode_chunks(self, data: np.ndarray) -> np.ndarray:
+        return self.engine.encode(data)
+
+    def _decode_chunks(self, chunks, chunk_size, want=None):
+        if len(chunks) < self.k:
+            raise ECError(f"{len(chunks)} chunks < k={self.k}")
+        return self.engine.decode(chunks, chunk_size)

@@ -1,0 +1,58 @@
+"""Plugin registry — `ErasureCodePluginRegistry` analog.
+
+The reference dlopens ``libec_<name>.so`` and calls its exported
+``__erasure_code_init`` (``src/erasure-code/ErasureCodePlugin.cc``).  Here
+plugins register by name in-process; the native C ABI seam lives in
+``native/`` (see SURVEY.md §8.8) and surfaces through the same names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .interface import ECError, ECProfile, ErasureCodeInterface
+
+_PLUGINS: dict[str, Callable[[ECProfile], ErasureCodeInterface]] = {}
+_BUILTINS_LOADED = False
+
+
+def register_plugin(name: str,
+                    factory: Callable[[ECProfile], ErasureCodeInterface]):
+    _PLUGINS[name] = factory
+
+
+def list_plugins() -> list[str]:
+    _load_builtin()
+    return sorted(_PLUGINS)
+
+
+def _load_builtin():
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    _BUILTINS_LOADED = True
+    from .jerasure import ErasureCodeJerasure
+    from .isa import ErasureCodeIsa
+    from .lrc import ErasureCodeLrc
+    from .shec import ErasureCodeShec
+    register_plugin("jerasure", ErasureCodeJerasure)
+    register_plugin("isa", ErasureCodeIsa)
+    register_plugin("lrc", ErasureCodeLrc)
+    register_plugin("shec", ErasureCodeShec)
+    # the reference ships jerasure as the default plugin; `jax_tpu` is this
+    # framework's name for the same RS math on the TPU engine (they share
+    # MatrixECEngine, so the alias is exact)
+    register_plugin("jax_tpu", ErasureCodeJerasure)
+
+
+def create_erasure_code(profile) -> ErasureCodeInterface:
+    """Factory: profile (dict | ECProfile | iterable of k=v) -> plugin."""
+    _load_builtin()
+    if not isinstance(profile, ECProfile):
+        profile = ECProfile.parse(profile)
+    factory = _PLUGINS.get(profile.plugin)
+    if factory is None:
+        raise ECError(
+            f"unknown erasure-code plugin {profile.plugin!r}"
+            f" (available: {sorted(_PLUGINS)})")
+    return factory(profile)

@@ -1,0 +1,9 @@
+"""Numeric cores: GF(2^8), Reed-Solomon matrices, rjenkins hashing, crush_ln.
+
+Every core has two forms:
+
+- a NumPy *oracle* (scalar-faithful to the published upstream algorithm) —
+  the bit-exactness standard used by tests; and
+- a JAX form (vectorised/batched, jit/vmap/shard_map-friendly) — the TPU
+  execution path.
+"""

@@ -1,0 +1,187 @@
+"""GF(2^8) arithmetic — the field under every Reed-Solomon erasure code.
+
+Reference behavior being re-created (not ported): jerasure/gf-complete's
+``w=8`` Galois field with primitive polynomial ``0x11d``
+(x^8 + x^4 + x^3 + x^2 + 1), as used by Ceph's jerasure and ISA-L erasure
+code plugins (reference: ``src/erasure-code/jerasure/``, bundled
+``gf-complete``; see SURVEY.md §3.6).
+
+This module is the NumPy **oracle**: simple, table-driven, scalar-faithful.
+The TPU path (`ceph_tpu.ops.gf_jax`) must agree with it byte-for-byte.
+
+Representations provided:
+
+- log/antilog tables (`GF_LOG`, `GF_EXP`) and a full 256x256 product table
+  (`GF_MUL_TABLE`) for gather-based multiply;
+- the *bitmatrix* form: each field element ``a`` maps to an 8x8 GF(2)
+  matrix ``M(a)`` over bit-vectors such that ``a*b`` = ``M(a) @ bits(b)``
+  mod 2.  This turns GF matmul into int8 matmul + parity — the MXU-friendly
+  formulation used by the Pallas kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Primitive polynomial for GF(2^8): x^8+x^4+x^3+x^2+1 — the gf-complete
+# default for w=8 (0x11d with the implicit x^8 term).
+GF_POLY = 0x11D
+GF_GENERATOR = 2  # x is primitive for 0x11d
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= GF_POLY
+    # duplicate so exp[log a + log b] needs no mod
+    for i in range(255, 512):
+        exp[i] = exp[i - 255]
+    log[0] = 0  # by convention; callers must special-case 0
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+
+def gf_mul(a, b):
+    """Elementwise GF(2^8) product of uint8 arrays/scalars."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    out = GF_EXP[GF_LOG[a] + GF_LOG[b]]
+    return np.where((a == 0) | (b == 0), np.uint8(0), out)
+
+
+def _build_mul_table() -> np.ndarray:
+    a = np.arange(256, dtype=np.uint8)[:, None]
+    b = np.arange(256, dtype=np.uint8)[None, :]
+    return gf_mul(np.broadcast_to(a, (256, 256)), np.broadcast_to(b, (256, 256)))
+
+
+GF_MUL_TABLE = _build_mul_table()
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(2^8) inverse of 0")
+    return int(GF_EXP[255 - GF_LOG[a]])
+
+
+def gf_div(a, b):
+    """Elementwise a / b in GF(2^8); b must be nonzero."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    if np.any(b == 0):
+        raise ZeroDivisionError("GF(2^8) division by 0")
+    out = GF_EXP[GF_LOG[a] - GF_LOG[b] + 255]
+    return np.where(a == 0, np.uint8(0), out)
+
+
+def gf_pow(a: int, n: int) -> int:
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(GF_EXP[(int(GF_LOG[a]) * n) % 255])
+
+
+def gf_matmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """GF(2^8) matrix product: XOR-accumulate of per-element products.
+
+    A: [n, k] uint8, B: [k, m] uint8 -> [n, m] uint8.  This is the oracle
+    for both encode (coding_matrix @ data_chunks) and decode
+    (inverse_submatrix @ surviving_chunks).
+    """
+    A = np.asarray(A, dtype=np.uint8)
+    B = np.asarray(B, dtype=np.uint8)
+    # products[i, j, l] = A[i, l] * B[l, j]; XOR-reduce over l
+    prod = GF_MUL_TABLE[A[:, None, :], B.T[None, :, :]]
+    return np.bitwise_xor.reduce(prod, axis=2)
+
+
+def gf_mat_inv(A: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2^8) matrix by Gauss-Jordan elimination."""
+    A = np.array(A, dtype=np.uint8)
+    n = A.shape[0]
+    if A.shape != (n, n):
+        raise ValueError(f"square matrix required, got {A.shape}")
+    aug = np.concatenate([A, np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = None
+        for row in range(col, n):
+            if aug[row, col] != 0:
+                pivot = row
+                break
+        if pivot is None:
+            raise np.linalg.LinAlgError("singular GF(2^8) matrix")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        inv = gf_inv(int(aug[col, col]))
+        aug[col] = gf_mul(aug[col], inv)
+        for row in range(n):
+            if row != col and aug[row, col] != 0:
+                aug[row] ^= gf_mul(aug[row, col], aug[col])
+    return aug[:, n:].copy()
+
+
+# ---------------------------------------------------------------------------
+# Bitmatrix formulation (the MXU-friendly form)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _bitmatrix_table() -> np.ndarray:
+    """BITMAT[a] is the 8x8 GF(2) matrix of 'multiply by a'.
+
+    Convention: bits(b)[j] = (b >> j) & 1 (LSB first).  Column j of
+    BITMAT[a] is bits(a * x^j), i.e. ``a * (1<<j)``.  Then
+    bits(a*b) = BITMAT[a] @ bits(b) mod 2.
+    """
+    out = np.zeros((256, 8, 8), dtype=np.uint8)
+    for a in range(256):
+        for j in range(8):
+            col = gf_mul(a, 1 << j)
+            for i in range(8):
+                out[a, i, j] = (int(col) >> i) & 1
+    return out
+
+
+def gf_bitmatrix(a) -> np.ndarray:
+    """8x8 GF(2) bit-matrix (uint8 0/1 entries) for multiplication by ``a``.
+
+    For a coefficient matrix C [m, k], `expand_bitmatrix(C)` gives the
+    [8m, 8k] GF(2) matrix whose mod-2 matmul with bit-decomposed data equals
+    the GF(2^8) matmul — jerasure's ``jerasure_matrix_to_bitmatrix``
+    equivalent, and the form the TPU MXU consumes as int8 matmul + parity.
+    """
+    return _bitmatrix_table()[np.asarray(a, dtype=np.uint8)]
+
+
+def expand_bitmatrix(C: np.ndarray) -> np.ndarray:
+    """[m, k] uint8 coefficient matrix -> [8m, 8k] 0/1 bitmatrix."""
+    C = np.asarray(C, dtype=np.uint8)
+    m, k = C.shape
+    bm = gf_bitmatrix(C)  # [m, k, 8, 8]
+    return bm.transpose(0, 2, 1, 3).reshape(8 * m, 8 * k)
+
+
+def bytes_to_bits(x: np.ndarray) -> np.ndarray:
+    """[..., n] uint8 -> [..., 8n] bits, LSB-first per byte (matches
+    `gf_bitmatrix`'s convention)."""
+    x = np.asarray(x, dtype=np.uint8)
+    bits = np.unpackbits(x[..., None], axis=-1, bitorder="little")
+    return bits.reshape(*x.shape[:-1], x.shape[-1] * 8)
+
+
+def bits_to_bytes(b: np.ndarray) -> np.ndarray:
+    b = np.asarray(b, dtype=np.uint8)
+    n8 = b.shape[-1]
+    assert n8 % 8 == 0
+    return np.packbits(b.reshape(*b.shape[:-1], n8 // 8, 8), axis=-1,
+                       bitorder="little").reshape(*b.shape[:-1], n8 // 8)

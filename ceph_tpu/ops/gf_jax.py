@@ -1,0 +1,121 @@
+"""GF(2^8) linear algebra in JAX — the TPU execution path for erasure codes.
+
+Two formulations, both byte-exact against the NumPy oracle in
+``ceph_tpu.ops.gf``:
+
+1. **bitmatrix matmul** (`gf_matmul_bits`): the GF(2^8) coefficient matrix
+   C [m, k] expands to a GF(2) matrix; data bytes expand to bit-planes; the
+   product is an int8 matmul with int32 accumulation followed by a mod-2
+   parity and bit re-packing.  This keeps the hot loop on the MXU, which is
+   exactly why this framework exists (reference hot loop:
+   ``gf-complete``'s ``galois_w08_region_multiply`` SIMD inner loop behind
+   ``src/erasure-code/jerasure``; SURVEY.md §4.2).
+2. **table gather** (`gf_matmul_gather`): 256x256 product-table lookup +
+   XOR reduce.  Simpler, used for cross-checking and small shapes.
+
+Layout convention for the bitmatrix path (chosen to avoid intra-lane
+shuffles on TPU):
+
+- data bit-planes are stacked along the contraction axis in (bit, chunk)
+  order: plane row ``s*k + i`` holds bit ``s`` of data chunk ``i``;
+- output bit rows are produced in (bit, parity) order: row ``r*m + j`` is
+  bit ``r`` of parity chunk ``j``;
+- re-packing bytes is then 8 strided row-slices combined with shifts —
+  pure elementwise ops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .gf import GF_MUL_TABLE, gf_bitmatrix
+
+
+def _bit_layout_matrix(coding: np.ndarray) -> np.ndarray:
+    """[m, k] uint8 -> [8m, 8k] 0/1 int8 bitmatrix in (bit, chunk) layout.
+
+    Row r*m+j, column s*k+i = BM(coding[j, i])[r, s].
+    """
+    coding = np.asarray(coding, dtype=np.uint8)
+    m, k = coding.shape
+    bm = gf_bitmatrix(coding)            # [m, k, 8, 8] (j, i, r, s)
+    bm = bm.transpose(2, 0, 3, 1)        # [8(r), m(j), 8(s), k(i)]
+    return bm.reshape(8 * m, 8 * k).astype(np.int8)
+
+
+def _expand_bits(data: jnp.ndarray) -> jnp.ndarray:
+    """[..., k, n] uint8 -> [..., 8k, n] int8 bit-planes in (bit, chunk) order."""
+    k = data.shape[-2]
+    shifts = jnp.arange(8, dtype=jnp.uint8).reshape(8, 1, 1)
+    bits = (data[..., None, :, :] >> shifts) & jnp.uint8(1)   # [..., 8, k, n]
+    return bits.reshape(*data.shape[:-2], 8 * k, data.shape[-1]).astype(jnp.int8)
+
+
+def _pack_bits(bits: jnp.ndarray, m: int) -> jnp.ndarray:
+    """[..., 8m, n] int32 0/1 in (bit, parity) order -> [..., m, n] uint8."""
+    b = bits.reshape(*bits.shape[:-2], 8, m, bits.shape[-1])
+    shifts = jnp.arange(8, dtype=jnp.int32).reshape(8, 1, 1)
+    return jnp.sum(b << shifts, axis=-3).astype(jnp.uint8)
+
+
+def gf_matmul_bits(bitmat: jnp.ndarray, data: jnp.ndarray, m: int) -> jnp.ndarray:
+    """GF(2^8) matmul via GF(2) int8 matmul on the MXU.
+
+    bitmat: [8m, 8k] int8 from `_bit_layout_matrix`.
+    data:   [..., k, n] uint8.
+    Returns [..., m, n] uint8.
+    """
+    dbits = _expand_bits(data)
+    acc = jax.lax.dot_general(
+        bitmat, dbits,
+        dimension_numbers=(((1,), (dbits.ndim - 2,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    # dot_general output: [8m, ..., n] — move the row axis back
+    if dbits.ndim > 2:
+        acc = jnp.moveaxis(acc, 0, -2)
+    return _pack_bits(acc & 1, m)
+
+
+def gf_matmul_gather(coding: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """GF(2^8) matmul via product-table gather + XOR reduce.
+
+    coding: [m, k] uint8; data: [..., k, n] uint8 -> [..., m, n] uint8.
+    """
+    table = jnp.asarray(GF_MUL_TABLE.reshape(-1))
+    idx = (coding.astype(jnp.int32)[:, :, None] * 256
+           + data.astype(jnp.int32)[..., None, :, :])
+    prods = table[idx]                       # [..., m, k, n]
+    return jax.lax.reduce(
+        prods, np.uint8(0), jax.lax.bitwise_xor, dimensions=(prods.ndim - 2,))
+
+
+class GFLinear:
+    """A compiled GF(2^8) linear map (encode or decode step) over batches.
+
+    Wraps a fixed coefficient matrix [m, k]; calling it on data
+    [batch..., k, n] uint8 returns [batch..., m, n] uint8 computed on the
+    default JAX backend (MXU path).  jit-compiled once per input shape.
+    """
+
+    def __init__(self, coding: np.ndarray, use_bits: bool = True):
+        self.coding = np.asarray(coding, dtype=np.uint8)
+        self.m, self.k = self.coding.shape
+        self.use_bits = use_bits
+        if use_bits:
+            self._mat = jnp.asarray(_bit_layout_matrix(self.coding))
+        else:
+            self._mat = jnp.asarray(self.coding)
+        self._fn = jax.jit(self._apply)
+
+    def _apply(self, data: jnp.ndarray) -> jnp.ndarray:
+        if self.use_bits:
+            return gf_matmul_bits(self._mat, data, self.m)
+        return gf_matmul_gather(self._mat, data)
+
+    def __call__(self, data) -> jax.Array:
+        return self._fn(jnp.asarray(data, dtype=jnp.uint8))

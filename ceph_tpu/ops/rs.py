@@ -1,0 +1,259 @@
+"""Reed-Solomon / Cauchy generator-matrix construction.
+
+Re-creates (independently, from the published algorithms) the coding-matrix
+constructions used by the reference's erasure-code plugins:
+
+- jerasure ``reed_sol_van``: extended-Vandermonde matrix made systematic by
+  column elimination (reference behavior: ``src/erasure-code/jerasure``,
+  bundled ``jerasure/src/reed_sol.c: reed_sol_vandermonde_coding_matrix``;
+  SURVEY.md §3.6).
+- jerasure ``reed_sol_r6_op``: the RAID-6 special case (row of ones + row of
+  powers of 2).
+- jerasure ``cauchy_orig`` / ``cauchy_good``: Cauchy matrices, with
+  ``cauchy_good`` applying the ones-minimising column/row scaling
+  (``jerasure/src/cauchy.c: cauchy_improve_coding_matrix``).
+- ISA-L ``reed_sol_van`` / ``cauchy``: ISA-L's ``gf_gen_rs_matrix`` /
+  ``gf_gen_cauchy1_matrix`` variants (reference behavior:
+  ``src/erasure-code/isa/ErasureCodeIsa.cc`` over the isa-l submodule).
+  Note the documented upstream caveat that ISA-L's Vandermonde construction
+  is not MDS for every (k, m); we reproduce the construction, not a fix.
+
+All matrices are the *coding* rows only: shape [m, k] uint8.  The full
+generator is ``[I_k; C]``.
+
+Provenance: the reference mount was empty (SURVEY.md §0), so byte-exactness
+is asserted against these independently re-derived constructions plus
+algebraic invariants (systematic, MDS where expected), not against captured
+reference bytes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .gf import gf_div, gf_inv, gf_mul, gf_pow, gf_mat_inv, gf_matmul, gf_bitmatrix
+
+
+def _gf_mul_int(a: int, b: int) -> int:
+    return int(gf_mul(a, b))
+
+
+def extended_vandermonde_matrix(rows: int, cols: int) -> np.ndarray:
+    """jerasure ``reed_sol_extended_vandermonde_matrix`` (w=8).
+
+    Row 0 is e_0, row rows-1 is e_{cols-1}; interior row i is
+    [1, i, i^2, ... i^(cols-1)] in GF(2^8).
+    """
+    if rows < cols:
+        raise ValueError("rows < cols")
+    vdm = np.zeros((rows, cols), dtype=np.uint8)
+    vdm[0, 0] = 1
+    for i in range(1, rows - 1):
+        acc = 1
+        for j in range(cols):
+            vdm[i, j] = acc
+            acc = _gf_mul_int(acc, i)
+    vdm[rows - 1, cols - 1] = 1
+    return vdm
+
+
+def big_vandermonde_distribution_matrix(rows: int, cols: int) -> np.ndarray:
+    """jerasure ``reed_sol_big_vandermonde_distribution_matrix``.
+
+    Column-eliminates the extended Vandermonde matrix so the top cols x cols
+    block is the identity; elimination order and operations follow the
+    upstream algorithm exactly (pivot search downward, column scaling,
+    column elimination from row i down).
+    """
+    if cols >= rows:
+        raise ValueError("cols >= rows")
+    dist = extended_vandermonde_matrix(rows, cols)
+    for i in range(1, cols):
+        # find a row at/below i with a nonzero entry in column i
+        j = i
+        while j < rows and dist[j, i] == 0:
+            j += 1
+        if j >= rows:
+            raise ValueError("bad rows/cols for distribution matrix")
+        if j != i:
+            tmp = dist[j].copy()
+            dist[j] = dist[i]
+            dist[i] = tmp
+        # scale column i so dist[i, i] == 1
+        if dist[i, i] != 1:
+            inv = gf_inv(int(dist[i, i]))
+            dist[:, i] = gf_mul(dist[:, i], inv)
+        # eliminate the rest of row i with column operations (rows >= i only;
+        # rows above already form the identity pattern and have 0 in col i)
+        for j2 in range(cols):
+            tmp_v = int(dist[i, j2])
+            if j2 != i and tmp_v != 0:
+                dist[i:, j2] ^= gf_mul(dist[i:, i], tmp_v)
+    return dist
+
+
+def reed_sol_van_matrix(k: int, m: int) -> np.ndarray:
+    """jerasure ``reed_sol_vandermonde_coding_matrix``: bottom m rows of the
+    big Vandermonde distribution matrix. Shape [m, k]."""
+    if k + m > 256:
+        raise ValueError("k+m must be <= 256 for w=8")
+    dist = big_vandermonde_distribution_matrix(k + m, k)
+    return dist[k:, :].copy()
+
+
+def reed_sol_r6_matrix(k: int) -> np.ndarray:
+    """jerasure ``reed_sol_r6_coding_matrix`` (m == 2): ones row + powers of 2."""
+    mat = np.zeros((2, k), dtype=np.uint8)
+    mat[0, :] = 1
+    acc = 1
+    for j in range(k):
+        mat[1, j] = acc
+        acc = _gf_mul_int(acc, 2)
+    return mat
+
+
+def cauchy_n_ones(n: int) -> int:
+    """Number of ones in the 8x8 bitmatrix of multiplication by ``n``."""
+    return int(gf_bitmatrix(n).sum())
+
+
+def cauchy_orig_matrix(k: int, m: int) -> np.ndarray:
+    """jerasure ``cauchy_original_coding_matrix``: entry (i, j) = 1/(i ^ (m+j))."""
+    if k + m > 256:
+        raise ValueError("k+m must be <= 256 for w=8")
+    mat = np.zeros((m, k), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            mat[i, j] = gf_inv(i ^ (m + j))
+    return mat
+
+
+def cauchy_good_matrix(k: int, m: int) -> np.ndarray:
+    """jerasure ``cauchy_good_general_coding_matrix``: the Cauchy matrix with
+    the ones-minimising improvement from ``cauchy_improve_coding_matrix``."""
+    if k == 1 and m == 2:
+        return np.array([[1], [1]], dtype=np.uint8)
+    mat = cauchy_orig_matrix(k, m)
+    # divide each column by its first-row element (row 0 becomes all ones)
+    for j in range(k):
+        if mat[0, j] != 1:
+            mat[:, j] = gf_div(mat[:, j], int(mat[0, j]))
+    # for each later row, find the division that minimises bitmatrix ones
+    for i in range(1, m):
+        best = sum(cauchy_n_ones(int(v)) for v in mat[i])
+        best_j = -1
+        for j in range(k):
+            if mat[i, j] != 1:
+                inv = gf_inv(int(mat[i, j]))
+                total = sum(
+                    cauchy_n_ones(_gf_mul_int(int(v), inv)) for v in mat[i])
+                if total < best:
+                    best = total
+                    best_j = j
+        if best_j != -1:
+            mat[i, :] = gf_div(mat[i, :], int(mat[i, best_j]))
+    return mat
+
+
+def isa_rs_van_matrix(k: int, m: int) -> np.ndarray:
+    """ISA-L ``gf_gen_rs_matrix`` coding rows: row r = powers of 2^r."""
+    mat = np.zeros((m, k), dtype=np.uint8)
+    gen = 1
+    for r in range(m):
+        p = 1
+        for j in range(k):
+            mat[r, j] = p
+            p = _gf_mul_int(p, gen)
+        gen = _gf_mul_int(gen, 2)
+    return mat
+
+
+def isa_cauchy_matrix(k: int, m: int) -> np.ndarray:
+    """ISA-L ``gf_gen_cauchy1_matrix`` coding rows: entry = 1/((k+r) ^ j)."""
+    mat = np.zeros((m, k), dtype=np.uint8)
+    for r in range(m):
+        for j in range(k):
+            mat[r, j] = gf_inv((k + r) ^ j)
+    return mat
+
+
+def decode_matrix(coding: np.ndarray, k: int, erasures: list[int]) -> np.ndarray:
+    """Build the k x k decode matrix for recovering the data chunks.
+
+    ``coding`` is [m, k]; chunk ids are 0..k-1 (data) then k..k+m-1 (parity).
+    ``erasures`` lists the erased chunk ids.  Returns D [k, k_surviving=k]
+    such that data = D @ survivors, where survivors are the first k
+    non-erased chunks in id order — the same survivor-selection rule as
+    jerasure ``jerasure_matrix_decode``.
+    """
+    m = coding.shape[0]
+    erased = set(erasures)
+    survivors = [i for i in range(k + m) if i not in erased][:k]
+    if len(survivors) < k:
+        raise ValueError("not enough surviving chunks to decode")
+    gen = np.concatenate([np.eye(k, dtype=np.uint8), np.asarray(coding, dtype=np.uint8)])
+    sub = gen[survivors, :]  # [k, k]
+    return gf_mat_inv(sub)
+
+
+def solve_gf_system(A: np.ndarray, b: np.ndarray) -> np.ndarray | None:
+    """Solve A @ x = b over GF(2^8) by Gaussian elimination.
+
+    A: [neq, nunk] uint8; b: [neq, width] uint8.  Returns x [nunk, width]
+    if the system determines every unknown uniquely, else None.  Used by
+    the non-MDS codes (SHEC) and as the LRC fallback solver.
+    """
+    A = np.array(A, dtype=np.uint8)
+    b = np.array(b, dtype=np.uint8)
+    neq, nunk = A.shape
+    row = 0
+    pivots = []
+    for col in range(nunk):
+        pivot = None
+        for r in range(row, neq):
+            if A[r, col] != 0:
+                pivot = r
+                break
+        if pivot is None:
+            return None  # unknown col not determined
+        if pivot != row:
+            A[[row, pivot]] = A[[pivot, row]]
+            b[[row, pivot]] = b[[pivot, row]]
+        inv = gf_inv(int(A[row, col]))
+        A[row] = gf_mul(A[row], inv)
+        b[row] = gf_mul(b[row], inv)
+        for r in range(neq):
+            if r != row and A[r, col] != 0:
+                factor = int(A[r, col])
+                A[r] ^= gf_mul(A[row], factor)
+                b[r] ^= gf_mul(b[row], factor)
+        pivots.append(row)
+        row += 1
+    return b[pivots]
+
+
+def encode_oracle(coding: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """NumPy oracle encode: data [k, chunk] uint8 -> parity [m, chunk]."""
+    return gf_matmul(coding, data)
+
+
+def decode_oracle(coding: np.ndarray, k: int, chunks: dict[int, np.ndarray],
+                  chunk_size: int) -> dict[int, np.ndarray]:
+    """NumPy oracle decode: recover ALL chunks from any k survivors.
+
+    ``chunks`` maps chunk id -> bytes for available chunks.  Returns a dict
+    with every chunk id 0..k+m-1 filled in.
+    """
+    m = coding.shape[0]
+    erasures = [i for i in range(k + m) if i not in chunks]
+    survivors = [i for i in range(k + m) if i in chunks][:k]
+    dm = decode_matrix(coding, k, erasures)
+    surv = np.stack([np.asarray(chunks[i], dtype=np.uint8) for i in survivors])
+    data = gf_matmul(dm, surv)
+    out = {i: data[i] for i in range(k)}
+    parity = gf_matmul(np.asarray(coding, dtype=np.uint8), data)
+    for j in range(m):
+        out[k + j] = parity[j]
+    for i, buf in chunks.items():
+        out[i] = np.asarray(buf, dtype=np.uint8)
+    return out

@@ -1,0 +1,12 @@
+"""Device-mesh parallelism: the TPU-native replacement for the reference's
+OSD<->OSD sub-read/sub-write fan-out (``src/osd/ECBackend.cc``; SURVEY.md
+§3.2, §4.3).
+
+- `mesh`        — mesh construction helpers (dp x shard axes).
+- `reconstruct` — SPMD erasure-code pipeline under `shard_map`: chunk-sharded
+  encode (XOR-reduce across the shard axis) and degraded-read reconstruct
+  (ICI all-gather of surviving shards + local decode).
+"""
+
+from .mesh import make_mesh  # noqa: F401
+from .reconstruct import ShardedEC  # noqa: F401

@@ -1,0 +1,35 @@
+"""Mesh helpers: lay out available devices as a (dp, shard) grid.
+
+``dp`` partitions independent stripes (pure data parallelism — the analog
+of PGs being independent); ``shard`` partitions the chunk axis of a stripe
+(the analog of EC shards living on k+m different OSDs), so collectives on
+``shard`` ride ICI exactly where the reference sends MOSDECSubOp* messages.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None, shard: int | None = None) -> Mesh:
+    """Build a (dp, shard) mesh over the first ``n_devices`` devices.
+
+    ``shard`` defaults to the largest power-of-two divisor of n_devices
+    capped at 8 (a typical k+m fits in 8-16 shards); dp gets the rest.
+    """
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if shard is None:
+        shard = 1
+        while shard * 2 <= min(n_devices, 8) and n_devices % (shard * 2) == 0:
+            shard *= 2
+    if n_devices % shard != 0:
+        raise ValueError(f"{n_devices} devices not divisible by shard={shard}")
+    dp = n_devices // shard
+    arr = np.array(devices).reshape(dp, shard)
+    return Mesh(arr, ("dp", "shard"))

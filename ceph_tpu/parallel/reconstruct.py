@@ -1,0 +1,180 @@
+"""SPMD erasure-code pipeline: chunk-sharded encode + degraded-read
+reconstruct under ``shard_map``.
+
+Reference behavior being re-created TPU-natively (SURVEY.md §4.2-4.3):
+
+- EC write: ``ECBackend::submit_transaction`` fans sub-writes of k+m chunks
+  to k+m OSDs.  Here a stripe's chunk axis is sharded over the mesh's
+  ``shard`` axis; computing parity requires combining contributions from
+  data chunks on different devices — an XOR-reduction that rides ICI
+  (implemented as an all-gather of local GF partial products + local XOR,
+  exactly the collective the scaling-book recipe would pick for a small
+  contraction axis).
+- EC degraded read: ``objects_read_and_reconstruct`` gathers any k
+  surviving shards from peer OSDs.  Here: ``jax.lax.all_gather`` of the
+  surviving shard rows over ICI, then each device decodes its local stripe
+  batch with the cached inverse submatrix.
+
+Chunk ids are padded up to a multiple of the shard-axis size so every
+device owns the same number of chunk rows (static shapes for XLA).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops import rs
+from ..ops.gf import GF_MUL_TABLE
+
+
+def _gf_matmul_gather_local(coding: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """[m, k_local] x [B, k_local, C] -> [B, m, C] GF partial product."""
+    table = jnp.asarray(GF_MUL_TABLE.reshape(-1))
+    idx = (coding.astype(jnp.int32)[:, :, None]
+           * 256 + data.astype(jnp.int32)[:, None, :, :])
+    prods = table[idx]  # [B, m, k_local, C]
+    return jax.lax.reduce(prods, np.uint8(0), jax.lax.bitwise_xor,
+                          dimensions=(2,))
+
+
+class ShardedEC:
+    """Erasure code over a (dp, shard) mesh.
+
+    Layout: stripes [B, nchunks_padded, C] with spec P('dp', 'shard', None):
+    stripe batches over dp, chunk ids over shard.
+    """
+
+    def __init__(self, coding: np.ndarray, k: int, m: int, mesh: Mesh):
+        self.coding = np.asarray(coding, dtype=np.uint8)
+        self.k, self.m = k, m
+        self.mesh = mesh
+        self.shard_n = mesh.shape["shard"]
+        self.k_pad = -(-k // self.shard_n) * self.shard_n
+        self.n_pad = -(-(k + m) // self.shard_n) * self.shard_n
+        # coding matrix padded on the data axis [m, k_pad]
+        cpad = np.zeros((m, self.k_pad), dtype=np.uint8)
+        cpad[:, :k] = self.coding
+        self._coding_pad = cpad
+        self._decode_cache: dict[tuple[int, ...], object] = {}
+
+        self._encode = jax.jit(self._build_encode())
+
+    # -- encode: data chunks sharded, XOR-combine partials over ICI --------
+    def _build_encode(self):
+        mesh = self.mesh
+        cpad = jnp.asarray(self._coding_pad)
+        shard_n = self.shard_n
+        klocal = self.k_pad // shard_n
+        m = self.m
+
+        def local_fn(data):  # data: [Bl, klocal, C]
+            idx = jax.lax.axis_index("shard")
+            cols = jax.lax.dynamic_slice_in_dim(cpad, idx * klocal, klocal,
+                                                axis=1)
+            partial = _gf_matmul_gather_local(cols, data)  # [Bl, m, C]
+            # XOR-combine partials across the shard axis via all-gather
+            # (ICI); every device ends with the full parity of its stripes.
+            gathered = jax.lax.all_gather(partial, "shard", axis=0)
+            parity = jax.lax.reduce(gathered, np.uint8(0),
+                                    jax.lax.bitwise_xor, dimensions=(0,))
+            return parity  # [Bl, m, C] replicated over shard
+
+        def fn(data):  # [B, k_pad, C] sharded P('dp','shard',None)
+            # out is replicated over 'shard' by construction (all_gather +
+            # full XOR-reduce); the static VMA check can't see that.
+            return shard_map(
+                local_fn, mesh=mesh,
+                in_specs=P("dp", "shard", None),
+                out_specs=P("dp", None, None), check_vma=False)(data)
+
+        return fn
+
+    def pad_data(self, data: np.ndarray) -> np.ndarray:
+        """[B, k, C] -> [B, k_pad, C] zero-padded."""
+        B, k, C = data.shape
+        assert k == self.k
+        out = np.zeros((B, self.k_pad, C), dtype=np.uint8)
+        out[:, :k] = data
+        return out
+
+    def shard_array(self, arr: np.ndarray, spec: P) -> jax.Array:
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def encode(self, data_padded) -> jax.Array:
+        """[B, k_pad, C] (sharded or host) -> parity [B, m, C]."""
+        return self._encode(data_padded)
+
+    # -- degraded read: all-gather survivors, decode locally ---------------
+    def _decode_fn(self, erasures: tuple[int, ...]):
+        # per-instance cache (an lru_cache on the method would pin self and
+        # share one global budget across instances)
+        cached = self._decode_cache.get(erasures)
+        if cached is not None:
+            return cached
+        fn = self._build_decode_fn(erasures)
+        self._decode_cache[erasures] = fn
+        return fn
+
+    def _build_decode_fn(self, erasures: tuple[int, ...]):
+        mesh = self.mesh
+        k, m = self.k, self.m
+        dm = rs.decode_matrix(self.coding, k, list(erasures))
+        survivors = tuple(i for i in range(k + m) if i not in erasures)[:k]
+        dmj = jnp.asarray(dm)
+        surv_idx = jnp.asarray(np.array(survivors, dtype=np.int32))
+
+        def local_fn(chunks):  # [Bl, nlocal, C] — this device's chunk rows
+            # gather every device's chunk rows over ICI (the sub-read fan-in)
+            full = jax.lax.all_gather(chunks, "shard", axis=0)
+            # full: [shard_n, Bl, nlocal, C]; chunk id = shard*nlocal + local
+            full = jnp.moveaxis(full, 2, 1).reshape(
+                -1, chunks.shape[0], chunks.shape[2])  # [n_pad, Bl, C]
+            surv = full[surv_idx]                      # [k, Bl, C]
+            surv = jnp.moveaxis(surv, 1, 0)            # [Bl, k, C]
+            data = _gf_matmul_gather_local(dmj, surv)  # [Bl, k, C]
+            return data
+
+        def fn(chunks):  # [B, n_pad, C] sharded P('dp','shard',None)
+            # replicated over 'shard' by construction (decode after gather)
+            return shard_map(
+                local_fn, mesh=mesh,
+                in_specs=P("dp", "shard", None),
+                out_specs=P("dp", None, None), check_vma=False)(chunks)
+
+        return jax.jit(fn)
+
+    def reconstruct(self, chunks_padded, erasures: tuple[int, ...]) -> jax.Array:
+        """[B, n_pad, C] chunk-sharded -> recovered data [B, k, C].
+
+        ``erasures`` lists erased chunk ids; their rows in the input are
+        ignored (may be garbage/zeros).
+        """
+        return self._decode_fn(tuple(sorted(erasures)))(chunks_padded)
+
+    # -- the full pipeline step (flagship "train step") --------------------
+    def pipeline_step(self, data_padded, erasures: tuple[int, ...]):
+        """Encode, then reconstruct with ``erasures`` erased, returning
+        (parity, recovered_data).  The compiled graph contains both the
+        XOR-combine and the all-gather collectives — this is the program
+        `__graft_entry__.dryrun_multichip` compiles over an N-device mesh.
+        """
+        parity = self._encode(data_padded)
+
+        def build(chunks):
+            return self._decode_fn(tuple(sorted(erasures)))(chunks)
+
+        B = data_padded.shape[0]
+        C = data_padded.shape[2]
+        all_chunks = jnp.concatenate(
+            [data_padded[:, :self.k], parity,
+             jnp.zeros((B, self.n_pad - self.k - self.m, C), jnp.uint8)],
+            axis=1)
+        recovered = build(all_chunks)
+        return parity, recovered

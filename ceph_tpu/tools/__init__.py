@@ -1,0 +1,1 @@
+"""CLI parity tools (reference: ``src/tools/``, ``src/test/erasure-code/``)."""

@@ -1,0 +1,3 @@
+"""Runtime substrate (reference: ``src/common/``; SURVEY.md §3.1)."""
+
+from .platform import honor_jax_platforms_env  # noqa: F401

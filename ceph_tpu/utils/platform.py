@@ -1,0 +1,19 @@
+"""Platform selection guard.
+
+This environment's TPU plugin (axon) force-overrides the ``jax_platforms``
+config at jax-import time, which silently defeats ``JAX_PLATFORMS=cpu``
+(CPU smoke runs, CI meshes) and can hang a CLI on TPU-tunnel hiccups.
+Every CLI entry point calls `honor_jax_platforms_env` before touching a
+backend so the caller's explicit environment choice wins.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and "axon" not in want:
+        import jax
+        jax.config.update("jax_platforms", want)

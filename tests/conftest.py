@@ -1,0 +1,27 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective tests
+run on an 8-device CPU mesh instead (the driver separately dry-run-compiles
+the multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the environment's TPU plugin (axon) force-overrides the
+``jax_platforms`` config at jax-import time, so setting JAX_PLATFORMS=cpu
+in the environment is NOT enough — we must update the config after the
+import, before any backend is initialised.  Otherwise every test touches
+the real single TPU chip (slow, serialised, and a tunnel hiccup hangs the
+whole suite).
+"""
+
+import os
+
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert not jax.config.jax_platforms or jax.config.jax_platforms == "cpu"

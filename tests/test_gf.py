@@ -1,0 +1,104 @@
+"""GF(2^8) oracle tests: field axioms, table identities, bitmatrix form."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert gf.GF_EXP[gf.GF_LOG[a]] == a
+
+
+def test_known_products_poly_0x11d():
+    # hand-checked values for the 0x11d field
+    assert int(gf.gf_mul(2, 128)) == 0x1D  # x * x^7 = x^8 = 0x11d mod
+    assert int(gf.gf_mul(2, 0x8E)) == 0x01  # 2 * 0x8e = 0x11c; ^0x11d = 1
+    assert int(gf.gf_mul(3, 3)) == 5
+    assert int(gf.gf_mul(0, 77)) == 0
+    assert int(gf.gf_mul(77, 0)) == 0
+
+
+def test_mul_commutative_associative():
+    rng = np.random.default_rng(0)
+    a, b, c = rng.integers(0, 256, size=(3, 200), dtype=np.uint8)
+    assert np.array_equal(gf.gf_mul(a, b), gf.gf_mul(b, a))
+    assert np.array_equal(gf.gf_mul(gf.gf_mul(a, b), c),
+                          gf.gf_mul(a, gf.gf_mul(b, c)))
+
+
+def test_distributive_over_xor():
+    rng = np.random.default_rng(1)
+    a, b, c = rng.integers(0, 256, size=(3, 200), dtype=np.uint8)
+    assert np.array_equal(gf.gf_mul(a, b ^ c),
+                          gf.gf_mul(a, b) ^ gf.gf_mul(a, c))
+
+
+def test_inverse():
+    for a in range(1, 256):
+        assert int(gf.gf_mul(a, gf.gf_inv(a))) == 1
+
+
+def test_div():
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, 256, size=100, dtype=np.uint8)
+    b = rng.integers(1, 256, size=100, dtype=np.uint8)
+    assert np.array_equal(gf.gf_mul(gf.gf_div(a, b), b), a)
+    with pytest.raises(ZeroDivisionError):
+        gf.gf_div(a, np.zeros(100, dtype=np.uint8))
+
+
+def test_mul_table_matches():
+    rng = np.random.default_rng(3)
+    a = rng.integers(0, 256, size=500, dtype=np.uint8)
+    b = rng.integers(0, 256, size=500, dtype=np.uint8)
+    assert np.array_equal(gf.GF_MUL_TABLE[a, b], gf.gf_mul(a, b))
+
+
+def test_matmul_identity():
+    rng = np.random.default_rng(4)
+    A = rng.integers(0, 256, size=(5, 5), dtype=np.uint8)
+    I = np.eye(5, dtype=np.uint8)
+    assert np.array_equal(gf.gf_matmul(A, I), A)
+    assert np.array_equal(gf.gf_matmul(I, A), A)
+
+
+def test_mat_inv():
+    rng = np.random.default_rng(5)
+    for _ in range(10):
+        A = rng.integers(0, 256, size=(6, 6), dtype=np.uint8)
+        try:
+            Ainv = gf.gf_mat_inv(A)
+        except np.linalg.LinAlgError:
+            continue
+        assert np.array_equal(gf.gf_matmul(A, Ainv), np.eye(6, dtype=np.uint8))
+
+
+def test_bitmatrix_mul_equivalence():
+    rng = np.random.default_rng(6)
+    for _ in range(50):
+        a = int(rng.integers(0, 256))
+        b = int(rng.integers(0, 256))
+        M = gf.gf_bitmatrix(a)
+        bits_b = np.array([(b >> j) & 1 for j in range(8)], dtype=np.uint8)
+        bits_ab = (M @ bits_b) % 2
+        ab = sum(int(bit) << i for i, bit in enumerate(bits_ab))
+        assert ab == int(gf.gf_mul(a, b))
+
+
+def test_expand_bitmatrix_matmul():
+    rng = np.random.default_rng(7)
+    C = rng.integers(0, 256, size=(3, 4), dtype=np.uint8)
+    data = rng.integers(0, 256, size=(4, 16), dtype=np.uint8)
+    expected = gf.gf_matmul(C, data)
+    BM = gf.expand_bitmatrix(C)  # [24, 32]
+    # [4 chunks * 8 bits, 16] with chunk-major bit rows to match expand_bitmatrix
+    dbits = np.concatenate(
+        [np.stack([(data[i] >> s) & 1 for s in range(8)]) for i in range(4)])
+    pbits = (BM.astype(np.int32) @ dbits.astype(np.int32)) % 2
+    packed = np.zeros((3, 16), dtype=np.uint8)
+    for j in range(3):
+        for s in range(8):
+            packed[j] |= (pbits[j * 8 + s].astype(np.uint8) << s)
+    assert np.array_equal(packed, expected)

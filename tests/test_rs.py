@@ -1,0 +1,115 @@
+"""Reed-Solomon matrix construction + oracle encode/decode tests.
+
+These are the byte-exactness oracle for every higher layer: all erasure
+patterns must round-trip, and the constructions must satisfy the algebraic
+properties the reference's plugins rely on (systematic generator, MDS for
+the jerasure constructions).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf, rs
+
+
+CONFIGS = [(2, 1), (3, 2), (4, 2), (6, 3), (8, 3), (8, 4), (10, 4)]
+
+
+def _is_mds(coding: np.ndarray, k: int) -> bool:
+    m = coding.shape[0]
+    gen = np.concatenate([np.eye(k, dtype=np.uint8), coding])
+    for survivors in itertools.combinations(range(k + m), k):
+        sub = gen[list(survivors), :]
+        try:
+            gf.gf_mat_inv(sub)
+        except np.linalg.LinAlgError:
+            return False
+    return True
+
+
+@pytest.mark.parametrize("k,m", [(3, 2), (4, 2), (5, 3), (6, 3)])
+def test_reed_sol_van_mds(k, m):
+    assert _is_mds(rs.reed_sol_van_matrix(k, m), k)
+
+
+@pytest.mark.parametrize("k,m", [(3, 2), (4, 2), (5, 3)])
+def test_cauchy_mds(k, m):
+    assert _is_mds(rs.cauchy_orig_matrix(k, m), k)
+    assert _is_mds(rs.cauchy_good_matrix(k, m), k)
+
+
+def test_reed_sol_van_deterministic():
+    a = rs.reed_sol_van_matrix(8, 3)
+    b = rs.reed_sol_van_matrix(8, 3)
+    assert np.array_equal(a, b)
+    assert a.shape == (3, 8)
+
+
+def test_r6_matrix():
+    mat = rs.reed_sol_r6_matrix(5)
+    assert np.array_equal(mat[0], np.ones(5, dtype=np.uint8))
+    assert np.array_equal(mat[1], np.array([1, 2, 4, 8, 16], dtype=np.uint8))
+
+
+def test_cauchy_good_row0_ones():
+    mat = rs.cauchy_good_matrix(6, 3)
+    assert np.all(mat[0] == 1)
+
+
+def test_isa_van_structure():
+    mat = rs.isa_rs_van_matrix(4, 3)
+    assert np.all(mat[0] == 1)
+    assert np.array_equal(mat[1], np.array([1, 2, 4, 8], dtype=np.uint8))
+    # row 2 = powers of 4
+    assert np.array_equal(mat[2], np.array([1, 4, 16, 64], dtype=np.uint8))
+
+
+def test_isa_cauchy_mds_small():
+    assert _is_mds(rs.isa_cauchy_matrix(4, 2), 4)
+
+
+@pytest.mark.parametrize("k,m", CONFIGS)
+def test_roundtrip_all_single_and_double_erasures(k, m):
+    rng = np.random.default_rng(42)
+    coding = rs.reed_sol_van_matrix(k, m)
+    chunk = 64
+    data = rng.integers(0, 256, size=(k, chunk), dtype=np.uint8)
+    parity = rs.encode_oracle(coding, data)
+    all_chunks = {i: data[i] for i in range(k)}
+    all_chunks.update({k + j: parity[j] for j in range(m)})
+
+    patterns = [(e,) for e in range(k + m)]
+    if m >= 2:
+        patterns += list(itertools.combinations(range(k + m), 2))
+    for erasures in patterns:
+        avail = {i: c for i, c in all_chunks.items() if i not in erasures}
+        rec = rs.decode_oracle(coding, k, avail, chunk)
+        for i in range(k + m):
+            assert np.array_equal(rec[i], all_chunks[i]), (erasures, i)
+
+
+def test_roundtrip_exhaustive_k4_m3():
+    """Exhaustive erasure-pattern round-trip, the reference's EC unit-test
+    posture (TestErasureCodeJerasure exhaustive erasures; SURVEY.md §5.1)."""
+    rng = np.random.default_rng(7)
+    k, m, chunk = 4, 3, 32
+    coding = rs.reed_sol_van_matrix(k, m)
+    data = rng.integers(0, 256, size=(k, chunk), dtype=np.uint8)
+    parity = rs.encode_oracle(coding, data)
+    all_chunks = {i: data[i] for i in range(k)}
+    all_chunks.update({k + j: parity[j] for j in range(m)})
+    for nerase in range(1, m + 1):
+        for erasures in itertools.combinations(range(k + m), nerase):
+            avail = {i: c for i, c in all_chunks.items() if i not in erasures}
+            rec = rs.decode_oracle(coding, k, avail, chunk)
+            for i in erasures:
+                assert np.array_equal(rec[i], all_chunks[i])
+
+
+def test_systematic_property():
+    """First k rows of the generator are identity: encode leaves data as-is."""
+    k, m = 8, 3
+    dist = rs.big_vandermonde_distribution_matrix(k + m, k)
+    assert np.array_equal(dist[:k], np.eye(k, dtype=np.uint8))
