@@ -1,0 +1,41 @@
+"""CRUSH — deterministic pseudo-random placement, TPU-native.
+
+The reference's CRUSH core is scalar C (`src/crush/mapper.c`,
+`src/crush/hash.c`, `src/crush/crush_ln_table.h` — SURVEY.md §3.3): a
+rule VM walking a weighted hierarchy with straw2 draws per replica.
+Here the same semantics are expressed twice:
+
+- `ceph_tpu.crush.mapper` — a scalar NumPy/Python **oracle** that defines
+  the semantics (and is fuzz-checked against itself for invariants);
+- `ceph_tpu.crush.jax_mapper` — a **batched** JAX mapper that maps
+  millions of PGs per launch on TPU vector units, bit-identical to the
+  oracle (enforced by tests/test_crush_jax.py).
+"""
+
+from .hash import (
+    crush_hash32,
+    crush_hash32_2,
+    crush_hash32_3,
+    crush_hash32_4,
+    ceph_str_hash_rjenkins,
+)
+from .ln import crush_ln
+from .map import (
+    Bucket,
+    CrushMap,
+    Rule,
+    Step,
+    Tunables,
+    build_flat_map,
+    build_hierarchy,
+)
+from .mapper import do_rule
+from .jax_mapper import BatchMapper
+
+__all__ = [
+    "crush_hash32", "crush_hash32_2", "crush_hash32_3", "crush_hash32_4",
+    "ceph_str_hash_rjenkins", "crush_ln",
+    "Bucket", "CrushMap", "Rule", "Step", "Tunables",
+    "build_flat_map", "build_hierarchy",
+    "do_rule", "BatchMapper",
+]

@@ -1,0 +1,428 @@
+"""Batched CRUSH mapping in JAX — millions of PGs per launch.
+
+The reference maps PGs one at a time through scalar C
+(`crush_do_rule` in `src/crush/mapper.c`; `osdmaptool --test-map-pgs`
+loops it single-threaded — SURVEY.md §4.5).  Here the PG batch is the
+vector axis: every straw2 draw becomes a [B, S] hash + argmax, retry
+loops become masked `lax.while_loop`s bounded by `choose_total_tries`,
+and the hierarchy walk is a fixed-depth masked descent.  Output is
+bit-identical to the scalar oracle (`ceph_tpu.crush.mapper`), enforced by
+tests/test_crush_jax.py.
+
+Supported (the overwhelmingly common case — everything else falls back
+to the oracle): straw2-only hierarchies, rules of shape
+`take → [set_*] → choose{,leaf}_{firstn,indep} → emit`, default
+chooseleaf tunables (vary_r=1, stable=1), reweights.
+
+Requires jax_enable_x64 (straw2 draws are 64-bit fixed point).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .hash import crush_hash32_2, crush_hash32_3
+from .ln import LL_TBL, RH_LH_TBL
+from .map import CRUSH_ITEM_NONE, CrushMap, Rule
+
+_NONE = CRUSH_ITEM_NONE
+_I64_MIN = -(1 << 63)
+
+
+def _floor_log2(x):
+    """Integer floor(log2(x)) for x ≥ 1 (works on jnp uint32 arrays)."""
+    import jax.numpy as jnp
+    r = jnp.zeros_like(x)
+    for shift in (16, 8, 4, 2, 1):
+        m = x >= (1 << shift)
+        r = r + jnp.where(m, np.uint32(shift), np.uint32(0))
+        x = jnp.where(m, x >> shift, x)
+    return r
+
+
+def _crush_ln_jnp(u, rh_lh, ll):
+    """JAX twin of ceph_tpu.crush.ln.crush_ln (same generated tables)."""
+    import jax.numpy as jnp
+    x = u.astype(jnp.uint32) + np.uint32(1)            # [1, 0x10000]
+    fl2 = _floor_log2(x)
+    bits = jnp.maximum(np.uint32(15) - jnp.minimum(fl2, np.uint32(15)),
+                       np.uint32(0))
+    xn = (x << bits).astype(jnp.uint64)
+    iexpon = (np.uint64(15) - bits.astype(jnp.uint64))
+    index1 = (xn >> np.uint64(8)) << np.uint64(1)       # [256, 512]
+    rh = rh_lh[(index1 - np.uint64(256)).astype(jnp.int32)]
+    lh = rh_lh[(index1 - np.uint64(255)).astype(jnp.int32)]
+    xl64 = (xn * rh) >> np.uint64(48)
+    llv = ll[(xl64 & np.uint64(0xFF)).astype(jnp.int32)]
+    return (iexpon << np.uint64(44)) + ((lh + llv) >> np.uint64(4))
+
+
+def _straw2_draws(u, w):
+    """Per-item draws: u [.., S] hashes (0..0xffff), w [.., S] int64 weights.
+
+    Returns int64 draws; w==0 ⇒ INT64_MIN (never wins except at index 0
+    of an all-zero bucket, matching the reference's `i == 0` seed).
+    """
+    import jax
+    import jax.numpy as jnp
+    rh_lh = jnp.asarray(RH_LH_TBL)
+    ll = jnp.asarray(LL_TBL)
+    lnv = _crush_ln_jnp(u, rh_lh, ll).astype(jnp.int64) - np.int64(1 << 48)
+    # draw = (ln << 16) / w — divide by the 16.16 weight; the s64 shift
+    # wraps mod 2^64 exactly as the scalar oracle emulates
+    shifted_u = jax.lax.bitcast_convert_type(lnv, jnp.uint64) << np.uint64(16)
+    s = jax.lax.bitcast_convert_type(shifted_u, jnp.int64)
+    neg = s < 0
+    mag = jax.lax.bitcast_convert_type(jnp.abs(s), jnp.uint64)
+    wq = jnp.maximum(w, np.int64(1)).astype(jnp.uint64)
+    q = mag // wq
+    qi = jax.lax.bitcast_convert_type(q, jnp.int64)
+    draws = jnp.where(neg, -qi, qi)
+    return jnp.where(w > 0, draws, np.int64(_I64_MIN))
+
+
+class BatchMapper:
+    """Compile one CRUSH rule into a batched x → device-vector function.
+
+    __call__(xs[B], reweight[max_devices]?) → int32 [B, result_max];
+    firstn results are compacted with CRUSH_ITEM_NONE padding at the end,
+    indep results keep positional NONE holes (EC shard order).
+    """
+
+    def __init__(self, cmap: CrushMap, rule: Rule | int,
+                 result_max: int | None = None, chunk: int = 1 << 16):
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            raise RuntimeError(
+                "BatchMapper needs 64-bit ints: set JAX_ENABLE_X64=1 or "
+                "jax.config.update('jax_enable_x64', True)")
+        if isinstance(rule, int):
+            rule = cmap.rules[rule]
+        self.cmap = cmap
+        self.rule = rule
+        self.chunk = chunk
+        if cmap.choose_args:
+            raise NotImplementedError("choose_args: use the scalar oracle")
+        t = cmap.tunables
+
+        # --- parse the rule into (take, one choose step, emit) -----------
+        take = None
+        choose = None
+        tries = t.choose_total_tries
+        leaf_tries = 0
+        for s in rule.steps:
+            if s.op == "take":
+                take = s.arg1
+            elif s.op == "set_choose_tries":
+                tries = s.arg1 if s.arg1 > 0 else tries
+            elif s.op == "set_chooseleaf_tries":
+                leaf_tries = s.arg1 if s.arg1 > 0 else leaf_tries
+            elif s.op in ("choose_firstn", "chooseleaf_firstn",
+                          "choose_indep", "chooseleaf_indep"):
+                if choose is not None:
+                    raise NotImplementedError(
+                        "multi-step choose chains: use the scalar oracle")
+                choose = s
+            elif s.op == "emit":
+                pass
+            else:
+                raise NotImplementedError(f"rule step {s.op}: use the oracle")
+        if take is None or choose is None:
+            raise ValueError("rule must contain take and a choose step")
+        if t.chooseleaf_vary_r != 1 or t.chooseleaf_stable != 1 \
+                or t.choose_local_tries or t.choose_local_fallback_tries:
+            raise NotImplementedError(
+                "non-default tunables: use the scalar oracle")
+
+        self.firstn = choose.op.endswith("firstn")
+        self.recurse = choose.op.startswith("chooseleaf")
+        self.target_type = choose.arg2
+        numrep = choose.arg1
+        if result_max is None:
+            if numrep <= 0:
+                raise ValueError("numrep<=0 rule needs explicit result_max")
+            result_max = numrep
+        if numrep <= 0:
+            numrep += result_max
+        self.numrep = min(numrep, result_max)
+        self.result_max = result_max
+        self.tries = tries
+        if self.firstn:
+            self.recurse_tries = (leaf_tries if leaf_tries
+                                  else (1 if t.chooseleaf_descend_once
+                                        else tries))
+        else:
+            self.recurse_tries = leaf_tries if leaf_tries else 1
+        self.take = take
+
+        # --- flatten the bucket table ------------------------------------
+        nb = len(cmap.buckets)
+        S = 1
+        for b in cmap.buckets:
+            if b is None:
+                continue
+            if b.alg != "straw2":
+                raise NotImplementedError(
+                    f"bucket alg {b.alg}: use the scalar oracle")
+            if b.size == 0:
+                raise ValueError("empty bucket in map")
+            S = max(S, b.size)
+        items = np.zeros((nb, S), dtype=np.int32)
+        weights = np.zeros((nb, S), dtype=np.int64)
+        sizes = np.zeros(nb, dtype=np.int32)
+        btype = np.zeros(nb, dtype=np.int32)
+        for row, b in enumerate(cmap.buckets):
+            if b is None:
+                continue
+            items[row, :b.size] = b.items
+            weights[row, :b.size] = b.weights
+            sizes[row] = b.size
+            btype[row] = b.type
+        self._items, self._weights = items, weights
+        self._sizes, self._btype = sizes, btype
+        self._nb, self._S = nb, S
+        # descent depths
+        self.d1 = cmap.max_depth_to_type(take, self.target_type)
+        if self.recurse:
+            d2 = 0
+            for b in cmap.buckets:
+                if b is not None and b.type == self.target_type:
+                    d2 = max(d2, cmap.max_depth_to_type(b.id, 0))
+            self.d2 = d2
+        else:
+            self.d2 = 0
+
+        self._fn = jax.jit(self._build())
+
+    # -- jitted pieces ----------------------------------------------------
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        items = jnp.asarray(self._items)
+        weights = jnp.asarray(self._weights)
+        sizes = jnp.asarray(self._sizes)
+        btype = jnp.asarray(self._btype)
+        nb, S = self._nb, self._S
+        col = jnp.arange(S, dtype=jnp.int32)
+
+        def item_type(itm):
+            rows = jnp.clip(-1 - itm, 0, nb - 1)
+            return jnp.where(itm < 0, btype[rows], 0)
+
+        def straw2(rows, x, r):
+            """rows/x/r [B] → chosen item [B]."""
+            its = items[rows]                       # [B, S]
+            ws = weights[rows]
+            u = crush_hash32_3(x[:, None], its.astype(jnp.uint32),
+                               r[:, None].astype(jnp.uint32))
+            u = (u & np.uint32(0xFFFF))
+            draws = _straw2_draws(u, ws)
+            draws = jnp.where(col[None, :] < sizes[rows][:, None],
+                              draws, np.int64(_I64_MIN))
+            sel = jnp.argmax(draws, axis=1)
+            return its[jnp.arange(its.shape[0]), sel]
+
+        def descend(start, x, r, target, depth):
+            """Masked hierarchy walk until item type == target."""
+            itm = start
+            for _ in range(depth):
+                isb = itm < 0
+                rows = jnp.clip(-1 - itm, 0, nb - 1)
+                t = jnp.where(isb, btype[rows], 0)
+                need = isb & (t != target)
+                nxt = straw2(rows, x, r)
+                itm = jnp.where(need, nxt, itm)
+            return itm
+
+        def dev_out(wdev, itm, x):
+            """is_out() — reweight rejection for a device item."""
+            w = wdev[jnp.clip(itm, 0, wdev.shape[0] - 1)]
+            h = crush_hash32_2(x, itm.astype(jnp.uint32)) & np.uint32(0xFFFF)
+            keep = (w >= np.uint32(0x10000)) | ((w > 0) & (h < w))
+            return ~keep
+
+        target = self.target_type
+        numrep, tries = self.numrep, self.tries
+        rtries = self.recurse_tries
+        # chooseleaf with target type 0: the descent already lands on a
+        # device; C takes the `out2[outpos] = item` direct path, so no
+        # inner recursion happens
+        leafmode = self.recurse and target != 0
+        d1, d2 = self.d1, self.d2
+        take = self.take
+        vary_r = self.cmap.tunables.chooseleaf_vary_r
+
+        def leaf_attempts(host, x, r, prev_leafs, wdev):
+            """Inner chooseleaf: ≤ rtries attempts inside `host`.
+
+            C: nested crush_choose_firstn(numrep=1, tries=rtries,
+            parent_r=sub_r) with stable=1.  Returns (leaf, got)."""
+            sub_r = (r >> (vary_r - 1)) if vary_r else jnp.zeros_like(r)
+            got = jnp.zeros(r.shape, dtype=bool)
+            dead = jnp.zeros(r.shape, dtype=bool)
+            leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
+            for ft in range(rtries):
+                ri = sub_r + np.int32(ft)
+                cand = descend(host, x, ri, 0, max(d2, 1))
+                valid = (cand >= 0) & (host < 0)
+                collide = jnp.zeros_like(got)
+                for pl in prev_leafs:
+                    collide |= cand == pl
+                reject = collide | dev_out(wdev, cand, x) | ~valid
+                active = ~got & ~dead
+                succ = active & ~reject
+                leaf = jnp.where(succ, cand, leaf)
+                got |= succ
+                dead |= active & ~valid   # C: skip_rep — no more attempts
+            return leaf, got
+
+        def firstn_fn(x, wdev):
+            B = x.shape[0]
+            outs, leafs = [], []
+            root = jnp.full((B,), take, dtype=jnp.int32)
+            for rep in range(numrep):
+                def body(st):
+                    ftotal, placed, dead, item, leaf = st
+                    active = ~placed & ~dead
+                    r = (np.int32(rep) + ftotal).astype(jnp.int32)
+                    itm = descend(root, x, r, target, max(d1, 1))
+                    valid = item_type(itm) == target
+                    collide = jnp.zeros_like(placed)
+                    for po in outs:
+                        collide |= itm == po
+                    if leafmode:
+                        lf, lgot = leaf_attempts(itm, x, r, leafs, wdev)
+                        reject = collide | ~lgot
+                    else:
+                        lf = itm
+                        if target == 0:
+                            reject = collide | dev_out(wdev, itm, x)
+                        else:
+                            reject = collide
+                    succ = active & valid & ~reject
+                    item = jnp.where(succ, itm, item)
+                    leaf = jnp.where(succ, lf, leaf)
+                    placed = placed | succ
+                    dead = dead | (active & ~valid)
+                    ftotal = ftotal + jnp.where(active & valid & reject,
+                                                np.int32(1), np.int32(0))
+                    return ftotal, placed, dead, item, leaf
+
+                def cond(st):
+                    ftotal, placed, dead, _, _ = st
+                    return jnp.any(~placed & ~dead & (ftotal < tries))
+
+                st = (jnp.zeros((B,), jnp.int32),
+                      jnp.zeros((B,), bool), jnp.zeros((B,), bool),
+                      jnp.full((B,), _NONE, jnp.int32),
+                      jnp.full((B,), _NONE, jnp.int32))
+                ftotal, placed, dead, item, leaf = jax.lax.while_loop(
+                    cond, body, st)
+                outs.append(jnp.where(placed, item, np.int32(_NONE)))
+                leafs.append(jnp.where(placed, leaf, np.int32(_NONE)))
+            res = jnp.stack(leafs if leafmode else outs, axis=1)
+            # compact: stable-move NONE entries to the end (C firstn
+            # advances outpos only on success)
+            order = jnp.argsort(res == _NONE, axis=1, stable=True)
+            return jnp.take_along_axis(res, order, axis=1)
+
+        def indep_fn(x, wdev):
+            B = x.shape[0]
+            root = jnp.full((B,), take, dtype=jnp.int32)
+            UNDEF = np.int32(-0x7FFFFFFE)
+
+            def round_body(st):
+                out, out2, ftotal = st
+                for rep in range(numrep):
+                    needs = out[:, rep] == UNDEF
+                    r = (np.int32(rep) + np.int32(numrep) * ftotal
+                         ).astype(jnp.int32) * jnp.ones((B,), jnp.int32)
+                    itm = descend(root, x, r, target, max(d1, 1))
+                    valid = item_type(itm) == target
+                    collide = jnp.any(out == itm[:, None], axis=1)
+                    if leafmode:
+                        lf, lgot = _indep_leaf(itm, x, r, rep, wdev)
+                        reject = collide | ~lgot
+                    else:
+                        lf = itm
+                        if target == 0:
+                            reject = collide | dev_out(wdev, itm, x)
+                        else:
+                            reject = collide
+                    # invalid → permanent NONE (C: left--, slot dead)
+                    kill = needs & ~valid
+                    succ = needs & valid & ~reject
+                    newv = jnp.where(succ, itm, jnp.where(
+                        kill, np.int32(_NONE), out[:, rep]))
+                    out = out.at[:, rep].set(newv)
+                    newl = jnp.where(succ, lf, jnp.where(
+                        kill, np.int32(_NONE), out2[:, rep]))
+                    out2 = out2.at[:, rep].set(newl)
+                return out, out2, ftotal + 1
+
+            def round_cond(st):
+                out, _, ftotal = st
+                return (ftotal < tries) & jnp.any(out == UNDEF)
+
+            def _indep_leaf(host, x, r, rep, wdev):
+                """C: nested crush_choose_indep(left=1, numrep, outpos=rep,
+                parent_r=r, tries=recurse_tries); the inner draw index is
+                rep + parent_r + numrep*ftotal_inner; self-only collision
+                check ⇒ none."""
+                got = jnp.zeros(r.shape, dtype=bool)
+                dead = jnp.zeros(r.shape, dtype=bool)
+                leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
+                for ft in range(rtries):
+                    ri = np.int32(rep) + r + np.int32(numrep * ft)
+                    cand = descend(host, x, ri, 0, max(d2, 1))
+                    valid = (cand >= 0) & (host < 0)
+                    reject = dev_out(wdev, cand, x) | ~valid
+                    active = ~got & ~dead
+                    succ = active & ~reject
+                    leaf = jnp.where(succ, cand, leaf)
+                    got |= succ
+                    dead |= active & ~valid
+                return leaf, got
+
+            out0 = jnp.full((B, numrep), UNDEF, jnp.int32)
+            st = (out0, out0, jnp.int32(0))
+            out, out2, _ = jax.lax.while_loop(round_cond, round_body, st)
+            res = out2 if leafmode else out
+            return jnp.where(res == UNDEF, np.int32(_NONE), res)
+
+        fn = firstn_fn if self.firstn else indep_fn
+
+        def run(x, wdev):
+            res = fn(x, wdev)
+            if res.shape[1] < self.result_max:
+                pad = jnp.full((x.shape[0], self.result_max - res.shape[1]),
+                               np.int32(_NONE), jnp.int32)
+                res = jnp.concatenate([res, pad], axis=1)
+            return res
+
+        return run
+
+    def __call__(self, xs, reweight=None) -> np.ndarray:
+        import jax.numpy as jnp
+        xs = np.asarray(xs, dtype=np.uint32)
+        if reweight is None:
+            reweight = np.full(max(self.cmap.max_devices, 1), 0x10000,
+                               dtype=np.uint32)
+        else:
+            reweight = np.asarray(reweight, dtype=np.uint32)
+        wdev = jnp.asarray(reweight)
+        outs = []
+        for lo in range(0, len(xs), self.chunk):
+            hi = min(lo + self.chunk, len(xs))
+            part = xs[lo:hi]
+            n = len(part)
+            if n < self.chunk and len(xs) > self.chunk:
+                part = np.pad(part, (0, self.chunk - n))
+            res = np.asarray(self._fn(jnp.asarray(part), wdev))
+            outs.append(res[:n])
+        return np.concatenate(outs, axis=0)

@@ -72,6 +72,10 @@ class ErasureCodeInterface(abc.ABC):
 
     k: int
     m: int
+    #: MDS property: ANY k of the k+m chunks reconstruct the stripe.
+    #: Non-MDS plugins (SHEC, LRC layers) must override to False so
+    #: callers don't assume the first-k-survivors decode rule works.
+    is_mds: bool = True
 
     # -- geometry ----------------------------------------------------------
     def get_chunk_count(self) -> int:

@@ -88,6 +88,8 @@ def _expand_kml(k: int, m: int, l: int) -> tuple[str, list[str]]:
 
 
 class ErasureCodeLrc(ErasureCodeInterface):
+    is_mds = False  # locality layers: decodability depends on the layer map
+
     def __init__(self, profile: ECProfile):
         self.profile = profile
         extra = profile.extra

@@ -20,7 +20,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import rs
-from ..ops.gf import gf_pow
+from ..ops.gf import gf_mul, gf_pow
 from .interface import ECError, ECProfile, ErasureCodeInterface
 from .jax_backend import MatrixECEngine
 
@@ -40,6 +40,8 @@ def shec_matrix(k: int, m: int, c: int) -> np.ndarray:
 
 
 class ErasureCodeShec(ErasureCodeInterface):
+    is_mds = False  # shingled parities: not every k-subset decodes
+
     def __init__(self, profile: ECProfile):
         self.profile = profile
         self.k = profile.k
@@ -86,7 +88,6 @@ class ErasureCodeShec(ErasureCodeInterface):
                 acc = np.asarray(chunks[i], dtype=np.uint8).copy()
                 for j in range(self.k):
                     if j not in missing_data and row[j]:
-                        from ..ops.gf import gf_mul
                         acc ^= gf_mul(row[j], data[j])
                 eqs.append(row[missing_data])
                 rhs.append(acc)

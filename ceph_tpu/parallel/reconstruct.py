@@ -31,17 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..ops import rs
-from ..ops.gf import GF_MUL_TABLE
-
-
-def _gf_matmul_gather_local(coding: jnp.ndarray, data: jnp.ndarray) -> jnp.ndarray:
-    """[m, k_local] x [B, k_local, C] -> [B, m, C] GF partial product."""
-    table = jnp.asarray(GF_MUL_TABLE.reshape(-1))
-    idx = (coding.astype(jnp.int32)[:, :, None]
-           * 256 + data.astype(jnp.int32)[:, None, :, :])
-    prods = table[idx]  # [B, m, k_local, C]
-    return jax.lax.reduce(prods, np.uint8(0), jax.lax.bitwise_xor,
-                          dimensions=(2,))
+from ..ops.gf_jax import gf_matmul_gather as _gf_matmul_gather_local
 
 
 class ShardedEC:

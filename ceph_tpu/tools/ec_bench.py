@@ -80,10 +80,12 @@ def run(argv=None) -> dict:
     def encode_once():
         if engine is not None:
             return engine.encode_device(data)
-        return np.stack([
-            np.stack(list(code.encode(set(range(k, k + m)), data[b].reshape(-1))
-                          .values()))
-            for b in range(batch)])
+        stripes = []
+        for b in range(batch):
+            out = code.encode(set(range(k, k + m)), data[b].reshape(-1))
+            # index by chunk id: set/dict iteration order is not id order
+            stripes.append(np.stack([out[i] for i in range(k, k + m)]))
+        return np.stack(stripes)
 
     # erasure patterns for decode
     if args.erased:
@@ -127,8 +129,10 @@ def run(argv=None) -> dict:
 
         def decode_once(pattern):
             survivors = [i for i in range(k + m) if i not in pattern][:k]
-            if engine is not None:
-                # MDS matrix codes: first-k survivor rule (jerasure's)
+            if engine is not None and code.is_mds:
+                # MDS matrix codes: first-k survivor rule (jerasure's).
+                # Non-MDS plugins (SHEC/LRC) must use their own solver —
+                # an arbitrary k-subset can be singular for them.
                 return engine.decode_batch(all_chunks[:, survivors, :],
                                            pattern)
             # non-MDS / locality codes: ask the plugin what to read

@@ -14,7 +14,9 @@ whole suite).
 
 import os
 
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+# x64 is required by the CRUSH straw2 draw math (64-bit fixed point);
+# the EC paths use explicit uint8/int32 dtypes and are unaffected.
+os.environ["JAX_ENABLE_X64"] = "1"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -24,4 +26,5 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 assert not jax.config.jax_platforms or jax.config.jax_platforms == "cpu"

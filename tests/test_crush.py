@@ -1,0 +1,180 @@
+"""CRUSH oracle semantics tests: hash, crush_ln, scalar rule engine.
+
+The reference's own tier-1 tests (`src/test/crush/` — SURVEY.md §5) assert
+mapping invariants and distribution quality; the same checks apply here.
+Byte-goldens vs `crushtool --test` are blocked on the empty reference
+mount (SURVEY.md §0), so the scalar oracle IS the spec and the JAX path
+is tested bit-exact against it (test_crush_jax.py).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (
+    Bucket, CrushMap, Rule, Step, Tunables,
+    build_flat_map, build_hierarchy,
+    ceph_str_hash_rjenkins, crush_hash32_2, crush_hash32_3, crush_ln,
+    do_rule,
+)
+from ceph_tpu.crush.map import CRUSH_ITEM_NONE
+
+
+class TestHash:
+    def test_deterministic(self):
+        assert int(crush_hash32_3(1, 2, 3)) == int(crush_hash32_3(1, 2, 3))
+        assert int(crush_hash32_3(1, 2, 3)) != int(crush_hash32_3(1, 2, 4))
+
+    def test_vector_matches_scalar(self):
+        xs = np.arange(1000, dtype=np.uint32)
+        vec = crush_hash32_2(xs, np.uint32(7))
+        for i in (0, 1, 17, 999):
+            assert int(vec[i]) == int(crush_hash32_2(int(xs[i]), 7))
+
+    def test_distribution_rough_uniform(self):
+        xs = np.arange(20000, dtype=np.uint32)
+        h = crush_hash32_3(xs, np.uint32(3), np.uint32(0)) & np.uint32(0xFFFF)
+        # mean of uniform [0, 0xffff] is 0x7fff.5; allow 1.5% drift
+        assert abs(float(h.mean()) - 0x8000) < 0x8000 * 0.015
+
+    def test_negative_item_ids_wrap(self):
+        # bucket ids are negative; C casts to u32
+        a = crush_hash32_3(5, np.uint32(-2 & 0xFFFFFFFF), 0)
+        b = crush_hash32_3(5, np.uint32(0xFFFFFFFE), 0)
+        assert int(a) == int(b)
+
+    def test_str_hash(self):
+        h1 = ceph_str_hash_rjenkins(b"foo")
+        h2 = ceph_str_hash_rjenkins(b"foo")
+        h3 = ceph_str_hash_rjenkins(b"fop")
+        assert h1 == h2 != h3
+        # cross 12-byte block boundary
+        for n in (0, 1, 11, 12, 13, 24, 25):
+            ceph_str_hash_rjenkins(b"x" * n)
+
+
+class TestCrushLn:
+    def test_endpoints(self):
+        assert int(crush_ln(0)) == 0
+        assert int(crush_ln(0xFFFF)) == 1 << 48
+
+    def test_nearly_monotone(self):
+        # the reference algorithm has a documented boundary glitch (see
+        # ln.py docstring): dips are allowed but must stay below one
+        # fine-table span ≈ 2^48·log2(1+255/2^15)/16
+        xs = np.arange(0x10000, dtype=np.uint32)
+        v = crush_ln(xs).astype(np.int64)
+        d = np.diff(v)
+        span = int((1 << 48) * np.log2(1 + 255 / (1 << 15)) / 16) + 1
+        assert d.min() >= -span
+        assert (d < 0).sum() < 1000
+
+    def test_tracks_log2(self):
+        # fixed point: 2^44 per octave of (x+1); the boundary glitch
+        # bounds worst-case error at ~0.012 octave
+        xs = np.arange(1, 0x10000, dtype=np.uint32)
+        approx = crush_ln(xs).astype(np.float64)
+        exact = np.log2(xs.astype(np.float64) + 1) * (1 << 44)
+        assert np.abs(approx - exact).max() < (1 << 44) * 0.012
+
+
+def _hier():
+    return build_hierarchy(n_racks=3, hosts_per_rack=2, osds_per_host=2)
+
+
+class TestOracle:
+    def test_flat_firstn_distinct_and_stable(self):
+        m = build_flat_map(10)
+        for x in range(50):
+            out = do_rule(m, 0, x, 3)
+            assert len(out) == 3
+            assert len(set(out)) == 3
+            assert all(0 <= o < 10 for o in out)
+            assert out == do_rule(m, 0, x, 3)
+
+    def test_flat_distribution_follows_weights(self):
+        # osd 0 has 3x the weight of the others
+        w = [0x30000] + [0x10000] * 7
+        m = build_flat_map(8, weights=w)
+        counts = np.zeros(8)
+        for x in range(4000):
+            counts[do_rule(m, 0, x, 1)[0]] += 1
+        frac = counts[0] / counts.sum()
+        assert 0.2 < frac < 0.4  # ideal 0.3
+
+    def test_zero_weight_excluded(self):
+        w = [0x10000] * 8
+        w[3] = 0
+        m = build_flat_map(8, weights=w)
+        for x in range(300):
+            assert 3 not in do_rule(m, 0, x, 4)
+
+    def test_reweight_out_excluded(self):
+        m = build_flat_map(8)
+        rw = [0x10000] * 8
+        rw[5] = 0
+        for x in range(300):
+            assert 5 not in do_rule(m, 0, x, 4, weight=rw)
+
+    def test_chooseleaf_distinct_hosts(self):
+        m = _hier()
+        host_of = {}
+        for row, b in enumerate(m.buckets):
+            if b is not None and b.type == 1:
+                for o in b.items:
+                    host_of[o] = b.id
+        for x in range(100):
+            out = do_rule(m, 0, x, 3)
+            assert len(out) == 3
+            hosts = [host_of[o] for o in out]
+            assert len(set(hosts)) == 3
+
+    def test_firstn_more_reps_than_hosts(self):
+        m = _hier()  # 6 hosts
+        out = do_rule(m, 0, 42, 8)
+        # firstn compacts: at most 6 distinct hosts' leaves, no NONE holes
+        assert CRUSH_ITEM_NONE not in out
+        assert len(out) <= 6
+
+    def test_indep_positional_none(self):
+        m = build_hierarchy(3, 2, 2, rule="chooseleaf_indep")
+        out = do_rule(m, 0, 7, 6)
+        assert len(out) == 6
+        placed = [o for o in out if o != CRUSH_ITEM_NONE]
+        assert len(set(placed)) == len(placed)
+        # ask for more shards than hosts exist → NONE holes, positions kept
+        out8 = do_rule(m, 0, 7, 8)
+        assert len(out8) == 8
+        assert any(o == CRUSH_ITEM_NONE for o in out8)
+        # surviving placements keep their slots vs a fresh mapping
+        for i in range(6):
+            if out[i] != CRUSH_ITEM_NONE:
+                assert out[i] in out8 or out8[i] == CRUSH_ITEM_NONE
+
+    def test_indep_stability_under_reweight(self):
+        """Marking one osd out moves ONLY shards on that osd (indep)."""
+        m = build_hierarchy(4, 2, 2, rule="chooseleaf_indep")
+        base = do_rule(m, 0, 123, 4)
+        victim = base[1]
+        rw = [0x10000] * m.max_devices
+        rw[victim] = 0
+        moved = do_rule(m, 0, 123, 4, weight=rw)
+        for i in range(4):
+            if i != 1 and base[i] != CRUSH_ITEM_NONE:
+                assert moved[i] == base[i]
+        assert moved[1] != victim
+
+    def test_uniform_bucket(self):
+        m = CrushMap(types={0: "osd", 10: "root"}, max_devices=8)
+        m.add_bucket(Bucket(id=-1, type=10, alg="uniform",
+                            items=list(range(8)), item_weight=0x10000))
+        m.rules.append(Rule(id=0, name="r", steps=[
+            Step("take", -1), Step("choose_firstn", 0, 0), Step("emit")]))
+        for x in range(50):
+            out = do_rule(m, 0, x, 3)
+            assert len(out) == 3 and len(set(out)) == 3
+
+    def test_legacy_tunables_run(self):
+        m = _hier()
+        m.tunables = Tunables.legacy()
+        out = do_rule(m, 0, 11, 3)
+        assert len(out) == 3 and len(set(out)) == 3
