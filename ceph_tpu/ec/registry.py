@@ -35,7 +35,9 @@ def _load_builtin():
     from .isa import ErasureCodeIsa
     from .lrc import ErasureCodeLrc
     from .shec import ErasureCodeShec
+    from .clay import ErasureCodeClay
     register_plugin("jerasure", ErasureCodeJerasure)
+    register_plugin("clay", ErasureCodeClay)
     register_plugin("isa", ErasureCodeIsa)
     register_plugin("lrc", ErasureCodeLrc)
     register_plugin("shec", ErasureCodeShec)

@@ -1,0 +1,119 @@
+"""Clay (coupled-layer MSR) plugin tests.
+
+Reference test model: ``src/test/erasure-code/TestErasureCodeClay.cc``
+(SURVEY.md §5 tier 1) — round-trip all erasure patterns, verify the
+sub-chunk repair path and its bandwidth advantage.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import create_erasure_code
+from ceph_tpu.ec.clay import ErasureCodeClay, _runs
+
+
+def make(k, m, **extra):
+    prof = {"plugin": "clay", "k": str(k), "m": str(m)}
+    prof.update({key: str(val) for key, val in extra.items()})
+    return create_erasure_code(prof)
+
+
+def payload(ec, nbytes, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=nbytes, dtype=np.uint8)
+
+
+CONFIGS = [
+    (2, 2, {}),           # q=2 t=2, 4 sub-chunks
+    (4, 2, {}),           # q=2 t=3, 8 sub-chunks
+    (3, 2, {}),           # nu=1 shortening, q=2 t=3
+    (4, 3, {"d": 5}),     # non-default d, nu=1, q=2 t=4
+]
+
+
+@pytest.mark.parametrize("k,m,extra", CONFIGS)
+def test_roundtrip_all_erasure_patterns(k, m, extra):
+    ec = make(k, m, **extra)
+    data = payload(ec, 2000 + 13 * k)
+    encoded = ec.encode(set(range(k + m)), data)
+    chunk_size = encoded[0].size
+    assert chunk_size % ec.get_sub_chunk_count() == 0
+    for nerased in range(1, m + 1):
+        for erased in itertools.combinations(range(k + m), nerased):
+            chunks = {i: encoded[i] for i in range(k + m)
+                      if i not in erased}
+            out = ec.decode(set(erased), chunks)
+            for c in erased:
+                assert np.array_equal(out[c], encoded[c]), \
+                    f"chunk {c} mismatch for erasures {erased}"
+
+
+def test_decode_concat_recovers_payload():
+    ec = make(4, 2)
+    data = payload(ec, 4096, seed=3)
+    encoded = ec.encode(set(range(6)), data)
+    got = ec.decode_concat({i: encoded[i] for i in (0, 2, 3, 4)})
+    assert np.array_equal(got[: data.size], data)
+
+
+@pytest.mark.parametrize("k,m", [(2, 2), (4, 2), (3, 2), (6, 3)])
+def test_repair_single_chunk_bandwidth_optimal(k, m):
+    ec = make(k, m)
+    assert isinstance(ec, ErasureCodeClay)
+    data = payload(ec, 3000, seed=k * 10 + m)
+    n = k + m
+    encoded = ec.encode(set(range(n)), data)
+    chunk_size = encoded[0].size
+    sub = chunk_size // ec.get_sub_chunk_count()
+    for lost in range(n):
+        avail = set(range(n)) - {lost}
+        assert ec.is_repair({lost}, avail)
+        need = ec.minimum_to_decode_with_subchunks({lost}, avail)
+        assert set(need) == avail
+        planes = ec.repair_planes(lost)
+        # bandwidth: q^(t-1) of q^t sub-chunks per helper
+        assert len(planes) * ec.q == ec.get_sub_chunk_count()
+        total_runs = sum(cnt for runs in need.values()
+                         for _, cnt in runs)
+        assert total_runs == len(avail) * len(planes)
+        helper = {
+            h: encoded[h].reshape(ec.get_sub_chunk_count(), sub)[planes]
+            for h in avail}
+        got = ec.repair_chunk(lost, helper, chunk_size)
+        assert np.array_equal(got, encoded[lost]), f"repair of {lost} failed"
+        # the repair read strictly fewer bytes than conventional decode
+        read = len(avail) * len(planes) * sub
+        conventional = k * ec.get_sub_chunk_count() * sub
+        assert read < conventional
+
+
+def test_minimum_to_decode_subchunks_full_when_not_repair():
+    ec = make(4, 2)
+    # two losses -> conventional decode, full chunk ranges
+    need = ec.minimum_to_decode_with_subchunks({0, 1}, {2, 3, 4, 5})
+    assert all(runs == [(0, ec.get_sub_chunk_count())]
+               for runs in need.values())
+
+
+def test_nondefault_d_disables_repair_path():
+    ec = make(4, 3, d=5)
+    assert not ec.is_repair({0}, {1, 2, 3, 4, 5, 6})
+    # conventional decode still works with d < k+m-1
+    data = payload(ec, 1024, seed=9)
+    encoded = ec.encode(set(range(7)), data)
+    out = ec.decode({0}, {i: encoded[i] for i in range(1, 7)})
+    assert np.array_equal(out[0], encoded[0])
+
+
+def test_bad_d_rejected():
+    with pytest.raises(Exception):
+        make(4, 2, d=6)  # d > k+m-1
+    with pytest.raises(Exception):
+        make(4, 2, d=4)  # d < k+1
+
+
+def test_runs_helper():
+    assert _runs([0, 1, 2, 5, 6, 9]) == [(0, 3), (5, 2), (9, 1)]
+    assert _runs([]) == []
