@@ -75,6 +75,11 @@ class Step:
     #              # | set_chooseleaf_vary_r | set_chooseleaf_stable
     arg1: int = 0  # take: item id; choose*: numrep; set_*: value
     arg2: int = 0  # choose*: bucket type to select
+    # take-with-class bookkeeping (reference: `step take <root> class <c>`
+    # resolves to a class-filtered shadow bucket): `arg1` holds the shadow
+    # id the mappers walk; `orig`/`cls` keep the source form for decompile.
+    orig: int | None = None
+    cls: str | None = None
 
 
 @dataclass
@@ -98,6 +103,9 @@ class CrushMap:
     device_classes: dict[int, str] = field(default_factory=dict)  # osd id → class
     # balancer weight-sets: bucket id → {"ids": [...], "weight_set": [[w]*size per position]}
     choose_args: dict[int, dict] = field(default_factory=dict)
+    # per-class shadow-tree clone cache: class → {bucket id → clone id|None}
+    _shadow_cache: dict = field(default_factory=dict, repr=False,
+                                compare=False)
 
     def bucket(self, bid: int) -> Bucket:
         row = -1 - bid
@@ -119,6 +127,53 @@ class CrushMap:
             if r.name == name:
                 return r
         raise KeyError(name)
+
+    def class_shadow(self, root_id: int, device_class: str) -> int:
+        """Shadow-tree id for ``take <root> class <cls>`` steps.
+
+        The reference builds per-class clones of every bucket containing
+        only devices of that class (``CrushWrapper::populate_classes`` /
+        ``device_class_clone``); rule takes then walk the clone.  Clone ids
+        here are allocated deterministically below the most negative
+        existing id, cached per (bucket, class).
+
+        Raises KeyError if the filtered subtree is empty.
+        """
+        cache = self._shadow_cache.setdefault(device_class, {})
+
+        def clone(bid: int) -> int | None:
+            if bid in cache:
+                return cache[bid]
+            b = self.bucket(bid)
+            items, weights = [], []
+            for item, w in zip(b.items,
+                               b.weights or [b.item_weight] * b.size):
+                if item >= 0:
+                    if self.device_classes.get(item) == device_class:
+                        items.append(item)
+                        weights.append(w)
+                else:
+                    sub = clone(item)
+                    if sub is not None:
+                        items.append(sub)
+                        weights.append(self.bucket(sub).weight)
+            if not items:
+                cache[bid] = None
+                return None
+            sid = -1 - len(self.buckets)
+            sb = Bucket(id=sid, type=b.type, alg=b.alg, hash=b.hash,
+                        items=items, weights=weights,
+                        item_weight=b.item_weight)
+            self.add_bucket(sb)
+            self.names[sid] = f"{self.names.get(bid, bid)}~{device_class}"
+            cache[bid] = sid
+            return sid
+
+        sid = clone(root_id)
+        if sid is None:
+            raise KeyError(
+                f"no devices of class {device_class!r} under bucket {root_id}")
+        return sid
 
     def max_depth_to_type(self, root_id: int, target_type: int) -> int:
         """Longest descent path (in choose steps) from root to target type."""

@@ -1,0 +1,6 @@
+"""OSD layer: cluster maps, placement groups, backends.
+
+Reference: ``src/osd/`` (SURVEY.md §3.4/§3.5).
+"""
+
+from .osdmap import OSDMap, PGPool, PGid, ceph_stable_mod  # noqa: F401

@@ -1,0 +1,223 @@
+"""crushtool — compile/decompile/test CRUSH maps.
+
+Reference: ``src/tools/crushtool.cc`` (SURVEY.md §3.10); the
+``--test --show-mappings`` output is the second north-star CRUSH harness
+(SURVEY.md §4.5) and the golden-capture source for mapping tests.
+
+Usage::
+
+    crushtool -c map.txt -o map.json          # compile text → map
+    crushtool -d map.json [-o map.txt]        # decompile → text
+    crushtool -i map.json --test --rule 0 --num-rep 3 \
+        --min-x 0 --max-x 1023 --show-mappings
+    crushtool -i map.json --test --show-utilization
+    crushtool --build --num-osds 64 host straw2 4 rack straw2 4 \
+        root straw2 0 -o map.json
+
+Mapping batches run through `BatchMapper` (TPU/JAX path) when the rule
+shape supports it, falling back to the scalar oracle — results are
+identical either way (tests/test_crush_jax.py enforces bit-equality).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..crush import mapper
+from ..crush.compiler import (compile_crushmap, crushmap_from_dict,
+                              crushmap_to_dict, decompile_crushmap,
+                              weight_to_float)
+from ..crush.map import CRUSH_ITEM_NONE, Bucket, CrushMap, Rule, Step
+
+
+def load_map(path: str) -> CrushMap:
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        return crushmap_from_dict(json.loads(text))
+    return compile_crushmap(text)
+
+
+def save_map(cmap: CrushMap, path: str):
+    with open(path, "w") as f:
+        json.dump(crushmap_to_dict(cmap), f, indent=1)
+        f.write("\n")
+
+
+def batch_map(cmap: CrushMap, rule: Rule, xs, num_rep: int,
+              weights=None) -> list[list[int]]:
+    """Map a batch of inputs; JAX path with scalar fallback."""
+    try:
+        from ..crush.jax_mapper import BatchMapper
+        bm = BatchMapper(cmap, rule, result_max=num_rep)
+        res = bm(xs, weights)
+        return [[int(o) for o in row] for row in res]
+    except (NotImplementedError, ValueError, RuntimeError):
+        wl = list(weights) if weights is not None else None
+        return [mapper.do_rule(cmap, rule, int(x), num_rep, wl) for x in xs]
+
+
+def build_hierarchy_args(num_osds: int, layers: list[tuple[str, str, int]],
+                         ) -> CrushMap:
+    """--build: bottom-up layered topology. Each layer (typename, alg,
+    fanout); fanout 0 = one bucket holding everything below."""
+    cmap = CrushMap(types={0: "osd"}, max_devices=num_osds)
+    for i in range(num_osds):
+        cmap.names[i] = f"osd.{i}"
+    cur = list(range(num_osds))
+    cur_w = [0x10000] * num_osds
+    next_bid = -1
+    for li, (tname, alg, fanout) in enumerate(layers, start=1):
+        cmap.types[li] = tname
+        if fanout <= 0:
+            groups = [cur]
+        else:
+            groups = [cur[i:i + fanout] for i in range(0, len(cur), fanout)]
+        nxt, nxt_w = [], []
+        for gi, grp in enumerate(groups):
+            ws = [cur_w[cur.index(it)] for it in grp]
+            b = Bucket(id=next_bid, type=li, alg=alg, items=list(grp),
+                       weights=ws)
+            cmap.add_bucket(b)
+            cmap.names[next_bid] = (tname if len(groups) == 1
+                                    else f"{tname}{gi}")
+            nxt.append(next_bid)
+            nxt_w.append(b.weight)
+            next_bid -= 1
+        cur, cur_w = nxt, nxt_w
+    # default rule: chooseleaf over the layer under the root (the failure
+    # domain), or straight to devices for a single-layer build
+    top_type = len(layers)
+    domain = top_type - 1 if top_type >= 2 else 0
+    cmap.rules.append(Rule(id=0, name="replicated_rule", steps=[
+        Step("take", cur[0]),
+        Step("chooseleaf_firstn", 0, domain),
+        Step("emit")]))
+    return cmap
+
+
+def cmd_test(cmap: CrushMap, args) -> int:
+    rules = [r for r in cmap.rules
+             if args.rule is None or r.id == args.rule]
+    if not rules:
+        print(f"rule {args.rule} not found", file=sys.stderr)
+        return 1
+    weights = None
+    if args.weight:
+        weights = [0x10000] * cmap.max_devices
+        for spec in args.weight:
+            osd, w = spec.split(":") if ":" in spec else spec.split(",")
+            weights[int(osd)] = int(float(w) * 0x10000)
+    min_x, max_x = args.min_x, args.max_x
+    xs = list(range(min_x, max_x + 1))
+    for rule in rules:
+        reps = ([args.num_rep] if args.num_rep
+                else list(range(rule.min_size, rule.max_size + 1)))
+        for num_rep in reps:
+            rows = batch_map(cmap, rule, xs, num_rep, weights)
+            if args.show_mappings:
+                for x, row in zip(xs, rows):
+                    shown = [o for o in row if o != CRUSH_ITEM_NONE] \
+                        if rule.steps and _is_firstn(rule) else \
+                        ["NONE" if o == CRUSH_ITEM_NONE else o for o in row]
+                    print(f"CRUSH rule {rule.id} x {x} {shown}")
+            if args.show_utilization:
+                counts: dict[int, int] = {}
+                placed = 0
+                for row in rows:
+                    for o in row:
+                        if o != CRUSH_ITEM_NONE:
+                            counts[o] = counts.get(o, 0) + 1
+                            placed += 1
+                n_dev = max(cmap.max_devices, 1)
+                avg = placed / n_dev
+                print(f"rule {rule.id} ({rule.name}) num_rep {num_rep} "
+                      f"result size == {placed / len(xs):.2f}\tok for all x")
+                for o in sorted(counts):
+                    print(f"  device {o}:\t\t stored : {counts[o]}\t "
+                          f"expected : {avg:.2f}")
+            if args.show_statistics:
+                sizes: dict[int, int] = {}
+                for row in rows:
+                    got = sum(1 for o in row if o != CRUSH_ITEM_NONE)
+                    sizes[got] = sizes.get(got, 0) + 1
+                for got in sorted(sizes):
+                    print(f"rule {rule.id} ({rule.name}) num_rep {num_rep} "
+                          f"result size == {got}:\t{sizes[got]}/{len(xs)}")
+    return 0
+
+
+def _is_firstn(rule: Rule) -> bool:
+    return any(s.op.endswith("firstn") for s in rule.steps)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="crushtool", description=__doc__)
+    p.add_argument("-c", "--compile", metavar="FILE",
+                   help="compile text map FILE")
+    p.add_argument("-d", "--decompile", metavar="FILE",
+                   help="decompile map FILE to text")
+    p.add_argument("-i", "--in-file", metavar="FILE", help="input map")
+    p.add_argument("-o", "--out-file", metavar="FILE", help="output path")
+    p.add_argument("--build", action="store_true")
+    p.add_argument("--num-osds", type=int, default=0)
+    p.add_argument("layers", nargs="*", default=[],
+                   help="--build layers: NAME ALG SIZE triples")
+    p.add_argument("--test", action="store_true")
+    p.add_argument("--rule", type=int, default=None)
+    p.add_argument("--num-rep", type=int, default=None)
+    p.add_argument("--min-x", type=int, default=0)
+    p.add_argument("--max-x", type=int, default=1023)
+    p.add_argument("--weight", action="append", default=[],
+                   metavar="OSD:W", help="reweight device (repeatable)")
+    p.add_argument("--show-mappings", action="store_true")
+    p.add_argument("--show-utilization", action="store_true")
+    p.add_argument("--show-statistics", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    from ..utils import honor_jax_platforms_env
+    honor_jax_platforms_env()
+    args = build_parser().parse_args(argv)
+    if args.compile:
+        with open(args.compile) as f:
+            cmap = compile_crushmap(f.read())
+        save_map(cmap, args.out_file or args.compile + ".json")
+        return 0
+    if args.decompile:
+        text = decompile_crushmap(load_map(args.decompile))
+        if args.out_file:
+            with open(args.out_file, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
+    if args.build:
+        if args.num_osds <= 0 or len(args.layers) % 3:
+            print("--build needs --num-osds and NAME ALG SIZE triples",
+                  file=sys.stderr)
+            return 1
+        layers = [(args.layers[i], args.layers[i + 1],
+                   int(args.layers[i + 2]))
+                  for i in range(0, len(args.layers), 3)]
+        cmap = build_hierarchy_args(args.num_osds, layers)
+        if args.out_file:
+            save_map(cmap, args.out_file)
+        if args.test:
+            return cmd_test(cmap, args)
+        return 0
+    if args.test:
+        if not args.in_file:
+            print("--test needs -i MAP", file=sys.stderr)
+            return 1
+        return cmd_test(load_map(args.in_file), args)
+    build_parser().print_usage()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,302 @@
+"""OSDMap + CRUSH compiler + tool tests.
+
+Reference test model: ``src/test/crush/`` and ``src/test/osd/TestOSDMap.cc``
+(SURVEY.md §5 tier 1); CLI behavior mirrors ``src/tools/osdmaptool.cc``
+``--test-map-pgs`` and ``src/tools/crushtool.cc`` ``--test``.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.compiler import (compile_crushmap, crushmap_from_dict,
+                                     crushmap_to_dict, decompile_crushmap)
+from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, build_flat_map,
+                                build_hierarchy)
+from ceph_tpu.crush.mapper import do_rule
+from ceph_tpu.osd.osdmap import (Incremental, OSDMap, PGid, TYPE_ERASURE,
+                                 UP, ceph_stable_mod)
+from ceph_tpu.tools.osdmaptool import (map_pool_pgs, osdmap_from_dict,
+                                       osdmap_to_dict, run_test_map_pgs)
+
+MAP_TEXT = """
+# begin crush map
+tunable choose_total_tries 50
+tunable chooseleaf_descend_once 1
+
+# devices
+device 0 osd.0 class hdd
+device 1 osd.1 class ssd
+device 2 osd.2 class hdd
+device 3 osd.3 class ssd
+
+# types
+type 0 osd
+type 1 host
+type 10 root
+
+# buckets
+host node-a {
+    id -2
+    alg straw2
+    hash 0  # rjenkins1
+    item osd.0 weight 1.00000
+    item osd.1 weight 2.00000
+}
+host node-b {
+    id -3
+    alg straw2
+    hash 0
+    item osd.2 weight 1.00000
+    item osd.3 weight 2.00000
+}
+root default {
+    id -1
+    alg straw2
+    hash 0
+    item node-a weight 3.00000
+    item node-b weight 3.00000
+}
+
+# rules
+rule replicated_rule {
+    id 0
+    type replicated
+    min_size 1
+    max_size 10
+    step take default
+    step chooseleaf firstn 0 type host
+    step emit
+}
+rule hdd_rule {
+    id 1
+    type replicated
+    step take default class hdd
+    step chooseleaf firstn 0 type host
+    step emit
+}
+# end crush map
+"""
+
+
+class TestCompiler:
+    def test_compile_basics(self):
+        m = compile_crushmap(MAP_TEXT)
+        assert m.max_devices == 4
+        assert m.tunables.choose_total_tries == 50
+        assert m.device_classes == {0: "hdd", 1: "ssd", 2: "hdd", 3: "ssd"}
+        b = m.bucket(-2)
+        assert b.items == [0, 1]
+        assert b.weights == [0x10000, 0x20000]
+        assert m.bucket(-1).items == [-2, -3]
+        assert [r.name for r in m.rules] == ["replicated_rule", "hdd_rule"]
+
+    def test_class_shadow_resolution(self):
+        m = compile_crushmap(MAP_TEXT)
+        take = m.rules[1].steps[0]
+        assert take.cls == "hdd" and take.orig == -1
+        shadow = m.bucket(take.arg1)
+        # shadow root contains shadow hosts which contain only hdd osds
+        leaves = []
+        for child in shadow.items:
+            leaves.extend(m.bucket(child).items)
+        assert sorted(leaves) == [0, 2]
+        # mapping through the hdd rule only ever lands on hdd devices
+        for x in range(100):
+            out = do_rule(m, m.rules[1], x, 2)
+            assert set(out) <= {0, 2}, (x, out)
+
+    def test_decompile_compile_roundtrip(self):
+        m1 = compile_crushmap(MAP_TEXT)
+        text = decompile_crushmap(m1)
+        m2 = compile_crushmap(text)
+        # identical mapping behavior (the meaningful equality)
+        for rid in (0, 1):
+            for x in range(64):
+                assert do_rule(m1, m1.rules[rid], x, 3) == \
+                    do_rule(m2, m2.rules[rid], x, 3)
+
+    def test_json_roundtrip(self):
+        m1 = compile_crushmap(MAP_TEXT)
+        d = json.loads(json.dumps(crushmap_to_dict(m1)))
+        m2 = crushmap_from_dict(d)
+        for rid in (0, 1):
+            for x in range(64):
+                assert do_rule(m1, m1.rules[rid], x, 3) == \
+                    do_rule(m2, m2.rules[rid], x, 3)
+
+    def test_bad_input_rejected(self):
+        with pytest.raises(Exception):
+            compile_crushmap("bogus line\n")
+        with pytest.raises(Exception):
+            compile_crushmap("rule r {\n step take nosuch\n}\n")
+
+
+class TestStableMod:
+    def test_matches_definition(self):
+        for b in (1, 3, 4, 6, 8, 12, 100):
+            bmask = (1 << max(0, (b - 1)).bit_length()) - 1
+            for x in range(300):
+                got = ceph_stable_mod(x, b, bmask)
+                assert 0 <= got < b
+        # stability: growing pg_num from 4→6 only remaps pgs whose slot split
+        before = {x: ceph_stable_mod(x, 4, 3) for x in range(64)}
+        after = {x: ceph_stable_mod(x, 6, 7) for x in range(64)}
+        for x in range(64):
+            if after[x] != before[x]:
+                assert after[x] >= 4  # moved pgs land only on new slots
+
+
+class TestOSDMap:
+    def make(self, n=8, pg_num=64):
+        m = OSDMap.build_simple(n, pg_bits=0)
+        m.pools[0].pg_num = pg_num
+        m.pools[0].pgp_num = pg_num
+        return m
+
+    def test_build_simple(self):
+        m = self.make()
+        assert m.num_up_osds() == 8
+        assert m.pools[0].name == "rbd"
+
+    def test_object_to_pg_to_osds(self):
+        m = self.make()
+        pg = m.object_locator_to_pg("foo", 0)
+        pg = m.raw_pg_to_pg(pg)
+        assert 0 <= pg.seed < 64
+        up, up_p, acting, acting_p = m.pg_to_up_acting_osds(pg)
+        assert len(up) == 3 and len(set(up)) == 3
+        assert up_p == up[0] and acting == up and acting_p == up_p
+
+    def test_mapping_deterministic_and_spread(self):
+        m = self.make()
+        seen = set()
+        for s in range(64):
+            up, *_ = m.pg_to_up_acting_osds(PGid(0, s))
+            assert up == m.pg_to_up_acting_osds(PGid(0, s))[0]
+            seen.update(up)
+        assert len(seen) == 8  # every osd holds something at 64 pgs
+
+    def test_down_osd_leaves_up_set(self):
+        m = self.make()
+        victim = m.pg_to_up_acting_osds(PGid(0, 0))[0][0]
+        m.mark_down(victim)
+        up, *_ = m.pg_to_up_acting_osds(PGid(0, 0))
+        assert victim not in up
+
+    def test_out_osd_remapped_by_crush(self):
+        m = self.make()
+        victim = m.pg_to_up_acting_osds(PGid(0, 0))[0][0]
+        m.mark_out(victim)
+        up, *_ = m.pg_to_up_acting_osds(PGid(0, 0))
+        assert victim not in up
+        assert len(up) == 3  # CRUSH found a replacement
+
+    def test_pg_temp_overrides_acting(self):
+        m = self.make()
+        pg = PGid(0, 5)
+        up, up_p, *_ = m.pg_to_up_acting_osds(pg)
+        m.pg_temp[pg] = [7, 6, 5]
+        up2, up_p2, acting, acting_p = m.pg_to_up_acting_osds(pg)
+        assert up2 == up and acting == [7, 6, 5] and acting_p == 7
+
+    def test_primary_temp(self):
+        m = self.make()
+        pg = PGid(0, 9)
+        up, *_ = m.pg_to_up_acting_osds(pg)
+        m.primary_temp[pg] = up[1]
+        *_, acting_p = m.pg_to_up_acting_osds(pg)
+        assert acting_p == up[1]
+
+    def test_pg_upmap_items(self):
+        m = self.make()
+        pg = PGid(0, 3)
+        up, *_ = m.pg_to_up_acting_osds(pg)
+        spare = next(o for o in range(8) if o not in up)
+        m.pg_upmap_items[pg] = [(up[1], spare)]
+        up2, *_ = m.pg_to_up_acting_osds(pg)
+        assert up2[1] == spare and up2[0] == up[0] and up2[2] == up[2]
+
+    def test_incremental_roundtrip(self):
+        m = self.make()
+        inc = Incremental(epoch=2, new_weight={3: 0},
+                          new_state={2: UP},  # xor: marks osd.2 down
+                          new_pg_temp={PGid(0, 1): [4, 5, 6]})
+        m.apply_incremental(inc)
+        assert m.epoch == 2 and m.is_out(3) and not m.is_up(2)
+        assert m.pg_temp[PGid(0, 1)] == [4, 5, 6]
+        with pytest.raises(ValueError):
+            m.apply_incremental(Incremental(epoch=9))
+
+    def test_erasure_pool_keeps_holes(self):
+        crush = build_hierarchy(2, 2, 2, rule="chooseleaf_indep")
+        m = OSDMap(crush=crush, max_osd=8)
+        m.epoch = 1
+        for o in range(8):
+            m.osd_state[o] = 3
+        m.create_pool("ecpool", pg_num=32, size=4, type=TYPE_ERASURE)
+        m.mark_down(0)
+        m.mark_down(1)
+        for s in range(32):
+            up, *_ = m.pg_to_up_acting_osds(PGid(0, s))
+            assert len(up) == 4  # positional holes, not compaction
+
+    def test_osdmap_json_roundtrip(self):
+        m = self.make()
+        m.pg_temp[PGid(0, 1)] = [1, 2, 3]
+        m.pg_upmap_items[PGid(0, 2)] = [(0, 7)]
+        m2 = osdmap_from_dict(json.loads(json.dumps(osdmap_to_dict(m))))
+        for s in range(16):
+            assert m.pg_to_up_acting_osds(PGid(0, s)) == \
+                m2.pg_to_up_acting_osds(PGid(0, s))
+
+
+class TestMapPGsBatch:
+    def test_batch_matches_scalar(self):
+        m = OSDMap.build_simple(16, pg_bits=2)
+        jax_res = map_pool_pgs(m, m.pools[0], use_jax=True)
+        scalar = map_pool_pgs(m, m.pools[0], use_jax=False)
+        assert np.array_equal(jax_res, scalar)
+
+    def test_report_runs(self):
+        m = OSDMap.build_simple(8, pg_bits=2)
+        buf = io.StringIO()
+        stats = run_test_map_pgs(m, None, use_jax=False, out=buf)
+        assert stats["pgs"] == 8 << 2
+        assert stats["count"].sum() == (8 << 2) * 3
+        text = buf.getvalue()
+        assert "avg" in text and "stddev" in text and "osd.0" in text
+
+    def test_report_excludes_down_osds(self):
+        m = OSDMap.build_simple(8, pg_bits=2)
+        m.mark_down(0)
+        stats = run_test_map_pgs(m, None, use_jax=False, out=io.StringIO())
+        assert stats["count"][0] == 0
+
+    def test_report_survives_oversized_pg_temp(self):
+        m = OSDMap.build_simple(8, pg_bits=2)
+        m.pg_temp[PGid(0, 1)] = [1, 2, 3, 4]  # wider than pool.size=3
+        stats = run_test_map_pgs(m, None, use_jax=False, out=io.StringIO())
+        assert stats["pgs"] == 8 << 2
+
+    def test_createsimple_erasure_pool(self):
+        m = OSDMap.build_simple(8, pg_bits=0, pool_type=TYPE_ERASURE)
+        pool = m.pools[0]
+        assert pool.is_erasure() and pool.crush_rule == 1
+        up, *_ = m.pg_to_up_acting_osds(PGid(0, 0))
+        assert len(up) == pool.size
+
+    def test_shrink_max_osd(self):
+        m = OSDMap.build_simple(8, pg_bits=0)
+        m.apply_incremental(Incremental(epoch=2, new_max_osd=4))
+        assert (m.max_osd == 4 and len(m.osd_state) == 4
+                and m.num_up_osds() == 4)
+
+    def test_pps_batch_matches_scalar(self):
+        m = OSDMap.build_simple(4, pg_bits=2)
+        pool = m.pools[0]
+        batch = pool.raw_pg_to_pps_batch(np.arange(pool.pg_num))
+        for s in range(pool.pg_num):
+            assert int(batch[s]) == pool.raw_pg_to_pps(s)
