@@ -5,7 +5,9 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference harness is ``ceph_erasure_code_benchmark`` (SURVEY.md §4.4);
 its binary is unavailable (reference mount empty — SURVEY.md §0), so the
 baseline denominator is this machine's CPU running the same GF(2^8)
-region math through the optimised NumPy table path — measured fresh each
+region math through the native C++ engine (``native/`` — the
+gf-complete analog, -O3 -march=native autovectorized), falling back to
+the NumPy table path if the library isn't built.  Measured fresh each
 run and reported via vs_baseline.  BASELINE.md records the protocol.
 """
 
@@ -23,16 +25,24 @@ ITERS = 10
 
 
 def _cpu_baseline_gbps(coding, chunk):
-    from ceph_tpu.ops import rs
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, size=(K, chunk), dtype=np.uint8)
-    rs.encode_oracle(coding, data)  # warm
-    n = 3
+    from ceph_tpu import native
+    if native.available():
+        ec = native.NativeEC(K, M)
+        encode = ec.encode
+        label = "native-c++"
+    else:
+        from ceph_tpu.ops import rs
+        encode = lambda d: rs.encode_oracle(coding, d)  # noqa: E731
+        label = "numpy"
+    encode(data)  # warm
+    n = 5
     t0 = time.perf_counter()
     for _ in range(n):
-        rs.encode_oracle(coding, data)
+        encode(data)
     dt = time.perf_counter() - t0
-    return (n * K * chunk) / dt / 1e9
+    return (n * K * chunk) / dt / 1e9, label
 
 
 def main():
@@ -64,15 +74,16 @@ def main():
     dt = time.perf_counter() - t0
     gbps = (ITERS * BATCH * K * chunk) / dt / 1e9
 
-    base = _cpu_baseline_gbps(coding, chunk)
+    base, base_label = _cpu_baseline_gbps(coding, chunk)
     print(json.dumps({
         "metric": "ec_encode_k8m3_1MiB_GBps",
         "value": round(gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(gbps / base, 2),
+        "baseline": base_label,
     }))
     print(f"# device={jax.devices()[0].device_kind} batch={BATCH} "
-          f"iters={ITERS} cpu_oracle_baseline={base:.3f} GB/s",
+          f"iters={ITERS} cpu_baseline[{base_label}]={base:.3f} GB/s",
           file=sys.stderr)
 
 

@@ -1,0 +1,211 @@
+"""ctypes binding for the native runtime (``native/``).
+
+The reference keeps its EC hot path in native code (gf-complete /
+jerasure, dlopen'd behind ErasureCodePluginRegistry — SURVEY.md §3.6).
+This package binds the framework's C++ analog: the GF(2^8) region
+engine, the reed_sol_van plugin bridge, and the stripe-coalescing ring
+(`native/ec_plugin.h`).  Built with ``make -C native``; everything here
+degrades gracefully (`available()` → False) when the library isn't
+built, and tests skip accordingly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+import numpy as np
+
+_LIB_PATH = Path(__file__).resolve().parents[2] / "native" / \
+    "libceph_tpu_native.so"
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None and _LIB_PATH.exists():
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.gf256_init.restype = None
+        lib.gf256_mul_table.restype = u8p
+        lib.gf256_inv_table.restype = u8p
+        lib.gf256_mul.restype = ctypes.c_uint8
+        lib.gf256_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
+        init = getattr(lib, "__erasure_code_init")
+        init.restype = ctypes.c_int
+        init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.ec_create.restype = ctypes.c_void_p
+        lib.ec_create.argtypes = [ctypes.c_char_p]
+        lib.ec_free.argtypes = [ctypes.c_void_p]
+        lib.ec_k.argtypes = [ctypes.c_void_p]
+        lib.ec_k.restype = ctypes.c_int
+        lib.ec_m.argtypes = [ctypes.c_void_p]
+        lib.ec_m.restype = ctypes.c_int
+        lib.ec_coding_matrix.argtypes = [ctypes.c_void_p]
+        lib.ec_coding_matrix.restype = u8p
+        lib.ec_encode.restype = ctypes.c_int
+        lib.ec_encode.argtypes = [ctypes.c_void_p, u8p, u8p,
+                                  ctypes.c_size_t]
+        lib.ec_decode.restype = ctypes.c_int
+        lib.ec_decode.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int), u8p, u8p,
+                                  ctypes.c_size_t]
+        lib.ec_ring_create.restype = ctypes.c_void_p
+        lib.ec_ring_create.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                       ctypes.c_size_t]
+        lib.ec_ring_free.argtypes = [ctypes.c_void_p]
+        lib.ec_ring_set_executor.restype = None
+        lib.ec_ring_set_executor.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_void_p,
+                                             ctypes.c_void_p]
+        lib.ec_ring_submit.restype = ctypes.c_long
+        lib.ec_ring_submit.argtypes = [ctypes.c_void_p, u8p]
+        lib.ec_ring_flush.restype = ctypes.c_long
+        lib.ec_ring_flush.argtypes = [ctypes.c_void_p]
+        lib.ec_ring_get_parity.restype = ctypes.c_int
+        lib.ec_ring_get_parity.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                           u8p]
+        lib.ec_ring_pending.restype = ctypes.c_size_t
+        lib.ec_ring_pending.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+EXECUTOR_CFUNC = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t, ctypes.c_size_t,
+    ctypes.c_int, ctypes.c_int, ctypes.c_void_p)
+
+
+def _as_u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def gf256_mul_table() -> np.ndarray:
+    lib = _load()
+    ptr = lib.gf256_mul_table()
+    return np.ctypeslib.as_array(ptr, shape=(256, 256)).copy()
+
+
+class NativeEC:
+    """The native plugin instance + coalescing ring, Python view."""
+
+    def __init__(self, k: int, m: int, technique: str = "reed_sol_van"):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native library not built (make -C native)")
+        # NB: getattr — inside a class body the literal name would be
+        # Python-mangled to _NativeEC__erasure_code_init
+        getattr(self._lib, "__erasure_code_init")(b"jax_tpu", b".")
+        prof = f"k={k} m={m} technique={technique}".encode()
+        self._inst = self._lib.ec_create(prof)
+        if not self._inst:
+            raise ValueError(f"ec_create rejected profile {prof!r}")
+        self.k, self.m = k, m
+        self._ring = None
+        self._executor_ref = None   # keep the CFUNC alive
+
+    def close(self):
+        if self._ring:
+            self._lib.ec_ring_free(self._ring)
+            self._ring = None
+        if self._inst:
+            self._lib.ec_free(self._inst)
+            self._inst = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def coding_matrix(self) -> np.ndarray:
+        ptr = self._lib.ec_coding_matrix(self._inst)
+        return np.ctypeslib.as_array(ptr, shape=(self.m, self.k)).copy()
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data [k, chunk] uint8 → parity [m, chunk]."""
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        chunk = data.shape[1]
+        parity = np.empty((self.m, chunk), dtype=np.uint8)
+        rc = self._lib.ec_encode(self._inst, _as_u8p(data),
+                                 _as_u8p(parity), chunk)
+        if rc:
+            raise RuntimeError("ec_encode failed")
+        return parity
+
+    def decode(self, chunks: dict[int, np.ndarray]) -> np.ndarray:
+        """any k survivors → data [k, chunk]."""
+        survivors = sorted(chunks)[: self.k]
+        arrs = np.ascontiguousarray(
+            np.stack([np.asarray(chunks[i], dtype=np.uint8)
+                      for i in survivors]))
+        chunk = arrs.shape[1]
+        out = np.empty((self.k, chunk), dtype=np.uint8)
+        surv = (ctypes.c_int * self.k)(*survivors)
+        rc = self._lib.ec_decode(self._inst, surv, _as_u8p(arrs),
+                                 _as_u8p(out), chunk)
+        if rc:
+            raise RuntimeError("ec_decode failed")
+        return out
+
+    # -- coalescing ring ---------------------------------------------------
+    def ring_open(self, capacity: int, chunk_size: int):
+        if self._ring:
+            self._lib.ec_ring_free(self._ring)
+        self._ring = self._lib.ec_ring_create(self._inst, capacity,
+                                              chunk_size)
+        self._chunk = chunk_size
+        if not self._ring:
+            raise RuntimeError("ec_ring_create failed")
+
+    def ring_set_python_executor(self, fn):
+        """fn(data [B,k,chunk] np.uint8) -> parity [B,m,chunk]; wraps it
+        as the C executor — this is how the JAX/TPU engine plugs into the
+        native bridge (PJRT-in-C++ carries the same signature)."""
+        k, m, chunk = self.k, self.m, self._chunk
+
+        def trampoline(data_p, parity_p, chunk_sz, batch, kk, mm, ctx):
+            try:
+                data = np.ctypeslib.as_array(
+                    data_p, shape=(batch, kk, chunk_sz))
+                parity = fn(data.copy())
+                dst = np.ctypeslib.as_array(
+                    parity_p, shape=(batch, mm, chunk_sz))
+                dst[...] = parity
+                return 0
+            except Exception:
+                return -1
+
+        self._executor_ref = EXECUTOR_CFUNC(trampoline)
+        self._lib.ec_ring_set_executor(
+            self._ring, ctypes.cast(self._executor_ref, ctypes.c_void_p),
+            None)
+
+    def ring_submit(self, data: np.ndarray) -> int:
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        slot = self._lib.ec_ring_submit(self._ring, _as_u8p(data))
+        if slot < 0:
+            raise BufferError("ring full — flush first")
+        return slot
+
+    def ring_flush(self) -> int:
+        n = self._lib.ec_ring_flush(self._ring)
+        if n < 0:
+            raise RuntimeError("ring executor failed")
+        return n
+
+    def ring_parity(self, slot: int) -> np.ndarray:
+        out = np.empty((self.m, self._chunk), dtype=np.uint8)
+        rc = self._lib.ec_ring_get_parity(self._ring, slot, _as_u8p(out))
+        if rc:
+            raise KeyError(f"slot {slot} not available")
+        return out
+
+    def ring_pending(self) -> int:
+        return self._lib.ec_ring_pending(self._ring)

@@ -1,0 +1,233 @@
+/* Plugin bridge implementation — see ec_plugin.h. */
+#include "ec_plugin.h"
+
+#include <mutex>
+#include <new>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "gf256.h"
+
+/* ---- reed_sol_van generator (jerasure-equivalent construction) ------- */
+
+/* Build the systematic Vandermonde generator exactly like
+ * ceph_tpu/ops/rs.py reed_sol_van_matrix (the jerasure
+ * reed_sol_big_vandermonde_distribution_matrix algorithm: extended
+ * Vandermonde, pivot row swap, column scaling, column elimination from
+ * row i down); tests assert C++ bytes == Python bytes. */
+static int build_reed_sol_van(int k, int m, uint8_t *out /* [m][k] */) {
+    gf256_init();
+    const int rows = k + m, cols = k;
+    if (rows > 256 || cols > rows) return -1;
+    static uint8_t v[256 * 256];
+    memset(v, 0, (size_t)rows * cols);
+    /* extended vandermonde: row 0 = e0, last row = e_{cols-1},
+     * interior row i = [1, i, i^2, ...] */
+    v[0] = 1;
+    for (int i = 1; i < rows - 1; i++) {
+        uint8_t acc = 1;
+        for (int j = 0; j < cols; j++) {
+            v[i * cols + j] = acc;
+            acc = gf256_mul(acc, (uint8_t)i);
+        }
+    }
+    v[(rows - 1) * cols + (cols - 1)] = 1;
+    for (int i = 1; i < cols; i++) {
+        /* pivot: first row at/below i with nonzero column i */
+        int j = i;
+        while (j < rows && v[j * cols + i] == 0) j++;
+        if (j >= rows) return -1;
+        if (j != i) {
+            for (int c = 0; c < cols; c++) {
+                uint8_t t = v[j * cols + c];
+                v[j * cols + c] = v[i * cols + c];
+                v[i * cols + c] = t;
+            }
+        }
+        if (v[i * cols + i] != 1) {
+            uint8_t inv = gf256_inv_table()[v[i * cols + i]];
+            for (int r = 0; r < rows; r++)
+                v[r * cols + i] = gf256_mul(v[r * cols + i], inv);
+        }
+        for (int j2 = 0; j2 < cols; j2++) {
+            uint8_t f = v[i * cols + j2];
+            if (j2 == i || f == 0) continue;
+            for (int r = i; r < rows; r++)
+                v[r * cols + j2] ^= gf256_mul(v[r * cols + i], f);
+        }
+    }
+    memcpy(out, v + (size_t)cols * k, (size_t)m * k);
+    return 0;
+}
+
+/* ---- instance -------------------------------------------------------- */
+
+struct ec_instance {
+    int k = 0, m = 0;
+    std::string technique = "reed_sol_van";
+    uint8_t coding[256 * 256];
+};
+
+int __erasure_code_init(const char *plugin_name, const char *directory) {
+    (void)plugin_name;
+    (void)directory;
+    gf256_init();
+    return 0;
+}
+
+ec_instance_t *ec_create(const char *profile) {
+    if (!profile) return nullptr;
+    int k = 0, m = 0;
+    std::string technique = "reed_sol_van";
+    const char *p = profile;
+    while (*p) {
+        while (*p == ' ') p++;
+        const char *eq = strchr(p, '=');
+        if (!eq) break;
+        std::string key(p, eq - p);
+        const char *end = eq + 1;
+        while (*end && *end != ' ') end++;
+        std::string val(eq + 1, end - (eq + 1));
+        if (key == "k") k = atoi(val.c_str());
+        else if (key == "m") m = atoi(val.c_str());
+        else if (key == "technique") technique = val;
+        p = end;
+    }
+    if (k < 1 || m < 1 || k + m > 256) return nullptr;
+    if (technique != "reed_sol_van") return nullptr;  /* bridge scope */
+    auto *inst = new (std::nothrow) ec_instance_t;
+    if (!inst) return nullptr;
+    inst->k = k;
+    inst->m = m;
+    inst->technique = technique;
+    if (build_reed_sol_van(k, m, inst->coding)) {
+        delete inst;
+        return nullptr;
+    }
+    return inst;
+}
+
+void ec_free(ec_instance_t *inst) { delete inst; }
+
+int ec_k(const ec_instance_t *inst) { return inst->k; }
+int ec_m(const ec_instance_t *inst) { return inst->m; }
+const uint8_t *ec_coding_matrix(const ec_instance_t *inst) {
+    return inst->coding;
+}
+
+int ec_encode(ec_instance_t *inst, const uint8_t *data, uint8_t *parity,
+              size_t chunk_size) {
+    gf256_rs_encode_batch(inst->coding, inst->k, inst->m, data, parity,
+                          chunk_size, 1);
+    return 0;
+}
+
+int ec_decode(ec_instance_t *inst, const int *survivors,
+              const uint8_t *chunks, uint8_t *out_data, size_t chunk_size) {
+    const uint8_t *cptr[256];
+    uint8_t *optr[256];
+    for (int i = 0; i < inst->k; i++) {
+        cptr[i] = chunks + (size_t)i * chunk_size;
+        optr[i] = out_data + (size_t)i * chunk_size;
+    }
+    return gf256_rs_decode(inst->coding, inst->k, inst->m, survivors,
+                           cptr, optr, chunk_size);
+}
+
+/* ---- coalescing ring ------------------------------------------------- */
+
+struct ec_ring {
+    ec_instance_t *inst;
+    size_t capacity, chunk;
+    size_t pending = 0;       /* stripes submitted since last flush */
+    long next_slot = 0;       /* monotonically increasing slot ids */
+    long flushed_start = 0;   /* first slot of the last flushed batch */
+    long flushed_count = 0;   /* its size; parity stays readable until
+                               * the next flush overwrites the buffer */
+    uint8_t *data;            /* [capacity][k][chunk] staging */
+    uint8_t *parity;          /* [capacity][m][chunk] results */
+    ec_batch_executor_fn exec = nullptr;
+    void *exec_ctx = nullptr;
+    std::mutex mu;
+};
+
+static int cpu_executor(const uint8_t *data, uint8_t *parity,
+                        size_t chunk, size_t batch, int k, int m,
+                        void *ctx) {
+    ec_instance_t *inst = static_cast<ec_instance_t *>(ctx);
+    gf256_rs_encode_batch(inst->coding, k, m, data, parity, chunk, batch);
+    return 0;
+}
+
+ec_ring_t *ec_ring_create(ec_instance_t *inst, size_t capacity,
+                          size_t chunk_size) {
+    if (!inst || !capacity || !chunk_size) return nullptr;
+    auto *r = new (std::nothrow) ec_ring_t;
+    if (!r) return nullptr;
+    r->inst = inst;
+    r->capacity = capacity;
+    r->chunk = chunk_size;
+    r->data = static_cast<uint8_t *>(
+        malloc(capacity * (size_t)inst->k * chunk_size));
+    r->parity = static_cast<uint8_t *>(
+        malloc(capacity * (size_t)inst->m * chunk_size));
+    if (!r->data || !r->parity) {
+        free(r->data);
+        free(r->parity);
+        delete r;
+        return nullptr;
+    }
+    return r;
+}
+
+void ec_ring_free(ec_ring_t *r) {
+    if (!r) return;
+    free(r->data);
+    free(r->parity);
+    delete r;
+}
+
+void ec_ring_set_executor(ec_ring_t *r, ec_batch_executor_fn fn,
+                          void *ctx) {
+    std::lock_guard<std::mutex> g(r->mu);
+    r->exec = fn;
+    r->exec_ctx = ctx;
+}
+
+long ec_ring_submit(ec_ring_t *r, const uint8_t *data) {
+    std::lock_guard<std::mutex> g(r->mu);
+    if (r->pending >= r->capacity) return -1;
+    size_t row = r->pending++;
+    memcpy(r->data + row * r->inst->k * r->chunk, data,
+           (size_t)r->inst->k * r->chunk);
+    return r->next_slot++;
+}
+
+long ec_ring_flush(ec_ring_t *r) {
+    std::lock_guard<std::mutex> g(r->mu);
+    if (!r->pending) return 0;
+    ec_batch_executor_fn fn = r->exec ? r->exec : cpu_executor;
+    void *ctx = r->exec ? r->exec_ctx : r->inst;
+    int rc = fn(r->data, r->parity, r->chunk, r->pending, r->inst->k,
+                r->inst->m, ctx);
+    if (rc) return -1;
+    long n = (long)r->pending;
+    r->flushed_start = r->next_slot - n;
+    r->flushed_count = n;
+    r->pending = 0;
+    return n;
+}
+
+int ec_ring_get_parity(ec_ring_t *r, long slot, uint8_t *parity) {
+    std::lock_guard<std::mutex> g(r->mu);
+    if (slot < r->flushed_start ||
+        slot >= r->flushed_start + r->flushed_count)
+        return -1;  /* never flushed, or overwritten by a later flush */
+    size_t row = (size_t)(slot - r->flushed_start);
+    memcpy(parity, r->parity + row * r->inst->m * r->chunk,
+           (size_t)r->inst->m * r->chunk);
+    return 0;
+}
+
+size_t ec_ring_pending(const ec_ring_t *r) { return r->pending; }

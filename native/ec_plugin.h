@@ -1,0 +1,91 @@
+/* Erasure-code plugin bridge — the native seam of the framework.
+ *
+ * Reference counterpart: ErasureCodePluginRegistry + ErasureCodePlugin
+ * (src/erasure-code/ErasureCodePlugin.{h,cc}) — the dlopen'd
+ * libec_<name>.so boundary the OSD's ECBackend calls through, and the
+ * seam the jax_tpu backend snaps into (SURVEY.md §3.6, §8 stage 8).
+ *
+ * This library exports:
+ *  - the same entry-point name (__erasure_code_init) so a dlopen-style
+ *    loader finds it;
+ *  - an instance API (create/encode/decode/free) backed by the gf256
+ *    CPU engine by default;
+ *  - a request-coalescing ring: many small stripe encodes batch into
+ *    one launch through a pluggable batch executor.  The host runtime
+ *    (PJRT/TPU, or Python-JAX in tests) registers the executor; with
+ *    none registered the CPU engine runs the batch.  This is the
+ *    "coalescing ring" of SURVEY.md §8 hard-part #4: 4 KiB stripes are
+ *    far too small to feed an MXU one at a time.
+ */
+#ifndef CEPH_TPU_EC_PLUGIN_H
+#define CEPH_TPU_EC_PLUGIN_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct ec_instance ec_instance_t;
+
+/* Registry entry point, named for parity with the reference ABI. */
+int __erasure_code_init(const char *plugin_name, const char *directory);
+
+/* profile: "k=8 m=3 technique=reed_sol_van" (space- or NUL-separated).
+ * Returns NULL on bad profile. */
+ec_instance_t *ec_create(const char *profile);
+void ec_free(ec_instance_t *inst);
+
+int ec_k(const ec_instance_t *inst);
+int ec_m(const ec_instance_t *inst);
+/* generator matrix [m][k], owned by the instance */
+const uint8_t *ec_coding_matrix(const ec_instance_t *inst);
+
+/* Direct (un-coalesced) paths. data: [k][chunk] contiguous;
+ * parity out: [m][chunk]. */
+int ec_encode(ec_instance_t *inst, const uint8_t *data, uint8_t *parity,
+              size_t chunk_size);
+/* survivors: k ids; chunks: [k][chunk] in survivor order;
+ * out: [k][chunk] data chunks. */
+int ec_decode(ec_instance_t *inst, const int *survivors,
+              const uint8_t *chunks, uint8_t *out_data, size_t chunk_size);
+
+/* ---- coalescing ring ------------------------------------------------- */
+
+/* Batch executor: encode `batch` stripes at once.
+ * data [batch][k][chunk] -> parity [batch][m][chunk]; return 0 on ok. */
+typedef int (*ec_batch_executor_fn)(const uint8_t *data, uint8_t *parity,
+                                    size_t chunk_size, size_t batch,
+                                    int k, int m, void *ctx);
+
+typedef struct ec_ring ec_ring_t;
+
+/* capacity: max pending stripes; chunk_size fixed per ring (the OSD's
+ * stripe_unit is per-pool, so one ring per pool/backend). */
+ec_ring_t *ec_ring_create(ec_instance_t *inst, size_t capacity,
+                          size_t chunk_size);
+void ec_ring_free(ec_ring_t *ring);
+
+void ec_ring_set_executor(ec_ring_t *ring, ec_batch_executor_fn fn,
+                          void *ctx);
+
+/* Queue one stripe ([k][chunk] copied in). Returns slot id >= 0, or -1
+ * when full (caller flushes then retries). */
+long ec_ring_submit(ec_ring_t *ring, const uint8_t *data);
+
+/* Run the executor over everything pending; returns number of stripes
+ * encoded, or -1 on executor failure. */
+long ec_ring_flush(ec_ring_t *ring);
+
+/* Fetch parity for a completed slot ([m][chunk] copied out).
+ * Returns 0, or -1 if the slot has not been flushed. */
+int ec_ring_get_parity(ec_ring_t *ring, long slot, uint8_t *parity);
+
+size_t ec_ring_pending(const ec_ring_t *ring);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* CEPH_TPU_EC_PLUGIN_H */

@@ -1,0 +1,171 @@
+/* GF(2^8) region arithmetic — see gf256.h for the role statement.
+ *
+ * Behavior re-created from the reference's semantics (jerasure w=8,
+ * poly 0x11d); implementation is original: split-nibble product tables
+ * (the standard SSSE3-friendly layout) with plain C loops g++ -O3
+ * autovectorizes to pshufb/tbl gathers.
+ */
+#include "gf256.h"
+
+#include <string.h>
+
+#define GF_POLY 0x11d
+
+static uint8_t MUL[256][256];
+static uint8_t INV[256];
+/* split tables: LO[c][x&15] ^ HI[c][x>>4] == MUL[c][x] */
+static uint8_t LO[256][16];
+static uint8_t HI[256][16];
+static int initialized = 0;
+
+static uint8_t slow_mul(uint8_t a, uint8_t b) {
+    uint16_t r = 0, aa = a;
+    while (b) {
+        if (b & 1) r ^= aa;
+        aa <<= 1;
+        if (aa & 0x100) aa ^= GF_POLY;
+        b >>= 1;
+    }
+    return (uint8_t)r;
+}
+
+void gf256_init(void) {
+    if (initialized) return;
+    for (int a = 0; a < 256; a++)
+        for (int b = 0; b < 256; b++)
+            MUL[a][b] = slow_mul((uint8_t)a, (uint8_t)b);
+    for (int a = 1; a < 256; a++)
+        for (int b = 1; b < 256; b++)
+            if (MUL[a][b] == 1) { INV[a] = (uint8_t)b; break; }
+    for (int c = 0; c < 256; c++) {
+        for (int x = 0; x < 16; x++) {
+            LO[c][x] = MUL[c][x];
+            HI[c][x] = MUL[c][x << 4];
+        }
+    }
+    initialized = 1;
+}
+
+const uint8_t *gf256_mul_table(void) { gf256_init(); return &MUL[0][0]; }
+const uint8_t *gf256_inv_table(void) { gf256_init(); return INV; }
+
+uint8_t gf256_mul(uint8_t a, uint8_t b) { gf256_init(); return MUL[a][b]; }
+
+void gf256_region_mul(uint8_t *dst, const uint8_t *src, uint8_t c,
+                      size_t n) {
+    gf256_init();
+    if (c == 0) { memset(dst, 0, n); return; }
+    if (c == 1) { if (dst != src) memmove(dst, src, n); return; }
+    const uint8_t *lo = LO[c], *hi = HI[c];
+    for (size_t i = 0; i < n; i++)
+        dst[i] = (uint8_t)(lo[src[i] & 15] ^ hi[src[i] >> 4]);
+}
+
+void gf256_region_mul_xor(uint8_t *dst, const uint8_t *src, uint8_t c,
+                          size_t n) {
+    gf256_init();
+    if (c == 0) return;
+    if (c == 1) {
+        for (size_t i = 0; i < n; i++) dst[i] ^= src[i];
+        return;
+    }
+    const uint8_t *lo = LO[c], *hi = HI[c];
+    for (size_t i = 0; i < n; i++)
+        dst[i] ^= (uint8_t)(lo[src[i] & 15] ^ hi[src[i] >> 4]);
+}
+
+void gf256_rs_encode(const uint8_t *coding, int k, int m,
+                     const uint8_t *const *data, uint8_t *const *parity,
+                     size_t chunk_size) {
+    gf256_init();
+    for (int j = 0; j < m; j++) {
+        gf256_region_mul(parity[j], data[0], coding[j * k], chunk_size);
+        for (int i = 1; i < k; i++)
+            gf256_region_mul_xor(parity[j], data[i], coding[j * k + i],
+                                 chunk_size);
+    }
+}
+
+void gf256_rs_encode_batch(const uint8_t *coding, int k, int m,
+                           const uint8_t *data, uint8_t *parity,
+                           size_t chunk_size, size_t batch) {
+    for (size_t b = 0; b < batch; b++) {
+        const uint8_t *d[256];
+        uint8_t *p[256];
+        for (int i = 0; i < k; i++)
+            d[i] = data + (b * k + i) * chunk_size;
+        for (int j = 0; j < m; j++)
+            p[j] = parity + (b * m + j) * chunk_size;
+        gf256_rs_encode(coding, k, m, d, p, chunk_size);
+    }
+}
+
+int gf256_mat_invert(const uint8_t *mat, uint8_t *inv, int k) {
+    gf256_init();
+    uint8_t a[256 * 256];
+    if (k <= 0 || k > 256) return -1;
+    memcpy(a, mat, (size_t)k * k);
+    /* identity */
+    memset(inv, 0, (size_t)k * k);
+    for (int i = 0; i < k; i++) inv[i * k + i] = 1;
+    for (int col = 0; col < k; col++) {
+        int pivot = -1;
+        for (int r = col; r < k; r++)
+            if (a[r * k + col]) { pivot = r; break; }
+        if (pivot < 0) return -1;
+        if (pivot != col) {
+            for (int c = 0; c < k; c++) {
+                uint8_t t = a[col * k + c];
+                a[col * k + c] = a[pivot * k + c];
+                a[pivot * k + c] = t;
+                t = inv[col * k + c];
+                inv[col * k + c] = inv[pivot * k + c];
+                inv[pivot * k + c] = t;
+            }
+        }
+        uint8_t pv = INV[a[col * k + col]];
+        for (int c = 0; c < k; c++) {
+            a[col * k + c] = MUL[a[col * k + c]][pv];
+            inv[col * k + c] = MUL[inv[col * k + c]][pv];
+        }
+        for (int r = 0; r < k; r++) {
+            if (r == col) continue;
+            uint8_t f = a[r * k + col];
+            if (!f) continue;
+            for (int c = 0; c < k; c++) {
+                a[r * k + c] ^= MUL[a[col * k + c]][f];
+                inv[r * k + c] ^= MUL[inv[col * k + c]][f];
+            }
+        }
+    }
+    return 0;
+}
+
+int gf256_rs_decode(const uint8_t *coding, int k, int m,
+                    const int *survivors, const uint8_t *const *chunks,
+                    uint8_t *const *out_data, size_t chunk_size) {
+    gf256_init();
+    if (k <= 0 || k > 256 || m < 0 || k + m > 256) return -1;
+    /* generator rows for the survivors: identity row for data ids,
+     * coding row for parity ids */
+    uint8_t sub[256 * 256];
+    for (int r = 0; r < k; r++) {
+        int id = survivors[r];
+        if (id < 0 || id >= k + m) return -1;
+        if (id < k) {
+            memset(&sub[r * k], 0, (size_t)k);
+            sub[r * k + id] = 1;
+        } else {
+            memcpy(&sub[r * k], &coding[(id - k) * k], (size_t)k);
+        }
+    }
+    uint8_t dm[256 * 256];
+    if (gf256_mat_invert(sub, dm, k)) return -1;
+    for (int i = 0; i < k; i++) {
+        gf256_region_mul(out_data[i], chunks[0], dm[i * k], chunk_size);
+        for (int r = 1; r < k; r++)
+            gf256_region_mul_xor(out_data[i], chunks[r], dm[i * k + r],
+                                 chunk_size);
+    }
+    return 0;
+}

@@ -1,0 +1,54 @@
+/* Minimal native self-test (run by `make test`); the thorough
+ * cross-checks against the Python oracle live in tests/test_native.py. */
+#include <assert.h>
+#include <stdio.h>
+#include <string.h>
+
+#include "ec_plugin.h"
+#include "gf256.h"
+
+int main() {
+    assert(__erasure_code_init("jax_tpu", ".") == 0);
+    /* field sanity */
+    assert(gf256_mul(2, 142) == 1 || gf256_mul(2, 141) == 1);
+    for (int a = 1; a < 256; a++)
+        assert(gf256_mul((uint8_t)a, gf256_inv_table()[a]) == 1);
+
+    ec_instance_t *ec = ec_create("k=4 m=2 technique=reed_sol_van");
+    assert(ec && ec_k(ec) == 4 && ec_m(ec) == 2);
+
+    const size_t chunk = 1024;
+    uint8_t data[4 * 1024], parity[2 * 1024], out[4 * 1024];
+    for (size_t i = 0; i < sizeof data; i++) data[i] = (uint8_t)(i * 31 + 7);
+    assert(ec_encode(ec, data, parity, chunk) == 0);
+
+    /* decode with chunks 0 and 2 lost: survivors 1,3,4,5 */
+    int surv[4] = {1, 3, 4, 5};
+    uint8_t chunks[4 * 1024];
+    memcpy(chunks + 0 * chunk, data + 1 * chunk, chunk);
+    memcpy(chunks + 1 * chunk, data + 3 * chunk, chunk);
+    memcpy(chunks + 2 * chunk, parity + 0 * chunk, chunk);
+    memcpy(chunks + 3 * chunk, parity + 1 * chunk, chunk);
+    assert(ec_decode(ec, surv, chunks, out, chunk) == 0);
+    assert(memcmp(out, data, sizeof data) == 0);
+
+    /* ring: coalesce 8 stripes, CPU executor */
+    ec_ring_t *ring = ec_ring_create(ec, 16, chunk);
+    long slots[8];
+    for (int s = 0; s < 8; s++) {
+        slots[s] = ec_ring_submit(ring, data);
+        assert(slots[s] >= 0);
+    }
+    assert(ec_ring_pending(ring) == 8);
+    uint8_t p2[2 * 1024];
+    assert(ec_ring_get_parity(ring, slots[0], p2) == -1); /* pre-flush */
+    assert(ec_ring_flush(ring) == 8);
+    for (int s = 0; s < 8; s++) {
+        assert(ec_ring_get_parity(ring, slots[s], p2) == 0);
+        assert(memcmp(p2, parity, sizeof p2) == 0);
+    }
+    ec_ring_free(ring);
+    ec_free(ec);
+    printf("native selftest ok\n");
+    return 0;
+}
