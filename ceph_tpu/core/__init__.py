@@ -1,0 +1,12 @@
+"""Core runtime & utilities — the L0/L1 layer (SURVEY.md §3.1).
+
+Reference counterparts: ``src/include/buffer.h`` (bufferlist),
+``src/include/encoding.h`` / ``denc.h`` (versioned codec),
+``src/common/config*`` (typed options), ``src/log/`` (subsystem log),
+``src/common/perf_counters.*``, ``src/common/Formatter.*``,
+``src/common/Throttle/Timer/Finisher``, ``src/common/admin_socket.*``,
+``src/common/TrackedOp.*``.
+"""
+
+from .buffer import BufferList, BufferPtr  # noqa: F401
+from .encoding import Decoder, Encoder  # noqa: F401

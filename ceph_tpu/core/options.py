@@ -1,0 +1,78 @@
+"""The option table — typed defaults for every subsystem.
+
+Reference counterpart: ``src/common/options.cc`` /
+``src/common/options/*.yaml.in`` (SURVEY.md §3.1 — ~2000 options
+upstream; this table carries the ones this framework's subsystems
+actually read, same metadata shape)."""
+
+from __future__ import annotations
+
+from .config import Level, Option
+
+
+def build_options() -> list[Option]:
+    return [
+        # -- messenger ----------------------------------------------------
+        Option("ms_bind_port_min", int, 6800, "bind port range start"),
+        Option("ms_bind_port_max", int, 7300, "bind port range end"),
+        Option("ms_connection_timeout", float, 10.0,
+               "connect/handshake timeout (s)"),
+        Option("ms_inject_socket_failures", int, 0,
+               "fault injection: drop 1-in-N sends (0=off)",
+               Level.DEV),
+        Option("ms_crc_data", bool, True, "checksum frame payloads"),
+        # -- mon ----------------------------------------------------------
+        Option("mon_lease", float, 5.0, "paxos lease duration (s)"),
+        Option("mon_election_timeout", float, 5.0,
+               "election restart timeout (s)"),
+        Option("mon_tick_interval", float, 1.0, "mon tick period (s)"),
+        # -- osd ----------------------------------------------------------
+        Option("osd_heartbeat_interval", float, 1.0,
+               "peer ping period (s)"),
+        Option("osd_heartbeat_grace", float, 6.0,
+               "declare peer dead after this silence (s)"),
+        Option("osd_pool_default_size", int, 3, "replicas per object"),
+        Option("osd_pool_default_min_size", int, 2,
+               "min replicas to serve writes"),
+        Option("osd_pool_default_pg_num", int, 32, "default pg count"),
+        Option("osd_max_write_size", int, 90 << 20,
+               "largest single write (bytes)"),
+        Option("osd_op_queue", str, "wpq", "op scheduler",
+               enum_allowed=("wpq", "mclock")),
+        Option("osd_recovery_max_active", int, 3,
+               "concurrent recovery ops per OSD"),
+        Option("osd_scrub_interval", float, 86400.0,
+               "periodic scrub target (s)"),
+        Option("osd_client_message_cap", int, 256,
+               "max in-flight client messages"),
+        # -- erasure coding ----------------------------------------------
+        Option("osd_pool_default_erasure_code_profile", str,
+               "plugin=jerasure technique=reed_sol_van k=2 m=2",
+               "profile for new EC pools"),
+        Option("ec_batch_stripes", int, 64,
+               "stripes coalesced per TPU launch", Level.ADVANCED,
+               min=1, max=65536),
+        # -- objectstore --------------------------------------------------
+        Option("objectstore", str, "memstore", "backend",
+               enum_allowed=("memstore", "kstore")),
+        Option("kstore_path", str, "", "kstore data directory"),
+        Option("kstore_wal_sync", bool, True,
+               "fsync the WAL on each transaction commit"),
+        Option("bluestore_debug_inject_read_err", bool, False,
+               "fault injection: EIO on reads", Level.DEV),
+        # -- client -------------------------------------------------------
+        Option("client_mount_timeout", float, 30.0,
+               "initial mon hunt timeout (s)"),
+        Option("objecter_inflight_ops", int, 1024,
+               "client op throttle"),
+        # -- tpu ----------------------------------------------------------
+        Option("tpu_mesh_shape", str, "auto",
+               "device mesh, e.g. '2x4' or 'auto'"),
+        Option("tpu_ec_min_batch", int, 8,
+               "flush the coalescing ring at this depth", min=1),
+        # -- logging / tracking ------------------------------------------
+        Option("log_ring_size", int, 10000, "gathered entries kept"),
+        Option("op_complaint_time", float, 30.0,
+               "slow-op warning age (s)"),
+        Option("op_history_size", int, 20, "completed ops kept"),
+    ]

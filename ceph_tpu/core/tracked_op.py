@@ -1,0 +1,86 @@
+"""TrackedOp / OpTracker — in-flight op tracking and slow-op warnings.
+
+Reference behavior re-created (``src/common/TrackedOp.{h,cc}``;
+SURVEY.md §3.1/§6.1): every request entering a daemon is wrapped in a
+tracked op that records event timestamps ("queued", "reached_pg",
+"commit_sent"...); the tracker can dump ops-in-flight, keeps a bounded
+history of completed ops (the `dump_historic_ops` admin command), and
+flags ops alive past a complaint age (slow-op health warnings).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+
+class TrackedOp:
+    def __init__(self, tracker: "OpTracker", desc: str):
+        self._tracker = tracker
+        self.description = desc
+        self.initiated_at = time.monotonic()
+        self.events: list[tuple[float, str]] = [(0.0, "initiated")]
+        self.completed_at: float | None = None
+
+    def mark_event(self, name: str):
+        self.events.append((time.monotonic() - self.initiated_at, name))
+
+    def finish(self):
+        self.mark_event("done")
+        self.completed_at = time.monotonic()
+        self._tracker._complete(self)
+
+    @property
+    def age(self) -> float:
+        end = self.completed_at if self.completed_at is not None \
+            else time.monotonic()
+        return end - self.initiated_at
+
+    def dump(self) -> dict:
+        return {
+            "description": self.description,
+            "age": round(self.age, 6),
+            "events": [{"time": round(t, 6), "event": e}
+                       for t, e in self.events],
+        }
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 20,
+                 complaint_time: float = 30.0):
+        self._inflight: dict[int, TrackedOp] = {}
+        self._history: collections.deque[TrackedOp] = collections.deque(
+            maxlen=history_size)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self.complaint_time = complaint_time
+
+    def create_request(self, desc: str) -> TrackedOp:
+        op = TrackedOp(self, desc)
+        with self._lock:
+            self._seq += 1
+            op._id = self._seq
+            self._inflight[op._id] = op
+        return op
+
+    def _complete(self, op: TrackedOp):
+        with self._lock:
+            self._inflight.pop(op._id, None)
+            self._history.append(op)
+
+    # -- introspection (admin socket commands) -----------------------------
+    def dump_ops_in_flight(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._inflight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._history]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def get_slow_ops(self) -> list[TrackedOp]:
+        with self._lock:
+            return [op for op in self._inflight.values()
+                    if op.age > self.complaint_time]

@@ -141,6 +141,9 @@ class NativeEC:
 
     def decode(self, chunks: dict[int, np.ndarray]) -> np.ndarray:
         """any k survivors → data [k, chunk]."""
+        if len(chunks) < self.k:
+            raise ValueError(
+                f"{len(chunks)} surviving chunks < k={self.k}")
         survivors = sorted(chunks)[: self.k]
         arrs = np.ascontiguousarray(
             np.stack([np.asarray(chunks[i], dtype=np.uint8)

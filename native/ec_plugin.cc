@@ -6,6 +6,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <vector>
 
 #include "gf256.h"
 
@@ -20,8 +21,8 @@ static int build_reed_sol_van(int k, int m, uint8_t *out /* [m][k] */) {
     gf256_init();
     const int rows = k + m, cols = k;
     if (rows > 256 || cols > rows) return -1;
-    static uint8_t v[256 * 256];
-    memset(v, 0, (size_t)rows * cols);
+    std::vector<uint8_t> vbuf((size_t)rows * cols, 0);
+    uint8_t *v = vbuf.data();
     /* extended vandermonde: row 0 = e0, last row = e_{cols-1},
      * interior row i = [1, i, i^2, ...] */
     v[0] = 1;
@@ -141,6 +142,7 @@ struct ec_ring {
     ec_instance_t *inst;
     size_t capacity, chunk;
     size_t pending = 0;       /* stripes submitted since last flush */
+    bool flushing = false;    /* executor running (lock dropped) */
     long next_slot = 0;       /* monotonically increasing slot ids */
     long flushed_start = 0;   /* first slot of the last flushed batch */
     long flushed_count = 0;   /* its size; parity stays readable until
@@ -197,7 +199,10 @@ void ec_ring_set_executor(ec_ring_t *r, ec_batch_executor_fn fn,
 
 long ec_ring_submit(ec_ring_t *r, const uint8_t *data) {
     std::lock_guard<std::mutex> g(r->mu);
-    if (r->pending >= r->capacity) return -1;
+    /* a flush is reading the staging rows with the lock dropped; treat
+     * the ring as full rather than corrupt the in-flight batch (also
+     * breaks the executor-calls-submit deadlock: it gets -1) */
+    if (r->flushing || r->pending >= r->capacity) return -1;
     size_t row = r->pending++;
     memcpy(r->data + row * r->inst->k * r->chunk, data,
            (size_t)r->inst->k * r->chunk);
@@ -205,14 +210,27 @@ long ec_ring_submit(ec_ring_t *r, const uint8_t *data) {
 }
 
 long ec_ring_flush(ec_ring_t *r) {
-    std::lock_guard<std::mutex> g(r->mu);
-    if (!r->pending) return 0;
-    ec_batch_executor_fn fn = r->exec ? r->exec : cpu_executor;
-    void *ctx = r->exec ? r->exec_ctx : r->inst;
-    int rc = fn(r->data, r->parity, r->chunk, r->pending, r->inst->k,
+    ec_batch_executor_fn fn;
+    void *ctx;
+    size_t batch;
+    {
+        std::lock_guard<std::mutex> g(r->mu);
+        if (r->flushing) return -1;  /* re-entrant flush */
+        if (!r->pending) return 0;
+        fn = r->exec ? r->exec : cpu_executor;
+        ctx = r->exec ? r->exec_ctx : r->inst;
+        batch = r->pending;
+        r->flushing = true;
+    }
+    /* run the executor unlocked: it may be a Python/JAX trampoline that
+     * takes arbitrary time or calls back into ring APIs (which see
+     * flushing=true and fail cleanly instead of deadlocking) */
+    int rc = fn(r->data, r->parity, r->chunk, batch, r->inst->k,
                 r->inst->m, ctx);
+    std::lock_guard<std::mutex> g(r->mu);
+    r->flushing = false;
     if (rc) return -1;
-    long n = (long)r->pending;
+    long n = (long)batch;
     r->flushed_start = r->next_slot - n;
     r->flushed_count = n;
     r->pending = 0;

@@ -1,0 +1,415 @@
+"""Core runtime tests (L0/L1 — SURVEY.md §3.1).
+
+Reference test model: ``src/test/bufferlist.cc``, ``src/test/encoding/``,
+``src/test/common/`` (SURVEY.md §5 tier 1).
+"""
+
+import io
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.core.admin_socket import admin_command
+from ceph_tpu.core.auth import (AuthClient, AuthError, AuthServer,
+                                CryptoKey, KeyRing, ServiceVerifier)
+from ceph_tpu.core.buffer import BufferList, BufferPtr
+from ceph_tpu.core.config import ConfigError, ConfigProxy, Option
+from ceph_tpu.core.context import CephContext
+from ceph_tpu.core.encoding import DecodeError, Decoder, Encoder
+from ceph_tpu.core.formatter import Formatter
+from ceph_tpu.core.log import Log
+from ceph_tpu.core.perf_counters import PerfCountersBuilder
+from ceph_tpu.core.threading_utils import (Finisher, SafeTimer,
+                                           ShardedThreadPool, Throttle)
+from ceph_tpu.core.tracked_op import OpTracker
+
+
+class TestBufferList:
+    def test_append_and_flatten(self):
+        bl = BufferList()
+        bl.append(b"hello ")
+        bl.append(b"world")
+        assert len(bl) == 11 and bytes(bl) == b"hello world"
+        assert bl.num_buffers == 2
+        bl.rebuild()
+        assert bl.num_buffers == 1 and bytes(bl) == b"hello world"
+
+    def test_numpy_zero_copy_in(self):
+        arr = np.arange(16, dtype=np.uint8)
+        bl = BufferList(arr)
+        assert bytes(bl) == arr.tobytes()
+        out = bl.to_numpy()
+        assert np.array_equal(out, arr)
+
+    def test_substr_of_no_copy(self):
+        bl = BufferList()
+        bl.append(b"aaaa")
+        bl.append(b"bbbb")
+        bl.append(b"cccc")
+        sub = BufferList().substr_of(bl, 2, 8)
+        assert bytes(sub) == b"aabbbbcc"
+        assert sub.num_buffers == 3  # views, not copies
+        with pytest.raises(IndexError):
+            BufferList().substr_of(bl, 8, 8)
+
+    def test_claim_append_moves(self):
+        a = BufferList(b"xy")
+        b = BufferList(b"z")
+        a.claim_append(b)
+        assert bytes(a) == b"xyz" and len(b) == 0
+
+    def test_crc_and_eq(self):
+        a = BufferList(b"data")
+        b = BufferList()
+        b.append(b"da")
+        b.append(b"ta")
+        assert a.crc32c() == b.crc32c()
+        assert a == b and a == b"data"
+
+    def test_ptr_substr(self):
+        p = BufferPtr(b"0123456789")
+        assert bytes(p.substr(3, 4)) == b"3456"
+
+
+class TestEncoding:
+    def test_scalar_roundtrip(self):
+        e = Encoder()
+        e.u8(7); e.u16(300); e.u32(1 << 20); e.u64(1 << 40)  # noqa: E702
+        e.s32(-5); e.s64(-(1 << 33)); e.f64(2.5)  # noqa: E702
+        e.boolean(True); e.string("héllo"); e.blob(b"\x00\x01")  # noqa: E702
+        d = Decoder(bytes(e))
+        assert (d.u8(), d.u16(), d.u32(), d.u64()) == (
+            7, 300, 1 << 20, 1 << 40)
+        assert (d.s32(), d.s64(), d.f64()) == (-5, -(1 << 33), 2.5)
+        assert d.boolean() is True
+        assert d.string() == "héllo" and d.blob() == b"\x00\x01"
+        assert d.remaining() == 0
+
+    def test_containers(self):
+        e = Encoder()
+        e.list_of([1, 2, 3], lambda enc, v: enc.u32(v))
+        e.map_of({"a": 1, "b": 2}, lambda enc, k: enc.string(k),
+                 lambda enc, v: enc.u64(v))
+        d = Decoder(bytes(e))
+        assert d.list_of(lambda dd: dd.u32()) == [1, 2, 3]
+        assert d.map_of(lambda dd: dd.string(),
+                        lambda dd: dd.u64()) == {"a": 1, "b": 2}
+
+    def test_struct_versioning_skips_new_fields(self):
+        # a v2 encoder writes an extra field; a v1-aware decoder must
+        # read the v1 fields and skip the rest cleanly
+        e = Encoder()
+        with e.struct_block(version=2, compat=1):
+            e.u32(42)
+            e.string("newfield")
+        e.u32(0xDEAD)  # data after the struct
+        d = Decoder(bytes(e))
+        with d.struct_block(understood_version=1) as blk:
+            assert blk.dec.u32() == 42
+            assert blk.version == 2
+            # v1 decoder stops here; FINISH skips "newfield"
+        assert d.u32() == 0xDEAD
+
+    def test_struct_compat_refusal(self):
+        e = Encoder()
+        with e.struct_block(version=3, compat=3):
+            e.u32(1)
+        d = Decoder(bytes(e))
+        with pytest.raises(DecodeError):
+            with d.struct_block(understood_version=2):
+                pass
+
+    def test_truncation_detected(self):
+        e = Encoder()
+        e.u64(1)
+        d = Decoder(bytes(e)[:5])
+        with pytest.raises(DecodeError):
+            d.u64()
+
+
+class TestConfig:
+    def make(self):
+        return ConfigProxy([
+            Option("a_int", int, 5, min=0, max=100),
+            Option("a_str", str, "x", enum_allowed=("x", "y")),
+            Option("a_bool", bool, False),
+        ])
+
+    def test_defaults_and_layering(self):
+        c = self.make()
+        assert c.get("a_int") == 5
+        c.set("a_int", 7, "file")
+        c.set("a_int", 9, "cmdline")
+        assert c.get("a_int") == 9            # cmdline beats file
+        c.set("a_int", 8, "env")
+        assert c.get("a_int") == 9            # env does NOT beat cmdline
+        c.rm("a_int", "cmdline")
+        assert c.get("a_int") == 8
+        assert c.source_of("a_int") == "env"
+
+    def test_validation(self):
+        c = self.make()
+        with pytest.raises(ConfigError):
+            c.set("a_int", 1000)
+        with pytest.raises(ConfigError):
+            c.set("a_str", "z")
+        with pytest.raises(ConfigError):
+            c.set("nosuch", 1)
+        c.set("a_bool", "true")
+        assert c.get("a_bool") is True
+
+    def test_observers_fire_on_effective_change(self):
+        c = self.make()
+        seen = []
+        c.add_observer("a_int", lambda k, v: seen.append(v))
+        c.set("a_int", 6, "override")
+        c.set("a_int", 3, "file")      # masked by override → no callback
+        assert seen == [6]
+
+    def test_injectargs_and_file(self):
+        c = self.make()
+        c.injectargs("--a-int 12 --a_str=y")
+        assert c.get("a_int") == 12 and c.get("a_str") == "y"
+        with tempfile.NamedTemporaryFile("w", suffix=".conf",
+                                         delete=False) as f:
+            f.write("[global]\na_int = 33  # comment\nunknown = 1\n")
+            path = f.name
+        try:
+            c2 = self.make()
+            c2.load_file(path)
+            assert c2.get("a_int") == 33
+        finally:
+            os.unlink(path)
+        assert "a_int" in c.diff()
+
+
+class TestLog:
+    def test_gather_vs_print(self):
+        sink = io.StringIO()
+        log = Log(ring_size=100, sink=sink)
+        log.set_level("osd", 1, gather=5)
+        log.dout("osd", 1, "printed")
+        log.dout("osd", 5, "gathered only")
+        log.dout("osd", 9, "dropped")
+        printed = sink.getvalue()
+        assert "printed" in printed and "gathered only" not in printed
+        dump = io.StringIO()
+        n = log.dump_recent(out=dump)
+        assert n == 2 and "gathered only" in dump.getvalue()
+        # ring cleared after dump
+        assert log.dump_recent(out=io.StringIO()) == 0
+
+
+class TestPerfCounters:
+    def test_counters_and_dump(self):
+        pc = (PerfCountersBuilder("osd")
+              .add_u64_counter("ops", "client ops")
+              .add_u64("queue_len")
+              .add_time_avg("op_latency")
+              .add_histogram("op_size_hist")
+              .create_perf_counters())
+        pc.inc("ops")
+        pc.inc("ops", 2)
+        pc.set("queue_len", 5)
+        pc.dec("queue_len")
+        pc.tinc("op_latency", 0.5)
+        pc.tinc("op_latency", 1.5)
+        pc.hinc("op_size_hist", 4096)
+        d = pc.dump()["osd"]
+        assert d["ops"] == 3 and d["queue_len"] == 4
+        assert d["op_latency"] == {"avgcount": 2, "sum": 2.0}
+        assert pc.avg("op_latency") == 1.0
+        assert sum(d["op_size_hist"]["values"][0]) == 1
+        schema = pc.schema()["osd"]
+        assert schema["ops"]["type"] == "u64"
+
+
+class TestFormatter:
+    def fill(self, f):
+        f.open_object()
+        f.dump_int("epoch", 3)
+        f.open_array("osds")
+        for i in range(2):
+            f.open_object()
+            f.dump_string("name", f"osd.{i}")
+            f.dump_bool("up", i == 0)
+            f.close_object()
+        f.close_array()
+        f.close_object()
+        return f.flush()
+
+    def test_json(self):
+        out = json.loads(self.fill(Formatter.create("json")))
+        assert out["epoch"] == 3 and out["osds"][1]["up"] is False
+
+    def test_xml(self):
+        text = self.fill(Formatter.create("xml"))
+        assert "<epoch>3</epoch>" in text and text.count("<name>") == 2
+
+    def test_table(self):
+        f = Formatter.create("table")
+        for i in range(2):
+            f.open_object()
+            f.dump_string("name", f"osd.{i}")
+            f.dump_int("pgs", 10 * i)
+            f.close_object()
+        text = f.flush()
+        lines = text.splitlines()
+        assert "NAME" in lines[0] and "PGS" in lines[0]
+        assert "osd.1" in lines[2]
+
+
+class TestThrottle:
+    def test_blocking_budget(self):
+        t = Throttle("bytes", 10)
+        assert t.get(6) and t.get(4)
+        assert not t.get_or_fail(1)
+        done = []
+
+        def waiter():
+            t.get(5)
+            done.append(1)
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.05)
+        assert not done
+        t.put(6)
+        th.join(timeout=2)
+        assert done
+        t.put(4)
+        t.put(5)
+        with pytest.raises(ValueError):
+            t.put(99)
+
+    def test_timeout(self):
+        t = Throttle("x", 1)
+        t.get(1)
+        assert t.get(1, timeout=0.05) is False
+
+
+class TestTimersAndPools:
+    def test_safe_timer_fires_and_cancels(self):
+        timer = SafeTimer("t")
+        fired = []
+        timer.add_event_after(0.05, lambda: fired.append("a"))
+        tok = timer.add_event_after(0.05, lambda: fired.append("b"))
+        assert timer.cancel_event(tok)
+        time.sleep(0.2)
+        assert fired == ["a"]
+        timer.shutdown()
+
+    def test_finisher_drains(self):
+        fin = Finisher("f")
+        got = []
+        for i in range(10):
+            fin.queue(lambda i=i: got.append(i))
+        assert fin.wait_for_empty(timeout=2)
+        assert got == list(range(10))
+        fin.shutdown()
+
+    def test_sharded_pool_orders_within_shard(self):
+        tp = ShardedThreadPool(num_shards=4)
+        order = {k: [] for k in range(8)}
+        for i in range(50):
+            for k in range(8):
+                tp.queue(k, lambda k=k, i=i: order[k].append(i))
+        assert tp.wait_for_empty(timeout=5)
+        tp.shutdown()
+        for k in range(8):
+            assert order[k] == list(range(50))
+
+
+class TestTrackedOp:
+    def test_inflight_history_slow(self):
+        tr = OpTracker(history_size=2, complaint_time=0.01)
+        op1 = tr.create_request("osd_op(write a)")
+        op1.mark_event("queued")
+        assert tr.dump_ops_in_flight()["num_ops"] == 1
+        time.sleep(0.02)
+        assert tr.get_slow_ops() == [op1]
+        op1.finish()
+        assert tr.dump_ops_in_flight()["num_ops"] == 0
+        hist = tr.dump_historic_ops()
+        assert hist["num_ops"] == 1
+        events = [e["event"] for e in hist["ops"][0]["events"]]
+        assert events == ["initiated", "queued", "done"]
+
+
+class TestAuth:
+    def setup_method(self):
+        self.keyring = KeyRing()
+        self.client_key = self.keyring.add(
+            "client.admin", caps={"osd": "allow *", "mon": "allow r"})
+        self.svc_key = CryptoKey()
+        self.server = AuthServer(self.keyring, {"osd": self.svc_key})
+
+    def test_full_ticket_flow(self):
+        reply = self.server.handle_auth_request("client.admin", "osd")
+        client = AuthClient("client.admin", self.client_key)
+        ticket = client.open_session(reply, "osd")
+        nonce = os.urandom(16)
+        authorizer = ticket.make_authorizer(nonce)
+        verifier = ServiceVerifier("osd", self.svc_key)
+        entity, session, caps = verifier.verify_authorizer(authorizer,
+                                                           nonce)
+        assert entity == "client.admin" and caps == "allow *"
+        # both ends now share the session key: signing works across
+        msg = b"frame-payload"
+        assert session.verify(msg, ticket.session_key.sign(msg))
+
+    def test_forged_proof_rejected(self):
+        reply = self.server.handle_auth_request("client.admin", "osd")
+        client = AuthClient("client.admin", self.client_key)
+        ticket = client.open_session(reply, "osd")
+        authorizer = ticket.make_authorizer(os.urandom(16))
+        verifier = ServiceVerifier("osd", self.svc_key)
+        with pytest.raises(AuthError):
+            verifier.verify_authorizer(authorizer, os.urandom(16))
+
+    def test_wrong_client_key_cannot_open(self):
+        reply = self.server.handle_auth_request("client.admin", "osd")
+        mallory = AuthClient("client.admin", CryptoKey())
+        with pytest.raises(AuthError):
+            mallory.open_session(reply, "osd")
+
+    def test_unknown_entity_or_service(self):
+        with pytest.raises(AuthError):
+            self.server.handle_auth_request("client.nobody", "osd")
+        with pytest.raises(AuthError):
+            self.server.handle_auth_request("client.admin", "mds")
+
+    def test_keyring_file_roundtrip(self):
+        text = self.keyring.dump()
+        kr2 = KeyRing.load(text)
+        assert kr2.get("client.admin").key.secret == \
+            self.client_key.secret
+        assert kr2.get("client.admin").caps["osd"] == "allow *"
+
+
+class TestCephContext:
+    def test_admin_socket_end_to_end(self):
+        with CephContext("testd") as ctx:
+            pc = (PerfCountersBuilder("sub").add_u64_counter("n")
+                  .create_perf_counters())
+            pc.inc("n", 4)
+            ctx.perf.add(pc)
+            sock = ctx.admin.path
+            assert admin_command(sock, "version")["version"]
+            assert admin_command(sock, "perf dump")["sub"]["n"] == 4
+            got = admin_command(sock, "config get",
+                                var="osd_pool_default_size")
+            assert got["osd_pool_default_size"] == 3
+            admin_command(sock, "config set",
+                          var="osd_pool_default_size", val="5")
+            assert admin_command(
+                sock, "config get", var="osd_pool_default_size")[
+                    "osd_pool_default_size"] == 5
+            helplist = admin_command(sock, "help")
+            assert "perf dump" in helplist
+            assert "error" in admin_command(sock, "nonsense")

@@ -59,6 +59,14 @@ def test_encode_decode_match_oracle():
     ec.close()
 
 
+def test_decode_with_too_few_chunks_rejected():
+    ec = native.NativeEC(4, 2)
+    chunks = {i: np.zeros(64, dtype=np.uint8) for i in range(3)}  # < k
+    with pytest.raises(ValueError):
+        ec.decode(chunks)
+    ec.close()
+
+
 def test_bad_profile_rejected():
     with pytest.raises(ValueError):
         native.NativeEC(0, 2)
