@@ -162,10 +162,13 @@ class ConfigProxy:
             tok = toks[i]
             if not tok.startswith("--"):
                 raise ConfigError(f"expected --option, got {tok!r}")
-            key = tok[2:].replace("-", "_")
+            key = tok[2:]
             if "=" in key:
                 key, val = key.split("=", 1)
+                key = key.replace("-", "_")  # normalize KEY only —
+                # values (paths, profiles) may legitimately contain '-'
             else:
+                key = key.replace("-", "_")
                 i += 1
                 if i >= len(toks):
                     raise ConfigError(f"--{key} missing value")

@@ -100,8 +100,6 @@ class XMLFormatter(Formatter):
         self._out.write(f"<{tag}>")
         self._stack.append(tag)
 
-    close_array_tag = None
-
     def close_object(self):
         self._out.write(f"</{self._stack.pop()}>")
 

@@ -12,7 +12,6 @@ averages; histograms use logarithmic buckets on both axes.
 from __future__ import annotations
 
 import math
-import threading
 from dataclasses import dataclass, field
 
 U64 = "u64"          # monotonically increasing counter
@@ -61,7 +60,10 @@ class PerfCounters:
     def __init__(self, name: str):
         self.name = name
         self._counters: dict[str, _Counter] = {}
-        self._lock = threading.Lock()
+        # no lock by design: updates are single int/float ops (GIL-
+        # atomic); dump() may observe a (sum, count) pair mid-update,
+        # which metrics readers tolerate — the reference makes the same
+        # tradeoff with relaxed atomics
 
     # -- updates (hot path) ------------------------------------------------
     def inc(self, name: str, by: float = 1):

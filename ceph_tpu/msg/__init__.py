@@ -1,0 +1,20 @@
+"""Messenger — the distributed communication backend (L2).
+
+Reference: ``src/msg/``, ``src/msg/async/`` (SURVEY.md §3.2).  The
+reference's AsyncMessenger is N epoll worker threads; here one asyncio
+event loop per Messenger carries all connections (the GIL makes extra
+loops pure overhead), with the same externally visible contract:
+per-connection ordered delivery, typed messages, authenticated and
+CRC-protected frames, reconnect with session resume, fault injection.
+
+The DATA plane of this framework deliberately does NOT ride this
+messenger: bulk chunk movement between TPU shards is XLA collectives
+over ICI (``ceph_tpu.parallel``) — SURVEY.md §3.2's "TPU-native
+equivalent".  This messenger is the control plane (maps, peering,
+heartbeats, client ops).
+"""
+
+from .message import (MSG_REGISTRY, Message, MGenericPing,  # noqa: F401
+                      MGenericReply, register_message)
+from .messenger import (Connection, Dispatcher, EntityAddr,  # noqa: F401
+                        Messenger)

@@ -175,6 +175,10 @@ class TestConfig:
         c = self.make()
         c.injectargs("--a-int 12 --a_str=y")
         assert c.get("a_int") == 12 and c.get("a_str") == "y"
+        # dashes in VALUES must survive (only the key normalizes)
+        c2 = ConfigProxy([Option("p", str, "")])
+        c2.injectargs("--p=/data/my-store")
+        assert c2.get("p") == "/data/my-store"
         with tempfile.NamedTemporaryFile("w", suffix=".conf",
                                          delete=False) as f:
             f.write("[global]\na_int = 33  # comment\nunknown = 1\n")
