@@ -1,0 +1,10 @@
+"""Monitor cluster — consensus and authoritative cluster maps (L4).
+
+Reference: ``src/mon/`` (SURVEY.md §3.4): a small Paxos quorum holds
+every authoritative map (OSDMap, monmap, auth, config); daemons and
+clients subscribe for updates and send commands.
+"""
+
+from .client import MonClient  # noqa: F401
+from .monitor import Monitor, MonMap  # noqa: F401
+from .store import MonitorDBStore  # noqa: F401

@@ -1,0 +1,136 @@
+"""MonClient — client-side mon session: hunt, commands, subscriptions.
+
+Reference behavior re-created (``src/mon/MonClient.{h,cc}``; SURVEY.md
+§3.4): pick a mon from the monmap, keep the session alive, resend
+commands on failover (mutating commands are leader-only, so a -11
+"not leader" reply triggers a reconnect to the leader), and maintain
+subscriptions (``sub_want``) — the osdmap feed every daemon lives on.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+
+from ..msg import Dispatcher, Messenger
+from . import messages as M
+from .monitor import MonMap
+
+
+class MonClient(Dispatcher):
+    def __init__(self, monmap: MonMap, entity: str = "client.admin",
+                 timeout: float = 10.0):
+        self.monmap = monmap
+        self.entity = entity
+        self.timeout = timeout
+        self.msgr = Messenger(entity)
+        self.msgr.add_dispatcher(self)
+        self._con = None
+        self._cur_rank: int | None = None
+        self._tid = 0
+        self._waiters: dict[int, tuple[threading.Event, list]] = {}
+        self._subs: dict[str, int] = {}
+        self.osdmap_epoch = 0
+        self.osdmap_dict: dict | None = None
+        self.on_osdmap = None       # cb(epoch, map_dict)
+        self._lock = threading.Lock()
+
+    # -- session -----------------------------------------------------------
+    def _connect(self, rank: int | None = None):
+        ranks = self.monmap.ranks()
+        order = [rank] if rank is not None else \
+            random.sample(ranks, len(ranks))
+        last_err = None
+        for r in order:
+            try:
+                self._con = self.msgr.connect_to(self.monmap.mons[r])
+                self._cur_rank = r
+                if self._subs:
+                    self._con.send_message(
+                        M.MMonSubscribe(what=dict(self._subs)))
+                return
+            except (ConnectionError, OSError) as e:
+                last_err = e
+        raise ConnectionError(f"no monitor reachable: {last_err}")
+
+    def _ensure(self):
+        if self._con is None or not self._con.is_connected:
+            self._connect()
+
+    def shutdown(self):
+        self.msgr.shutdown()
+
+    # -- commands ----------------------------------------------------------
+    def command(self, cmd: dict | str, timeout: float | None = None):
+        """→ (rc, status_str, output).  Retries against the leader when
+        a peon refuses a mutating command."""
+        if isinstance(cmd, str):
+            cmd = {"prefix": cmd}
+        deadline = timeout if timeout is not None else self.timeout
+        for _attempt in range(4):
+            self._ensure()
+            with self._lock:
+                self._tid += 1
+                tid = self._tid
+                ev = threading.Event()
+                self._waiters[tid] = (ev, [])
+            try:
+                self._con.send_message(M.MMonCommand(tid=tid, cmd=cmd))
+            except ConnectionError:
+                self._con = None
+                continue
+            if not ev.wait(deadline):
+                with self._lock:
+                    self._waiters.pop(tid, None)
+                self._con = None     # mon silent: hunt a new one
+                continue
+            with self._lock:
+                _, box = self._waiters.pop(tid)
+            reply = box[0]
+            if reply.rc == -11:      # not leader: follow the referral
+                leader = (reply.outb or {}).get("leader")
+                self._con = None
+                self._connect(leader if leader is not None else None)
+                continue
+            return reply.rc, reply.outs, reply.outb
+        raise TimeoutError(f"mon command {cmd.get('prefix')!r} failed")
+
+    # -- subscriptions -----------------------------------------------------
+    def sub_want(self, what: str, start: int = 0):
+        self._subs[what] = start
+        self._ensure()
+        self._con.send_message(M.MMonSubscribe(what={what: start}))
+
+    def wait_for_osdmap(self, min_epoch: int = 1,
+                        timeout: float = 10.0) -> dict:
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.osdmap_dict is not None and \
+                    self.osdmap_epoch >= min_epoch:
+                return self.osdmap_dict
+            time.sleep(0.02)
+        raise TimeoutError(f"osdmap epoch {min_epoch} not seen")
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, M.MMonCommandReply):
+            with self._lock:
+                waiter = self._waiters.get(msg.tid)
+                if waiter:
+                    waiter[1].append(msg)
+                    waiter[0].set()
+            return True
+        if isinstance(msg, M.MOSDMapMsg):
+            if msg.epoch >= self.osdmap_epoch:
+                self.osdmap_epoch = msg.epoch
+                self.osdmap_dict = msg.osdmap
+                if self.on_osdmap:
+                    self.on_osdmap(msg.epoch, msg.osdmap)
+            return True
+        return False
+
+    def ms_handle_reset(self, con):
+        if con is self._con:
+            self._con = None

@@ -1,0 +1,89 @@
+"""Monitor message types (reference ``src/messages/MMon*.h``,
+``MOSDBoot/MOSDFailure/MOSDMap`` — SURVEY.md §3.2/§3.4).  Payloads are
+JSON-in-frame: the control plane optimizes for evolvability, not bytes.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..msg.message import Message, register_message
+
+
+class _JsonMessage(Message):
+    """Base: one JSON object as payload."""
+
+    FIELDS: tuple = ()
+
+    def __init__(self, **kw):
+        super().__init__()
+        for f in self.FIELDS:
+            setattr(self, f, kw.get(f))
+
+    def encode_payload(self, enc):
+        enc.string(json.dumps({f: getattr(self, f) for f in self.FIELDS}))
+
+    def decode_payload(self, dec, version):
+        data = json.loads(dec.string())
+        for f in self.FIELDS:
+            setattr(self, f, data.get(f))
+
+
+@register_message
+class MMonElection(_JsonMessage):
+    TYPE = 16
+    FIELDS = ("payload",)
+
+
+@register_message
+class MMonPaxos(_JsonMessage):
+    TYPE = 17
+    FIELDS = ("payload",)
+
+
+@register_message
+class MMonCommand(_JsonMessage):
+    TYPE = 18
+    FIELDS = ("tid", "cmd")       # cmd: dict with "prefix" etc.
+
+
+@register_message
+class MMonCommandReply(_JsonMessage):
+    TYPE = 19
+    FIELDS = ("tid", "rc", "outs", "outb")  # status str, output obj
+
+
+@register_message
+class MMonSubscribe(_JsonMessage):
+    TYPE = 20
+    FIELDS = ("what",)            # {"osdmap": start_epoch, ...}
+
+
+@register_message
+class MMonMap(_JsonMessage):
+    TYPE = 21
+    FIELDS = ("monmap",)
+
+
+@register_message
+class MOSDMapMsg(_JsonMessage):
+    TYPE = 22
+    FIELDS = ("epoch", "osdmap")  # full map dict (epoch-stamped)
+
+
+@register_message
+class MOSDBoot(_JsonMessage):
+    TYPE = 23
+    FIELDS = ("osd", "addr")
+
+
+@register_message
+class MOSDFailure(_JsonMessage):
+    TYPE = 24
+    FIELDS = ("target", "reporter")
+
+
+@register_message
+class MOSDAlive(_JsonMessage):
+    TYPE = 25
+    FIELDS = ("osd",)

@@ -1,0 +1,705 @@
+"""Monitor daemon — elections, Paxos services, subscriptions, commands.
+
+Reference behavior re-created (``src/mon/Monitor.{h,cc}``,
+``PaxosService.{h,cc}``, ``OSDMonitor.cc``, ``AuthMonitor.cc``,
+``ConfigMonitor.cc``, ``LogMonitor.cc``, ``HealthMonitor.cc``;
+SURVEY.md §3.4):
+
+- boots into an election; the quorum then runs one Paxos log whose
+  values are service transactions (`{"service": ..., "ops": [...]}`);
+  every quorum member applies committed transactions to its store and
+  refreshes the service's in-memory state — so all mons expose
+  identical maps at identical versions;
+- **PaxosService** pattern: message/command handlers stage changes on
+  the LEADER's pending transaction; `propose_pending` pushes one round
+  through Paxos; non-leader mons forward mutating commands to the
+  leader (the reference routes via forward/route_message — here the
+  client resends; see MonClient);
+- clients subscribe (`MMonSubscribe`) and get map pushes; commands
+  (`MMonCommand`) are the `ceph ...` CLI's transport.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..core.auth import CryptoKey, KeyRing
+from ..core.threading_utils import SafeTimer
+from ..crush.compiler import crushmap_from_dict
+from ..msg import Dispatcher, EntityAddr, Messenger
+from ..osd.osdmap import EXISTS, OSDMap, TYPE_ERASURE, TYPE_REPLICATED, UP
+from ..tools.osdmaptool import osdmap_from_dict, osdmap_to_dict
+from . import messages as M
+from .paxos import Elector, Paxos, VICTORY
+from .store import MonitorDBStore, StoreTransaction
+
+
+@dataclass
+class MonMap:
+    """monmap: rank → address (reference ``src/mon/MonMap.h``)."""
+    epoch: int = 1
+    mons: dict[int, EntityAddr] = field(default_factory=dict)
+
+    def ranks(self) -> list[int]:
+        return sorted(self.mons)
+
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch,
+                "mons": {str(r): [a.host, a.port]
+                         for r, a in self.mons.items()}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MonMap":
+        return cls(epoch=d["epoch"],
+                   mons={int(r): EntityAddr(a[0], a[1])
+                         for r, a in d["mons"].items()})
+
+
+class PaxosService:
+    NAME = "base"
+
+    def __init__(self, mon: "Monitor"):
+        self.mon = mon
+        self.pending_ops: list = []
+
+    @property
+    def prefix(self) -> str:
+        return f"svc_{self.NAME}"
+
+    def stage(self, kind: str, key, value=None):
+        self.pending_ops.append([kind, self.prefix, str(key), value])
+
+    def have_pending(self) -> bool:
+        return bool(self.pending_ops)
+
+    def take_pending(self) -> list:
+        ops, self.pending_ops = self.pending_ops, []
+        return ops
+
+    # hooks
+    def create_initial(self):
+        pass
+
+    def update_from_store(self):
+        """Reload in-memory state after a commit (all quorum members)."""
+
+    def dispatch_command(self, cmd: dict) -> tuple[int, str, object] | None:
+        """→ (rc, status, output) or None if not mine.  Mutating
+        handlers stage ops and the monitor proposes after."""
+        return None
+
+
+class OSDMonitor(PaxosService):
+    NAME = "osdmap"
+
+    def __init__(self, mon):
+        super().__init__(mon)
+        self.osdmap = OSDMap()
+        self.failure_reports: dict[int, set[int]] = {}
+        # staged-but-uncommitted map: a second mutation arriving before
+        # the first commits must build on IT, not on the committed map,
+        # or the first proposal's changes are silently lost
+        self.pending_map: OSDMap | None = None
+
+    def create_initial(self):
+        self.osdmap.epoch = 1
+        self.stage("put", 1, json.dumps(osdmap_to_dict(self.osdmap)))
+        self.stage("put", "last_epoch", "1")
+
+    def update_from_store(self):
+        epoch = self.mon.store.get_int(self.prefix, "last_epoch")
+        if epoch > self.osdmap.epoch or self.osdmap.max_osd == 0:
+            blob = self.mon.store.get_str(self.prefix, epoch)
+            if blob:
+                self.osdmap = osdmap_from_dict(json.loads(blob))
+                self.mon.push_map("osdmap", epoch,
+                                  json.loads(blob))
+        if self.pending_map is not None and \
+                self.osdmap.epoch >= self.pending_map.epoch:
+            self.pending_map = None
+
+    # -- staging helpers (leader only) ------------------------------------
+    def _stage_map(self, m: OSDMap):
+        m.epoch += 1
+        self.stage("put", m.epoch, json.dumps(osdmap_to_dict(m)))
+        self.stage("put", "last_epoch", str(m.epoch))
+        self.pending_map = m
+
+    def _working(self) -> OSDMap:
+        """Copy of the newest staged (or committed) map to mutate."""
+        base = self.pending_map if self.pending_map is not None \
+            else self.osdmap
+        return osdmap_from_dict(osdmap_to_dict(base))
+
+    # -- daemon messages ---------------------------------------------------
+    def handle_boot(self, osd: int, addr: str):
+        m = self._working()
+        if osd >= m.max_osd:
+            grow = osd + 1 - m.max_osd
+            m.max_osd = osd + 1
+            m.osd_state += [0] * grow
+            m.osd_weight += [0x10000] * grow
+        m.osd_state[osd] |= EXISTS | UP
+        if m.is_out(osd):
+            m.osd_weight[osd] = 0x10000
+        self._stage_map(m)
+        self.mon.propose()
+
+    def handle_failure(self, target: int, reporter: int):
+        self.failure_reports.setdefault(target, set()).add(reporter)
+        # mark down on a single report when the cluster is tiny, else 2
+        need = 1 if self.osdmap.num_up_osds() <= 2 else 2
+        if len(self.failure_reports[target]) >= need and \
+                self.osdmap.is_up(target):
+            m = self._working()
+            m.mark_down(target)
+            self._stage_map(m)
+            self.failure_reports.pop(target, None)
+            self.mon.propose()
+
+    # -- commands ----------------------------------------------------------
+    def dispatch_command(self, cmd):
+        prefix = cmd.get("prefix", "")
+        if prefix == "osd dump":
+            return 0, "", osdmap_to_dict(self.osdmap)
+        if prefix == "osd getmap":
+            epoch = cmd.get("epoch") or \
+                self.mon.store.get_int(self.prefix, "last_epoch")
+            blob = self.mon.store.get_str(self.prefix, epoch)
+            if blob is None:
+                return -2, f"no epoch {epoch}", None
+            return 0, "", json.loads(blob)
+        if prefix == "osd tree":
+            return 0, "", self._tree()
+        if prefix == "osd stat":
+            m = self.osdmap
+            return 0, "", {"epoch": m.epoch, "num_osds": m.max_osd,
+                           "num_up_osds": m.num_up_osds(),
+                           "num_in_osds": m.num_in_osds()}
+        if prefix == "osd pool create":
+            name = cmd["pool"]
+            if name in self.osdmap.pool_name:
+                return 0, f"pool '{name}' already exists", None
+            m = self._working()
+            ptype = TYPE_ERASURE if cmd.get("pool_type") == "erasure" \
+                else TYPE_REPLICATED
+            profile_name = cmd.get("erasure_code_profile", "")
+            size = int(cmd.get("size",
+                               3 if ptype == TYPE_REPLICATED else 0))
+            if ptype == TYPE_ERASURE:
+                prof = m.erasure_code_profiles.get(
+                    profile_name or "default",
+                    {"k": "2", "m": "2"})
+                size = int(prof.get("k", 2)) + int(prof.get("m", 2))
+            m.create_pool(name, pg_num=int(cmd.get("pg_num", 32)),
+                          size=size, type=ptype,
+                          crush_rule=int(cmd.get("rule", 0)),
+                          erasure_code_profile=profile_name)
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"pool '{name}' created", None
+        if prefix == "osd pool delete":
+            name = cmd["pool"]
+            if name not in self.osdmap.pool_name:
+                return -2, f"pool '{name}' does not exist", None
+            m = self._working()
+            pid = m.pool_name.pop(name)
+            m.pools.pop(pid)
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"pool '{name}' removed", None
+        if prefix == "osd pool ls":
+            return 0, "", sorted(self.osdmap.pool_name)
+        if prefix == "osd erasure-code-profile set":
+            name = cmd["name"]
+            prof = {}
+            for item in cmd.get("profile", []):
+                k, _, v = item.partition("=")
+                prof[k] = v
+            m = self._working()
+            m.erasure_code_profiles[name] = prof
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, "", None
+        if prefix == "osd erasure-code-profile get":
+            prof = self.osdmap.erasure_code_profiles.get(cmd["name"])
+            if prof is None:
+                return -2, f"unknown profile {cmd['name']!r}", None
+            return 0, "", prof
+        if prefix == "osd erasure-code-profile ls":
+            return 0, "", sorted(self.osdmap.erasure_code_profiles)
+        if prefix in ("osd out", "osd in", "osd down"):
+            osd = int(cmd["ids"][0] if isinstance(cmd.get("ids"), list)
+                      else cmd["ids"])
+            if not (0 <= osd < self.osdmap.max_osd):
+                return -2, f"osd.{osd} does not exist", None
+            m = self._working()
+            if prefix == "osd out":
+                m.mark_out(osd)
+            elif prefix == "osd in":
+                m.osd_weight[osd] = 0x10000
+            else:
+                m.mark_down(osd)
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"marked {prefix.split()[1]} osd.{osd}", None
+        if prefix == "osd setcrushmap":
+            m = self._working()
+            m.crush = crushmap_from_dict(cmd["crushmap"])
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, "set crush map", None
+        return None
+
+    def _tree(self) -> dict:
+        m = self.osdmap
+        nodes = []
+        for b in m.crush.buckets:
+            if b is None:
+                continue
+            nodes.append({
+                "id": b.id, "name": m.crush.names.get(b.id, str(b.id)),
+                "type": m.crush.types.get(b.type, str(b.type)),
+                "children": list(b.items)})
+        for o in range(m.max_osd):
+            nodes.append({
+                "id": o, "name": f"osd.{o}", "type": "osd",
+                "status": "up" if m.is_up(o) else "down",
+                "reweight": m.osd_weight[o] / 0x10000})
+        return {"nodes": nodes}
+
+
+class AuthMonitor(PaxosService):
+    NAME = "auth"
+
+    def __init__(self, mon):
+        super().__init__(mon)
+        self.keyring = KeyRing()
+
+    def create_initial(self):
+        key = CryptoKey()
+        kr = KeyRing()
+        kr.add("client.admin", key,
+               caps={"mon": "allow *", "osd": "allow *"})
+        self.stage("put", "keyring", kr.dump())
+
+    def update_from_store(self):
+        blob = self.mon.store.get_str(self.prefix, "keyring")
+        if blob:
+            self.keyring = KeyRing.load(blob)
+
+    def dispatch_command(self, cmd):
+        prefix = cmd.get("prefix", "")
+        if prefix == "auth get-or-create":
+            entity = cmd["entity"]
+            if entity not in self.keyring:
+                caps = {}
+                for item in cmd.get("caps", []):
+                    svc, _, cap = item.partition("=")
+                    caps[svc] = cap.strip('"')
+                self.keyring.add(entity, caps=caps)
+                self.stage("put", "keyring", self.keyring.dump())
+                self.mon.propose()
+            ea = self.keyring.get(entity)
+            return 0, "", {"entity": entity, "key": ea.key.to_str(),
+                           "caps": ea.caps}
+        if prefix == "auth get":
+            entity = cmd["entity"]
+            if entity not in self.keyring:
+                return -2, f"no such entity {entity!r}", None
+            ea = self.keyring.get(entity)
+            return 0, "", {"entity": entity, "key": ea.key.to_str(),
+                           "caps": ea.caps}
+        if prefix == "auth ls":
+            return 0, "", self.keyring.entities()
+        return None
+
+
+class ConfigMonitor(PaxosService):
+    NAME = "config"
+
+    def update_from_store(self):
+        pass  # read-through service; nothing cached
+
+    def dispatch_command(self, cmd):
+        prefix = cmd.get("prefix", "")
+        if prefix == "config-key put":
+            self.stage("put", cmd["key"], str(cmd.get("val", "")))
+            self.mon.propose()
+            return 0, f"set {cmd['key']}", None
+        if prefix == "config-key get":
+            val = self.mon.store.get_str(self.prefix, cmd["key"])
+            if val is None:
+                return -2, f"no such key {cmd['key']!r}", None
+            return 0, "", val
+        if prefix == "config-key del":
+            self.stage("erase", cmd["key"])
+            self.mon.propose()
+            return 0, f"deleted {cmd['key']}", None
+        if prefix == "config-key ls":
+            return 0, "", self.mon.store.keys(self.prefix)
+        return None
+
+
+class LogMonitor(PaxosService):
+    NAME = "log"
+
+    def __init__(self, mon):
+        super().__init__(mon)
+        self._staged_seq = 0   # beyond the committed 'seq'
+
+    def update_from_store(self):
+        committed = self.mon.store.get_int(self.prefix, "seq")
+        if committed >= self._staged_seq:
+            self._staged_seq = 0
+
+    def dispatch_command(self, cmd):
+        prefix = cmd.get("prefix", "")
+        if prefix == "log":
+            seq = max(self.mon.store.get_int(self.prefix, "seq"),
+                      self._staged_seq) + 1
+            self._staged_seq = seq
+            entry = json.dumps({"stamp": time.time(),
+                                "text": cmd.get("logtext", "")})
+            self.stage("put", seq, entry)
+            self.stage("put", "seq", str(seq))
+            self.mon.propose()
+            return 0, "logged", None
+        if prefix == "log last":
+            n = int(cmd.get("num", 20))
+            seq = self.mon.store.get_int(self.prefix, "seq")
+            out = []
+            for s in range(max(1, seq - n + 1), seq + 1):
+                blob = self.mon.store.get_str(self.prefix, s)
+                if blob:
+                    out.append(json.loads(blob))
+            return 0, "", out
+        return None
+
+
+class HealthMonitor(PaxosService):
+    NAME = "health"
+
+    def dispatch_command(self, cmd):
+        prefix = cmd.get("prefix", "")
+        if prefix in ("health", "status"):
+            osdsvc: OSDMonitor = self.mon.services["osdmap"]
+            m = osdsvc.osdmap
+            checks = []
+            down = [o for o in range(m.max_osd)
+                    if m.exists(o) and not m.is_up(o)]
+            if down:
+                checks.append({"code": "OSD_DOWN",
+                               "summary": f"{len(down)} osds down",
+                               "detail": [f"osd.{o} down" for o in down]})
+            status = ("HEALTH_OK" if not checks else "HEALTH_WARN")
+            out = {"health": status, "checks": checks}
+            if prefix == "status":
+                out.update({
+                    "quorum": self.mon.elector.quorum,
+                    "leader": self.mon.elector.leader,
+                    "monmap_epoch": self.mon.monmap.epoch,
+                    "osdmap_epoch": m.epoch,
+                    "num_osds": m.max_osd,
+                    "num_up_osds": m.num_up_osds(),
+                    "pools": sorted(m.pool_name),
+                })
+            return 0, status, out
+        return None
+
+
+class Monitor(Dispatcher):
+    def __init__(self, rank: int, monmap: MonMap,
+                 store: MonitorDBStore | None = None,
+                 tick_interval: float = 0.25):
+        self.rank = rank
+        self.name = f"mon.{rank}"
+        self.monmap = monmap
+        self.store = store if store is not None else MonitorDBStore()
+        self.lock = threading.RLock()
+        self.msgr = Messenger(self.name)
+        self.msgr.add_dispatcher(self)
+        self.elector = Elector(rank, monmap.ranks())
+        self.paxos = Paxos(self.store, rank)
+        self.paxos.on_commit = self._on_paxos_commit
+        self.paxos.on_active = self._on_paxos_active
+        self.services: dict[str, PaxosService] = {}
+        for svc_cls in (OSDMonitor, AuthMonitor, ConfigMonitor,
+                        LogMonitor, HealthMonitor):
+            self.services[svc_cls.NAME] = svc_cls(self)
+        self._peer_cons: dict[int, object] = {}
+        self._subs: dict[object, dict] = {}   # connection → {what: since}
+        self._proposal_queue: list[bytes] = []
+        # (paxos version, fn) fired once last_committed reaches version —
+        # the reference's wait_for_finished_proposal: a mutating command
+        # must not be answered before its round commits
+        self._commit_waiters: list[tuple[int, object]] = []
+        self._election_started = 0.0
+        self.timer = SafeTimer(f"{self.name}-tick")
+        self._tick_interval = tick_interval
+        self._tick_token = None
+        self.running = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        addr = self.monmap.mons[self.rank]
+        self.msgr.bind(addr.host, addr.port)
+        self.running = True
+        with self.lock:
+            for svc in self.services.values():
+                svc.update_from_store()
+            self._start_election()
+        self._tick_token = self.timer.add_event_after(
+            self._tick_interval, self._tick)
+
+    def shutdown(self):
+        self.running = False
+        self.timer.shutdown()
+        self.msgr.shutdown()
+        self.store.close()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.elector.state == "leader"
+
+    @property
+    def quorum(self) -> list[int]:
+        return self.elector.quorum
+
+    # -- peer plumbing -----------------------------------------------------
+    def _peer_send(self, rank: int, msg):
+        if rank == self.rank:
+            return
+        con = self._peer_cons.get(rank)
+        if con is None or con._closed:
+            # lazy connect: we are often ON the messenger loop thread
+            # (dispatch path) — a blocking connect would deadlock it
+            con = self.msgr.connect_to_lazy(self.monmap.mons[rank])
+            self._peer_cons[rank] = con
+        try:
+            con.send_message(msg)
+        except ConnectionError:
+            self._peer_cons.pop(rank, None)
+
+    def _drain_outboxes(self):
+        for to, payload in self.elector.outbox:
+            self._peer_send(to, M.MMonElection(
+                payload=json.dumps(payload)))
+        self.elector.outbox = []
+        for to, payload in self.paxos.outbox:
+            self._peer_send(to, M.MMonPaxos(payload=json.dumps(payload)))
+        self.paxos.outbox = []
+
+    # -- election / paxos --------------------------------------------------
+    def _start_election(self):
+        self._election_started = time.monotonic()
+        was_leader = self.elector.state == "leader"
+        # leadership is in doubt: any not-yet-committed round may be
+        # dropped by the next leader's collect, so a success reply would
+        # lie — fail waiters with -11 and let MonClient retry (services
+        # are idempotent-enough: a re-run sees the committed state)
+        waiters, self._commit_waiters = self._commit_waiters, []
+        for _v, fn in waiters:
+            fn(rc=-11, outs="leadership changed, retry", outb=None)
+        self._proposal_queue.clear()
+        osdsvc = self.services.get("osdmap")
+        if osdsvc is not None:
+            osdsvc.pending_map = None
+        self.elector.start()
+        if self.elector.state == "leader" and not was_leader:
+            self.paxos.leader_collect(self.elector.quorum)
+        self._drain_outboxes()
+
+    def _on_paxos_active(self):
+        # drain queued proposals one at a time
+        if self._proposal_queue and self.is_leader:
+            value = self._proposal_queue.pop(0)
+            self.paxos.propose(value)
+        self._drain_outboxes()
+
+    def _on_paxos_commit(self, version: int, value: bytes):
+        rec = json.loads(value.decode())
+        t = StoreTransaction()
+        for kind, prefix, key, val in rec["ops"]:
+            if kind == "put":
+                t.put(prefix, key, val if val is not None else "")
+            else:
+                t.erase(prefix, key)
+        if not t.empty():
+            self.store.apply_transaction(t)
+        svc = self.services.get(rec.get("service", ""))
+        if svc:
+            svc.update_from_store()
+        matured = [fn for v, fn in self._commit_waiters if v <= version]
+        self._commit_waiters = [(v, fn) for v, fn in self._commit_waiters
+                                if v > version]
+        for fn in matured:
+            fn()
+
+    def propose(self):
+        """Bundle every service's pending ops into one paxos value and
+        queue it (leader only; callers already hold the mon lock)."""
+        for name, svc in self.services.items():
+            if not svc.have_pending():
+                continue
+            value = json.dumps({
+                "service": name,
+                "ops": svc.take_pending()}).encode()
+            self._proposal_queue.append(value)
+        if self.paxos.is_active() and self._proposal_queue \
+                and self.is_leader:
+            self.paxos.propose(self._proposal_queue.pop(0))
+        self._drain_outboxes()
+
+    # -- subscriptions -----------------------------------------------------
+    def push_map(self, what: str, epoch: int, payload: dict):
+        """Called by services after a commit: feed subscribers."""
+        if what != "osdmap":
+            return
+        dead = []
+        for con, subs in self._subs.items():
+            if "osdmap" in subs:
+                try:
+                    con.send_message(M.MOSDMapMsg(epoch=epoch,
+                                                  osdmap=payload))
+                except ConnectionError:
+                    dead.append(con)
+        for con in dead:
+            self._subs.pop(con, None)
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, msg) -> bool:
+        with self.lock:
+            return self._dispatch_locked(msg)
+
+    def _dispatch_locked(self, msg) -> bool:
+        if isinstance(msg, M.MMonElection):
+            payload = json.loads(msg.payload)
+            was_leader = self.elector.state == "leader"
+            was_state = self.elector.state
+            self.elector.handle(payload)
+            if self.elector.state == "leader" and not was_leader:
+                self.paxos.leader_collect(self.elector.quorum)
+            elif self.elector.state == "peon" and was_state != "peon":
+                # grace before judging the new leader's leases; an
+                # out-of-quorum peon never gets one and rejoins via a
+                # fresh election when this runs out
+                self.paxos.lease_until = time.monotonic() + 3.0
+            self._drain_outboxes()
+            return True
+        if isinstance(msg, M.MMonPaxos):
+            self.paxos.handle(json.loads(msg.payload))
+            self._drain_outboxes()
+            return True
+        if isinstance(msg, M.MMonCommand):
+            self._handle_command(msg)
+            return True
+        if isinstance(msg, M.MMonSubscribe):
+            self._subs.setdefault(msg.connection, {}).update(
+                json.loads(msg.what) if isinstance(msg.what, str)
+                else msg.what)
+            # immediate catch-up push
+            osdsvc: OSDMonitor = self.services["osdmap"]
+            if osdsvc.osdmap.epoch >= 1:
+                msg.connection.send_message(M.MOSDMapMsg(
+                    epoch=osdsvc.osdmap.epoch,
+                    osdmap=osdmap_to_dict(osdsvc.osdmap)))
+            return True
+        if isinstance(msg, M.MOSDBoot):
+            if self.is_leader:
+                self.services["osdmap"].handle_boot(msg.osd, msg.addr)
+            return True
+        if isinstance(msg, M.MOSDFailure):
+            if self.is_leader:
+                self.services["osdmap"].handle_failure(msg.target,
+                                                       msg.reporter)
+            return True
+        return False
+
+    def _handle_command(self, msg: M.MMonCommand):
+        cmd = msg.cmd if isinstance(msg.cmd, dict) else json.loads(msg.cmd)
+        rc, outs, outb = -22, f"unknown command {cmd.get('prefix')!r}", None
+        if not self.is_leader and _is_mutating(cmd):
+            reply = M.MMonCommandReply(
+                tid=msg.tid, rc=-11, outs="not leader",
+                outb={"leader": self.elector.leader})
+            msg.connection.send_message(reply)
+            return
+        if cmd.get("prefix") == "mon dump":
+            rc, outs, outb = 0, "", self.monmap.to_dict()
+        elif cmd.get("prefix") == "quorum_status":
+            rc, outs, outb = 0, "", {
+                "quorum": self.quorum, "leader": self.elector.leader,
+                "rank": self.rank, "state": self.elector.state}
+        else:
+            for svc in self.services.values():
+                res = svc.dispatch_command(cmd)
+                if res is not None:
+                    rc, outs, outb = res
+                    break
+
+        def reply(rc=rc, outs=outs, outb=outb):
+            try:
+                msg.connection.send_message(M.MMonCommandReply(
+                    tid=msg.tid, rc=rc, outs=outs, outb=outb))
+            except ConnectionError:
+                pass
+
+        outstanding = len(self._proposal_queue) + (
+            1 if self.paxos.state == "updating" else 0)
+        if rc == 0 and outstanding:
+            # answer only once every round this command queued commits
+            self._commit_waiters.append(
+                (self.paxos.last_committed + outstanding, reply))
+        else:
+            reply()
+
+    def ms_handle_reset(self, con):
+        with self.lock:
+            self._subs.pop(con, None)
+
+    # -- tick --------------------------------------------------------------
+    def _tick(self):
+        if not self.running:
+            return
+        with self.lock:
+            st = self.elector.state
+            if st == "electing":
+                elapsed = time.monotonic() - self._election_started
+                if elapsed > 0.75:
+                    # ack-gather window over: take the quorum we have
+                    was_leader = self.elector.state == "leader"
+                    self.elector.finalize()
+                    if self.elector.state == "leader" and not was_leader:
+                        self.paxos.leader_collect(self.elector.quorum)
+                    self._drain_outboxes()
+                if self.elector.state == "electing" and elapsed > 2.0:
+                    self._start_election()
+            elif st == "leader":
+                if self.paxos.is_active():
+                    self.paxos.extend_lease()
+                    # create initial service state on a fresh cluster
+                    if self.paxos.last_committed == 0:
+                        for svc in self.services.values():
+                            svc.create_initial()
+                        self.propose()
+                self._drain_outboxes()
+            elif st == "peon":
+                if self.paxos.lease_expired():
+                    self._start_election()
+        if self.running:
+            self._tick_token = self.timer.add_event_after(
+                self._tick_interval, self._tick)
+
+
+def _is_mutating(cmd: dict) -> bool:
+    prefix = cmd.get("prefix", "")
+    read_only = ("osd dump", "osd getmap", "osd tree", "osd stat",
+                 "osd pool ls", "osd erasure-code-profile get",
+                 "osd erasure-code-profile ls", "auth get", "auth ls",
+                 "config-key get", "config-key ls", "log last",
+                 "health", "status", "mon dump", "quorum_status")
+    return prefix not in read_only

@@ -57,6 +57,12 @@ class EntityAddr:
         return f"{self.host}:{self.port}/{self.nonce}"
 
 
+# everything a failed/garbled handshake can throw; retrying is right for
+# each (a peer mid-restart can emit any of them)
+_HANDSHAKE_ERRORS = (ConnectionError, OSError, EOFError, ValueError,
+                     KeyError, struct.error)
+
+
 async def _read_json(r: asyncio.StreamReader) -> dict:
     """One length-prefixed JSON handshake blob."""
     (n,) = struct.unpack("<I", await r.readexactly(4))
@@ -106,6 +112,10 @@ class Connection:
     def send_message(self, msg: Message):
         if self._closed:
             raise ConnectionError("connection closed")
+        if self._send_q.qsize() >= self.msgr.max_queued:
+            # a dead peer must not grow an unbounded backlog; senders
+            # (heartbeats, elections) retry at the protocol level
+            raise ConnectionError("send queue full (peer unreachable?)")
         self.msgr._call_soon(self._send_q.put_nowait, msg)
 
     def mark_down(self):
@@ -253,9 +263,10 @@ class Connection:
             try:
                 await self.msgr._establish(self, resume=True)
                 return
-            except (ConnectionError, OSError, EOFError):
+            except _HANDSHAKE_ERRORS:
                 await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 0.2)
+                backoff = min(backoff * 2,
+                              self.msgr.reconnect_backoff_max)
         self.msgr._notify_reset(self)
 
     async def _start_io(self, r: asyncio.StreamReader,
@@ -296,7 +307,9 @@ class Messenger:
                  verifier: ServiceVerifier | None = None,
                  session_ticket=None,
                  inject_socket_failures: int = 0,
-                 reconnect: bool = True):
+                 reconnect: bool = True,
+                 reconnect_backoff_max: float = 2.0,
+                 max_queued: int = 4096):
         """`verifier` makes the accepting side demand an authorizer;
         `session_ticket` (core.auth.SessionTicket) makes the connecting
         side present one.  Both None ⇒ AUTH_NONE mode."""
@@ -307,6 +320,8 @@ class Messenger:
         self.keyring_key = keyring_key
         self.inject_socket_failures = inject_socket_failures
         self.reconnect = reconnect
+        self.reconnect_backoff_max = reconnect_backoff_max
+        self.max_queued = max_queued
         self.dispatchers: list[Dispatcher] = []
         self.connections: list[Connection] = []
         self._server: asyncio.AbstractServer | None = None
@@ -350,6 +365,33 @@ class Messenger:
             self._establish(con, resume=False), self._loop)
         fut.result(10)
         self.connections.append(con)
+        return con
+
+    def connect_to_lazy(self, addr: EntityAddr) -> Connection:
+        """Non-blocking connect: returns immediately; messages queue and
+        flow once the handshake lands; failures retry via the normal
+        reconnect loop.  REQUIRED when calling from a dispatch handler —
+        the blocking connect_to would deadlock the messenger's own loop."""
+        con = Connection(self, addr, outgoing=True)
+        self.connections.append(con)
+
+        async def _first():
+            try:
+                await self._establish(con, resume=False)
+            except _HANDSHAKE_ERRORS:
+                if self.reconnect:
+                    await con._reconnect()  # loops until success/close
+                else:
+                    con._closed = True
+                    self._conn_closed(con)
+                    self._notify_reset(con)
+
+        def _spawn():
+            con._reconnect_task = self._loop.create_task(_first())
+
+        # create_task is NOT thread-safe and won't wake a foreign
+        # loop's selector; route through the self-pipe
+        self._loop.call_soon_threadsafe(_spawn)
         return con
 
     async def _establish(self, con: Connection, resume: bool):

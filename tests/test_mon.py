@@ -1,0 +1,290 @@
+"""Monitor cluster tests.
+
+Reference test model: mon unit/standalone tests (``src/test/mon/``,
+``qa/standalone/mon/`` — SURVEY.md §5): quorum formation, paxos
+commits visible on every mon, command routing with leader referral,
+subscriptions, leader failover, store persistence.
+"""
+
+import json
+import time
+
+import pytest
+
+from ceph_tpu.mon import MonClient, MonitorDBStore, Monitor, MonMap
+from ceph_tpu.mon.paxos import Elector, Paxos
+from ceph_tpu.mon.store import StoreTransaction
+from ceph_tpu.msg import EntityAddr
+
+
+def wait_for(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def free_ports(n):
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_cluster(n=3, stores=None):
+    ports = free_ports(n)
+    monmap = MonMap(mons={r: EntityAddr("127.0.0.1", ports[r])
+                          for r in range(n)})
+    mons = [Monitor(r, monmap,
+                    store=stores[r] if stores else None)
+            for r in range(n)]
+    for m in mons:
+        m.start()
+    return monmap, mons
+
+
+@pytest.fixture
+def cluster():
+    monmap, mons = make_cluster(3)
+    yield monmap, mons
+    for m in mons:
+        m.shutdown()
+
+
+class TestStore:
+    def test_transaction_and_replay(self, tmp_path):
+        path = str(tmp_path / "mon.wal")
+        st = MonitorDBStore(path)
+        t = StoreTransaction().put("p", "a", b"1").put("p", "b", b"2")
+        st.apply_transaction(t)
+        st.apply_transaction(StoreTransaction().erase("p", "a"))
+        st.close()
+        st2 = MonitorDBStore(path)
+        assert st2.get("p", "a") is None
+        assert st2.get("p", "b") == b"2"
+        st2.close()
+
+    def test_torn_tail_ignored(self, tmp_path):
+        path = str(tmp_path / "mon.wal")
+        st = MonitorDBStore(path)
+        st.apply_transaction(StoreTransaction().put("p", "k", b"v"))
+        st.close()
+        with open(path, "ab") as f:
+            f.write(b'[["put", "p", "k2"')   # torn write
+        st2 = MonitorDBStore(path)
+        assert st2.get("p", "k") == b"v"
+        assert st2.get("p", "k2") is None
+        st2.close()
+
+    def test_version_key_ordering(self):
+        st = MonitorDBStore()
+        for v in (1, 2, 10, 9):
+            st.apply_transaction(StoreTransaction().put("x", v, b"."))
+        assert st.keys("x") == ["1", "2", "9", "10"]
+
+
+class TestElectorUnit:
+    def test_solo_wins(self):
+        e = Elector(0, [0])
+        e.start()
+        assert e.state == "leader" and e.quorum == [0]
+
+    def test_three_way(self):
+        es = [Elector(r, [0, 1, 2]) for r in range(3)]
+        es[2].start()
+        # pump messages until stable
+        for _ in range(20):
+            moved = False
+            for e in es:
+                for to, payload in e.outbox:
+                    es[to].handle(payload)
+                    moved = True
+                e.outbox = []
+            if not moved:
+                break
+        assert es[0].state == "leader"
+        assert es[1].leader == 0 and es[2].leader == 0
+        # the first round may settle on a majority quorum before the
+        # last ack lands; the rejoin path (integration-tested via
+        # `status`) then widens it — here require a valid majority
+        q = sorted(es[0].quorum)
+        assert 0 in q and len(q) >= 2 and set(q) <= {0, 1, 2}
+
+
+class TestQuorum:
+    def test_leader_elected(self, cluster):
+        monmap, mons = cluster
+        assert wait_for(lambda: any(m.is_leader for m in mons))
+        leaders = [m for m in mons if m.is_leader]
+        assert len(leaders) == 1
+        assert leaders[0].rank == 0   # lowest rank wins
+
+    def test_initial_maps_created_everywhere(self, cluster):
+        monmap, mons = cluster
+        assert wait_for(lambda: all(
+            m.services["osdmap"].osdmap.epoch >= 1
+            and m.store.get_int("svc_osdmap", "last_epoch") >= 1
+            for m in mons), timeout=15)
+
+
+class TestCommands:
+    def test_pool_create_via_any_mon(self, cluster):
+        monmap, mons = cluster
+        assert wait_for(lambda: any(m.is_leader for m in mons))
+        mc = MonClient(monmap)
+        try:
+            rc, outs, _ = mc.command({"prefix": "osd pool create",
+                                      "pool": "data", "pg_num": 16})
+            assert rc == 0, outs
+            # visible on EVERY quorum member
+            assert wait_for(lambda: all(
+                "data" in m.services["osdmap"].osdmap.pool_name
+                for m in mons), timeout=15)
+            rc, _, out = mc.command({"prefix": "osd pool ls"})
+            assert rc == 0 and "data" in out
+        finally:
+            mc.shutdown()
+
+    def test_ec_profile_and_pool(self, cluster):
+        monmap, mons = cluster
+        assert wait_for(lambda: any(m.is_leader for m in mons))
+        mc = MonClient(monmap)
+        try:
+            rc, outs, _ = mc.command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "ec43",
+                "profile": ["k=4", "m=3", "plugin=jerasure"]})
+            assert rc == 0, outs
+            rc, _, prof = mc.command({
+                "prefix": "osd erasure-code-profile get", "name": "ec43"})
+            assert rc == 0 and prof["k"] == "4" and prof["m"] == "3"
+            rc, outs, _ = mc.command({
+                "prefix": "osd pool create", "pool": "ecpool",
+                "pg_num": 8, "pool_type": "erasure",
+                "erasure_code_profile": "ec43"})
+            assert rc == 0, outs
+            rc, _, dump = mc.command({"prefix": "osd dump"})
+            pool = next(p for p in dump["pools"]
+                        if p["name"] == "ecpool")
+            assert pool["type"] == 3 and pool["size"] == 7
+        finally:
+            mc.shutdown()
+
+    def test_config_key_and_log(self, cluster):
+        monmap, mons = cluster
+        assert wait_for(lambda: any(m.is_leader for m in mons))
+        mc = MonClient(monmap)
+        try:
+            rc, _, _ = mc.command({"prefix": "config-key put",
+                                   "key": "foo/bar", "val": "baz"})
+            assert rc == 0
+            rc, _, val = mc.command({"prefix": "config-key get",
+                                     "key": "foo/bar"})
+            assert rc == 0 and val == "baz"
+            rc, _, _ = mc.command({"prefix": "log",
+                                   "logtext": "hello cluster"})
+            assert rc == 0
+            rc, _, entries = mc.command({"prefix": "log last"})
+            assert rc == 0 and entries[-1]["text"] == "hello cluster"
+        finally:
+            mc.shutdown()
+
+    def test_status_and_auth(self, cluster):
+        monmap, mons = cluster
+        assert wait_for(lambda: any(m.is_leader for m in mons))
+        mc = MonClient(monmap)
+        try:
+            rc, status, out = mc.command({"prefix": "status"})
+            assert rc == 0 and sorted(out["quorum"]) == [0, 1, 2]
+            rc, _, out = mc.command({"prefix": "auth get-or-create",
+                                     "entity": "osd.7",
+                                     "caps": ["osd=allow *"]})
+            assert rc == 0 and out["key"]
+            rc, _, out2 = mc.command({"prefix": "auth get",
+                                      "entity": "osd.7"})
+            assert out2["key"] == out["key"]
+        finally:
+            mc.shutdown()
+
+
+class TestSubscriptions:
+    def test_osdmap_pushed_on_change(self, cluster):
+        monmap, mons = cluster
+        assert wait_for(lambda: any(m.is_leader for m in mons))
+        mc = MonClient(monmap)
+        try:
+            mc.sub_want("osdmap")
+            first = mc.wait_for_osdmap()
+            epoch0 = mc.osdmap_epoch
+            rc, outs, _ = mc.command({"prefix": "osd pool create",
+                                      "pool": "subs", "pg_num": 8})
+            assert rc == 0, outs
+            assert wait_for(lambda: mc.osdmap_epoch > epoch0)
+            assert any(p["name"] == "subs"
+                       for p in mc.osdmap_dict["pools"])
+        finally:
+            mc.shutdown()
+
+
+class TestFailover:
+    def test_leader_death_reelects_and_serves(self):
+        monmap, mons = make_cluster(3)
+        mc = None
+        try:
+            assert wait_for(lambda: any(m.is_leader for m in mons))
+            mc = MonClient(monmap)
+            rc, _, _ = mc.command({"prefix": "config-key put",
+                                   "key": "k", "val": "1"})
+            assert rc == 0
+            # kill the leader (rank 0)
+            mons[0].shutdown()
+            # remaining two must re-elect (rank 1 leads) and serve
+            assert wait_for(lambda: mons[1].is_leader, timeout=20)
+            rc, _, val = mc.command({"prefix": "config-key get",
+                                     "key": "k"}, timeout=20)
+            assert rc == 0 and val == "1"
+            rc, _, _ = mc.command({"prefix": "config-key put",
+                                   "key": "k2", "val": "2"}, timeout=20)
+            assert rc == 0
+        finally:
+            if mc:
+                mc.shutdown()
+            for m in mons[1:]:
+                m.shutdown()
+
+    def test_restart_replays_store(self, tmp_path):
+        stores = [MonitorDBStore(str(tmp_path / f"mon{r}.wal"))
+                  for r in range(3)]
+        monmap, mons = make_cluster(3, stores=stores)
+        try:
+            assert wait_for(lambda: any(m.is_leader for m in mons))
+            mc = MonClient(monmap)
+            rc, _, _ = mc.command({"prefix": "osd pool create",
+                                   "pool": "persist", "pg_num": 8})
+            assert rc == 0
+            assert wait_for(lambda: all(
+                "persist" in m.services["osdmap"].osdmap.pool_name
+                for m in mons), timeout=15)
+            mc.shutdown()
+        finally:
+            for m in mons:
+                m.shutdown()
+        # cold restart from the WALs
+        stores2 = [MonitorDBStore(str(tmp_path / f"mon{r}.wal"))
+                   for r in range(3)]
+        monmap2, mons2 = make_cluster(3, stores=stores2)
+        try:
+            assert wait_for(lambda: all(
+                "persist" in m.services["osdmap"].osdmap.pool_name
+                for m in mons2), timeout=15)
+        finally:
+            for m in mons2:
+                m.shutdown()
