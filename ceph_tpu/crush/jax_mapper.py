@@ -100,7 +100,7 @@ class BatchMapper:
                 "BatchMapper needs 64-bit ints: set JAX_ENABLE_X64=1 or "
                 "jax.config.update('jax_enable_x64', True)")
         if isinstance(rule, int):
-            rule = cmap.rules[rule]
+            rule = cmap.rule_by_id(rule)
         self.cmap = cmap
         self.rule = rule
         self.chunk = chunk

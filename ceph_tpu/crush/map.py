@@ -122,6 +122,14 @@ class CrushMap:
     def item_type(self, item: int) -> int:
         return 0 if item >= 0 else self.bucket(item).type
 
+    def rule_by_id(self, rule_id: int) -> Rule:
+        """Resolve a rule by its id (the reference resolves by id, not
+        list position — rule ids may be sparse/non-dense)."""
+        for r in self.rules:
+            if r.id == rule_id:
+                return r
+        raise KeyError(f"no rule with id {rule_id}")
+
     def rule_by_name(self, name: str) -> Rule:
         for r in self.rules:
             if r.name == name:

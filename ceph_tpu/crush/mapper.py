@@ -307,7 +307,7 @@ def do_rule(cmap: CrushMap, rule: Rule | int, x: int, result_max: int,
     indep rules return exactly result_max slots with CRUSH_ITEM_NONE holes.
     """
     if isinstance(rule, int):
-        rule = cmap.rules[rule]
+        rule = cmap.rule_by_id(rule)
     if weight is None:
         weight = [0x10000] * cmap.max_devices
     t = cmap.tunables

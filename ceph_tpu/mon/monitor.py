@@ -671,15 +671,19 @@ class Monitor(Dispatcher):
                 elapsed = time.monotonic() - self._election_started
                 if elapsed > 0.75:
                     # ack-gather window over: take the quorum we have
-                    was_leader = self.elector.state == "leader"
                     self.elector.finalize()
-                    if self.elector.state == "leader" and not was_leader:
+                    if self.elector.state == "leader":
                         self.paxos.leader_collect(self.elector.quorum)
                     self._drain_outboxes()
                 if self.elector.state == "electing" and elapsed > 2.0:
                     self._start_election()
             elif st == "leader":
-                if self.paxos.is_active():
+                if self.paxos.accept_timed_out():
+                    # a quorum member stopped accepting: re-elect so the
+                    # quorum shrinks to the live set (reference
+                    # Paxos::accept_timeout → bootstrap)
+                    self._start_election()
+                elif self.paxos.is_active():
                     self.paxos.extend_lease()
                     # create initial service state on a fresh cluster
                     if self.paxos.last_committed == 0:

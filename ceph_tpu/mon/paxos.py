@@ -50,7 +50,8 @@ class Elector:
         self.leader: int | None = None
         self.quorum: list[int] = []
         self.acked: set[int] = set()
-        self.acked_epoch: int | None = None  # epoch we deferred at
+        self.electing_me = False     # am I an active candidate?
+        self.deferred_to: int | None = None  # who we acked this epoch
         self.outbox: list[tuple[int, dict]] = []   # (to_rank, payload)
 
     @property
@@ -63,14 +64,39 @@ class Elector:
             self.epoch += 1
         self.state = "electing"
         self.leader = None
+        self.electing_me = True
         self.acked = {self.rank}
-        self.acked_epoch = None
+        self.deferred_to = None
         for r in self.ranks:
             if r != self.rank:
                 self.outbox.append(
                     (r, {"op": PROPOSE, "epoch": self.epoch,
                          "from": self.rank}))
         self._maybe_win()
+
+    def _bump_epoch(self, epoch: int):
+        """Adopt a newer epoch; a new round voids both our candidacy
+        and any deferral made in the old round (reference
+        ElectionLogic::bump_epoch)."""
+        if epoch > self.epoch:
+            self.epoch = epoch
+            self.electing_me = False
+            self.deferred_to = None
+            self.acked = set()
+        if self.epoch % 2 == 0:
+            self.epoch += 1
+
+    def _defer(self, frm: int):
+        """Ack a better (lower-ranked) candidate.  Deferring withdraws
+        our own candidacy: with ``electing_me`` false, stray ACKs that
+        arrive later are discarded and ``finalize()`` cannot declare us
+        the winner — otherwise two leaders could emerge in one epoch."""
+        self.state = "electing"
+        self.electing_me = False
+        self.deferred_to = frm
+        self.acked = set()
+        self.outbox.append(
+            (frm, {"op": ACK, "epoch": self.epoch, "from": self.rank}))
 
     def handle(self, msg: dict):
         op, frm, epoch = msg["op"], msg["from"], msg["epoch"]
@@ -82,27 +108,33 @@ class Elector:
                            "from": self.rank}))
             return
         if op == PROPOSE:
-            self.epoch = max(self.epoch, epoch)
-            if self.epoch % 2 == 0:
-                self.epoch += 1
+            self._bump_epoch(epoch)
             if frm < self.rank:
-                # defer to the lower rank
-                self.state = "electing"
-                self.acked_epoch = self.epoch
-                self.outbox.append(
-                    (frm, {"op": ACK, "epoch": self.epoch,
-                           "from": self.rank}))
+                # they would win over me — defer unless we already
+                # deferred to a still-better (lower) candidate this
+                # round (reference ElectionLogic::receive_propose; <=
+                # re-acks the SAME candidate's retry, repairing a lost
+                # ACK)
+                if self.deferred_to is None or frm <= self.deferred_to:
+                    self._defer(frm)
             else:
-                # we outrank them: run our own candidacy
-                if self.state != "electing" or \
-                        self.rank not in self.acked:
+                # I would win over them
+                if self.deferred_to is not None:
+                    # already deferred to someone who beats them too:
+                    # ignore (deferred_to < self.rank < frm)
+                    pass
+                elif not self.electing_me:
                     self.start()
                 else:
+                    # already campaigning: remind them of my candidacy
                     self.outbox.append(
                         (frm, {"op": PROPOSE, "epoch": self.epoch,
                                "from": self.rank}))
         elif op == ACK:
-            if self.state == "electing" and epoch == self.epoch:
+            # acks only count while we are an active candidate; after a
+            # deferral they are stale and must not elect us
+            if self.electing_me and self.state == "electing" \
+                    and epoch == self.epoch:
                 self.acked.add(frm)
                 self._maybe_win()
         elif op == VICTORY:
@@ -111,6 +143,8 @@ class Elector:
                 self.state = "peon"
                 self.leader = frm
                 self.quorum = msg["quorum"]
+                self.electing_me = False
+                self.deferred_to = None
 
     def _maybe_win(self):
         """Immediate victory only when EVERY rank deferred; a mere
@@ -123,7 +157,8 @@ class Elector:
 
     def finalize(self):
         """Gather-timeout expiry: take the quorum we have, if majority."""
-        if self.state == "electing" and len(self.acked) >= self.majority:
+        if self.state == "electing" and self.electing_me \
+                and len(self.acked) >= self.majority:
             self._declare_victory()
 
     def _declare_victory(self):
@@ -172,6 +207,7 @@ class Paxos:
         self._accepts: set[int] = set()
         self._pending_value: bytes | None = None
         self._pending_v = 0
+        self._begin_started = 0.0     # when the open BEGIN round started
         self.lease_until = 0.0
 
     # -- helpers -----------------------------------------------------------
@@ -242,6 +278,7 @@ class Paxos:
         self._pending_v = v
         self._pending_value = value
         self._accepts = {self.rank}
+        self._begin_started = time.monotonic()
         self.store.apply_transaction(_tx(
             ("put", PAXOS_PREFIX, f"uncommitted_{v}", value),
             ("put", PAXOS_PREFIX, f"uncommitted_pn_{v}",
@@ -253,10 +290,21 @@ class Paxos:
                     "value": value.hex(), "from": self.rank}))
         self._maybe_commit()
 
+    def accept_timed_out(self, timeout: float = 5.0) -> bool:
+        """True when a BEGIN round has waited longer than `timeout` for
+        the full quorum to accept — the monitor bootstraps a new
+        election (reference: Paxos accept_timeout → mon->bootstrap())."""
+        return (self.state == "updating"
+                and time.monotonic() - self._begin_started > timeout)
+
     def _maybe_commit(self):
+        # Commit only when the ENTIRE quorum accepted (reference
+        # Paxos::handle_accept).  A mere majority of the quorum is not
+        # safe: the quorum itself may be a strict subset of all mons, so
+        # a majority-of-quorum commit could land on a minority of mons
+        # and be lost to a later election drawn from the others.
         if self.state == "updating" and \
-                len(self._accepts) >= len(self.quorum) // 2 + 1 and \
-                self.rank in self._accepts:
+                len(self._accepts) == len(self.quorum):
             v, value = self._pending_v, self._pending_value
             self._commit_local(v, value)
             for r in self.quorum:

@@ -467,6 +467,12 @@ class Messenger:
                              "ticket": bytes.fromhex(authz["ticket"]),
                              "proof": bytes.fromhex(answer["proof"])},
                             challenge)
+                    # the hello's entity is unauthenticated; bind the
+                    # session to the ticket-verified identity so a valid
+                    # ticket for A cannot splice into B's session
+                    if hello.get("entity") != entity:
+                        raise AuthError(
+                            "hello entity does not match ticket")
                 except (AuthError, KeyError, ValueError) as e:
                     payload = json.dumps({"error": str(e)}).encode()
                     prefix = b"" if banner_sent else BANNER

@@ -232,8 +232,8 @@ class OSDMap:
         """CRUSH mapping, no overrides (``OSDMap::_pg_to_raw_osds``)."""
         pool = self.pools[pgid.pool]
         pps = pool.raw_pg_to_pps(pgid.seed)
-        raw = do_rule(self.crush, self.crush.rules[pool.crush_rule], pps,
-                      pool.size, self.osd_weight)
+        raw = do_rule(self.crush, self.crush.rule_by_id(pool.crush_rule),
+                      pps, pool.size, self.osd_weight)
         return [o if (o == CRUSH_ITEM_NONE or self.exists(o)) else
                 CRUSH_ITEM_NONE for o in raw]
 

@@ -100,7 +100,7 @@ def map_pool_pgs(m: OSDMap, pool: PGPool,
     one BatchMapper launch."""
     seeds = np.arange(pool.pg_num, dtype=np.uint32)
     pps = pool.raw_pg_to_pps_batch(seeds)
-    rule = m.crush.rules[pool.crush_rule]
+    rule = m.crush.rule_by_id(pool.crush_rule)
     if use_jax:
         try:
             from ..crush.jax_mapper import BatchMapper

@@ -107,11 +107,28 @@ class TestChooseleafIndep:
         m = build_hierarchy(2, 2, 2, rule="chooseleaf_indep")  # 4 hosts
         _check(m, 0, 6, XS[:150])
 
-    def test_reweight_outs(self):
+    def test_reweight_outs_indep(self):
         m = build_hierarchy(4, 2, 2, rule="chooseleaf_indep")
         rng = np.random.default_rng(4)
         rw = rng.integers(0, 0x10001, size=m.max_devices).astype(np.uint32)
         _check(m, 0, 4, XS, weight=rw)
+
+    def test_skewed_weights_deep_hierarchy_indep(self):
+        # skewed bucket weights + more hosts: retries hit the tail cases
+        m = build_hierarchy(6, 3, 2, rule="chooseleaf_indep")
+        for b in m.buckets:
+            if b is not None and b.type == 1:   # host buckets
+                b.weights = [(i + 1) * 0x8000 for i in range(len(b.items))]
+        # re-aggregate parent weights bottom-up (racks before the root:
+        # iterate by increasing bucket type so parents see fresh sums)
+        parents = sorted((b for b in m.buckets
+                          if b is not None and b.type > 1),
+                         key=lambda b: b.type)
+        for b in parents:
+            b.weights = [sum(m.bucket(h).weights) for h in b.items]
+        rng = np.random.default_rng(5)
+        rw = rng.integers(0, 0x10001, size=m.max_devices).astype(np.uint32)
+        _check(m, 0, 5, XS, weight=rw)
 
     def test_flat_indep(self):
         m = build_flat_map(10)

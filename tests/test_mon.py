@@ -12,7 +12,7 @@ import time
 import pytest
 
 from ceph_tpu.mon import MonClient, MonitorDBStore, Monitor, MonMap
-from ceph_tpu.mon.paxos import Elector, Paxos
+from ceph_tpu.mon.paxos import ACK, PROPOSE, Elector, Paxos
 from ceph_tpu.mon.store import StoreTransaction
 from ceph_tpu.msg import EntityAddr
 
@@ -117,6 +117,53 @@ class TestElectorUnit:
         # `status`) then widens it — here require a valid majority
         q = sorted(es[0].quorum)
         assert 0 in q and len(q) >= 2 and set(q) <= {0, 1, 2}
+
+    def test_defer_withdraws_candidacy(self):
+        """Late ACKs arriving after a deferral must not elect the
+        deferred mon — with 5 mons, mon 1 gathers a majority, then
+        sees mon 0's PROPOSE; finalize() must not declare mon 1."""
+        e = Elector(1, [0, 1, 2, 3, 4])
+        e.start()
+        ep = e.epoch
+        e.handle({"op": ACK, "epoch": ep, "from": 2})
+        e.handle({"op": ACK, "epoch": ep, "from": 3})   # majority w/ self
+        # mon 0 proposes before we finalize: we defer, withdrawing
+        e.handle({"op": PROPOSE, "epoch": ep, "from": 0})
+        assert not e.electing_me and e.deferred_to == 0
+        # a stray late ack must be discarded
+        e.handle({"op": ACK, "epoch": ep, "from": 4})
+        e.finalize()
+        assert e.state != "leader"
+
+    def test_defer_only_to_strictly_better_candidates(self):
+        """Having deferred to rank 1, a later PROPOSE from rank 2 (worse)
+        is ignored; from rank 0 (better) is re-acked; a retry from the
+        same candidate is re-acked (lost-ACK repair)."""
+        e = Elector(3, [0, 1, 2, 3, 4])
+        e.handle({"op": PROPOSE, "epoch": 3, "from": 1})
+        assert e.deferred_to == 1
+        acks = [m for _, m in e.outbox if m["op"] == ACK]
+        assert len(acks) == 1
+        e.outbox = []
+        e.handle({"op": PROPOSE, "epoch": 3, "from": 2})  # worse: ignore
+        assert e.deferred_to == 1 and not e.outbox
+        e.handle({"op": PROPOSE, "epoch": 3, "from": 1})  # retry: re-ack
+        assert [m["op"] for _, m in e.outbox] == [ACK]
+        e.outbox = []
+        e.handle({"op": PROPOSE, "epoch": 3, "from": 0})  # better: re-defer
+        assert e.deferred_to == 0
+        assert [m["op"] for _, m in e.outbox] == [ACK]
+
+    def test_deferred_mon_does_not_restart_same_epoch(self):
+        """After deferring to rank 0, a PROPOSE from a higher rank must
+        not resurrect our candidacy within the same epoch."""
+        e = Elector(1, [0, 1, 2])
+        e.handle({"op": PROPOSE, "epoch": 3, "from": 0})
+        assert not e.electing_me
+        e.outbox = []
+        e.handle({"op": PROPOSE, "epoch": 3, "from": 2})
+        assert not e.electing_me
+        assert not [m for _, m in e.outbox if m["op"] == PROPOSE]
 
 
 class TestQuorum:
