@@ -96,6 +96,19 @@ class MonClient(Dispatcher):
             return reply.rc, reply.outs, reply.outb
         raise TimeoutError(f"mon command {cmd.get('prefix')!r} failed")
 
+    def send(self, msg):
+        """Fire-and-forget daemon→mon message (MOSDBoot/MOSDFailure —
+        peons forward these to the leader)."""
+        try:
+            self._ensure()
+            con = self._con
+            if con is not None:
+                con.send_message(msg)
+        except (ConnectionError, OSError, AttributeError):
+            # AttributeError: another thread hunted (_con = None)
+            # between _ensure and the send — next call reconnects
+            self._con = None
+
     # -- subscriptions -----------------------------------------------------
     def sub_want(self, what: str, start: int = 0):
         self._subs[what] = start

@@ -106,8 +106,22 @@ class OSDMonitor(PaxosService):
 
     def create_initial(self):
         self.osdmap.epoch = 1
+        if len(self.osdmap.crush.buckets) == 0:
+            self.osdmap.crush = self._seed_crush(0)
         self.stage("put", 1, json.dumps(osdmap_to_dict(self.osdmap)))
         self.stage("put", "last_epoch", "1")
+
+    @staticmethod
+    def _seed_crush(n_osds: int):
+        """Default CRUSH tree: flat straw2 root + replicated(0)/
+        erasure(1) rules (what `vstart` clusters get upstream)."""
+        from ..crush.map import Rule, Step, build_flat_map
+        crush = build_flat_map(n_osds)
+        crush.rules.append(Rule(
+            id=1, name="erasure_rule", type="erasure",
+            steps=[Step("take", -1), Step("choose_indep", 0, 0),
+                   Step("emit")]))
+        return crush
 
     def update_from_store(self):
         epoch = self.mon.store.get_int(self.prefix, "last_epoch")
@@ -142,7 +156,25 @@ class OSDMonitor(PaxosService):
             m.max_osd = osd + 1
             m.osd_state += [0] * grow
             m.osd_weight += [0x10000] * grow
+        # keep the CRUSH tree covering every known device (the
+        # reference's `osd crush add` that deploy tooling issues on
+        # boot).  An EMPTY map is seeded flat with replicated(0)/
+        # erasure(1) rules; an existing map — possibly an admin's
+        # custom hierarchy via `osd setcrushmap` — is only EXTENDED
+        # (new device into the root bucket), never replaced.
+        if len(m.crush.buckets) == 0:
+            m.crush = self._seed_crush(m.max_osd)
+        elif m.crush.max_devices < m.max_osd:
+            root = m.crush.buckets[0]     # id -1: the conventional root
+            for dev in range(m.crush.max_devices, m.max_osd):
+                if root is not None and dev not in root.items:
+                    root.items.append(dev)
+                    root.weights.append(0x10000)
+                m.crush.names.setdefault(dev, f"osd.{dev}")
+            m.crush.max_devices = m.max_osd
         m.osd_state[osd] |= EXISTS | UP
+        if addr:
+            m.osd_addrs[osd] = addr
         if m.is_out(osd):
             m.osd_weight[osd] = 0x10000
         self._stage_map(m)
@@ -189,14 +221,25 @@ class OSDMonitor(PaxosService):
             profile_name = cmd.get("erasure_code_profile", "")
             size = int(cmd.get("size",
                                3 if ptype == TYPE_REPLICATED else 0))
+            min_size = None
             if ptype == TYPE_ERASURE:
                 prof = m.erasure_code_profiles.get(
                     profile_name or "default",
                     {"k": "2", "m": "2"})
-                size = int(prof.get("k", 2)) + int(prof.get("m", 2))
+                k = int(prof.get("k", 2))
+                size = k + int(prof.get("m", 2))
+                # the reference's EC default: min_size = k + 1 (survive
+                # writes with up to m-1 shards down, never go below k)
+                min_size = min(k + 1, size)
+            default_rule = 1 if ptype == TYPE_ERASURE else 0
+            rule_id = int(cmd.get("rule", default_rule))
+            try:
+                m.crush.rule_by_id(rule_id)
+            except KeyError:
+                return -22, f"crush rule {rule_id} does not exist", None
             m.create_pool(name, pg_num=int(cmd.get("pg_num", 32)),
-                          size=size, type=ptype,
-                          crush_rule=int(cmd.get("rule", 0)),
+                          size=size, min_size=min_size, type=ptype,
+                          crush_rule=rule_id,
                           erasure_code_profile=profile_name)
             self._stage_map(m)
             self.mon.propose()
@@ -580,7 +623,16 @@ class Monitor(Dispatcher):
             payload = json.loads(msg.payload)
             was_leader = self.elector.state == "leader"
             was_state = self.elector.state
+            was_epoch = self.elector.epoch
             self.elector.handle(payload)
+            if self.elector.state == "electing" and (
+                    was_state != "electing"
+                    or self.elector.epoch != was_epoch):
+                # joined/entered a round via dispatch: restart the
+                # gather clock, or a stale _election_started makes the
+                # tick's 2s restart fire immediately (same-epoch
+                # re-campaign after a deferral = possible double vote)
+                self._election_started = time.monotonic()
             if self.elector.state == "leader" and not was_leader:
                 self.paxos.leader_collect(self.elector.quorum)
             elif self.elector.state == "peon" and was_state != "peon":
@@ -611,11 +663,20 @@ class Monitor(Dispatcher):
         if isinstance(msg, M.MOSDBoot):
             if self.is_leader:
                 self.services["osdmap"].handle_boot(msg.osd, msg.addr)
+            elif self.elector.leader is not None:
+                # peon: forward to the leader (reference
+                # Monitor::forward_request_leader)
+                self._peer_send(self.elector.leader,
+                                M.MOSDBoot(osd=msg.osd, addr=msg.addr))
             return True
         if isinstance(msg, M.MOSDFailure):
             if self.is_leader:
                 self.services["osdmap"].handle_failure(msg.target,
                                                        msg.reporter)
+            elif self.elector.leader is not None:
+                self._peer_send(self.elector.leader,
+                                M.MOSDFailure(target=msg.target,
+                                              reporter=msg.reporter))
             return True
         return False
 

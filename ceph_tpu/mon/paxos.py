@@ -62,6 +62,12 @@ class Elector:
         """Begin (or restart) an election round."""
         if self.epoch % 2 == 0:
             self.epoch += 1
+        elif self.deferred_to is not None:
+            # we ACKed someone in the current round; campaigning in the
+            # SAME epoch could hand two candidates a majority (our old
+            # ACK still counts for the other).  Open a fresh round so
+            # peers' epoch filter voids stale votes.
+            self.epoch += 2
         self.state = "electing"
         self.leader = None
         self.electing_me = True

@@ -1,0 +1,16 @@
+"""Local object store layer (reference ``src/os/`` — SURVEY.md §3.7).
+
+``ObjectStore`` is the transactional API every OSD writes through;
+``Transaction`` is the opcode stream; ``MemStore`` is the in-RAM
+implementation (the reference's unit-test fake and our default
+backing for the control-plane OSD — TPU arrays hold the data-plane
+hot copies, so a RAM store is the idiomatic mapping, with the WAL
+store adding durability where the reference uses BlueStore).
+"""
+
+from .objectstore import Collection, ObjectStore, Transaction
+from .memstore import MemStore
+from .kvstore import WALStore
+
+__all__ = ["Collection", "ObjectStore", "Transaction", "MemStore",
+           "WALStore"]

@@ -1,0 +1,86 @@
+"""WALStore — a durable ObjectStore: MemStore + write-ahead log.
+
+Stands in for the reference's persistent store tier
+(``src/os/bluestore/BlueStore.cc`` commits every mutation through the
+RocksDB WAL; SURVEY.md §6.4).  Every queued Transaction is one JSONL
+WAL record appended before the in-memory apply; ``mount()`` replays the
+log with the same torn-tail recovery rule as ``MonitorDBStore`` (stop
+at the last parseable record).  This gives the OSD crash-restart
+durability without re-creating BlueStore's block-device allocator —
+machinery whose job (feeding NVMe) has no analog when chunk payloads
+live in HBM-backed JAX arrays.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable
+
+from .memstore import MemStore
+from .objectstore import Transaction
+
+
+class WALStore(MemStore):
+    def __init__(self, path: str, *, sync: bool = False,
+                 name: str = "walstore"):
+        super().__init__(name=name)
+        self._path = path
+        self._sync = sync
+        self._wal = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def mkfs(self):
+        super().mkfs()
+        if os.path.exists(self._path):
+            os.unlink(self._path)
+        self._open_wal()
+
+    def mount(self):
+        if os.path.exists(self._path):
+            self._replay()
+        self._open_wal()
+
+    def umount(self):
+        if self._wal is not None:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+            self._wal.close()
+            self._wal = None
+        super().umount()
+
+    def _open_wal(self):
+        if self._wal is None:
+            self._wal = open(self._path, "ab")
+
+    def _replay(self):
+        with open(self._path, "rb") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line.decode())
+                except json.JSONDecodeError:
+                    break   # torn tail: last record lost, earlier ones good
+                txn = Transaction.from_dict(rec)
+                with self.lock:
+                    for op in txn.ops:
+                        self._apply_op(op)
+
+    # -- write path --------------------------------------------------------
+    def queue_transaction(self, txn: Transaction,
+                          on_commit: Callable | None = None) -> None:
+        if self._wal is None:
+            self._open_wal()
+        rec = (json.dumps(txn.to_dict(), separators=(",", ":"))
+               .encode() + b"\n")
+        with self.lock:
+            self._wal.write(rec)
+            self._wal.flush()
+            if self._sync:
+                os.fsync(self._wal.fileno())
+            for op in txn.ops:
+                self._apply_op(op)
+        if on_commit is not None:
+            self.finisher.queue(on_commit)

@@ -1,0 +1,148 @@
+"""MemStore — the in-RAM ObjectStore.
+
+Reference behavior re-created (``src/os/memstore/MemStore.{h,cc}``;
+SURVEY.md §3.7): collections of objects held in process memory, with
+the full Transaction opcode set and commit callbacks delivered off the
+caller's thread through a Finisher, preserving the reference's async
+completion ordering (callbacks fire in queue order).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from ..core.threading_utils import Finisher
+from .objectstore import (Collection, ObjectStore, StoredObject,
+                          Transaction, OP_CLONE, OP_MKCOLL,
+                          OP_OMAP_RMKEYS, OP_OMAP_SETKEYS, OP_REMOVE,
+                          OP_RMATTR, OP_RMCOLL, OP_SETATTRS, OP_TOUCH,
+                          OP_TRUNCATE, OP_WRITE, OP_ZERO)
+
+
+class MemStore(ObjectStore):
+    def __init__(self, name: str = "memstore"):
+        self.name = name
+        self.colls: dict[str, Collection] = {}
+        self.lock = threading.RLock()
+        self.finisher = Finisher(f"{name}-fin")
+
+    # -- lifecycle ---------------------------------------------------------
+    def mkfs(self):
+        with self.lock:
+            self.colls.clear()
+
+    def umount(self):
+        self.finisher.shutdown()
+
+    # -- write path --------------------------------------------------------
+    def queue_transaction(self, txn: Transaction,
+                          on_commit: Callable | None = None) -> None:
+        with self.lock:
+            for op in txn.ops:
+                self._apply_op(op)
+        if on_commit is not None:
+            self.finisher.queue(on_commit)
+
+    def _coll(self, cid: str) -> Collection:
+        c = self.colls.get(cid)
+        if c is None:
+            raise KeyError(f"no collection {cid!r}")
+        return c
+
+    def _obj(self, cid: str, oid: str, create: bool = False) -> StoredObject:
+        c = self._coll(cid)
+        o = c.objects.get(oid)
+        if o is None:
+            if not create:
+                raise KeyError(f"no object {cid}/{oid}")
+            o = c.objects[oid] = StoredObject()
+        return o
+
+    def _apply_op(self, op: list):
+        code, cid, oid = op[0], op[1], op[2]
+        if code == OP_MKCOLL:
+            self.colls.setdefault(cid, Collection(cid))
+        elif code == OP_RMCOLL:
+            self.colls.pop(cid, None)
+        elif code == OP_TOUCH:
+            self._obj(cid, oid, create=True)
+        elif code == OP_WRITE:
+            off, data = op[3], op[4]
+            o = self._obj(cid, oid, create=True)
+            end = off + len(data)
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[off:end] = data
+        elif code == OP_ZERO:
+            off, length = op[3], op[4]
+            o = self._obj(cid, oid, create=True)
+            end = off + length
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[off:end] = b"\0" * length
+        elif code == OP_TRUNCATE:
+            size = op[3]
+            o = self._obj(cid, oid, create=True)
+            if len(o.data) > size:
+                del o.data[size:]
+            else:
+                o.data.extend(b"\0" * (size - len(o.data)))
+        elif code == OP_REMOVE:
+            self._coll(cid).objects.pop(oid, None)
+        elif code == OP_SETATTRS:
+            self._obj(cid, oid, create=True).xattrs.update(op[3])
+        elif code == OP_RMATTR:
+            self._obj(cid, oid, create=True).xattrs.pop(op[3], None)
+        elif code == OP_OMAP_SETKEYS:
+            self._obj(cid, oid, create=True).omap.update(op[3])
+        elif code == OP_OMAP_RMKEYS:
+            o = self._obj(cid, oid, create=True)
+            for k in op[3]:
+                o.omap.pop(k, None)
+        elif code == OP_CLONE:
+            src = self._obj(cid, oid)
+            dst = self._obj(cid, op[3], create=True)
+            dst.data = bytearray(src.data)
+            dst.xattrs = dict(src.xattrs)
+            dst.omap = dict(src.omap)
+        else:
+            raise ValueError(f"unknown transaction op {code!r}")
+
+    # -- read path ---------------------------------------------------------
+    def read(self, cid: str, oid: str, off: int = 0,
+             length: int | None = None) -> bytes:
+        with self.lock:
+            o = self._obj(cid, oid)
+            if length is None:
+                return bytes(o.data[off:])
+            return bytes(o.data[off:off + length])
+
+    def stat(self, cid: str, oid: str) -> dict:
+        with self.lock:
+            return {"size": len(self._obj(cid, oid).data)}
+
+    def getattr(self, cid: str, oid: str, name: str) -> bytes:
+        with self.lock:
+            return self._obj(cid, oid).xattrs[name]
+
+    def getattrs(self, cid: str, oid: str) -> dict[str, bytes]:
+        with self.lock:
+            return dict(self._obj(cid, oid).xattrs)
+
+    def omap_get(self, cid: str, oid: str) -> dict[str, bytes]:
+        with self.lock:
+            return dict(self._obj(cid, oid).omap)
+
+    def exists(self, cid: str, oid: str) -> bool:
+        with self.lock:
+            c = self.colls.get(cid)
+            return c is not None and oid in c.objects
+
+    def list_objects(self, cid: str) -> list[str]:
+        with self.lock:
+            return sorted(self._coll(cid).objects)
+
+    def list_collections(self) -> list[str]:
+        with self.lock:
+            return sorted(self.colls)

@@ -1,0 +1,233 @@
+"""ObjectStore API — collections, objects, transactions.
+
+Reference behavior re-created (``src/os/ObjectStore.h``,
+``src/os/Transaction.cc``; SURVEY.md §3.7):
+
+- a store holds **collections** (one per PG), each a namespace of
+  objects; an object is (data bytes, xattrs, omap);
+- every mutation travels as a ``Transaction`` — an ordered opcode
+  stream applied atomically with an async commit callback
+  (``queue_transaction``);
+- reads are synchronous (``read``, ``stat``, ``getattr``,
+  ``omap_get``), exactly the reference's split.
+
+Transactions are dict-serializable so the replication backends can
+ship them inside ``MOSDRepOp`` / EC sub-write messages the way the
+reference encodes ``Transaction`` into those message payloads.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable
+
+# transaction opcodes (reference Transaction::OP_*)
+OP_TOUCH = "touch"
+OP_WRITE = "write"
+OP_ZERO = "zero"
+OP_TRUNCATE = "truncate"
+OP_REMOVE = "remove"
+OP_SETATTRS = "setattrs"
+OP_RMATTR = "rmattr"
+OP_OMAP_SETKEYS = "omap_setkeys"
+OP_OMAP_RMKEYS = "omap_rmkeys"
+OP_CLONE = "clone"
+OP_MKCOLL = "create_collection"
+OP_RMCOLL = "remove_collection"
+
+
+class Transaction:
+    """An ordered opcode stream (reference ``ObjectStore::Transaction``).
+
+    Ops are ``[opcode, cid, oid, *args]`` lists; byte payloads are kept
+    as ``bytes`` in memory and hex-encoded only by ``to_dict`` for the
+    wire.
+    """
+
+    def __init__(self):
+        self.ops: list[list] = []
+
+    def __len__(self):
+        return len(self.ops)
+
+    def empty(self) -> bool:
+        return not self.ops
+
+    # -- builders (the reference's fluent API) ----------------------------
+    def touch(self, cid: str, oid: str) -> "Transaction":
+        self.ops.append([OP_TOUCH, cid, oid])
+        return self
+
+    def write(self, cid: str, oid: str, off: int,
+              data: bytes) -> "Transaction":
+        self.ops.append([OP_WRITE, cid, oid, off, bytes(data)])
+        return self
+
+    def zero(self, cid: str, oid: str, off: int,
+             length: int) -> "Transaction":
+        self.ops.append([OP_ZERO, cid, oid, off, length])
+        return self
+
+    def truncate(self, cid: str, oid: str, size: int) -> "Transaction":
+        self.ops.append([OP_TRUNCATE, cid, oid, size])
+        return self
+
+    def remove(self, cid: str, oid: str) -> "Transaction":
+        self.ops.append([OP_REMOVE, cid, oid])
+        return self
+
+    def setattrs(self, cid: str, oid: str,
+                 attrs: dict[str, bytes]) -> "Transaction":
+        self.ops.append([OP_SETATTRS, cid, oid,
+                         {k: bytes(v) for k, v in attrs.items()}])
+        return self
+
+    def rmattr(self, cid: str, oid: str, name: str) -> "Transaction":
+        self.ops.append([OP_RMATTR, cid, oid, name])
+        return self
+
+    def omap_setkeys(self, cid: str, oid: str,
+                     kv: dict[str, bytes]) -> "Transaction":
+        self.ops.append([OP_OMAP_SETKEYS, cid, oid,
+                         {k: bytes(v) for k, v in kv.items()}])
+        return self
+
+    def omap_rmkeys(self, cid: str, oid: str,
+                    keys: list[str]) -> "Transaction":
+        self.ops.append([OP_OMAP_RMKEYS, cid, oid, list(keys)])
+        return self
+
+    def clone(self, cid: str, oid: str, dest: str) -> "Transaction":
+        self.ops.append([OP_CLONE, cid, oid, dest])
+        return self
+
+    def create_collection(self, cid: str) -> "Transaction":
+        self.ops.append([OP_MKCOLL, cid, ""])
+        return self
+
+    def remove_collection(self, cid: str) -> "Transaction":
+        self.ops.append([OP_RMCOLL, cid, ""])
+        return self
+
+    def append(self, other: "Transaction") -> "Transaction":
+        self.ops.extend(other.ops)
+        return self
+
+    # -- wire form ---------------------------------------------------------
+    def to_dict(self) -> list:
+        out = []
+        for op in self.ops:
+            enc = []
+            for a in op:
+                if isinstance(a, bytes):
+                    enc.append({"hex": a.hex()})
+                elif isinstance(a, dict):
+                    enc.append({k: v.hex() if isinstance(v, bytes) else v
+                                for k, v in a.items()})
+                else:
+                    enc.append(a)
+            out.append(enc)
+        return out
+
+    @classmethod
+    def from_dict(cls, data: list) -> "Transaction":
+        t = cls()
+        for op in data:
+            dec = []
+            for i, a in enumerate(op):
+                if isinstance(a, dict) and set(a) == {"hex"}:
+                    dec.append(bytes.fromhex(a["hex"]))
+                elif isinstance(a, dict):
+                    # attr/omap maps: values were hex bytes except list
+                    # args which stay as-is
+                    if op[0] in (OP_SETATTRS, OP_OMAP_SETKEYS) and i == 3:
+                        dec.append({k: bytes.fromhex(v)
+                                    for k, v in a.items()})
+                    else:
+                        dec.append(a)
+                else:
+                    dec.append(a)
+            t.ops.append(dec)
+        return t
+
+
+class Collection:
+    """A collection handle: object namespace (≙ one PG's shard on this
+    store)."""
+
+    def __init__(self, cid: str):
+        self.cid = cid
+        self.objects: dict[str, "StoredObject"] = {}
+
+
+class StoredObject:
+    __slots__ = ("data", "xattrs", "omap")
+
+    def __init__(self):
+        self.data = bytearray()
+        self.xattrs: dict[str, bytes] = {}
+        self.omap: dict[str, bytes] = {}
+
+
+class ObjectStore(abc.ABC):
+    """The transactional store API (reference ``src/os/ObjectStore.h``)."""
+
+    # -- lifecycle ---------------------------------------------------------
+    def mkfs(self):
+        """Initialize an empty store."""
+
+    def mount(self):
+        """Load persisted state (no-op for RAM stores)."""
+
+    def umount(self):
+        """Flush and release."""
+
+    # -- writes ------------------------------------------------------------
+    @abc.abstractmethod
+    def queue_transaction(self, txn: Transaction,
+                          on_commit: Callable | None = None) -> None:
+        """Apply atomically; fire ``on_commit()`` once durable."""
+
+    def apply_transaction(self, txn: Transaction) -> None:
+        """Synchronous convenience wrapper."""
+        import threading
+        ev = threading.Event()
+        self.queue_transaction(txn, ev.set)
+        ev.wait()
+
+    # -- reads -------------------------------------------------------------
+    @abc.abstractmethod
+    def read(self, cid: str, oid: str, off: int = 0,
+             length: int | None = None) -> bytes:
+        """→ data; raises KeyError when the object does not exist."""
+
+    @abc.abstractmethod
+    def stat(self, cid: str, oid: str) -> dict:
+        """→ {"size": int} or raises KeyError."""
+
+    @abc.abstractmethod
+    def getattr(self, cid: str, oid: str, name: str) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def getattrs(self, cid: str, oid: str) -> dict[str, bytes]:
+        ...
+
+    @abc.abstractmethod
+    def omap_get(self, cid: str, oid: str) -> dict[str, bytes]:
+        ...
+
+    @abc.abstractmethod
+    def exists(self, cid: str, oid: str) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def list_objects(self, cid: str) -> list[str]:
+        ...
+
+    @abc.abstractmethod
+    def list_collections(self) -> list[str]:
+        ...
+
+    def collection_exists(self, cid: str) -> bool:
+        return cid in self.list_collections()

@@ -1,0 +1,295 @@
+"""OSD daemon — boot, map consumption, PG ownership, heartbeats.
+
+Reference behavior re-created (``src/osd/OSD.{h,cc}``; SURVEY.md §3.5,
+§4.6):
+
+- **boot**: authenticate to the mons, announce ``MOSDBoot`` (address
+  included) and wait to appear up in the committed OSDMap;
+- **map consumption**: subscribe to osdmap pushes; every epoch advance
+  recomputes this OSD's PG set via ``pg_to_up_acting_osds`` and drives
+  each PG's peering state machine (``OSD::handle_osd_map`` →
+  ``advance_pg``);
+- **dispatch**: client ops and peer sub-ops are routed to the owning
+  PG under the daemon lock (the sharded op queue collapses to one
+  lock at this scale — the TPU compute plane, not this control loop,
+  is the throughput path);
+- **heartbeats**: ping PG peers on a timer; silence beyond the grace
+  window produces ``MOSDFailure`` reports to the mon cluster
+  (``OSD::handle_osd_ping`` / ``send_failures``), which marks OSDs
+  down and re-triggers peering everywhere.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..core.threading_utils import SafeTimer
+from ..mon import messages as MM
+from ..mon.client import MonClient
+from ..msg import Dispatcher, EntityAddr, Messenger
+from ..os_store import MemStore
+from ..tools.osdmaptool import osdmap_from_dict
+from . import messages as M
+from .osdmap import OSDMap, PGid
+from .pg import PG, ECBackend, ReplicatedBackend
+
+
+class OSDaemon(Dispatcher):
+    def __init__(self, whoami: int, monmap, store=None, *,
+                 heartbeat_interval: float = 0.5,
+                 heartbeat_grace: float = 3.0):
+        self.whoami = whoami
+        self.monmap = monmap
+        self.store = store if store is not None else MemStore(
+            name=f"osd.{whoami}")
+        self.msgr = Messenger(f"osd.{whoami}")
+        self.msgr.add_dispatcher(self)
+        self.monc = MonClient(monmap, entity=f"osd.{whoami}")
+        self.osdmap = OSDMap()
+        self.pgs: dict[PGid, PG] = {}
+        self.lock = threading.RLock()
+        self.running = False
+        self.addr: EntityAddr | None = None
+        self._peer_cons: dict[int, object] = {}
+        self._hb_interval = heartbeat_interval
+        self._hb_grace = heartbeat_grace
+        self._hb_last: dict[int, float] = {}
+        self._hb_reported: dict[int, float] = {}  # osd → last report time
+        self.timer = SafeTimer(f"osd.{whoami}-tick")
+        self._tick_token = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, wait_for_up: bool = True, timeout: float = 15.0):
+        self.store.mount()
+        self.addr = self.msgr.bind()
+        self.running = True
+        self.monc.on_osdmap = self._on_osdmap
+        self.monc.sub_want("osdmap")
+        self._send_boot()
+        if wait_for_up:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self.lock:
+                    if self.osdmap.is_up(self.whoami):
+                        break
+                time.sleep(0.02)
+            else:
+                raise TimeoutError(f"osd.{self.whoami} never came up")
+        self._tick_token = self.timer.add_event_after(
+            self._hb_interval, self._tick)
+
+    def shutdown(self):
+        self.running = False
+        self.timer.shutdown()
+        self.monc.shutdown()
+        self.msgr.shutdown()
+        self.store.umount()
+
+    def _send_boot(self):
+        self.monc.send(MM.MOSDBoot(
+            osd=self.whoami, addr=f"{self.addr.host}:{self.addr.port}"))
+
+    # -- map handling ------------------------------------------------------
+    def _on_osdmap(self, epoch: int, map_dict: dict):
+        with self.lock:
+            if epoch <= self.osdmap.epoch:
+                return
+            self.osdmap = osdmap_from_dict(map_dict)
+            if self.running and not self.osdmap.is_up(self.whoami):
+                # marked down but alive: rejoin (reference
+                # OSD::_committed_osd_maps → start_boot)
+                self._send_boot()
+            self._scan_pgs()
+
+    def _scan_pgs(self):
+        """Recompute which PGs this OSD hosts and advance each
+        (reference OSD::consume_map / split into advance_pg)."""
+        m = self.osdmap
+        seen: set[PGid] = set()
+        for pool in m.pools.values():
+            for ps in range(pool.pg_num):
+                pgid = PGid(pool.id, ps)
+                up, upp, acting, actingp = m.pg_to_up_acting_osds(pgid)
+                if self.whoami not in acting and pgid not in self.pgs:
+                    continue
+                seen.add(pgid)
+                pg = self.pgs.get(pgid)
+                if pg is None:
+                    pg = PG(self, pgid, pool)
+                    pg.acting = []   # force interval change on first map
+                    self.pgs[pgid] = pg
+                    # adopt whatever an earlier incarnation persisted
+                    pg.primary = actingp
+                    if self.whoami in acting:
+                        pg.shard = acting.index(self.whoami)
+                    pg.load_from_store()
+                    pg.create_onstore()
+                pg.pool = m.pools[pool.id]
+                pg.advance_map(up, upp, acting, actingp, m.epoch)
+
+    # -- peer plumbing -----------------------------------------------------
+    def send_to_osd(self, osd: int, msg):
+        if osd == self.whoami:
+            # loop back through local dispatch (the reference short-
+            # circuits local sub-ops the same way)
+            self._route(msg)
+            return
+        addr_s = self.osdmap.osd_addrs.get(osd)
+        if not addr_s:
+            return
+        cached = self._peer_cons.get(osd)
+        con = None
+        if cached is not None:
+            cached_addr, cached_con = cached
+            if cached_addr == addr_s and not cached_con._closed:
+                con = cached_con
+            else:
+                # the peer rebooted on a new address (or the link
+                # died): drop the stale connection or every message
+                # queues forever against the dead incarnation
+                cached_con.mark_down()
+        if con is None:
+            host, _, port = addr_s.rpartition(":")
+            con = self.msgr.connect_to_lazy(EntityAddr(host, int(port)))
+            self._peer_cons[osd] = (addr_s, con)
+        try:
+            con.send_message(msg)
+        except ConnectionError:
+            self._peer_cons.pop(osd, None)
+
+    # -- heartbeats --------------------------------------------------------
+    def _hb_peers(self) -> set[int]:
+        peers: set[int] = set()
+        for pg in self.pgs.values():
+            peers.update(o for o in pg.acting_live()
+                         if o != self.whoami)
+        return peers
+
+    def _tick(self):
+        if not self.running:
+            return
+        with self.lock:
+            now = time.monotonic()
+            # peering retransmit: queries/notifies are fire-and-forget
+            # and can race a peer's map update (its reply goes to a
+            # stale address); a stuck primary simply re-asks
+            for pg in self.pgs.values():
+                if pg.is_primary and pg.state == "peering":
+                    pg._start_peering()
+                elif pg.is_primary and pg.state == "down" and \
+                        len(pg.acting_live()) >= max(1, pg.pool.min_size):
+                    pg._start_peering()
+            for o in self._hb_peers():
+                self._hb_last.setdefault(o, now)
+                self.send_to_osd(o, M.MOSDPing(
+                    from_osd=self.whoami, epoch=self.osdmap.epoch,
+                    kind="ping", stamp=now))
+                if (now - self._hb_last[o] > self._hb_grace
+                        and self.osdmap.is_up(o)
+                        and now - self._hb_reported.get(o, 0.0)
+                        > self._hb_grace):
+                    # RE-send while the map still shows the peer up:
+                    # a report can be dropped by a mon mid-election
+                    # (reference OSD::send_failures retries too)
+                    self._hb_reported[o] = now
+                    self.monc.send(MM.MOSDFailure(
+                        target=o, reporter=self.whoami))
+        if self.running:
+            self._tick_token = self.timer.add_event_after(
+                self._hb_interval, self._tick)
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, msg) -> bool:
+        return self._route(msg)
+
+    def _route(self, msg) -> bool:
+        with self.lock:
+            if isinstance(msg, M.MOSDPing):
+                if msg.kind == "ping":
+                    if msg.connection is not None:
+                        try:
+                            msg.connection.send_message(M.MOSDPing(
+                                from_osd=self.whoami,
+                                epoch=self.osdmap.epoch,
+                                kind="ping_reply", stamp=msg.stamp))
+                        except ConnectionError:
+                            pass
+                else:
+                    self._hb_last[msg.from_osd] = time.monotonic()
+                    self._hb_reported.pop(msg.from_osd, None)
+                return True
+            if isinstance(msg, M.MOSDOp):
+                self._handle_client_op(msg)
+                return True
+            handlers = {
+                M.MOSDPGQuery: lambda pg: pg.handle_query(msg),
+                M.MOSDPGNotify: lambda pg: pg.handle_notify(msg),
+                M.MOSDPGLog: lambda pg: pg.handle_log(msg),
+                M.MOSDPGPush: lambda pg: pg.handle_push(msg),
+                M.MOSDPGPushReply: lambda pg: pg.handle_push_reply(msg),
+                M.MOSDPGPull: lambda pg: pg.handle_pull(msg),
+                M.MOSDRepOp: lambda pg: pg.backend.apply_rep_op(msg),
+                M.MOSDRepOpReply:
+                    lambda pg: pg.backend.handle_rep_reply(msg),
+                M.MOSDECSubOpWrite:
+                    lambda pg: pg.backend.apply_sub_write(msg),
+                M.MOSDECSubOpWriteReply:
+                    lambda pg: pg.backend.handle_sub_write_reply(msg),
+                M.MOSDECSubOpRead:
+                    lambda pg: pg.backend.handle_sub_read(msg),
+                M.MOSDECSubOpReadReply:
+                    lambda pg: pg.backend.handle_sub_read_reply(msg),
+            }
+            fn = handlers.get(type(msg))
+            if fn is None:
+                return False
+            pg = self._pg_for(msg)
+            if pg is None:
+                return True
+            backend_kind = (ECBackend if isinstance(msg, (
+                M.MOSDECSubOpWrite, M.MOSDECSubOpWriteReply,
+                M.MOSDECSubOpRead, M.MOSDECSubOpReadReply))
+                else None)
+            if backend_kind and not isinstance(pg.backend, backend_kind):
+                return True
+            rep_kind = (ReplicatedBackend if isinstance(msg, (
+                M.MOSDRepOp, M.MOSDRepOpReply)) else None)
+            if rep_kind and not isinstance(pg.backend, rep_kind):
+                return True
+            fn(pg)
+            return True
+
+    def _pg_for(self, msg) -> PG | None:
+        try:
+            pgid = PGid.parse(msg.pgid)
+        except (AttributeError, ValueError):
+            return None
+        pg = self.pgs.get(pgid)
+        if pg is None:
+            return None
+        # discard cross-interval stragglers (the reference drops
+        # messages from older intervals after comparing epochs)
+        if getattr(msg, "epoch", None) is not None and \
+                msg.epoch < pg.interval_epoch:
+            return None
+        return pg
+
+    def _handle_client_op(self, msg: M.MOSDOp):
+        pg = self.pgs.get(PGid.parse(msg.pgid))
+        if pg is None:
+            try:
+                msg.connection.send_message(M.MOSDOpReply(
+                    tid=msg.tid, rc=-11, outs="pg not here",
+                    results=None, version=[0, 0],
+                    epoch=self.osdmap.epoch))
+            except (ConnectionError, AttributeError):
+                pass
+            return
+        pg.do_op(msg)
+
+    def ms_handle_reset(self, con):
+        with self.lock:
+            for o, (_a, c) in list(self._peer_cons.items()):
+                if c is con:
+                    del self._peer_cons[o]

@@ -1,0 +1,131 @@
+"""OSD data-plane message types.
+
+Reference: ``src/messages/MOSDOp.h``, ``MOSDOpReply.h``, ``MOSDRepOp.h``,
+``MOSDRepOpReply.h``, ``MOSDPGQuery/Notify/Log.h``, ``MOSDECSubOpWrite/
+Read.h`` (ECMsgTypes), ``MOSDPing.h``, push/pull recovery messages
+(SURVEY.md §3.2/§3.5).  Like the mon plane, payloads are JSON-in-frame:
+the framed messenger carries them; bulk chunk bytes ride hex-encoded —
+the TPU data plane moves real bulk through JAX arrays/ICI, not through
+this control messenger, so wire-byte thrift here buys nothing.
+
+Field conventions:
+- ``reqid``: "client_name:tid" — the reference's osd_reqid_t, used for
+  dup-op detection via the PG log.
+- ``version``: [epoch, v] pairs — eversion_t.
+- ``txn``: an ``os_store.Transaction.to_dict()`` opcode stream.
+"""
+
+from __future__ import annotations
+
+from ..mon.messages import _JsonMessage
+from ..msg.message import register_message
+
+
+@register_message
+class MOSDOp(_JsonMessage):
+    """Client → primary: one object op batch (reference MOSDOp)."""
+    TYPE = 40
+    FIELDS = ("tid", "client", "pgid", "oid", "epoch", "ops", "flags")
+
+
+@register_message
+class MOSDOpReply(_JsonMessage):
+    TYPE = 41
+    FIELDS = ("tid", "rc", "outs", "results", "version", "epoch")
+
+
+@register_message
+class MOSDRepOp(_JsonMessage):
+    """Primary → replica: apply this transaction (ReplicatedBackend)."""
+    TYPE = 42
+    FIELDS = ("reqid", "pgid", "epoch", "txn", "version", "log_entries",
+              "pg_info")
+
+
+@register_message
+class MOSDRepOpReply(_JsonMessage):
+    TYPE = 43
+    FIELDS = ("reqid", "pgid", "epoch", "rc", "from_osd")
+
+
+@register_message
+class MOSDPGQuery(_JsonMessage):
+    """Primary → peer: send me your info/log (reference MOSDPGQuery;
+    kind: "info" | "log"; since: eversion for log requests)."""
+    TYPE = 44
+    FIELDS = ("pgid", "epoch", "kind", "since", "from_osd")
+
+
+@register_message
+class MOSDPGNotify(_JsonMessage):
+    """Peer → primary: my pg_info (reference MOSDPGNotify)."""
+    TYPE = 45
+    FIELDS = ("pgid", "epoch", "info", "from_osd")
+
+
+@register_message
+class MOSDPGLog(_JsonMessage):
+    """Log share / activation (reference MOSDPGLog): when ``activate``
+    is set the receiver adopts the authoritative info+log and goes
+    active."""
+    TYPE = 46
+    FIELDS = ("pgid", "epoch", "info", "entries", "activate", "from_osd")
+
+
+@register_message
+class MOSDECSubOpWrite(_JsonMessage):
+    """Primary → shard k: write your chunk (reference MOSDECSubOpWrite)."""
+    TYPE = 47
+    FIELDS = ("reqid", "pgid", "shard", "epoch", "txn", "version",
+              "log_entries", "pg_info")
+
+
+@register_message
+class MOSDECSubOpWriteReply(_JsonMessage):
+    TYPE = 48
+    FIELDS = ("reqid", "pgid", "shard", "epoch", "rc", "from_osd")
+
+
+@register_message
+class MOSDECSubOpRead(_JsonMessage):
+    """Primary → shard: read chunk extents (reference MOSDECSubOpRead)."""
+    TYPE = 49
+    FIELDS = ("tid", "pgid", "shard", "epoch", "oid", "attrs")
+
+
+@register_message
+class MOSDECSubOpReadReply(_JsonMessage):
+    TYPE = 50
+    FIELDS = ("tid", "pgid", "shard", "epoch", "rc", "data", "attrs",
+              "from_osd")
+
+
+@register_message
+class MOSDPing(_JsonMessage):
+    """OSD↔OSD heartbeat (reference MOSDPing; kind: "ping" |
+    "ping_reply")."""
+    TYPE = 51
+    FIELDS = ("from_osd", "epoch", "kind", "stamp")
+
+
+@register_message
+class MOSDPGPush(_JsonMessage):
+    """Recovery push: full object (or shard chunk) state (reference
+    MOSDPGPush carrying PushOp)."""
+    TYPE = 52
+    FIELDS = ("pgid", "epoch", "oid", "data", "attrs", "omap", "version",
+              "from_osd", "pull_tid")
+
+
+@register_message
+class MOSDPGPushReply(_JsonMessage):
+    TYPE = 53
+    FIELDS = ("pgid", "epoch", "oid", "from_osd")
+
+
+@register_message
+class MOSDPGPull(_JsonMessage):
+    """Primary-missing recovery: ask a peer holding the object to push
+    it back (reference MOSDPGPull carrying PullOp)."""
+    TYPE = 54
+    FIELDS = ("pgid", "epoch", "oid", "from_osd", "pull_tid")

@@ -158,6 +158,9 @@ class OSDMap:
         self.pg_upmap_items: dict[PGid, list[tuple[int, int]]] = {}
         self.erasure_code_profiles: dict[str, dict[str, str]] = {}
         self.flags = 0
+        # daemon addresses, "host:port" — the Objecter's routing table
+        # (reference OSDMap::get_addrs)
+        self.osd_addrs: dict[int, str] = {}
 
     # -- construction ------------------------------------------------------
     @classmethod

@@ -1,0 +1,145 @@
+"""OSD types: eversion, pg_info, pg_log, missing set.
+
+Reference behavior re-created (``src/osd/osd_types.{h,cc}``,
+``src/osd/PGLog.{h,cc}``; SURVEY.md §3.5):
+
+- ``eversion_t`` — (epoch, version) totally ordered pairs stamping
+  every PG mutation;
+- ``pg_log_entry_t`` — MODIFY/DELETE/ERROR entries keyed by object,
+  carrying the request id for duplicate-op detection;
+- ``PGLog`` — the bounded per-PG op journal; divergence between a
+  peer's ``last_update`` and the authoritative log yields that peer's
+  **missing set** (object → newest version needed), which drives
+  log-based recovery instead of full backfill;
+- ``pg_info_t`` — the summary peers exchange during peering.
+
+All types are dict-round-trippable: they ride in MOSDPGNotify/Log
+messages and persist in the PG's meta object, the same dual life the
+reference's encode/decode gives them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# log entry ops (reference pg_log_entry_t::{MODIFY,DELETE,ERROR})
+MODIFY = "modify"
+DELETE = "delete"
+ERROR = "error"
+
+ZERO = (0, 0)    # eversion_t() — "nothing"
+
+
+def ver_str(v: tuple[int, int]) -> str:
+    return f"{v[0]}'{v[1]}"
+
+
+@dataclass
+class LogEntry:
+    op: str                     # MODIFY | DELETE | ERROR
+    oid: str
+    version: tuple[int, int]    # eversion: (epoch, v)
+    prior_version: tuple[int, int] = ZERO
+    reqid: str = ""             # "client:tid" for dup detection
+    mtime: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {"op": self.op, "oid": self.oid,
+                "version": list(self.version),
+                "prior_version": list(self.prior_version),
+                "reqid": self.reqid, "mtime": self.mtime}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LogEntry":
+        return cls(op=d["op"], oid=d["oid"],
+                   version=tuple(d["version"]),
+                   prior_version=tuple(d.get("prior_version", ZERO)),
+                   reqid=d.get("reqid", ""), mtime=d.get("mtime", 0.0))
+
+
+@dataclass
+class PGInfo:
+    pgid: str
+    last_update: tuple[int, int] = ZERO
+    last_complete: tuple[int, int] = ZERO
+    log_tail: tuple[int, int] = ZERO
+    same_interval_since: int = 0
+    epoch_created: int = 0
+
+    def to_dict(self) -> dict:
+        return {"pgid": self.pgid,
+                "last_update": list(self.last_update),
+                "last_complete": list(self.last_complete),
+                "log_tail": list(self.log_tail),
+                "same_interval_since": self.same_interval_since,
+                "epoch_created": self.epoch_created}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PGInfo":
+        return cls(pgid=d["pgid"],
+                   last_update=tuple(d["last_update"]),
+                   last_complete=tuple(d["last_complete"]),
+                   log_tail=tuple(d.get("log_tail", ZERO)),
+                   same_interval_since=d.get("same_interval_since", 0),
+                   epoch_created=d.get("epoch_created", 0))
+
+
+@dataclass
+class PGLog:
+    """The per-PG op journal (reference ``PGLog``/``pg_log_t``)."""
+
+    entries: list[LogEntry] = field(default_factory=list)
+    tail: tuple[int, int] = ZERO      # versions ≤ tail are trimmed away
+
+    @property
+    def head(self) -> tuple[int, int]:
+        return self.entries[-1].version if self.entries else self.tail
+
+    def add(self, e: LogEntry):
+        self.entries.append(e)
+
+    def trim(self, to: tuple[int, int]):
+        """Drop entries ≤ `to` (reference PGLog::trim)."""
+        self.entries = [e for e in self.entries if e.version > to]
+        if to > self.tail:
+            self.tail = to
+
+    def find_reqid(self, reqid: str) -> LogEntry | None:
+        """Duplicate-op check (reference pg_log dup detection)."""
+        for e in reversed(self.entries):
+            if e.reqid == reqid:
+                return e
+        return None
+
+    def entries_after(self, since: tuple[int, int]) -> list[LogEntry]:
+        return [e for e in self.entries if e.version > since]
+
+    def missing_for(self, peer_last_update: tuple[int, int],
+                    ) -> dict[str, tuple[int, int] | None]:
+        """Objects a peer at `peer_last_update` lacks, per this
+        (authoritative) log: object → newest needed version, or None
+        when the newest entry is a delete (reference
+        PGLog::merge_log building pg_missing_t).
+
+        Requires ``peer_last_update >= tail`` — otherwise the journal
+        no longer covers the peer's gap and backfill (full resync) is
+        needed; the caller checks that."""
+        missing: dict[str, tuple[int, int] | None] = {}
+        for e in self.entries:
+            if e.version <= peer_last_update:
+                continue
+            if e.op == MODIFY:
+                missing[e.oid] = e.version
+            elif e.op == DELETE:
+                missing[e.oid] = None
+        return missing
+
+    def to_dict(self) -> dict:
+        return {"tail": list(self.tail),
+                "entries": [e.to_dict() for e in self.entries]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PGLog":
+        return cls(entries=[LogEntry.from_dict(e)
+                            for e in d.get("entries", [])],
+                   tail=tuple(d.get("tail", ZERO)))

@@ -1,0 +1,7 @@
+"""Client object layer (reference ``src/osdc/`` + ``src/librados/``;
+SURVEY.md §3.8): the Objecter op engine and the librados-style API."""
+
+from .objecter import Objecter
+from .librados import Rados, IoCtx, Completion
+
+__all__ = ["Objecter", "Rados", "IoCtx", "Completion"]

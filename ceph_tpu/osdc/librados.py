@@ -1,0 +1,253 @@
+"""librados-style API — Rados / IoCtx / Completion.
+
+Reference behavior re-created (``src/librados/``, ``librados.hpp``;
+SURVEY.md §3.8): a cluster handle (`Rados`) opens per-pool I/O contexts
+(`IoCtx`); object ops compose into one submission (the reference's
+``ObjectWriteOperation``); sync wrappers ride the async engine, and
+``aio_*`` return `Completion` objects with ``wait_for_complete``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..mon.client import MonClient
+from .objecter import Objecter
+
+
+class Error(Exception):
+    def __init__(self, rc: int, msg: str = ""):
+        super().__init__(f"rc={rc}: {msg}")
+        self.rc = rc
+
+
+class ObjectNotFound(Error):
+    pass
+
+
+def _raise(rc: int, outs: str):
+    if rc == -2:
+        raise ObjectNotFound(rc, outs)
+    if rc != 0:
+        raise Error(rc, outs)
+
+
+class Completion:
+    """AioCompletion analog."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self.rc: int | None = None
+        self.results = None
+        self.version = (0, 0)
+
+    def _complete(self, rc, outs, results, version):
+        self.rc, self.results, self.version = rc, results, version
+        self._ev.set()
+
+    def wait_for_complete(self, timeout: float | None = None) -> bool:
+        return self._ev.wait(timeout)
+
+    def is_complete(self) -> bool:
+        return self._ev.is_set()
+
+
+class Rados:
+    """Cluster handle (reference ``librados::Rados``)."""
+
+    def __init__(self, monmap, name: str = "client.admin"):
+        self.monmap = monmap
+        self.name = name
+        self.monc = MonClient(monmap, entity=name)
+        self.objecter: Objecter | None = None
+
+    def connect(self, timeout: float = 15.0):
+        self.objecter = Objecter(self.monmap, entity=self.name)
+        self.objecter.wait_for_osdmap(1, timeout)
+        return self
+
+    def shutdown(self):
+        if self.objecter:
+            self.objecter.shutdown()
+        self.monc.shutdown()
+
+    # -- pool ops (mon plane) ---------------------------------------------
+    def create_pool(self, name: str, *, pg_num: int = 8,
+                    pool_type: str = "replicated", size: int = 3,
+                    erasure_code_profile: str = "", rule: int = 0):
+        cmd = {"prefix": "osd pool create", "pool": name,
+               "pg_num": pg_num, "pool_type": pool_type, "size": size,
+               "rule": rule}
+        if erasure_code_profile:
+            cmd["erasure_code_profile"] = erasure_code_profile
+        rc, outs, _ = self.monc.command(cmd)
+        _raise(rc, outs)
+
+    def delete_pool(self, name: str):
+        rc, outs, _ = self.monc.command(
+            {"prefix": "osd pool delete", "pool": name})
+        _raise(rc, outs)
+
+    def list_pools(self) -> list[str]:
+        rc, outs, out = self.monc.command({"prefix": "osd pool ls"})
+        _raise(rc, outs)
+        return out
+
+    def pool_lookup(self, name: str, timeout: float = 10.0) -> int:
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            m = self.objecter.osdmap
+            if name in m.pool_name:
+                return m.pool_name[name]
+            time.sleep(0.05)
+        raise ObjectNotFound(-2, f"pool {name!r}")
+
+    def open_ioctx(self, pool_name: str) -> "IoCtx":
+        return IoCtx(self, self.pool_lookup(pool_name), pool_name)
+
+    def mon_command(self, cmd: dict):
+        return self.monc.command(cmd)
+
+
+class IoCtx:
+    """Per-pool I/O context (reference ``librados::IoCtx``)."""
+
+    def __init__(self, rados: Rados, pool_id: int, pool_name: str):
+        self.rados = rados
+        self.pool_id = pool_id
+        self.pool_name = pool_name
+        self.objecter = rados.objecter
+
+    # -- async engine ------------------------------------------------------
+    def _aio(self, oid: str, ops: list[dict]) -> Completion:
+        c = Completion()
+        self.objecter.op_submit(self.pool_id, oid, ops, c._complete)
+        return c
+
+    def _sync(self, oid: str, ops: list[dict], timeout: float = 10.0):
+        rc, outs, results, version = self.objecter.operate(
+            self.pool_id, oid, ops, timeout)
+        _raise(rc, outs)
+        return results, version
+
+    # -- writes ------------------------------------------------------------
+    def write_full(self, oid: str, data: bytes):
+        self._sync(oid, [{"op": "write_full", "data": data.hex()}])
+
+    def write(self, oid: str, data: bytes, off: int = 0):
+        self._sync(oid, [{"op": "write", "off": off,
+                          "data": data.hex()}])
+
+    def append(self, oid: str, data: bytes):
+        self._sync(oid, [{"op": "append", "data": data.hex()}])
+
+    def truncate(self, oid: str, size: int):
+        self._sync(oid, [{"op": "truncate", "size": size}])
+
+    def remove(self, oid: str):
+        self._sync(oid, [{"op": "delete"}])
+
+    def setxattr(self, oid: str, name: str, value: bytes):
+        self._sync(oid, [{"op": "setxattr", "name": name,
+                          "data": value.hex()}])
+
+    def rmxattr(self, oid: str, name: str):
+        self._sync(oid, [{"op": "rmxattr", "name": name}])
+
+    def omap_set(self, oid: str, kv: dict[str, bytes]):
+        self._sync(oid, [{"op": "omap_set",
+                          "kv": {k: v.hex() for k, v in kv.items()}}])
+
+    def omap_rm_keys(self, oid: str, keys: list[str]):
+        self._sync(oid, [{"op": "omap_rm", "keys": list(keys)}])
+
+    def aio_write_full(self, oid: str, data: bytes) -> Completion:
+        return self._aio(oid, [{"op": "write_full", "data": data.hex()}])
+
+    def aio_append(self, oid: str, data: bytes) -> Completion:
+        return self._aio(oid, [{"op": "append", "data": data.hex()}])
+
+    def aio_remove(self, oid: str) -> Completion:
+        return self._aio(oid, [{"op": "delete"}])
+
+    # -- reads -------------------------------------------------------------
+    def read(self, oid: str, length: int | None = None,
+             off: int = 0) -> bytes:
+        op = {"op": "read", "off": off}
+        if length is not None:
+            op["len"] = length
+        results, _ = self._sync(oid, [op])
+        return bytes.fromhex(results[0]["data"])
+
+    def aio_read(self, oid: str, length: int | None = None,
+                 off: int = 0) -> Completion:
+        op = {"op": "read", "off": off}
+        if length is not None:
+            op["len"] = length
+        return self._aio(oid, [op])
+
+    def stat(self, oid: str) -> dict:
+        results, _ = self._sync(oid, [{"op": "stat"}])
+        return results[0]
+
+    def getxattr(self, oid: str, name: str) -> bytes:
+        results, _ = self._sync(oid, [{"op": "getxattr", "name": name}])
+        return bytes.fromhex(results[0]["data"])
+
+    def getxattrs(self, oid: str) -> dict[str, bytes]:
+        results, _ = self._sync(oid, [{"op": "getxattrs"}])
+        return {k: bytes.fromhex(v)
+                for k, v in results[0]["attrs"].items()}
+
+    def omap_get(self, oid: str) -> dict[str, bytes]:
+        results, _ = self._sync(oid, [{"op": "omap_get"}])
+        return {k: bytes.fromhex(v) for k, v in results[0]["kv"].items()}
+
+    def list_objects(self, timeout: float = 20.0) -> list[str]:
+        """Pool listing = pgls over every PG (reference pool listing
+        iterates PGs the same way)."""
+        m = self.objecter.osdmap
+        pool = m.pools[self.pool_id]
+        oids: set[str] = set()
+        from ..osd.osdmap import PGid
+        for ps in range(pool.pg_num):
+            rc, _outs, results, _ = self._pgls(PGid(self.pool_id, ps),
+                                               timeout)
+            if rc == 0 and results:
+                oids.update(results[0].get("objects", []))
+        return sorted(oids)
+
+    def _pgls(self, pgid, timeout):
+        """Direct-to-PG listing op (bypasses the name→PG hash)."""
+        import threading as _t
+        ev = _t.Event()
+        box: list = []
+
+        def on_reply(rc, outs, results, version):
+            box.append((rc, outs, results, version))
+            ev.set()
+
+        with self.objecter.lock:
+            self.objecter._tid += 1
+            from .objecter import _Op
+            op = _Op(self.objecter._tid, self.pool_id, "",
+                     [{"op": "pgls"}], on_reply)
+            op.pgid = pgid
+            self.objecter.inflight[op.tid] = op
+            _up, _upp, _acting, primary = \
+                self.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            op.target_osd = primary
+            con = self.objecter._osd_con(primary)
+            if con is not None:
+                from ..osd import messages as M
+                con.send_message(M.MOSDOp(
+                    tid=op.tid, client=self.objecter.entity,
+                    pgid=str(pgid), oid="",
+                    epoch=self.objecter.osdmap.epoch,
+                    ops=[{"op": "pgls"}], flags=0))
+        if not ev.wait(timeout):
+            with self.objecter.lock:
+                self.objecter.inflight.pop(op.tid, None)
+            raise TimeoutError(f"pgls {pgid} timed out")
+        return box[0]

@@ -1,0 +1,219 @@
+"""Objecter — the client op engine.
+
+Reference behavior re-created (``src/osdc/Objecter.{h,cc}``; SURVEY.md
+§3.8, §4.1):
+
+- ``_calc_target``: object → PG (rjenkins str hash + ``ceph_stable_mod``
+  fold) → acting primary, all computed client-side from the cached,
+  subscription-updated OSDMap — no lookup service anywhere, the CRUSH
+  contract;
+- in-flight op tracking: every op keeps its computed target; each new
+  map epoch recomputes targets and **resends** ops whose primary moved
+  (or that raced an interval change and got EAGAIN), so map churn
+  mid-workload loses nothing — duplicate delivery is absorbed by the
+  PG-log reqid dup detection on the OSD;
+- connection resets requeue every op targeted at that OSD.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..mon.client import MonClient
+from ..msg import Dispatcher, EntityAddr, Messenger
+from ..osd import messages as M
+from ..osd.osdmap import OSDMap, PGid
+from ..tools.osdmaptool import osdmap_from_dict
+
+
+class _Op:
+    __slots__ = ("tid", "pool", "oid", "ops", "on_reply", "pgid",
+                 "target_osd", "attempts", "submitted")
+
+    def __init__(self, tid, pool, oid, ops, on_reply):
+        self.tid = tid
+        self.pool = pool
+        self.oid = oid
+        self.ops = ops
+        self.on_reply = on_reply
+        self.pgid: PGid | None = None
+        self.target_osd = -1
+        self.attempts = 0
+        self.submitted = time.monotonic()
+
+
+class Objecter(Dispatcher):
+    def __init__(self, monmap, entity: str = "client.objecter", *,
+                 resend_interval: float = 2.0):
+        self.entity = entity
+        self.monc = MonClient(monmap, entity=entity)
+        self.msgr = Messenger(entity)
+        self.msgr.add_dispatcher(self)
+        self.osdmap = OSDMap()
+        self.lock = threading.RLock()
+        self._tid = 0
+        self.inflight: dict[int, _Op] = {}
+        self._osd_cons: dict[int, object] = {}
+        self._map_waiters: list[threading.Event] = []
+        self.monc.on_osdmap = self._on_osdmap
+        self.monc.sub_want("osdmap")
+        # op resend tick: an op can be dropped server-side by an
+        # interval change racing its execution (the OSD clears backend
+        # state on re-peering); periodic resend makes every op
+        # eventually complete — duplicates are absorbed by PG-log
+        # reqid dup detection (reference: Objecter op resend +
+        # osd_op_complaint/backoff machinery)
+        self._resend_interval = resend_interval
+        self._stop = threading.Event()
+        self._ticker = threading.Thread(
+            target=self._resend_loop, name=f"{entity}-resend",
+            daemon=True)
+        self._ticker.start()
+
+    def _resend_loop(self):
+        while not self._stop.wait(self._resend_interval):
+            now = time.monotonic()
+            with self.lock:
+                for op in list(self.inflight.values()):
+                    if now - op.submitted > self._resend_interval:
+                        op.submitted = now
+                        self._send_op(op)
+
+    def wait_for_osdmap(self, min_epoch: int = 1, timeout: float = 10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.osdmap.epoch >= min_epoch:
+                    return
+            time.sleep(0.02)
+        raise TimeoutError("no osdmap")
+
+    def shutdown(self):
+        self._stop.set()
+        self.monc.shutdown()
+        self.msgr.shutdown()
+
+    # -- map flow ----------------------------------------------------------
+    def _on_osdmap(self, epoch: int, map_dict: dict):
+        with self.lock:
+            if epoch <= self.osdmap.epoch:
+                return
+            self.osdmap = osdmap_from_dict(map_dict)
+            # recompute every in-flight target; resend movers
+            # (reference Objecter::handle_osd_map → _scan_requests)
+            for op in list(self.inflight.values()):
+                pgid, primary = self._calc_target(op.pool, op.oid)
+                if pgid != op.pgid or primary != op.target_osd:
+                    self._send_op(op)
+            for ev in self._map_waiters:
+                ev.set()
+            self._map_waiters.clear()
+
+    # -- target computation ------------------------------------------------
+    def _calc_target(self, pool: int, oid: str) -> tuple[PGid, int]:
+        raw = self.osdmap.object_locator_to_pg(oid, pool)
+        pgid = self.osdmap.raw_pg_to_pg(raw)
+        _up, _upp, _acting, primary = \
+            self.osdmap.pg_to_up_acting_osds(pgid)
+        return pgid, primary
+
+    # -- submission --------------------------------------------------------
+    def op_submit(self, pool: int, oid: str, ops: list[dict],
+                  on_reply) -> int:
+        with self.lock:
+            self._tid += 1
+            op = _Op(self._tid, pool, oid, list(ops), on_reply)
+            self.inflight[op.tid] = op
+            self._send_op(op)
+            return op.tid
+
+    def _send_op(self, op: _Op):
+        pgid, primary = self._calc_target(op.pool, op.oid)
+        op.pgid, op.target_osd = pgid, primary
+        op.attempts += 1
+        if primary < 0:
+            return   # no primary this epoch: wait for the next map
+        con = self._osd_con(primary)
+        if con is None:
+            return
+        try:
+            con.send_message(M.MOSDOp(
+                tid=op.tid, client=self.entity, pgid=str(pgid),
+                oid=op.oid, epoch=self.osdmap.epoch, ops=op.ops,
+                flags=0))
+        except ConnectionError:
+            self._osd_cons.pop(primary, None)
+
+    def _osd_con(self, osd: int):
+        addr_s = self.osdmap.osd_addrs.get(osd)
+        if not addr_s:
+            return None
+        cached = self._osd_cons.get(osd)
+        if cached is not None:
+            cached_addr, con = cached
+            if cached_addr == addr_s and not con._closed:
+                return con
+            con.mark_down()   # stale incarnation: reconnect fresh
+        host, _, port = addr_s.rpartition(":")
+        con = self.msgr.connect_to_lazy(EntityAddr(host, int(port)))
+        self._osd_cons[osd] = (addr_s, con)
+        return con
+
+    # -- replies -----------------------------------------------------------
+    def ms_dispatch(self, msg) -> bool:
+        if not isinstance(msg, M.MOSDOpReply):
+            return False
+        with self.lock:
+            op = self.inflight.get(msg.tid)
+            if op is None:
+                return True
+            if msg.rc == -11:
+                # wrong/new primary: retry after the next map (or a
+                # short delay if our map is already newer)
+                if msg.epoch is not None and \
+                        msg.epoch > self.osdmap.epoch:
+                    return True   # our map push will trigger resend
+                t = threading.Timer(0.1, self._retry, args=(msg.tid,))
+                t.daemon = True
+                t.start()
+                return True
+            del self.inflight[msg.tid]
+        op.on_reply(msg.rc, msg.outs, msg.results,
+                    tuple(msg.version or (0, 0)))
+        return True
+
+    def _retry(self, tid: int):
+        with self.lock:
+            op = self.inflight.get(tid)
+            if op is not None:
+                self._send_op(op)
+
+    def ms_handle_reset(self, con):
+        with self.lock:
+            victims = [o for o, (_a, c) in self._osd_cons.items()
+                       if c is con]
+            for o in victims:
+                del self._osd_cons[o]
+            for op in self.inflight.values():
+                if op.target_osd in victims:
+                    self._send_op(op)
+
+    # -- sync convenience --------------------------------------------------
+    def operate(self, pool: int, oid: str, ops: list[dict],
+                timeout: float = 10.0):
+        """→ (rc, outs, results, version) with resend-until-timeout."""
+        ev = threading.Event()
+        box: list = []
+
+        def on_reply(rc, outs, results, version):
+            box.append((rc, outs, results, version))
+            ev.set()
+
+        tid = self.op_submit(pool, oid, ops, on_reply)
+        if not ev.wait(timeout):
+            with self.lock:
+                self.inflight.pop(tid, None)
+            raise TimeoutError(
+                f"osd op on {oid!r} (pool {pool}) timed out")
+        return box[0]

@@ -55,6 +55,7 @@ def osdmap_to_dict(m: OSDMap) -> dict:
         "pg_upmap_items": {str(pg): [list(pair) for pair in pairs]
                            for pg, pairs in m.pg_upmap_items.items()},
         "erasure_code_profiles": m.erasure_code_profiles,
+        "osd_addrs": {str(o): a for o, a in m.osd_addrs.items()},
     }
 
 
@@ -78,6 +79,7 @@ def osdmap_from_dict(d: dict) -> OSDMap:
         PGid.parse(s): [tuple(pair) for pair in v]
         for s, v in d.get("pg_upmap_items", {}).items()}
     m.erasure_code_profiles = d.get("erasure_code_profiles", {})
+    m.osd_addrs = {int(o): a for o, a in d.get("osd_addrs", {}).items()}
     return m
 
 

@@ -1,0 +1,143 @@
+"""MiniCluster — the in-process dev cluster (vstart.sh analog).
+
+Reference behavior re-created (``src/vstart.sh`` + the
+``qa/standalone/ceph-helpers.sh`` throwaway-cluster pattern; SURVEY.md
+§5.3): N mons + M osds on localhost sockets, started from nothing,
+with helpers to kill/revive daemons — the single-host integration
+fixture every end-to-end test runs on, and the substrate for the
+``rados bench`` harness.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+from .mon.monitor import MonMap, Monitor
+from .msg import EntityAddr
+from .osd.daemon import OSDaemon
+from .osdc.librados import Rados
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class MiniCluster:
+    def __init__(self, n_mons: int = 3, n_osds: int = 3, *,
+                 osd_stores=None, mon_stores=None):
+        ports = _free_ports(n_mons)
+        self.monmap = MonMap(mons={r: EntityAddr("127.0.0.1", ports[r])
+                                   for r in range(n_mons)})
+        self.mons = [Monitor(r, self.monmap,
+                             store=mon_stores[r] if mon_stores else None)
+                     for r in range(n_mons)]
+        self._osd_stores = osd_stores
+        self.osds: dict[int, OSDaemon] = {}
+        self.n_osds = n_osds
+        self._clients: list[Rados] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, timeout: float = 30.0) -> "MiniCluster":
+        for m in self.mons:
+            m.start()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if any(m.is_leader for m in self.mons):
+                break
+            time.sleep(0.02)
+        else:
+            raise TimeoutError("no mon leader")
+        for i in range(self.n_osds):
+            self.start_osd(i)
+        return self
+
+    def start_osd(self, i: int, timeout: float = 15.0) -> OSDaemon:
+        store = self._osd_stores[i] if self._osd_stores else None
+        osd = OSDaemon(i, self.monmap, store=store)
+        osd.start(wait_for_up=True, timeout=timeout)
+        self.osds[i] = osd
+        return osd
+
+    def kill_osd(self, i: int):
+        """Hard-stop an OSD (keeps its store object for a revive)."""
+        osd = self.osds.pop(i)
+        osd.running = False
+        osd.timer.shutdown()
+        osd.monc.shutdown()
+        osd.msgr.shutdown()
+        # deliberately NOT umounting: a revive remounts the same store
+        if self._osd_stores is None:
+            self._osd_stores = {}
+        if not isinstance(self._osd_stores, dict):
+            self._osd_stores = {j: s for j, s in
+                                enumerate(self._osd_stores)}
+        self._osd_stores[i] = osd.store
+
+    def revive_osd(self, i: int, timeout: float = 15.0) -> OSDaemon:
+        return self.start_osd(i, timeout=timeout)
+
+    def stop(self):
+        for c in self._clients:
+            try:
+                c.shutdown()
+            except Exception:
+                pass
+        for osd in list(self.osds.values()):
+            try:
+                osd.shutdown()
+            except Exception:
+                pass
+        for m in self.mons:
+            try:
+                m.shutdown()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- clients -----------------------------------------------------------
+    def rados(self, name: str = "client.admin") -> Rados:
+        r = Rados(self.monmap, name=name).connect()
+        self._clients.append(r)
+        return r
+
+    # -- cluster helpers ---------------------------------------------------
+    def wait_for_clean(self, timeout: float = 30.0):
+        """Wait until every PG on every live OSD is active (+clean when
+        it owns recovery state)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            states = []
+            for osd in self.osds.values():
+                with osd.lock:
+                    states.extend(pg.state for pg in osd.pgs.values()
+                                  if osd.whoami == pg.primary)
+            if states and all(s in ("active", "active+clean")
+                              for s in states):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"cluster never went clean: {states}")
+
+    def wait_for_osd_down(self, i: int, timeout: float = 20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for osd in self.osds.values():
+                with osd.lock:
+                    if osd.osdmap.max_osd > i and \
+                            not osd.osdmap.is_up(i):
+                        return
+            time.sleep(0.05)
+        raise TimeoutError(f"osd.{i} never marked down")

@@ -1,0 +1,281 @@
+"""End-to-end RADOS spine tests on the in-process MiniCluster.
+
+The tier-3 integration layer (reference ``vstart.sh`` +
+``qa/standalone/``; SURVEY.md §5.3): real sockets, real mons, real
+OSDs, real client — covering the reference's
+``qa/standalone/erasure-code/test-erasure-code.sh`` (EC write →
+kill → degraded read) and osd-thrash style flows at mini scale.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.os_store import WALStore
+from ceph_tpu.osd.types import LogEntry, PGLog, MODIFY, DELETE
+from ceph_tpu.vstart import MiniCluster
+
+
+# ---------------------------------------------------------------------------
+# unit: PGLog divergence → missing sets
+# ---------------------------------------------------------------------------
+class TestPGLog:
+    def test_missing_for(self):
+        log = PGLog()
+        log.add(LogEntry(MODIFY, "a", (1, 1)))
+        log.add(LogEntry(MODIFY, "b", (1, 2)))
+        log.add(LogEntry(MODIFY, "a", (2, 3)))
+        log.add(LogEntry(DELETE, "b", (2, 4)))
+        assert log.missing_for((1, 2)) == {"a": (2, 3), "b": None}
+        assert log.missing_for((2, 4)) == {}
+        assert log.missing_for((0, 0)) == {"a": (2, 3), "b": None}
+
+    def test_dup_detection_and_trim(self):
+        log = PGLog()
+        log.add(LogEntry(MODIFY, "a", (1, 1), reqid="c:1"))
+        log.add(LogEntry(MODIFY, "a", (1, 2), reqid="c:2"))
+        assert log.find_reqid("c:1").version == (1, 1)
+        assert log.find_reqid("c:9") is None
+        log.trim((1, 1))
+        assert log.find_reqid("c:1") is None
+        assert log.tail == (1, 1) and log.head == (1, 2)
+
+    def test_wire_roundtrip(self):
+        log = PGLog(tail=(1, 0))
+        log.add(LogEntry(MODIFY, "x", (1, 1), prior_version=(0, 0),
+                         reqid="c:1", mtime=1.5))
+        log2 = PGLog.from_dict(log.to_dict())
+        assert log2.tail == (1, 0)
+        assert log2.entries[0].version == (1, 1)
+        assert log2.entries[0].reqid == "c:1"
+
+
+# ---------------------------------------------------------------------------
+# replicated pool: the §4.1 spine
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="class")
+def repl_cluster():
+    c = MiniCluster(n_mons=3, n_osds=3)
+    c.start()
+    r = c.rados()
+    r.create_pool("rp", pg_num=8, size=3, pool_type="replicated")
+    io = r.open_ioctx("rp")
+    c.wait_for_clean()
+    yield c, r, io
+    c.stop()
+
+
+class TestReplicatedPool:
+    def test_object_ops(self, repl_cluster):
+        c, r, io = repl_cluster
+        io.write_full("o1", b"hello")
+        assert io.read("o1") == b"hello"
+        io.append("o1", b" world")
+        assert io.read("o1") == b"hello world"
+        io.write("o1", b"J", 0)
+        assert io.read("o1") == b"Jello world"
+        assert io.stat("o1")["size"] == 11
+        io.setxattr("o1", "k", b"v")
+        assert io.getxattr("o1", "k") == b"v"
+        io.omap_set("o1", {"a": b"1", "b": b"2"})
+        io.omap_rm_keys("o1", ["b"])
+        assert io.omap_get("o1") == {"a": b"1"}
+        io.truncate("o1", 5)
+        assert io.read("o1") == b"Jello"
+        assert "o1" in io.list_objects()
+        io.remove("o1")
+        from ceph_tpu.osdc.librados import ObjectNotFound
+        with pytest.raises(ObjectNotFound):
+            io.stat("o1")
+
+    def test_three_copies_on_disk(self, repl_cluster):
+        c, r, io = repl_cluster
+        io.write_full("rep", b"x" * 100)
+        time.sleep(0.3)
+        copies = 0
+        for osd in c.osds.values():
+            with osd.lock:
+                for cid in osd.store.list_collections():
+                    if osd.store.exists(cid, "rep"):
+                        assert osd.store.read(cid, "rep") == b"x" * 100
+                        copies += 1
+        assert copies == 3
+
+    def test_failover_degraded_io_and_recovery(self, repl_cluster):
+        c, r, io = repl_cluster
+        for i in range(6):
+            io.write_full(f"f{i}", f"data-{i}".encode() * 10)
+        pool_id = r.pool_lookup("rp")
+        m = r.objecter.osdmap
+        pgid = m.raw_pg_to_pg(m.object_locator_to_pg("f3", pool_id))
+        _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+        c.kill_osd(primary)
+        c.wait_for_osd_down(primary)
+        # degraded read through the new primary
+        assert io.read("f3") == b"data-3" * 10
+        # degraded write
+        io.write_full("f3", b"NEWDATA")
+        assert io.read("f3") == b"NEWDATA"
+        # revive: log-based recovery must converge and carry NEWDATA
+        c.revive_osd(primary)
+        c.wait_for_clean(timeout=40)
+        osd = c.osds[primary]
+        deadline = time.monotonic() + 20
+        found = None
+        while time.monotonic() < deadline:
+            with osd.lock:
+                for cid in osd.store.list_collections():
+                    if osd.store.exists(cid, "f3"):
+                        found = osd.store.read(cid, "f3")
+            if found == b"NEWDATA":
+                break
+            time.sleep(0.2)
+        assert found == b"NEWDATA"
+
+    def test_ops_survive_map_churn(self, repl_cluster):
+        """Writes racing an osd kill/revive all land exactly once
+        (VERDICT round-2 item 4: map churn mid-run loses no op)."""
+        c, r, io = repl_cluster
+        completions = [io.aio_write_full(f"churn{i}", f"c-{i}".encode())
+                       for i in range(8)]
+        victim = max(c.osds)
+        c.kill_osd(victim)
+        completions += [io.aio_write_full(f"churn{i}", f"c-{i}".encode())
+                        for i in range(8, 16)]
+        c.wait_for_osd_down(victim)
+        c.revive_osd(victim)
+        for comp in completions:
+            assert comp.wait_for_complete(30)
+            assert comp.rc == 0
+        c.wait_for_clean(timeout=40)
+        for i in range(16):
+            assert io.read(f"churn{i}") == f"c-{i}".encode()
+
+
+# ---------------------------------------------------------------------------
+# EC pool: the §4.2/4.3 paths — the round-2 "done" criterion
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="class")
+def ec_cluster():
+    c = MiniCluster(n_mons=3, n_osds=6)
+    c.start()
+    r = c.rados()
+    rc, outs, _ = r.mon_command({
+        "prefix": "osd erasure-code-profile set", "name": "k4m2",
+        "profile": ["k=4", "m=2", "plugin=jax_tpu",
+                    "technique=reed_sol_van"]})
+    assert rc == 0, outs
+    r.create_pool("ecp", pg_num=4, pool_type="erasure",
+                  erasure_code_profile="k4m2")
+    io = r.open_ioctx("ecp")
+    c.wait_for_clean()
+    yield c, r, io
+    c.stop()
+
+
+class TestECPool:
+    PAYLOAD = bytes(range(256)) * 64      # 16 KiB
+
+    def test_write_read_roundtrip(self, ec_cluster):
+        c, r, io = ec_cluster
+        io.write_full("e1", self.PAYLOAD)
+        assert io.read("e1") == self.PAYLOAD
+        assert io.stat("e1")["size"] == len(self.PAYLOAD)
+        # range read decodes then slices
+        assert io.read("e1", 100, 50) == self.PAYLOAD[50:150]
+
+    def test_shards_distributed(self, ec_cluster):
+        c, r, io = ec_cluster
+        io.write_full("e2", self.PAYLOAD)
+        time.sleep(0.3)
+        holders = []
+        for i, osd in c.osds.items():
+            with osd.lock:
+                for cid in osd.store.list_collections():
+                    if osd.store.exists(cid, "e2"):
+                        holders.append(
+                            (i, len(osd.store.read(cid, "e2"))))
+        assert len(holders) == 6          # k+m shards, one per OSD
+        chunk = len(self.PAYLOAD) // 4
+        assert all(ln == chunk for _, ln in holders)
+
+    def test_partial_overwrite_rejected(self, ec_cluster):
+        c, r, io = ec_cluster
+        io.write_full("e3", self.PAYLOAD)
+        from ceph_tpu.osdc.librados import Error
+        with pytest.raises(Error):
+            io.write("e3", b"zz", 10)
+
+    def test_kill_osd_degraded_read_reconstructs(self, ec_cluster):
+        """The round-2 VERDICT criterion: client writes a k=4,m=2 EC
+        object via CRUSH placement, one OSD dies, mon marks it down, a
+        degraded read reconstructs through the decode path
+        byte-identically."""
+        c, r, io = ec_cluster
+        io.write_full("edeg", self.PAYLOAD)
+        pool_id = r.pool_lookup("ecp")
+        m = r.objecter.osdmap
+        pgid = m.raw_pg_to_pg(m.object_locator_to_pg("edeg", pool_id))
+        _, _, acting, _ = m.pg_to_up_acting_osds(pgid)
+        victim = acting[0]                # data shard 0 (and primary)
+        c.kill_osd(victim)
+        c.wait_for_osd_down(victim)
+        assert io.read("edeg") == self.PAYLOAD     # reconstructed
+        # degraded write with a shard hole, then read it back
+        io.write_full("edeg2", self.PAYLOAD[::-1])
+        assert io.read("edeg2") == self.PAYLOAD[::-1]
+        # revive: the missing shard chunks are reconstructed and
+        # pushed back (EC recovery = decode, not copy)
+        c.revive_osd(victim)
+        c.wait_for_clean(timeout=60)
+        osd = c.osds[victim]
+        deadline = time.monotonic() + 25
+        shards = set()
+        while time.monotonic() < deadline:
+            with osd.lock:
+                shards = {o for cid in osd.store.list_collections()
+                          for o in osd.store.list_objects(cid)
+                          if o.startswith("edeg")}
+            if {"edeg", "edeg2"} <= shards:
+                break
+            time.sleep(0.3)
+        assert {"edeg", "edeg2"} <= shards
+
+
+# ---------------------------------------------------------------------------
+# durability: WAL-backed OSDs survive restart (§6.4 checkpoint/resume)
+# ---------------------------------------------------------------------------
+class TestDurability:
+    def test_osd_restart_replays_wal(self, tmp_path):
+        stores = [WALStore(str(tmp_path / f"osd{i}.wal")) for i in range(3)]
+        c = MiniCluster(n_mons=1, n_osds=3, osd_stores=stores)
+        try:
+            c.start()
+            r = c.rados()
+            r.create_pool("dp", pg_num=4, size=3)
+            io = r.open_ioctx("dp")
+            c.wait_for_clean()
+            io.write_full("durable", b"survives")
+            time.sleep(0.3)
+            victim = 2
+            c.kill_osd(victim)
+            c.wait_for_osd_down(victim)
+            # fresh store OBJECT, same WAL file: cold restart
+            c._osd_stores[victim] = WALStore(
+                str(tmp_path / f"osd{victim}.wal"))
+            c.revive_osd(victim)
+            c.wait_for_clean(timeout=40)
+            osd = c.osds[victim]
+            found = None
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                with osd.lock:
+                    for cid in osd.store.list_collections():
+                        if osd.store.exists(cid, "durable"):
+                            found = osd.store.read(cid, "durable")
+                if found:
+                    break
+                time.sleep(0.2)
+            assert found == b"survives"
+        finally:
+            c.stop()
