@@ -98,6 +98,12 @@ class OSDMonitor(PaxosService):
     def __init__(self, mon):
         super().__init__(mon)
         self.osdmap = OSDMap()
+        # the initial map always carries the default replicated(0)/
+        # erasure(1) rules, so `osd pool create` on a fresh quorum —
+        # before any OSD has booted — succeeds exactly as the
+        # reference's pool create against the initial crush map does
+        # (`src/mon/OSDMonitor.cc` create_initial)
+        self.osdmap.crush = self._seed_crush(0)
         self.failure_reports: dict[int, set[int]] = {}
         # staged-but-uncommitted map: a second mutation arriving before
         # the first commits must build on IT, not on the committed map,
@@ -165,7 +171,26 @@ class OSDMonitor(PaxosService):
         if len(m.crush.buckets) == 0:
             m.crush = self._seed_crush(m.max_osd)
         elif m.crush.max_devices < m.max_osd:
-            root = m.crush.buckets[0]     # id -1: the conventional root
+            # resolve the actual root: prefer rule 0's take target,
+            # fall back to bucket id -1 (maps without either get no
+            # auto-extend; an admin owns such a hierarchy)
+            root = None
+            try:
+                rule0 = m.crush.rule_by_id(0)
+                for st in rule0.steps:
+                    if st.op == "take":
+                        # a class-filtered take walks a shadow bucket
+                        # (st.arg1); the REAL root is st.orig
+                        root = m.crush.bucket(
+                            st.orig if st.orig is not None else st.arg1)
+                        break
+            except KeyError:
+                pass
+            if root is None:
+                try:
+                    root = m.crush.bucket(-1)
+                except (KeyError, IndexError):
+                    root = None
             for dev in range(m.crush.max_devices, m.max_osd):
                 if root is not None and dev not in root.items:
                     root.items.append(dev)
@@ -481,6 +506,7 @@ class Monitor(Dispatcher):
         # must not be answered before its round commits
         self._commit_waiters: list[tuple[int, object]] = []
         self._election_started = 0.0
+        self._initial_created = False
         self.timer = SafeTimer(f"{self.name}-tick")
         self._tick_interval = tick_interval
         self._tick_token = None
@@ -548,6 +574,9 @@ class Monitor(Dispatcher):
         for _v, fn in waiters:
             fn(rc=-11, outs="leadership changed, retry", outb=None)
         self._proposal_queue.clear()
+        # any staged-but-uncommitted create_initial round died with the
+        # queue; let the next activation re-run it
+        self._initial_created = False
         osdsvc = self.services.get("osdmap")
         if osdsvc is not None:
             osdsvc.pending_map = None
@@ -557,6 +586,19 @@ class Monitor(Dispatcher):
         self._drain_outboxes()
 
     def _on_paxos_active(self):
+        # fresh cluster: create initial service state the moment paxos
+        # first goes active, not on the next tick — a command arriving
+        # in the window between election and first tick must already
+        # see the seeded maps/keyring.  A flag (reset per election)
+        # rather than a queue-empty guard: an early mutating request
+        # queued before activation must not starve create_initial
+        if self.is_leader and self.paxos.last_committed == 0 \
+                and not self._initial_created:
+            self._initial_created = True
+            for svc in self.services.values():
+                svc.create_initial()
+            self.propose()
+            return
         # drain queued proposals one at a time
         if self._proposal_queue and self.is_leader:
             value = self._proposal_queue.pop(0)
@@ -746,8 +788,11 @@ class Monitor(Dispatcher):
                     self._start_election()
                 elif self.paxos.is_active():
                     self.paxos.extend_lease()
-                    # create initial service state on a fresh cluster
-                    if self.paxos.last_committed == 0:
+                    # fallback seeding path (normally _on_paxos_active
+                    # already did this); same guard so it never re-runs
+                    if self.paxos.last_committed == 0 and \
+                            not self._initial_created:
+                        self._initial_created = True
                         for svc in self.services.values():
                             svc.create_initial()
                         self.propose()

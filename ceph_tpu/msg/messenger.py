@@ -324,6 +324,7 @@ class Messenger:
         self.max_queued = max_queued
         self.dispatchers: list[Dispatcher] = []
         self.connections: list[Connection] = []
+        self._down = False
         self._server: asyncio.AbstractServer | None = None
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
@@ -349,14 +350,45 @@ class Messenger:
         return EntityAddr(host, sock.getsockname()[1], self._nonce)
 
     def shutdown(self):
-        def _stop():
+        async def _stop():
+            # cancel, then AWAIT, every task before stopping the loop —
+            # stop() in the same callback leaves the cancellations
+            # unprocessed and the interpreter prints "Task was
+            # destroyed but it is pending!" for each at GC time
             for c in list(self.connections):
-                c._do_close()
+                c._closed = True
+                c._tasks = []
+                c._reconnect_task = None
+                if c._writer:
+                    c._writer.close()
+                    c._writer = None
+                self._conn_closed(c)
             if self._server:
                 self._server.close()
+            # sweep EVERY task on this loop — connection readers/
+            # senders, reconnect loops, AND in-flight _accept handlers
+            # (start_server spawns those; we hold no reference to them).
+            # Loop until drained: a cross-thread callback queued before
+            # _down was set can spawn a task while gather() yields
+            while True:
+                pending = [t for t in asyncio.all_tasks()
+                           if t is not asyncio.current_task()]
+                if not pending:
+                    break
+                for t in pending:
+                    t.cancel()
+                await asyncio.gather(*pending, return_exceptions=True)
             self._loop.stop()
-        self._call_soon(_stop)
+        if self._down or self._loop.is_closed():
+            return    # double shutdown
+        self._down = True
+        try:
+            asyncio.run_coroutine_threadsafe(_stop(), self._loop)
+        except RuntimeError:
+            return    # loop already gone
         self._thread.join(timeout=5)
+        if not self._thread.is_alive():
+            self._loop.close()
 
     # -- connecting --------------------------------------------------------
     def connect_to(self, addr: EntityAddr) -> Connection:
@@ -387,11 +419,14 @@ class Messenger:
                     self._notify_reset(con)
 
         def _spawn():
+            if self._down:
+                return    # raced shutdown(): don't spawn past the sweep
             con._reconnect_task = self._loop.create_task(_first())
 
         # create_task is NOT thread-safe and won't wake a foreign
-        # loop's selector; route through the self-pipe
-        self._loop.call_soon_threadsafe(_spawn)
+        # loop's selector; route through the self-pipe (_call_soon also
+        # absorbs the post-shutdown closed-loop RuntimeError)
+        self._call_soon(_spawn)
         return con
 
     async def _establish(self, con: Connection, resume: bool):
@@ -536,4 +571,7 @@ class Messenger:
             self.connections.remove(con)
 
     def _call_soon(self, fn, *args):
-        self._loop.call_soon_threadsafe(fn, *args)
+        try:
+            self._loop.call_soon_threadsafe(fn, *args)
+        except RuntimeError:
+            pass    # loop closed by shutdown(); nothing left to do
