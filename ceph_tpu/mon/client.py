@@ -139,8 +139,15 @@ class MonClient(Dispatcher):
             if msg.epoch >= self.osdmap_epoch:
                 self.osdmap_epoch = msg.epoch
                 self.osdmap_dict = msg.osdmap
+                # advance a range subscription so a reconnect resumes
+                # from the next unseen epoch instead of replaying all
+                if self._subs.get("osdmap", 0) > 0:
+                    self._subs["osdmap"] = max(self._subs["osdmap"],
+                                               msg.epoch + 1)
                 if self.on_osdmap:
-                    self.on_osdmap(msg.epoch, msg.osdmap)
+                    newest = msg.newest if msg.newest is not None \
+                        else msg.epoch
+                    self.on_osdmap(msg.epoch, msg.osdmap, newest)
             return True
         return False
 

@@ -68,7 +68,10 @@ class MMonMap(_JsonMessage):
 @register_message
 class MOSDMapMsg(_JsonMessage):
     TYPE = 22
-    FIELDS = ("epoch", "osdmap")  # full map dict (epoch-stamped)
+    # full map dict (epoch-stamped); `newest` is the mon's current
+    # epoch so a subscriber replaying history (start>0 subscriptions
+    # get the whole range) can tell catch-up maps from live ones
+    FIELDS = ("epoch", "osdmap", "newest")
 
 
 @register_message
@@ -85,5 +88,7 @@ class MOSDFailure(_JsonMessage):
 
 @register_message
 class MOSDAlive(_JsonMessage):
+    """A would-be primary asks the mon to record up_thru = want
+    before it activates (reference ``src/messages/MOSDAlive.h``)."""
     TYPE = 25
-    FIELDS = ("osd",)
+    FIELDS = ("osd", "want")

@@ -162,6 +162,7 @@ class OSDMonitor(PaxosService):
             m.max_osd = osd + 1
             m.osd_state += [0] * grow
             m.osd_weight += [0x10000] * grow
+            m.osd_up_thru += [0] * grow
         # keep the CRUSH tree covering every known device (the
         # reference's `osd crush add` that deploy tooling issues on
         # boot).  An EMPTY map is seeded flat with replicated(0)/
@@ -202,6 +203,19 @@ class OSDMonitor(PaxosService):
             m.osd_addrs[osd] = addr
         if m.is_out(osd):
             m.osd_weight[osd] = 0x10000
+        self._stage_map(m)
+        self.mon.propose()
+
+    def handle_alive(self, osd: int, want: int):
+        """Bump up_thru so the requesting primary's interval counts as
+        maybe-went-rw (reference OSDMonitor::prepare_alive)."""
+        if not (0 <= osd < self.osdmap.max_osd) or want is None:
+            return
+        cur = (self.pending_map or self.osdmap).osd_up_thru
+        if cur[osd] >= want or not self.osdmap.is_up(osd):
+            return
+        m = self._working()
+        m.osd_up_thru[osd] = want
         self._stage_map(m)
         self.mon.propose()
 
@@ -256,6 +270,8 @@ class OSDMonitor(PaxosService):
                 # the reference's EC default: min_size = k + 1 (survive
                 # writes with up to m-1 shards down, never go below k)
                 min_size = min(k + 1, size)
+            if cmd.get("min_size") is not None:
+                min_size = int(cmd["min_size"])
             default_rule = 1 if ptype == TYPE_ERASURE else 0
             rule_id = int(cmd.get("rule", default_rule))
             try:
@@ -692,15 +708,31 @@ class Monitor(Dispatcher):
             self._handle_command(msg)
             return True
         if isinstance(msg, M.MMonSubscribe):
-            self._subs.setdefault(msg.connection, {}).update(
-                json.loads(msg.what) if isinstance(msg.what, str)
-                else msg.what)
-            # immediate catch-up push
+            subs = (json.loads(msg.what) if isinstance(msg.what, str)
+                    else msg.what)
+            self._subs.setdefault(msg.connection, {}).update(subs)
+            # immediate catch-up push; a start epoch > 0 asks for the
+            # full history range (OSDs need every interval transition
+            # to build past_intervals — reference OSDs likewise fetch
+            # the map range they missed before processing), start == 0
+            # means "just the latest" (clients)
             osdsvc: OSDMonitor = self.services["osdmap"]
-            if osdsvc.osdmap.epoch >= 1:
-                msg.connection.send_message(M.MOSDMapMsg(
-                    epoch=osdsvc.osdmap.epoch,
-                    osdmap=osdmap_to_dict(osdsvc.osdmap)))
+            cur = osdsvc.osdmap.epoch
+            if cur >= 1:
+                start = subs.get("osdmap") or 0
+                try:
+                    if 0 < start <= cur:
+                        for e in range(start, cur):
+                            blob = self.store.get_str(osdsvc.prefix, e)
+                            if blob:
+                                msg.connection.send_message(M.MOSDMapMsg(
+                                    epoch=e, osdmap=json.loads(blob),
+                                    newest=cur))
+                    msg.connection.send_message(M.MOSDMapMsg(
+                        epoch=cur, osdmap=osdmap_to_dict(osdsvc.osdmap),
+                        newest=cur))
+                except ConnectionError:
+                    self._subs.pop(msg.connection, None)
             return True
         if isinstance(msg, M.MOSDBoot):
             if self.is_leader:
@@ -719,6 +751,13 @@ class Monitor(Dispatcher):
                 self._peer_send(self.elector.leader,
                                 M.MOSDFailure(target=msg.target,
                                               reporter=msg.reporter))
+            return True
+        if isinstance(msg, M.MOSDAlive):
+            if self.is_leader:
+                self.services["osdmap"].handle_alive(msg.osd, msg.want)
+            elif self.elector.leader is not None:
+                self._peer_send(self.elector.leader,
+                                M.MOSDAlive(osd=msg.osd, want=msg.want))
             return True
         return False
 

@@ -114,16 +114,21 @@ class Transaction:
         return self
 
     # -- wire form ---------------------------------------------------------
+    # Tagged envelopes keep the decode unambiguous regardless of user
+    # key names: a bytes arg becomes {"b": hex}, an attr/omap map
+    # becomes {"d": {key: {"b": hex} | value}}.  (A user attr literally
+    # named "hex"/"b" can no longer be confused with a payload.)
     def to_dict(self) -> list:
         out = []
         for op in self.ops:
             enc = []
             for a in op:
                 if isinstance(a, bytes):
-                    enc.append({"hex": a.hex()})
+                    enc.append({"b": a.hex()})
                 elif isinstance(a, dict):
-                    enc.append({k: v.hex() if isinstance(v, bytes) else v
-                                for k, v in a.items()})
+                    enc.append({"d": {
+                        k: {"b": v.hex()} if isinstance(v, bytes) else v
+                        for k, v in a.items()}})
                 else:
                     enc.append(a)
             out.append(enc)
@@ -134,17 +139,15 @@ class Transaction:
         t = cls()
         for op in data:
             dec = []
-            for i, a in enumerate(op):
-                if isinstance(a, dict) and set(a) == {"hex"}:
-                    dec.append(bytes.fromhex(a["hex"]))
-                elif isinstance(a, dict):
-                    # attr/omap maps: values were hex bytes except list
-                    # args which stay as-is
-                    if op[0] in (OP_SETATTRS, OP_OMAP_SETKEYS) and i == 3:
-                        dec.append({k: bytes.fromhex(v)
-                                    for k, v in a.items()})
-                    else:
-                        dec.append(a)
+            for a in op:
+                if isinstance(a, dict) and set(a) == {"b"}:
+                    dec.append(bytes.fromhex(a["b"]))
+                elif isinstance(a, dict) and set(a) == {"d"}:
+                    dec.append({
+                        k: (bytes.fromhex(v["b"])
+                            if isinstance(v, dict) and set(v) == {"b"}
+                            else v)
+                        for k, v in a["d"].items()})
                 else:
                     dec.append(a)
             t.ops.append(dec)

@@ -48,6 +48,13 @@ class OSDaemon(Dispatcher):
         self.monc = MonClient(monmap, entity=f"osd.{whoami}")
         self.osdmap = OSDMap()
         self.pgs: dict[PGid, PG] = {}
+        # interval history per PG, built by walking EVERY map epoch in
+        # order (the mon feeds the full range on a start>0
+        # subscription).  closed intervals: {"first","last","acting",
+        # "primary","maybe_went_rw"} — reference PastIntervals built
+        # by check_new_interval over the fetched map range.
+        self.pg_intervals: dict[PGid, list[dict]] = {}
+        self._open_intervals: dict[PGid, dict] = {}
         self.lock = threading.RLock()
         self.running = False
         self.addr: EntityAddr | None = None
@@ -65,7 +72,9 @@ class OSDaemon(Dispatcher):
         self.addr = self.msgr.bind()
         self.running = True
         self.monc.on_osdmap = self._on_osdmap
-        self.monc.sub_want("osdmap")
+        # subscribe from epoch 1: the full history replay rebuilds
+        # pg_intervals (a revived OSD starts a fresh daemon object)
+        self.monc.sub_want("osdmap", 1)
         self._send_boot()
         if wait_for_up:
             deadline = time.monotonic() + timeout
@@ -90,17 +99,75 @@ class OSDaemon(Dispatcher):
         self.monc.send(MM.MOSDBoot(
             osd=self.whoami, addr=f"{self.addr.host}:{self.addr.port}"))
 
+    def request_up_thru(self, want: int):
+        """Ask the mon to bump our up_thru (idempotent; the committed
+        map's arrival re-drives the waiting PGs' peering)."""
+        self.monc.send(MM.MOSDAlive(osd=self.whoami, want=want))
+
     # -- map handling ------------------------------------------------------
-    def _on_osdmap(self, epoch: int, map_dict: dict):
+    def _on_osdmap(self, epoch: int, map_dict: dict, newest: int = 0):
         with self.lock:
             if epoch <= self.osdmap.epoch:
                 return
+            prev = self.osdmap
             self.osdmap = osdmap_from_dict(map_dict)
+            # a peer that came back up starts a fresh grace window —
+            # its stale _hb_last would otherwise trip an immediate
+            # failure report (one flap per revive)
+            for o in range(self.osdmap.max_osd):
+                if self.osdmap.is_up(o) and \
+                        (o >= prev.max_osd or not prev.is_up(o)):
+                    self._hb_last.pop(o, None)
+                    self._hb_reported.pop(o, None)
+            self._update_pg_intervals()
+            catching_up = epoch < max(newest, self.monc.osdmap_epoch)
+            if catching_up:
+                # history replay: record intervals only — peering,
+                # PG creation and rejoin-boot wait for the live map
+                return
             if self.running and not self.osdmap.is_up(self.whoami):
                 # marked down but alive: rejoin (reference
                 # OSD::_committed_osd_maps → start_boot)
                 self._send_boot()
             self._scan_pgs()
+
+    def _update_pg_intervals(self):
+        """Track acting-set intervals for every PG of every pool at
+        every epoch (reference PastIntervals::check_new_interval).
+        ``maybe_went_rw``: the interval had a primary and at least
+        min_size live members, so it COULD have accepted writes —
+        peering must see a member of every such interval since
+        last_epoch_started before activating, or acknowledged writes
+        could be silently lost (ADVICE r2 high)."""
+        m = self.osdmap
+        from ..crush.map import CRUSH_ITEM_NONE
+        for pool in m.pools.values():
+            for ps in range(pool.pg_num):
+                pgid = PGid(pool.id, ps)
+                _up, _upp, acting, actingp = m.pg_to_up_acting_osds(pgid)
+                open_iv = self._open_intervals.get(pgid)
+                if open_iv is not None and \
+                        open_iv["acting"] == acting and \
+                        open_iv["primary"] == actingp:
+                    continue
+                if open_iv is not None and open_iv["primary"] != -1:
+                    open_iv["last"] = m.epoch - 1
+                    # rw additionally requires the primary to have
+                    # bumped up_thru into the interval (reference
+                    # check_new_interval's could_have_gone_active):
+                    # a primary that was already dead never does, so
+                    # its phantom intervals can't block peering
+                    open_iv["maybe_went_rw"] = (
+                        open_iv["maybe_went_rw"]
+                        and m.up_thru(open_iv["primary"])
+                        >= open_iv["first"])
+                    self.pg_intervals.setdefault(pgid, []).append(open_iv)
+                live = sum(1 for o in acting if o != CRUSH_ITEM_NONE)
+                self._open_intervals[pgid] = {
+                    "first": m.epoch, "acting": list(acting),
+                    "primary": actingp,
+                    "maybe_went_rw": actingp != -1
+                    and live >= max(1, pool.min_size)}
 
     def _scan_pgs(self):
         """Recompute which PGs this OSD hosts and advance each
@@ -118,6 +185,10 @@ class OSDaemon(Dispatcher):
                 if pg is None:
                     pg = PG(self, pgid, pool)
                     pg.acting = []   # force interval change on first map
+                    # share the daemon-maintained interval history (the
+                    # daemon appends under the same lock the PG reads)
+                    pg.past_intervals = self.pg_intervals.setdefault(
+                        pgid, [])
                     self.pgs[pgid] = pg
                     # adopt whatever an earlier incarnation persisted
                     pg.primary = actingp
@@ -160,11 +231,14 @@ class OSDaemon(Dispatcher):
 
     # -- heartbeats --------------------------------------------------------
     def _hb_peers(self) -> set[int]:
-        peers: set[int] = set()
-        for pg in self.pgs.values():
-            peers.update(o for o in pg.acting_live()
-                         if o != self.whoami)
-        return peers
+        """PG peers plus every other up OSD: the reference tops up
+        heartbeat peers beyond PG membership (OSD::maybe_update_
+        heartbeat_peers, osd_heartbeat_min_peers) so failures are
+        detected even when the failed OSD shares no PG with a
+        survivor; at mini-cluster scale that means everyone."""
+        m = self.osdmap
+        return {o for o in range(m.max_osd)
+                if o != self.whoami and m.is_up(o)}
 
     def _tick(self):
         if not self.running:
@@ -175,7 +249,8 @@ class OSDaemon(Dispatcher):
             # and can race a peer's map update (its reply goes to a
             # stale address); a stuck primary simply re-asks
             for pg in self.pgs.values():
-                if pg.is_primary and pg.state == "peering":
+                if pg.is_primary and pg.state in ("peering",
+                                                  "incomplete"):
                     pg._start_peering()
                 elif pg.is_primary and pg.state == "down" and \
                         len(pg.acting_live()) >= max(1, pg.pool.min_size):
@@ -245,6 +320,14 @@ class OSDaemon(Dispatcher):
             if fn is None:
                 return False
             pg = self._pg_for(msg)
+            if pg is None and isinstance(msg, (M.MOSDPGQuery,
+                                               M.MOSDPGPull)):
+                # a peering primary is probing a prior-interval holder
+                # that hasn't instantiated this PG (e.g. just revived,
+                # no longer acting): materialize it from the store so
+                # its info/objects can flow back (the reference
+                # likewise answers queries for PGs it only has on disk)
+                pg = self._create_stray_pg(msg.pgid)
             if pg is None:
                 return True
             backend_kind = (ECBackend if isinstance(msg, (
@@ -259,6 +342,34 @@ class OSDaemon(Dispatcher):
                 return True
             fn(pg)
             return True
+
+    def _create_stray_pg(self, pgid_s: str) -> PG | None:
+        try:
+            pgid = PGid.parse(pgid_s)
+        except (ValueError, AttributeError):
+            return None
+        pool = self.osdmap.pools.get(pgid.pool)
+        if pool is None:
+            return None
+        pg = PG(self, pgid, pool)
+        pg.past_intervals = self.pg_intervals.setdefault(pgid, [])
+        _up, _upp, acting, actingp = \
+            self.osdmap.pg_to_up_acting_osds(pgid)
+        pg.acting = list(acting)
+        pg.primary = actingp
+        pg.state = "stray"
+        pg.interval_epoch = self.osdmap.epoch
+        if self.whoami in acting:
+            pg.shard = acting.index(self.whoami)
+        elif pool.is_erasure():
+            # find which shard collection an earlier incarnation left
+            for s in range(pool.size):
+                if self.store.collection_exists(f"{pgid}s{s}"):
+                    pg.shard = s
+                    break
+        pg.load_from_store()
+        self.pgs[pgid] = pg
+        return pg
 
     def _pg_for(self, msg) -> PG | None:
         try:

@@ -150,6 +150,13 @@ class OSDMap:
         self.max_osd = max_osd
         self.osd_state = [0] * max_osd
         self.osd_weight = [0x10000] * max_osd     # reweight, 16.16
+        # newest epoch through which each OSD confirmed aliveness as a
+        # would-be primary (reference osd_info_t::up_thru, bumped by
+        # MOSDAlive before a primary activates): an interval whose
+        # primary never bumped up_thru into it provably accepted no
+        # writes, which is what keeps dead-primary intervals from
+        # blocking peering forever
+        self.osd_up_thru = [0] * max_osd
         self.pools: dict[int, PGPool] = {}
         self.pool_name: dict[str, int] = {}
         self.pg_temp: dict[PGid, list[int]] = {}
@@ -208,6 +215,9 @@ class OSDMap:
 
     def is_out(self, osd: int) -> bool:
         return self.osd_weight[osd] == 0
+
+    def up_thru(self, osd: int) -> int:
+        return self.osd_up_thru[osd] if 0 <= osd < self.max_osd else 0
 
     def mark_down(self, osd: int):
         self.osd_state[osd] &= ~UP
@@ -306,9 +316,11 @@ class OSDMap:
             if self.max_osd > old:
                 self.osd_state += [0] * (self.max_osd - old)
                 self.osd_weight += [0x10000] * (self.max_osd - old)
+                self.osd_up_thru += [0] * (self.max_osd - old)
             else:
                 del self.osd_state[self.max_osd:]
                 del self.osd_weight[self.max_osd:]
+                del self.osd_up_thru[self.max_osd:]
         for pid, pool in inc.new_pools.items():
             pool.last_change = inc.epoch
             self.pools[pid] = pool

@@ -85,6 +85,12 @@ class PG:
         self.waiting_for_active: list = []
         self.waiting_for_object: dict[str, list] = {}
         self._queried: set[int] = set()
+        # closed acting intervals, maintained by the daemon from the
+        # full map history (reference PastIntervals); peering refuses
+        # to activate while a maybe-went-rw interval since
+        # last_epoch_started has no gathered representative
+        self.past_intervals: list[dict] = []
+        self._probe_targets: set[int] = set()
         self._pulls: dict[int, str] = {}       # pull_tid → oid
         self._pull_tid = 0
         self.backend = (ECBackend(self) if pool.is_erasure()
@@ -146,6 +152,10 @@ class PG:
             self.primary = acting_primary
             if self.daemon.whoami in new_acting:
                 self.shard = new_acting.index(self.daemon.whoami)
+                # a PG first materialized as a stray (probe answer) has
+                # no collection yet; becoming acting means we will hold
+                # data, so make sure it exists before any txn lands
+                self.create_onstore()
             self.interval_epoch = epoch
             self.info.same_interval_since = epoch
             self.state = "peering" if self.is_primary else "stray"
@@ -154,33 +164,74 @@ class PG:
             self.peer_info.clear()
             self.peer_missing.clear()
             self._queried.clear()
+            self._pulls.clear()     # re-pull in the new interval
             if self.is_primary:
                 self._start_peering()
         elif self.daemon.whoami == self.primary and \
-                self.state in ("reset", "stray", "down"):
+                self.state in ("reset", "stray", "down", "incomplete"):
             # same interval, but we never got going (e.g. min_size
-            # regained without an acting change)
+            # regained without an acting change, or a prior-interval
+            # holder came back up without changing our acting set)
             self._start_peering()
 
     def _peer_osds(self) -> list[int]:
         me = self.daemon.whoami
         return [o for o in dict.fromkeys(self.acting_live()) if o != me]
 
+    def _prior_interval_osds(self) -> set[int]:
+        """Up members of maybe-went-rw intervals since our
+        last_epoch_started (reference PeeringState::build_prior's
+        probe set): they may hold acknowledged writes the current
+        acting set never saw, so GetInfo must include them."""
+        m = self.daemon.osdmap
+        me = self.daemon.whoami
+        targets: set[int] = set()
+        les = self.info.last_epoch_started
+        for iv in self.past_intervals:
+            if iv["last"] < les or not iv["maybe_went_rw"]:
+                continue
+            for o in iv["acting"]:
+                if o != CRUSH_ITEM_NONE and o != me and m.is_up(o):
+                    targets.add(o)
+        return targets
+
     def _start_peering(self):
         self.state = "peering"
-        peers = self._peer_osds()
         if len(self.acting_live()) < max(1, self.pool.min_size):
             self.state = "down"      # not enough members to go active
             return
-        if not peers:
-            self._activate()
+        probe = set(self._peer_osds()) | self._prior_interval_osds()
+        self._probe_targets = probe
+        if not probe:
+            if self._check_prior_intervals():
+                self._activate()
+            else:
+                self.state = "incomplete"
             return
-        for o in peers:
+        for o in probe:
             self._queried.add(o)
             self.daemon.send_to_osd(o, M.MOSDPGQuery(
                 pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
                 kind="info", since=None,
                 from_osd=self.daemon.whoami))
+
+    def _check_prior_intervals(self) -> bool:
+        """True when every maybe-went-rw past interval since the
+        newest known last_epoch_started has at least one member among
+        the gathered infos (self + peers) — i.e. no interval's
+        acknowledged writes can be invisible to this peering round
+        (reference PeeringState 'incomplete'/'down' gating)."""
+        les = max([self.info.last_epoch_started] +
+                  [pi.last_epoch_started
+                   for pi in self.peer_info.values()])
+        known = {self.daemon.whoami} | set(self.peer_info)
+        for iv in self.past_intervals:
+            if iv["last"] < les or not iv["maybe_went_rw"]:
+                continue
+            members = [o for o in iv["acting"] if o != CRUSH_ITEM_NONE]
+            if members and not any(o in known for o in members):
+                return False
+        return True
 
     def handle_query(self, msg: M.MOSDPGQuery):
         """Replica side: answer info/log queries."""
@@ -198,14 +249,25 @@ class PG:
 
     def handle_notify(self, msg: M.MOSDPGNotify):
         """Primary side: collect peer infos (GetInfo)."""
-        if not self.is_primary or self.state != "peering":
+        if not self.is_primary or self.state not in ("peering",
+                                                     "incomplete"):
             return
         self.peer_info[msg.from_osd] = PGInfo.from_dict(msg.info)
-        if set(self.peer_info) >= set(self._peer_osds()):
+        # only wait on probe targets that are still up — a target that
+        # died mid-gather is re-probed (or re-gated) by the tick retry
+        m = self.daemon.osdmap
+        pending = {o for o in self._probe_targets if m.is_up(o)}
+        if set(self.peer_info) >= pending:
             self._choose_authoritative()
 
     def _choose_authoritative(self):
-        """GetLog: adopt the best log if a peer is ahead of us."""
+        """GetLog: adopt the best log if a peer is ahead of us — but
+        first refuse to proceed while a prior rw interval has no
+        gathered representative (acknowledged writes could be lost)."""
+        if not self._check_prior_intervals():
+            self.state = "incomplete"
+            return
+        self.state = "peering"
         best_osd, best = self.daemon.whoami, self.info
         for o, pi in self.peer_info.items():
             if pi.last_update > best.last_update:
@@ -213,6 +275,8 @@ class PG:
         if best_osd == self.daemon.whoami:
             self._activate()
         else:
+            # best may be a stray from a prior interval — its log (and
+            # via recovery, its objects) flow back into the acting set
             self.daemon.send_to_osd(best_osd, M.MOSDPGQuery(
                 pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
                 kind="log", since=list(self.info.last_update),
@@ -240,8 +304,11 @@ class PG:
         if msg.activate:
             # replica activation: adopt authoritative log
             self._merge_authoritative(info, entries)
+            self.info.last_epoch_started = max(
+                self.info.last_epoch_started, info.last_epoch_started)
             self.state = "active"
             self._apply_local_deletes()
+            self.daemon.store.queue_transaction(self._persist_meta())
         else:
             if not self.is_primary or self.state != "peering":
                 return
@@ -259,6 +326,20 @@ class PG:
     def _activate(self):
         """Primary: compute peer missing, activate acting set, kick
         recovery (reference PeeringState::Active + activate())."""
+        # before going rw, our up_thru must reach this interval so a
+        # FUTURE peering can tell this interval might have accepted
+        # writes (reference PeeringState::need_up_thru / MOSDAlive);
+        # stay in peering until the bumped map arrives — the tick
+        # retries and the request is idempotent
+        daemon = self.daemon
+        if daemon.osdmap.up_thru(daemon.whoami) < self.interval_epoch:
+            daemon.request_up_thru(self.interval_epoch)
+            self.state = "peering"
+            return
+        # this interval went rw: record it so future peerings know the
+        # cutoff below which past intervals no longer matter
+        self.info.last_epoch_started = max(
+            self.info.last_epoch_started, self.interval_epoch)
         self._apply_local_deletes()
         self.peer_missing = {}
         for o in self._peer_osds():
@@ -383,7 +464,8 @@ class PG:
         if not self.is_primary:
             self._reply(msg, -11, "not primary")   # EAGAIN: client remaps
             return
-        if self.state in ("peering", "down", "reset", "stray"):
+        if self.state in ("peering", "down", "reset", "stray",
+                          "incomplete"):
             self.waiting_for_active.append(lambda: self.do_op(msg))
             return
         reqid = f"{msg.client}:{msg.tid}"
@@ -919,11 +1001,13 @@ class ECBackend:
                 if o != CRUSH_ITEM_NONE and m.is_up(o)}
 
     def _start_data_read(self, msg: M.MOSDOp, want=None, on_chunks=None,
-                         exclude: set[int] | None = None):
+                         exclude: set[int] | None = None, on_fail=None):
         """Gather minimum_to_decode shards, then decode+reply (or hand
         chunks to `on_chunks` for recovery reconstruction).  `exclude`
         drops shards known not to hold the object (recovery targets,
-        peers still missing it)."""
+        peers still missing it).  Every failure path fires `on_fail`
+        so recovery callers can release their pull registration and
+        retry later instead of wedging."""
         pg, daemon = self.pg, self.pg.daemon
         oid = msg.oid if msg is not None else None
         k = self.engine.k
@@ -939,13 +1023,16 @@ class ECBackend:
         try:
             need = self.engine.minimum_to_decode(want, set(avail))
         except Exception:
+            if on_fail is not None:
+                on_fail()
             if msg is not None:
                 pg._reply(msg, -5, "not enough shards to read")  # EIO
             return
         self._read_tid += 1
         tid = self._read_tid
         st = {"msg": msg, "need": set(need), "chunks": {},
-              "want": want, "on_chunks": on_chunks, "oid": oid}
+              "want": want, "on_chunks": on_chunks, "oid": oid,
+              "on_fail": on_fail}
         self._reads[tid] = st
         for s in need:
             o = avail[s]
@@ -958,6 +1045,8 @@ class ECBackend:
                         st.setdefault("meta", local_meta)
                 except KeyError:
                     del self._reads[tid]
+                    if on_fail is not None:
+                        on_fail()
                     if msg is not None:
                         pg._reply(msg, -2, "no such object")
                     return
@@ -987,6 +1076,8 @@ class ECBackend:
             return
         if msg.rc != 0:
             del self._reads[msg.tid]
+            if st.get("on_fail") is not None:
+                st["on_fail"]()
             if st["msg"] is not None:
                 self.pg._reply(st["msg"], msg.rc, "shard read failed")
             return
@@ -997,6 +1088,8 @@ class ECBackend:
         hinfo = meta.get("hinfo")
         if hinfo is not None and zlib.crc32(chunk) != hinfo:
             del self._reads[msg.tid]
+            if st.get("on_fail") is not None:
+                st["on_fail"]()
             if st["msg"] is not None:
                 self.pg._reply(st["msg"], -5, "chunk crc mismatch")
             return
@@ -1094,7 +1187,9 @@ class ECBackend:
             pg._maybe_clean()
 
         self._start_data_read(fake, want={shard}, on_chunks=on_chunks,
-                              exclude={shard})
+                              exclude={shard},
+                              on_fail=lambda: pg._pulls.pop(pull_tid,
+                                                            None))
 
     def answer_pull(self, msg: M.MOSDPGPull):
         # EC primaries reconstruct rather than pull whole objects

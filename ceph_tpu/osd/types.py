@@ -65,6 +65,10 @@ class PGInfo:
     log_tail: tuple[int, int] = ZERO
     same_interval_since: int = 0
     epoch_created: int = 0
+    # epoch at which this PG last activated (reference
+    # pg_history_t::last_epoch_started) — the cutoff for which past
+    # intervals peering must still account for
+    last_epoch_started: int = 0
 
     def to_dict(self) -> dict:
         return {"pgid": self.pgid,
@@ -72,7 +76,8 @@ class PGInfo:
                 "last_complete": list(self.last_complete),
                 "log_tail": list(self.log_tail),
                 "same_interval_since": self.same_interval_since,
-                "epoch_created": self.epoch_created}
+                "epoch_created": self.epoch_created,
+                "last_epoch_started": self.last_epoch_started}
 
     @classmethod
     def from_dict(cls, d: dict) -> "PGInfo":
@@ -81,7 +86,8 @@ class PGInfo:
                    last_complete=tuple(d["last_complete"]),
                    log_tail=tuple(d.get("log_tail", ZERO)),
                    same_interval_since=d.get("same_interval_since", 0),
-                   epoch_created=d.get("epoch_created", 0))
+                   epoch_created=d.get("epoch_created", 0),
+                   last_epoch_started=d.get("last_epoch_started", 0))
 
 
 @dataclass

@@ -74,10 +74,13 @@ class Rados:
     # -- pool ops (mon plane) ---------------------------------------------
     def create_pool(self, name: str, *, pg_num: int = 8,
                     pool_type: str = "replicated", size: int = 3,
-                    erasure_code_profile: str = "", rule: int = 0):
+                    erasure_code_profile: str = "", rule: int = 0,
+                    min_size: int | None = None):
         cmd = {"prefix": "osd pool create", "pool": name,
                "pg_num": pg_num, "pool_type": pool_type, "size": size,
                "rule": rule}
+        if min_size is not None:
+            cmd["min_size"] = min_size
         if erasure_code_profile:
             cmd["erasure_code_profile"] = erasure_code_profile
         rc, outs, _ = self.monc.command(cmd)

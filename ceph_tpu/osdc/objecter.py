@@ -95,7 +95,7 @@ class Objecter(Dispatcher):
         self.msgr.shutdown()
 
     # -- map flow ----------------------------------------------------------
-    def _on_osdmap(self, epoch: int, map_dict: dict):
+    def _on_osdmap(self, epoch: int, map_dict: dict, newest: int = 0):
         with self.lock:
             if epoch <= self.osdmap.epoch:
                 return

@@ -40,6 +40,7 @@ def osdmap_to_dict(m: OSDMap) -> dict:
         "max_osd": m.max_osd,
         "osd_state": m.osd_state,
         "osd_weight": m.osd_weight,
+        "osd_up_thru": m.osd_up_thru,
         "flags": m.flags,
         "crush": crushmap_to_dict(m.crush),
         "pools": [{
@@ -64,6 +65,7 @@ def osdmap_from_dict(d: dict) -> OSDMap:
     m.epoch = d["epoch"]
     m.osd_state = list(d["osd_state"])
     m.osd_weight = list(d["osd_weight"])
+    m.osd_up_thru = list(d.get("osd_up_thru", [])) or [0] * d["max_osd"]
     m.flags = d.get("flags", 0)
     for p in d["pools"]:
         pool = PGPool(**p)

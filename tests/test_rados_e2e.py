@@ -279,3 +279,57 @@ class TestDurability:
             assert found == b"survives"
         finally:
             c.stop()
+
+
+# ---------------------------------------------------------------------------
+# peering safety: prior-interval writers must be represented (reference
+# PeeringState build_prior / 'incomplete' — ADVICE r2 high)
+# ---------------------------------------------------------------------------
+class TestPeeringSafety:
+    def test_incomplete_blocks_activation_until_writer_returns(self):
+        c = MiniCluster(n_mons=1, n_osds=4)
+        try:
+            c.start()
+            r = c.rados()
+            r.create_pool("sp", pg_num=8, size=2, min_size=1)
+            io = r.open_ioctx("sp")
+            c.wait_for_clean()
+            pool_id = r.pool_lookup("sp")
+            m = r.objecter.osdmap
+            # find an object and its two acting OSDs
+            oid = "precious"
+            pgid = m.raw_pg_to_pg(m.object_locator_to_pg(oid, pool_id))
+            _, _, acting, _ = m.pg_to_up_acting_osds(pgid)
+            assert len(acting) == 2
+            io.write_full(oid, b"must-survive")
+            # kill BOTH holders before recovery can copy elsewhere;
+            # mark them out so CRUSH re-places the PG on survivors
+            # (down-but-in OSDs still occupy their CRUSH slots)
+            for o in acting:
+                c.kill_osd(o)
+            for o in acting:
+                c.wait_for_osd_down(o)
+                r.monc.command({"prefix": "osd out", "ids": [o]})
+            # the PG's new primary must NOT activate empty: with the
+            # write-holding interval unrepresented it goes incomplete
+            # (pre-fix behavior: min_size=1 let it activate with no
+            # data and acknowledged writes were silently lost)
+            state = None
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                for osd in c.osds.values():
+                    with osd.lock:
+                        pg = osd.pgs.get(pgid)
+                        if pg is not None and pg.is_primary:
+                            state = pg.state
+                if state in ("incomplete", "down"):
+                    break
+                time.sleep(0.1)
+            assert state == "incomplete", f"pg state {state!r}"
+            # one prior-interval writer revives: peering gathers its
+            # info, adopts its log, and the data flows back
+            c.revive_osd(acting[0])
+            c.wait_for_clean(timeout=40)
+            assert io.read(oid) == b"must-survive"
+        finally:
+            c.stop()
