@@ -1,14 +1,20 @@
-"""North-star benchmark: EC encode throughput (k=8, m=3, 1 MiB stripes).
+"""North-star benchmark: EC encode/decode sweep + CRUSH mapping rate.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
+whose headline is encode GB/s at k=8,m=3 with 1 MiB stripes; the
+"sweep" field carries {encode,decode} x {4KiB,64KiB,1MiB} with the
+per-size speedups (BASELINE.md rows 1/2/5), and "crush" carries the
+BatchMapper PGs/sec vs the native-C scalar (row 4).
 
-The reference harness is ``ceph_erasure_code_benchmark`` (SURVEY.md §4.4);
-its binary is unavailable (reference mount empty — SURVEY.md §0), so the
-baseline denominator is this machine's CPU running the same GF(2^8)
-region math through the native C++ engine (``native/`` — the
-gf-complete analog, -O3 -march=native autovectorized), falling back to
-the NumPy table path if the library isn't built.  Measured fresh each
-run and reported via vs_baseline.  BASELINE.md records the protocol.
+Reference harnesses: ``ceph_erasure_code_benchmark`` (SURVEY.md §4.4)
+and ``osdmaptool --test-map-pgs`` (§4.5); their binaries are
+unavailable (reference mount empty — SURVEY.md §0), so the
+denominators are this machine's CPU running the same math through the
+native C++ engines in ``native/`` (-O3 -march=native), the gf-complete
+/ crush mapper.c analogs.  Measured fresh each run.
+
+The TPU leg verifies parity bytes against the NumPy oracle before any
+timing — a wrong-bytes kernel can't post a number.
 """
 
 import json
@@ -19,72 +25,178 @@ import numpy as np
 
 
 K, M = 8, 3
-STRIPE = 1 << 20          # 1 MiB logical stripe
-BATCH = 64                # stripes per launch
+SIZES = [4096, 65536, 1 << 20]       # logical stripe bytes
+TARGET_BYTES = 64 << 20              # data per device launch
 ITERS = 10
+DECODE_ERASURES = (0, 9)             # one data, one parity shard lost
 
 
-def _cpu_baseline_gbps(coding, chunk):
-    rng = np.random.default_rng(0)
-    data = rng.integers(0, 256, size=(K, chunk), dtype=np.uint8)
+def _native_ec():
     from ceph_tpu import native
     if native.available():
-        ec = native.NativeEC(K, M)
-        encode = ec.encode
-        label = "native-c++"
-    else:
-        from ceph_tpu.ops import rs
-        encode = lambda d: rs.encode_oracle(coding, d)  # noqa: E731
-        label = "numpy"
-    encode(data)  # warm
-    n = 5
+        return native.NativeEC(K, M), "native-c++"
+    return None, "numpy"
+
+
+def _cpu_encode_gbps(coding, chunk, nat):
+    from ceph_tpu.ops import rs
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(K, chunk), dtype=np.uint8)
+    encode = nat.encode if nat else (lambda d: rs.encode_oracle(coding, d))
+    encode(data)
+    n = max(3, (4 << 20) // (K * chunk))
     t0 = time.perf_counter()
     for _ in range(n):
         encode(data)
     dt = time.perf_counter() - t0
-    return (n * K * chunk) / dt / 1e9, label
+    return (n * K * chunk) / dt / 1e9
+
+
+def _cpu_decode_gbps(coding, chunk, nat):
+    from ceph_tpu.ops import rs
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(K, chunk), dtype=np.uint8)
+    parity = (nat.encode(data) if nat else rs.encode_oracle(coding, data))
+    chunks = {i: (data[i] if i < K else parity[i - K])
+              for i in range(K + M) if i not in DECODE_ERASURES}
+    if nat:
+        decode = lambda: nat.decode(dict(chunks))          # noqa: E731
+    else:
+        dm = rs.decode_matrix(coding, K, list(DECODE_ERASURES))
+        surv = [i for i in range(K + M) if i not in DECODE_ERASURES][:K]
+        stack = np.stack([chunks[i] for i in surv])
+        decode = lambda: rs.encode_oracle(dm, stack)       # noqa: E731
+    decode()
+    n = max(3, (4 << 20) // (K * chunk))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        decode()
+    dt = time.perf_counter() - t0
+    return (n * K * chunk) / dt / 1e9
+
+
+def _device_leg(gflin, data, logical_bytes):
+    """On-device throughput of a GFLinear map.
+
+    The ITERS applications are chained inside ONE jit (each iteration
+    xor-folds its output back into the input) and completion is forced
+    by fetching a checksum.  This is deliberate: through the axon
+    relay, `block_until_ready` returns before execution finishes and
+    identical (executable, input) pairs can be served from a cache, so
+    the naive dispatch-loop pattern measures RPC artifacts, not the
+    TPU.  A dependent chain with a scalar fetch is immune on both
+    direct and relayed backends.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rows = gflin.m
+
+    @jax.jit
+    def loop(d):
+        def body(_, dd):
+            p = gflin._apply(dd)
+            r = min(rows, dd.shape[-2])
+            return dd.at[..., :r, :].set(
+                jnp.bitwise_xor(dd[..., :r, :], p[..., :r, :]))
+        out = jax.lax.fori_loop(0, ITERS, body, d)
+        return jnp.sum(out.astype(jnp.uint32))
+
+    darr = jax.device_put(data)
+    warm = jax.device_put(data ^ np.uint8(0xFF))
+    int(loop(warm))                          # compile + warm
+    t0 = time.perf_counter()
+    int(loop(darr))
+    dt = time.perf_counter() - t0
+    gbps = ITERS * logical_bytes / dt / 1e9
+    # achieved int8 tensor-op rate: 2 * (8m)(8k) MACs per k input bytes
+    tops = ITERS * 2 * 64 * rows * logical_bytes / dt / 1e12
+    return gbps, tops
+
+
+def _ec_sweep():
+    import jax
+    from ceph_tpu.ops import rs
+    from ceph_tpu.ops.gf_jax import GFLinear
+
+    coding = rs.reed_sol_van_matrix(K, M)
+    nat, base_label = _native_ec()
+    dm = rs.decode_matrix(coding, K, list(DECODE_ERASURES))
+    surv = [i for i in range(K + M) if i not in DECODE_ERASURES][:K]
+    enc = GFLinear(coding)
+    dec = GFLinear(dm)
+    rng = np.random.default_rng(2)
+    sweep = {}
+    for size in SIZES:
+        chunk = size // K
+        batch = max(1, TARGET_BYTES // size)
+        data = rng.integers(0, 256, size=(batch, K, chunk),
+                            dtype=np.uint8)
+        # verify bytes BEFORE timing (stripe 0 vs oracle)
+        parity0 = rs.encode_oracle(coding, data[0])
+        got = np.asarray(enc(data[:2]))[0]
+        assert np.array_equal(got, parity0), f"parity mismatch @{size}"
+        e_gbps, e_tops = _device_leg(enc, data, batch * K * chunk)
+
+        # decode leg input: each stripe's k surviving shards (ids in
+        # `surv`; parity identical across stripes would be unrealistic,
+        # so encode 3 distinct stripes' parity for the verify)
+        parity = np.stack([rs.encode_oracle(coding, data[b])
+                           for b in range(min(batch, 3))])
+        sdata = np.empty((batch, K, chunk), dtype=np.uint8)
+        for j, s in enumerate(surv):
+            if s < K:
+                sdata[:, j] = data[:, s]
+            else:
+                sdata[:min(batch, 3), j] = parity[:, s - K]
+                sdata[min(batch, 3):, j] = parity[0, s - K]
+        got0 = np.asarray(dec(sdata[:2]))[0]
+        assert np.array_equal(got0, data[0]), f"decode mismatch @{size}"
+        d_gbps, d_tops = _device_leg(dec, sdata, batch * K * chunk)
+
+        e_base = _cpu_encode_gbps(coding, chunk, nat)
+        d_base = _cpu_decode_gbps(coding, chunk, nat)
+        sweep[str(size)] = {
+            "encode_GBps": round(e_gbps, 3),
+            "decode_GBps": round(d_gbps, 3),
+            "encode_vs_baseline": round(e_gbps / e_base, 2),
+            "decode_vs_baseline": round(d_gbps / d_base, 2),
+            "encode_int8_TOPS": round(e_tops, 3),
+            "batch": batch,
+        }
+    return sweep, base_label, enc.backend
+
+
+def _crush_leg():
+    """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
+    (BASELINE.md row 4, scaled to fit a bench-run budget)."""
+    try:
+        from ceph_tpu.crush.bench import measure
+        return measure()
+    except Exception as e:        # keep the EC headline even if broken
+        return {"error": str(e)[:200]}
 
 
 def main():
     from ceph_tpu.utils import honor_jax_platforms_env
     honor_jax_platforms_env()
-    from ceph_tpu.ops import rs
-    from ceph_tpu.ops.gf_jax import GFLinear
-
-    coding = rs.reed_sol_van_matrix(K, M)
-    chunk = STRIPE // K
-
     import jax
-    enc = GFLinear(coding)
-    rng = np.random.default_rng(1)
-    data = rng.integers(0, 256, size=(BATCH, K, chunk), dtype=np.uint8)
-    darr = jax.device_put(data)
 
-    out = enc(darr)
-    out.block_until_ready()  # compile + warm
-
-    # correctness spot-check against the oracle before timing
-    expect = rs.encode_oracle(coding, data[0])
-    assert np.array_equal(np.asarray(out)[0], expect), "parity mismatch"
-
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        out = enc(darr)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-    gbps = (ITERS * BATCH * K * chunk) / dt / 1e9
-
-    base, base_label = _cpu_baseline_gbps(coding, chunk)
+    sweep, base_label, backend = _ec_sweep()
+    crush = _crush_leg()
+    head = sweep[str(1 << 20)]
     print(json.dumps({
         "metric": "ec_encode_k8m3_1MiB_GBps",
-        "value": round(gbps, 3),
+        "value": head["encode_GBps"],
         "unit": "GB/s",
-        "vs_baseline": round(gbps / base, 2),
+        "vs_baseline": head["encode_vs_baseline"],
         "baseline": base_label,
+        "backend": backend,
+        "sweep": sweep,
+        "crush": crush,
     }))
-    print(f"# device={jax.devices()[0].device_kind} batch={BATCH} "
-          f"iters={ITERS} cpu_baseline[{base_label}]={base:.3f} GB/s",
-          file=sys.stderr)
+    print(f"# device={jax.devices()[0].device_kind} backend={backend} "
+          f"iters={ITERS} baseline={base_label}", file=sys.stderr)
 
 
 if __name__ == "__main__":

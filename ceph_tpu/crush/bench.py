@@ -1,0 +1,91 @@
+"""CRUSH mapping-rate benchmark — BatchMapper vs the native-C scalar.
+
+BASELINE.md row 4: the reference maps PGs one at a time through scalar
+C (``osdmaptool --test-map-pgs`` looping ``crush_do_rule`` — SURVEY.md
+§4.5).  Here both contenders run the same canonical topology (root →
+hosts → osds, straw2, ``chooseleaf_firstn host``) over the same PG
+batch: the TPU side is `BatchMapper` (masked batched descent), the
+denominator is ``native/crush.cc`` (single core, -O3), with a
+mutual bit-exactness check on a sample before any timing.
+
+Scale via env: CRUSH_BENCH_OSDS (default 4096 = 64 hosts x 64 osds),
+CRUSH_BENCH_PGS (default 1M on TPU, 64k elsewhere).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from .jax_mapper import BatchMapper
+from .map import build_hierarchy
+
+
+def measure() -> dict:
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_osds = int(os.environ.get("CRUSH_BENCH_OSDS", 4096))
+    hosts = max(1, int(round(n_osds ** 0.5 / 8)) * 8)
+    per_host = n_osds // hosts
+    default_pgs = (1 << 20) if on_tpu else (1 << 16)
+    n_pgs = int(os.environ.get("CRUSH_BENCH_PGS", default_pgs))
+    numrep = 3
+
+    cmap = build_hierarchy(1, hosts, per_host)
+    t0 = time.perf_counter()
+    bm = BatchMapper(cmap, 0, result_max=numrep, chunk=1 << 17)
+    xs = np.arange(n_pgs, dtype=np.uint32)
+    # first chunk call includes XLA compile; warm on DIFFERENT inputs
+    # than the timed run (the axon relay memoizes identical
+    # executable+input executions)
+    bm(xs[: bm.chunk] ^ np.uint32(0xA5A5A5A5))
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    got = bm(xs)
+    tpu_s = time.perf_counter() - t0
+
+    result = {
+        "osds": hosts * per_host, "pgs": n_pgs, "numrep": numrep,
+        "rule": "chooseleaf_firstn host",
+        "tpu_pgs_per_sec": round(n_pgs / tpu_s, 1),
+        "tpu_compile_s": round(compile_s, 2),
+        "tpu_map_s": round(tpu_s, 2),
+    }
+
+    try:
+        from ..native import NativeCrush
+        nc = NativeCrush(bm)
+    except Exception as e:
+        result["native_error"] = str(e)[:120]
+        return result
+
+    # bit-exactness on a sample before timing
+    sample = xs[:: max(1, n_pgs // 4096)][:4096]
+    if not np.array_equal(nc.map(sample), got[:: max(1, n_pgs // 4096)]
+                          [: len(sample)]):
+        result["native_error"] = "MISMATCH vs native scalar"
+        return result
+
+    # native single-core rate, measured on a slice big enough to time
+    nat_n = min(n_pgs, 1 << 17)
+    t0 = time.perf_counter()
+    nc.map(xs[:nat_n])
+    nat_s = time.perf_counter() - t0
+    nat_rate = nat_n / nat_s
+    result.update({
+        "native_pgs_per_sec": round(nat_rate, 1),
+        "vs_native": round((n_pgs / tpu_s) / nat_rate, 2),
+        "vs_native_amortized": round(
+            (n_pgs / (tpu_s + compile_s)) / nat_rate, 2),
+    })
+    return result
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(measure()))

@@ -59,11 +59,50 @@ def _crush_ln_jnp(u, rh_lh, ll):
     return (iexpon << np.uint64(44)) + ((lh + llv) >> np.uint64(4))
 
 
-def _straw2_draws(u, w):
+def _magicu64(d: int) -> tuple[int, int, int]:
+    """Granlund–Montgomery magic for exact unsigned 64-bit division by
+    the constant d (Hacker's Delight magicu): n // d ==
+    (mulhi(n, M) >> s) when add == 0, else
+    (((n - t) >> 1) + t) >> (s - 1) with t = mulhi(n, M).
+
+    TPUs have no 64-bit integer divide (XLA emulates it with a long
+    shift-subtract loop); bucket weights are compile-time constants,
+    so each item's divisor becomes ~4 32-bit multiplies instead.
+    """
+    if d <= 0:
+        return 0, 0, 0
+    nc = ((1 << 64) // d) * d - 1
+    for p in range(64, 129):
+        # smallest p with 2^p > nc*(d - 1 - (2^p - 1) % d) gives an
+        # exact magic for all n ≤ nc (covers the full u64 range)
+        if (1 << p) > nc * (d - 1 - (((1 << p) - 1) % d)):
+            m = ((1 << p) + d - 1 - (((1 << p) - 1) % d)) // d
+            return m & ((1 << 64) - 1), p - 64, int(m >> 64)
+    raise AssertionError(f"no magic for {d}")
+
+
+def _mulhi_u64(a, b):
+    """High 64 bits of a*b via 32-bit limbs (exact in uint64)."""
+    import jax.numpy as jnp
+    mask = np.uint64(0xFFFFFFFF)
+    a0, a1 = a & mask, a >> np.uint64(32)
+    b0, b1 = b & mask, b >> np.uint64(32)
+    lo_lo = a0 * b0
+    hi_lo = a1 * b0
+    lo_hi = a0 * b1
+    cross = (lo_lo >> np.uint64(32)) + (hi_lo & mask) + (lo_hi & mask)
+    return (a1 * b1 + (hi_lo >> np.uint64(32)) + (lo_hi >> np.uint64(32))
+            + (cross >> np.uint64(32)))
+
+
+def _straw2_draws(u, w, wmagic=None):
     """Per-item draws: u [.., S] hashes (0..0xffff), w [.., S] int64 weights.
 
     Returns int64 draws; w==0 ⇒ INT64_MIN (never wins except at index 0
     of an all-zero bucket, matching the reference's `i == 0` seed).
+
+    wmagic: optional (M, s, add) uint64/int32 arrays matching w, from
+    `_magicu64` — the division-free path for static weight tables.
     """
     import jax
     import jax.numpy as jnp
@@ -76,8 +115,19 @@ def _straw2_draws(u, w):
     s = jax.lax.bitcast_convert_type(shifted_u, jnp.int64)
     neg = s < 0
     mag = jax.lax.bitcast_convert_type(jnp.abs(s), jnp.uint64)
-    wq = jnp.maximum(w, np.int64(1)).astype(jnp.uint64)
-    q = mag // wq
+    if wmagic is None:
+        wq = jnp.maximum(w, np.int64(1)).astype(jnp.uint64)
+        q = mag // wq
+    else:
+        M, sh, add = wmagic
+        t = _mulhi_u64(mag, M)
+        q_plain = t >> sh.astype(jnp.uint64)
+        # add case evaluates q = ((n - t)/2 + t) >> (s - 1); the only
+        # s == 0 add case is d == 1, where the quotient is n itself
+        q_add = (((mag - t) >> np.uint64(1)) + t) >> (
+            jnp.maximum(sh, 1).astype(jnp.uint64) - np.uint64(1))
+        q_add = jnp.where(sh == 0, mag, q_add)
+        q = jnp.where(add.astype(bool), q_add, q_plain)
     qi = jax.lax.bitcast_convert_type(q, jnp.int64)
     draws = jnp.where(neg, -qi, qi)
     return jnp.where(w > 0, draws, np.int64(_I64_MIN))
@@ -104,8 +154,6 @@ class BatchMapper:
         self.cmap = cmap
         self.rule = rule
         self.chunk = chunk
-        if cmap.choose_args:
-            raise NotImplementedError("choose_args: use the scalar oracle")
         t = cmap.tunables
 
         # --- parse the rule into (take, one choose step, emit) -----------
@@ -171,19 +219,50 @@ class BatchMapper:
                 raise ValueError("empty bucket in map")
             S = max(S, b.size)
         items = np.zeros((nb, S), dtype=np.int32)
-        weights = np.zeros((nb, S), dtype=np.int64)
+        hash_ids = np.zeros((nb, S), dtype=np.int32)
         sizes = np.zeros(nb, dtype=np.int32)
         btype = np.zeros(nb, dtype=np.int32)
+        # choose_args (balancer weight-set): per-POSITION weight
+        # overrides and id substitution (reference CrushWrapper
+        # choose_args / bucket_straw2_choose's position argument)
+        P = 1
+        for arg in cmap.choose_args.values():
+            if arg.get("weight_set"):
+                P = max(P, len(arg["weight_set"]))
+        weights = np.zeros((P, nb, S), dtype=np.int64)
         for row, b in enumerate(cmap.buckets):
             if b is None:
                 continue
             items[row, :b.size] = b.items
-            weights[row, :b.size] = b.weights
+            hash_ids[row, :b.size] = b.items
             sizes[row] = b.size
             btype[row] = b.type
+            arg = cmap.choose_args.get(b.id) or {}
+            ws = arg.get("weight_set")
+            if arg.get("ids"):
+                hash_ids[row, :b.size] = arg["ids"]
+            for p in range(P):
+                if ws:
+                    weights[p, row, :b.size] = ws[min(p, len(ws) - 1)]
+                else:
+                    weights[p, row, :b.size] = b.weights
         self._items, self._weights = items, weights
+        self._hash_ids = hash_ids
         self._sizes, self._btype = sizes, btype
-        self._nb, self._S = nb, S
+        self._nb, self._S, self._P = nb, S, P
+        # division-free straw2: per-item magic constants for the static
+        # weight table (TPU has no native u64 divide)
+        mw = np.zeros((P, nb, S), dtype=np.uint64)
+        sw = np.zeros((P, nb, S), dtype=np.int32)
+        aw = np.zeros((P, nb, S), dtype=np.int32)
+        for p in range(P):
+            for row in range(nb):
+                for col in range(S):
+                    d = int(weights[p, row, col])
+                    if d > 0:
+                        mw[p, row, col], sw[p, row, col], \
+                            aw[p, row, col] = _magicu64(d)
+        self._wmagic = (mw, sw, aw)
         # descent depths
         self.d1 = cmap.max_depth_to_type(take, self.target_type)
         if self.recurse:
@@ -204,30 +283,38 @@ class BatchMapper:
         import jax.numpy as jnp
 
         items = jnp.asarray(self._items)
-        weights = jnp.asarray(self._weights)
+        hash_ids = jnp.asarray(self._hash_ids)
+        weights = jnp.asarray(self._weights)        # [P, nb, S]
         sizes = jnp.asarray(self._sizes)
         btype = jnp.asarray(self._btype)
-        nb, S = self._nb, self._S
+        wm_m = jnp.asarray(self._wmagic[0])
+        wm_s = jnp.asarray(self._wmagic[1])
+        wm_a = jnp.asarray(self._wmagic[2])
+        nb, S, P = self._nb, self._S, self._P
         col = jnp.arange(S, dtype=jnp.int32)
 
         def item_type(itm):
             rows = jnp.clip(-1 - itm, 0, nb - 1)
             return jnp.where(itm < 0, btype[rows], 0)
 
-        def straw2(rows, x, r):
-            """rows/x/r [B] → chosen item [B]."""
+        def straw2(rows, x, r, pos):
+            """rows/x/r/pos [B] → chosen item [B].  `pos` is the output
+            position selecting the choose_args weight-set column."""
             its = items[rows]                       # [B, S]
-            ws = weights[rows]
-            u = crush_hash32_3(x[:, None], its.astype(jnp.uint32),
+            hids = hash_ids[rows]
+            p = jnp.clip(pos, 0, P - 1)
+            ws = weights[p, rows]
+            u = crush_hash32_3(x[:, None], hids.astype(jnp.uint32),
                                r[:, None].astype(jnp.uint32))
             u = (u & np.uint32(0xFFFF))
-            draws = _straw2_draws(u, ws)
+            draws = _straw2_draws(u, ws, (wm_m[p, rows], wm_s[p, rows],
+                                          wm_a[p, rows]))
             draws = jnp.where(col[None, :] < sizes[rows][:, None],
                               draws, np.int64(_I64_MIN))
             sel = jnp.argmax(draws, axis=1)
             return its[jnp.arange(its.shape[0]), sel]
 
-        def descend(start, x, r, target, depth):
+        def descend(start, x, r, target, depth, pos):
             """Masked hierarchy walk until item type == target."""
             itm = start
             for _ in range(depth):
@@ -235,7 +322,7 @@ class BatchMapper:
                 rows = jnp.clip(-1 - itm, 0, nb - 1)
                 t = jnp.where(isb, btype[rows], 0)
                 need = isb & (t != target)
-                nxt = straw2(rows, x, r)
+                nxt = straw2(rows, x, r, pos)
                 itm = jnp.where(need, nxt, itm)
             return itm
 
@@ -261,18 +348,19 @@ class BatchMapper:
             """Inner chooseleaf: ≤ rtries attempts inside `host`.
 
             C: nested crush_choose_firstn(numrep=1, tries=rtries,
-            parent_r=sub_r) with stable=1.  Returns (leaf, got)."""
+            parent_r=sub_r) with stable=1.  `prev_leafs` is the
+            [B, numrep] leaf array so far (NONE-padded — NONE never
+            equals a valid device).  Returns (leaf, got)."""
             sub_r = (r >> (vary_r - 1)) if vary_r else jnp.zeros_like(r)
             got = jnp.zeros(r.shape, dtype=bool)
             dead = jnp.zeros(r.shape, dtype=bool)
             leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
             for ft in range(rtries):
                 ri = sub_r + np.int32(ft)
-                cand = descend(host, x, ri, 0, max(d2, 1))
+                cand = descend(host, x, ri, 0, max(d2, 1),
+                               jnp.zeros_like(ri))
                 valid = (cand >= 0) & (host < 0)
-                collide = jnp.zeros_like(got)
-                for pl in prev_leafs:
-                    collide |= cand == pl
+                collide = jnp.any(prev_leafs == cand[:, None], axis=1)
                 reject = collide | dev_out(wdev, cand, x) | ~valid
                 active = ~got & ~dead
                 succ = active & ~reject
@@ -282,19 +370,23 @@ class BatchMapper:
             return leaf, got
 
         def firstn_fn(x, wdev):
+            # one traced rep body under lax.scan (compile cost is one
+            # rep, not numrep unrolled copies — the r2 compile-time sink)
             B = x.shape[0]
-            outs, leafs = [], []
             root = jnp.full((B,), take, dtype=jnp.int32)
-            for rep in range(numrep):
+
+            def rep_body(carry, rep):
+                out, leafs = carry
+
                 def body(st):
                     ftotal, placed, dead, item, leaf = st
                     active = ~placed & ~dead
-                    r = (np.int32(rep) + ftotal).astype(jnp.int32)
-                    itm = descend(root, x, r, target, max(d1, 1))
+                    r = (rep + ftotal).astype(jnp.int32)
+                    pos = jnp.sum((out != _NONE).astype(jnp.int32),
+                                  axis=1)
+                    itm = descend(root, x, r, target, max(d1, 1), pos)
                     valid = item_type(itm) == target
-                    collide = jnp.zeros_like(placed)
-                    for po in outs:
-                        collide |= itm == po
+                    collide = jnp.any(out == itm[:, None], axis=1)
                     if leafmode:
                         lf, lgot = leaf_attempts(itm, x, r, leafs, wdev)
                         reject = collide | ~lgot
@@ -309,8 +401,8 @@ class BatchMapper:
                     leaf = jnp.where(succ, lf, leaf)
                     placed = placed | succ
                     dead = dead | (active & ~valid)
-                    ftotal = ftotal + jnp.where(active & valid & reject,
-                                                np.int32(1), np.int32(0))
+                    ftotal = ftotal + (active & valid & reject
+                                       ).astype(jnp.int32)
                     return ftotal, placed, dead, item, leaf
 
                 def cond(st):
@@ -323,9 +415,17 @@ class BatchMapper:
                       jnp.full((B,), _NONE, jnp.int32))
                 ftotal, placed, dead, item, leaf = jax.lax.while_loop(
                     cond, body, st)
-                outs.append(jnp.where(placed, item, np.int32(_NONE)))
-                leafs.append(jnp.where(placed, leaf, np.int32(_NONE)))
-            res = jnp.stack(leafs if leafmode else outs, axis=1)
+                out = out.at[:, rep].set(
+                    jnp.where(placed, item, np.int32(_NONE)))
+                leafs = leafs.at[:, rep].set(
+                    jnp.where(placed, leaf, np.int32(_NONE)))
+                return (out, leafs), None
+
+            init = (jnp.full((B, numrep), _NONE, jnp.int32),
+                    jnp.full((B, numrep), _NONE, jnp.int32))
+            (out, leafs), _ = jax.lax.scan(
+                rep_body, init, jnp.arange(numrep, dtype=np.int32))
+            res = leafs if leafmode else out
             # compact: stable-move NONE entries to the end (C firstn
             # advances outpos only on success)
             order = jnp.argsort(res == _NONE, axis=1, stable=True)
@@ -336,13 +436,39 @@ class BatchMapper:
             root = jnp.full((B,), take, dtype=jnp.int32)
             UNDEF = np.int32(-0x7FFFFFFE)
 
+            def _indep_leaf(host, x, r, rep, wdev):
+                """C: nested crush_choose_indep(left=1, numrep, outpos=rep,
+                parent_r=r, tries=recurse_tries); the inner draw index is
+                rep + parent_r + numrep*ftotal_inner; self-only collision
+                check ⇒ none."""
+                got = jnp.zeros(r.shape, dtype=bool)
+                dead = jnp.zeros(r.shape, dtype=bool)
+                leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
+                for ft in range(rtries):
+                    ri = rep + r + np.int32(numrep * ft)
+                    cand = descend(host, x, ri, 0, max(d2, 1),
+                                   jnp.broadcast_to(rep, ri.shape))
+                    valid = (cand >= 0) & (host < 0)
+                    reject = dev_out(wdev, cand, x) | ~valid
+                    active = ~got & ~dead
+                    succ = active & ~reject
+                    leaf = jnp.where(succ, cand, leaf)
+                    got |= succ
+                    dead |= active & ~valid
+                return leaf, got
+
             def round_body(st):
-                out, out2, ftotal = st
-                for rep in range(numrep):
+                # one traced rep step under fori_loop (was numrep
+                # unrolled copies — the r2 compile-time sink)
+                out0, out20, ftotal = st
+
+                def rep_step(rep, c):
+                    out, out2 = c
                     needs = out[:, rep] == UNDEF
-                    r = (np.int32(rep) + np.int32(numrep) * ftotal
+                    r = (rep + np.int32(numrep) * ftotal
                          ).astype(jnp.int32) * jnp.ones((B,), jnp.int32)
-                    itm = descend(root, x, r, target, max(d1, 1))
+                    itm = descend(root, x, r, target, max(d1, 1),
+                                  jnp.broadcast_to(rep, r.shape))
                     valid = item_type(itm) == target
                     collide = jnp.any(out == itm[:, None], axis=1)
                     if leafmode:
@@ -363,31 +489,15 @@ class BatchMapper:
                     newl = jnp.where(succ, lf, jnp.where(
                         kill, np.int32(_NONE), out2[:, rep]))
                     out2 = out2.at[:, rep].set(newl)
+                    return out, out2
+
+                out, out2 = jax.lax.fori_loop(0, numrep, rep_step,
+                                              (out0, out20))
                 return out, out2, ftotal + 1
 
             def round_cond(st):
                 out, _, ftotal = st
                 return (ftotal < tries) & jnp.any(out == UNDEF)
-
-            def _indep_leaf(host, x, r, rep, wdev):
-                """C: nested crush_choose_indep(left=1, numrep, outpos=rep,
-                parent_r=r, tries=recurse_tries); the inner draw index is
-                rep + parent_r + numrep*ftotal_inner; self-only collision
-                check ⇒ none."""
-                got = jnp.zeros(r.shape, dtype=bool)
-                dead = jnp.zeros(r.shape, dtype=bool)
-                leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
-                for ft in range(rtries):
-                    ri = np.int32(rep) + r + np.int32(numrep * ft)
-                    cand = descend(host, x, ri, 0, max(d2, 1))
-                    valid = (cand >= 0) & (host < 0)
-                    reject = dev_out(wdev, cand, x) | ~valid
-                    active = ~got & ~dead
-                    succ = active & ~reject
-                    leaf = jnp.where(succ, cand, leaf)
-                    got |= succ
-                    dead |= active & ~valid
-                return leaf, got
 
             out0 = jnp.full((B, numrep), UNDEF, jnp.int32)
             st = (out0, out0, jnp.int32(0))

@@ -1,0 +1,5 @@
+"""Management plane (reference ``src/mgr`` + ``src/pybind/mgr`` —
+SURVEY.md §3.10): Python modules that observe cluster maps and steer
+them through mon commands.  First resident: the upmap balancer."""
+
+from .balancer import UpmapBalancer  # noqa: F401

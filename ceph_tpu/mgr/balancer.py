@@ -1,0 +1,181 @@
+"""Upmap balancer — evens per-OSD PG load with pg_upmap_items.
+
+Reference behavior re-created (``src/pybind/mgr/balancer/module.py``
+upmap mode + ``OSDMap::calc_pg_upmaps`` in ``src/osd/OSDMap.cc``):
+compute every PG's placement, find overfull/underfull OSDs against
+their CRUSH-weight-proportional targets, and propose pg_upmap_items
+exceptions moving single replicas from the fullest OSD to compatible
+underfull ones — never violating the rule's failure domain.
+
+TPU-first: the full-pool placement matrix comes from ONE BatchMapper
+launch (`tools.osdmaptool.map_pool_pgs`) instead of the reference's
+per-PG scalar loop — this module is crush_tpu's first in-system
+consumer: every optimize() round is a batched what-if evaluation of
+the whole pool.
+
+Apply through the mon: ``{"prefix": "osd pg-upmap-items", "pgid":
+"<p.s>", "mappings": [[from, to], ...]}`` (same command the reference
+balancer issues).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crush.map import CRUSH_ITEM_NONE
+from ..osd.osdmap import OSDMap, PGid
+
+
+class UpmapBalancer:
+    def __init__(self, osdmap: OSDMap, pool_id: int):
+        self.m = osdmap
+        self.pool = osdmap.pools[pool_id]
+        self.rule = osdmap.crush.rule_by_id(self.pool.crush_rule)
+        # failure-domain type of the rule's choose step (0 = osd)
+        self.domain_type = 0
+        for s in self.rule.steps:
+            if s.op.startswith(("choose_firstn", "chooseleaf_firstn",
+                                "choose_indep", "chooseleaf_indep")):
+                self.domain_type = s.arg2
+        self._domain_of = self._build_domain_index()
+
+    def _build_domain_index(self) -> dict[int, int]:
+        """osd → ancestor bucket id of the failure-domain type."""
+        dom: dict[int, int] = {}
+        if self.domain_type == 0:
+            return dom
+        crush = self.m.crush
+
+        def walk(bid: int, domain: int | None):
+            b = crush.bucket(bid)
+            d = bid if b.type == self.domain_type else domain
+            for it in b.items:
+                if it >= 0:
+                    if d is not None:
+                        dom[it] = d
+                else:
+                    walk(it, d)
+
+        for b in crush.buckets:
+            if b is not None and b.type > self.domain_type and \
+                    not any(b.id in p.items for p in crush.buckets
+                            if p is not None):
+                walk(b.id, None)
+        return dom
+
+    # -- placement snapshot ------------------------------------------------
+    def _placements(self) -> dict[PGid, list[int]]:
+        from ..tools.osdmaptool import map_pool_pgs
+        raw = map_pool_pgs(self.m, self.pool)
+        place: dict[PGid, list[int]] = {}
+        for seed in range(self.pool.pg_num):
+            pgid = PGid(self.pool.id, seed)
+            row = [o for o in raw[seed] if o != CRUSH_ITEM_NONE]
+            row = self.m._apply_upmap(pgid, row)
+            place[pgid] = [o for o in row
+                           if o != CRUSH_ITEM_NONE and self.m.is_up(o)]
+        return place
+
+    def pg_counts(self, place=None) -> np.ndarray:
+        place = place if place is not None else self._placements()
+        counts = np.zeros(self.m.max_osd, dtype=np.int64)
+        for osds in place.values():
+            for o in osds:
+                counts[o] += 1
+        return counts
+
+    def _targets(self) -> np.ndarray:
+        """Per-OSD target load ∝ CRUSH device weight (in OSDs only)."""
+        w = np.zeros(self.m.max_osd, dtype=np.float64)
+        crush = self.m.crush
+        for b in crush.buckets:
+            if b is None:
+                continue
+            for it, bw in zip(b.items, b.weights):
+                if it >= 0 and not self.m.is_out(it) \
+                        and self.m.is_up(it):
+                    w[it] = bw
+        total_slots = self.pool.pg_num * self.pool.size
+        if w.sum() == 0:
+            return np.zeros_like(w)
+        return total_slots * w / w.sum()
+
+    # -- optimization ------------------------------------------------------
+    def optimize(self, max_changes: int = 10,
+                 deviation_stop: float = 1.0
+                 ) -> dict[PGid, list[tuple[int, int]]]:
+        """Propose up to max_changes pg_upmap_items changes.  Greedy
+        per-round: move one replica off the currently fullest OSD to
+        the most underfull compatible OSD (reference calc_pg_upmaps'
+        retry loop, simplified to single-replica swaps)."""
+        place = self._placements()
+        counts = self.pg_counts(place).astype(np.float64)
+        targets = self._targets()
+        proposals: dict[PGid, list[tuple[int, int]]] = {}
+        pgs_by_osd: dict[int, set[PGid]] = {}
+        for pgid, osds in place.items():
+            for o in osds:
+                pgs_by_osd.setdefault(o, set()).add(pgid)
+
+        for _ in range(max_changes):
+            dev = counts - targets
+            # ignore out/down osds entirely
+            for o in range(self.m.max_osd):
+                if not self.m.is_up(o) or self.m.is_out(o):
+                    dev[o] = 0
+            omax = int(np.argmax(dev))
+            if dev[omax] <= deviation_stop:
+                break
+            under = sorted(
+                (o for o in range(self.m.max_osd)
+                 if self.m.is_up(o) and not self.m.is_out(o)
+                 and dev[o] < -0.5),
+                key=lambda o: dev[o])
+            moved = False
+            for pgid in sorted(pgs_by_osd.get(omax, ()),
+                               key=lambda p: p.seed):
+                others = [o for o in place[pgid] if o != omax]
+                used_domains = {self._domain_of.get(o) for o in others} \
+                    if self.domain_type else set()
+                for ou in under:
+                    if ou in place[pgid]:
+                        continue
+                    if self.domain_type and \
+                            self._domain_of.get(ou) in used_domains:
+                        continue
+                    # the PG may sit on omax only VIA an existing
+                    # upmap pair (raw→omax): rewrite that pair's
+                    # target instead of appending a no-op (omax, ou)
+                    # that _apply_upmap would ignore
+                    items = []
+                    rewired = False
+                    for a, b in self.m.pg_upmap_items.get(pgid, []):
+                        if b == omax and not rewired:
+                            items.append((a, ou))
+                            rewired = True
+                        else:
+                            items.append((a, b))
+                    if not rewired:
+                        items.append((omax, ou))
+                    proposals[pgid] = items
+                    # apply locally for subsequent rounds
+                    self.m.pg_upmap_items[pgid] = items
+                    place[pgid] = [ou if o == omax else o
+                                   for o in place[pgid]]
+                    pgs_by_osd[omax].discard(pgid)
+                    pgs_by_osd.setdefault(ou, set()).add(pgid)
+                    counts[omax] -= 1
+                    counts[ou] += 1
+                    moved = True
+                    break
+                if moved:
+                    break
+            if not moved:
+                break
+        return proposals
+
+    def stddev(self) -> float:
+        counts = self.pg_counts().astype(np.float64)
+        live = [o for o in range(self.m.max_osd)
+                if self.m.is_up(o) and not self.m.is_out(o)]
+        return float(np.std(counts[live]))

@@ -30,11 +30,19 @@ from ..core.auth import CryptoKey, KeyRing
 from ..core.threading_utils import SafeTimer
 from ..crush.compiler import crushmap_from_dict
 from ..msg import Dispatcher, EntityAddr, Messenger
-from ..osd.osdmap import EXISTS, OSDMap, TYPE_ERASURE, TYPE_REPLICATED, UP
+from ..osd.osdmap import (EXISTS, OSDMap, PGid, TYPE_ERASURE,
+                          TYPE_REPLICATED, UP)
 from ..tools.osdmaptool import osdmap_from_dict, osdmap_to_dict
 from . import messages as M
 from .paxos import Elector, Paxos, VICTORY
 from .store import MonitorDBStore, StoreTransaction
+
+
+def _parse_pgid(s) -> PGid | None:
+    try:
+        return PGid.parse(s)
+    except (ValueError, AttributeError, TypeError):
+        return None
 
 
 @dataclass
@@ -330,6 +338,32 @@ class OSDMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, f"marked {prefix.split()[1]} osd.{osd}", None
+        if prefix == "osd pg-upmap-items":
+            # the balancer's apply path (reference OSDMonitor command
+            # of the same name): pairwise from→to placement exceptions
+            pgid = _parse_pgid(cmd["pgid"])
+            if pgid is None or pgid.pool not in self.osdmap.pools:
+                return -2, f"invalid pgid {cmd.get('pgid')!r}", None
+            pairs = [(int(a), int(b)) for a, b in cmd["mappings"]]
+            for a, b in pairs:
+                if not (0 <= b < self.osdmap.max_osd):
+                    return -22, f"osd.{b} does not exist", None
+            m = self._working()
+            if pairs:
+                m.pg_upmap_items[pgid] = pairs
+            else:
+                m.pg_upmap_items.pop(pgid, None)
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"set {cmd['pgid']} pg_upmap_items", None
+        if prefix == "osd rm-pg-upmap-items":
+            pgid = _parse_pgid(cmd["pgid"])
+            m = self._working()
+            if pgid is None or m.pg_upmap_items.pop(pgid, None) is None:
+                return -2, f"no upmap items for {cmd.get('pgid')!r}", None
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"cleared {cmd['pgid']} pg_upmap_items", None
         if prefix == "osd setcrushmap":
             m = self._working()
             m.crush = crushmap_from_dict(cmd["crushmap"])

@@ -68,6 +68,22 @@ def _load():
                                            u8p]
         lib.ec_ring_pending.restype = ctypes.c_size_t
         lib.ec_ring_pending.argtypes = [ctypes.c_void_p]
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.crush_set_ln_tables.restype = None
+        lib.crush_set_ln_tables.argtypes = [u64p, u64p]
+        lib.crush_flat_create.restype = ctypes.c_void_p
+        lib.crush_flat_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, i32p, i64p, i32p, i32p]
+        lib.crush_flat_destroy.argtypes = [ctypes.c_void_p]
+        lib.crush_flat_map.restype = None
+        lib.crush_flat_map.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, u32p, ctypes.c_int,
+            u32p, ctypes.c_int, i32p]
         _lib = lib
     return _lib
 
@@ -212,3 +228,75 @@ class NativeEC:
 
     def ring_pending(self) -> int:
         return self._lib.ec_ring_pending(self._ring)
+
+
+class NativeCrush:
+    """Scalar crush_do_rule analog over BatchMapper's flat tables —
+    the honest single-core denominator for the CRUSH PGs/sec bench
+    (reference ``src/crush/mapper.c`` via ``osdmaptool``)."""
+
+    _tables_set = False
+
+    def __init__(self, mapper):
+        """`mapper` is a ceph_tpu.crush.jax_mapper.BatchMapper — the
+        flat arrays and parsed rule params are reused verbatim."""
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native library not built")
+        if not NativeCrush._tables_set:
+            from ..crush.ln import LL_TBL, RH_LH_TBL
+            rh = np.ascontiguousarray(RH_LH_TBL, dtype=np.uint64)
+            ll = np.ascontiguousarray(LL_TBL, dtype=np.uint64)
+            self._lib.crush_set_ln_tables(
+                rh.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                ll.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+            NativeCrush._tables_set = True
+        items = np.ascontiguousarray(mapper._items, dtype=np.int32)
+        # position-0 weights (the scalar denominator doesn't model
+        # choose_args positional weight-sets; bench maps have none)
+        weights = np.ascontiguousarray(mapper._weights[0],
+                                       dtype=np.int64)
+        sizes = np.ascontiguousarray(mapper._sizes, dtype=np.int32)
+        btype = np.ascontiguousarray(mapper._btype, dtype=np.int32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        self._h = self._lib.crush_flat_create(
+            mapper._nb, mapper._S,
+            items.ctypes.data_as(i32p),
+            weights.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            sizes.ctypes.data_as(i32p), btype.ctypes.data_as(i32p))
+        self._m = mapper
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.crush_flat_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def map(self, xs: np.ndarray, reweight: np.ndarray | None = None
+            ) -> np.ndarray:
+        m = self._m
+        xs = np.ascontiguousarray(xs, dtype=np.uint32)
+        if reweight is None:
+            reweight = np.full(max(m.cmap.max_devices, 1), 0x10000,
+                               dtype=np.uint32)
+        reweight = np.ascontiguousarray(reweight, dtype=np.uint32)
+        out = np.empty((len(xs), m.numrep), dtype=np.int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        self._lib.crush_flat_map(
+            self._h, m.take, m.target_type, m.numrep,
+            int(m.firstn), int(m.recurse and m.target_type != 0),
+            m.tries, m.recurse_tries,
+            m.cmap.tunables.chooseleaf_vary_r, m.d1, m.d2,
+            xs.ctypes.data_as(u32p), len(xs),
+            reweight.ctypes.data_as(u32p), len(reweight),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if out.shape[1] < m.result_max:
+            pad = np.full((len(xs), m.result_max - out.shape[1]),
+                          np.int32(-0x7FFFFFFF), dtype=np.int32)
+            out = np.concatenate([out, pad], axis=1)
+        return out

@@ -98,21 +98,45 @@ class GFLinear:
     """A compiled GF(2^8) linear map (encode or decode step) over batches.
 
     Wraps a fixed coefficient matrix [m, k]; calling it on data
-    [batch..., k, n] uint8 returns [batch..., m, n] uint8 computed on the
-    default JAX backend (MXU path).  jit-compiled once per input shape.
+    [batch..., k, n] uint8 returns [batch..., m, n] uint8.
+
+    Backends:
+    - ``"pallas"`` — the fused VMEM kernel (`ceph_tpu.ops.gf_pallas`),
+      the TPU production path: one HBM read of the data, one HBM write
+      of the parity, expand/matmul/pack fused per tile;
+    - ``"xla"`` — the dot_general bitmatrix composition above (works on
+      any backend; what CPU tests run);
+    - ``"auto"`` (default) — pallas on TPU, xla elsewhere.
     """
 
-    def __init__(self, coding: np.ndarray, use_bits: bool = True):
+    def __init__(self, coding: np.ndarray, use_bits: bool = True,
+                 backend: str = "auto"):
         self.coding = np.asarray(coding, dtype=np.uint8)
         self.m, self.k = self.coding.shape
         self.use_bits = use_bits
+        if backend == "auto":
+            backend = ("pallas" if jax.default_backend() == "tpu"
+                       and use_bits else "xla")
+        self.backend = backend
         if use_bits:
             self._mat = jnp.asarray(_bit_layout_matrix(self.coding))
         else:
             self._mat = jnp.asarray(self.coding)
-        self._fn = jax.jit(self._apply)
+        # the pallas path jits internally (and interpret mode under an
+        # outer jit miscompiles on the CPU backend); jit only the
+        # XLA-composed paths here
+        self._fn = (self._apply if self.backend.startswith("pallas")
+                    else jax.jit(self._apply))
 
     def _apply(self, data: jnp.ndarray) -> jnp.ndarray:
+        if self.backend in ("pallas", "pallas-interpret"):
+            from .gf_pallas import gf_matmul_pallas
+            if not hasattr(self, "_bdmats"):
+                self._bdmats = {}
+            return gf_matmul_pallas(
+                self._mat, data, self.m,
+                interpret=self.backend == "pallas-interpret",
+                bdmats=self._bdmats)
         if self.use_bits:
             return gf_matmul_bits(self._mat, data, self.m)
         return gf_matmul_gather(self._mat, data)

@@ -1,0 +1,153 @@
+"""Fused GF(2^8) matmul Pallas kernel — the TPU hot loop for erasure codes.
+
+The XLA bitmatrix path (`ceph_tpu.ops.gf_jax.gf_matmul_bits`) materializes
+the 8x bit-plane expansion and the 32x int32 accumulator in HBM between
+ops; at EC shapes (k<=20 rows) that elementwise HBM traffic dominates the
+matmul.  This kernel fuses the whole pipeline per tile in VMEM:
+
+    read data tile [G, k, TN] uint8          (HBM read: 1 byte/byte)
+      -> bit-plane expand   [G*8k, TN] int8    (VPU, VMEM only)
+      -> GF(2) matmul on the MXU -> [G*8m, TN] int32
+      -> mask + bit re-pack -> [G, m, TN] uint8 (VPU, VMEM only)
+    write parity tile [G, m, TN] uint8       (HBM write: m/k byte/byte)
+
+so HBM moves only the data once in and the parity once out — the same
+shape as the reference's ``galois_w08_region_multiply`` region loop
+(gf-complete behind ``src/erasure-code/jerasure``; SURVEY.md §4.2), but
+batched across stripes and fed to a 128x128 systolic array.
+
+G stripes are packed block-diagonally into one matmul so the MXU's
+128-deep contraction actually fills: a single k=8 stripe contracts over
+only 8k=64 of 128 MXU rows (~9% utilization, measured 7.5 GB/s on
+v5e); G=2 makes the contraction exactly 128 deep (measured ~2x).
+
+Bit layouts extend `gf_jax._bit_layout_matrix` per diagonal block:
+contraction row g*8k + s*k + i is bit s of chunk i of stripe g; output
+row g*8m + r*m + j is bit r of parity j of stripe g.  Byte-exactness
+against the NumPy oracle is asserted in ``tests/test_gf_pallas.py``
+(interpret mode) and on real TPU by ``bench.py``'s pre-timing verify.
+
+Mosaic notes: no vector shifts on narrow ints (shrui/shli fail to
+legalize) — bit extraction is AND + compare, packing is multiply-add;
+the kernel traces under `jax.enable_x64(False)` because i64 grid
+arithmetic (from the CRUSH-required global x64 mode) also fails to
+legalize.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# lane width is 128 on all TPU generations; tiles are multiples of it
+_LANES = 128
+_MAX_TN = 4096          # per-tile lane extent (VMEM budget ~1 MB/tile)
+# stripes per matmul: 2 fills the 128-deep contraction for k=8, but
+# measured v5e throughput is flat across G=1/2/4 (the expand/pack VPU
+# work and DMA granularity dominate, not the MXU) — keep it simple
+_GROUP = 1
+
+
+def _gf_kernel(bitmat_ref, data_ref, out_ref, *, k: int, m: int, g: int):
+    """One (stripe-group, lane-tile): fused expand -> matmul -> pack."""
+    planes = []
+    for gi in range(g):
+        d = data_ref[gi]                              # [k, TN] uint8
+        for s in range(8):
+            planes.append(((d & jnp.uint8(1 << s)) != 0).astype(jnp.int8))
+    bits = jnp.concatenate(planes, axis=0)            # [g*8k, TN] int8
+    acc = jax.lax.dot_general(
+        bitmat_ref[...], bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)             # [g*8m, TN] int32
+    acc = acc & 1
+    for gi in range(g):
+        base = gi * 8 * m
+        packed = acc[base:base + m]
+        for r in range(1, 8):
+            packed = packed + acc[base + r * m:base + (r + 1) * m] \
+                * (1 << r)
+        out_ref[gi] = packed.astype(jnp.uint8)
+
+
+def block_diag_bitmat(bitmat: np.ndarray, g: int) -> np.ndarray:
+    """[8m, 8k] -> block-diagonal [g*8m, g*8k] int8."""
+    m8, k8 = bitmat.shape
+    out = np.zeros((g * m8, g * k8), dtype=np.int8)
+    for gi in range(g):
+        out[gi * m8:(gi + 1) * m8, gi * k8:(gi + 1) * k8] = bitmat
+    return out
+
+
+def _pick_tile(n: int) -> int:
+    for tn in (_MAX_TN, 2048, 1024, 512, 256, _LANES):
+        if tn <= n and n % tn == 0:
+            return tn
+    return n            # n < 128: single undersized tile (padded by Mosaic)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "g", "interpret"))
+def _gf_apply_pallas(bdmat, data, *, k: int, m: int, g: int,
+                     interpret: bool = False):
+    """bdmat [g*8m, g*8k] int8, data [B, k, n] uint8 (B % g == 0)
+    -> [B, m, n] uint8."""
+    b, _, n = data.shape
+    tn = _pick_tile(n)
+    grid = (b // g, n // tn)
+    return pl.pallas_call(
+        functools.partial(_gf_kernel, k=k, m=m, g=g),
+        out_shape=jax.ShapeDtypeStruct((b, m, n), jnp.uint8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * m * g, 8 * k * g), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((g, k, tn), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((g, m, tn), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(bdmat, data)
+
+
+def gf_matmul_pallas(bitmat: jnp.ndarray, data: jnp.ndarray, m: int,
+                     interpret: bool = False, bdmats=None) -> jnp.ndarray:
+    """Fused GF(2^8) matmul.  data [..., k, n] uint8 -> [..., m, n].
+
+    Accepts unbatched [k, n] and arbitrary leading batch dims; lane
+    extents not divisible by 128 and batches not divisible by the
+    stripe group are zero-padded (GF-linear maps send zero bytes to
+    zero bytes, so padding never corrupts parity).
+
+    bdmats: optional {g: device block-diag matrix} cache (GFLinear
+    precomputes it so the hot path never rebuilds/re-uploads it).
+    """
+    k8 = bitmat.shape[1]
+    k = k8 // 8
+    lead = data.shape[:-2]
+    n = data.shape[-1]
+    x = data.reshape((-1, k, n))
+    b = x.shape[0]
+    g = _GROUP if b >= _GROUP else 1
+    npad = -n % _LANES
+    bpad = -b % g
+    if npad or bpad:
+        x = jnp.pad(x, ((0, bpad), (0, 0), (0, npad)))
+    bdmat = (bdmats or {}).get(g)
+    if bdmat is None:
+        bdmat = jnp.asarray(block_diag_bitmat(np.asarray(bitmat), g))
+        if bdmats is not None:
+            bdmats[g] = bdmat
+    # trace in 32-bit mode: under jax_enable_x64 (required by CRUSH)
+    # the grid/index arithmetic becomes i64, which Mosaic rejects
+    with jax.enable_x64(False):
+        out = _gf_apply_pallas(bdmat, x, k=k, m=m, g=g,
+                               interpret=interpret)
+    out = out[:b, :, :n]
+    return out.reshape(*lead, m, n)

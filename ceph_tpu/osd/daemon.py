@@ -119,7 +119,7 @@ class OSDaemon(Dispatcher):
                         (o >= prev.max_osd or not prev.is_up(o)):
                     self._hb_last.pop(o, None)
                     self._hb_reported.pop(o, None)
-            self._update_pg_intervals()
+            placements = self._update_pg_intervals()
             catching_up = epoch < max(newest, self.monc.osdmap_epoch)
             if catching_up:
                 # history replay: record intervals only — peering,
@@ -129,7 +129,7 @@ class OSDaemon(Dispatcher):
                 # marked down but alive: rejoin (reference
                 # OSD::_committed_osd_maps → start_boot)
                 self._send_boot()
-            self._scan_pgs()
+            self._scan_pgs(placements)
 
     def _update_pg_intervals(self):
         """Track acting-set intervals for every PG of every pool at
@@ -138,13 +138,19 @@ class OSDaemon(Dispatcher):
         min_size live members, so it COULD have accepted writes —
         peering must see a member of every such interval since
         last_epoch_started before activating, or acknowledged writes
-        could be silently lost (ADVICE r2 high)."""
+        could be silently lost (ADVICE r2 high).
+
+        Returns the {pgid: mapping} snapshot so _scan_pgs reuses it
+        instead of recomputing every PG's CRUSH placement."""
         m = self.osdmap
         from ..crush.map import CRUSH_ITEM_NONE
+        placements: dict[PGid, tuple] = {}
         for pool in m.pools.values():
             for ps in range(pool.pg_num):
                 pgid = PGid(pool.id, ps)
-                _up, _upp, acting, actingp = m.pg_to_up_acting_osds(pgid)
+                mapping = m.pg_to_up_acting_osds(pgid)
+                placements[pgid] = mapping
+                _up, _upp, acting, actingp = mapping
                 open_iv = self._open_intervals.get(pgid)
                 if open_iv is not None and \
                         open_iv["acting"] == acting and \
@@ -168,8 +174,9 @@ class OSDaemon(Dispatcher):
                     "primary": actingp,
                     "maybe_went_rw": actingp != -1
                     and live >= max(1, pool.min_size)}
+        return placements
 
-    def _scan_pgs(self):
+    def _scan_pgs(self, placements: dict | None = None):
         """Recompute which PGs this OSD hosts and advance each
         (reference OSD::consume_map / split into advance_pg)."""
         m = self.osdmap
@@ -177,7 +184,9 @@ class OSDaemon(Dispatcher):
         for pool in m.pools.values():
             for ps in range(pool.pg_num):
                 pgid = PGid(pool.id, ps)
-                up, upp, acting, actingp = m.pg_to_up_acting_osds(pgid)
+                mapping = (placements.get(pgid) if placements
+                           else None) or m.pg_to_up_acting_osds(pgid)
+                up, upp, acting, actingp = mapping
                 if self.whoami not in acting and pgid not in self.pgs:
                     continue
                 seen.add(pgid)

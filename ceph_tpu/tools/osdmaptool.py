@@ -210,6 +210,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mark-up-in", action="store_true")
     p.add_argument("--export-crush", metavar="FILE")
     p.add_argument("--import-crush", metavar="FILE")
+    p.add_argument("--upmap", metavar="FILE",
+                   help="run the upmap balancer, write the proposed "
+                        "`osd pg-upmap-items` commands to FILE")
+    p.add_argument("--upmap-pool", type=int, default=None)
+    p.add_argument("--upmap-max", type=int, default=100)
     p.add_argument("--no-jax", action="store_true",
                    help="force the scalar oracle path")
     p.add_argument("-o", "--out-file", metavar="FILE")
@@ -273,6 +278,26 @@ def main(argv=None) -> int:
               f"acting {acting}")
     if args.test_map_pgs:
         run_test_map_pgs(m, args.pool, use_jax=not args.no_jax)
+    if args.upmap:
+        # reference `osdmaptool --upmap out.txt`: emit the balancer's
+        # proposed commands (and keep them applied in -o output)
+        from ..mgr.balancer import UpmapBalancer
+        pools = ([args.upmap_pool] if args.upmap_pool is not None
+                 else list(m.pools))
+        lines = []
+        for pid in pools:
+            bal = UpmapBalancer(m, pid)
+            before = bal.stddev()
+            props = bal.optimize(max_changes=args.upmap_max)
+            for pgid, items in sorted(props.items(),
+                                      key=lambda kv: str(kv[0])):
+                pairs = " ".join(f"{a} {b}" for a, b in items)
+                lines.append(f"ceph osd pg-upmap-items {pgid} {pairs}")
+            print(f"pool {pid}: stddev {before:.2f} -> "
+                  f"{bal.stddev():.2f}, {len(props)} changes")
+        with open(args.upmap, "w") as f:
+            f.write("\n".join(lines) + ("\n" if lines else ""))
+        dirty = True
     if dirty and args.out_file:
         save_osdmap(m, args.out_file)
         print(f"osdmaptool: writing epoch {m.epoch} to {args.out_file}")

@@ -1,0 +1,319 @@
+// Scalar CRUSH mapper — the native-C performance denominator.
+//
+// Mirrors the reference's crush_do_rule hot loop (src/crush/mapper.c:
+// straw2 buckets, firstn/indep choose, reweight rejection) for the
+// flattened bucket-table representation ceph_tpu.crush.BatchMapper
+// uses, so the TPU batched mapper and this scalar loop race on exactly
+// the same map + rule semantics.  Bit-exactness against the Python
+// oracle is asserted by tests/test_native.py before any benchmark
+// trusts the numbers.
+//
+// The crush_ln fixed-point tables are injected from Python (generated
+// once in ceph_tpu/crush/ln.py) so both sides share identical rounding.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int32_t NONE = -0x7FFFFFFF;
+constexpr uint32_t HASH_SEED = 1315423911u;
+
+uint64_t RH_LH[258];
+uint64_t LL[256];
+
+inline void mix(uint32_t &a, uint32_t &b, uint32_t &c) {
+  a = a - b;  a = a - c;  a = a ^ (c >> 13);
+  b = b - c;  b = b - a;  b = b ^ (a << 8);
+  c = c - a;  c = c - b;  c = c ^ (b >> 13);
+  a = a - b;  a = a - c;  a = a ^ (c >> 12);
+  b = b - c;  b = b - a;  b = b ^ (a << 16);
+  c = c - a;  c = c - b;  c = c ^ (b >> 5);
+  a = a - b;  a = a - c;  a = a ^ (c >> 3);
+  b = b - c;  b = b - a;  b = b ^ (a << 10);
+  c = c - a;  c = c - b;  c = c ^ (b >> 15);
+}
+
+inline uint32_t hash32_2(uint32_t a, uint32_t b) {
+  uint32_t h = HASH_SEED ^ a ^ b;
+  uint32_t x = 231232u, y = 1232u;
+  mix(a, b, h);
+  mix(x, a, h);
+  mix(b, y, h);
+  return h;
+}
+
+inline uint32_t hash32_3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t h = HASH_SEED ^ a ^ b ^ c;
+  uint32_t x = 231232u, y = 1232u;
+  mix(a, b, h);
+  mix(c, x, h);
+  mix(y, a, h);
+  mix(b, x, h);
+  mix(y, c, h);
+  return h;
+}
+
+inline uint64_t crush_ln(uint32_t xin) {
+  uint64_t x = (uint64_t)xin + 1;         // [1, 0x10000]
+  int fl2 = 63 - __builtin_clzll(x);
+  uint64_t bits = fl2 >= 15 ? 0 : (uint64_t)(15 - fl2);
+  x <<= bits;
+  uint64_t iexpon = 15 - bits;
+  uint64_t index1 = (x >> 8) << 1;        // [256, 512]
+  uint64_t rh = RH_LH[index1 - 256];
+  uint64_t lh = RH_LH[index1 + 1 - 256];
+  uint64_t xl64 = (x * rh) >> 48;
+  uint64_t ll = LL[xl64 & 0xFF];
+  return (iexpon << 44) + ((lh + ll) >> 4);
+}
+
+inline int64_t straw2_draw(uint32_t u16, int64_t w) {
+  if (w <= 0) return INT64_MIN;
+  int64_t lnv = (int64_t)crush_ln(u16) - ((int64_t)1 << 48);
+  uint64_t shifted = (uint64_t)lnv << 16;   // wraps mod 2^64 like the ref
+  int64_t s = (int64_t)shifted;
+  bool neg = s < 0;
+  uint64_t mag = neg ? (0 - (uint64_t)s) : (uint64_t)s;
+  uint64_t q = mag / (uint64_t)w;
+  int64_t qi = (int64_t)q;
+  return neg ? -qi : qi;
+}
+
+struct Flat {
+  int nb, S, ndev;
+  std::vector<int32_t> items;    // [nb*S]
+  std::vector<int64_t> weights;  // [nb*S]
+  std::vector<int32_t> sizes;    // [nb]
+  std::vector<int32_t> btype;    // [nb]
+};
+
+struct Ctx {
+  const Flat *f;
+  const uint32_t *wdev;
+  int ndev;
+};
+
+inline int32_t straw2_choose(const Flat &f, int row, uint32_t x, uint32_t r) {
+  const int32_t *its = &f.items[(size_t)row * f.S];
+  const int64_t *ws = &f.weights[(size_t)row * f.S];
+  int sz = f.sizes[row];
+  int32_t best_item = its[0];
+  int64_t best = INT64_MIN;
+  for (int i = 0; i < sz; i++) {
+    uint32_t u = hash32_3(x, (uint32_t)its[i], r) & 0xFFFFu;
+    int64_t d = straw2_draw(u, ws[i]);
+    if (i == 0 || d > best) {
+      best = d;
+      best_item = its[i];
+    }
+  }
+  return best_item;
+}
+
+inline int item_type(const Flat &f, int32_t itm) {
+  if (itm >= 0) return 0;
+  int row = -1 - itm;
+  if (row >= f.nb) row = f.nb - 1;
+  return f.btype[row];
+}
+
+inline int32_t descend(const Flat &f, int32_t start, uint32_t x, uint32_t r,
+                       int target, int depth) {
+  int32_t itm = start;
+  for (int i = 0; i < depth; i++) {
+    if (itm < 0) {
+      int row = -1 - itm;
+      if (row >= f.nb) row = f.nb - 1;
+      if (f.btype[row] != target) itm = straw2_choose(f, row, x, r);
+    }
+  }
+  return itm;
+}
+
+inline bool dev_out(const Ctx &c, int32_t itm, uint32_t x) {
+  int idx = itm < 0 ? 0 : (itm >= c.ndev ? c.ndev - 1 : itm);
+  uint32_t w = c.wdev[idx];
+  uint32_t h = hash32_2(x, (uint32_t)itm) & 0xFFFFu;
+  bool keep = (w >= 0x10000u) || (w > 0 && h < w);
+  return !keep;
+}
+
+struct Params {
+  int32_t take;
+  int target, numrep, tries, rtries;
+  int firstn, leafmode, vary_r, d1, d2;
+};
+
+inline bool in_set(const int32_t *arr, int n, int32_t v) {
+  for (int i = 0; i < n; i++)
+    if (arr[i] == v) return true;
+  return false;
+}
+
+// inner chooseleaf for firstn (mirror of BatchMapper.leaf_attempts)
+inline bool leaf_firstn(const Flat &f, const Ctx &c, const Params &p,
+                        int32_t host, uint32_t x, int32_t r,
+                        const int32_t *leafs, int nleafs, int32_t *out) {
+  int32_t sub_r = p.vary_r ? (r >> (p.vary_r - 1)) : 0;
+  bool got = false, dead = false;
+  for (int ft = 0; ft < p.rtries && !got && !dead; ft++) {
+    int32_t ri = sub_r + ft;
+    int32_t cand = descend(f, host, x, (uint32_t)ri, 0, p.d2);
+    bool valid = cand >= 0 && host < 0;
+    bool reject = in_set(leafs, nleafs, cand) || dev_out(c, cand, x) ||
+                  !valid;
+    if (!reject) {
+      *out = cand;
+      got = true;
+    }
+    if (!valid) dead = true;
+  }
+  return got;
+}
+
+void map_firstn(const Flat &f, const Ctx &c, const Params &p, uint32_t x,
+                int32_t *res) {
+  std::vector<int32_t> outs(p.numrep, NONE), leafs(p.numrep, NONE);
+  for (int rep = 0; rep < p.numrep; rep++) {
+    int ftotal = 0;
+    bool placed = false, dead = false;
+    int32_t item = NONE, leaf = NONE;
+    while (!placed && !dead && ftotal < p.tries) {
+      int32_t r = rep + ftotal;
+      int32_t itm = descend(f, p.take, x, (uint32_t)r, p.target, p.d1);
+      bool valid = item_type(f, itm) == p.target;
+      bool collide = in_set(outs.data(), p.numrep, itm);
+      bool reject;
+      int32_t lf = itm;
+      if (p.leafmode) {
+        bool lgot = leaf_firstn(f, c, p, itm, x, r, leafs.data(),
+                                p.numrep, &lf);
+        reject = collide || !lgot;
+      } else if (p.target == 0) {
+        reject = collide || dev_out(c, itm, x);
+      } else {
+        reject = collide;
+      }
+      if (valid && !reject) {
+        item = itm;
+        leaf = lf;
+        placed = true;
+      }
+      if (!valid) dead = true;
+      if (valid && reject) ftotal++;
+    }
+    outs[rep] = placed ? item : NONE;
+    leafs[rep] = placed ? leaf : NONE;
+  }
+  // compact NONE to the end, stable (C firstn advances outpos on success)
+  const std::vector<int32_t> &src = p.leafmode ? leafs : outs;
+  int pos = 0;
+  for (int i = 0; i < p.numrep; i++)
+    if (src[i] != NONE) res[pos++] = src[i];
+  for (; pos < p.numrep; pos++) res[pos] = NONE;
+}
+
+inline bool leaf_indep(const Flat &f, const Ctx &c, const Params &p,
+                       int32_t host, uint32_t x, int32_t r, int rep,
+                       int32_t *out) {
+  bool got = false, dead = false;
+  for (int ft = 0; ft < p.rtries && !got && !dead; ft++) {
+    int32_t ri = rep + r + p.numrep * ft;
+    int32_t cand = descend(f, host, x, (uint32_t)ri, 0, p.d2);
+    bool valid = cand >= 0 && host < 0;
+    bool reject = dev_out(c, cand, x) || !valid;
+    if (!reject) {
+      *out = cand;
+      got = true;
+    }
+    if (!valid) dead = true;
+  }
+  return got;
+}
+
+void map_indep(const Flat &f, const Ctx &c, const Params &p, uint32_t x,
+               int32_t *res) {
+  constexpr int32_t UNDEF = -0x7FFFFFFE;
+  std::vector<int32_t> out(p.numrep, UNDEF), out2(p.numrep, UNDEF);
+  int ftotal = 0;
+  auto any_undef = [&]() {
+    for (int i = 0; i < p.numrep; i++)
+      if (out[i] == UNDEF) return true;
+    return false;
+  };
+  while (ftotal < p.tries && any_undef()) {
+    for (int rep = 0; rep < p.numrep; rep++) {
+      if (out[rep] != UNDEF) continue;
+      int32_t r = rep + p.numrep * ftotal;
+      int32_t itm = descend(f, p.take, x, (uint32_t)r, p.target, p.d1);
+      bool valid = item_type(f, itm) == p.target;
+      bool collide = in_set(out.data(), p.numrep, itm);
+      bool reject;
+      int32_t lf = itm;
+      if (p.leafmode) {
+        bool lgot = leaf_indep(f, c, p, itm, x, r, rep, &lf);
+        reject = collide || !lgot;
+      } else if (p.target == 0) {
+        reject = collide || dev_out(c, itm, x);
+      } else {
+        reject = collide;
+      }
+      if (!valid) {
+        out[rep] = NONE;
+        out2[rep] = NONE;
+      } else if (!reject) {
+        out[rep] = itm;
+        out2[rep] = lf;
+      }
+    }
+    ftotal++;
+  }
+  const std::vector<int32_t> &src = p.leafmode ? out2 : out;
+  for (int i = 0; i < p.numrep; i++)
+    res[i] = src[i] == UNDEF ? NONE : src[i];
+}
+
+}  // namespace
+
+extern "C" {
+
+void crush_set_ln_tables(const uint64_t *rh_lh, const uint64_t *ll) {
+  memcpy(RH_LH, rh_lh, sizeof(RH_LH));
+  memcpy(LL, ll, sizeof(LL));
+}
+
+void *crush_flat_create(int nb, int S, const int32_t *items,
+                        const int64_t *weights, const int32_t *sizes,
+                        const int32_t *btype) {
+  Flat *f = new Flat;
+  f->nb = nb;
+  f->S = S;
+  f->items.assign(items, items + (size_t)nb * S);
+  f->weights.assign(weights, weights + (size_t)nb * S);
+  f->sizes.assign(sizes, sizes + nb);
+  f->btype.assign(btype, btype + nb);
+  return f;
+}
+
+void crush_flat_destroy(void *h) { delete static_cast<Flat *>(h); }
+
+// xs[n] -> out[n*numrep]; wdev[ndev] is the 16.16 reweight table
+void crush_flat_map(void *h, int32_t take, int target, int numrep,
+                    int firstn, int leafmode, int tries, int rtries,
+                    int vary_r, int d1, int d2, const uint32_t *xs, int n,
+                    const uint32_t *wdev, int ndev, int32_t *out) {
+  const Flat &f = *static_cast<Flat *>(h);
+  Ctx c{&f, wdev, ndev};
+  Params p{take, target, numrep, tries, rtries,
+           firstn, leafmode, vary_r, d1 < 1 ? 1 : d1, d2 < 1 ? 1 : d2};
+  for (int i = 0; i < n; i++) {
+    if (firstn)
+      map_firstn(f, c, p, xs[i], out + (size_t)i * numrep);
+    else
+      map_indep(f, c, p, xs[i], out + (size_t)i * numrep);
+  }
+}
+
+}  // extern "C"

@@ -145,3 +145,39 @@ class TestChunking:
         got = bm(xs)
         want = _oracle_batch(m, 0, xs, 3)
         assert np.array_equal(got, want)
+
+
+class TestChooseArgs:
+    """Balancer weight-set (choose_args) support — positional weight
+    overrides and id substitution must stay bit-exact vs the oracle."""
+
+    def test_weight_set_single_position(self):
+        m = build_hierarchy(2, 3, 2)
+        # skew one host's weight-set without touching real weights
+        host = next(b for b in m.buckets
+                    if b is not None and b.type == 1)
+        m.choose_args[host.id] = {
+            "weight_set": [[0x4000, 0x18000]]}
+        _check(m, 0, 3, XS)
+
+    def test_weight_set_per_position(self):
+        m = build_flat_map(8)
+        m.choose_args[-1] = {"weight_set": [
+            [0x10000] * 8,
+            [(i + 1) * 0x3000 for i in range(8)],
+            [0x20000, 0x1000] * 4,
+        ]}
+        _check(m, 0, 3, XS)
+
+    def test_ids_substitution(self):
+        m = build_flat_map(6)
+        m.choose_args[-1] = {"ids": [100 + i for i in range(6)]}
+        _check(m, 0, 3, XS)
+
+    def test_weight_set_zero_position(self):
+        m = build_hierarchy(2, 2, 3)
+        root = m.bucket(-1)
+        m.choose_args[-1] = {
+            "weight_set": [[0x8000] * len(root.items),
+                           [0x20000] * len(root.items)]}
+        _check(m, 0, 4, XS)
