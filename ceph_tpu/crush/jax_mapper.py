@@ -59,6 +59,7 @@ def _crush_ln_jnp(u, rh_lh, ll):
     return (iexpon << np.uint64(44)) + ((lh + llv) >> np.uint64(4))
 
 
+@functools.lru_cache(maxsize=None)
 def _magicu64(d: int) -> tuple[int, int, int]:
     """Granlund–Montgomery magic for exact unsigned 64-bit division by
     the constant d (Hacker's Delight magicu): n // d ==
@@ -344,21 +345,21 @@ class BatchMapper:
         take = self.take
         vary_r = self.cmap.tunables.chooseleaf_vary_r
 
-        def leaf_attempts(host, x, r, prev_leafs, wdev):
+        def leaf_attempts(host, x, r, prev_leafs, wdev, pos):
             """Inner chooseleaf: ≤ rtries attempts inside `host`.
 
             C: nested crush_choose_firstn(numrep=1, tries=rtries,
-            parent_r=sub_r) with stable=1.  `prev_leafs` is the
-            [B, numrep] leaf array so far (NONE-padded — NONE never
-            equals a valid device).  Returns (leaf, got)."""
+            parent_r=sub_r) with stable=1 — the recursive call keeps
+            the OUTER outpos as the choose_args position.  `prev_leafs`
+            is the [B, numrep] leaf array so far (NONE-padded — NONE
+            never equals a valid device).  Returns (leaf, got)."""
             sub_r = (r >> (vary_r - 1)) if vary_r else jnp.zeros_like(r)
             got = jnp.zeros(r.shape, dtype=bool)
             dead = jnp.zeros(r.shape, dtype=bool)
             leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
             for ft in range(rtries):
                 ri = sub_r + np.int32(ft)
-                cand = descend(host, x, ri, 0, max(d2, 1),
-                               jnp.zeros_like(ri))
+                cand = descend(host, x, ri, 0, max(d2, 1), pos)
                 valid = (cand >= 0) & (host < 0)
                 collide = jnp.any(prev_leafs == cand[:, None], axis=1)
                 reject = collide | dev_out(wdev, cand, x) | ~valid
@@ -388,7 +389,8 @@ class BatchMapper:
                     valid = item_type(itm) == target
                     collide = jnp.any(out == itm[:, None], axis=1)
                     if leafmode:
-                        lf, lgot = leaf_attempts(itm, x, r, leafs, wdev)
+                        lf, lgot = leaf_attempts(itm, x, r, leafs,
+                                                 wdev, pos)
                         reject = collide | ~lgot
                     else:
                         lf = itm

@@ -56,10 +56,11 @@ class UpmapBalancer:
                 else:
                     walk(it, d)
 
+        children = {it for b in crush.buckets if b is not None
+                    for it in b.items if it < 0}
         for b in crush.buckets:
             if b is not None and b.type > self.domain_type and \
-                    not any(b.id in p.items for p in crush.buckets
-                            if p is not None):
+                    b.id not in children:
                 walk(b.id, None)
         return dom
 

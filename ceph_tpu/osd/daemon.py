@@ -104,6 +104,12 @@ class OSDaemon(Dispatcher):
         map's arrival re-drives the waiting PGs' peering)."""
         self.monc.send(MM.MOSDAlive(osd=self.whoami, want=want))
 
+    def scrub_pg(self, pgid: PGid) -> bool:
+        """Kick a scrub on a PG this OSD is primary for."""
+        with self.lock:
+            pg = self.pgs.get(pgid)
+            return bool(pg is not None and pg.start_scrub())
+
     # -- map handling ------------------------------------------------------
     def _on_osdmap(self, epoch: int, map_dict: dict, newest: int = 0):
         with self.lock:
@@ -258,6 +264,7 @@ class OSDaemon(Dispatcher):
             # and can race a peer's map update (its reply goes to a
             # stale address); a stuck primary simply re-asks
             for pg in self.pgs.values():
+                pg.check_scrub_timeout()
                 if pg.is_primary and pg.state in ("peering",
                                                   "incomplete"):
                     pg._start_peering()
@@ -324,13 +331,17 @@ class OSDaemon(Dispatcher):
                     lambda pg: pg.backend.handle_sub_read(msg),
                 M.MOSDECSubOpReadReply:
                     lambda pg: pg.backend.handle_sub_read_reply(msg),
+                M.MOSDRepScrub: lambda pg: pg.handle_rep_scrub(msg),
+                M.MOSDRepScrubMap:
+                    lambda pg: pg.handle_scrub_map(msg),
             }
             fn = handlers.get(type(msg))
             if fn is None:
                 return False
             pg = self._pg_for(msg)
             if pg is None and isinstance(msg, (M.MOSDPGQuery,
-                                               M.MOSDPGPull)):
+                                               M.MOSDPGPull,
+                                               M.MOSDRepScrub)):
                 # a peering primary is probing a prior-interval holder
                 # that hasn't instantiated this PG (e.g. just revived,
                 # no longer acting): materialize it from the store so

@@ -129,3 +129,22 @@ class MOSDPGPull(_JsonMessage):
     it back (reference MOSDPGPull carrying PullOp)."""
     TYPE = 54
     FIELDS = ("pgid", "epoch", "oid", "from_osd", "pull_tid")
+
+
+@register_message
+class MOSDRepScrub(_JsonMessage):
+    """Primary → acting member: build and return your scrub map for
+    this PG (reference MOSDRepScrub → replica ScrubMap build)."""
+    TYPE = 55
+    FIELDS = ("pgid", "epoch", "scrub_tid", "from_osd")
+
+
+@register_message
+class MOSDRepScrubMap(_JsonMessage):
+    """Acting member → primary: my scrub map (reference
+    MOSDRepScrubMap).  objects: {oid: {"size", "crc", "version",
+    "valid"}} — for EC shards "crc" is the chunk crc and "valid" is
+    the self-check against the stored hinfo."""
+    TYPE = 56
+    FIELDS = ("pgid", "epoch", "scrub_tid", "shard", "objects",
+              "from_osd")

@@ -91,6 +91,13 @@ class PG:
         # last_epoch_started has no gathered representative
         self.past_intervals: list[dict] = []
         self._probe_targets: set[int] = set()
+        # scrub state (primary-driven; reference src/osd/scrubber/)
+        self.scrubbing = False
+        self.last_scrub = 0.0
+        self.scrub_errors = 0
+        self._scrub_tid = 0
+        self._scrub_maps: dict[int, dict] = {}
+        self._scrub_waiting: set[int] = set()
         self._pulls: dict[int, str] = {}       # pull_tid → oid
         self._pull_tid = 0
         self.backend = (ECBackend(self) if pool.is_erasure()
@@ -160,6 +167,9 @@ class PG:
             self.info.same_interval_since = epoch
             self.state = "peering" if self.is_primary else "stray"
             # drop cross-interval op state; clients resend on map change
+            self.scrubbing = False
+            self._scrub_maps.clear()
+            self._scrub_waiting.clear()
             self.backend.on_change()
             self.peer_info.clear()
             self.peer_missing.clear()
@@ -481,6 +491,12 @@ class PG:
             self._kick_recovery()
             return
         is_write = any(op.get("op") in _WRITE_OPS for op in msg.ops)
+        if is_write and self.scrubbing:
+            # writes quiesce during scrub (reference blocks the scrub
+            # chunk range; PG granularity here) — released by
+            # _maybe_finish_scrub / check_scrub_timeout
+            self.waiting_for_active.append(lambda: self.do_op(msg))
+            return
         try:
             if is_write:
                 self.backend.submit_write(msg, reqid)
@@ -509,6 +525,78 @@ class PG:
         self.log.add(entry)
         self.info.last_update = entry.version
         self._persist_meta(txn)
+
+    # =======================================================================
+    # scrub (reference src/osd/scrubber/: primary gathers a ScrubMap
+    # from every acting member, compares, repairs from survivors)
+    # =======================================================================
+    def start_scrub(self) -> bool:
+        """Primary: kick a scrub round.  False if the PG can't scrub
+        now (not primary / not active / already scrubbing / writes in
+        flight — scrub maps must not race uncommitted writes)."""
+        if not self.is_primary or not self.state.startswith("active") \
+                or self.scrubbing or self.backend._inflight:
+            return False
+        self.scrubbing = True
+        self._scrub_started = time.monotonic()
+        self._scrub_tid += 1
+        self._scrub_maps = {
+            self.daemon.whoami: self.backend.build_scrub_map()}
+        self._scrub_waiting = set(self._peer_osds())
+        for o in self._scrub_waiting:
+            self.daemon.send_to_osd(o, M.MOSDRepScrub(
+                pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
+                scrub_tid=self._scrub_tid,
+                from_osd=self.daemon.whoami))
+        self._maybe_finish_scrub()
+        return True
+
+    def handle_rep_scrub(self, msg: M.MOSDRepScrub):
+        """Acting member: walk my collection, return the scrub map."""
+        self.daemon.send_to_osd(msg.from_osd, M.MOSDRepScrubMap(
+            pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
+            scrub_tid=msg.scrub_tid, shard=self.shard,
+            objects=self.backend.build_scrub_map(),
+            from_osd=self.daemon.whoami))
+
+    def handle_scrub_map(self, msg: M.MOSDRepScrubMap):
+        if not self.scrubbing or msg.scrub_tid != self._scrub_tid:
+            return
+        self._scrub_maps[msg.from_osd] = msg.objects
+        self._scrub_waiting.discard(msg.from_osd)
+        self._maybe_finish_scrub()
+
+    def _maybe_finish_scrub(self):
+        if self._scrub_waiting:
+            return
+        errors = self.backend.scrub_compare(self._scrub_maps)
+        self.scrub_errors = errors
+        self.last_scrub = time.time()
+        self.scrubbing = False
+        self._scrub_maps = {}
+        if errors:
+            # repair queued as recovery state by scrub_compare
+            self.state = "active"
+            self._kick_recovery()
+        # release writes that queued behind the scrub
+        waiters, self.waiting_for_active = self.waiting_for_active, []
+        for fn in waiters:
+            fn()
+
+    def check_scrub_timeout(self, grace: float = 30.0):
+        """Abort a scrub whose peers never answered (a peer without
+        the PG materialized, or whose address dropped from the map) so
+        the PG doesn't refuse scrubs forever."""
+        if self.scrubbing and \
+                time.monotonic() - getattr(self, "_scrub_started", 0.0) \
+                > grace:
+            self.scrubbing = False
+            self._scrub_maps = {}
+            self._scrub_waiting = set()
+            waiters, self.waiting_for_active = \
+                self.waiting_for_active, []
+            for fn in waiters:
+                fn()
 
 
 _WRITE_OPS = {"write", "write_full", "append", "delete", "truncate",
@@ -686,6 +774,70 @@ class ReplicatedBackend:
             else:
                 raise ValueError(f"unknown read op {kind!r}")
         return results
+
+    # -- scrub -------------------------------------------------------------
+    def build_scrub_map(self) -> dict:
+        """oid → {size, crc, version} over my copy of the collection
+        (reference ScrubMap build: whole-object crc per replica)."""
+        pg = self.pg
+        store, cid = pg.daemon.store, pg.cid
+        out = {}
+        for oid in pg._list_objects():
+            try:
+                data = store.read(cid, oid)
+                meta = json.loads(bytes(store.getattr(cid, oid, "_")))
+            except KeyError:
+                continue
+            out[oid] = {"size": len(data), "crc": zlib.crc32(data),
+                        "version": meta.get("version", list(ZERO)),
+                        "valid": True}
+        return out
+
+    def scrub_compare(self, maps: dict[int, dict]) -> int:
+        """Majority-vote across replica crcs; divergent or absent
+        copies become recovery state (pushed from the authoritative
+        copy).  Ties prefer the primary's copy — the reference prefers
+        the copy matching the object_info digest and falls back to the
+        primary.  Returns the inconsistency count."""
+        pg = self.pg
+        me = pg.daemon.whoami
+        oids = set()
+        for m in maps.values():
+            oids.update(m)
+        errors = 0
+        for oid in sorted(oids):
+            votes: dict[tuple, list[int]] = {}
+            for osd, m in maps.items():
+                e = m.get(oid)
+                if e is not None:
+                    votes.setdefault((e["crc"], e["size"]),
+                                     []).append(osd)
+            best = max(votes, key=lambda k: (len(votes[k]),
+                                             me in votes[k]))
+            good = votes[best]
+            ver = tuple(next(m[oid] for m in maps.values()
+                             if oid in m)["version"])
+            for osd in maps:
+                if osd in good:
+                    continue
+                errors += 1
+                if osd == me:
+                    pg.missing[oid] = ver
+                    # pull specifically from an authoritative copy
+                    # (recover_primary_object would pick any peer,
+                    # including another inconsistent one)
+                    donor = next((o for o in good if o != me), None)
+                    if donor is not None and not any(
+                            oid == o for o in pg._pulls.values()):
+                        pg._pull_tid += 1
+                        pg._pulls[pg._pull_tid] = oid
+                        pg.daemon.send_to_osd(donor, M.MOSDPGPull(
+                            pgid=str(pg.pgid),
+                            epoch=pg.daemon.osdmap.epoch, oid=oid,
+                            from_osd=me, pull_tid=pg._pull_tid))
+                else:
+                    pg.peer_missing.setdefault(osd, {})[oid] = ver
+        return errors
 
     # -- recovery ----------------------------------------------------------
     def push_object(self, peer: int, oid: str, version: tuple):
@@ -1190,6 +1342,52 @@ class ECBackend:
                               exclude={shard},
                               on_fail=lambda: pg._pulls.pop(pull_tid,
                                                             None))
+
+    # -- scrub -------------------------------------------------------------
+    def build_scrub_map(self) -> dict:
+        """oid → {size, crc, version, valid}: each EC shard verifies
+        its own chunk against the stored hinfo crc (reference deep
+        scrub on EC shards), so corruption is self-evident without
+        cross-shard comparison."""
+        pg = self.pg
+        store, cid = pg.daemon.store, pg.cid
+        out = {}
+        for oid in pg._list_objects():
+            try:
+                chunk = store.read(cid, oid)
+                meta = json.loads(bytes(store.getattr(cid, oid, "_")))
+            except KeyError:
+                continue
+            crc = zlib.crc32(chunk)
+            hinfo = meta.get("hinfo")
+            out[oid] = {"size": int(meta.get("size", 0)), "crc": crc,
+                        "version": meta.get("version", list(ZERO)),
+                        "valid": hinfo is None or crc == hinfo}
+        return out
+
+    def scrub_compare(self, maps: dict[int, dict]) -> int:
+        """A shard whose self-check failed (or that is missing an
+        object other members have) gets its chunk reconstructed from
+        the k survivors — the §4.3 path as repair."""
+        pg = self.pg
+        me = pg.daemon.whoami
+        oids = set()
+        for m in maps.values():
+            oids.update(m)
+        errors = 0
+        for oid in sorted(oids):
+            ver = tuple(next(m[oid] for m in maps.values()
+                             if oid in m)["version"])
+            for osd, m in maps.items():
+                e = m.get(oid)
+                if e is not None and e["valid"]:
+                    continue
+                errors += 1
+                if osd == me:
+                    pg.missing[oid] = ver
+                else:
+                    pg.peer_missing.setdefault(osd, {})[oid] = ver
+        return errors
 
     def answer_pull(self, msg: M.MOSDPGPull):
         # EC primaries reconstruct rather than pull whole objects

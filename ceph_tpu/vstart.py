@@ -131,6 +131,33 @@ class MiniCluster:
             time.sleep(0.05)
         raise TimeoutError(f"cluster never went clean: {states}")
 
+    def scrub_pg(self, pgid, timeout: float = 20.0) -> int:
+        """Scrub one PG on its primary; wait for completion and
+        subsequent repair to settle.  Returns the error count the
+        scrub found (0 = clean)."""
+        primary = None
+        for osd in self.osds.values():
+            with osd.lock:
+                pg = osd.pgs.get(pgid)
+                if pg is not None and pg.is_primary:
+                    primary = osd
+                    break
+        if primary is None:
+            raise KeyError(f"no primary for {pgid}")
+        deadline = time.monotonic() + timeout
+        while not primary.scrub_pg(pgid):
+            # refused while writes are in flight — retry
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"scrub of {pgid} never started")
+            time.sleep(0.05)
+        while time.monotonic() < deadline:
+            with primary.lock:
+                pg = primary.pgs[pgid]
+                if not pg.scrubbing:
+                    return pg.scrub_errors
+            time.sleep(0.05)
+        raise TimeoutError(f"scrub of {pgid} never finished")
+
     def wait_for_osd_down(self, i: int, timeout: float = 20.0):
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:

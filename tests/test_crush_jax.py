@@ -181,3 +181,15 @@ class TestChooseArgs:
             "weight_set": [[0x8000] * len(root.items),
                            [0x20000] * len(root.items)]}
         _check(m, 0, 4, XS)
+
+    def test_weight_set_multi_position_chooseleaf(self):
+        # regression: the inner chooseleaf descent must keep the OUTER
+        # output position for weight-set selection (review r3 finding)
+        m = build_hierarchy(1, 3, 3)
+        for b in m.buckets:
+            if b is not None and b.type == 1:
+                m.choose_args[b.id] = {"weight_set": [
+                    [0x10000] * len(b.items),
+                    [0x4000 * (i + 1) for i in range(len(b.items))],
+                ]}
+        _check(m, 0, 3, XS)

@@ -1,0 +1,141 @@
+"""Scrub + repair e2e (reference src/osd/scrubber/ +
+osd-scrub-repair.sh: corrupt a copy on disk, scrub detects, repair
+restores it from survivors)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.os_store import Transaction
+from ceph_tpu.vstart import MiniCluster
+
+
+def _corrupt(osd, oid, payload=b"CORRUPTION"):
+    """Silently damage the object's bytes in one OSD's store (no meta
+    update — exactly what bitrot looks like)."""
+    with osd.lock:
+        for cid in osd.store.list_collections():
+            if osd.store.exists(cid, oid):
+                osd.store.queue_transaction(
+                    Transaction().write(cid, oid, 0, payload))
+                return cid
+    raise KeyError(f"{oid} not on osd.{osd.whoami}")
+
+
+def _wait_repaired(c, check, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if check():
+            return
+        time.sleep(0.1)
+    raise AssertionError("repair never converged")
+
+
+class TestReplicatedScrub:
+    def test_corrupt_replica_detected_and_repaired(self):
+        c = MiniCluster(n_mons=1, n_osds=3)
+        try:
+            c.start()
+            r = c.rados()
+            r.create_pool("sp", pg_num=4, size=3)
+            io = r.open_ioctx("sp")
+            c.wait_for_clean()
+            io.write_full("victim", b"pristine-bytes" * 20)
+            time.sleep(0.3)
+            pool_id = r.pool_lookup("sp")
+            m = r.objecter.osdmap
+            pgid = m.raw_pg_to_pg(m.object_locator_to_pg("victim",
+                                                         pool_id))
+            _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+            # corrupt a NON-primary replica
+            bad = next(o for o in acting if o != primary)
+            cid = _corrupt(c.osds[bad], "victim")
+            # clean scrub on an undamaged PG reports zero errors
+            errors = c.scrub_pg(pgid)
+            assert errors == 1
+            def repaired():
+                with c.osds[bad].lock:
+                    try:
+                        return c.osds[bad].store.read(
+                            cid, "victim") == b"pristine-bytes" * 20
+                    except KeyError:
+                        return False
+            _wait_repaired(c, repaired)
+            # a second scrub is clean
+            assert c.scrub_pg(pgid) == 0
+            assert io.read("victim") == b"pristine-bytes" * 20
+        finally:
+            c.stop()
+
+    def test_corrupt_primary_repaired_from_replica(self):
+        c = MiniCluster(n_mons=1, n_osds=3)
+        try:
+            c.start()
+            r = c.rados()
+            r.create_pool("sp2", pg_num=4, size=3)
+            io = r.open_ioctx("sp2")
+            c.wait_for_clean()
+            io.write_full("pvictim", b"authoritative" * 16)
+            time.sleep(0.3)
+            pool_id = r.pool_lookup("sp2")
+            m = r.objecter.osdmap
+            pgid = m.raw_pg_to_pg(
+                m.object_locator_to_pg("pvictim", pool_id))
+            _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+            cid = _corrupt(c.osds[primary], "pvictim")
+            assert c.scrub_pg(pgid) == 1
+            def repaired():
+                with c.osds[primary].lock:
+                    try:
+                        return c.osds[primary].store.read(
+                            cid, "pvictim") == b"authoritative" * 16
+                    except KeyError:
+                        return False
+            _wait_repaired(c, repaired)
+            assert c.scrub_pg(pgid) == 0
+            assert io.read("pvictim") == b"authoritative" * 16
+        finally:
+            c.stop()
+
+
+class TestECScrub:
+    def test_corrupt_shard_reconstructed(self):
+        c = MiniCluster(n_mons=1, n_osds=4)
+        try:
+            c.start()
+            r = c.rados()
+            r.monc.command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "scrubec", "profile": ["k=2", "m=1"]})
+            r.create_pool("ep", pg_num=2, pool_type="erasure",
+                          erasure_code_profile="scrubec")
+            io = r.open_ioctx("ep")
+            c.wait_for_clean()
+            payload = bytes(range(256)) * 8
+            io.write_full("evictim", payload)
+            time.sleep(0.3)
+            pool_id = r.pool_lookup("ep")
+            m = r.objecter.osdmap
+            pgid = m.raw_pg_to_pg(
+                m.object_locator_to_pg("evictim", pool_id))
+            _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+            bad = next(o for o in acting if o != primary and o >= 0)
+            cid = _corrupt(c.osds[bad], "evictim", b"\xff\xff\xff")
+            with c.osds[bad].lock:
+                broken = bytes(c.osds[bad].store.read(cid, "evictim"))
+            assert c.scrub_pg(pgid) == 1
+            def repaired():
+                with c.osds[bad].lock:
+                    try:
+                        cur = bytes(c.osds[bad].store.read(
+                            cid, "evictim"))
+                    except KeyError:
+                        return False
+                    return cur != broken and not cur.startswith(
+                        b"\xff\xff\xff")
+            _wait_repaired(c, repaired)
+            assert c.scrub_pg(pgid) == 0
+            assert io.read("evictim") == payload
+        finally:
+            c.stop()
