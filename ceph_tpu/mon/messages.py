@@ -77,13 +77,13 @@ class MOSDMapMsg(_JsonMessage):
 @register_message
 class MOSDBoot(_JsonMessage):
     TYPE = 23
-    FIELDS = ("osd", "addr")
+    FIELDS = ("osd", "addr", "fwd")
 
 
 @register_message
 class MOSDFailure(_JsonMessage):
     TYPE = 24
-    FIELDS = ("target", "reporter")
+    FIELDS = ("target", "reporter", "fwd")
 
 
 @register_message
@@ -91,4 +91,14 @@ class MOSDAlive(_JsonMessage):
     """A would-be primary asks the mon to record up_thru = want
     before it activates (reference ``src/messages/MOSDAlive.h``)."""
     TYPE = 25
-    FIELDS = ("osd", "want")
+    FIELDS = ("osd", "want", "fwd")
+
+
+@register_message
+class MPGStats(_JsonMessage):
+    """Primary OSD → mon: per-PG state/object counts (reference
+    MPGStats → PGMap aggregation, ``src/mon/PGMap.cc``).  pg_stats:
+    {pgid: {"state", "num_objects", "log_size", "last_scrub",
+    "scrub_errors"}}."""
+    TYPE = 26
+    FIELDS = ("osd", "epoch", "pg_stats", "osd_stats", "fwd")

@@ -498,14 +498,84 @@ class LogMonitor(PaxosService):
         return None
 
 
+PG_STALE_GRACE = 6.0     # seconds without a primary report → stale
+
+
+class PGMap:
+    """Cluster-wide PG state aggregation (reference ``src/mon/
+    PGMap.cc``; held in memory on the leader like the modern mgr's
+    copy — stats are telemetry, not paxos state)."""
+
+    def __init__(self):
+        # pgid str → {"state", "num_objects", ..., "osd", "stamp"}
+        self.pg_stats: dict[str, dict] = {}
+        self.osd_stats: dict[int, dict] = {}
+
+    def apply_report(self, osd: int, pg_stats: dict, osd_stats: dict):
+        now = time.time()
+        for pgid, st in (pg_stats or {}).items():
+            st = dict(st)
+            st["osd"] = osd
+            st["stamp"] = now
+            self.pg_stats[pgid] = st
+        if osd_stats:
+            self.osd_stats[osd] = dict(osd_stats, stamp=now)
+
+    def prune(self, live_pools: set[int]):
+        """Drop stats for PGs of deleted pools — their primaries stop
+        reporting, and without pruning they'd read as stale forever
+        (reference: PGMap consumes pool deletions from the OSDMap)."""
+        for pgid in list(self.pg_stats):
+            try:
+                pool = int(pgid.split(".", 1)[0])
+            except ValueError:
+                pool = -1
+            if pool not in live_pools:
+                del self.pg_stats[pgid]
+
+    def states(self, total_expected: int | None = None) -> dict:
+        """state string → count; primaries silent past the grace are
+        'stale+<last state>', PGs never reported at all are
+        'unknown' (reference pg states of the same names)."""
+        now = time.time()
+        out: dict[str, int] = {}
+        for st in self.pg_stats.values():
+            s = st.get("state", "unknown")
+            if now - st["stamp"] > PG_STALE_GRACE:
+                s = f"stale+{s}"
+            out[s] = out.get(s, 0) + 1
+        if total_expected is not None:
+            known = len(self.pg_stats)
+            if total_expected > known:
+                out["unknown"] = out.get("unknown", 0) + \
+                    (total_expected - known)
+        return out
+
+    def num_objects(self) -> int:
+        return sum(int(st.get("num_objects", 0))
+                   for st in self.pg_stats.values())
+
+
 class HealthMonitor(PaxosService):
     NAME = "health"
 
     def dispatch_command(self, cmd):
         prefix = cmd.get("prefix", "")
-        if prefix in ("health", "status"):
+        if prefix == "pg dump":
+            self.mon.pgmap.prune(
+                set(self.mon.services["osdmap"].osdmap.pools))
+            return 0, "", {"pg_stats": self.mon.pgmap.pg_stats,
+                           "osd_stats": {
+                               str(o): s for o, s in
+                               self.mon.pgmap.osd_stats.items()}}
+        if prefix in ("health", "status", "pg stat"):
             osdsvc: OSDMonitor = self.mon.services["osdmap"]
             m = osdsvc.osdmap
+            self.mon.pgmap.prune(set(m.pools))
+            total_pgs = sum(p.pg_num for p in m.pools.values())
+            states = self.mon.pgmap.states(total_expected=total_pgs)
+            if prefix == "pg stat":
+                return 0, "", {"num_pgs": total_pgs, "states": states}
             checks = []
             down = [o for o in range(m.max_osd)
                     if m.exists(o) and not m.is_up(o)]
@@ -513,6 +583,26 @@ class HealthMonitor(PaxosService):
                 checks.append({"code": "OSD_DOWN",
                                "summary": f"{len(down)} osds down",
                                "detail": [f"osd.{o} down" for o in down]})
+            unhealthy = {s: n for s, n in states.items()
+                         if s not in ("active", "active+clean")}
+            degraded = {s: n for s, n in states.items()
+                        if "active" in s and "clean" not in s}
+            if degraded:
+                checks.append({
+                    "code": "PG_DEGRADED",
+                    "summary": f"{sum(degraded.values())} pgs not clean",
+                    "detail": [f"{n} pgs {s}"
+                               for s, n in sorted(degraded.items())]})
+            stuck = {s: n for s, n in unhealthy.items()
+                     if s.split("+")[0] in ("peering", "incomplete",
+                                            "down", "stale", "unknown")}
+            if stuck:
+                checks.append({
+                    "code": "PG_AVAILABILITY",
+                    "summary": f"{sum(stuck.values())} pgs stuck "
+                               f"({'/'.join(sorted(stuck))})",
+                    "detail": [f"{n} pgs {s}"
+                               for s, n in sorted(stuck.items())]})
             status = ("HEALTH_OK" if not checks else "HEALTH_WARN")
             out = {"health": status, "checks": checks}
             if prefix == "status":
@@ -524,6 +614,9 @@ class HealthMonitor(PaxosService):
                     "num_osds": m.max_osd,
                     "num_up_osds": m.num_up_osds(),
                     "pools": sorted(m.pool_name),
+                    "num_pgs": total_pgs,
+                    "pg_states": states,
+                    "num_objects": self.mon.pgmap.num_objects(),
                 })
             return 0, status, out
         return None
@@ -549,6 +642,7 @@ class Monitor(Dispatcher):
                         LogMonitor, HealthMonitor):
             self.services[svc_cls.NAME] = svc_cls(self)
         self._peer_cons: dict[int, object] = {}
+        self.pgmap = PGMap()
         self._subs: dict[object, dict] = {}   # connection → {what: since}
         self._proposal_queue: list[bytes] = []
         # (paxos version, fn) fired once last_committed reaches version —
@@ -557,6 +651,31 @@ class Monitor(Dispatcher):
         self._commit_waiters: list[tuple[int, object]] = []
         self._election_started = 0.0
         self._initial_created = False
+        # observability (reference: every daemon has PerfCounters and
+        # an AdminSocket — `ceph daemon mon.X perf dump`)
+        import os as _os
+        from ..core.admin_socket import AdminSocket
+        from ..core.perf_counters import PerfCountersBuilder
+        pb = PerfCountersBuilder(self.name)
+        pb.add_u64_counter("paxos_commits", "committed paxos values")
+        pb.add_u64_counter("elections", "election rounds entered")
+        pb.add_u64_counter("commands", "client commands dispatched")
+        self.perf = pb.create_perf_counters()
+        self.admin_socket = AdminSocket(
+            f"/tmp/ceph_tpu-{self.name}.{_os.getpid()}.asok")
+        self.admin_socket.register(
+            "perf dump", lambda c: self.perf.dump(),
+            "dump perf counters")
+        self.admin_socket.register(
+            "quorum_status", lambda c: {
+                "quorum": self.quorum, "leader": self.elector.leader,
+                "rank": self.rank, "state": self.elector.state},
+            "election/quorum state")
+        self.admin_socket.register(
+            "mon_status", lambda c: {
+                "rank": self.rank, "epoch": self.elector.epoch,
+                "paxos_version": self.paxos.last_committed},
+            "daemon status")
         self.timer = SafeTimer(f"{self.name}-tick")
         self._tick_interval = tick_interval
         self._tick_token = None
@@ -566,6 +685,7 @@ class Monitor(Dispatcher):
     def start(self):
         addr = self.monmap.mons[self.rank]
         self.msgr.bind(addr.host, addr.port)
+        self.admin_socket.start()
         self.running = True
         with self.lock:
             for svc in self.services.values():
@@ -577,6 +697,7 @@ class Monitor(Dispatcher):
     def shutdown(self):
         self.running = False
         self.timer.shutdown()
+        self.admin_socket.shutdown()
         self.msgr.shutdown()
         self.store.close()
 
@@ -614,6 +735,7 @@ class Monitor(Dispatcher):
 
     # -- election / paxos --------------------------------------------------
     def _start_election(self):
+        self.perf.inc("elections")
         self._election_started = time.monotonic()
         was_leader = self.elector.state == "leader"
         # leadership is in doubt: any not-yet-committed round may be
@@ -656,6 +778,7 @@ class Monitor(Dispatcher):
         self._drain_outboxes()
 
     def _on_paxos_commit(self, version: int, value: bytes):
+        self.perf.inc("paxos_commits")
         rec = json.loads(value.decode())
         t = StoreTransaction()
         for kind, prefix, key, val in rec["ops"]:
@@ -769,33 +892,52 @@ class Monitor(Dispatcher):
                     self._subs.pop(msg.connection, None)
             return True
         if isinstance(msg, M.MOSDBoot):
+            # forward at most ONE hop (reference
+            # Monitor::forward_request_leader): during an election two
+            # non-leaders may each point at the other, and unbounded
+            # forwarding would ping-pong daemon messages forever
             if self.is_leader:
                 self.services["osdmap"].handle_boot(msg.osd, msg.addr)
-            elif self.elector.leader is not None:
-                # peon: forward to the leader (reference
-                # Monitor::forward_request_leader)
+            elif self.elector.leader is not None and not msg.fwd:
                 self._peer_send(self.elector.leader,
-                                M.MOSDBoot(osd=msg.osd, addr=msg.addr))
+                                M.MOSDBoot(osd=msg.osd, addr=msg.addr,
+                                           fwd=1))
             return True
         if isinstance(msg, M.MOSDFailure):
             if self.is_leader:
                 self.services["osdmap"].handle_failure(msg.target,
                                                        msg.reporter)
-            elif self.elector.leader is not None:
+            elif self.elector.leader is not None and not msg.fwd:
                 self._peer_send(self.elector.leader,
                                 M.MOSDFailure(target=msg.target,
-                                              reporter=msg.reporter))
+                                              reporter=msg.reporter,
+                                              fwd=1))
             return True
         if isinstance(msg, M.MOSDAlive):
             if self.is_leader:
                 self.services["osdmap"].handle_alive(msg.osd, msg.want)
-            elif self.elector.leader is not None:
+            elif self.elector.leader is not None and not msg.fwd:
                 self._peer_send(self.elector.leader,
-                                M.MOSDAlive(osd=msg.osd, want=msg.want))
+                                M.MOSDAlive(osd=msg.osd, want=msg.want,
+                                            fwd=1))
+            return True
+        if isinstance(msg, M.MPGStats):
+            # every mon keeps a PGMap copy (reports fan out through
+            # the leader in the reference; applying locally on any
+            # receiving mon keeps `status` answerable everywhere)
+            self.pgmap.apply_report(msg.osd, msg.pg_stats,
+                                    msg.osd_stats)
+            if not self.is_leader and self.elector.leader is not None \
+                    and not msg.fwd:
+                self._peer_send(self.elector.leader, M.MPGStats(
+                    osd=msg.osd, epoch=msg.epoch,
+                    pg_stats=msg.pg_stats, osd_stats=msg.osd_stats,
+                    fwd=1))
             return True
         return False
 
     def _handle_command(self, msg: M.MMonCommand):
+        self.perf.inc("commands")
         cmd = msg.cmd if isinstance(msg.cmd, dict) else json.loads(msg.cmd)
         rc, outs, outb = -22, f"unknown command {cmd.get('prefix')!r}", None
         if not self.is_leader and _is_mutating(cmd):
@@ -880,9 +1022,13 @@ class Monitor(Dispatcher):
 
 def _is_mutating(cmd: dict) -> bool:
     prefix = cmd.get("prefix", "")
+    # NB: "status"/"health"/"pg *" are reads but deliberately NOT
+    # listed — PG stats aggregate on the leader (OSD reports are
+    # forwarded there), so those commands redirect to it for an
+    # authoritative answer
     read_only = ("osd dump", "osd getmap", "osd tree", "osd stat",
                  "osd pool ls", "osd erasure-code-profile get",
                  "osd erasure-code-profile ls", "auth get", "auth ls",
                  "config-key get", "config-key ls", "log last",
-                 "health", "status", "mon dump", "quorum_status")
+                 "mon dump", "quorum_status")
     return prefix not in read_only

@@ -21,10 +21,16 @@ Reference behavior re-created (``src/osd/OSD.{h,cc}``; SURVEY.md §3.5,
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
+from ..core.admin_socket import AdminSocket
+from ..core.config import ConfigProxy
+from ..core.options import build_options
+from ..core.perf_counters import PerfCountersBuilder
 from ..core.threading_utils import SafeTimer
+from ..core.tracked_op import OpTracker
 from ..mon import messages as MM
 from ..mon.client import MonClient
 from ..msg import Dispatcher, EntityAddr, Messenger
@@ -32,15 +38,44 @@ from ..os_store import MemStore
 from ..tools.osdmaptool import osdmap_from_dict
 from . import messages as M
 from .osdmap import OSDMap, PGid
-from .pg import PG, ECBackend, ReplicatedBackend
+from .pg import PG, ECBackend, ReplicatedBackend, _WRITE_OPS
+
+
+def _build_osd_perf(name: str):
+    """The OSD's counter set (reference ``OSD::create_logger`` —
+    l_osd_op & friends, trimmed to the paths this OSD has)."""
+    b = PerfCountersBuilder(name)
+    b.add_u64_counter("op", "client operations")
+    b.add_u64_counter("op_r", "client read operations")
+    b.add_u64_counter("op_w", "client write operations")
+    b.add_time_avg("op_latency", "client op latency")
+    b.add_u64_counter("subop", "replica/shard sub-operations")
+    b.add_u64_counter("recovery_ops", "objects recovered/pushed")
+    b.add_u64_counter("scrub_errors_found", "scrub inconsistencies")
+    b.add_u64("numpg", "placement groups hosted")
+    return b.create_perf_counters()
 
 
 class OSDaemon(Dispatcher):
     def __init__(self, whoami: int, monmap, store=None, *,
                  heartbeat_interval: float = 0.5,
-                 heartbeat_grace: float = 3.0):
+                 heartbeat_grace: float = 3.0,
+                 config: ConfigProxy | None = None,
+                 admin_socket_path: str | None = None):
         self.whoami = whoami
         self.monmap = monmap
+        # every knob below reads through the typed option table
+        # (reference md_config_t; ctor kwargs land as overrides so
+        # `config set` / injectargs can retune a live daemon)
+        self.config = config or ConfigProxy(build_options())
+        self.config.set("osd_heartbeat_interval", heartbeat_interval)
+        self.config.set("osd_heartbeat_grace", heartbeat_grace)
+        self.perf = _build_osd_perf(f"osd.{whoami}")
+        self.op_tracker = OpTracker()
+        self.admin_socket = AdminSocket(
+            admin_socket_path
+            or f"/tmp/ceph_tpu-osd.{whoami}.{os.getpid()}.asok")
+        self._register_admin_commands()
         self.store = store if store is not None else MemStore(
             name=f"osd.{whoami}")
         self.msgr = Messenger(f"osd.{whoami}")
@@ -59,16 +94,54 @@ class OSDaemon(Dispatcher):
         self.running = False
         self.addr: EntityAddr | None = None
         self._peer_cons: dict[int, object] = {}
-        self._hb_interval = heartbeat_interval
-        self._hb_grace = heartbeat_grace
+        self._hb_interval = self.config.get("osd_heartbeat_interval")
+        self._hb_grace = self.config.get("osd_heartbeat_grace")
+        self.config.add_observer(
+            "osd_heartbeat_interval",
+            lambda _n, v: setattr(self, "_hb_interval", v))
+        self.config.add_observer(
+            "osd_heartbeat_grace",
+            lambda _n, v: setattr(self, "_hb_grace", v))
         self._hb_last: dict[int, float] = {}
         self._hb_reported: dict[int, float] = {}  # osd → last report time
+        self._stats_interval = max(1.0, heartbeat_interval * 2)
+        self._stats_last = 0.0
         self.timer = SafeTimer(f"osd.{whoami}-tick")
         self._tick_token = None
+
+    def _register_admin_commands(self):
+        """Live-introspection surface (reference AdminSocket hooks:
+        `ceph daemon osd.N <cmd>`)."""
+        a = self.admin_socket
+        a.register("perf dump", lambda c: self.perf.dump(),
+                   "dump perf counters")
+        a.register("perf schema", lambda c: self.perf.schema(),
+                   "perf counter schema")
+        a.register("dump_ops_in_flight",
+                   lambda c: self.op_tracker.dump_ops_in_flight(),
+                   "in-flight client ops")
+        a.register("dump_historic_ops",
+                   lambda c: self.op_tracker.dump_historic_ops(),
+                   "recently completed ops")
+        a.register("config show", lambda c: {
+            k: self.config.get(k) for k in self.config.keys()},
+            "effective configuration")
+        a.register("config set", lambda c: (
+            self.config.set(c["key"], c["value"]),
+            {"success": f"{c['key']} = {self.config.get(c['key'])}"}
+        )[1], "set a config override")
+        a.register("config help", lambda c: self.config.help(c["key"]),
+                   "option metadata")
+        a.register("status", lambda c: {
+            "whoami": self.whoami, "epoch": self.osdmap.epoch,
+            "num_pgs": len(self.pgs),
+            "state": "active" if self.running else "stopped"},
+            "daemon status")
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, wait_for_up: bool = True, timeout: float = 15.0):
         self.store.mount()
+        self.admin_socket.start()
         self.addr = self.msgr.bind()
         self.running = True
         self.monc.on_osdmap = self._on_osdmap
@@ -91,6 +164,7 @@ class OSDaemon(Dispatcher):
     def shutdown(self):
         self.running = False
         self.timer.shutdown()
+        self.admin_socket.shutdown()
         self.monc.shutdown()
         self.msgr.shutdown()
         self.store.umount()
@@ -213,6 +287,7 @@ class OSDaemon(Dispatcher):
                     pg.create_onstore()
                 pg.pool = m.pools[pool.id]
                 pg.advance_map(up, upp, acting, actingp, m.epoch)
+        self.perf.set("numpg", len(self.pgs))
 
     # -- peer plumbing -----------------------------------------------------
     def send_to_osd(self, osd: int, msg):
@@ -286,9 +361,35 @@ class OSDaemon(Dispatcher):
                     self._hb_reported[o] = now
                     self.monc.send(MM.MOSDFailure(
                         target=o, reporter=self.whoami))
+            if now - self._stats_last >= self._stats_interval:
+                self._stats_last = now
+                self._report_pg_stats()
         if self.running:
             self._tick_token = self.timer.add_event_after(
                 self._hb_interval, self._tick)
+
+    def _report_pg_stats(self):
+        """Primary PGs report state/object counts to the mon (reference
+        MPGStats → PGMap; caller holds the lock)."""
+        stats = {}
+        for pgid, pg in self.pgs.items():
+            if not pg.is_primary:
+                continue
+            stats[str(pgid)] = {
+                "state": pg.state + ("+scrubbing" if pg.scrubbing
+                                     else ""),
+                "num_objects": len(pg._list_objects()),
+                "log_size": len(pg.log.entries),
+                "missing": len(pg.missing) + sum(
+                    len(pm) for pm in pg.peer_missing.values()),
+                "last_scrub": pg.last_scrub,
+                "scrub_errors": pg.scrub_errors,
+            }
+        if stats or self.pgs:
+            self.monc.send(MM.MPGStats(
+                osd=self.whoami, epoch=self.osdmap.epoch,
+                pg_stats=stats,
+                osd_stats={"num_pgs": len(self.pgs)}))
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:
@@ -407,8 +508,19 @@ class OSDaemon(Dispatcher):
         return pg
 
     def _handle_client_op(self, msg: M.MOSDOp):
+        # TrackedOp + counters on the op path (reference
+        # OSD::ms_fast_dispatch → op_tracker.create_request)
+        kinds = {op.get("op") for op in (msg.ops or [])}
+        is_write = bool(kinds & _WRITE_OPS)
+        self.perf.inc("op")
+        self.perf.inc("op_w" if is_write else "op_r")
+        msg.tracked = self.op_tracker.create_request(
+            f"osd_op({msg.client}.{msg.tid} {msg.pgid} {msg.oid} "
+            f"{'+'.join(sorted(k for k in kinds if k))})")
         pg = self.pgs.get(PGid.parse(msg.pgid))
         if pg is None:
+            msg.tracked.finish()
+            msg.tracked = None
             try:
                 msg.connection.send_message(M.MOSDOpReply(
                     tid=msg.tid, rc=-11, outs="pg not here",
@@ -424,3 +536,4 @@ class OSDaemon(Dispatcher):
             for o, (_a, c) in list(self._peer_cons.items()):
                 if c is con:
                     del self._peer_cons[o]
+

@@ -437,6 +437,7 @@ class PG:
 
     def handle_push(self, msg: M.MOSDPGPush):
         """Receive a recovered/backfilled object (replica or primary)."""
+        self.daemon.perf.inc("recovery_ops")
         self.backend.apply_push(msg)
         if msg.pull_tid is not None and self.is_primary:
             # this push answered one of OUR pulls
@@ -511,6 +512,12 @@ class PG:
 
     def _reply(self, msg: M.MOSDOp, rc: int, outs: str = "",
                results=None, version=ZERO):
+        tracked = getattr(msg, "tracked", None)
+        if tracked is not None:
+            msg.tracked = None
+            tracked.mark_event("replied")
+            tracked.finish()
+            self.daemon.perf.tinc("op_latency", tracked.age)
         try:
             msg.connection.send_message(M.MOSDOpReply(
                 tid=msg.tid, rc=rc, outs=outs, results=results,
@@ -534,8 +541,11 @@ class PG:
         """Primary: kick a scrub round.  False if the PG can't scrub
         now (not primary / not active / already scrubbing / writes in
         flight — scrub maps must not race uncommitted writes)."""
+        busy = (self.backend._inflight
+                or getattr(self.backend, "_rmw", None)
+                or getattr(self.backend, "_reads", None))
         if not self.is_primary or not self.state.startswith("active") \
-                or self.scrubbing or self.backend._inflight:
+                or self.scrubbing or busy:
             return False
         self.scrubbing = True
         self._scrub_started = time.monotonic()
@@ -570,6 +580,8 @@ class PG:
         if self._scrub_waiting:
             return
         errors = self.backend.scrub_compare(self._scrub_maps)
+        if errors:
+            self.daemon.perf.inc("scrub_errors_found", errors)
         self.scrub_errors = errors
         self.last_scrub = time.time()
         self.scrubbing = False
@@ -728,6 +740,7 @@ class ReplicatedBackend:
     # -- replica apply -----------------------------------------------------
     def apply_rep_op(self, msg: M.MOSDRepOp):
         pg, daemon = self.pg, self.pg.daemon
+        daemon.perf.inc("subop")
         txn = Transaction.from_dict(msg.txn)
         for ed in msg.log_entries or []:
             e = LogEntry.from_dict(ed)
@@ -923,6 +936,10 @@ class ECBackend:
         self._inflight: dict[str, dict] = {}
         self._reads: dict[int, dict] = {}
         self._read_tid = 0
+        # per-object read-modify-write gate: oid → queued retries
+        # (reference ECBackend's extent cache serializes RMW per
+        # object; PG-object granularity here)
+        self._rmw: dict[str, list] = {}
 
     @property
     def engine(self):
@@ -935,32 +952,97 @@ class ECBackend:
     def on_change(self):
         self._inflight.clear()
         self._reads.clear()
+        self._rmw.clear()
 
     # -- writes ------------------------------------------------------------
     def submit_write(self, msg: M.MOSDOp, reqid: str):
-        """EC pools accept object-granular mutations: write_full,
-        append, delete, xattr/omap ops (the reference's EC pools
-        likewise reject partial overwrites without the RMW cache —
-        ``pool.requires_aligned_append``)."""
-        pg, daemon = self.pg, self.pg.daemon
+        """EC mutations: write_full/delete/xattr/omap apply directly;
+        partial `write` and `append` on an existing object go through
+        read-modify-write — gather the stripe (decode from minimum
+        shards, reconstructing if degraded), splice the new bytes,
+        re-encode, sub-write (reference ``src/osd/ECTransaction.cc``
+        + the extent cache, at object granularity)."""
+        pg = self.pg
+        oid = msg.oid
+        if oid in self._rmw:
+            # an RMW is mid-flight on this object: EVERY write to it
+            # queues behind it (a write_full/delete slipping past
+            # would be clobbered when the RMW's splice commits)
+            self._rmw[oid].append(
+                lambda: self.submit_write(msg, reqid))
+            return
+        exists = self._read_local_meta(oid) is not None
+        kinds = [op.get("op") for op in msg.ops]
+        needs_old = exists and any(k in ("write", "append", "truncate")
+                                   for k in kinds)
+        if needs_old:
+            self._rmw[oid] = []
+            fake = M.MOSDOp(tid=0, client="rmw", pgid=str(pg.pgid),
+                            oid=oid, epoch=pg.daemon.osdmap.epoch,
+                            ops=[], flags=0)
+            fake.connection = None
+
+            def on_chunks(decoded, meta):
+                size = int(meta.get("size", 0))
+                k = self.engine.k
+                old = b"".join(
+                    decoded[i].tobytes() for i in range(k))[:size]
+                self._apply_ops(msg, reqid, old)
+                self._release_rmw(oid)
+
+            def on_fail():
+                self._release_rmw(oid)
+                pg._reply(msg, -5, "rmw read failed")
+
+            self._start_data_read(fake, on_chunks=on_chunks,
+                                  on_fail=on_fail)
+            return
+        self._apply_ops(msg, reqid, b"" if not exists else None)
+
+    def _release_rmw(self, oid: str):
+        waiters = self._rmw.pop(oid, [])
+        for fn in waiters:
+            fn()
+
+    def _apply_ops(self, msg: M.MOSDOp, reqid: str,
+                   old: bytes | None):
+        """Build the new object payload from `old` (b"" for a fresh
+        object, None when no data op needs it) and fan out."""
+        pg = self.pg
         oid = msg.oid
         version = pg.next_version()
         prior = self._object_version(oid)
         data = None
+        cur = old
         delete = False
         attr_ops = []
         results = []
-        size = None
         for op in msg.ops:
             kind = op.get("op")
             if kind == "write_full":
-                data = bytes.fromhex(op["data"])
+                cur = bytes.fromhex(op["data"])
+                data = cur
+                results.append({})
+            elif kind == "write":
+                buf = bytes.fromhex(op["data"])
+                off = int(op.get("off", 0))
+                base = bytearray(cur or b"")
+                if len(base) < off:
+                    base.extend(b"\x00" * (off - len(base)))
+                base[off:off + len(buf)] = buf
+                cur = bytes(base)
+                data = cur
                 results.append({})
             elif kind == "append":
-                cur = self._read_local_size(oid)
-                old = (self._local_chunks_joined(oid, cur)
-                       if cur else b"")
-                data = old + bytes.fromhex(op["data"])
+                cur = (cur or b"") + bytes.fromhex(op["data"])
+                data = cur
+                results.append({})
+            elif kind == "truncate":
+                size = int(op["size"])
+                base = (cur or b"")
+                cur = (base[:size] if size <= len(base)
+                       else base + b"\x00" * (size - len(base)))
+                data = cur
                 results.append({})
             elif kind == "delete":
                 delete = True
@@ -968,15 +1050,12 @@ class ECBackend:
             elif kind in ("setxattr", "rmxattr", "omap_set", "omap_rm"):
                 attr_ops.append(op)
                 results.append({})
-            elif kind == "write":
-                raise ValueError(
-                    "EC pools require write_full/append (no partial "
-                    "overwrite without the RMW cache)")
             else:
                 raise ValueError(f"unknown write op {kind!r}")
         entry = LogEntry(op=DELETE if delete else MODIFY, oid=oid,
                          version=version, prior_version=prior,
                          reqid=reqid, mtime=time.time())
+        daemon = pg.daemon
         # encode once; per-shard transactions
         shard_chunks = None
         if data is not None:
@@ -1059,6 +1138,7 @@ class ECBackend:
 
     def apply_sub_write(self, msg: M.MOSDECSubOpWrite):
         pg, daemon = self.pg, self.pg.daemon
+        daemon.perf.inc("subop")
         txn = Transaction.from_dict(msg.txn)
         entries = [LogEntry.from_dict(e) for e in msg.log_entries or []]
         self._apply_shard_txn(txn, entries)
@@ -1093,16 +1173,6 @@ class ECBackend:
         except KeyError:
             return None
 
-    def _read_local_size(self, oid: str) -> int | None:
-        meta = self._read_local_meta(oid)
-        return None if meta is None else int(meta["size"])
-
-    def _local_chunks_joined(self, oid: str, size: int) -> bytes:
-        """Fast path used only by append on a PG whose data shards are
-        all local-readable — falls back to raising KeyError (degraded
-        appends wait for recovery upstream)."""
-        raise ValueError("EC append on existing object requires "
-                         "read-modify-write; use write_full")
 
     # -- reads -------------------------------------------------------------
     def do_reads(self, msg: M.MOSDOp):

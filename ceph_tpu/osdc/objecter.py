@@ -46,7 +46,12 @@ class _Op:
 class Objecter(Dispatcher):
     def __init__(self, monmap, entity: str = "client.objecter", *,
                  resend_interval: float = 2.0):
-        self.entity = entity
+        # a per-session nonce joins the entity name in every reqid:
+        # two sessions of the same client name must never collide in
+        # the OSDs' dup-op log (the reference's osd_reqid_t carries
+        # the session GID the mon hands out at authentication)
+        import uuid
+        self.entity = f"{entity}:{uuid.uuid4().hex[:12]}"
         self.monc = MonClient(monmap, entity=entity)
         self.msgr = Messenger(entity)
         self.msgr.add_dispatcher(self)

@@ -1,0 +1,3 @@
+"""RBD — block images striped over RADOS objects (SURVEY.md §3.9)."""
+
+from .image import Image, RBD, ImageNotFound  # noqa: F401

@@ -72,6 +72,7 @@ class MiniCluster:
         osd = self.osds.pop(i)
         osd.running = False
         osd.timer.shutdown()
+        osd.admin_socket.shutdown()
         osd.monc.shutdown()
         osd.msgr.shutdown()
         # deliberately NOT umounting: a revive remounts the same store

@@ -312,14 +312,18 @@ class TestFailover:
                   for r in range(3)]
         monmap, mons = make_cluster(3, stores=stores)
         try:
-            assert wait_for(lambda: any(m.is_leader for m in mons))
+            # generous timeouts: this test shares one CPU core with
+            # the rest of the suite and flakes under load otherwise
+            assert wait_for(lambda: any(m.is_leader for m in mons),
+                            timeout=30)
             mc = MonClient(monmap)
             rc, _, _ = mc.command({"prefix": "osd pool create",
-                                   "pool": "persist", "pg_num": 8})
+                                   "pool": "persist", "pg_num": 8},
+                                  timeout=30)
             assert rc == 0
             assert wait_for(lambda: all(
                 "persist" in m.services["osdmap"].osdmap.pool_name
-                for m in mons), timeout=15)
+                for m in mons), timeout=30)
             mc.shutdown()
         finally:
             for m in mons:
@@ -331,7 +335,7 @@ class TestFailover:
         try:
             assert wait_for(lambda: all(
                 "persist" in m.services["osdmap"].osdmap.pool_name
-                for m in mons2), timeout=15)
+                for m in mons2), timeout=30)
         finally:
             for m in mons2:
                 m.shutdown()

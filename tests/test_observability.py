@@ -1,0 +1,77 @@
+"""The core layer wired into live daemons (VERDICT r2 item 8):
+config-driven knobs, TrackedOp on the op path, perf counters, and a
+live admin socket answering `perf dump` / `dump_ops_in_flight`."""
+
+import time
+
+import pytest
+
+from ceph_tpu.core.admin_socket import admin_command
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    r = c.rados()
+    r.create_pool("obs", pg_num=4, size=3)
+    io = r.open_ioctx("obs")
+    c.wait_for_clean()
+    yield c, r, io
+    c.stop()
+
+
+class TestAdminSocket:
+    def test_osd_perf_counters_count_ops(self, cluster):
+        c, r, io = cluster
+        for i in range(5):
+            io.write_full(f"m{i}", b"payload")
+        for i in range(5):
+            io.read(f"m{i}")
+        osd = next(iter(c.osds.values()))
+        dump = admin_command(osd.admin_socket.path, "perf dump")
+        counters = dump[osd.perf.name] if osd.perf.name in dump \
+            else dump
+        total_ops = sum(
+            admin_command(o.admin_socket.path,
+                          "perf dump")[f"osd.{i}"]["op"]
+            for i, o in c.osds.items())
+        assert total_ops >= 10
+        lat = admin_command(osd.admin_socket.path, "perf dump")
+        # some OSD served something and has latency samples
+        sums = [admin_command(o.admin_socket.path, "perf dump")
+                [f"osd.{i}"]["op_latency"] for i, o in c.osds.items()]
+        assert any(s["avgcount"] > 0 for s in sums)
+
+    def test_historic_ops_recorded(self, cluster):
+        c, r, io = cluster
+        io.write_full("hist", b"x")
+        io.read("hist")
+        found = []
+        for i, o in c.osds.items():
+            h = admin_command(o.admin_socket.path, "dump_historic_ops")
+            found.extend(h.get("ops", []))
+        assert any("hist" in op.get("description", "") for op in found)
+
+    def test_config_show_and_live_set(self, cluster):
+        c, r, io = cluster
+        osd = next(iter(c.osds.values()))
+        cfg = admin_command(osd.admin_socket.path, "config show")
+        assert cfg["osd_heartbeat_interval"] == 0.5
+        admin_command(osd.admin_socket.path, "config set",
+                      key="osd_heartbeat_grace", value=9.5)
+        assert osd._hb_grace == 9.5    # observer updated the live knob
+        helpinfo = admin_command(osd.admin_socket.path, "config help",
+                                 key="osd_heartbeat_grace")
+        assert helpinfo["type"] == "float"
+        admin_command(osd.admin_socket.path, "config set",
+                      key="osd_heartbeat_grace", value=3.0)
+
+    def test_mon_admin_socket(self, cluster):
+        c, r, io = cluster
+        mon = c.mons[0]
+        dump = admin_command(mon.admin_socket.path, "perf dump")
+        assert dump["mon.0"]["paxos_commits"] > 0
+        q = admin_command(mon.admin_socket.path, "quorum_status")
+        assert q["state"] == "leader"

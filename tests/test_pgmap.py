@@ -1,0 +1,78 @@
+"""PG stats → PGMap → health/status (reference MPGStats +
+src/mon/PGMap.cc): cluster state must be observable via `status`
+alone, through degradation and recovery."""
+
+import time
+
+import pytest
+
+from ceph_tpu.vstart import MiniCluster
+
+
+def _status(r):
+    rc, _, out = r.monc.command({"prefix": "status"})
+    assert rc == 0
+    return out
+
+
+def _wait_states(r, pred, timeout=40.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        out = _status(r)
+        last = out.get("pg_states")
+        if pred(out):
+            return out
+        time.sleep(0.3)
+    raise AssertionError(f"status never converged: {last}")
+
+
+class TestPGMapStatus:
+    def test_clean_degraded_recovered_via_status_alone(self):
+        c = MiniCluster(n_mons=3, n_osds=3)
+        try:
+            c.start()
+            r = c.rados()
+            r.create_pool("pgsp", pg_num=8, size=3)
+            io = r.open_ioctx("pgsp")
+            # all PGs clean, visible through the mon only
+            out = _wait_states(
+                r, lambda o: o["pg_states"].get("active+clean", 0)
+                == o["num_pgs"])
+            assert out["health"] == "HEALTH_OK"
+            assert out["num_pgs"] == 8
+            for i in range(10):
+                io.write_full(f"obj{i}", b"x" * 64)
+            out = _wait_states(
+                r, lambda o: o.get("num_objects", 0) >= 10)
+            # kill an OSD: health must degrade without asking any OSD
+            c.kill_osd(2)
+            out = _wait_states(
+                r, lambda o: o["health"] == "HEALTH_WARN"
+                and any(ch["code"] == "OSD_DOWN"
+                        for ch in o["checks"]))
+            # revive: back to fully clean, via status alone
+            c.revive_osd(2)
+            out = _wait_states(
+                r, lambda o: o["pg_states"].get("active+clean", 0)
+                == o["num_pgs"] and o["health"] == "HEALTH_OK")
+        finally:
+            c.stop()
+
+    def test_pg_dump_and_stat(self):
+        c = MiniCluster(n_mons=1, n_osds=2)
+        try:
+            c.start()
+            r = c.rados()
+            r.create_pool("pdp", pg_num=4, size=2)
+            _wait_states(
+                r, lambda o: o["pg_states"].get("active+clean", 0) == 4)
+            rc, _, out = r.monc.command({"prefix": "pg stat"})
+            assert rc == 0 and out["num_pgs"] == 4
+            rc, _, dump = r.monc.command({"prefix": "pg dump"})
+            assert rc == 0 and len(dump["pg_stats"]) == 4
+            for st in dump["pg_stats"].values():
+                assert st["state"] == "active+clean"
+            assert dump["osd_stats"]
+        finally:
+            c.stop()

@@ -199,12 +199,25 @@ class TestECPool:
         chunk = len(self.PAYLOAD) // 4
         assert all(ln == chunk for _, ln in holders)
 
-    def test_partial_overwrite_rejected(self, ec_cluster):
+    def test_partial_overwrite_rmw(self, ec_cluster):
+        """EC read-modify-write (reference ECTransaction + extent
+        cache): partial write and append on an existing EC object
+        gather the stripe, splice, re-encode, and round-trip."""
         c, r, io = ec_cluster
         io.write_full("e3", self.PAYLOAD)
-        from ceph_tpu.osdc.librados import Error
-        with pytest.raises(Error):
-            io.write("e3", b"zz", 10)
+        io.write("e3", b"zz", 10)
+        want = bytearray(self.PAYLOAD)
+        want[10:12] = b"zz"
+        assert io.read("e3") == bytes(want)
+        io.append("e3", b"-tail")
+        want.extend(b"-tail")
+        assert io.read("e3") == bytes(want)
+        # write past EOF zero-fills the gap
+        io.write_full("e4", b"head")
+        io.write("e4", b"end", 10)
+        assert io.read("e4") == b"head\x00\x00\x00\x00\x00\x00end"
+        io.truncate("e4", 6)
+        assert io.read("e4") == b"head\x00\x00"
 
     def test_kill_osd_degraded_read_reconstructs(self, ec_cluster):
         """The round-2 VERDICT criterion: client writes a k=4,m=2 EC
@@ -331,5 +344,43 @@ class TestPeeringSafety:
             c.revive_osd(acting[0])
             c.wait_for_clean(timeout=40)
             assert io.read(oid) == b"must-survive"
+        finally:
+            c.stop()
+
+
+class TestECPartialWriteDegraded:
+    def test_rmw_with_shard_down(self):
+        """Degraded RMW: the stripe gather reconstructs the dead
+        shard's chunk before splicing (VERDICT r2 item 7)."""
+        c = MiniCluster(n_mons=1, n_osds=5)
+        try:
+            c.start()
+            r = c.rados()
+            rc, outs, _ = r.mon_command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "rmw42", "profile": ["k=2", "m=2"]})
+            assert rc == 0, outs
+            r.create_pool("rmwp", pg_num=2, pool_type="erasure",
+                          erasure_code_profile="rmw42")
+            io = r.open_ioctx("rmwp")
+            c.wait_for_clean()
+            payload = bytes(range(200))
+            io.write_full("rmw", payload)
+            pool_id = r.pool_lookup("rmwp")
+            m = r.objecter.osdmap
+            pgid = m.raw_pg_to_pg(m.object_locator_to_pg("rmw",
+                                                         pool_id))
+            _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+            victim = next(o for o in acting
+                          if o != primary and o >= 0)
+            c.kill_osd(victim)
+            c.wait_for_osd_down(victim)
+            io.write("rmw", b"SPLICED", 50)
+            want = bytearray(payload)
+            want[50:57] = b"SPLICED"
+            assert io.read("rmw") == bytes(want)
+            io.append("rmw", b"+more")
+            want.extend(b"+more")
+            assert io.read("rmw") == bytes(want)
         finally:
             c.stop()

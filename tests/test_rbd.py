@@ -1,0 +1,114 @@
+"""Striper math (reference Striper::file_to_extents vectors) and the
+RBD image layer over a live cluster: I/O spanning many objects,
+snapshot read-back after overwrite (VERDICT r2 item 9)."""
+
+import pytest
+
+from ceph_tpu.osdc.striper import FileLayout, file_to_extents
+from ceph_tpu.rbd import Image, ImageNotFound, RBD
+from ceph_tpu.vstart import MiniCluster
+
+
+class TestStriper:
+    def test_default_layout_chunks(self):
+        lay = FileLayout(stripe_unit=4096, stripe_count=1,
+                         object_size=4096)
+        ext = file_to_extents(lay, 0, 10000)
+        assert [(e.object_no, e.offset, e.length) for e in ext] == [
+            (0, 0, 4096), (1, 0, 4096), (2, 0, 1808)]
+
+    def test_striping_round_robin(self):
+        # 2 objects per set, 2 units per object
+        lay = FileLayout(stripe_unit=100, stripe_count=2,
+                         object_size=200)
+        ext = file_to_extents(lay, 0, 800)
+        assert [(e.object_no, e.offset) for e in ext] == [
+            (0, 0), (1, 0), (0, 100), (1, 100),
+            (2, 0), (3, 0), (2, 100), (3, 100)]
+
+    def test_mid_unit_offsets(self):
+        lay = FileLayout(stripe_unit=100, stripe_count=2,
+                         object_size=200)
+        ext = file_to_extents(lay, 250, 100)
+        # block 2 (obj 0 unit 1) tail + block 3 (obj 1 unit 1) head
+        assert [(e.object_no, e.offset, e.length) for e in ext] == [
+            (0, 150, 50), (1, 100, 50)]
+
+    def test_invalid_layout(self):
+        with pytest.raises(ValueError):
+            file_to_extents(FileLayout(stripe_unit=100,
+                                       object_size=250), 0, 1)
+
+
+@pytest.fixture(scope="module")
+def rbd_cluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    r = c.rados()
+    r.create_pool("rbd", pg_num=8, size=2)
+    io = r.open_ioctx("rbd")
+    c.wait_for_clean()
+    yield c, r, io
+    c.stop()
+
+
+class TestImage:
+    def test_image_io_spanning_objects(self, rbd_cluster):
+        c, r, io = rbd_cluster
+        rbd = RBD()
+        rbd.create(io, "img", 64 << 10, order=12)   # 4 KiB objects
+        img = rbd.open(io, "img")
+        assert img.stat()["size"] == 64 << 10
+        payload = bytes(range(256)) * 80            # 20 KiB ≥ 5 objects
+        img.write(1000, payload)
+        assert img.read(1000, len(payload)) == payload
+        # sparse reads are zeros
+        assert img.read(40 << 10, 100) == b"\x00" * 100
+        # data objects actually exist in the pool
+        datas = [o for o in io.list_objects()
+                 if o.startswith("rbd_data.img.")]
+        assert len(datas) >= 5
+        assert "img" in rbd.list(io)
+
+    def test_snapshot_readback_after_overwrite(self, rbd_cluster):
+        c, r, io = rbd_cluster
+        rbd = RBD()
+        rbd.create(io, "snapimg", 32 << 10, order=12)
+        img = rbd.open(io, "snapimg")
+        v1 = b"generation-one!!" * 512          # 8 KiB, 2 objects
+        img.write(0, v1)
+        img.create_snap("s1")
+        v2 = b"generation-TWO??" * 512
+        img.write(0, v2)
+        assert img.read(0, len(v2)) == v2
+        snap = rbd.open(io, "snapimg", snapshot="s1")
+        assert snap.read(0, len(v1)) == v1
+        with pytest.raises(ValueError):
+            snap.write(0, b"nope")
+        # second snapshot layers correctly
+        img.create_snap("s2")
+        v3 = b"generation-333.." * 512
+        img.write(0, v3)
+        assert rbd.open(io, "snapimg", "s1").read(0, len(v1)) == v1
+        assert rbd.open(io, "snapimg", "s2").read(0, len(v2)) == v2
+        assert img.read(0, len(v3)) == v3
+        # snapshot of a region written AFTER the snap reads zeros
+        img.write(16 << 10, b"late-bytes")
+        assert rbd.open(io, "snapimg", "s2").read(16 << 10, 10) \
+            == b"\x00" * 10
+        img.remove_snap("s1")
+        with pytest.raises(ImageNotFound):
+            rbd.open(io, "snapimg", snapshot="s1")
+
+    def test_resize_and_discard(self, rbd_cluster):
+        c, r, io = rbd_cluster
+        rbd = RBD()
+        rbd.create(io, "rsz", 16 << 10, order=12)
+        img = rbd.open(io, "rsz")
+        img.write(0, b"A" * (16 << 10))
+        img.resize(8 << 10)
+        assert img.size() == 8 << 10
+        assert img.read(0, 32 << 10) == b"A" * (8 << 10)
+        img.discard(0, 4 << 10)
+        assert img.read(0, 4 << 10) == b"\x00" * (4 << 10)
+        assert img.read(4 << 10, 4 << 10) == b"A" * (4 << 10)
