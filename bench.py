@@ -178,25 +178,41 @@ def _crush_leg():
 
 
 def main():
-    from ceph_tpu.utils import honor_jax_platforms_env
-    honor_jax_platforms_env()
-    import jax
+    try:
+        from ceph_tpu.utils import honor_jax_platforms_env
+        honor_jax_platforms_env()
+        import jax
+    except Exception as e:
+        print(json.dumps({"metric": "ec_encode_k8m3_1MiB_GBps",
+                          "value": 0, "unit": "GB/s",
+                          "vs_baseline": 0,
+                          "error": f"jax init: {str(e)[:200]}"}))
+        return
 
-    sweep, base_label, backend = _ec_sweep()
-    crush = _crush_leg()
-    head = sweep[str(1 << 20)]
-    print(json.dumps({
-        "metric": "ec_encode_k8m3_1MiB_GBps",
-        "value": head["encode_GBps"],
-        "unit": "GB/s",
-        "vs_baseline": head["encode_vs_baseline"],
-        "baseline": base_label,
-        "backend": backend,
-        "sweep": sweep,
-        "crush": crush,
-    }))
-    print(f"# device={jax.devices()[0].device_kind} backend={backend} "
-          f"iters={ITERS} baseline={base_label}", file=sys.stderr)
+    try:
+        sweep, base_label, backend = _ec_sweep()
+        head = sweep[str(1 << 20)]
+        out = {
+            "metric": "ec_encode_k8m3_1MiB_GBps",
+            "value": head["encode_GBps"],
+            "unit": "GB/s",
+            "vs_baseline": head["encode_vs_baseline"],
+            "baseline": base_label,
+            "backend": backend,
+            "sweep": sweep,
+        }
+    except Exception as e:      # still emit a line the driver can log
+        out = {"metric": "ec_encode_k8m3_1MiB_GBps", "value": 0,
+               "unit": "GB/s", "vs_baseline": 0,
+               "error": str(e)[:300]}
+    out["crush"] = _crush_leg()
+    print(json.dumps(out))
+    try:
+        dev = jax.devices()[0].device_kind
+    except Exception:
+        dev = "unknown"
+    print(f"# device={dev} backend={out.get('backend')} iters={ITERS} "
+          f"baseline={out.get('baseline')}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -37,6 +37,9 @@ def build_options() -> list[Option]:
         Option("osd_pool_default_pg_num", int, 32, "default pg count"),
         Option("osd_max_write_size", int, 90 << 20,
                "largest single write (bytes)"),
+        Option("osd_max_pg_log_entries", int, 500,
+               "trim the PG log beyond this many entries (a peer "
+               "whose gap exceeds the log is backfilled)"),
         Option("osd_op_queue", str, "wpq", "op scheduler",
                enum_allowed=("wpq", "mclock")),
         Option("osd_recovery_max_active", int, 3,

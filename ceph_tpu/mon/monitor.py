@@ -293,6 +293,44 @@ class OSDMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, f"pool '{name}' created", None
+        if prefix == "osd pool mksnap":
+            # pool snapshots (reference OSDMonitor pool mksnap):
+            # bump snap_seq, record the name; clients pick the new
+            # SnapContext up from the map and OSDs clone-on-write
+            name = cmd["pool"]
+            if name not in self.osdmap.pool_name:
+                return -2, f"pool '{name}' does not exist", None
+            m = self._working()
+            pool = m.pools[m.pool_name[name]]
+            if pool.is_erasure():
+                # the EC backend has no clone-on-write path (the
+                # reference gates EC pool snaps behind overwrite
+                # support similarly)
+                return -95, "pool snapshots are not supported on " \
+                    "erasure-coded pools", None
+            if cmd["snap"] in pool.snaps.values():
+                return -17, f"snapshot {cmd['snap']!r} exists", None
+            pool.snap_seq += 1
+            pool.snaps[pool.snap_seq] = cmd["snap"]
+            pool.last_change = m.epoch + 1
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"created pool {name} snap {cmd['snap']}", None
+        if prefix == "osd pool rmsnap":
+            name = cmd["pool"]
+            if name not in self.osdmap.pool_name:
+                return -2, f"pool '{name}' does not exist", None
+            m = self._working()
+            pool = m.pools[m.pool_name[name]]
+            sid = next((i for i, n in pool.snaps.items()
+                        if n == cmd["snap"]), None)
+            if sid is None:
+                return -2, f"no snapshot {cmd['snap']!r}", None
+            del pool.snaps[sid]
+            pool.last_change = m.epoch + 1
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"removed pool {name} snap {cmd['snap']}", None
         if prefix == "osd pool delete":
             name = cmd["pool"]
             if name not in self.osdmap.pool_name:

@@ -39,6 +39,33 @@ from ..tools.osdmaptool import osdmap_from_dict
 from . import messages as M
 from .osdmap import OSDMap, PGid
 from .pg import PG, ECBackend, ReplicatedBackend, _WRITE_OPS
+from .scheduler import (CLIENT, PEERING, RECOVERY, SCRUB, SUBOP,
+                        WeightedPriorityQueue)
+
+
+# message type → scheduler class (reference op_scheduler_class
+# assignment in OSD::enqueue_op).  NB: MOSDPGBackfillPrune rides the
+# SUBOP class so it stays FIFO with the live rep-ops whose objects it
+# must never prune.
+_SCHED_CLASS = {
+    M.MOSDOp: CLIENT,
+    M.MWatchNotifyAck: CLIENT,
+    M.MOSDRepOp: SUBOP,
+    M.MOSDRepOpReply: SUBOP,
+    M.MOSDECSubOpWrite: SUBOP,
+    M.MOSDECSubOpWriteReply: SUBOP,
+    M.MOSDECSubOpRead: SUBOP,
+    M.MOSDECSubOpReadReply: SUBOP,
+    M.MOSDPGBackfillPrune: SUBOP,
+    M.MOSDPGQuery: PEERING,
+    M.MOSDPGNotify: PEERING,
+    M.MOSDPGLog: PEERING,
+    M.MOSDPGPush: RECOVERY,
+    M.MOSDPGPushReply: RECOVERY,
+    M.MOSDPGPull: RECOVERY,
+    M.MOSDRepScrub: SCRUB,
+    M.MOSDRepScrubMap: SCRUB,
+}
 
 
 def _build_osd_perf(name: str):
@@ -108,6 +135,15 @@ class OSDaemon(Dispatcher):
         self._stats_last = 0.0
         self.timer = SafeTimer(f"osd.{whoami}-tick")
         self._tick_token = None
+        # the op scheduler (reference ShardedOpWQ + WPQ): dispatch
+        # classifies work, one worker drains by weighted priority so
+        # recovery/scrub storms can't bury client I/O (heartbeats
+        # bypass the queue entirely — their latency IS the failure
+        # detector)
+        self.op_queue = WeightedPriorityQueue()
+        self._op_worker = threading.Thread(
+            target=self._op_worker_loop, name=f"osd.{whoami}-opwq",
+            daemon=True)
 
     def _register_admin_commands(self):
         """Live-introspection surface (reference AdminSocket hooks:
@@ -144,6 +180,8 @@ class OSDaemon(Dispatcher):
         self.admin_socket.start()
         self.addr = self.msgr.bind()
         self.running = True
+        if not self._op_worker.is_alive():
+            self._op_worker.start()
         self.monc.on_osdmap = self._on_osdmap
         # subscribe from epoch 1: the full history replay rebuilds
         # pg_intervals (a revived OSD starts a fresh daemon object)
@@ -161,8 +199,35 @@ class OSDaemon(Dispatcher):
         self._tick_token = self.timer.add_event_after(
             self._hb_interval, self._tick)
 
+    def _op_worker_loop(self):
+        while True:
+            got = self.op_queue.dequeue(timeout=1.0)
+            if got is None:
+                if not self.running:
+                    return
+                continue
+            _klass, msg = got
+            try:
+                self._route(msg)
+            except Exception:       # noqa: BLE001 — a poisoned op
+                # must not kill the op thread; fail the op visibly
+                # instead of leaving the client to time out
+                tracked = getattr(msg, "tracked", None)
+                if tracked is not None:
+                    tracked.finish()
+                con = getattr(msg, "connection", None)
+                if isinstance(msg, M.MOSDOp) and con is not None:
+                    try:
+                        con.send_message(M.MOSDOpReply(
+                            tid=msg.tid, rc=-5, outs="op faulted",
+                            results=None, version=[0, 0],
+                            epoch=self.osdmap.epoch))
+                    except ConnectionError:
+                        pass
+
     def shutdown(self):
         self.running = False
+        self.op_queue.close()
         self.timer.shutdown()
         self.admin_socket.shutdown()
         self.monc.shutdown()
@@ -210,6 +275,21 @@ class OSDaemon(Dispatcher):
                 # OSD::_committed_osd_maps → start_boot)
                 self._send_boot()
             self._scan_pgs(placements)
+            # pool snapshot deletions drive clone trimming (reference
+            # snap trim queue fed by OSDMap snap removals)
+            for pid, pool in self.osdmap.pools.items():
+                prevpool = prev.pools.get(pid)
+                if prevpool is None:
+                    continue
+                removed = set(prevpool.snaps) - set(pool.snaps)
+                if not removed:
+                    continue
+                for pgid, pg in self.pgs.items():
+                    if pgid.pool == pid and \
+                            self.whoami in pg.acting:
+                        fn = getattr(pg.backend, "snap_trim", None)
+                        if fn is not None:
+                            fn(removed)
 
     def _update_pg_intervals(self):
         """Track acting-set intervals for every PG of every pool at
@@ -285,6 +365,9 @@ class OSDaemon(Dispatcher):
                         pg.shard = acting.index(self.whoami)
                     pg.load_from_store()
                     pg.create_onstore()
+                    fn = getattr(pg.backend, "snap_trim", None)
+                    if fn is not None:
+                        fn(None)    # reconcile missed snap removals
                 pg.pool = m.pools[pool.id]
                 pg.advance_map(up, upp, acting, actingp, m.epoch)
         self.perf.set("numpg", len(self.pgs))
@@ -393,7 +476,15 @@ class OSDaemon(Dispatcher):
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:
-        return self._route(msg)
+        # heartbeats answer inline on the messenger thread; everything
+        # else is classified into the weighted op queue
+        if isinstance(msg, M.MOSDPing):
+            return self._route(msg)
+        klass = _SCHED_CLASS.get(type(msg))
+        if klass is None:
+            return False
+        self.op_queue.enqueue(klass, msg)
+        return True
 
     def _route(self, msg) -> bool:
         with self.lock:
@@ -435,6 +526,10 @@ class OSDaemon(Dispatcher):
                 M.MOSDRepScrub: lambda pg: pg.handle_rep_scrub(msg),
                 M.MOSDRepScrubMap:
                     lambda pg: pg.handle_scrub_map(msg),
+                M.MWatchNotifyAck:
+                    lambda pg: pg.handle_notify_ack(msg),
+                M.MOSDPGBackfillPrune:
+                    lambda pg: pg.handle_backfill_prune(msg),
             }
             fn = handlers.get(type(msg))
             if fn is None:
@@ -536,4 +631,6 @@ class OSDaemon(Dispatcher):
             for o, (_a, c) in list(self._peer_cons.items()):
                 if c is con:
                     del self._peer_cons[o]
+            for pg in self.pgs.values():
+                pg.con_reset(con)
 

@@ -23,9 +23,13 @@ from ..msg.message import register_message
 
 @register_message
 class MOSDOp(_JsonMessage):
-    """Client → primary: one object op batch (reference MOSDOp)."""
+    """Client → primary: one object op batch (reference MOSDOp).
+    ``snapc``: the writer's SnapContext {"seq", "snaps"} from the pool
+    (reference SnapContext riding every write); read ops may carry a
+    per-op "snapid" for snapshot reads."""
     TYPE = 40
-    FIELDS = ("tid", "client", "pgid", "oid", "epoch", "ops", "flags")
+    FIELDS = ("tid", "client", "pgid", "oid", "epoch", "ops", "flags",
+              "snapc")
 
 
 @register_message
@@ -148,3 +152,27 @@ class MOSDRepScrubMap(_JsonMessage):
     TYPE = 56
     FIELDS = ("pgid", "epoch", "scrub_tid", "shard", "objects",
               "from_osd")
+
+
+@register_message
+class MWatchNotify(_JsonMessage):
+    """Primary → watching client: a notify fired on an object you
+    watch (reference ``src/messages/MWatchNotify.h``)."""
+    TYPE = 57
+    FIELDS = ("oid", "pgid", "notify_id", "watch_id", "data")
+
+
+@register_message
+class MWatchNotifyAck(_JsonMessage):
+    """Watching client → primary: notify delivered+handled."""
+    TYPE = 58
+    FIELDS = ("oid", "pgid", "notify_id", "watch_id", "reply")
+
+
+@register_message
+class MOSDPGBackfillPrune(_JsonMessage):
+    """Primary → backfill target: the authoritative object list; the
+    target removes anything extraneous (reference backfill's
+    remove-extraneous pass during the scan)."""
+    TYPE = 59
+    FIELDS = ("pgid", "epoch", "keep", "from_osd")

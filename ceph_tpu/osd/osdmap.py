@@ -80,6 +80,10 @@ class PGPool:
     flags: int = FLAG_HASHPSPOOL
     erasure_code_profile: str = ""
     last_change: int = 0             # epoch of last modification
+    # pool snapshots (reference pg_pool_t::snap_seq/snaps): clients
+    # stamp writes with the pool SnapContext; OSDs clone-on-write
+    snap_seq: int = 0
+    snaps: dict = field(default_factory=dict)   # id → name
 
     def __post_init__(self):
         if self.pgp_num == 0:

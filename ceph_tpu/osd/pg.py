@@ -50,6 +50,16 @@ from . import messages as M
 from .types import (DELETE, LogEntry, MODIFY, PGInfo, PGLog, ZERO)
 
 META_OID = "_meta"          # per-PG meta object (info+log in omap)
+SNAPMAP_OID = "_snapmapper"  # snap id → clone index (reference SnapMapper)
+_SNAP_SEP = "\x00snap\x00"   # head oid + sep + seq = clone object name
+
+
+def snap_clone_oid(oid: str, seq: int) -> str:
+    return f"{oid}{_SNAP_SEP}{seq}"
+
+
+def is_snap_clone(name: str) -> bool:
+    return _SNAP_SEP in name
 
 
 def _obj_meta(version, size: int, hinfo: int | None = None) -> bytes:
@@ -100,6 +110,16 @@ class PG:
         self._scrub_waiting: set[int] = set()
         self._pulls: dict[int, str] = {}       # pull_tid → oid
         self._pull_tid = 0
+        # backfill (reference PrimaryLogPG backfill scan): peers whose
+        # gap exceeds the log are refilled by walking the collection
+        # in batches behind a cursor, not one giant synchronous push
+        self.backfill_targets: dict[int, dict] = {}
+        # watch/notify (reference src/osd/Watch.h): primary-resident
+        # sessions oid → {watch_id: connection}; notifies pend until
+        # every watcher acks (or the timeout fires)
+        self.watchers: dict[str, dict[str, object]] = {}
+        self._notifies: dict[int, dict] = {}
+        self._notify_id = 0
         self.backend = (ECBackend(self) if pool.is_erasure()
                         else ReplicatedBackend(self))
 
@@ -175,6 +195,8 @@ class PG:
             self.peer_missing.clear()
             self._queried.clear()
             self._pulls.clear()     # re-pull in the new interval
+            self.backfill_targets.clear()   # re-scan, pushes are
+                                            # version-guarded anyway
             if self.is_primary:
                 self._start_peering()
         elif self.daemon.whoami == self.primary and \
@@ -356,18 +378,19 @@ class PG:
             pi = self.peer_info.get(o)
             plu = pi.last_update if pi else ZERO
             if plu < self.log.tail:
-                # journal no longer covers the peer: backfill — push
-                # everything we have (small-scale stand-in for the
-                # reference's backfill scan); versions read from OUR
-                # shard's object meta
+                # journal no longer covers the peer: backfill — walk
+                # the collection behind a cursor in bounded batches
+                # (reference backfill scan in PrimaryLogPG); pushes
+                # racing live writes are version-guarded on apply
                 pm: dict[str, tuple | None] = {}
-                for oid in self._list_objects():
-                    try:
-                        meta = json.loads(bytes(self.daemon.store.getattr(
-                            self.cid, oid, "_")))
-                        pm[oid] = tuple(meta["version"])
-                    except KeyError:
-                        pm[oid] = self.info.last_update
+                # objs=None: the scan initializes lazily in
+                # _kick_backfill, AFTER the primary has recovered its
+                # own missing objects — a snapshot taken now would
+                # omit them and the prune would delete the target's
+                # only copies
+                self.backfill_targets[o] = {"cursor": "",
+                                            "pending": set(),
+                                            "objs": None}
             else:
                 pm = self.log.missing_for(plu)
             self.peer_missing[o] = pm
@@ -386,12 +409,16 @@ class PG:
             fn()
         self._kick_recovery()
 
-    def _list_objects(self) -> list[str]:
+    def _list_objects(self, include_snaps: bool = False) -> list[str]:
+        """Head objects by default; include_snaps adds clone objects
+        (backfill/scrub want them — pgls and clients never do)."""
         try:
             objs = self.daemon.store.list_objects(self.cid)
         except KeyError:
             return []
-        return [o for o in objs if o != META_OID]
+        return [o for o in objs
+                if o not in (META_OID, SNAPMAP_OID)
+                and (include_snaps or not is_snap_clone(o))]
 
     # =======================================================================
     # recovery (log-based push/pull; EC reconstructs chunks)
@@ -427,10 +454,92 @@ class PG:
                 if oid in self.missing:
                     continue       # recover locally first
                 self.backend.push_object(o, oid, ver)
+        self._kick_backfill()
         self._maybe_clean()
+
+    BACKFILL_BATCH = 8
+
+    def _object_version_onstore(self, oid: str) -> tuple:
+        try:
+            meta = json.loads(bytes(self.daemon.store.getattr(
+                self.cid, oid, "_")))
+            return tuple(meta.get("version", ZERO))
+        except KeyError:
+            return self.info.last_update
+
+    def backfill_gate(self, peer: int, oid: str,
+                      is_delete: bool = False) -> bool:
+        """True → send the live write to this peer now.  Objects the
+        peer hasn't been backfilled yet must NOT receive partial
+        mutations (they'd build on a base the peer lacks; the later
+        full push would then be rejected as stale) — the backfill scan
+        delivers their current state instead (reference: writes gated
+        by the target's last_backfill).  Deletes always flow (removing
+        a never-backfilled object is harmlessly idempotent and keeps
+        pre-downtime copies from resurfacing)."""
+        st = self.backfill_targets.get(peer)
+        if st is None or is_delete:
+            return True
+        if st["objs"] is None:
+            return False        # scan not started: snapshot will cover
+        if oid <= st["cursor"]:
+            return True         # already backfilled: live writes apply
+        import bisect
+        i = bisect.bisect_left(st["objs"], oid)
+        if i >= len(st["objs"]) or st["objs"][i] != oid:
+            st["objs"].insert(i, oid)   # new object: scan must visit
+        return False
+
+    def _kick_backfill(self):
+        """Advance each backfill target by one bounded batch once its
+        previous batch fully acked (reference backfill with
+        osd_max_backfills-style pacing, single-queue here).  The scan
+        walks the object-list snapshot taken at registration — objects
+        created afterwards flow through live replication, deleted ones
+        are skipped (the push would find nothing to read)."""
+        import bisect
+        for o, st in list(self.backfill_targets.items()):
+            if st["pending"]:
+                continue
+            if st["objs"] is None:
+                if self.missing:
+                    continue    # wait until the primary is whole
+                objs = self._list_objects(include_snaps=True)
+                if self.daemon.store.exists(self.cid, SNAPMAP_OID):
+                    # the snap index must travel too, or the target
+                    # can never trim its backfilled clones
+                    objs.append(SNAPMAP_OID)
+                st["objs"] = sorted(objs)
+                # the target may hold objects deleted on the primary
+                # while it was gone and no longer in the log: hand it
+                # the authoritative list to prune against (reference
+                # backfill removes extraneous objects on the target)
+                self.daemon.send_to_osd(o, M.MOSDPGBackfillPrune(
+                    pgid=str(self.pgid),
+                    epoch=self.daemon.osdmap.epoch,
+                    keep=st["objs"], from_osd=self.daemon.whoami))
+            objs = st["objs"]
+            lo = bisect.bisect_right(objs, st["cursor"])
+            batch = []
+            while lo < len(objs) and len(batch) < self.BACKFILL_BATCH:
+                oid = objs[lo]
+                st["cursor"] = oid
+                lo += 1
+                if self.daemon.store.exists(self.cid, oid):
+                    batch.append(oid)
+            if not batch:
+                if lo >= len(objs):
+                    del self.backfill_targets[o]
+                    self._maybe_clean()
+                continue
+            for oid in batch:
+                st["pending"].add(oid)
+                self.backend.push_object(
+                    o, oid, self._object_version_onstore(oid))
 
     def _maybe_clean(self):
         if self.state == "active" and not self.missing and \
+                self.backfill_targets == {} and \
                 not any(self.peer_missing.values()):
             self.info.last_complete = self.info.last_update
             self.state = "active+clean"
@@ -457,6 +566,11 @@ class PG:
         pm = self.peer_missing.get(msg.from_osd)
         if pm is not None:
             pm.pop(msg.oid, None)
+        bf = self.backfill_targets.get(msg.from_osd)
+        if bf is not None:
+            bf["pending"].discard(msg.oid)
+            if not bf["pending"]:
+                self._kick_backfill()
         self._object_recovered(msg.oid)
         self._maybe_clean()
 
@@ -482,7 +596,13 @@ class PG:
         reqid = f"{msg.client}:{msg.tid}"
         dup = self.log.find_reqid(reqid)
         if dup is not None and any(
-                op.get("op") in _WRITE_OPS for op in msg.ops):
+                op.get("op") in _WRITE_OPS or op.get("op") == "call"
+                for op in msg.ops):
+            # 'call' methods may mutate, so their resends must dedup
+            # too (the dup reply can't reproduce a read-only call's
+            # output — the reference stores per-dup result codes; a
+            # client that truly lost a read-only reply simply retries
+            # with a fresh tid)
             self._reply(msg, 0, "", results=[{}] * len(msg.ops),
                         version=dup.version)
             return
@@ -491,6 +611,21 @@ class PG:
             self.wait_for_object(oid, lambda: self.do_op(msg))
             self._kick_recovery()
             return
+        watchish = [op.get("op") in ("watch", "unwatch", "notify")
+                    for op in msg.ops]
+        if any(watchish):
+            if not all(watchish):
+                # a mixed batch would silently drop the data ops
+                self._reply(msg, -22,
+                            "watch/notify ops cannot batch with "
+                            "data ops")
+                return
+            self._do_watch_ops(msg)
+            return
+        if any(op.get("op") == "call" for op in msg.ops):
+            msg = self._expand_class_calls(msg)
+            if msg is None:
+                return      # class method failed; error already sent
         is_write = any(op.get("op") in _WRITE_OPS for op in msg.ops)
         if is_write and self.scrubbing:
             # writes quiesce during scrub (reference blocks the scrub
@@ -512,6 +647,12 @@ class PG:
 
     def _reply(self, msg: M.MOSDOp, rc: int, outs: str = "",
                results=None, version=ZERO):
+        call_results = getattr(msg, "_call_results", None)
+        if call_results and results is not None:
+            results = list(results)
+            for idx, res in call_results.items():
+                if idx < len(results):
+                    results[idx] = res
         tracked = getattr(msg, "tracked", None)
         if tracked is not None:
             msg.tracked = None
@@ -525,13 +666,177 @@ class PG:
         except (ConnectionError, AttributeError):
             pass
 
+    # =======================================================================
+    # object classes (reference ClassHandler + src/cls/)
+    # =======================================================================
+    def _expand_class_calls(self, msg: M.MOSDOp):
+        """Run `call` ops on the primary: the method reads the current
+        object and stages standard mutations that replace the call in
+        the op list — durability then rides the normal replication
+        path (reference: cls methods execute inside do_osd_ops and
+        their writes join the op's transaction)."""
+        from ..cls import ClsContext, ClsError, call as cls_call
+        store, cid, oid = self.daemon.store, self.cid, msg.oid
+
+        def read_xattr(name):
+            try:
+                return store.getattr(cid, oid, name)
+            except KeyError:
+                return None
+
+        def exists():
+            return store.exists(cid, oid)
+
+        new_ops = []
+        call_results = {}
+        for i, op in enumerate(msg.ops):
+            if op.get("op") != "call":
+                new_ops.append(op)
+                continue
+            ctx = ClsContext(read_xattr, exists)
+            try:
+                out = cls_call(op["cls"], op["method"], ctx,
+                               bytes.fromhex(op.get("data", "")))
+            except ClsError as e:
+                self._reply(msg, e.rc, str(e))
+                return None
+            call_results[len(new_ops)] = {"data": out.hex()}
+            if ctx.staged_ops:
+                new_ops.extend(ctx.staged_ops)
+            else:
+                # read-only method: keep a no-op placeholder so the
+                # result stays aligned with an op slot
+                new_ops.append({"op": "cls_noop"})
+        expanded = M.MOSDOp(tid=msg.tid, client=msg.client,
+                            pgid=msg.pgid, oid=oid, epoch=msg.epoch,
+                            ops=new_ops, flags=msg.flags,
+                            snapc=getattr(msg, "snapc", None))
+        expanded.connection = msg.connection
+        expanded.tracked = getattr(msg, "tracked", None)
+        expanded._call_results = call_results
+        return expanded
+
+    # =======================================================================
+    # watch / notify (reference src/osd/Watch.{h,cc} + Notify)
+    # =======================================================================
+    def _do_watch_ops(self, msg: M.MOSDOp):
+        results = []
+        for op in msg.ops:
+            kind = op.get("op")
+            if kind == "watch":
+                wid = f"{msg.client}:{op.get('watch_id', 0)}"
+                self.watchers.setdefault(msg.oid, {})[wid] = \
+                    msg.connection
+                results.append({"watch_id": wid})
+            elif kind == "unwatch":
+                wid = f"{msg.client}:{op.get('watch_id', 0)}"
+                ws = self.watchers.get(msg.oid, {})
+                ws.pop(wid, None)
+                results.append({})
+            elif kind == "notify":
+                self._start_notify(msg, op)
+                return          # replies when acks (or timeout) land
+            else:
+                results.append({})
+        self._reply(msg, 0, "", results=results)
+
+    def _start_notify(self, msg: M.MOSDOp, op: dict):
+        self._notify_id += 1
+        nid = self._notify_id
+        targets = dict(self.watchers.get(msg.oid, {}))
+        st = {"msg": msg, "waiting": set(targets), "replies": {},
+              "done": False}
+        self._notifies[nid] = st
+        for wid, con in targets.items():
+            try:
+                con.send_message(M.MWatchNotify(
+                    oid=msg.oid, pgid=str(self.pgid), notify_id=nid,
+                    watch_id=wid, data=op.get("data", "")))
+            except (ConnectionError, AttributeError):
+                st["waiting"].discard(wid)
+        timeout = float(op.get("timeout", 10.0))
+        self.daemon.timer.add_event_after(
+            timeout, lambda: self._finish_notify(nid, timed_out=True))
+        self._maybe_finish_notify(nid)
+
+    def handle_notify_ack(self, msg: M.MWatchNotifyAck):
+        st = self._notifies.get(msg.notify_id)
+        if st is None:
+            return
+        st["waiting"].discard(msg.watch_id)
+        st["replies"][msg.watch_id] = msg.reply
+        self._maybe_finish_notify(msg.notify_id)
+
+    def _maybe_finish_notify(self, nid: int):
+        st = self._notifies.get(nid)
+        if st is not None and not st["waiting"]:
+            self._finish_notify(nid)
+
+    def _finish_notify(self, nid: int, timed_out: bool = False):
+        st = self._notifies.pop(nid, None)
+        if st is None or st["done"]:
+            return
+        st["done"] = True
+        self._reply(st["msg"], 0, "", results=[{
+            "notify_id": nid, "replies": st["replies"],
+            "timed_out_watchers": sorted(st["waiting"])}])
+
+    def handle_backfill_prune(self, msg):
+        """Backfill target: delete objects the primary no longer has
+        (they were removed while we were down and have fallen out of
+        the log).  Version-epoch guard: an object written at or after
+        the prune's epoch is NEVER extraneous — a stale prune from a
+        deposed primary (reordered behind a newer primary's writes)
+        must not delete fresh data."""
+        keep = set(msg.keep or ())
+        store, cid = self.daemon.store, self.cid
+        for oid in self._list_objects(include_snaps=True):
+            if oid in keep:
+                continue
+            try:
+                meta = json.loads(bytes(store.getattr(cid, oid, "_")))
+                ver_epoch = int(meta.get("version", ZERO)[0])
+            except KeyError:
+                ver_epoch = 0
+            if ver_epoch >= (msg.epoch or 0):
+                continue
+            store.queue_transaction(Transaction().remove(cid, oid))
+
+    def con_reset(self, con):
+        """A client connection died: its watches evaporate and any
+        notify still waiting on it completes without it (reference
+        watch timeout/disconnect handling)."""
+        dead_wids = set()
+        for oid, ws in list(self.watchers.items()):
+            for wid, c in list(ws.items()):
+                if c is con:
+                    del ws[wid]
+                    dead_wids.add(wid)
+            if not ws:
+                self.watchers.pop(oid, None)
+        for nid in list(self._notifies):
+            st = self._notifies.get(nid)
+            if st and st["waiting"] & dead_wids:
+                st["waiting"] -= dead_wids
+                self._maybe_finish_notify(nid)
+
     def append_log_entry(self, entry: LogEntry, txn: Transaction):
         """Stamp a mutation into the journal + meta, atomically with
         the data write (the reference writes log and data in one
         ObjectStore transaction)."""
         self.log.add(entry)
         self.info.last_update = entry.version
+        self._maybe_trim_log()
         self._persist_meta(txn)
+
+    def _maybe_trim_log(self):
+        """Bound the journal (reference PGLog::trim via
+        osd_min/max_pg_log_entries): every member sees the identical
+        entry sequence, so local trimming converges to the same tail
+        cluster-wide; peers that fall behind the tail get backfill."""
+        limit = self.daemon.config.get("osd_max_pg_log_entries")
+        if len(self.log.entries) > limit:
+            self.log.trim(self.log.entries[-limit - 1].version)
 
     # =======================================================================
     # scrub (reference src/osd/scrubber/: primary gathers a ScrubMap
@@ -613,6 +918,23 @@ class PG:
 
 _WRITE_OPS = {"write", "write_full", "append", "delete", "truncate",
               "setxattr", "rmxattr", "omap_set", "omap_rm"}
+_NOOP_OPS = {"cls_noop"}
+
+
+def _push_is_stale(store, cid: str, msg) -> bool:
+    """A backfill/recovery push racing live writes must never regress
+    an object: skip apply when the local copy is already at or past
+    the pushed version (the reply still flows so the primary's
+    cursor advances)."""
+    try:
+        meta = json.loads(bytes(store.getattr(cid, msg.oid, "_")))
+        local = tuple(meta.get("version", ZERO))
+    except KeyError:
+        return False
+    # STRICTLY newer only: an equal-version push is either an
+    # idempotent re-push or a scrub repair overwriting corrupt bytes
+    # whose version never changed — both must apply
+    return local > tuple(msg.version or ZERO)
 
 
 # ===========================================================================
@@ -634,13 +956,18 @@ class ReplicatedBackend:
         cid, oid = pg.cid, msg.oid
         version = pg.next_version()
         prior = self._object_version(oid)
+        snap_txn = self._maybe_clone_for_snap(cid, oid, msg)
         txn, results, delete = self._prepare_txn(cid, oid, msg.ops,
                                                  version)
+        if snap_txn is not None:
+            snap_txn.append(txn)
+            txn = snap_txn
         entry = LogEntry(op=DELETE if delete else MODIFY, oid=oid,
                          version=version, prior_version=prior,
                          reqid=reqid, mtime=time.time())
         pg.append_log_entry(entry, txn)
-        peers = pg._peer_osds()
+        peers = [o for o in pg._peer_osds()
+                 if pg.backfill_gate(o, oid, is_delete=delete)]
         state = {"waiting": set(peers), "msg": msg, "version": version,
                  "results": results}
         self._inflight[reqid] = state
@@ -664,6 +991,81 @@ class ReplicatedBackend:
         except KeyError:
             return ZERO
 
+    # -- pool snapshots (reference PrimaryLogPG make_writeable +
+    # SnapMapper: clone the head before the first write past each
+    # snap; the clone txn replicates with the write so every acting
+    # member holds identical clones) --------------------------------------
+    def _maybe_clone_for_snap(self, cid, oid, msg) -> Transaction | None:
+        snapc = getattr(msg, "snapc", None)
+        if not snapc:
+            return None
+        seq = int(snapc.get("seq", 0))
+        store = self.pg.daemon.store
+        if not store.exists(cid, oid):
+            # creation after the snaps: stamp when the object appeared
+            # (snapshot reads older than that report ENOENT) AND set
+            # its snap baseline — clones made later must never claim
+            # to cover snaps that predate the object
+            t = Transaction()
+            t.touch(cid, oid)
+            t.setattrs(cid, oid,
+                       {"created_seq": str(seq).encode(),
+                        "snap_seq": str(seq).encode()})
+            return t
+        try:
+            last = int(bytes(store.getattr(cid, oid, "snap_seq")))
+        except KeyError:
+            last = 0
+        if last >= seq:
+            return None
+        covered = sorted(s for s in (snapc.get("snaps") or ())
+                         if s > last)
+        t = Transaction()
+        if covered:
+            clone = snap_clone_oid(oid, seq)
+            t.clone(cid, oid, clone)
+            t.setattrs(cid, clone, {
+                "snaps": json.dumps(covered).encode()})
+            t.omap_setkeys(cid, SNAPMAP_OID, {
+                f"{s:010d}|{oid}|{seq}": clone.encode()
+                for s in covered})
+        t.setattrs(cid, oid, {"snap_seq": str(seq).encode()})
+        return t
+
+    def _resolve_snap_read(self, oid: str, snapid: int) -> str | None:
+        """Which object holds `oid` as of snapshot `snapid`: the
+        OLDEST clone whose seq >= snapid, else the head if it has not
+        been cloned past snapid (and existed by then), else nothing
+        (reference SnapSet clone resolution)."""
+        pg = self.pg
+        store, cid = pg.daemon.store, pg.cid
+        prefix = f"{oid}{_SNAP_SEP}"
+        seqs = sorted(int(o[len(prefix):])
+                      for o in pg._list_objects(include_snaps=True)
+                      if o.startswith(prefix))
+        for cseq in seqs:
+            clone = snap_clone_oid(oid, cseq)
+            try:
+                covered = json.loads(bytes(
+                    store.getattr(cid, clone, "snaps")))
+            except KeyError:
+                covered = []
+            if snapid in covered:
+                return clone
+        if not store.exists(cid, oid):
+            return None
+        try:
+            created = int(bytes(store.getattr(cid, oid,
+                                              "created_seq")))
+            if created >= snapid:
+                return None     # didn't exist at snapshot time
+        except KeyError:
+            pass
+        # no clone >= snapid and the object predates the snapshot:
+        # the head is unchanged since then (any later write would
+        # have left a clone covering snapid)
+        return oid
+
     def _prepare_txn(self, cid, oid, ops, version):
         """The per-opcode switch (reference do_osd_ops) for mutations."""
         store = self.pg.daemon.store
@@ -677,7 +1079,9 @@ class ReplicatedBackend:
             pass
         for op in ops:
             kind = op.get("op")
-            if kind == "write":
+            if kind in _NOOP_OPS:
+                results.append({})
+            elif kind == "write":
                 data = bytes.fromhex(op["data"])
                 off = int(op.get("off", 0))
                 txn.write(cid, oid, off, data)
@@ -750,6 +1154,7 @@ class ReplicatedBackend:
             if e.version > pg.log.head:
                 pg.log.add(e)
                 pg.info.last_update = e.version
+        pg._maybe_trim_log()
         pg._persist_meta(txn)
         daemon.store.queue_transaction(txn)
         daemon.send_to_osd(pg.primary, M.MOSDRepOpReply(
@@ -763,13 +1168,21 @@ class ReplicatedBackend:
         results = []
         for op in msg.ops:
             kind = op.get("op")
-            if kind == "read":
+            src = oid
+            if op.get("snapid"):
+                # snapshot read: resolve through the clone chain
+                src = self._resolve_snap_read(oid, int(op["snapid"]))
+                if src is None:
+                    raise KeyError(oid)     # ENOENT at that snapshot
+            if kind in _NOOP_OPS:
+                results.append({})
+            elif kind == "read":
                 length = op.get("len")
-                data = store.read(cid, oid, int(op.get("off", 0)),
+                data = store.read(cid, src, int(op.get("off", 0)),
                                   None if length is None else int(length))
                 results.append({"data": data.hex()})
             elif kind == "stat":
-                results.append({"size": store.stat(cid, oid)["size"],
+                results.append({"size": store.stat(cid, src)["size"],
                                 "version": self._object_version(oid)})
             elif kind == "getxattr":
                 results.append(
@@ -795,7 +1208,7 @@ class ReplicatedBackend:
         pg = self.pg
         store, cid = pg.daemon.store, pg.cid
         out = {}
-        for oid in pg._list_objects():
+        for oid in pg._list_objects(include_snaps=True):
             try:
                 data = store.read(cid, oid)
                 meta = json.loads(bytes(store.getattr(cid, oid, "_")))
@@ -852,6 +1265,49 @@ class ReplicatedBackend:
                     pg.peer_missing.setdefault(osd, {})[oid] = ver
         return errors
 
+    def snap_trim(self, removed: set[int] | None):
+        """Deleted pool snaps release their clones (reference
+        SnapMapper-driven snap trim): each clone's covered-snaps set
+        shrinks; empty → the clone object is removed.  Runs on every
+        acting member (clones are replicated, so is the trim).
+        removed=None reconciles against the pool's current snap set —
+        the catch-up path for an OSD that missed rmsnap epochs."""
+        pg = self.pg
+        store, cid = pg.daemon.store, pg.cid
+        try:
+            index = store.omap_get(cid, SNAPMAP_OID)
+        except KeyError:
+            return
+        if removed is None:
+            live = set(pg.pool.snaps)
+            removed = {int(k.split("|", 1)[0]) for k in index} - live
+            if not removed:
+                return
+        t = Transaction()
+        dead_keys = []
+        clones: dict[str, None] = {}
+        for key in index:
+            sid = int(key.split("|", 1)[0])
+            if sid in removed:
+                dead_keys.append(key)
+                clones[bytes(index[key]).decode()] = None
+        for clone in clones:
+            try:
+                covered = set(json.loads(bytes(
+                    store.getattr(cid, clone, "snaps"))))
+            except KeyError:
+                continue
+            covered -= removed
+            if covered:
+                t.setattrs(cid, clone, {
+                    "snaps": json.dumps(sorted(covered)).encode()})
+            else:
+                t.remove(cid, clone)
+        if dead_keys:
+            t.omap_rmkeys(cid, SNAPMAP_OID, dead_keys)
+        if not t.empty():
+            store.queue_transaction(t)
+
     # -- recovery ----------------------------------------------------------
     def push_object(self, peer: int, oid: str, version: tuple):
         pg, daemon = self.pg, self.pg.daemon
@@ -905,6 +1361,8 @@ class ReplicatedBackend:
     def apply_push(self, msg: M.MOSDPGPush):
         pg, daemon = self.pg, self.pg.daemon
         cid = pg.cid
+        if _push_is_stale(daemon.store, cid, msg):
+            return      # a live write already superseded this push
         t = Transaction()
         if not daemon.store.collection_exists(cid):
             t.create_collection(cid)
@@ -1019,7 +1477,9 @@ class ECBackend:
         results = []
         for op in msg.ops:
             kind = op.get("op")
-            if kind == "write_full":
+            if kind in _NOOP_OPS:
+                results.append({})
+            elif kind == "write_full":
                 cur = bytes.fromhex(op["data"])
                 data = cur
                 results.append({})
@@ -1066,6 +1526,9 @@ class ECBackend:
         live = []
         for s, o in enumerate(pg.acting):
             if o == CRUSH_ITEM_NONE or not daemon.osdmap.is_up(o):
+                continue
+            if o != daemon.whoami and \
+                    not pg.backfill_gate(o, oid, is_delete=delete):
                 continue
             live.append((s, o))
         state = {"waiting": {s for s, _ in live}, "msg": msg,
@@ -1133,6 +1596,7 @@ class ECBackend:
             if e.version > pg.log.head:
                 pg.log.add(e)
                 pg.info.last_update = e.version
+        pg._maybe_trim_log()
         pg._persist_meta(txn)
         pg.daemon.store.queue_transaction(txn)
 
@@ -1185,7 +1649,12 @@ class ECBackend:
         needs_data = False
         for op in msg.ops:
             kind = op.get("op")
-            if kind in ("read",):
+            if op.get("snapid"):
+                raise ValueError(
+                    "pool snapshots are not supported on EC pools")
+            if kind in _NOOP_OPS:
+                simple.append({})
+            elif kind in ("read",):
                 needs_data = True
             elif kind == "stat":
                 if meta is None:
@@ -1466,6 +1935,8 @@ class ECBackend:
     def apply_push(self, msg: M.MOSDPGPush):
         pg = self.pg
         cid = pg.cid
+        if _push_is_stale(pg.daemon.store, cid, msg):
+            return      # a live write already superseded this push
         t = Transaction()
         if not pg.daemon.store.collection_exists(cid):
             t.create_collection(cid)

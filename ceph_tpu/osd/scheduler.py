@@ -1,0 +1,86 @@
+"""Op scheduler — weighted-priority dequeue of OSD work.
+
+Reference behavior re-created (``src/osd/scheduler/OpScheduler.h`` /
+``src/common/WeightedPriorityQueue.h``; SURVEY.md §3.5): incoming work
+is classified (client ops, peer sub-ops, recovery, scrub, background)
+and drained by a scheduler that picks among non-empty priority classes
+with probability proportional to weight — strict priority for the
+highest class would starve recovery; pure FIFO would let recovery
+storms bury client I/O.  This is the WPQ flavor; the reference's
+mClock QoS scheduler is a possible future refinement.
+
+Deterministic weighted round-robin (no RNG): each class accrues
+credit += weight on every dequeue round; the non-empty class with the
+most credit is served and pays cost 1.  Within a class, FIFO.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+# priority classes (reference op_scheduler_class)
+CLIENT = "client"          # MOSDOp
+SUBOP = "subop"            # replication / EC sub-writes + reads
+PEERING = "peering"        # maps/queries/notifies/logs — never starved
+RECOVERY = "recovery"      # pushes/pulls/backfill
+SCRUB = "scrub"            # scrub maps
+
+DEFAULT_WEIGHTS = {
+    PEERING: 1000,          # control plane preempts everything
+    CLIENT: 63,
+    SUBOP: 63,
+    RECOVERY: 5,
+    SCRUB: 2,
+}
+
+
+class WeightedPriorityQueue:
+    """Blocking multi-class queue with weighted fair dequeue."""
+
+    def __init__(self, weights: dict[str, int] | None = None):
+        self.weights = dict(weights or DEFAULT_WEIGHTS)
+        self._queues: dict[str, collections.deque] = {
+            c: collections.deque() for c in self.weights}
+        self._credit: dict[str, float] = {c: 0.0 for c in self.weights}
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def enqueue(self, klass: str, item):
+        with self._cv:
+            if klass not in self._queues:
+                self._queues[klass] = collections.deque()
+                self._credit[klass] = 0.0
+                self.weights.setdefault(klass, 1)
+            self._queues[klass].append(item)
+            self._cv.notify()
+
+    def dequeue(self, timeout: float | None = None):
+        """→ (class, item) or None on timeout/close."""
+        with self._cv:
+            while True:
+                nonempty = [c for c, q in self._queues.items() if q]
+                if nonempty:
+                    for c in nonempty:
+                        self._credit[c] += self.weights[c]
+                    best = max(nonempty, key=lambda c: self._credit[c])
+                    self._credit[best] -= sum(
+                        self.weights[c] for c in nonempty)
+                    return best, self._queues[best].popleft()
+                if self._closed:
+                    return None
+                if not self._cv.wait(timeout):
+                    return None
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def __len__(self):
+        with self._cv:
+            return sum(len(q) for q in self._queues.values())
+
+    def depths(self) -> dict[str, int]:
+        with self._cv:
+            return {c: len(q) for c, q in self._queues.items() if q}

@@ -90,12 +90,19 @@ class PGInfo:
                    last_epoch_started=d.get("last_epoch_started", 0))
 
 
+MAX_DUPS = 3000     # reference osd_pg_log_dups_tracked (default 3000)
+
+
 @dataclass
 class PGLog:
     """The per-PG op journal (reference ``PGLog``/``pg_log_t``)."""
 
     entries: list[LogEntry] = field(default_factory=list)
     tail: tuple[int, int] = ZERO      # versions ≤ tail are trimmed away
+    # reqids of trimmed entries (reference pg_log_dup_t): trimming must
+    # not forget which client ops already applied, or a late resend
+    # re-applies them
+    dups: list[tuple[str, tuple[int, int]]] = field(default_factory=list)
 
     @property
     def head(self) -> tuple[int, int]:
@@ -105,16 +112,33 @@ class PGLog:
         self.entries.append(e)
 
     def trim(self, to: tuple[int, int]):
-        """Drop entries ≤ `to` (reference PGLog::trim)."""
-        self.entries = [e for e in self.entries if e.version > to]
+        """Drop entries ≤ `to`, keeping their reqids in the bounded
+        dup list (reference PGLog::trim + pg_log_dup_t).  Entries are
+        version-ordered, so the cut point is a bisect, not a scan —
+        trim runs on every write once the log is at its cap."""
+        import bisect
+        idx = bisect.bisect_right(self.entries, to,
+                                  key=lambda e: e.version)
+        if idx:
+            for e in self.entries[:idx]:
+                if e.reqid:
+                    self.dups.append((e.reqid, e.version))
+            if len(self.dups) > MAX_DUPS:
+                del self.dups[: len(self.dups) - MAX_DUPS]
+            del self.entries[:idx]
         if to > self.tail:
             self.tail = to
 
     def find_reqid(self, reqid: str) -> LogEntry | None:
-        """Duplicate-op check (reference pg_log dup detection)."""
+        """Duplicate-op check (reference pg_log dup detection), also
+        consulting the trimmed-dup history."""
         for e in reversed(self.entries):
             if e.reqid == reqid:
                 return e
+        for rid, ver in reversed(self.dups):
+            if rid == reqid:
+                return LogEntry(op=MODIFY, oid="", version=ver,
+                                reqid=rid)
         return None
 
     def entries_after(self, since: tuple[int, int]) -> list[LogEntry]:
@@ -142,10 +166,13 @@ class PGLog:
 
     def to_dict(self) -> dict:
         return {"tail": list(self.tail),
-                "entries": [e.to_dict() for e in self.entries]}
+                "entries": [e.to_dict() for e in self.entries],
+                "dups": [[r, list(v)] for r, v in self.dups]}
 
     @classmethod
     def from_dict(cls, d: dict) -> "PGLog":
         return cls(entries=[LogEntry.from_dict(e)
                             for e in d.get("entries", [])],
-                   tail=tuple(d.get("tail", ZERO)))
+                   tail=tuple(d.get("tail", ZERO)),
+                   dups=[(r, tuple(v))
+                         for r, v in d.get("dups", [])])

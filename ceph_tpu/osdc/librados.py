@@ -165,6 +165,112 @@ class IoCtx:
     def omap_rm_keys(self, oid: str, keys: list[str]):
         self._sync(oid, [{"op": "omap_rm", "keys": list(keys)}])
 
+    def execute(self, oid: str, cls: str, method: str,
+                data: bytes = b"") -> bytes:
+        """Invoke an object-class method on the primary (reference
+        rados_exec / IoCtx::exec)."""
+        results, _ = self._sync(oid, [{"op": "call", "cls": cls,
+                                       "method": method,
+                                       "data": data.hex()}])
+        return bytes.fromhex(results[0].get("data", ""))
+
+    def lock_exclusive(self, oid: str, name: str, cookie: str,
+                       entity: str = ""):
+        import json as _json
+        self.execute(oid, "lock", "lock", _json.dumps({
+            "name": name, "type": "exclusive", "cookie": cookie,
+            "entity": entity or self.rados.objecter.entity}).encode())
+
+    def unlock(self, oid: str, name: str, cookie: str,
+               entity: str = ""):
+        import json as _json
+        self.execute(oid, "lock", "unlock", _json.dumps({
+            "name": name, "cookie": cookie,
+            "entity": entity or self.rados.objecter.entity}).encode())
+
+    # -- pool snapshots ----------------------------------------------------
+    def create_snap(self, snap_name: str):
+        """Pool snapshot (reference rados_ioctx_snap_create)."""
+        rc, outs, _ = self.rados.monc.command({
+            "prefix": "osd pool mksnap", "pool": self.pool_name,
+            "snap": snap_name})
+        _raise(rc, outs)
+        self._wait_snap_visible(snap_name, present=True)
+
+    def remove_snap(self, snap_name: str):
+        rc, outs, _ = self.rados.monc.command({
+            "prefix": "osd pool rmsnap", "pool": self.pool_name,
+            "snap": snap_name})
+        _raise(rc, outs)
+        self._wait_snap_visible(snap_name, present=False)
+
+    def snap_lookup(self, snap_name: str) -> int:
+        pool = self.objecter.osdmap.pools[self.pool_id]
+        for sid, name in pool.snaps.items():
+            if name == snap_name:
+                return sid
+        raise ObjectNotFound(-2, f"no snap {snap_name!r}")
+
+    def list_snaps(self) -> dict[int, str]:
+        return dict(self.objecter.osdmap.pools[self.pool_id].snaps)
+
+    def _wait_snap_visible(self, snap_name: str, present: bool,
+                           timeout: float = 10.0):
+        """Block until this client's map reflects the snap change —
+        writes issued after create_snap must carry the new seq."""
+        import time as _t
+        deadline = _t.monotonic() + timeout
+        while _t.monotonic() < deadline:
+            pool = self.objecter.osdmap.pools.get(self.pool_id)
+            if pool is not None and \
+                    (snap_name in pool.snaps.values()) == present:
+                return
+            _t.sleep(0.02)
+        raise TimeoutError(f"snap {snap_name!r} never became "
+                           f"{'visible' if present else 'gone'}")
+
+    def snap_read(self, oid: str, snap_name: str,
+                  length: int | None = None, off: int = 0) -> bytes:
+        """Read an object as of a pool snapshot."""
+        sid = self.snap_lookup(snap_name)
+        op = {"op": "read", "off": off, "snapid": sid}
+        if length is not None:
+            op["len"] = length
+        results, _ = self._sync(oid, [op])
+        return bytes.fromhex(results[0]["data"])
+
+    # -- watch/notify ------------------------------------------------------
+    def watch(self, oid: str, callback) -> str:
+        """Register `callback(notify_id, oid, payload)` for notifies
+        on the object; returns the watch handle (reference
+        rados_watch).  Sessions are primary-resident: a primary change
+        drops them and the application re-watches (the reference's
+        linger-op re-registration is future work)."""
+        obj = self.rados.objecter
+        obj._watch_id += 1
+        local = obj._watch_id
+        handle = f"{obj.entity}:{local}"
+        obj.watch_cbs[handle] = callback
+        self._sync(oid, [{"op": "watch", "watch_id": local}])
+        return handle
+
+    def unwatch(self, oid: str, handle: str):
+        obj = self.rados.objecter
+        local = int(handle.rsplit(":", 1)[1])
+        self._sync(oid, [{"op": "unwatch", "watch_id": local}])
+        obj.watch_cbs.pop(handle, None)
+
+    def notify(self, oid: str, payload: bytes = b"",
+               timeout: float = 10.0) -> dict:
+        """Fire a notify; blocks until every watcher acks or the
+        timeout lapses.  Returns {"replies": {watch_id: reply},
+        "timed_out_watchers": [...]} (reference rados_notify2)."""
+        results, _ = self._sync(oid, [{"op": "notify",
+                                       "data": payload.hex(),
+                                       "timeout": timeout}],
+                                timeout=timeout + 10.0)
+        return results[0]
+
     def aio_write_full(self, oid: str, data: bytes) -> Completion:
         return self._aio(oid, [{"op": "write_full", "data": data.hex()}])
 

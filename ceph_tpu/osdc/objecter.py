@@ -58,6 +58,8 @@ class Objecter(Dispatcher):
         self.osdmap = OSDMap()
         self.lock = threading.RLock()
         self._tid = 0
+        self._watch_id = 0
+        self.watch_cbs: dict[str, object] = {}
         self.inflight: dict[int, _Op] = {}
         self._osd_cons: dict[int, object] = {}
         self._map_waiters: list[threading.Event] = []
@@ -76,12 +78,25 @@ class Objecter(Dispatcher):
             daemon=True)
         self._ticker.start()
 
+    @staticmethod
+    def _idempotent(op) -> bool:
+        """Writes dedup via reqid and reads are harmless to repeat;
+        `notify` re-delivers to every watcher on each send, so it may
+        only be resent when its target actually moved (the old
+        primary can no longer complete it)."""
+        return not any(o.get("op") == "notify" for o in op.ops)
+
     def _resend_loop(self):
         while not self._stop.wait(self._resend_interval):
             now = time.monotonic()
             with self.lock:
                 for op in list(self.inflight.values()):
-                    if now - op.submitted > self._resend_interval:
+                    if now - op.submitted <= self._resend_interval:
+                        continue
+                    pgid, primary = self._calc_target(op.pool, op.oid)
+                    moved = (pgid != op.pgid
+                             or primary != op.target_osd)
+                    if moved or self._idempotent(op):
                         op.submitted = now
                         self._send_op(op)
 
@@ -105,12 +120,19 @@ class Objecter(Dispatcher):
             if epoch <= self.osdmap.epoch:
                 return
             self.osdmap = osdmap_from_dict(map_dict)
-            # recompute every in-flight target; resend movers
-            # (reference Objecter::handle_osd_map → _scan_requests)
+            # epoch-driven resend (reference Objecter::handle_osd_map
+            # → _scan_requests): every in-flight op re-targets and
+            # resends on a map advance — OSDs silently drop ops from
+            # older intervals, and dup detection makes the resend
+            # idempotent, so eager resend beats waiting for the
+            # periodic ticker
             for op in list(self.inflight.values()):
-                pgid, primary = self._calc_target(op.pool, op.oid)
-                if pgid != op.pgid or primary != op.target_osd:
-                    self._send_op(op)
+                if self._idempotent(op):
+                    self._send_op(op)       # re-targets internally
+                else:
+                    pgid, primary = self._calc_target(op.pool, op.oid)
+                    if pgid != op.pgid or primary != op.target_osd:
+                        self._send_op(op)
             for ev in self._map_waiters:
                 ev.set()
             self._map_waiters.clear()
@@ -142,11 +164,16 @@ class Objecter(Dispatcher):
         con = self._osd_con(primary)
         if con is None:
             return
+        pool = self.osdmap.pools.get(op.pool)
+        snapc = None
+        if pool is not None and pool.snap_seq:
+            snapc = {"seq": pool.snap_seq,
+                     "snaps": sorted(pool.snaps, reverse=True)}
         try:
             con.send_message(M.MOSDOp(
                 tid=op.tid, client=self.entity, pgid=str(pgid),
                 oid=op.oid, epoch=self.osdmap.epoch, ops=op.ops,
-                flags=0))
+                flags=0, snapc=snapc))
         except ConnectionError:
             self._osd_cons.pop(primary, None)
 
@@ -167,6 +194,28 @@ class Objecter(Dispatcher):
 
     # -- replies -----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, M.MWatchNotify):
+            # a notify fired on an object this client watches: run the
+            # registered callback, ack back up the same connection
+            # (reference watch/notify client protocol)
+            cb = self.watch_cbs.get(msg.watch_id)
+            reply = None
+            if cb is not None:
+                try:
+                    reply = cb(msg.notify_id, msg.oid,
+                               bytes.fromhex(msg.data or ""))
+                except Exception:
+                    reply = None
+            try:
+                msg.connection.send_message(M.MWatchNotifyAck(
+                    oid=msg.oid, pgid=msg.pgid,
+                    notify_id=msg.notify_id, watch_id=msg.watch_id,
+                    reply=reply if isinstance(reply, (str, int,
+                                                      type(None)))
+                    else str(reply)))
+            except (ConnectionError, AttributeError):
+                pass
+            return True
         if not isinstance(msg, M.MOSDOpReply):
             return False
         with self.lock:

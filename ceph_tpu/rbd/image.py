@@ -46,8 +46,9 @@ class RBD:
 
     def create(self, ioctx, name: str, size: int, *, order: int = 22,
                stripe_unit: int | None = None, stripe_count: int = 1):
-        if any(o == _header_oid(name)
-               for o in (ioctx.list_objects() if size >= 0 else ())):
+        if size < 0:
+            raise ValueError("image size must be >= 0")
+        if _header_oid(name) in ioctx.list_objects():
             raise ValueError(f"image {name!r} exists")
         object_size = 1 << order
         su = stripe_unit if stripe_unit else object_size
@@ -135,6 +136,7 @@ class Image:
             first_dead = -(-new_size // self.layout.object_size)
             last = -(-old // self.layout.object_size)
             for objno in range(first_dead, last):
+                self._cow_preserve(objno)   # snapshots keep the bytes
                 try:
                     self.ioctx.remove(_data_oid(self.name, objno))
                 except Exception:
@@ -169,11 +171,32 @@ class Image:
         if snap is None:
             raise ImageNotFound(f"no snapshot {snap_name!r}")
         self._save_header()
-        suffix = f"@{snap['id']}"
+        self._gc_clones()
+
+    def _gc_clones(self):
+        """Collect clone objects no remaining snapshot resolves to.
+        Mirrors _read_object_at_snap exactly: each snap uses the
+        OLDEST clone with id >= its own; every other clone is garbage
+        (reference: the OSD's snap trimmer removing unreferenced
+        clones)."""
+        snap_ids = sorted(s["id"]
+                          for s in self._hdr["snaps"].values())
+        prefix = f"rbd_data.{self.name}."
+        clones: dict[str, list[int]] = {}
         for o in self.ioctx.list_objects():
-            if o.startswith(f"rbd_data.{self.name}.") \
-                    and o.endswith(suffix):
-                self.ioctx.remove(o)
+            if o.startswith(prefix) and "@" in o:
+                base, _, cid = o.rpartition("@")
+                clones.setdefault(base, []).append(int(cid))
+        for base, cids in clones.items():
+            needed = set()
+            for sid in snap_ids:
+                cand = min((c for c in cids if c >= sid),
+                           default=None)
+                if cand is not None:
+                    needed.add(cand)
+            for c in cids:
+                if c not in needed:
+                    self.ioctx.remove(f"{base}@{c}")
 
     def list_snaps(self) -> list[dict]:
         return [{"name": n, **s}

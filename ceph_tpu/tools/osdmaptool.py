@@ -49,6 +49,8 @@ def osdmap_to_dict(m: OSDMap) -> dict:
             "pgp_num": p.pgp_num, "crush_rule": p.crush_rule,
             "flags": p.flags, "last_change": p.last_change,
             "erasure_code_profile": p.erasure_code_profile,
+            "snap_seq": p.snap_seq,
+            "snaps": {str(i): n for i, n in p.snaps.items()},
         } for p in m.pools.values()],
         "pg_temp": {str(pg): osds for pg, osds in m.pg_temp.items()},
         "primary_temp": {str(pg): o for pg, o in m.primary_temp.items()},
@@ -68,6 +70,9 @@ def osdmap_from_dict(d: dict) -> OSDMap:
     m.osd_up_thru = list(d.get("osd_up_thru", [])) or [0] * d["max_osd"]
     m.flags = d.get("flags", 0)
     for p in d["pools"]:
+        p = dict(p)
+        p["snaps"] = {int(i): n
+                      for i, n in (p.get("snaps") or {}).items()}
         pool = PGPool(**p)
         m.pools[pool.id] = pool
         m.pool_name[pool.name] = pool.id

@@ -71,6 +71,7 @@ class MiniCluster:
         """Hard-stop an OSD (keeps its store object for a revive)."""
         osd = self.osds.pop(i)
         osd.running = False
+        osd.op_queue.close()
         osd.timer.shutdown()
         osd.admin_socket.shutdown()
         osd.monc.shutdown()

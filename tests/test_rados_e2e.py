@@ -37,8 +37,15 @@ class TestPGLog:
         assert log.find_reqid("c:1").version == (1, 1)
         assert log.find_reqid("c:9") is None
         log.trim((1, 1))
-        assert log.find_reqid("c:1") is None
+        # trimming must NOT forget applied reqids (reference
+        # pg_log_dup_t): a late client resend of c:1 would otherwise
+        # be applied twice
+        dup = log.find_reqid("c:1")
+        assert dup is not None and dup.version == (1, 1)
         assert log.tail == (1, 1) and log.head == (1, 2)
+        # and the dup survives a wire/persist round-trip
+        log2 = PGLog.from_dict(log.to_dict())
+        assert log2.find_reqid("c:1").version == (1, 1)
 
     def test_wire_roundtrip(self):
         log = PGLog(tail=(1, 0))

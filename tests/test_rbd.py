@@ -112,3 +112,25 @@ class TestImage:
         img.discard(0, 4 << 10)
         assert img.read(0, 4 << 10) == b"\x00" * (4 << 10)
         assert img.read(4 << 10, 4 << 10) == b"A" * (4 << 10)
+
+    def test_remove_snap_gc_keeps_older_snaps(self, rbd_cluster):
+        """remove_snap must neither lose older snaps' data (their
+        clones may be keyed to the removed snap's id) nor leak clones
+        once no snapshot needs them."""
+        c, r, io = rbd_cluster
+        rbd = RBD()
+        rbd.create(io, "gcimg", 8 << 10, order=12)
+        img = rbd.open(io, "gcimg")
+        a = b"AAAA" * 1024
+        img.write(0, a)
+        img.create_snap("s1")
+        img.create_snap("s2")
+        img.write(0, b"BBBB" * 1024)     # single clone keyed @2
+        img.remove_snap("s2")
+        # s1 still reads the original bytes through the @2 clone
+        assert rbd.open(io, "gcimg", "s1").read(0, len(a)) == a
+        img.remove_snap("s1")
+        # no snapshots remain: every clone is garbage-collected
+        leftovers = [o for o in io.list_objects()
+                     if o.startswith("rbd_data.gcimg.") and "@" in o]
+        assert leftovers == []

@@ -1,0 +1,66 @@
+"""watch/notify e2e (reference src/osd/Watch.h + rados_notify2):
+watchers get callbacks with the payload, notify blocks for acks,
+unwatch and dead connections stop delivery."""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    r = c.rados()
+    r.create_pool("wn", pg_num=4, size=2)
+    io = r.open_ioctx("wn")
+    c.wait_for_clean()
+    io.write_full("bell", b"ding")
+    yield c, r, io
+    c.stop()
+
+
+class TestWatchNotify:
+    def test_notify_reaches_watchers_and_acks(self, cluster):
+        c, r, io = cluster
+        got1, got2 = [], []
+        r2 = c.rados()
+        io2 = r2.open_ioctx("wn")
+        h1 = io.watch("bell", lambda nid, oid, data:
+                      got1.append((oid, data)) or "w1-ack")
+        h2 = io2.watch("bell", lambda nid, oid, data:
+                       got2.append((oid, data)) or "w2-ack")
+        r3 = c.rados()
+        io3 = r3.open_ioctx("wn")
+        res = io3.notify("bell", b"ring-ring")
+        assert got1 == [("bell", b"ring-ring")]
+        assert got2 == [("bell", b"ring-ring")]
+        assert sorted(res["replies"].values()) == ["w1-ack", "w2-ack"]
+        assert res["timed_out_watchers"] == []
+        # unwatch one; next notify reaches only the other
+        io2.unwatch("bell", h2)
+        res = io3.notify("bell", b"again")
+        assert len(got1) == 2 and len(got2) == 1
+        assert len(res["replies"]) == 1
+        io.unwatch("bell", h1)
+
+    def test_notify_without_watchers_completes(self, cluster):
+        c, r, io = cluster
+        res = io.notify("bell", b"anyone?")
+        assert res["replies"] == {}
+
+    def test_dead_watcher_dropped(self, cluster):
+        c, r, io = cluster
+        rdead = c.rados()
+        iodead = rdead.open_ioctx("wn")
+        iodead.watch("bell", lambda *a: None)
+        rdead.shutdown()
+        time.sleep(0.3)
+        # notify must not hang on the dead session: either the reset
+        # dropped the watcher or the timeout reaps it
+        t0 = time.monotonic()
+        res = io.notify("bell", b"late", timeout=3.0)
+        assert time.monotonic() - t0 < 8.0
