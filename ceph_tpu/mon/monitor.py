@@ -615,6 +615,17 @@ class HealthMonitor(PaxosService):
             if prefix == "pg stat":
                 return 0, "", {"num_pgs": total_pgs, "states": states}
             checks = []
+            quorum = set(self.mon.elector.quorum or [])
+            absent = [r for r in self.mon.monmap.ranks()
+                      if r not in quorum]
+            if absent and quorum:
+                checks.append({
+                    "code": "MON_DOWN",
+                    "summary": f"{len(absent)}/"
+                               f"{len(self.mon.monmap.ranks())} mons "
+                               f"out of quorum",
+                    "detail": [f"mon.{r} not in quorum"
+                               for r in absent]})
             down = [o for o in range(m.max_osd)
                     if m.exists(o) and not m.is_up(o)]
             if down:
@@ -1038,6 +1049,11 @@ class Monitor(Dispatcher):
                     # a quorum member stopped accepting: re-elect so the
                     # quorum shrinks to the live set (reference
                     # Paxos::accept_timeout → bootstrap)
+                    self._start_election()
+                elif self.paxos.peon_ack_stale():
+                    # a quorum peon stopped acking leases: re-elect so
+                    # the quorum shrinks to the live set and health
+                    # reports MON_DOWN (reference lease-ack timeout)
                     self._start_election()
                 elif self.paxos.is_active():
                     self.paxos.extend_lease()

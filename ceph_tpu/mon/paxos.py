@@ -34,6 +34,7 @@ PROPOSE, ACK, VICTORY = "propose", "ack", "victory"
 # paxos ops
 COLLECT, LAST, BEGIN, ACCEPT, COMMIT, LEASE, CATCHUP = (
     "collect", "last", "begin", "accept", "commit", "lease", "catchup")
+LEASE_ACK = "lease_ack"
 
 PAXOS_PREFIX = "paxos"
 
@@ -215,6 +216,8 @@ class Paxos:
         self._pending_v = 0
         self._begin_started = 0.0     # when the open BEGIN round started
         self.lease_until = 0.0
+        # leader-side: rank → monotonic time of last lease ack
+        self.lease_acks: dict[int, float] = {}
 
     # -- helpers -----------------------------------------------------------
     def _new_pn(self) -> int:
@@ -234,6 +237,8 @@ class Paxos:
     def leader_collect(self, quorum: list[int]):
         """Phase 1 after winning an election."""
         self.quorum = quorum
+        now = time.monotonic()
+        self.lease_acks = {r: now for r in quorum if r != self.rank}
         self.state = "recovering"
         pn = self._new_pn()
         self._collect_pn = pn
@@ -319,6 +324,15 @@ class Paxos:
                         "op": COMMIT, "v": v, "value": value.hex(),
                         "from": self.rank}))
             self._go_active()
+
+    def peon_ack_stale(self, grace: float = 6.0) -> list[int]:
+        """Quorum peons silent past grace (leader side) — the failure
+        signal the reference derives from missing lease acks."""
+        if not self.lease_acks:
+            return []
+        now = time.monotonic()
+        return [r for r, t in self.lease_acks.items()
+                if now - t > grace]
 
     def extend_lease(self, duration: float = 5.0):
         self.lease_until = time.monotonic() + duration
@@ -414,12 +428,22 @@ class Paxos:
             self._commit_local(msg["v"], bytes.fromhex(msg["value"]))
         elif op == LEASE:
             self.lease_until = time.monotonic() + msg["duration"]
+            # ack so the leader can tell live peons from dead ones
+            # (reference MMonPaxos OP_LEASE_ACK)
+            self.outbox.append((frm, {"op": LEASE_ACK,
+                                      "from": self.rank}))
             if msg["last_committed"] > self.last_committed:
                 # we missed a COMMIT (dropped peer message): ask the
                 # leader to resend the gap instead of serving stale reads
                 self.outbox.append((frm, {
                     "op": CATCHUP, "from": self.rank,
                     "last_committed": self.last_committed}))
+        elif op == LEASE_ACK:
+            # only quorum members refresh: a late ack from an evicted
+            # rank must not re-enter the table (it would never refresh
+            # again and trip the staleness check forever)
+            if frm in self.quorum:
+                self.lease_acks[frm] = time.monotonic()
         elif op == CATCHUP:
             for v in range(msg["last_committed"] + 1,
                            self.last_committed + 1):

@@ -1,0 +1,3 @@
+"""RGW — S3-subset object gateway over librados (SURVEY.md §3.9)."""
+
+from .gateway import RGWService, S3Client  # noqa: F401

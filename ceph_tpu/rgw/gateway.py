@@ -1,0 +1,286 @@
+"""RGW gateway — an S3-dialect REST frontend over RADOS.
+
+Reference behavior re-created (``src/rgw/``: ``rgw_main.cc`` REST
+frontend, ``rgw_op.cc`` op layer, ``rgw_rados.cc`` store; SURVEY.md
+§3.9), reduced to the core S3 data path:
+
+- buckets: ``PUT/DELETE /bucket``, ``GET /bucket`` lists keys
+  (XML ListBucketResult like S3); the bucket index is an omap on a
+  per-bucket index object (the reference's ``cls_rgw`` bucket-index
+  omap, without sharding);
+- objects: ``PUT/GET/HEAD/DELETE /bucket/key``; bytes live in RADOS
+  objects ``<bucket>_<key>`` in the ``.rgw.data`` pool, metadata
+  (size, etag) in the bucket index;
+- ``GET /`` lists buckets (ListAllMyBucketsResult).
+
+ETags are MD5 hex like S3.  Auth/ACL/multipart/versioning are out of
+scope for this slice; the HTTP dialect is enough for s3-style clients
+that can be pointed at an endpoint with auth disabled.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import threading
+from xml.sax.saxutils import escape as _xesc
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..osdc.librados import ObjectNotFound
+
+DATA_POOL = ".rgw.data"
+META_POOL = ".rgw.meta"
+BUCKETS_OID = "buckets"          # omap: bucket name → meta json
+
+
+def _index_oid(bucket: str) -> str:
+    return f"index.{bucket}"
+
+
+def _data_oid(bucket: str, key: str) -> str:
+    return f"{bucket}\x00{key}"
+
+
+class RGWStore:
+    """The op layer (reference rgw_op.cc + rgw_rados.cc, trimmed)."""
+
+    def __init__(self, rados):
+        self.rados = rados
+        for pool in (DATA_POOL, META_POOL):
+            try:
+                rados.create_pool(pool, pg_num=8, size=2)
+            except Exception:
+                pass        # exists
+        self.meta = rados.open_ioctx(META_POOL)
+        self.data = rados.open_ioctx(DATA_POOL)
+
+    # -- buckets -----------------------------------------------------------
+    def create_bucket(self, bucket: str):
+        self.meta.omap_set(BUCKETS_OID, {
+            bucket: json.dumps({"name": bucket}).encode()})
+
+    def delete_bucket(self, bucket: str) -> bool:
+        if self.list_objects(bucket):
+            return False            # 409 BucketNotEmpty
+        # (list_objects raises on cluster outage, so an unreachable
+        # index can never masquerade as an empty bucket here)
+        self.meta.omap_rm_keys(BUCKETS_OID, [bucket])
+        try:
+            self.meta.remove(_index_oid(bucket))
+        except Exception:
+            pass
+        return True
+
+    def bucket_exists(self, bucket: str) -> bool:
+        try:
+            return bucket in self.meta.omap_get(BUCKETS_OID)
+        except ObjectNotFound:
+            return False        # nothing registered yet
+
+    def list_buckets(self) -> list[str]:
+        try:
+            return sorted(self.meta.omap_get(BUCKETS_OID))
+        except ObjectNotFound:
+            return []
+
+    # -- objects -----------------------------------------------------------
+    def put_object(self, bucket: str, key: str, body: bytes) -> str:
+        etag = hashlib.md5(body).hexdigest()
+        self.data.write_full(_data_oid(bucket, key), body)
+        self.meta.omap_set(_index_oid(bucket), {
+            key: json.dumps({"size": len(body),
+                             "etag": etag}).encode()})
+        return etag
+
+    def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
+        meta = self.head_object(bucket, key)
+        return bytes(self.data.read(_data_oid(bucket, key))), meta
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        try:
+            idx = self.meta.omap_get(_index_oid(bucket))
+        except ObjectNotFound:
+            idx = {}        # bucket never indexed anything
+        if key not in idx:
+            raise KeyError(key)
+        return json.loads(bytes(idx[key]))
+
+    def delete_object(self, bucket: str, key: str):
+        self.meta.omap_rm_keys(_index_oid(bucket), [key])
+        try:
+            self.data.remove(_data_oid(bucket, key))
+        except Exception:
+            pass
+
+    def list_objects(self, bucket: str) -> dict[str, dict]:
+        try:
+            idx = self.meta.omap_get(_index_oid(bucket))
+        except ObjectNotFound:
+            return {}
+        return {k: json.loads(bytes(v)) for k, v in idx.items()}
+
+
+def _xml_list_bucket(bucket: str, objs: dict[str, dict]) -> bytes:
+    rows = "".join(
+        f"<Contents><Key>{_xesc(k)}</Key><Size>{m['size']}</Size>"
+        f"<ETag>&quot;{m['etag']}&quot;</ETag></Contents>"
+        for k, m in sorted(objs.items()))
+    return (f'<?xml version="1.0"?><ListBucketResult>'
+            f"<Name>{_xesc(bucket)}</Name>{rows}</ListBucketResult>"
+            ).encode()
+
+
+def _xml_list_buckets(names: list[str]) -> bytes:
+    rows = "".join(f"<Bucket><Name>{_xesc(n)}</Name></Bucket>"
+                   for n in names)
+    return (f'<?xml version="1.0"?><ListAllMyBucketsResult>'
+            f"<Buckets>{rows}</Buckets></ListAllMyBucketsResult>"
+            ).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: RGWStore = None      # set by RGWService
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):   # quiet
+        pass
+
+    def _reply(self, code: int, body: bytes = b"",
+               ctype: str = "application/xml", headers: dict = None):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _parse(self):
+        path = self.path.split("?", 1)[0].strip("/")
+        if not path:
+            return None, None
+        parts = path.split("/", 1)
+        return parts[0], parts[1] if len(parts) > 1 else None
+
+    def handle_one_request(self):
+        try:
+            super().handle_one_request()
+        except (TimeoutError, ConnectionError, OSError):
+            # cluster outage mid-op: drop the connection rather than
+            # fabricate 404s (clients retry)
+            self.close_connection = True
+
+    def do_PUT(self):
+        bucket, key = self._parse()
+        # always drain the request body first: replying while unread
+        # bytes sit on a keep-alive connection desyncs the stream
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if bucket is None:
+            return self._reply(400)
+        if key is None:
+            self.store.create_bucket(bucket)
+            return self._reply(200)
+        if not self.store.bucket_exists(bucket):
+            return self._reply(404)
+        etag = self.store.put_object(bucket, key, body)
+        return self._reply(200, headers={"ETag": f'"{etag}"'})
+
+    def do_GET(self):
+        bucket, key = self._parse()
+        if bucket is None:
+            return self._reply(
+                200, _xml_list_buckets(self.store.list_buckets()))
+        if key is None:
+            if not self.store.bucket_exists(bucket):
+                return self._reply(404)
+            return self._reply(200, _xml_list_bucket(
+                bucket, self.store.list_objects(bucket)))
+        try:
+            body, meta = self.store.get_object(bucket, key)
+        except KeyError:
+            return self._reply(404)
+        return self._reply(200, body,
+                           ctype="application/octet-stream",
+                           headers={"ETag": f'"{meta["etag"]}"'})
+
+    def do_HEAD(self):
+        bucket, key = self._parse()
+        if bucket is None or key is None:
+            return self._reply(400)
+        try:
+            meta = self.store.head_object(bucket, key)
+        except KeyError:
+            return self._reply(404)
+        return self._reply(200, headers={
+            "ETag": f'"{meta["etag"]}"',
+            "X-Object-Size": str(meta["size"])})
+
+    def do_DELETE(self):
+        bucket, key = self._parse()
+        if bucket is None:
+            return self._reply(400)
+        if key is None:
+            ok = self.store.delete_bucket(bucket)
+            return self._reply(204 if ok else 409)
+        self.store.delete_object(bucket, key)
+        return self._reply(204)
+
+
+class RGWService:
+    """The gateway daemon: HTTP frontend bound to a RADOS cluster."""
+
+    def __init__(self, rados, host: str = "127.0.0.1", port: int = 0):
+        self.store = RGWStore(rados)
+        handler = type("Handler", (_Handler,), {"store": self.store})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="rgw", daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class S3Client:
+    """Tiny S3-dialect client for tests/tools."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+
+    def _req(self, method: str, path: str, body: bytes = b""):
+        con = http.client.HTTPConnection(self.host, self.port,
+                                         timeout=10)
+        try:
+            con.request(method, path, body=body or None)
+            resp = con.getresponse()
+            return resp.status, dict(resp.getheaders()), resp.read()
+        finally:
+            con.close()
+
+    def make_bucket(self, b):
+        return self._req("PUT", f"/{b}")[0]
+
+    def put(self, b, k, data: bytes):
+        st, hdr, _ = self._req("PUT", f"/{b}/{k}", data)
+        return st, hdr.get("ETag", "").strip('"')
+
+    def get(self, b, k):
+        st, hdr, body = self._req("GET", f"/{b}/{k}")
+        return st, body
+
+    def head(self, b, k):
+        return self._req("HEAD", f"/{b}/{k}")[0]
+
+    def delete(self, b, k=None):
+        return self._req("DELETE", f"/{b}/{k}" if k else f"/{b}")[0]
+
+    def list(self, b=None):
+        return self._req("GET", f"/{b}" if b else "/")

@@ -1,0 +1,100 @@
+"""ceph CLI — mon command dispatch (reference ``src/ceph.in``).
+
+    ceph -m HOST:PORT[,...] status | health | pg stat | pg dump
+    ceph -m ... osd tree | osd dump | osd stat | osd pool ls
+    ceph -m ... osd pool create NAME [--pg-num N] [--size N] [--type T]
+    ceph -m ... osd out ID | osd in ID | osd down ID
+    ceph -m ... osd pool mksnap POOL SNAP | rmsnap POOL SNAP
+    ceph -m ... osd pg-upmap-items PGID FROM TO [FROM TO ...]
+    ceph -m ... daemon SOCK_PATH COMMAND [k=v ...]
+
+Free-form: any unrecognized argument list is sent as
+{"prefix": "<joined words>"} — the same pass-through the reference CLI
+does with its command descriptions."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..core.admin_socket import admin_command
+from ..mon.client import MonClient
+from .rados import _monmap_from_addrs
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = argparse.ArgumentParser(prog="ceph", add_help=False)
+    p.add_argument("-m", "--mon")
+    args, rest = p.parse_known_args(argv)
+    if not rest:
+        print(__doc__)
+        return 1
+
+    try:
+        return _dispatch(args, rest)
+    except (IndexError, ValueError):
+        print(__doc__)
+        return 1
+
+
+def _dispatch(args, rest) -> int:
+    if rest[0] == "daemon":
+        # `ceph daemon <asok> <cmd> [k=v ...]` — local admin socket
+        sock, words, kvs = rest[1], [], {}
+        for tok in rest[2:]:
+            if "=" in tok:
+                k, v = tok.split("=", 1)
+                kvs[k] = v
+            else:
+                words.append(tok)
+        out = admin_command(sock, " ".join(words), **kvs)
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+
+    if not args.mon:
+        raise SystemExit("ceph: -m HOST:PORT required")
+    mc = MonClient(_monmap_from_addrs(args.mon))
+    try:
+        cmd: dict = {}
+        if rest[0] == "osd" and rest[1:2] == ["pool"] and \
+                rest[2:3] == ["create"]:
+            sub = argparse.ArgumentParser()
+            sub.add_argument("name")
+            sub.add_argument("--pg-num", type=int, default=32)
+            sub.add_argument("--size", type=int, default=3)
+            sub.add_argument("--type", default="replicated")
+            sub.add_argument("--profile", default="")
+            a = sub.parse_args(rest[3:])
+            cmd = {"prefix": "osd pool create", "pool": a.name,
+                   "pg_num": a.pg_num, "size": a.size,
+                   "pool_type": a.type}
+            if a.profile:
+                cmd["erasure_code_profile"] = a.profile
+        elif rest[0] == "osd" and rest[1:2] == ["pool"] and \
+                rest[2:3] in (["mksnap"], ["rmsnap"]):
+            cmd = {"prefix": f"osd pool {rest[2]}", "pool": rest[3],
+                   "snap": rest[4]}
+        elif rest[0] == "osd" and rest[1:2] == ["pg-upmap-items"]:
+            pairs = [[int(a), int(b)]
+                     for a, b in zip(rest[3::2], rest[4::2])]
+            cmd = {"prefix": "osd pg-upmap-items", "pgid": rest[2],
+                   "mappings": pairs}
+        elif rest[0] == "osd" and rest[1:2] in (["out"], ["in"],
+                                                ["down"]):
+            cmd = {"prefix": f"osd {rest[1]}", "ids": [int(rest[2])]}
+        else:
+            cmd = {"prefix": " ".join(rest)}
+        rc, outs, outb = mc.command(cmd)
+        if outb is not None:
+            print(json.dumps(outb, indent=2, default=str))
+        if outs:
+            print(outs, file=sys.stderr)
+        return 0 if rc == 0 else 1
+    finally:
+        mc.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

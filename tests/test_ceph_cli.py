@@ -1,0 +1,71 @@
+"""ceph CLI over a MiniCluster (reference src/ceph.in)."""
+
+import io as _io
+import json
+import sys
+
+import pytest
+
+from ceph_tpu.tools.ceph import main as ceph_main
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _run(c, *argv):
+    addrs = ",".join(f"{a.host}:{a.port}"
+                     for a in c.monmap.mons.values())
+    old = sys.stdout
+    sys.stdout = buf = _io.StringIO()
+    try:
+        rc = ceph_main(["-m", addrs, *argv])
+    finally:
+        sys.stdout = old
+    return rc, buf.getvalue()
+
+
+class TestCephCLI:
+    def test_status_and_health(self, cluster):
+        rc, out = _run(cluster, "status")
+        assert rc == 0
+        st = json.loads(out)
+        assert st["num_up_osds"] == 3
+        rc, out = _run(cluster, "health")
+        assert rc == 0
+
+    def test_pool_lifecycle_and_tree(self, cluster):
+        rc, _ = _run(cluster, "osd", "pool", "create", "clipool",
+                     "--pg-num", "4", "--size", "2")
+        assert rc == 0
+        rc, out = _run(cluster, "osd", "pool", "ls")
+        assert rc == 0 and "clipool" in json.loads(out)
+        rc, out = _run(cluster, "osd", "tree")
+        assert rc == 0
+        rc, out = _run(cluster, "osd", "stat")
+        assert json.loads(out)["num_osds"] == 3
+
+    def test_osd_out_in(self, cluster):
+        rc, _ = _run(cluster, "osd", "out", "2")
+        assert rc == 0
+        rc, out = _run(cluster, "osd", "dump")
+        assert json.loads(out)["osd_weight"][2] == 0
+        rc, _ = _run(cluster, "osd", "in", "2")
+        assert rc == 0
+
+    def test_daemon_admin_socket(self, cluster):
+        osd = next(iter(cluster.osds.values()))
+        old = sys.stdout
+        sys.stdout = buf = _io.StringIO()
+        try:
+            rc = ceph_main(["daemon", osd.admin_socket.path,
+                            "perf", "dump"])
+        finally:
+            sys.stdout = old
+        assert rc == 0
+        assert f"osd.{osd.whoami}" in json.loads(buf.getvalue())

@@ -19,7 +19,12 @@ def _wait_states(r, pred, timeout=40.0):
     deadline = time.monotonic() + timeout
     last = None
     while time.monotonic() < deadline:
-        out = _status(r)
+        try:
+            out = _status(r)
+        except (TimeoutError, ConnectionError):
+            # mid-election the mons refuse/redirect; keep polling
+            time.sleep(0.3)
+            continue
         last = out.get("pg_states")
         if pred(out):
             return out
@@ -74,5 +79,25 @@ class TestPGMapStatus:
             for st in dump["pg_stats"].values():
                 assert st["state"] == "active+clean"
             assert dump["osd_stats"]
+        finally:
+            c.stop()
+
+
+class TestMonHealth:
+    def test_mon_down_health_check(self):
+        c = MiniCluster(n_mons=3, n_osds=2)
+        try:
+            c.start()
+            r = c.rados()
+            r.create_pool("mh", pg_num=2, size=2)
+            _wait_states(r, lambda o: o["health"] == "HEALTH_OK")
+            # kill a non-leader mon: quorum persists, health warns
+            leader = next(m.rank for m in c.mons if m.is_leader)
+            victim = next(m for m in c.mons if m.rank != leader)
+            victim.shutdown()
+            out = _wait_states(
+                r, lambda o: any(ch["code"] == "MON_DOWN"
+                                 for ch in o["checks"]))
+            assert out["health"] == "HEALTH_WARN"
         finally:
             c.stop()
