@@ -60,7 +60,7 @@ class MiniCluster:
             self.start_osd(i)
         return self
 
-    def start_osd(self, i: int, timeout: float = 15.0) -> OSDaemon:
+    def start_osd(self, i: int, timeout: float = 30.0) -> OSDaemon:
         store = self._osd_stores[i] if self._osd_stores else None
         osd = OSDaemon(i, self.monmap, store=store)
         osd.start(wait_for_up=True, timeout=timeout)
@@ -84,7 +84,7 @@ class MiniCluster:
                                 enumerate(self._osd_stores)}
         self._osd_stores[i] = osd.store
 
-    def revive_osd(self, i: int, timeout: float = 15.0) -> OSDaemon:
+    def revive_osd(self, i: int, timeout: float = 30.0) -> OSDaemon:
         return self.start_osd(i, timeout=timeout)
 
     def stop(self):
