@@ -3,3 +3,4 @@ SURVEY.md §3.10): Python modules that observe cluster maps and steer
 them through mon commands.  First resident: the upmap balancer."""
 
 from .balancer import UpmapBalancer  # noqa: F401
+from .exporter import Exporter, ExporterService  # noqa: F401

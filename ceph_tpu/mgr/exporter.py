@@ -1,0 +1,135 @@
+"""Prometheus exporter — cluster + daemon metrics over HTTP.
+
+Reference behavior re-created (``src/pybind/mgr/prometheus/
+module.py``; SURVEY.md §3.10): scrape-on-demand ``GET /metrics`` in
+the Prometheus text exposition format, fed from the mon's
+health/status/PGMap (cluster health, osd up/in counts, PG states,
+object counts) and from live daemons' PerfCounters via their admin
+sockets (op counts, latency sums, recovery/scrub counters).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..core.admin_socket import admin_command
+
+_HEALTH_VAL = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
+
+
+def _san(name: str) -> str:
+    return "".join(ch if ch.isalnum() or ch == "_" else "_"
+                   for ch in name)
+
+
+class Exporter:
+    def __init__(self, monc, asok_paths: dict[str, str] | None = None):
+        """monc: a MonClient; asok_paths: daemon name → admin socket
+        (scraped for perf counters)."""
+        self.monc = monc
+        self.asok_paths = dict(asok_paths or {})
+
+    def collect(self) -> str:
+        lines: list[str] = []
+
+        def emit(name, value, labels=None, help_=None, typ="gauge"):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {typ}")
+            lab = ""
+            if labels:
+                lab = "{" + ",".join(
+                    f'{k}="{v}"' for k, v in labels.items()) + "}"
+            lines.append(f"{name}{lab} {value}")
+
+        try:
+            rc, _, st = self.monc.command({"prefix": "status"})
+        except Exception:
+            rc, st = -1, None
+        if rc == 0 and st:
+            emit("ceph_health_status",
+                 _HEALTH_VAL.get(st.get("health"), 2),
+                 help_="cluster health (0=OK 1=WARN 2=ERR)")
+            emit("ceph_osd_up", st.get("num_up_osds", 0),
+                 help_="OSDs up")
+            emit("ceph_osd_total", st.get("num_osds", 0),
+                 help_="OSDs known")
+            emit("ceph_mon_quorum_count",
+                 len(st.get("quorum") or []),
+                 help_="mons in quorum")
+            emit("ceph_pg_total", st.get("num_pgs", 0),
+                 help_="placement groups")
+            emit("ceph_objects_total", st.get("num_objects", 0),
+                 help_="objects (primary-reported)")
+            first = True
+            for state, n in sorted(
+                    (st.get("pg_states") or {}).items()):
+                emit("ceph_pg_state", n,
+                     labels={"state": state},
+                     help_="PGs by state" if first else None)
+                first = False
+
+        for daemon, path in sorted(self.asok_paths.items()):
+            try:
+                dump = admin_command(path, "perf dump")
+            except Exception:
+                continue        # daemon down: skip its series
+            # one metric FAMILY per counter, instance in the
+            # ceph_daemon label (reference prometheus module's
+            # shape) — sum(ceph_osd_op) must aggregate across OSDs
+            dtype = _san(daemon.split(".", 1)[0])
+            for counters in dump.values():
+                for cname, val in counters.items():
+                    base = f"ceph_{dtype}_{_san(cname)}"
+                    lab = {"ceph_daemon": daemon}
+                    if isinstance(val, dict):
+                        if "avgcount" in val:
+                            emit(base + "_sum", val.get("sum", 0),
+                                 labels=lab)
+                            emit(base + "_count",
+                                 val.get("avgcount", 0), labels=lab)
+                    else:
+                        emit(base, val, labels=lab)
+        return "\n".join(lines) + "\n"
+
+
+class ExporterService:
+    """HTTP frontend: GET /metrics (reference module's scrape port)."""
+
+    def __init__(self, exporter: Exporter, host: str = "127.0.0.1",
+                 port: int = 0):
+        ex = exporter
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                from urllib.parse import urlsplit
+                if urlsplit(self.path).path.rstrip("/") not in \
+                        ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = ex.collect().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="mgr-exporter",
+            daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

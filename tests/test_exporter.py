@@ -1,0 +1,55 @@
+"""Prometheus exporter mgr module (reference pybind/mgr/prometheus)."""
+
+import http.client
+import time
+
+import pytest
+
+from ceph_tpu.mgr import Exporter, ExporterService
+from ceph_tpu.vstart import MiniCluster
+
+
+class TestExporter:
+    def test_metrics_endpoint(self):
+        c = MiniCluster(n_mons=1, n_osds=2)
+        try:
+            c.start()
+            r = c.rados()
+            r.create_pool("mx", pg_num=4, size=2)
+            io = r.open_ioctx("mx")
+            c.wait_for_clean()
+            for i in range(3):
+                io.write_full(f"m{i}", b"bytes")
+            asoks = {f"osd.{i}": o.admin_socket.path
+                     for i, o in c.osds.items()}
+            asoks["mon.0"] = c.mons[0].admin_socket.path
+            svc = ExporterService(Exporter(r.monc, asoks)).start()
+            try:
+                deadline = time.monotonic() + 20
+                text = ""
+                while time.monotonic() < deadline:
+                    con = http.client.HTTPConnection(
+                        "127.0.0.1", svc.port, timeout=10)
+                    con.request("GET", "/metrics")
+                    resp = con.getresponse()
+                    assert resp.status == 200
+                    text = resp.read().decode()
+                    con.close()
+                    if 'ceph_pg_state{state="active+clean"} 4' in text \
+                            and 'ceph_osd_op{ceph_daemon="osd.0"}' \
+                            in text:
+                        break
+                    time.sleep(0.5)
+                assert "ceph_health_status 0" in text
+                assert "ceph_osd_up 2" in text
+                assert 'ceph_pg_state{state="active+clean"} 4' in text
+                # per-daemon perf counters: one family per counter,
+                # instances as labels (aggregatable)
+                assert 'ceph_osd_op{ceph_daemon="osd.0"}' in text
+                assert 'ceph_osd_op{ceph_daemon="osd.1"}' in text
+                assert 'ceph_mon_paxos_commits{ceph_daemon="mon.0"}' \
+                    in text
+            finally:
+                svc.shutdown()
+        finally:
+            c.stop()
