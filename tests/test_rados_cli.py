@@ -72,3 +72,8 @@ class TestRadosCLI:
         assert rc == 0
         summary = json.loads(out.strip().splitlines()[-1])
         assert summary["mode"] == "seq" and summary["ops"] > 0
+        rc, out = _run(c, "-p", "benchp", "bench", "1", "rand",
+                       "--json", capture=True)
+        assert rc == 0
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["mode"] == "rand" and summary["ops"] > 0
