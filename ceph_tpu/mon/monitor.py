@@ -361,6 +361,21 @@ class OSDMonitor(PaxosService):
             return 0, "", prof
         if prefix == "osd erasure-code-profile ls":
             return 0, "", sorted(self.osdmap.erasure_code_profiles)
+        if prefix == "osd reweight":
+            # fractional override weight (reference `ceph osd
+            # reweight`): 0.0..1.0 scales CRUSH acceptance without
+            # touching the map hierarchy
+            osd = int(cmd["id"])
+            w = float(cmd["weight"])
+            if not (0 <= osd < self.osdmap.max_osd):
+                return -2, f"osd.{osd} does not exist", None
+            if not 0.0 <= w <= 1.0:
+                return -22, "weight must be in [0, 1]", None
+            m = self._working()
+            m.osd_weight[osd] = int(round(w * 0x10000))
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"reweighted osd.{osd} to {w}", None
         if prefix in ("osd out", "osd in", "osd down"):
             osd = int(cmd["ids"][0] if isinstance(cmd.get("ids"), list)
                       else cmd["ids"])

@@ -4,6 +4,7 @@
     ceph -m ... osd tree | osd dump | osd stat | osd pool ls
     ceph -m ... osd pool create NAME [--pg-num N] [--size N] [--type T]
     ceph -m ... osd out ID | osd in ID | osd down ID
+    ceph -m ... osd reweight ID WEIGHT
     ceph -m ... osd pool mksnap POOL SNAP | rmsnap POOL SNAP
     ceph -m ... osd pg-upmap-items PGID FROM TO [FROM TO ...]
     ceph -m ... daemon SOCK_PATH COMMAND [k=v ...]
@@ -84,6 +85,9 @@ def _dispatch(args, rest) -> int:
         elif rest[0] == "osd" and rest[1:2] in (["out"], ["in"],
                                                 ["down"]):
             cmd = {"prefix": f"osd {rest[1]}", "ids": [int(rest[2])]}
+        elif rest[0] == "osd" and rest[1:2] == ["reweight"]:
+            cmd = {"prefix": "osd reweight", "id": int(rest[2]),
+                   "weight": float(rest[3])}
         else:
             cmd = {"prefix": " ".join(rest)}
         rc, outs, outb = mc.command(cmd)

@@ -69,3 +69,11 @@ class TestCephCLI:
             sys.stdout = old
         assert rc == 0
         assert f"osd.{osd.whoami}" in json.loads(buf.getvalue())
+
+    def test_osd_reweight(self, cluster):
+        rc, _ = _run(cluster, "osd", "reweight", "1", "0.5")
+        assert rc == 0
+        rc, out = _run(cluster, "osd", "dump")
+        assert json.loads(out)["osd_weight"][1] == 0x8000
+        rc, _ = _run(cluster, "osd", "reweight", "1", "1.0")
+        assert rc == 0
