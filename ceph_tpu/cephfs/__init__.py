@@ -1,0 +1,5 @@
+"""CephFS client (reference ``src/client/`` + ``libcephfs.h`` —
+SURVEY.md §3.9): POSIX-ish namespace ops against the active MDS, file
+data striped client-side over the data pool."""
+
+from .client import CephFS  # noqa: F401

@@ -1,0 +1,437 @@
+"""CephFS client — libcephfs-style mount + POSIX ops.
+
+Reference behavior re-created (``src/client/Client.cc``,
+``libcephfs.h``; SURVEY.md §3.9):
+
+- **mount**: subscribe to the FSMap, find the filesystem's rank-0
+  active MDS, open a session;
+- **metadata** goes through MClientRequest RPC to the MDS with
+  path-walk lookups cached as dentries (dropped on failover);
+- **file data** never touches the MDS: reads/writes map logical byte
+  ranges through the striper onto ``<ino-hex>.<objno-08x>`` objects in
+  the data pool, exactly the reference's object naming;
+- **failover**: a dead MDS connection re-resolves the active from the
+  FSMap and resends in-flight requests under their original tids —
+  the MDS's journaled completed-request set makes resends idempotent;
+- **cap-flush analog**: size/mtime propagate to the MDS via setattr on
+  close/fsync (the reference's Fw dirty-cap flush).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..mds import messages as M
+from ..mds.daemon import ROOT_INO, data_oid
+from ..mds.fsmap import FSMap
+from ..mon.client import MonClient
+from ..msg import Dispatcher, EntityAddr, Messenger
+from ..osdc.librados import Error, IoCtx, ObjectNotFound, Rados
+from ..osdc.striper import FileLayout, file_to_extents
+
+
+class CephFSError(OSError):
+    def __init__(self, rc: int, msg: str = ""):
+        super().__init__(-rc, msg or f"rc={rc}")
+        self.rc = rc
+
+
+def _split(path: str) -> list[str]:
+    return [p for p in path.split("/") if p]
+
+
+class _Fd:
+    def __init__(self, path, parent_ino, name, rec, mode):
+        self.path = path
+        self.parent_ino = parent_ino
+        self.name = name
+        self.rec = dict(rec)
+        self.mode = mode
+        self.dirty = False
+
+
+class CephFS(Dispatcher):
+    """One mounted filesystem (reference ``struct ceph_mount_info``)."""
+
+    def __init__(self, monmap, fs_name: str | None = None,
+                 entity: str | None = None,
+                 default_layout: FileLayout | None = None):
+        self.monmap = monmap
+        self.fs_name = fs_name
+        self.entity = entity or f"client.fs{id(self) & 0xFFFF:04x}"
+        self.default_layout = default_layout or FileLayout()
+        self.monc = MonClient(monmap, entity=self.entity)
+        self.msgr = Messenger(self.entity)
+        self.msgr.add_dispatcher(self)
+        self.rados: Rados | None = None
+        self.data: IoCtx | None = None
+        self.fsmap = FSMap()
+        self.fscid = -1
+        self._mds_con = None
+        self._lock = threading.Lock()
+        self._tid = 0
+        self._waiters: dict[int, tuple[threading.Event, list]] = {}
+        self._dcache: dict[tuple[int, str], dict] = {}
+        self._fds: dict[int, _Fd] = {}
+        self._next_fd = 3
+        self.mounted = False
+
+    # -- mount / session ---------------------------------------------------
+    def mount(self, timeout: float = 20.0) -> "CephFS":
+        self.monc.on_fsmap = self._on_fsmap
+        self.monc.sub_want("fsmap", 0)
+        self.monc.wait_for_fsmap(1, timeout)
+        deadline = time.monotonic() + timeout
+        fs = None
+        while time.monotonic() < deadline:
+            with self._lock:
+                fs = (self.fsmap.fs_by_name(self.fs_name)
+                      if self.fs_name else
+                      next(iter(self.fsmap.filesystems.values()), None))
+                if fs is not None and \
+                        self.fsmap.active_for(fs.fscid) is not None:
+                    break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError(f"no active MDS for {self.fs_name!r}")
+        self.fscid = fs.fscid
+        self.rados = Rados(self.monmap,
+                           name=f"{self.entity}-data").connect()
+        self.data = IoCtx(self.rados, fs.data_pool, "")
+        self._connect_mds(timeout)
+        self.mounted = True
+        return self
+
+    def unmount(self):
+        self.mounted = False
+        for fd in list(self._fds):
+            try:
+                self.close(fd)
+            except (CephFSError, TimeoutError, ConnectionError):
+                pass
+        if self._mds_con is not None:
+            try:
+                self._mds_con.send_message(M.MClientSession(
+                    op="request_close", client=self.entity, seq=0))
+            except ConnectionError:
+                pass
+        if self.rados is not None:
+            self.rados.shutdown()
+            self.rados = None
+        self.monc.shutdown()
+        self.msgr.shutdown()
+
+    def _on_fsmap(self, epoch: int, fsmap_dict: dict):
+        with self._lock:
+            self.fsmap = FSMap.from_dict(fsmap_dict)
+
+    def _connect_mds(self, timeout: float = 20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                active = self.fsmap.active_for(self.fscid)
+            if active is not None:
+                try:
+                    con = self.msgr.connect_to(
+                        EntityAddr(active.addr[0], active.addr[1]))
+                    con.send_message(M.MClientSession(
+                        op="request_open", client=self.entity, seq=1))
+                    self._mds_con = con
+                    return
+                except (ConnectionError, OSError):
+                    pass
+            time.sleep(0.1)
+        raise TimeoutError("could not reach an active MDS")
+
+    # -- RPC ---------------------------------------------------------------
+    def _request(self, op: str, args: dict, timeout: float = 20.0):
+        """Send one metadata op; survive MDS failover by re-resolving
+        the active and resending under the same tid."""
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+            ev = threading.Event()
+            self._waiters[tid] = (ev, [])
+        msg = M.MClientRequest(tid=tid, client=self.entity, op=op,
+                               args=args)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            con = self._mds_con
+            try:
+                if con is None:
+                    raise ConnectionError("no mds session")
+                con.send_message(msg)
+            except (ConnectionError, OSError):
+                self._mds_con = None
+                self._dcache.clear()
+                try:
+                    self._connect_mds(
+                        max(0.2, deadline - time.monotonic()))
+                except TimeoutError:
+                    break
+                continue
+            if ev.wait(min(2.0, max(0.1, deadline - time.monotonic()))):
+                with self._lock:
+                    _, box = self._waiters.pop(tid)
+                reply = box[0]
+                if reply.rc == -108:     # target went standby mid-op
+                    with self._lock:
+                        self._waiters[tid] = (ev, box)
+                        box.clear()
+                        ev.clear()
+                    self._mds_con = None
+                    continue
+                if reply.rc != 0:
+                    raise CephFSError(reply.rc, reply.outs or "")
+                return reply.result
+            # silence: connection may be dead (killed MDS) — probe it
+            if con is not None and not con.is_connected:
+                self._mds_con = None
+        with self._lock:
+            self._waiters.pop(tid, None)
+        raise TimeoutError(f"mds op {op} timed out")
+
+    def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, M.MClientReply):
+            with self._lock:
+                waiter = self._waiters.get(msg.tid)
+                if waiter:
+                    waiter[1].append(msg)
+                    waiter[0].set()
+            return True
+        if isinstance(msg, M.MClientSession):
+            return True
+        return False
+
+    def ms_handle_reset(self, con):
+        if con is self._mds_con:
+            self._mds_con = None
+
+    # -- path resolution ---------------------------------------------------
+    def _resolve_dir(self, parts: list[str]) -> int:
+        """Walk to the directory holding parts[-1]; → its ino."""
+        ino = ROOT_INO
+        for name in parts[:-1]:
+            rec = self._lookup(ino, name)
+            if rec["type"] != "dir":
+                raise CephFSError(-20, f"{name!r} is not a directory")
+            ino = rec["ino"]
+        return ino
+
+    def _lookup(self, dino: int, name: str) -> dict:
+        key = (dino, name)
+        rec = self._dcache.get(key)
+        if rec is None:
+            rec = self._request("lookup", {"dir": dino, "name": name})
+            self._dcache[key] = rec
+        return rec
+
+    def _resolve(self, path: str) -> tuple[int, str, dict]:
+        """→ (parent_ino, name, rec); root is (1, "", root_rec)."""
+        parts = _split(path)
+        if not parts:
+            return ROOT_INO, "", {"ino": ROOT_INO, "type": "dir",
+                                  "size": 0, "mtime": 0}
+        dino = self._resolve_dir(parts)
+        return dino, parts[-1], self._lookup(dino, parts[-1])
+
+    # -- namespace ops -----------------------------------------------------
+    def mkdir(self, path: str):
+        parts = _split(path)
+        if not parts:
+            raise CephFSError(-17, "/ exists")
+        dino = self._resolve_dir(parts)
+        rec = self._request("mkdir", {"dir": dino, "name": parts[-1]})
+        self._dcache[(dino, parts[-1])] = rec
+
+    def mkdirs(self, path: str):
+        parts = _split(path)
+        for i in range(1, len(parts) + 1):
+            try:
+                self.mkdir("/".join(parts[:i]))
+            except CephFSError as e:
+                if e.rc != -17:
+                    raise
+
+    def readdir(self, path: str) -> list[tuple[str, dict]]:
+        _, _, rec = self._resolve(path)
+        if rec["type"] != "dir":
+            raise CephFSError(-20, f"{path!r} is not a directory")
+        out = self._request("readdir", {"dir": rec["ino"]})
+        return [(name, r) for name, r in out]
+
+    def listdir(self, path: str) -> list[str]:
+        return [name for name, _ in self.readdir(path)]
+
+    def stat(self, path: str) -> dict:
+        _, _, rec = self._resolve(path)
+        for fd in self._fds.values():
+            if fd.rec["ino"] == rec["ino"] and fd.dirty:
+                return dict(fd.rec)     # unflushed size is newer
+        return rec
+
+    def unlink(self, path: str):
+        dino, name, _rec = self._resolve(path)
+        self._request("unlink", {"dir": dino, "name": name})
+        self._dcache.pop((dino, name), None)
+
+    def rmdir(self, path: str):
+        dino, name, _rec = self._resolve(path)
+        self._request("rmdir", {"dir": dino, "name": name})
+        self._dcache.pop((dino, name), None)
+
+    def rename(self, src: str, dst: str):
+        sparts, dparts = _split(src), _split(dst)
+        if not sparts or not dparts:
+            raise CephFSError(-22, "cannot rename /")
+        sdino = self._resolve_dir(sparts)
+        ddino = self._resolve_dir(dparts)
+        self._request("rename", {
+            "sdir": sdino, "sname": sparts[-1],
+            "ddir": ddino, "dname": dparts[-1]})
+        self._dcache.pop((sdino, sparts[-1]), None)
+        self._dcache.pop((ddino, dparts[-1]), None)
+
+    # -- file I/O ----------------------------------------------------------
+    def open(self, path: str, flags: str = "r",
+             layout: FileLayout | None = None) -> int:
+        """flags: 'r', 'w' (create+truncate), 'a', 'x' (excl create)."""
+        parts = _split(path)
+        if not parts:
+            raise CephFSError(-21, "/ is a directory")
+        dino = self._resolve_dir(parts)
+        name = parts[-1]
+        if flags in ("w", "a", "x"):
+            lay = layout or self.default_layout
+            args = {"dir": dino, "name": name,
+                    "layout": {"stripe_unit": lay.stripe_unit,
+                               "stripe_count": lay.stripe_count,
+                               "object_size": lay.object_size}}
+            if flags == "x":
+                args["excl"] = True
+            rec = self._request("create", args)
+            self._dcache[(dino, name)] = rec
+            if flags == "w" and rec.get("size", 0):
+                rec = self._truncate_fd_rec(dino, name, rec, 0)
+        else:
+            rec = self._lookup(dino, name)
+            if rec["type"] != "file":
+                raise CephFSError(-21, f"{path!r} is a directory")
+        fd = self._next_fd
+        self._next_fd += 1
+        self._fds[fd] = _Fd(path, dino, name, rec,
+                            "r" if flags == "r" else "w")
+        return fd
+
+    def _layout_of(self, rec: dict) -> FileLayout:
+        lay = rec.get("layout")
+        if not lay:
+            return self.default_layout
+        return FileLayout(stripe_unit=lay["stripe_unit"],
+                          stripe_count=lay["stripe_count"],
+                          object_size=lay["object_size"])
+
+    def write(self, fd: int, data: bytes, offset: int | None = None
+              ) -> int:
+        f = self._fd(fd, "w")
+        off = offset if offset is not None else f.rec.get("size", 0)
+        layout = self._layout_of(f.rec)
+        for ext in file_to_extents(layout, off, len(data)):
+            lo = ext.logical_offset - off
+            self.data.write(data_oid(f.rec["ino"], ext.object_no),
+                            data[lo:lo + ext.length], off=ext.offset)
+        end = off + len(data)
+        if end > f.rec.get("size", 0):
+            f.rec["size"] = end
+        f.rec["mtime"] = time.time()
+        f.dirty = True
+        return len(data)
+
+    def read(self, fd: int, size: int | None = None,
+             offset: int = 0) -> bytes:
+        f = self._fd(fd, None)
+        fsize = f.rec.get("size", 0)
+        if size is None:
+            size = max(0, fsize - offset)
+        size = min(size, max(0, fsize - offset))
+        if size == 0:
+            return b""
+        layout = self._layout_of(f.rec)
+        out = bytearray(size)
+        for ext in file_to_extents(layout, offset, size):
+            try:
+                chunk = self.data.read(
+                    data_oid(f.rec["ino"], ext.object_no),
+                    length=ext.length, off=ext.offset)
+            except ObjectNotFound:
+                chunk = b""                  # hole
+            lo = ext.logical_offset - offset
+            out[lo:lo + len(chunk)] = chunk
+        return bytes(out)
+
+    def fsync(self, fd: int):
+        f = self._fd(fd, None)
+        if f.dirty:
+            rec = self._request("setattr", {
+                "dir": f.parent_ino, "name": f.name,
+                "size": f.rec["size"], "mtime": f.rec["mtime"]})
+            f.rec = dict(rec)
+            self._dcache[(f.parent_ino, f.name)] = rec
+            f.dirty = False
+
+    def close(self, fd: int):
+        self.fsync(fd)
+        self._fds.pop(fd, None)
+
+    def truncate(self, path: str, size: int):
+        dino, name, rec = self._resolve(path)
+        self._truncate_fd_rec(dino, name, rec, size)
+
+    def _truncate_fd_rec(self, dino, name, rec, size) -> dict:
+        old = rec.get("size", 0)
+        new = self._request("setattr", {"dir": dino, "name": name,
+                                        "size": size,
+                                        "mtime": time.time()})
+        self._dcache[(dino, name)] = new
+        if size < old:
+            layout = self._layout_of(rec)
+            first_dead = -(-size // layout.object_size)
+            last = max(0, -(-old // layout.object_size))
+            for objno in range(first_dead, last):
+                try:
+                    self.data.remove(data_oid(rec["ino"], objno))
+                except (ObjectNotFound, Error):
+                    pass
+            if size % layout.object_size and size > 0:
+                objno = size // layout.object_size
+                try:
+                    self.data.truncate(data_oid(rec["ino"], objno),
+                                       size % layout.object_size)
+                except (ObjectNotFound, Error):
+                    pass
+        return new
+
+    # -- helpers -----------------------------------------------------------
+    def _fd(self, fd: int, need: str | None) -> _Fd:
+        f = self._fds.get(fd)
+        if f is None:
+            raise CephFSError(-9, f"bad fd {fd}")
+        if need == "w" and f.mode != "w":
+            raise CephFSError(-9, "fd not open for write")
+        return f
+
+    def write_file(self, path: str, data: bytes,
+                   layout: FileLayout | None = None):
+        fd = self.open(path, "w", layout=layout)
+        try:
+            self.write(fd, data, 0)
+        finally:
+            self.close(fd)
+
+    def read_file(self, path: str) -> bytes:
+        fd = self.open(path, "r")
+        try:
+            return self.read(fd)
+        finally:
+            self.close(fd)

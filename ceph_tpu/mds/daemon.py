@@ -1,0 +1,548 @@
+"""MDS daemon — the CephFS metadata server.
+
+Reference behavior re-created (``src/mds/MDSRank.cc``, ``Server.cc``,
+``MDCache.cc``, ``MDLog.cc``; SURVEY.md §3.9):
+
+- **standby → active**: beacons to the mons; the MDSMonitor promotes a
+  standby into a filesystem's rank 0 and everyone learns it from the
+  FSMap (beacon timeout = failover, reference MDSMonitor::tick);
+- **metadata in RADOS**: each directory is a *dirfrag object* in the
+  metadata pool (``<ino-hex>.00000000``) whose omap maps dentry name →
+  inode record — the reference's CDir backing store exactly;
+- **write-ahead journal** (reference MDLog): every mutation appends an
+  event to the journal object's omap and is acknowledged from the
+  journal, not the dirfrags; dirty dirfrags flush lazily and the
+  journal trims behind them.  A newly-active MDS **replays** the
+  journal into the backing store before serving — metadata acked
+  before a crash survives the failover;
+- **sessions + request dedup**: journal events carry (client, tid);
+  replay rebuilds the completed-request set so a client resending
+  across a failover gets its original answer, not EEXIST (reference
+  session completed_requests);
+- **never on the data path**: file bytes flow client↔OSD through the
+  striper; the MDS only tracks size/mtime via setattr (cap flush
+  analog) and purges data objects on unlink.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..mon import messages as MM
+from ..mon.client import MonClient
+from ..msg import Dispatcher, Messenger
+from ..osdc.librados import IoCtx, ObjectNotFound, Rados
+from . import messages as M
+from .fsmap import FSMap, STATE_ACTIVE
+
+ROOT_INO = 1
+INO_CHUNK = 128          # inode numbers claimed per journal event
+JHEAD = "mds{rank}_journal"
+INOTABLE = "mds{rank}_inotable"
+
+
+def dirfrag_oid(ino: int) -> str:
+    return f"{ino:x}.00000000"
+
+
+def data_oid(ino: int, objno: int) -> str:
+    """File data object name (reference ``<ino-hex>.<objno-08x>``)."""
+    return f"{ino:x}.{objno:08x}"
+
+
+def _now() -> float:
+    return time.time()
+
+
+class MDSDaemon(Dispatcher):
+    def __init__(self, name: str, monmap, *,
+                 beacon_interval: float = 0.4,
+                 flush_interval: float = 2.0):
+        self.name = name
+        self.monmap = monmap
+        self.beacon_interval = beacon_interval
+        self.flush_interval = flush_interval
+        self.monc = MonClient(monmap, entity=f"mds.{name}")
+        self.msgr = Messenger(f"mds.{name}")
+        self.msgr.add_dispatcher(self)
+        self.lock = threading.RLock()
+        self.state = "boot"           # boot / standby / active
+        self.fsmap = FSMap()
+        self.rank = -1
+        self.addr = None
+        self.running = False
+        self._beacon_seq = 0
+        self._thread: threading.Thread | None = None
+        # active-state machinery
+        self.rados: Rados | None = None
+        self.meta: IoCtx | None = None
+        self.data: IoCtx | None = None
+        # dir ino → {dentry: inode record}; dirty deltas per dir
+        self._dirs: dict[int, dict[str, dict]] = {}
+        self._dirty_set: dict[int, dict[str, dict]] = {}
+        self._dirty_rm: dict[int, set[str]] = {}
+        self._jseq = 0                # next journal event seq
+        self._jfirst = 0              # lowest unflushed journal seq
+        self._completed: dict[tuple[str, int], dict] = {}
+        self._next_ino = 0
+        self._ino_limit = 0
+        self._last_flush = 0.0
+        self.sessions: dict[str, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self.addr = self.msgr.bind()
+        self.running = True
+        self.monc.on_fsmap = self._on_fsmap
+        self.monc.sub_want("fsmap", 0)
+        self._send_beacon()
+        self.state = "standby"
+        self._thread = threading.Thread(
+            target=self._beacon_loop, name=f"mds.{self.name}-beacon",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.running = False
+        with self.lock:
+            if self.state == "active":
+                try:
+                    self._flush(trim=True)
+                except Exception:     # noqa: BLE001 — fs may be gone
+                    pass
+        if self.rados is not None:
+            self.rados.shutdown()
+            self.rados = None
+        self.monc.shutdown()
+        self.msgr.shutdown()
+
+    def kill(self):
+        """Hard-stop without flushing — the failover test's crash:
+        journaled-but-unflushed metadata must survive via replay."""
+        self.running = False
+        if self.rados is not None:
+            self.rados.shutdown()
+            self.rados = None
+        self.monc.shutdown()
+        self.msgr.shutdown()
+
+    def _send_beacon(self):
+        self._beacon_seq += 1
+        self.monc.send(MM.MMDSBeacon(
+            name=self.name, addr=[self.addr.host, self.addr.port],
+            state=self.state, seq=self._beacon_seq))
+
+    def _beacon_loop(self):
+        while self.running:
+            self._send_beacon()
+            with self.lock:
+                if self.state == "active" and self.meta is not None \
+                        and _now() - self._last_flush \
+                        > self.flush_interval:
+                    try:
+                        self._flush(trim=True)
+                    except Exception:   # noqa: BLE001 — cluster churn;
+                        pass            # journal still has everything
+                elif self.state != "active":
+                    # a transiently failed activation retries while
+                    # the map still names us active (pools may have
+                    # been mid-create on the first attempt)
+                    me = self.fsmap.mds_info.get(self.name)
+                    if me is not None and me.state == STATE_ACTIVE:
+                        try:
+                            self._activate(me.fscid, me.rank)
+                        except Exception:   # noqa: BLE001
+                            pass
+            time.sleep(self.beacon_interval)
+
+    # -- fsmap consumption -------------------------------------------------
+    def _on_fsmap(self, epoch: int, fsmap_dict: dict):
+        with self.lock:
+            self.fsmap = FSMap.from_dict(fsmap_dict)
+            me = self.fsmap.mds_info.get(self.name)
+            if me is not None and me.state == STATE_ACTIVE \
+                    and self.state != "active":
+                try:
+                    self._activate(me.fscid, me.rank)
+                except Exception:   # noqa: BLE001 — pools may still be
+                    # creating; the next fsmap push (or beacon-driven
+                    # re-promotion) retries
+                    self.state = "standby"
+            elif (me is None or me.state != STATE_ACTIVE) \
+                    and self.state == "active":
+                # mon failed us (partition zombie): drop rank, the
+                # reference respawns — we fall back to standby
+                self._deactivate()
+
+    # -- activation / journal replay --------------------------------------
+    def _activate(self, fscid: int, rank: int):
+        fs = self.fsmap.filesystems[fscid]
+        try:
+            self.rados = Rados(self.monmap,
+                               name=f"client.mds-{self.name}").connect()
+            self.meta = IoCtx(self.rados, fs.metadata_pool, "")
+            self.data = IoCtx(self.rados, fs.data_pool, "")
+            self.rank = rank
+            self._dirs.clear()
+            self._dirty_set.clear()
+            self._dirty_rm.clear()
+            self._completed.clear()
+            self._replay_journal()
+            self._load_inotable()
+        except Exception:
+            if self.rados is not None:
+                self.rados.shutdown()
+                self.rados = None
+            self.meta = self.data = None
+            raise
+        self.state = "active"
+        self._last_flush = _now()
+        self._send_beacon()
+
+    def _deactivate(self):
+        self.state = "standby"
+        self.rank = -1
+        self._dirs.clear()
+        self._dirty_set.clear()
+        self._dirty_rm.clear()
+        self.sessions.clear()
+        if self.rados is not None:
+            self.rados.shutdown()
+            self.rados = None
+        self.meta = self.data = None
+
+    @property
+    def _journal_oid(self) -> str:
+        return JHEAD.format(rank=max(self.rank, 0))
+
+    @property
+    def _inotable_oid(self) -> str:
+        return INOTABLE.format(rank=max(self.rank, 0))
+
+    def _replay_journal(self):
+        """Apply every journaled event to the backing dirfrags, then
+        trim (reference MDLog replay on rank takeover)."""
+        try:
+            entries = self.meta.omap_get(self._journal_oid)
+        except ObjectNotFound:
+            self._jseq = self._jfirst = 1
+            return
+        seqs = sorted(int(k[1:]) for k in entries if k.startswith("e"))
+        for seq in seqs:
+            ev = json.loads(entries[f"e{seq:020d}"].decode())
+            self._apply_event(ev)
+            if ev.get("client") is not None:
+                self._completed[(ev["client"], ev["tid"])] = \
+                    ev.get("reply", {"rc": 0})
+        self._jseq = (seqs[-1] + 1) if seqs else 1
+        self._jfirst = seqs[0] if seqs else self._jseq
+        self._flush(trim=True)
+
+    def _apply_event(self, ev: dict):
+        """Events are lists of idempotent sub-ops, safe to re-apply."""
+        for sub in ev["subs"]:
+            kind = sub[0]
+            if kind == "set":
+                _, dino, name, rec = sub
+                self._dir(dino)[name] = rec
+                self._dirty_set.setdefault(dino, {})[name] = rec
+                self._dirty_rm.get(dino, set()).discard(name)
+            elif kind == "rm":
+                _, dino, name = sub
+                self._dir(dino).pop(name, None)
+                self._dirty_rm.setdefault(dino, set()).add(name)
+                self._dirty_set.get(dino, {}).pop(name, None)
+            elif kind == "inotable":
+                _, limit = sub
+                cur = self._backing_inotable()
+                if limit > cur:
+                    self.meta.omap_set(self._inotable_oid,
+                                       {"next": str(limit).encode()})
+                self._ino_limit = max(self._ino_limit, limit)
+
+    # -- inode table -------------------------------------------------------
+    def _backing_inotable(self) -> int:
+        try:
+            kv = self.meta.omap_get(self._inotable_oid)
+            return int(kv.get("next", b"0"))
+        except ObjectNotFound:
+            return 0
+
+    def _load_inotable(self):
+        base = max(self._backing_inotable(), ROOT_INO + 1,
+                   self._ino_limit)
+        self._next_ino = base
+        self._ino_limit = base
+
+    def _alloc_ino(self) -> tuple[int, list]:
+        """→ (ino, extra journal sub-ops claiming a fresh chunk)."""
+        subs = []
+        if self._next_ino >= self._ino_limit:
+            self._ino_limit = self._next_ino + INO_CHUNK
+            subs.append(["inotable", self._ino_limit])
+        ino = self._next_ino
+        self._next_ino += 1
+        return ino, subs
+
+    # -- dirfrag cache -----------------------------------------------------
+    def _dir(self, ino: int) -> dict[str, dict]:
+        d = self._dirs.get(ino)
+        if d is None:
+            try:
+                raw = self.meta.omap_get(dirfrag_oid(ino))
+                d = {k: json.loads(v.decode()) for k, v in raw.items()}
+            except ObjectNotFound:
+                d = {}
+            self._dirs[ino] = d
+        return d
+
+    def _journal(self, subs: list, client=None, tid=None, reply=None):
+        ev = {"subs": subs, "client": client, "tid": tid}
+        if reply is not None:
+            ev["reply"] = reply
+        seq = self._jseq
+        self._jseq += 1
+        self.meta.omap_set(self._journal_oid,
+                           {f"e{seq:020d}": json.dumps(ev).encode()})
+        for sub in subs:
+            if sub[0] == "inotable":
+                # table claims apply to backing immediately — a chunk
+                # must never be re-handed after replay
+                cur = self._backing_inotable()
+                if sub[1] > cur:
+                    self.meta.omap_set(self._inotable_oid,
+                                       {"next": str(sub[1]).encode()})
+
+    def _flush(self, trim: bool = False):
+        """Write dirty dirfrag deltas to their objects; optionally trim
+        the journal entries they cover (reference MDLog trim)."""
+        upto = self._jseq
+        for dino, sets in list(self._dirty_set.items()):
+            if sets:
+                self.meta.omap_set(
+                    dirfrag_oid(dino),
+                    {n: json.dumps(r).encode() for n, r in sets.items()})
+            self._dirty_set.pop(dino, None)
+        for dino, rms in list(self._dirty_rm.items()):
+            if rms:
+                try:
+                    self.meta.omap_rm_keys(dirfrag_oid(dino), sorted(rms))
+                except ObjectNotFound:
+                    pass
+            self._dirty_rm.pop(dino, None)
+        if trim and upto > self._jfirst:
+            keys = [f"e{s:020d}" for s in range(self._jfirst, upto)]
+            try:
+                self.meta.omap_rm_keys(self._journal_oid, keys)
+            except ObjectNotFound:
+                pass
+            self._jfirst = upto
+        self._last_flush = _now()
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, M.MClientSession):
+            with self.lock:
+                if msg.op == "request_open":
+                    self.sessions[msg.client] = msg.seq or 0
+                    op = "open"
+                else:
+                    self.sessions.pop(msg.client, None)
+                    op = "close"
+            try:
+                msg.connection.send_message(M.MClientSession(
+                    op=op, client=msg.client, seq=msg.seq))
+            except ConnectionError:
+                pass
+            return True
+        if isinstance(msg, M.MClientRequest):
+            with self.lock:
+                rc, outs, result = self._handle_request(msg)
+            try:
+                msg.connection.send_message(M.MClientReply(
+                    tid=msg.tid, rc=rc, outs=outs, result=result))
+            except ConnectionError:
+                pass
+            return True
+        return False
+
+    def _handle_request(self, msg) -> tuple[int, str, object]:
+        if self.state != "active":
+            return -108, "mds not active", None      # ESHUTDOWN analog
+        key = (msg.client, msg.tid)
+        if key in self._completed:
+            done = self._completed[key]
+            return done.get("rc", 0), "", done.get("result")
+        args = msg.args or {}
+        handler = getattr(self, f"_op_{msg.op}", None)
+        if handler is None:
+            return -22, f"unknown mds op {msg.op!r}", None
+        try:
+            rc, outs, result = handler(args, msg.client, msg.tid)
+        except ObjectNotFound:
+            return -2, "metadata object vanished", None
+        return rc, outs, result
+
+    # -- read ops ----------------------------------------------------------
+    @staticmethod
+    def _root_rec() -> dict:
+        return {"ino": ROOT_INO, "type": "dir", "size": 0, "mtime": 0}
+
+    def _op_lookup(self, args, client, tid):
+        dino, name = args["dir"], args["name"]
+        if dino == ROOT_INO and name == "":
+            return 0, "", self._root_rec()
+        rec = self._dir(dino).get(name)
+        if rec is None:
+            return -2, f"no dentry {name!r}", None
+        return 0, "", rec
+
+    _op_getattr = _op_lookup
+
+    def _op_readdir(self, args, client, tid):
+        d = self._dir(args["dir"])
+        return 0, "", sorted([name, rec] for name, rec in d.items())
+
+    # -- mutations (journaled, deduped) ------------------------------------
+    def _mutate(self, subs, client, tid, result=None):
+        self._journal(subs, client=client, tid=tid,
+                      reply={"rc": 0, "result": result})
+        for s in [s for s in subs if s[0] != "inotable"]:
+            self._apply_cache(s)
+        self._completed[(client, tid)] = {"rc": 0, "result": result}
+        return 0, "", result
+
+    def _apply_cache(self, sub):
+        if sub[0] == "set":
+            _, dino, name, rec = sub
+            self._dir(dino)[name] = rec
+            self._dirty_set.setdefault(dino, {})[name] = rec
+            self._dirty_rm.get(dino, set()).discard(name)
+        elif sub[0] == "rm":
+            _, dino, name = sub
+            self._dir(dino).pop(name, None)
+            self._dirty_rm.setdefault(dino, set()).add(name)
+            self._dirty_set.get(dino, {}).pop(name, None)
+
+    def _op_mkdir(self, args, client, tid):
+        dino, name = args["dir"], args["name"]
+        if name in self._dir(dino):
+            return -17, f"{name!r} exists", None
+        ino, extra = self._alloc_ino()
+        rec = {"ino": ino, "type": "dir", "size": 0, "mtime": _now()}
+        return self._mutate(extra + [["set", dino, name, rec]],
+                            client, tid, rec)
+
+    def _op_create(self, args, client, tid):
+        dino, name = args["dir"], args["name"]
+        existing = self._dir(dino).get(name)
+        if existing is not None:
+            if existing["type"] != "file":
+                return -21, f"{name!r} is a directory", None
+            if args.get("excl"):
+                return -17, f"{name!r} exists", None
+            return 0, "", existing
+        ino, extra = self._alloc_ino()
+        rec = {"ino": ino, "type": "file", "size": 0, "mtime": _now()}
+        if args.get("layout"):
+            rec["layout"] = args["layout"]
+        return self._mutate(extra + [["set", dino, name, rec]],
+                            client, tid, rec)
+
+    def _op_setattr(self, args, client, tid):
+        dino, name = args["dir"], args["name"]
+        rec = self._dir(dino).get(name)
+        if rec is None:
+            return -2, f"no dentry {name!r}", None
+        rec = dict(rec)
+        for fld in ("size", "mtime"):
+            if args.get(fld) is not None:
+                rec[fld] = args[fld]
+        return self._mutate([["set", dino, name, rec]], client, tid, rec)
+
+    def _op_unlink(self, args, client, tid):
+        dino, name = args["dir"], args["name"]
+        rec = self._dir(dino).get(name)
+        if rec is None:
+            return -2, f"no dentry {name!r}", None
+        if rec["type"] == "dir":
+            return -21, f"{name!r} is a directory", None
+        rc = self._mutate([["rm", dino, name]], client, tid)
+        self._purge_file(rec)
+        return rc
+
+    def _op_rmdir(self, args, client, tid):
+        dino, name = args["dir"], args["name"]
+        rec = self._dir(dino).get(name)
+        if rec is None:
+            return -2, f"no dentry {name!r}", None
+        if rec["type"] != "dir":
+            return -20, f"{name!r} is not a directory", None
+        if self._dir(rec["ino"]):
+            return -39, f"{name!r} not empty", None
+        rc = self._mutate([["rm", dino, name]], client, tid)
+        try:
+            self.meta.remove(dirfrag_oid(rec["ino"]))
+        except ObjectNotFound:
+            pass
+        self._dirs.pop(rec["ino"], None)
+        return rc
+
+    def _descends_from(self, root_ino: int, needle: int) -> bool:
+        """True if `needle` is `root_ino` or inside its subtree."""
+        stack = [root_ino]
+        while stack:
+            ino = stack.pop()
+            if ino == needle:
+                return True
+            stack.extend(r["ino"] for r in self._dir(ino).values()
+                         if r["type"] == "dir")
+        return False
+
+    def _op_rename(self, args, client, tid):
+        sdino, sname = args["sdir"], args["sname"]
+        ddino, dname = args["ddir"], args["dname"]
+        rec = self._dir(sdino).get(sname)
+        if rec is None:
+            return -2, f"no dentry {sname!r}", None
+        if rec["type"] == "dir" and \
+                self._descends_from(rec["ino"], ddino):
+            # POSIX EINVAL: a directory cannot move into its own
+            # subtree (the detached cycle would orphan it)
+            return -22, f"{sname!r} is an ancestor of the target", None
+        target = self._dir(ddino).get(dname)
+        purge = None
+        if target is not None and \
+                not (sdino == ddino and sname == dname):
+            if target["type"] == "dir":
+                if target["ino"] == rec["ino"]:
+                    return 0, "", rec       # rename onto itself
+                if rec["type"] != "dir":
+                    return -21, f"{dname!r} is a directory", None
+                if self._dir(target["ino"]):
+                    return -39, f"{dname!r} not empty", None
+            elif rec["type"] == "dir":
+                return -20, f"{dname!r} is not a directory", None
+            else:
+                purge = target
+        rc = self._mutate([["rm", sdino, sname],
+                           ["set", ddino, dname, rec]], client, tid, rec)
+        if purge is not None:
+            self._purge_file(purge)
+        return rc
+
+    def _purge_file(self, rec: dict):
+        """Delete a dead file's data objects (reference purge queue —
+        synchronous here; the namespace op already committed)."""
+        from ..osdc.striper import FileLayout
+        layout = rec.get("layout") or {}
+        osize = layout.get("object_size", FileLayout.object_size)
+        nobj = max(1, -(-int(rec.get("size", 0)) // osize))
+        for objno in range(nobj):
+            try:
+                self.data.remove(data_oid(rec["ino"], objno))
+            except ObjectNotFound:
+                pass

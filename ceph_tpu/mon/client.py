@@ -34,6 +34,9 @@ class MonClient(Dispatcher):
         self.osdmap_epoch = 0
         self.osdmap_dict: dict | None = None
         self.on_osdmap = None       # cb(epoch, map_dict)
+        self.fsmap_epoch = 0
+        self.fsmap_dict: dict | None = None
+        self.on_fsmap = None        # cb(epoch, fsmap_dict)
         self._lock = threading.Lock()
 
     # -- session -----------------------------------------------------------
@@ -115,16 +118,25 @@ class MonClient(Dispatcher):
         self._ensure()
         self._con.send_message(M.MMonSubscribe(what={what: start}))
 
-    def wait_for_osdmap(self, min_epoch: int = 1,
-                        timeout: float = 10.0) -> dict:
+    def _wait_for_map(self, what: str, min_epoch: int,
+                      timeout: float) -> dict:
         import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if self.osdmap_dict is not None and \
-                    self.osdmap_epoch >= min_epoch:
-                return self.osdmap_dict
+            d = getattr(self, f"{what}_dict")
+            if d is not None and \
+                    getattr(self, f"{what}_epoch") >= min_epoch:
+                return d
             time.sleep(0.02)
-        raise TimeoutError(f"osdmap epoch {min_epoch} not seen")
+        raise TimeoutError(f"{what} epoch {min_epoch} not seen")
+
+    def wait_for_fsmap(self, min_epoch: int = 1,
+                       timeout: float = 10.0) -> dict:
+        return self._wait_for_map("fsmap", min_epoch, timeout)
+
+    def wait_for_osdmap(self, min_epoch: int = 1,
+                        timeout: float = 10.0) -> dict:
+        return self._wait_for_map("osdmap", min_epoch, timeout)
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:
@@ -134,6 +146,13 @@ class MonClient(Dispatcher):
                 if waiter:
                     waiter[1].append(msg)
                     waiter[0].set()
+            return True
+        if isinstance(msg, M.MFSMapMsg):
+            if msg.epoch >= self.fsmap_epoch:
+                self.fsmap_epoch = msg.epoch
+                self.fsmap_dict = msg.fsmap
+                if self.on_fsmap:
+                    self.on_fsmap(msg.epoch, msg.fsmap)
             return True
         if isinstance(msg, M.MOSDMapMsg):
             if msg.epoch >= self.osdmap_epoch:

@@ -95,6 +95,22 @@ class MOSDAlive(_JsonMessage):
 
 
 @register_message
+class MMDSBeacon(_JsonMessage):
+    """MDS → mon: liveness + desired state (reference
+    ``src/messages/MMDSBeacon.h``).  addr is [host, port] of the MDS's
+    client-facing messenger."""
+    TYPE = 27
+    FIELDS = ("name", "addr", "state", "seq", "fwd")
+
+
+@register_message
+class MFSMapMsg(_JsonMessage):
+    """Mon → subscriber: full FSMap push (reference MFSMap)."""
+    TYPE = 28
+    FIELDS = ("epoch", "fsmap")
+
+
+@register_message
 class MPGStats(_JsonMessage):
     """Primary OSD → mon: per-PG state/object counts (reference
     MPGStats → PGMap aggregation, ``src/mon/PGMap.cc``).  pg_stats:

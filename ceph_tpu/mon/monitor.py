@@ -29,6 +29,8 @@ from dataclasses import dataclass, field
 from ..core.auth import CryptoKey, KeyRing
 from ..core.threading_utils import SafeTimer
 from ..crush.compiler import crushmap_from_dict
+from ..mds.fsmap import (FSMap, Filesystem, MDSInfo, STATE_ACTIVE,
+                         STATE_STANDBY)
 from ..msg import Dispatcher, EntityAddr, Messenger
 from ..osd.osdmap import (EXISTS, OSDMap, PGid, TYPE_ERASURE,
                           TYPE_REPLICATED, UP)
@@ -98,6 +100,9 @@ class PaxosService:
         """→ (rc, status, output) or None if not mine.  Mutating
         handlers stage ops and the monitor proposes after."""
         return None
+
+    def tick(self):
+        """Periodic leader-side work (liveness checks etc.)."""
 
 
 class OSDMonitor(PaxosService):
@@ -443,6 +448,170 @@ class OSDMonitor(PaxosService):
         return {"nodes": nodes}
 
 
+class MDSMonitor(PaxosService):
+    """FSMap service: fs create/remove, MDS beacons, rank assignment,
+    beacon-timeout failover (reference ``src/mon/MDSMonitor.cc``)."""
+
+    NAME = "fsmap"
+    BEACON_GRACE = 3.0   # seconds without a beacon → MDS failed
+
+    def __init__(self, mon):
+        super().__init__(mon)
+        self.fsmap = FSMap()
+        self.pending_fsmap: FSMap | None = None
+        self.last_beacon: dict[str, float] = {}   # in-memory, leader
+
+    def create_initial(self):
+        self.fsmap.epoch = 1
+        self.stage("put", 1, json.dumps(self.fsmap.to_dict()))
+        self.stage("put", "last_epoch", "1")
+
+    def update_from_store(self):
+        epoch = self.mon.store.get_int(self.prefix, "last_epoch")
+        if epoch > self.fsmap.epoch:
+            blob = self.mon.store.get_str(self.prefix, epoch)
+            if blob:
+                d = json.loads(blob)
+                self.fsmap = FSMap.from_dict(d)
+                self.mon.push_map("fsmap", epoch, d)
+        if self.pending_fsmap is not None and \
+                self.fsmap.epoch >= self.pending_fsmap.epoch:
+            self.pending_fsmap = None
+
+    # -- staging -----------------------------------------------------------
+    def _working(self) -> FSMap:
+        base = self.pending_fsmap if self.pending_fsmap is not None \
+            else self.fsmap
+        return FSMap.from_dict(base.to_dict())
+
+    def _stage_map(self, m: FSMap):
+        m.epoch += 1
+        self.stage("put", m.epoch, json.dumps(m.to_dict()))
+        self.stage("put", "last_epoch", str(m.epoch))
+        self.pending_fsmap = m
+
+    @staticmethod
+    def _assign_ranks(m: FSMap) -> bool:
+        """Promote standbys into any filesystem missing its rank-0
+        active (the takeover path of reference
+        MDSMonitor::maybe_promote_standby)."""
+        changed = False
+        for fs in m.filesystems.values():
+            if m.active_for(fs.fscid) is None:
+                sbs = sorted(m.standbys(), key=lambda i: i.name)
+                if sbs:
+                    sb = sbs[0]
+                    sb.state = STATE_ACTIVE
+                    sb.rank = 0
+                    sb.fscid = fs.fscid
+                    changed = True
+        return changed
+
+    # -- beacons (leader) --------------------------------------------------
+    def handle_beacon(self, name: str, addr, state: str, seq):
+        self.last_beacon[name] = time.monotonic()
+        cur = self.pending_fsmap if self.pending_fsmap is not None \
+            else self.fsmap
+        known = cur.mds_info.get(name)
+        if known is not None and known.addr == list(addr or []):
+            return                       # steady-state heartbeat
+        m = self._working()
+        m.mds_info[name] = MDSInfo(name=name, addr=list(addr or []))
+        self._assign_ranks(m)
+        self._stage_map(m)
+        self.mon.propose()
+
+    def tick(self):
+        now = time.monotonic()
+        cur = self.pending_fsmap if self.pending_fsmap is not None \
+            else self.fsmap
+        stale = []
+        for name in cur.mds_info:
+            # unseen-by-this-leader entries get a fresh grace window
+            self.last_beacon.setdefault(name, now)
+            if now - self.last_beacon[name] > self.BEACON_GRACE:
+                stale.append(name)
+        # read-only probe first: copying the map 4×/sec in steady
+        # state is pointless work
+        needs_promotion = any(
+            cur.active_for(fs.fscid) is None
+            for fs in cur.filesystems.values()) and cur.standbys()
+        if not stale and not needs_promotion:
+            return
+        m = self._working()
+        for name in stale:
+            m.mds_info.pop(name, None)
+            self.last_beacon.pop(name, None)
+        changed = bool(stale)
+        if self._assign_ranks(m):
+            changed = True
+        if changed:
+            self._stage_map(m)
+            self.mon.propose()
+
+    # -- commands ----------------------------------------------------------
+    def dispatch_command(self, cmd):
+        prefix = cmd.get("prefix", "")
+        if prefix == "fs new":
+            name = cmd["fs_name"]
+            if self.fsmap.fs_by_name(name) is not None:
+                return -17, f"filesystem {name!r} already exists", None
+            osdmap = self.mon.services["osdmap"].osdmap
+            pools = []
+            for key in ("metadata", "data"):
+                pname = cmd[key]
+                if pname not in osdmap.pool_name:
+                    return -2, f"pool {pname!r} does not exist", None
+                pools.append(osdmap.pool_name[pname])
+            m = self._working()
+            fs = Filesystem(fscid=m.next_fscid, name=name,
+                            metadata_pool=pools[0], data_pool=pools[1])
+            m.next_fscid += 1
+            m.filesystems[fs.fscid] = fs
+            self._assign_ranks(m)
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"new fs with metadata pool {pools[0]} and " \
+                      f"data pool {pools[1]}", None
+        if prefix == "fs rm":
+            fs = self.fsmap.fs_by_name(cmd["fs_name"])
+            if fs is None:
+                return -2, f"no filesystem {cmd['fs_name']!r}", None
+            m = self._working()
+            for info in m.mds_info.values():
+                if info.fscid == fs.fscid:
+                    info.state = STATE_STANDBY
+                    info.rank = -1
+                    info.fscid = -1
+            m.filesystems.pop(fs.fscid, None)
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"removed filesystem {cmd['fs_name']!r}", None
+        if prefix == "fs ls":
+            osdmap = self.mon.services["osdmap"].osdmap
+            pname = {v: k for k, v in osdmap.pool_name.items()}
+            return 0, "", [
+                {"name": fs.name,
+                 "metadata_pool": pname.get(fs.metadata_pool,
+                                            fs.metadata_pool),
+                 "data_pools": [pname.get(fs.data_pool, fs.data_pool)]}
+                for fs in self.fsmap.filesystems.values()]
+        if prefix == "fs dump":
+            return 0, "", self.fsmap.to_dict()
+        if prefix == "mds stat":
+            # keys carry the fs name (reference "cephfs:0" style) so
+            # two filesystems' rank-0 actives can't collide
+            fsname = {c: fs.name
+                      for c, fs in self.fsmap.filesystems.items()}
+            up = {f"{fsname.get(i.fscid, i.fscid)}:mds.{i.rank}": n
+                  for n, i in self.fsmap.mds_info.items()
+                  if i.state == STATE_ACTIVE}
+            return 0, "", {
+                "epoch": self.fsmap.epoch, "up": up,
+                "standby_count": len(self.fsmap.standbys())}
+        return None
+
+
 class AuthMonitor(PaxosService):
     NAME = "auth"
 
@@ -702,8 +871,8 @@ class Monitor(Dispatcher):
         self.paxos.on_commit = self._on_paxos_commit
         self.paxos.on_active = self._on_paxos_active
         self.services: dict[str, PaxosService] = {}
-        for svc_cls in (OSDMonitor, AuthMonitor, ConfigMonitor,
-                        LogMonitor, HealthMonitor):
+        for svc_cls in (OSDMonitor, MDSMonitor, AuthMonitor,
+                        ConfigMonitor, LogMonitor, HealthMonitor):
             self.services[svc_cls.NAME] = svc_cls(self)
         self._peer_cons: dict[int, object] = {}
         self.pgmap = PGMap()
@@ -816,6 +985,9 @@ class Monitor(Dispatcher):
         osdsvc = self.services.get("osdmap")
         if osdsvc is not None:
             osdsvc.pending_map = None
+        fssvc = self.services.get("fsmap")
+        if fssvc is not None:
+            fssvc.pending_fsmap = None
         self.elector.start()
         if self.elector.state == "leader" and not was_leader:
             self.paxos.leader_collect(self.elector.quorum)
@@ -879,14 +1051,18 @@ class Monitor(Dispatcher):
     # -- subscriptions -----------------------------------------------------
     def push_map(self, what: str, epoch: int, payload: dict):
         """Called by services after a commit: feed subscribers."""
-        if what != "osdmap":
+        if what not in ("osdmap", "fsmap"):
             return
         dead = []
         for con, subs in self._subs.items():
-            if "osdmap" in subs:
+            if what in subs:
                 try:
-                    con.send_message(M.MOSDMapMsg(epoch=epoch,
-                                                  osdmap=payload))
+                    if what == "osdmap":
+                        con.send_message(M.MOSDMapMsg(epoch=epoch,
+                                                      osdmap=payload))
+                    else:
+                        con.send_message(M.MFSMapMsg(epoch=epoch,
+                                                     fsmap=payload))
                 except ConnectionError:
                     dead.append(con)
         for con in dead:
@@ -954,6 +1130,23 @@ class Monitor(Dispatcher):
                         newest=cur))
                 except ConnectionError:
                     self._subs.pop(msg.connection, None)
+            fssvc: MDSMonitor = self.services["fsmap"]
+            if "fsmap" in subs and fssvc.fsmap.epoch >= 1:
+                try:
+                    msg.connection.send_message(M.MFSMapMsg(
+                        epoch=fssvc.fsmap.epoch,
+                        fsmap=fssvc.fsmap.to_dict()))
+                except ConnectionError:
+                    self._subs.pop(msg.connection, None)
+            return True
+        if isinstance(msg, M.MMDSBeacon):
+            if self.is_leader:
+                self.services["fsmap"].handle_beacon(
+                    msg.name, msg.addr, msg.state, msg.seq)
+            elif self.elector.leader is not None and not msg.fwd:
+                self._peer_send(self.elector.leader, M.MMDSBeacon(
+                    name=msg.name, addr=msg.addr, state=msg.state,
+                    seq=msg.seq, fwd=1))
             return True
         if isinstance(msg, M.MOSDBoot):
             # forward at most ONE hop (reference
@@ -1080,6 +1273,9 @@ class Monitor(Dispatcher):
                         for svc in self.services.values():
                             svc.create_initial()
                         self.propose()
+                    elif self.paxos.last_committed > 0:
+                        for svc in self.services.values():
+                            svc.tick()
                 self._drain_outboxes()
             elif st == "peon":
                 if self.paxos.lease_expired():
@@ -1099,5 +1295,6 @@ def _is_mutating(cmd: dict) -> bool:
                  "osd pool ls", "osd erasure-code-profile get",
                  "osd erasure-code-profile ls", "auth get", "auth ls",
                  "config-key get", "config-key ls", "log last",
-                 "mon dump", "quorum_status")
+                 "mon dump", "quorum_status", "fs ls", "fs dump",
+                 "mds stat")
     return prefix not in read_only

@@ -13,6 +13,7 @@ from __future__ import annotations
 import socket
 import time
 
+from .mds.daemon import MDSDaemon
 from .mon.monitor import MonMap, Monitor
 from .msg import EntityAddr
 from .osd.daemon import OSDaemon
@@ -44,6 +45,8 @@ class MiniCluster:
         self.osds: dict[int, OSDaemon] = {}
         self.n_osds = n_osds
         self._clients: list[Rados] = []
+        self.mdss: dict[str, MDSDaemon] = {}
+        self._fs_clients: list = []
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, timeout: float = 30.0) -> "MiniCluster":
@@ -87,7 +90,57 @@ class MiniCluster:
     def revive_osd(self, i: int, timeout: float = 30.0) -> OSDaemon:
         return self.start_osd(i, timeout=timeout)
 
+    # -- mds / cephfs ------------------------------------------------------
+    def start_mds(self, name: str, **kw) -> MDSDaemon:
+        mds = MDSDaemon(name, self.monmap, **kw).start()
+        self.mdss[name] = mds
+        return mds
+
+    def kill_mds(self, name: str):
+        """Crash an MDS (no journal flush) — the failover fixture."""
+        self.mdss.pop(name).kill()
+
+    def fs_new(self, fs_name: str = "cephfs", *, pg_num: int = 8,
+               size: int = 2) -> None:
+        """Create the metadata/data pools and the filesystem."""
+        r = self.rados()
+        for pool in (f"{fs_name}_metadata", f"{fs_name}_data"):
+            r.create_pool(pool, pg_num=pg_num, size=size)
+        rc, outs, _ = r.mon_command({
+            "prefix": "fs new", "fs_name": fs_name,
+            "metadata": f"{fs_name}_metadata",
+            "data": f"{fs_name}_data"})
+        if rc != 0:
+            raise RuntimeError(f"fs new failed: {outs}")
+
+    def cephfs(self, fs_name: str = "cephfs", **kw):
+        from .cephfs.client import CephFS
+        fs = CephFS(self.monmap, fs_name=fs_name, **kw).mount()
+        self._fs_clients.append(fs)
+        return fs
+
+    def wait_for_active_mds(self, fs_name: str = "cephfs",
+                            timeout: float = 20.0) -> str:
+        """→ name of the active MDS once one is promoted and serving."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for name, mds in self.mdss.items():
+                if mds.state == "active":
+                    return name
+            time.sleep(0.05)
+        raise TimeoutError("no active MDS")
+
     def stop(self):
+        for c in self._fs_clients:
+            try:
+                c.unmount()
+            except Exception:
+                pass
+        for mds in list(self.mdss.values()):
+            try:
+                mds.shutdown()
+            except Exception:
+                pass
         for c in self._clients:
             try:
                 c.shutdown()
