@@ -1,0 +1,306 @@
+"""Bit-matrix XOR erasure codes — liberation / liber8tion / blaum_roth.
+
+Reference behavior re-created (``src/erasure-code/jerasure/
+ErasureCodeJerasure.{h,cc}`` techniques backed by jerasure's
+``liberation.c`` bit-matrix constructions; SURVEY.md §3.6): RAID-6
+(m=2) codes whose generator is a GF(2) matrix of w×w bit blocks, so
+encode/decode is pure XOR of *packets* — no GF(2^8) multiplies at all.
+Each chunk is w packets of ``chunk_size/w`` bytes; parity packet r is
+the XOR of the data packets its bitmatrix row selects.
+
+TPU-first: the packet XOR fan-in is expressed as an int8 matmul over
+bit-planes with a mod-2 reduction — the [m·w, k·w] selector against
+[k·w, packet_bits] lands on the MXU exactly like the GF(2^8) bitmatrix
+path in ``ops/gf_jax.py`` (one 8× smaller contraction: coefficients
+are already bits).
+
+Constructions (provenance: the reference mount is empty — SURVEY.md
+§0 — so bit-for-bit parity with jerasure's binaries is unverifiable;
+these follow the published definitions and are MDS-verified
+exhaustively in tests):
+
+- **liberation(k, w)** — Plank's Liberation codes (w prime, k ≤ w):
+  Q row r takes chunk i's packet (r + i) mod w, plus one extra bit
+  per column block i > 0 at row (i·(w−1)/2) mod w — the
+  minimal-density layout of ``liberation_coding_bitmatrix``.
+- **blaum_roth(k, w)** — w+1 prime, k ≤ w: column block i of the Q
+  rows is Bⁱ, B the multiply-by-x companion matrix of the ring
+  GF(2)[x]/(1+x+…+x^w).
+- **liber8tion(k)** — w=8, k ≤ 8.  The reference embeds matrices found
+  by Plank's search; the same (k, m=2, w=8) parameter domain is
+  served here with column blocks Cⁱ, C the companion matrix of the
+  GF(2^8) primitive polynomial 0x11d.  Equivalent fault tolerance
+  (MDS for any 2 erasures), slightly denser XOR schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .interface import ECError
+
+
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    return all(n % p for p in range(2, int(n ** 0.5) + 1))
+
+
+def default_w(technique: str, k: int) -> int:
+    """Smallest valid word size for a technique (profiles may override
+    with w=...; the reference errors on invalid combos the same way)."""
+    if technique == "liber8tion":
+        return 8
+    if technique == "liberation":
+        w = max(k, 3) | 1            # odd start
+        while not _is_prime(w):
+            w += 2
+        return w
+    if technique == "blaum_roth":
+        w = max(k, 2)
+        while not _is_prime(w + 1):
+            w += 1
+        return w
+    raise ECError(f"unknown bitmatrix technique {technique!r}")
+
+
+def liberation_bitmatrix(k: int, w: int) -> np.ndarray:
+    """[2w, kw] GF(2) coding matrix (parity rows only)."""
+    if not _is_prime(w):
+        raise ECError(f"liberation needs prime w (got {w})")
+    if k > w:
+        raise ECError(f"liberation needs k <= w ({k} > {w})")
+    mat = np.zeros((2 * w, k * w), dtype=np.int8)
+    for i in range(k):
+        for j in range(w):
+            mat[j, i * w + j] = 1                       # P: plain XOR
+            mat[w + j, i * w + (j + i) % w] = 1         # Q: row j ←
+            # chunk i packet (j+i) mod w
+        if i > 0:
+            jx = (i * ((w - 1) // 2)) % w
+            mat[w + jx, i * w + (jx + i - 1) % w] = 1   # the extra bit
+    return mat
+
+
+def _companion_powers_bitmatrix(companion: np.ndarray, k: int,
+                                w: int) -> np.ndarray:
+    """[2w, kw]: P rows = identities, Q column block i = companionⁱ."""
+    mat = np.zeros((2 * w, k * w), dtype=np.int8)
+    blk = np.eye(w, dtype=np.int8)
+    for i in range(k):
+        mat[:w, i * w: (i + 1) * w] = np.eye(w, dtype=np.int8)
+        mat[w:, i * w: (i + 1) * w] = blk
+        blk = (companion @ blk) & 1
+    return mat
+
+
+def blaum_roth_bitmatrix(k: int, w: int) -> np.ndarray:
+    if not _is_prime(w + 1):
+        raise ECError(f"blaum_roth needs w+1 prime (got w={w})")
+    if k > w:
+        raise ECError(f"blaum_roth needs k <= w ({k} > {w})")
+    # multiply-by-x companion matrix in GF(2)[x]/(1+x+...+x^w)
+    B = np.zeros((w, w), dtype=np.int8)
+    for j in range(w - 1):
+        B[j + 1, j] = 1
+    B[:, w - 1] = 1                  # x^w = 1 + x + ... + x^(w-1)
+    return _companion_powers_bitmatrix(B, k, w)
+
+
+def liber8tion_bitmatrix(k: int) -> np.ndarray:
+    w = 8
+    if k > w:
+        raise ECError(f"liber8tion needs k <= 8 (got {k})")
+    # companion matrix of x^8 + x^4 + x^3 + x^2 + 1 (0x11d)
+    C = np.zeros((w, w), dtype=np.int8)
+    for j in range(w - 1):
+        C[j + 1, j] = 1
+    for bit in range(w):
+        if (0x1D >> bit) & 1:
+            C[bit, w - 1] = 1
+    return _companion_powers_bitmatrix(C, k, w)
+
+
+def build_bitmatrix(technique: str, k: int, w: int | None) -> \
+        tuple[np.ndarray, int]:
+    w = w or default_w(technique, k)
+    if technique == "liberation":
+        return liberation_bitmatrix(k, w), w
+    if technique == "blaum_roth":
+        return blaum_roth_bitmatrix(k, w), w
+    if technique == "liber8tion":
+        if w != 8:
+            raise ECError("liber8tion requires w=8")
+        return liber8tion_bitmatrix(k), 8
+    raise ECError(f"unknown bitmatrix technique {technique!r}")
+
+
+def encode_oracle(coding_bits: np.ndarray, data: np.ndarray,
+                  w: int) -> np.ndarray:
+    """Scalar row-walk XOR oracle (independent of the matmul path):
+    data [k, C] → parity [m, C]."""
+    k = data.shape[0]
+    C = data.shape[1]
+    words = data.reshape(k * w, C // w)
+    mw = coding_bits.shape[0]
+    out = np.zeros((mw, C // w), dtype=np.uint8)
+    for r in range(mw):
+        for c in range(k * w):
+            if coding_bits[r, c]:
+                out[r] ^= words[c]
+    return out.reshape(mw // w, C)
+
+
+def _gf2_inv(a: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2) matrix (Gaussian elimination)."""
+    n = a.shape[0]
+    aug = np.concatenate([a.astype(np.int8) & 1,
+                          np.eye(n, dtype=np.int8)], axis=1)
+    for col in range(n):
+        piv = next((r for r in range(col, n) if aug[r, col]), None)
+        if piv is None:
+            raise ECError("bitmatrix submatrix is singular")
+        if piv != col:
+            aug[[col, piv]] = aug[[piv, col]]
+        hits = (aug[:, col] == 1)
+        hits[col] = False
+        aug[hits] ^= aug[col]
+    return aug[:, n:]
+
+
+class BitMatrixECEngine:
+    """Encode/decode one bitmatrix code; same duck-type as
+    `MatrixECEngine` (encode/encode_device/decode/decode_batch) so
+    the benchmark CLI and ECBackend drive both interchangeably.
+
+    Data layout: a chunk of C bytes is w packets of C/w bytes; the
+    word vector stacks chunk-major (chunk i packet j = row i·w+j),
+    matching jerasure's ``jerasure_bitmatrix_encode`` addressing.
+    """
+
+    def __init__(self, coding_bits: np.ndarray, k: int, w: int):
+        self.k, self.w = k, w
+        self.mw, kw = coding_bits.shape
+        self.m = self.mw // w
+        assert kw == k * w
+        self.coding_bits = coding_bits.astype(np.int8)
+        # full generator: data rows (identity) then parity rows
+        self.generator = np.concatenate(
+            [np.eye(k * w, dtype=np.int8), self.coding_bits], axis=0)
+        # erasure tuple → (inverse matrix, survivor chunk ids)
+        self._inverses: dict[tuple[int, ...],
+                             tuple[np.ndarray, list[int]]] = {}
+
+    # -- GF(2) mat × packet-words ------------------------------------------
+    # Below this many input bytes the XOR fan-in runs as NumPy matmul
+    # on the host — a TPU launch (and its per-shape compile) costs more
+    # than the work.  Large payloads batch onto the MXU (mirrors the
+    # small-stripe latency crux, SURVEY.md §8.4).
+    HOST_THRESHOLD = 1 << 20
+
+    @staticmethod
+    def _apply_np(mat: np.ndarray, words: np.ndarray) -> np.ndarray:
+        bits = np.unpackbits(words, axis=-1, bitorder="little")
+        acc = (mat.astype(np.int32) @ bits.astype(np.int32)) & 1
+        return np.packbits(acc.astype(np.uint8), axis=-1,
+                           bitorder="little")
+
+    @staticmethod
+    @functools.lru_cache(maxsize=None)
+    def _jit_apply():
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def go(mj, wj):
+            # wj [..., N, pw] uint8 → bits [..., N, pw*8] int8
+            bits = ((wj[..., None] >> jnp.arange(8, dtype=jnp.uint8))
+                    & jnp.uint8(1)).astype(jnp.int8)
+            bits = bits.reshape(*wj.shape[:-1], -1)
+            acc = jnp.matmul(mj.astype(jnp.int8), bits,
+                             preferred_element_type=jnp.int32)
+            par = (acc & 1).astype(jnp.uint8)
+            par = par.reshape(*par.shape[:-1], wj.shape[-1], 8)
+            return jnp.sum(par << jnp.arange(8, dtype=jnp.uint8),
+                           axis=-1).astype(jnp.uint8)
+
+        return go
+
+    @classmethod
+    def _apply(cls, mat: np.ndarray, words: np.ndarray,
+               device: bool = False):
+        """mat [R, N] 0/1 · words [..., N, pw] uint8 → [..., R, pw]."""
+        if not device and words.size < cls.HOST_THRESHOLD:
+            return cls._apply_np(mat, words)
+        import jax.numpy as jnp
+        out = cls._jit_apply()(jnp.asarray(mat), jnp.asarray(words))
+        return out if device else np.asarray(out)
+
+    def _to_words(self, data) -> np.ndarray:
+        """[..., k, C] → [..., k·w, C/w]."""
+        C = data.shape[-1]
+        if C % self.w:
+            raise ECError(f"chunk size {C} not a multiple of w={self.w}")
+        return np.asarray(data, dtype=np.uint8).reshape(
+            *data.shape[:-2], data.shape[-2] * self.w, C // self.w)
+
+    # -- encode ------------------------------------------------------------
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """[k, C] or [B, k, C] uint8 → parity of matching batch shape."""
+        C = data.shape[-1]
+        parity = self._apply(self.coding_bits, self._to_words(data))
+        return parity.reshape(*data.shape[:-2], self.m, C)
+
+    def encode_device(self, data):
+        """Same, but stays on device (benchmark/pipeline use)."""
+        import jax.numpy as jnp
+        C = data.shape[-1]
+        out = self._apply(self.coding_bits, self._to_words(data),
+                          device=True)
+        return jnp.reshape(out, (*data.shape[:-2], self.m, C))
+
+    # -- decode ------------------------------------------------------------
+    def _inverse_for(self, erasures: tuple[int, ...]) -> \
+            tuple[np.ndarray, list[int]]:
+        entry = self._inverses.get(erasures)
+        if entry is None:
+            k, w = self.k, self.w
+            survivors = [i for i in range(k + self.m)
+                         if i not in erasures][: k]
+            rows = np.concatenate(
+                [np.arange(c * w, (c + 1) * w) for c in survivors])
+            entry = (_gf2_inv(self.generator[rows]), survivors)
+            self._inverses[erasures] = entry
+        return entry
+
+    def decode(self, chunks: dict[int, np.ndarray],
+               chunk_size: int) -> dict[int, np.ndarray]:
+        """Recover all k+m chunks of one stripe from any ≥k survivors."""
+        k, w, m = self.k, self.w, self.m
+        if len(chunks) < k:
+            raise ECError(f"{len(chunks)} chunks < k={k}")
+        erasures = tuple(i for i in range(k + m) if i not in chunks)
+        inv, survivors = self._inverse_for(erasures)
+        words = np.concatenate(
+            [np.asarray(chunks[c], dtype=np.uint8).reshape(w, -1)
+             for c in survivors], axis=0)                # [kw, pw]
+        data = self._apply(inv, words).reshape(k, chunk_size)
+        out = {i: data[i] for i in range(k)}
+        if any(k + j not in chunks for j in range(m)):
+            parity = self.encode(data)
+            for j in range(m):
+                if k + j not in chunks:
+                    out[k + j] = parity[j]
+        for i, buf in chunks.items():
+            out[i] = np.asarray(buf, dtype=np.uint8)
+        return out
+
+    def decode_batch(self, survivors_data: np.ndarray,
+                     erasures: tuple[int, ...]) -> np.ndarray:
+        """[B, k, chunk] survivor stack (id order) → [B, k, chunk]."""
+        inv, _ = self._inverse_for(tuple(erasures))
+        B, _, C = survivors_data.shape
+        words = self._to_words(survivors_data)
+        return self._apply(inv, words).reshape(B, self.k, C)

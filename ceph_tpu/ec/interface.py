@@ -42,7 +42,9 @@ class ECProfile:
     k: int = 2
     m: int = 2
     technique: str = "reed_sol_van"
-    w: int = 8
+    #: None = "not specified" — each technique resolves its own
+    #: default (8 for GF(2^8) codes; smallest valid for bitmatrix)
+    w: int | None = None
     extra: dict = field(default_factory=dict)
 
     @classmethod

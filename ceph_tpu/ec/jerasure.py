@@ -1,12 +1,15 @@
 """jerasure-equivalent plugin (reference:
 ``src/erasure-code/jerasure/ErasureCodeJerasure.{h,cc}``; SURVEY.md §3.6).
 
-Techniques: ``reed_sol_van`` (default), ``reed_sol_r6_op`` (m must be 2),
-``cauchy_orig``, ``cauchy_good``.  The bit-matrix XOR techniques
-(``liberation``, ``liber8tion``, ``blaum_roth``) are scheduled work; the
-registry rejects them explicitly rather than silently substituting.
+GF(2^8) matrix techniques — ``reed_sol_van`` (default),
+``reed_sol_r6_op`` (m must be 2), ``cauchy_orig``, ``cauchy_good`` —
+execute on the shared `MatrixECEngine` (MXU bitmatrix path).
 
-All techniques execute on the shared `MatrixECEngine` (MXU path).
+Bit-matrix XOR techniques — ``liberation``, ``liber8tion``,
+``blaum_roth`` (all RAID-6, m=2) — execute on `BitMatrixECEngine`:
+pure packet-XOR codes whose selector matmul also lands on the MXU
+(see ``ec/bitmatrix.py`` for the constructions and the liber8tion
+matrix provenance note).
 """
 
 from __future__ import annotations
@@ -14,11 +17,14 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import rs
+from .bitmatrix import BitMatrixECEngine, build_bitmatrix
 from .interface import ECError, ECProfile, ErasureCodeInterface
 from .jax_backend import MatrixECEngine
 
 
-TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good")
+TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig",
+              "cauchy_good", "liberation", "liber8tion", "blaum_roth")
+BITMATRIX_TECHNIQUES = ("liberation", "liber8tion", "blaum_roth")
 
 
 class ErasureCodeJerasure(ErasureCodeInterface):
@@ -31,6 +37,20 @@ class ErasureCodeJerasure(ErasureCodeInterface):
             raise ECError(f"bad k={self.k} m={self.m}")
         if self.k + self.m > 256:
             raise ECError("k+m must be <= 256 for w=8")
+        if self.technique in BITMATRIX_TECHNIQUES:
+            if self.m != 2:
+                raise ECError(f"{self.technique} requires m=2")
+            # profile.w None = unspecified → the technique's smallest
+            # valid w (the reference's per-technique DEFAULT_W); an
+            # explicit invalid w raises from the construction
+            bits, self.w = build_bitmatrix(self.technique, self.k,
+                                           profile.w)
+            self.coding_matrix = bits
+            self.engine = BitMatrixECEngine(bits, self.k, self.w)
+            return
+        self.w = profile.w or 8
+        if self.w != 8:
+            raise ECError("GF(2^8) techniques require w=8")
         if self.technique == "reed_sol_van":
             coding = rs.reed_sol_van_matrix(self.k, self.m)
         elif self.technique == "reed_sol_r6_op":
@@ -46,6 +66,11 @@ class ErasureCodeJerasure(ErasureCodeInterface):
                           f" (supported: {TECHNIQUES})")
         self.coding_matrix = coding
         self.engine = MatrixECEngine(coding, self.k, self.m)
+
+    def get_alignment(self) -> int:
+        """Bitmatrix codes need chunk % w == 0 (w packets per chunk);
+        k·w·4 mirrors jerasure's alignment formula for all techniques."""
+        return self.k * self.w * 4
 
     def _encode_chunks(self, data: np.ndarray) -> np.ndarray:
         return self.engine.encode(data)

@@ -109,8 +109,12 @@ def run(argv=None) -> dict:
     if args.verify:
         coding = getattr(code, "coding_matrix", None)
         if coding is not None:
+            from ..ec.bitmatrix import BitMatrixECEngine
+            from ..ec.bitmatrix import encode_oracle as bm_oracle
+            bitmatrix = isinstance(engine, BitMatrixECEngine)
             for b in range(min(batch, 4)):
-                expect = rs.encode_oracle(coding, data[b])
+                expect = (bm_oracle(coding, data[b], code.w) if bitmatrix
+                          else rs.encode_oracle(coding, data[b]))
                 assert np.array_equal(parity_np[b], expect), \
                     f"parity mismatch vs oracle at stripe {b}"
 

@@ -1,0 +1,109 @@
+"""Bit-matrix XOR techniques (liberation/liber8tion/blaum_roth —
+reference jerasure's liberation.c constructions; SURVEY.md §3.6):
+construction validity, exhaustive-erasure MDS round-trips through the
+plugin interface, and packet-layout semantics."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import create_erasure_code
+from ceph_tpu.ec.interface import ECError
+from ceph_tpu.ec.bitmatrix import (BitMatrixECEngine, blaum_roth_bitmatrix,
+                                   build_bitmatrix, default_w,
+                                   liber8tion_bitmatrix,
+                                   liberation_bitmatrix)
+
+
+def test_default_w():
+    assert default_w("liberation", 5) == 5
+    assert default_w("liberation", 6) == 7
+    assert default_w("liber8tion", 4) == 8
+    assert default_w("blaum_roth", 5) == 6     # 7 prime
+    assert default_w("blaum_roth", 7) == 10    # 8, 9 composite +1
+
+
+def test_construction_validation():
+    with pytest.raises(ECError):
+        liberation_bitmatrix(5, 8)          # 8 not prime
+    with pytest.raises(ECError):
+        liberation_bitmatrix(8, 7)          # k > w
+    with pytest.raises(ECError):
+        blaum_roth_bitmatrix(4, 7)          # 8 not prime
+    with pytest.raises(ECError):
+        liber8tion_bitmatrix(9)             # k > 8
+
+
+def test_liberation_density():
+    """Liberation is minimal-density: kw + k - 1 ones in the Q rows."""
+    for k, w in [(3, 7), (7, 7), (5, 11)]:
+        bits = liberation_bitmatrix(k, w)
+        assert int(bits[w:].sum()) == k * w + k - 1
+        assert int(bits[:w].sum()) == k * w      # P rows: plain XOR
+
+
+@pytest.mark.parametrize("technique,k,w", [
+    ("liberation", 5, 7), ("liberation", 7, 7),
+    ("blaum_roth", 6, 6), ("blaum_roth", 4, 10),
+    ("liber8tion", 8, 8), ("liber8tion", 3, 8),
+])
+def test_exhaustive_erasure_roundtrip(technique, k, w):
+    prof = {"plugin": "jerasure", "k": k, "m": 2,
+            "technique": technique}
+    if technique != "liber8tion":
+        prof["w"] = w
+    code = create_erasure_code(prof)
+    assert code.w == w
+    payload = bytes(range(256)) * ((k * w * 4) // 128)
+    encoded = code.encode(set(range(k + 2)), payload)
+    chunk = code.get_chunk_size(len(payload))
+    assert chunk % w == 0
+    for era in itertools.combinations(range(k + 2), 2):
+        avail = {i: encoded[i] for i in encoded if i not in era}
+        got = code.decode(set(era), avail)
+        for i in era:
+            assert np.array_equal(got[i], encoded[i]), \
+                f"{technique} erasure {era} chunk {i}"
+
+
+def test_m_must_be_2():
+    with pytest.raises(ECError):
+        create_erasure_code({"plugin": "jerasure", "k": 4, "m": 3,
+                             "technique": "liberation"})
+
+
+def test_parity_is_packet_xor():
+    """Row-0 parity of every technique is the plain XOR of the data
+    chunks (the P drive), packet layout preserved."""
+    for technique in ("liberation", "liber8tion", "blaum_roth"):
+        code = create_erasure_code({"plugin": "jerasure", "k": 4,
+                                    "m": 2, "technique": technique})
+        k, w = 4, code.w
+        rng = np.random.default_rng(1)
+        payload = rng.integers(0, 256, size=k * w * 4,
+                               dtype=np.uint8).tobytes()
+        enc = code.encode(set(range(6)), payload)
+        p = np.zeros_like(enc[0])
+        for i in range(4):
+            p ^= enc[i]
+        assert np.array_equal(enc[4], p), technique
+
+
+def test_engine_matches_plain_xor_oracle():
+    """Scalar oracle: walk the bitmatrix row by row, XOR packets."""
+    k, w = 5, 7
+    bits, _ = build_bitmatrix("liberation", k, w)
+    eng = BitMatrixECEngine(bits, k, w)
+    rng = np.random.default_rng(7)
+    C = w * 12
+    data = rng.integers(0, 256, size=(k, C), dtype=np.uint8)
+    got = eng.encode(data)
+    pw = C // w
+    words = data.reshape(k * w, pw)
+    want = np.zeros((2 * w, pw), dtype=np.uint8)
+    for r in range(2 * w):
+        for c in range(k * w):
+            if bits[r, c]:
+                want[r] ^= words[c]
+    assert np.array_equal(got, want.reshape(2, C))
