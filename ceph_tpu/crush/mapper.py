@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .hash import crush_hash32_2, crush_hash32_3
+from .hash import crush_hash32_2, crush_hash32_3, crush_hash32_4
 from .ln import crush_ln
 from .map import CRUSH_ITEM_NONE, CRUSH_ITEM_UNDEF, Bucket, CrushMap, Rule
 
@@ -114,14 +114,162 @@ def bucket_perm_choose(bucket: Bucket, work: CrushWork, x: int, r: int) -> int:
     return bucket.items[perm[pr]]
 
 
+def _bucket_cache(bucket: Bucket, kind: str, build):
+    """Derived per-bucket tables (straw scalers, tree node weights,
+    list prefix sums) — computed once per weight vector, like the
+    reference's build-time ``crush_calc_straw``/``crush_make_tree_
+    bucket``, and invalidated when the weights change."""
+    key = (kind, tuple(bucket.weights), bucket.size)
+    cache = getattr(bucket, "_legacy_cache", None)
+    if cache is None or cache[0] != key:
+        bucket._legacy_cache = (key, build())
+    return bucket._legacy_cache[1]
+
+
+def bucket_list_choose(bucket: Bucket, x: int, r: int) -> int:
+    """RUSH_P list bucket: walk newest→oldest item; item i keeps the
+    draw with probability weight_i / sum(weights_0..i) (reference
+    ``bucket_list_choose``)."""
+    def build():
+        sums, acc = [], 0
+        for w in bucket.weights:
+            acc += w
+            sums.append(acc)
+        return sums
+
+    sums = _bucket_cache(bucket, "list", build)
+    for i in range(bucket.size - 1, -1, -1):
+        if sums[i] == 0:
+            continue
+        w = int(crush_hash32_4(x, bucket.items[i], r, bucket.id)) & 0xFFFF
+        w = (w * sums[i]) >> 16
+        if w < bucket.weights[i]:
+            return bucket.items[i]
+    return bucket.items[0]
+
+
+def _tree_height(n: int) -> int:
+    h = 0
+    while (n & 1) == 0:
+        h += 1
+        n >>= 1
+    return h
+
+
+def _tree_node_weights(bucket: Bucket) -> tuple[list[int], int]:
+    """Build the in-order-labelled weight tree (reference
+    ``crush_make_tree_bucket``): item i sits at node 2i+1; internal
+    node weight = sum of its subtree."""
+    size = bucket.size
+    depth = 1
+    t = max(size - 1, 0)
+    while t:
+        t >>= 1
+        depth += 1
+    num_nodes = 1 << depth
+    nodes = [0] * num_nodes
+
+    def fill(n: int) -> int:
+        if n & 1:                        # leaf
+            i = n >> 1
+            nodes[n] = bucket.weights[i] if i < size else 0
+            return nodes[n]
+        h = _tree_height(n)
+        nodes[n] = fill(n - (1 << (h - 1))) + fill(n + (1 << (h - 1)))
+        return nodes[n]
+
+    fill(num_nodes >> 1)
+    return nodes, num_nodes
+
+
+def bucket_tree_choose(bucket: Bucket, work: CrushWork, x: int,  # noqa: ARG001
+                       r: int) -> int:
+    """Weighted binary descent (reference ``bucket_tree_choose``)."""
+    if bucket.size == 0:
+        # do_rule rejects empty buckets before choosing; direct calls
+        # must not walk a weightless tree
+        raise ValueError("empty tree bucket")
+    nodes, num_nodes = _bucket_cache(
+        bucket, "tree", lambda: _tree_node_weights(bucket))
+    n = num_nodes >> 1
+    while (n & 1) == 0:
+        w = nodes[n]
+        t = (int(crush_hash32_4(x, n, r, bucket.id)) * w) >> 32
+        h = _tree_height(n)
+        left = n - (1 << (h - 1))
+        n = left if t < nodes[left] else n + (1 << (h - 1))
+    # an all-zero-weight subtree can land the descent on a padding
+    # leaf; clamp to a real item — it is then rejected by is_out
+    # (its weight is necessarily zero for this to be reachable)
+    return bucket.items[min(n >> 1, bucket.size - 1)]
+
+
+def calc_straw_scalers(weights: list[int]) -> list[int]:
+    """Legacy straw scalers (reference ``crush_calc_straw``,
+    straw_calc_version 0 algorithm; the v1 scaler fix for repeated
+    weights is not separately reproducible — reference source
+    unavailable, SURVEY.md §0 — so both versions use this published
+    construction).  Double-precision, matching the C build path."""
+    size = len(weights)
+    order = sorted(range(size), key=lambda i: (weights[i], i))
+    straws = [0] * size
+    numleft = size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        if weights[order[i]] == 0:
+            straws[order[i]] = 0
+            i += 1
+            continue
+        straws[order[i]] = int(straw * 0x10000)
+        i += 1
+        if i == size:
+            break
+        if weights[order[i]] == weights[order[i - 1]]:
+            continue
+        wbelow += float(weights[order[i - 1]] - lastw) * numleft
+        for j in range(i, size):
+            if weights[order[j]] == weights[order[i]]:
+                numleft -= 1
+            else:
+                break
+        wnext = numleft * (weights[order[i]] - weights[order[i - 1]])
+        pbelow = wbelow / (wbelow + wnext)
+        straw *= (1.0 / pbelow) ** (1.0 / numleft)
+        lastw = weights[order[i - 1]]
+    return straws
+
+
+def bucket_straw_choose(bucket: Bucket, work: CrushWork, x: int,  # noqa: ARG001
+                        r: int) -> int:
+    """Legacy straw: draw = 16-bit hash × precomputed scaler, max wins
+    (reference ``bucket_straw_choose``)."""
+    straws = _bucket_cache(
+        bucket, "straw", lambda: calc_straw_scalers(bucket.weights))
+    high, high_draw = 0, 0
+    for i in range(bucket.size):
+        draw = (int(crush_hash32_3(x, bucket.items[i], r)) & 0xFFFF) \
+            * straws[i]
+        if i == 0 or draw > high_draw:
+            high, high_draw = i, draw
+    return bucket.items[high]
+
+
 def crush_bucket_choose(cmap: CrushMap, bucket: Bucket, work: CrushWork,
                         x: int, r: int, position: int = 0) -> int:
     if bucket.alg == "straw2":
         return bucket_straw2_choose(cmap, bucket, x, r, position)
     if bucket.alg == "uniform":
         return bucket_perm_choose(bucket, work, x, r)
-    raise NotImplementedError(
-        f"bucket alg {bucket.alg!r} (legacy list/tree/straw not implemented)")
+    if bucket.alg == "list":
+        return bucket_list_choose(bucket, x, r)
+    if bucket.alg == "tree":
+        return bucket_tree_choose(bucket, work, x, r)
+    if bucket.alg == "straw":
+        return bucket_straw_choose(bucket, work, x, r)
+    raise NotImplementedError(f"bucket alg {bucket.alg!r}")
 
 
 def is_out(cmap: CrushMap, weight: list[int], item: int, x: int) -> bool:

@@ -254,11 +254,16 @@ class BitMatrixECEngine:
         return parity.reshape(*data.shape[:-2], self.m, C)
 
     def encode_device(self, data):
-        """Same, but stays on device (benchmark/pipeline use)."""
+        """Same, but stays on device (benchmark/pipeline use) — a
+        jax.Array input is reshaped with jnp, never copied to host."""
         import jax.numpy as jnp
         C = data.shape[-1]
-        out = self._apply(self.coding_bits, self._to_words(data),
-                          device=True)
+        if C % self.w:
+            raise ECError(f"chunk size {C} not a multiple of w={self.w}")
+        words = jnp.reshape(jnp.asarray(data).astype(jnp.uint8),
+                            (*data.shape[:-2],
+                             data.shape[-2] * self.w, C // self.w))
+        out = self._jit_apply()(jnp.asarray(self.coding_bits), words)
         return jnp.reshape(out, (*data.shape[:-2], self.m, C))
 
     # -- decode ------------------------------------------------------------

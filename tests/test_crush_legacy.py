@@ -1,0 +1,145 @@
+"""Legacy bucket algorithms — list / tree / straw (reference
+``bucket_list_choose`` / ``bucket_tree_choose`` / ``bucket_straw_choose``
+in mapper.c + ``crush_calc_straw`` in crush.c; SURVEY.md §3.3).
+Behavioral contracts: determinism, full-coverage selection,
+weight-proportional bias, zero-weight exclusion, and mixed-alg
+hierarchies mapping end-to-end."""
+
+import collections
+
+import pytest
+
+from ceph_tpu.crush.map import Bucket, CrushMap, Rule, Step
+from ceph_tpu.crush.mapper import (CrushWork, bucket_list_choose,
+                                   bucket_straw_choose, bucket_tree_choose,
+                                   calc_straw_scalers, do_rule)
+
+
+def _flat_map(alg: str, n: int = 8, weights=None) -> CrushMap:
+    m = CrushMap(max_devices=n, types={0: "osd", 10: "root"})
+    w = weights if weights is not None else [0x10000] * n
+    m.add_bucket(Bucket(id=-1, type=10, alg=alg,
+                        items=list(range(n)), weights=list(w)))
+    m.rules.append(Rule(id=0, name="r", steps=[
+        Step("take", -1), Step("choose_firstn", 0, 0), Step("emit")]))
+    return m
+
+
+@pytest.mark.parametrize("alg", ["list", "tree", "straw"])
+class TestLegacyBucketAlg:
+    def test_deterministic_and_valid(self, alg):
+        m = _flat_map(alg)
+        for x in range(64):
+            a = do_rule(m, 0, x, 3)
+            b = do_rule(m, 0, x, 3)
+            assert a == b
+            assert len(set(a)) == 3
+            assert all(0 <= d < 8 for d in a)
+
+    def test_all_items_reachable(self, alg):
+        m = _flat_map(alg)
+        seen = set()
+        for x in range(256):
+            seen.update(do_rule(m, 0, x, 2))
+        assert seen == set(range(8))
+
+    def test_zero_weight_never_chosen(self, alg):
+        w = [0x10000] * 8
+        w[3] = 0
+        m = _flat_map(alg, weights=w)
+        for x in range(200):
+            assert 3 not in do_rule(m, 0, x, 3)
+
+    def test_weight_bias(self, alg):
+        """A 4x-weight item must win noticeably more often."""
+        w = [0x10000] * 8
+        w[5] = 0x40000
+        m = _flat_map(alg, weights=w)
+        counts = collections.Counter()
+        for x in range(2000):
+            counts[do_rule(m, 0, x, 1)[0]] += 1
+        others = [counts[i] for i in range(8) if i != 5]
+        assert counts[5] > 1.8 * max(others)
+
+
+def test_straw_scalers_monotonic():
+    straws = calc_straw_scalers([0x8000, 0x10000, 0x20000, 0x10000])
+    assert straws[2] > straws[1] == straws[3] > straws[0] > 0
+    assert calc_straw_scalers([0, 0x10000])[0] == 0
+    # equal weights → equal scalers of 0x10000
+    assert calc_straw_scalers([0x10000] * 4) == [0x10000] * 4
+
+
+def test_list_prefers_tail_semantics():
+    """The list walk starts at the newest item — spot-check the raw
+    choose for one bucket/draw to pin the walk direction."""
+    b = Bucket(id=-1, type=10, alg="list", items=[0, 1, 2, 3],
+               weights=[0x10000] * 4)
+    got = {bucket_list_choose(b, x, 0) for x in range(128)}
+    assert got == {0, 1, 2, 3}
+
+
+def test_tree_node_layout():
+    b = Bucket(id=-1, type=10, alg="tree", items=[0, 1, 2],
+               weights=[0x10000, 0x10000, 0x10000])
+    w = CrushWork()
+    got = {bucket_tree_choose(b, w, x, 0) for x in range(128)}
+    assert got == {0, 1, 2}
+    nodes, num = b._legacy_cache[1]
+    assert num == 8
+    assert nodes[num >> 1] == 3 * 0x10000       # root holds total
+    assert nodes[1] == nodes[3] == nodes[5] == 0x10000
+    assert nodes[7] == 0                        # padding leaf
+    # weight change invalidates the cached tree
+    b.weights[0] = 0x20000
+    bucket_tree_choose(b, w, 1, 0)
+    assert b._legacy_cache[1][0][num >> 1] == 4 * 0x10000
+
+
+def test_tree_degenerate_buckets():
+    import pytest as _pt
+    empty = Bucket(id=-1, type=10, alg="tree", items=[], weights=[])
+    with _pt.raises(ValueError):
+        bucket_tree_choose(empty, CrushWork(), 1, 0)
+    # all-zero-weight, non-power-of-two size: descent may reach the
+    # padding leaf; must clamp to a real item, not crash
+    zero = Bucket(id=-1, type=10, alg="tree", items=[4, 5, 6],
+                  weights=[0, 0, 0])
+    for x in range(32):
+        assert bucket_tree_choose(zero, CrushWork(), x, 0) in (4, 5, 6)
+
+
+def test_straw_choose_uses_scalers():
+    b = Bucket(id=-1, type=10, alg="straw", items=[0, 1],
+               weights=[0x10000, 0])
+    w = CrushWork()
+    assert all(bucket_straw_choose(b, w, x, 0) == 0 for x in range(64))
+
+
+def test_mixed_alg_hierarchy():
+    """root(straw) → hosts(list/tree/uniform) → osds: mixed-alg maps
+    walk end-to-end (a migrated legacy cluster's shape)."""
+    m = CrushMap(max_devices=8,
+                 types={0: "osd", 1: "host", 10: "root"})
+    m.add_bucket(Bucket(id=-2, type=1, alg="list", items=[0, 1],
+                        weights=[0x10000] * 2))
+    m.add_bucket(Bucket(id=-3, type=1, alg="tree", items=[2, 3],
+                        weights=[0x10000] * 2))
+    m.add_bucket(Bucket(id=-4, type=1, alg="uniform", items=[4, 5],
+                        item_weight=0x10000))
+    m.add_bucket(Bucket(id=-5, type=1, alg="straw", items=[6, 7],
+                        weights=[0x10000] * 2))
+    m.add_bucket(Bucket(id=-1, type=10, alg="straw",
+                        items=[-2, -3, -4, -5],
+                        weights=[0x20000] * 4))
+    m.rules.append(Rule(id=0, name="r", steps=[
+        Step("take", -1), Step("chooseleaf_firstn", 0, 1),
+        Step("emit")]))
+    seen = set()
+    for x in range(400):
+        out = do_rule(m, 0, x, 3)
+        assert len(set(out)) == 3
+        hosts = {d // 2 for d in out}
+        assert len(hosts) == 3          # failure domain respected
+        seen.update(out)
+    assert seen == set(range(8))
