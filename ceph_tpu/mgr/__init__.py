@@ -1,6 +1,9 @@
 """Management plane (reference ``src/mgr`` + ``src/pybind/mgr`` —
-SURVEY.md §3.10): Python modules that observe cluster maps and steer
-them through mon commands.  First resident: the upmap balancer."""
+SURVEY.md §3.10): the active/standby mgr daemon hosts modules that
+observe cluster maps and steer them through mon commands — the upmap
+balancer, the pg_autoscaler, and the prometheus exporter."""
 
 from .balancer import UpmapBalancer  # noqa: F401
+from .daemon import (BalancerModule, MgrDaemon, MgrModule,  # noqa: F401
+                     PgAutoscalerModule, PrometheusModule)
 from .exporter import Exporter, ExporterService  # noqa: F401

@@ -1,0 +1,244 @@
+"""ceph-mgr daemon — active/standby module host.
+
+Reference behavior re-created (``src/mgr/Mgr.cc``, ``MgrStandby.cc``,
+``ActivePyModules.cc``; SURVEY.md §3.10): the mgr beacons to the mon
+cluster; the MgrMonitor elects one active (the rest standby) and a
+beacon timeout fails over.  The ACTIVE mgr hosts the management
+modules — here the upmap **balancer**, the **pg_autoscaler**, and the
+**prometheus** exporter — each driven from a periodic serve tick with
+a module context exposing mon commands and cluster maps (the
+reference's MgrModule API surface, narrowed to what the modules use).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..mon import messages as MM
+from ..mon.client import MonClient
+from ..osd.osdmap import OSDMap, PGid
+from ..tools.osdmaptool import osdmap_from_dict
+from .balancer import UpmapBalancer
+from .exporter import Exporter, ExporterService
+
+
+class MgrModuleContext:
+    """What a module sees (reference MgrModule: get_osdmap, mon
+    command access, logging)."""
+
+    def __init__(self, daemon: "MgrDaemon"):
+        self._d = daemon
+
+    def mon_command(self, cmd: dict):
+        return self._d.monc.command(cmd)
+
+    def get_osdmap(self) -> OSDMap | None:
+        d = self._d.monc.osdmap_dict
+        return osdmap_from_dict(d) if d else None
+
+    def get_pg_states(self) -> dict:
+        rc, _, out = self._d.monc.command({"prefix": "pg stat"})
+        return out if rc == 0 else {}
+
+
+class MgrModule:
+    NAME = "module"
+    TICK = 1.0
+
+    def __init__(self, ctx: MgrModuleContext):
+        self.ctx = ctx
+
+    def serve_tick(self):
+        """One periodic step; exceptions are logged-and-survived."""
+
+    def shutdown(self):
+        pass
+
+
+class BalancerModule(MgrModule):
+    """Upmap balancer (reference ``pybind/mgr/balancer`` upmap mode):
+    every tick evaluates each replicated pool's placement on the
+    batched mapper and applies a bounded set of pg-upmap-items."""
+
+    NAME = "balancer"
+    TICK = 2.0
+    MAX_CHANGES_PER_TICK = 8
+
+    def serve_tick(self):
+        m = self.ctx.get_osdmap()
+        if m is None:
+            return
+        for pid, pool in m.pools.items():
+            if pool.is_erasure():
+                continue
+            try:
+                bal = UpmapBalancer(m, pid)
+                proposals = bal.optimize(
+                    max_changes=self.MAX_CHANGES_PER_TICK)
+            except Exception:   # noqa: BLE001 — unbalanceable rule
+                continue
+            for pgid, items in proposals.items():
+                self.ctx.mon_command({
+                    "prefix": "osd pg-upmap-items", "pgid": str(pgid),
+                    "mappings": [[a, b] for a, b in items]})
+
+
+class PgAutoscalerModule(MgrModule):
+    """pg_num autoscaler (reference ``pybind/mgr/pg_autoscaler``):
+    grows pools toward ~TARGET_PGS_PER_OSD replica-slots per OSD,
+    doubling pg_num per step; pgp_num follows one tick later so the
+    split settles colocated before placement rebalances (the
+    reference's split-then-move pacing)."""
+
+    NAME = "pg_autoscaler"
+    TARGET_PGS_PER_OSD = 100
+    MAX_POOL_PG_NUM = 256
+
+    def serve_tick(self):
+        m = self.ctx.get_osdmap()
+        if m is None or not m.pools:
+            return
+        n_osds = max(1, m.num_in_osds())
+        budget = self.TARGET_PGS_PER_OSD * n_osds
+        share = budget // max(1, len(m.pools))
+        for pid, pool in m.pools.items():
+            name = next((n for n, i in m.pool_name.items()
+                         if i == pid), None)
+            if name is None:
+                continue
+            if pool.pgp_num < pool.pg_num:
+                # previous split step: let placement catch up now
+                self.ctx.mon_command({
+                    "prefix": "osd pool set", "pool": name,
+                    "var": "pgp_num", "val": str(pool.pg_num)})
+                continue
+            ideal = share // max(1, pool.size)
+            ideal = min(ideal, self.MAX_POOL_PG_NUM)
+            # grow only when under half the ideal (reference threshold
+            # 3x; halved here because steps double), one doubling at
+            # a time
+            if ideal >= pool.pg_num * 2:
+                self.ctx.mon_command({
+                    "prefix": "osd pool set", "pool": name,
+                    "var": "pg_num", "val": str(pool.pg_num * 2)})
+
+
+class PrometheusModule(MgrModule):
+    """Scrape endpoint (reference ``pybind/mgr/prometheus``)."""
+
+    NAME = "prometheus"
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self.service = ExporterService(
+            Exporter(ctx._d.monc, ctx._d.asok_paths)).start()
+        self.port = self.service.port
+
+    def shutdown(self):
+        self.service.shutdown()
+
+
+DEFAULT_MODULES = (BalancerModule, PgAutoscalerModule, PrometheusModule)
+
+
+class MgrDaemon:
+    def __init__(self, name: str, monmap, *,
+                 beacon_interval: float = 0.4,
+                 modules=DEFAULT_MODULES,
+                 asok_paths: dict[str, str] | None = None):
+        self.name = name
+        self.monmap = monmap
+        self.beacon_interval = beacon_interval
+        self.module_classes = modules
+        self.asok_paths = dict(asok_paths or {})
+        self.monc = MonClient(monmap, entity=f"mgr.{name}")
+        self.state = "boot"           # boot / standby / active
+        self.modules: dict[str, MgrModule] = {}
+        self.running = False
+        self._seq = 0
+        self._thread: threading.Thread | None = None
+        # _on_mgrmap runs on the MonClient messenger thread, which
+        # also delivers command replies — it must NEVER block on this
+        # lock or a module tick awaiting a reply deadlocks the whole
+        # client.  The push only flips _want_active; the loop thread
+        # owns every state transition.
+        self._want_active = False
+        self.lock = threading.RLock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self.running = True
+        self.monc.on_mgrmap = self._on_mgrmap
+        self.monc.sub_want("mgrmap", 0)
+        self.monc.sub_want("osdmap", 0)
+        self._send_beacon()
+        self.state = "standby"
+        self._thread = threading.Thread(
+            target=self._loop, name=f"mgr.{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.running = False
+        with self.lock:
+            self._stop_modules()
+        self.monc.shutdown()
+
+    def kill(self):
+        """Crash without deregistering (failover fixture)."""
+        self.running = False
+        with self.lock:
+            self._stop_modules()
+        self.monc.shutdown()
+
+    def _send_beacon(self):
+        self._seq += 1
+        self.monc.send(MM.MMgrBeacon(name=self.name, addr=[],
+                                     seq=self._seq))
+
+    # -- map handling ------------------------------------------------------
+    def _on_mgrmap(self, epoch: int, mgrmap: dict):
+        self._want_active = mgrmap.get("active_name") == self.name
+
+    def _start_modules(self):
+        ctx = MgrModuleContext(self)
+        for cls in self.module_classes:
+            try:
+                self.modules[cls.NAME] = cls(ctx)
+            except Exception:   # noqa: BLE001 — one bad module must
+                pass            # not take the mgr down
+        self._last_tick: dict[str, float] = {}
+
+    def _stop_modules(self):
+        for mod in self.modules.values():
+            try:
+                mod.shutdown()
+            except Exception:   # noqa: BLE001
+                pass
+        self.modules.clear()
+
+    def _loop(self):
+        while self.running:
+            self._send_beacon()
+            with self.lock:
+                if not self.running:
+                    return
+                if self._want_active and self.state != "active":
+                    self.state = "active"
+                    self._start_modules()
+                elif not self._want_active and self.state == "active":
+                    self.state = "standby"
+                    self._stop_modules()
+                if self.state == "active":
+                    now = time.monotonic()
+                    for name, mod in list(self.modules.items()):
+                        if now - self._last_tick.get(name, 0.0) \
+                                < mod.TICK:
+                            continue
+                        self._last_tick[name] = now
+                        try:
+                            mod.serve_tick()
+                        except Exception:   # noqa: BLE001
+                            pass
+            time.sleep(self.beacon_interval)

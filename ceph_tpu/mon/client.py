@@ -37,6 +37,9 @@ class MonClient(Dispatcher):
         self.fsmap_epoch = 0
         self.fsmap_dict: dict | None = None
         self.on_fsmap = None        # cb(epoch, fsmap_dict)
+        self.mgrmap_epoch = 0
+        self.mgrmap_dict: dict | None = None
+        self.on_mgrmap = None       # cb(epoch, mgrmap_dict)
         self._lock = threading.Lock()
 
     # -- session -----------------------------------------------------------
@@ -134,6 +137,10 @@ class MonClient(Dispatcher):
                        timeout: float = 10.0) -> dict:
         return self._wait_for_map("fsmap", min_epoch, timeout)
 
+    def wait_for_mgrmap(self, min_epoch: int = 1,
+                        timeout: float = 10.0) -> dict:
+        return self._wait_for_map("mgrmap", min_epoch, timeout)
+
     def wait_for_osdmap(self, min_epoch: int = 1,
                         timeout: float = 10.0) -> dict:
         return self._wait_for_map("osdmap", min_epoch, timeout)
@@ -153,6 +160,13 @@ class MonClient(Dispatcher):
                 self.fsmap_dict = msg.fsmap
                 if self.on_fsmap:
                     self.on_fsmap(msg.epoch, msg.fsmap)
+            return True
+        if isinstance(msg, M.MMgrMapMsg):
+            if msg.epoch >= self.mgrmap_epoch:
+                self.mgrmap_epoch = msg.epoch
+                self.mgrmap_dict = msg.mgrmap
+                if self.on_mgrmap:
+                    self.on_mgrmap(msg.epoch, msg.mgrmap)
             return True
         if isinstance(msg, M.MOSDMapMsg):
             if msg.epoch >= self.osdmap_epoch:

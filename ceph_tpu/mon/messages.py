@@ -111,6 +111,21 @@ class MFSMapMsg(_JsonMessage):
 
 
 @register_message
+class MMgrBeacon(_JsonMessage):
+    """mgr → mon: liveness + address (reference
+    ``src/messages/MMgrBeacon.h``)."""
+    TYPE = 29
+    FIELDS = ("name", "addr", "seq", "fwd")
+
+
+@register_message
+class MMgrMapMsg(_JsonMessage):
+    """Mon → subscriber: full MgrMap push (reference MMgrMap)."""
+    TYPE = 30
+    FIELDS = ("epoch", "mgrmap")
+
+
+@register_message
 class MPGStats(_JsonMessage):
     """Primary OSD → mon: per-PG state/object counts (reference
     MPGStats → PGMap aggregation, ``src/mon/PGMap.cc``).  pg_stats:

@@ -336,6 +336,53 @@ class OSDMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, f"removed pool {name} snap {cmd['snap']}", None
+        if prefix == "osd pool set":
+            name = cmd["pool"]
+            if name not in self.osdmap.pool_name:
+                return -2, f"pool '{name}' does not exist", None
+            var, val = cmd["var"], cmd["val"]
+            m = self._working()
+            pool = m.pools[m.pool_name[name]]
+            if var == "pg_num":
+                new = int(val)
+                if new < pool.pg_num:
+                    return -22, "pg_num cannot shrink (merge is not " \
+                        "supported)", None
+                if new == pool.pg_num:
+                    return 0, f"pg_num is already {new}", None
+                # OSDs split on this epoch (OSD::split_pgs).  pgp_num
+                # deliberately does NOT follow: children keep the
+                # parent's placement seed, so every split shard stays
+                # on the OSD that already holds its chunk (EC shard
+                # identity is positional).  Raising pgp_num afterwards
+                # re-places children as ordinary recovery/backfill —
+                # the reference's two-step split-then-rebalance
+                pool.pg_num = new
+            elif var == "pgp_num":
+                new = int(val)
+                if new > pool.pg_num:
+                    return -22, "pgp_num cannot exceed pg_num", None
+                pool.pgp_num = new
+            elif var == "size":
+                if pool.is_erasure():
+                    # EC width IS k+m from the profile; resizing would
+                    # desync shard count from the code (the reference
+                    # rejects it the same way)
+                    return -95, "cannot change size of an " \
+                        "erasure-coded pool", None
+                pool.size = int(val)
+            elif var == "min_size":
+                new = int(val)
+                if not 1 <= new <= pool.size:
+                    return -22, f"min_size must be in [1, " \
+                        f"{pool.size}]", None
+                pool.min_size = new
+            else:
+                return -22, f"unsupported pool var {var!r}", None
+            pool.last_change = m.epoch + 1
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"set pool {name} {var} to {val}", None
         if prefix == "osd pool delete":
             name = cmd["pool"]
             if name not in self.osdmap.pool_name:
@@ -612,6 +659,116 @@ class MDSMonitor(PaxosService):
         return None
 
 
+class MgrMonitor(PaxosService):
+    """MgrMap service: mgr beacons, active/standby election, beacon-
+    timeout failover (reference ``src/mon/MgrMonitor.cc``).  The map
+    is a flat dict: {epoch, active_name, active_addr, standbys}."""
+
+    NAME = "mgrmap"
+    BEACON_GRACE = 3.0
+
+    def __init__(self, mon):
+        super().__init__(mon)
+        self.mgrmap: dict = {"epoch": 0, "active_name": "",
+                             "active_addr": None, "standbys": []}
+        self.pending_mgrmap: dict | None = None
+        self.last_beacon: dict[str, float] = {}
+        self._addrs: dict[str, list] = {}
+
+    def create_initial(self):
+        self.mgrmap["epoch"] = 1
+        self.stage("put", 1, json.dumps(self.mgrmap))
+        self.stage("put", "last_epoch", "1")
+
+    def update_from_store(self):
+        epoch = self.mon.store.get_int(self.prefix, "last_epoch")
+        if epoch > self.mgrmap["epoch"]:
+            blob = self.mon.store.get_str(self.prefix, epoch)
+            if blob:
+                self.mgrmap = json.loads(blob)
+                self.mon.push_map("mgrmap", epoch, self.mgrmap)
+        if self.pending_mgrmap is not None and \
+                self.mgrmap["epoch"] >= self.pending_mgrmap["epoch"]:
+            self.pending_mgrmap = None
+
+    def _cur(self) -> dict:
+        return self.pending_mgrmap if self.pending_mgrmap is not None \
+            else self.mgrmap
+
+    def _stage_map(self, m: dict):
+        m["epoch"] += 1
+        self.stage("put", m["epoch"], json.dumps(m))
+        self.stage("put", "last_epoch", str(m["epoch"]))
+        self.pending_mgrmap = m
+
+    def handle_beacon(self, name: str, addr, seq):
+        self.last_beacon[name] = time.monotonic()
+        self._addrs[name] = list(addr or [])
+        cur = self._cur()
+        if cur["active_name"] == name or name in cur["standbys"]:
+            return
+        m = dict(cur, standbys=list(cur["standbys"]))
+        if not m["active_name"]:
+            m["active_name"] = name
+            m["active_addr"] = list(addr or [])
+        else:
+            m["standbys"].append(name)
+        self._stage_map(m)
+        self.mon.propose()
+
+    def tick(self):
+        now = time.monotonic()
+        cur = self._cur()
+        names = ([cur["active_name"]] if cur["active_name"] else []) \
+            + list(cur["standbys"])
+        stale = []
+        for n in names:
+            self.last_beacon.setdefault(n, now)
+            if now - self.last_beacon[n] > self.BEACON_GRACE:
+                stale.append(n)
+        if not stale:
+            return
+        m = dict(cur, standbys=[n for n in cur["standbys"]
+                                if n not in stale])
+        for n in stale:
+            self.last_beacon.pop(n, None)
+        if m["active_name"] in stale:
+            m["active_name"] = ""
+            m["active_addr"] = None
+        if not m["active_name"] and m["standbys"]:
+            promoted = m["standbys"].pop(0)
+            m["active_name"] = promoted
+            m["active_addr"] = self._addrs.get(promoted)
+        self._stage_map(m)
+        self.mon.propose()
+
+    def dispatch_command(self, cmd):
+        prefix = cmd.get("prefix", "")
+        if prefix == "mgr dump":
+            return 0, "", dict(self.mgrmap)
+        if prefix == "mgr stat":
+            return 0, "", {"epoch": self.mgrmap["epoch"],
+                           "active_name": self.mgrmap["active_name"],
+                           "available": bool(self.mgrmap["active_name"]),
+                           "num_standbys": len(self.mgrmap["standbys"])}
+        if prefix == "mgr fail":
+            cur = self._cur()
+            who = cmd.get("who") or cur["active_name"]
+            if who != cur["active_name"]:
+                return -2, f"mgr {who!r} is not active", None
+            m = dict(cur, standbys=list(cur["standbys"]),
+                     active_name="", active_addr=None)
+            self.last_beacon.pop(who, None)
+            if m["standbys"]:
+                promoted = m["standbys"].pop(0)
+                m["active_name"] = promoted
+                m["active_addr"] = self._addrs.get(promoted)
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"failed mgr {who}", None
+        return None
+
+
 class AuthMonitor(PaxosService):
     NAME = "auth"
 
@@ -871,8 +1028,9 @@ class Monitor(Dispatcher):
         self.paxos.on_commit = self._on_paxos_commit
         self.paxos.on_active = self._on_paxos_active
         self.services: dict[str, PaxosService] = {}
-        for svc_cls in (OSDMonitor, MDSMonitor, AuthMonitor,
-                        ConfigMonitor, LogMonitor, HealthMonitor):
+        for svc_cls in (OSDMonitor, MDSMonitor, MgrMonitor,
+                        AuthMonitor, ConfigMonitor, LogMonitor,
+                        HealthMonitor):
             self.services[svc_cls.NAME] = svc_cls(self)
         self._peer_cons: dict[int, object] = {}
         self.pgmap = PGMap()
@@ -988,6 +1146,9 @@ class Monitor(Dispatcher):
         fssvc = self.services.get("fsmap")
         if fssvc is not None:
             fssvc.pending_fsmap = None
+        mgrsvc = self.services.get("mgrmap")
+        if mgrsvc is not None:
+            mgrsvc.pending_mgrmap = None
         self.elector.start()
         if self.elector.state == "leader" and not was_leader:
             self.paxos.leader_collect(self.elector.quorum)
@@ -1049,20 +1210,22 @@ class Monitor(Dispatcher):
         self._drain_outboxes()
 
     # -- subscriptions -----------------------------------------------------
+    _MAP_MSG = {
+        "osdmap": lambda epoch, p: M.MOSDMapMsg(epoch=epoch, osdmap=p),
+        "fsmap": lambda epoch, p: M.MFSMapMsg(epoch=epoch, fsmap=p),
+        "mgrmap": lambda epoch, p: M.MMgrMapMsg(epoch=epoch, mgrmap=p),
+    }
+
     def push_map(self, what: str, epoch: int, payload: dict):
         """Called by services after a commit: feed subscribers."""
-        if what not in ("osdmap", "fsmap"):
+        make = self._MAP_MSG.get(what)
+        if make is None:
             return
         dead = []
         for con, subs in self._subs.items():
             if what in subs:
                 try:
-                    if what == "osdmap":
-                        con.send_message(M.MOSDMapMsg(epoch=epoch,
-                                                      osdmap=payload))
-                    else:
-                        con.send_message(M.MFSMapMsg(epoch=epoch,
-                                                     fsmap=payload))
+                    con.send_message(make(epoch, payload))
                 except ConnectionError:
                     dead.append(con)
         for con in dead:
@@ -1138,6 +1301,22 @@ class Monitor(Dispatcher):
                         fsmap=fssvc.fsmap.to_dict()))
                 except ConnectionError:
                     self._subs.pop(msg.connection, None)
+            mgrsvc: MgrMonitor = self.services["mgrmap"]
+            if "mgrmap" in subs and mgrsvc.mgrmap["epoch"] >= 1:
+                try:
+                    msg.connection.send_message(M.MMgrMapMsg(
+                        epoch=mgrsvc.mgrmap["epoch"],
+                        mgrmap=dict(mgrsvc.mgrmap)))
+                except ConnectionError:
+                    self._subs.pop(msg.connection, None)
+            return True
+        if isinstance(msg, M.MMgrBeacon):
+            if self.is_leader:
+                self.services["mgrmap"].handle_beacon(
+                    msg.name, msg.addr, msg.seq)
+            elif self.elector.leader is not None and not msg.fwd:
+                self._peer_send(self.elector.leader, M.MMgrBeacon(
+                    name=msg.name, addr=msg.addr, seq=msg.seq, fwd=1))
             return True
         if isinstance(msg, M.MMDSBeacon):
             if self.is_leader:
@@ -1296,5 +1475,5 @@ def _is_mutating(cmd: dict) -> bool:
                  "osd erasure-code-profile ls", "auth get", "auth ls",
                  "config-key get", "config-key ls", "log last",
                  "mon dump", "quorum_status", "fs ls", "fs dump",
-                 "mds stat")
+                 "mds stat", "mgr dump", "mgr stat")
     return prefix not in read_only

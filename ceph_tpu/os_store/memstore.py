@@ -14,10 +14,10 @@ from typing import Callable
 
 from ..core.threading_utils import Finisher
 from .objectstore import (Collection, ObjectStore, StoredObject,
-                          Transaction, OP_CLONE, OP_MKCOLL,
-                          OP_OMAP_RMKEYS, OP_OMAP_SETKEYS, OP_REMOVE,
-                          OP_RMATTR, OP_RMCOLL, OP_SETATTRS, OP_TOUCH,
-                          OP_TRUNCATE, OP_WRITE, OP_ZERO)
+                          Transaction, OP_CLONE, OP_COLL_MOVE,
+                          OP_MKCOLL, OP_OMAP_RMKEYS, OP_OMAP_SETKEYS,
+                          OP_REMOVE, OP_RMATTR, OP_RMCOLL, OP_SETATTRS,
+                          OP_TOUCH, OP_TRUNCATE, OP_WRITE, OP_ZERO)
 
 
 class MemStore(ObjectStore):
@@ -100,6 +100,12 @@ class MemStore(ObjectStore):
             o = self._obj(cid, oid, create=True)
             for k in op[3]:
                 o.omap.pop(k, None)
+        elif code == OP_COLL_MOVE:
+            # idempotent: WAL replay after the move finds nothing left
+            o = self._coll(cid).objects.pop(oid, None)
+            if o is not None:
+                self.colls.setdefault(
+                    op[3], Collection(op[3])).objects[oid] = o
         elif code == OP_CLONE:
             src = self._obj(cid, oid)
             dst = self._obj(cid, op[3], create=True)

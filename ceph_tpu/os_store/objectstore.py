@@ -34,6 +34,7 @@ OP_OMAP_RMKEYS = "omap_rmkeys"
 OP_CLONE = "clone"
 OP_MKCOLL = "create_collection"
 OP_RMCOLL = "remove_collection"
+OP_COLL_MOVE = "coll_move"      # reference OP_COLL_MOVE_RENAME (split)
 
 
 class Transaction:
@@ -95,6 +96,13 @@ class Transaction:
     def omap_rmkeys(self, cid: str, oid: str,
                     keys: list[str]) -> "Transaction":
         self.ops.append([OP_OMAP_RMKEYS, cid, oid, list(keys)])
+        return self
+
+    def coll_move(self, cid: str, oid: str,
+                  dest_cid: str) -> "Transaction":
+        """Move an object between collections (PG split/merge path —
+        reference ``OP_COLL_MOVE_RENAME``)."""
+        self.ops.append([OP_COLL_MOVE, cid, oid, dest_cid])
         return self
 
     def clone(self, cid: str, oid: str, dest: str) -> "Transaction":

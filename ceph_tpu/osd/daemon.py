@@ -35,6 +35,7 @@ from ..mon import messages as MM
 from ..mon.client import MonClient
 from ..msg import Dispatcher, EntityAddr, Messenger
 from ..os_store import MemStore
+from ..os_store.objectstore import Transaction
 from ..tools.osdmaptool import osdmap_from_dict
 from . import messages as M
 from .osdmap import OSDMap, PGid
@@ -264,6 +265,7 @@ class OSDaemon(Dispatcher):
                         (o >= prev.max_osd or not prev.is_up(o)):
                     self._hb_last.pop(o, None)
                     self._hb_reported.pop(o, None)
+            self._split_pgs(prev)
             placements = self._update_pg_intervals()
             catching_up = epoch < max(newest, self.monc.osdmap_epoch)
             if catching_up:
@@ -290,6 +292,117 @@ class OSDaemon(Dispatcher):
                         fn = getattr(pg.backend, "snap_trim", None)
                         if fn is not None:
                             fn(removed)
+
+    def _split_pgs(self, prev: OSDMap):
+        """PG splitting on pg_num growth (reference ``OSD::split_pgs``
+        + ``PG::split_into`` + ``pg_t::is_split``): every OSD holding
+        a parent collection carves out the child PGs locally — objects
+        (with their snap clones), log entries, snap-mapper index, and
+        info move by ``ceph_stable_mod`` re-hash; children then peer
+        under the new map with their data already in place, and CRUSH
+        relocation proceeds as ordinary recovery/backfill."""
+        import json as _json
+
+        from ..crush.hash import ceph_str_hash_rjenkins
+        from .osdmap import ceph_stable_mod
+        from .pg import META_OID, SNAPMAP_OID, _SNAP_SEP
+
+        for pid, pool in self.osdmap.pools.items():
+            old = prev.pools.get(pid)
+            if old is None or pool.pg_num <= old.pg_num:
+                continue
+            old_n, old_mask = old.pg_num, old.pg_num_mask
+
+            def head_of(oid: str) -> str:
+                return oid.split(_SNAP_SEP, 1)[0]
+
+            def child_ps(oid: str) -> int:
+                seed = int(ceph_str_hash_rjenkins(head_of(oid).encode()))
+                return pool.raw_pg_to_pg(seed)
+
+            shards = range(pool.size) if pool.is_erasure() else (-1,)
+            for p_ps in range(old_n):
+                children = [c for c in range(old_n, pool.pg_num)
+                            if ceph_stable_mod(c, old_n, old_mask) == p_ps]
+                if not children:
+                    continue
+                parent = PGid(pid, p_ps)
+                for s in shards:
+                    pcid = str(parent) if s < 0 else f"{parent}s{s}"
+                    if not self.store.collection_exists(pcid):
+                        continue
+                    try:
+                        meta = self.store.omap_get(pcid, META_OID)
+                    except KeyError:
+                        meta = {}
+                    pinfo = (_json.loads(meta["info"])
+                             if "info" in meta else None)
+                    plog = (_json.loads(meta["log"])
+                            if "log" in meta else None)
+                    try:
+                        snapmap = self.store.omap_get(pcid, SNAPMAP_OID)
+                    except KeyError:
+                        snapmap = {}
+                    kept_entries = list((plog or {}).get("entries", []))
+                    for c in children:
+                        child = PGid(pid, c)
+                        ccid = str(child) if s < 0 else f"{child}s{s}"
+                        if self.store.collection_exists(ccid):
+                            continue    # idempotent (restart replay)
+                        t = Transaction().create_collection(ccid)
+                        t.touch(ccid, META_OID)
+                        for oid in self.store.list_objects(pcid):
+                            if oid in (META_OID, SNAPMAP_OID):
+                                continue
+                            if child_ps(oid) == c:
+                                t.coll_move(pcid, oid, ccid)
+                        # snap-mapper index rows follow their objects
+                        moved_rows = {
+                            key: val for key, val in snapmap.items()
+                            if child_ps(key.split("|", 1)[1]
+                                        .rsplit("|", 1)[0]) == c}
+                        if moved_rows:
+                            t.omap_setkeys(ccid, SNAPMAP_OID,
+                                           moved_rows)
+                            t.omap_rmkeys(pcid, SNAPMAP_OID,
+                                          list(moved_rows))
+                        # meta: child inherits the parent's history,
+                        # log filtered to its objects (reference
+                        # PGLog::split_out_child)
+                        if pinfo is not None:
+                            cinfo = dict(pinfo, pgid=str(child))
+                            clog = dict(plog or {})
+                            clog["entries"] = [
+                                e for e in kept_entries
+                                if child_ps(e["oid"]) == c]
+                            kept_entries = [
+                                e for e in kept_entries
+                                if child_ps(e["oid"]) != c]
+                            t.omap_setkeys(ccid, META_OID, {
+                                "info": _json.dumps(cinfo).encode(),
+                                "log": _json.dumps(clog).encode()})
+                        self.store.queue_transaction(t)
+                        # child peering must account for the parent's
+                        # maybe-went-rw history
+                        self.pg_intervals.setdefault(child, [])
+                        self.pg_intervals[child][:] = [
+                            dict(iv) for iv in
+                            self.pg_intervals.get(parent, [])]
+                    if pinfo is not None and plog is not None and \
+                            len(kept_entries) != len(plog["entries"]):
+                        plog = dict(plog, entries=kept_entries)
+                        self.store.queue_transaction(
+                            Transaction().omap_setkeys(pcid, META_OID, {
+                                "log": _json.dumps(plog).encode()}))
+                # in-memory parent drops the moved objects' log rows;
+                # everything else reloads naturally on advance_map
+                ppg = self.pgs.get(parent)
+                if ppg is not None:
+                    ppg._held_cache = None
+                    ppg.log.entries = [
+                        e for e in ppg.log.entries
+                        if pool.raw_pg_to_pg(int(ceph_str_hash_rjenkins(
+                            head_of(e.oid).encode()))) == p_ps]
 
     def _update_pg_intervals(self):
         """Track acting-set intervals for every PG of every pool at

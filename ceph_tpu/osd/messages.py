@@ -115,10 +115,13 @@ class MOSDPing(_JsonMessage):
 @register_message
 class MOSDPGPush(_JsonMessage):
     """Recovery push: full object (or shard chunk) state (reference
-    MOSDPGPush carrying PushOp)."""
+    MOSDPGPush carrying PushOp).  `clones`/`snapmap` carry the head's
+    snap clones and their SnapMapper index rows — the reference's
+    SnapSet-aware push (a recovered head without its clones would
+    silently lose snapshot history)."""
     TYPE = 52
     FIELDS = ("pgid", "epoch", "oid", "data", "attrs", "omap", "version",
-              "from_osd", "pull_tid")
+              "from_osd", "pull_tid", "clones", "snapmap")
 
 
 @register_message

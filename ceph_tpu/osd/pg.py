@@ -110,6 +110,7 @@ class PG:
         self._scrub_waiting: set[int] = set()
         self._pulls: dict[int, str] = {}       # pull_tid → oid
         self._pull_tid = 0
+        self._held_cache: list[int] | None = None   # see _held_shards
         # backfill (reference PrimaryLogPG backfill scan): peers whose
         # gap exceeds the log are refilled by walking the collection
         # in batches behind a cursor, not one giant synchronous push
@@ -142,6 +143,68 @@ class PG:
         m = self.daemon.osdmap
         return [o for o in self.acting
                 if o != CRUSH_ITEM_NONE and m.is_up(o)]
+
+    # -- EC shard reality (split / re-placement) ---------------------------
+    def _held_shards(self) -> list[int]:
+        """Which shard collections on THIS OSD hold actual object
+        data.  After a split or pgp_num re-placement the assigned
+        shard can differ from the held one — peering advertises this
+        so the primary can re-home reconstruction (see PGInfo).
+        Cached: the store scan is O(objects); invalidated on interval
+        change / split, extended in place on local writes."""
+        if not self.pool.is_erasure():
+            return []
+        if self._held_cache is None:
+            out = []
+            for s in range(self.pool.size):
+                cid = self.cid_for_shard(s)
+                if not self.daemon.store.collection_exists(cid):
+                    continue
+                try:
+                    objs = self.daemon.store.list_objects(cid)
+                except KeyError:
+                    continue
+                if any(o not in (META_OID, SNAPMAP_OID) for o in objs):
+                    out.append(s)
+            self._held_cache = out
+        return list(self._held_cache)
+
+    def _note_local_object_write(self):
+        """First write into the assigned shard collection makes it
+        'held' — keep the cache truthful without a rescan."""
+        if self._held_cache is not None and self.shard >= 0 \
+                and self.shard not in self._held_cache:
+            self._held_cache.append(self.shard)
+
+    def _info_dict(self) -> dict:
+        d = self.info.to_dict()
+        if self.pool.is_erasure():
+            d["shards_held"] = self._held_shards()
+        return d
+
+    def _ec_inventory(self) -> dict[str, tuple]:
+        """oid → version for every object this PG should hold: the
+        log's surviving writes, plus anything in locally held shard
+        collections the (possibly trimmed) log no longer mentions."""
+        inv: dict[str, tuple] = {}
+        for e in self.log.entries:
+            if e.op == DELETE:
+                inv.pop(e.oid, None)
+            else:
+                inv[e.oid] = e.version
+        store = self.daemon.store
+        for s in self._held_shards():
+            cid = self.cid_for_shard(s)
+            for oid in store.list_objects(cid):
+                if oid in (META_OID, SNAPMAP_OID) or oid in inv:
+                    continue
+                try:
+                    meta = json.loads(bytes(store.getattr(cid, oid,
+                                                          "_")))
+                    inv[oid] = tuple(meta.get("version", ZERO))
+                except KeyError:
+                    inv[oid] = ZERO
+        return inv
 
     # -- persistence -------------------------------------------------------
     def _persist_meta(self, txn: Transaction | None = None) -> Transaction:
@@ -191,6 +254,7 @@ class PG:
             self._scrub_maps.clear()
             self._scrub_waiting.clear()
             self.backend.on_change()
+            self._held_cache = None
             self.peer_info.clear()
             self.peer_missing.clear()
             self._queried.clear()
@@ -270,13 +334,13 @@ class PG:
         if msg.kind == "info":
             self.daemon.send_to_osd(msg.from_osd, M.MOSDPGNotify(
                 pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
-                info=self.info.to_dict(), from_osd=self.daemon.whoami))
+                info=self._info_dict(), from_osd=self.daemon.whoami))
         elif msg.kind == "log":
             since = tuple(msg.since) if msg.since else ZERO
             entries = [e.to_dict() for e in self.log.entries_after(since)]
             self.daemon.send_to_osd(msg.from_osd, M.MOSDPGLog(
                 pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
-                info=self.info.to_dict(), entries=entries,
+                info=self._info_dict(), entries=entries,
                 activate=False, from_osd=self.daemon.whoami))
 
     def handle_notify(self, msg: M.MOSDPGNotify):
@@ -399,9 +463,31 @@ class PG:
                        [e for e in self.log.entries])
             self.daemon.send_to_osd(o, M.MOSDPGLog(
                 pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
-                info=self.info.to_dict(),
+                info=self._info_dict(),
                 entries=[e.to_dict() for e in entries],
                 activate=True, from_osd=self.daemon.whoami))
+        if self.pool.is_erasure():
+            # split / pgp_num re-placement can permute shard
+            # assignments: a member whose ASSIGNED shard collection is
+            # empty (its data lives under another shard id) needs its
+            # whole chunk set reconstructed, invisible to the log diff
+            # above because logs match (reference: EC PGs are
+            # per-shard entities; this recreates the shard-granular
+            # missing set)
+            inv = None
+            if self.shard not in self._held_shards():
+                inv = self._ec_inventory()
+                for oid, ver in inv.items():
+                    self.missing.setdefault(oid, ver)
+            for o in self._peer_osds():
+                pi = self.peer_info.get(o)
+                if pi is None or pi.shards_held is None:
+                    continue
+                if self.acting.index(o) not in pi.shards_held:
+                    if inv is None:
+                        inv = self._ec_inventory()
+                    if inv:
+                        self.peer_missing[o] = dict(inv)
         self.state = "active"
         self.daemon.store.queue_transaction(self._persist_meta())
         waiters, self.waiting_for_active = self.waiting_for_active, []
@@ -1309,6 +1395,33 @@ class ReplicatedBackend:
             store.queue_transaction(t)
 
     # -- recovery ----------------------------------------------------------
+    @staticmethod
+    def _snap_payload(store, cid: str, oid: str):
+        """A head's snap clones + SnapMapper rows, for the push
+        payload (reference: recovery is SnapSet-aware — clones travel
+        with the head)."""
+        clones = {}
+        prefix = f"{oid}{_SNAP_SEP}"
+        try:
+            siblings = store.list_objects(cid)
+        except KeyError:
+            return None, None
+        for o in siblings:
+            if o.startswith(prefix):
+                clones[o] = {
+                    "data": store.read(cid, o).hex(),
+                    "attrs": {k: v.hex() for k, v in
+                              store.getattrs(cid, o).items()}}
+        rows = {}
+        try:
+            snapmap = store.omap_get(cid, SNAPMAP_OID)
+        except KeyError:
+            snapmap = {}
+        for key, val in snapmap.items():
+            if key.split("|", 1)[1].rsplit("|", 1)[0] == oid:
+                rows[key] = val.hex()
+        return clones or None, rows or None
+
     def push_object(self, peer: int, oid: str, version: tuple):
         pg, daemon = self.pg, self.pg.daemon
         cid = pg.cid
@@ -1318,13 +1431,14 @@ class ReplicatedBackend:
             omap = daemon.store.omap_get(cid, oid)
         except KeyError:
             return
+        clones, snaprows = self._snap_payload(daemon.store, cid, oid)
         daemon.send_to_osd(peer, M.MOSDPGPush(
             pgid=str(pg.pgid), epoch=daemon.osdmap.epoch, oid=oid,
             data=data.hex(),
             attrs={k: v.hex() for k, v in attrs.items()},
             omap={k: v.hex() for k, v in omap.items()},
             version=list(version), from_osd=daemon.whoami,
-            pull_tid=None))
+            pull_tid=None, clones=clones, snapmap=snaprows))
 
     def recover_primary_object(self, oid: str, version: tuple):
         """Pull from any peer whose info covers the version."""
@@ -1350,13 +1464,16 @@ class ReplicatedBackend:
         except KeyError:
             return
         meta = json.loads(bytes(attrs.get("_", b"{}")) or b"{}")
+        clones, snaprows = self._snap_payload(daemon.store, pg.cid,
+                                              msg.oid)
         daemon.send_to_osd(msg.from_osd, M.MOSDPGPush(
             pgid=str(pg.pgid), epoch=daemon.osdmap.epoch, oid=msg.oid,
             data=data.hex(),
             attrs={k: v.hex() for k, v in attrs.items()},
             omap={k: v.hex() for k, v in omap.items()},
             version=meta.get("version", list(ZERO)),
-            from_osd=daemon.whoami, pull_tid=msg.pull_tid))
+            from_osd=daemon.whoami, pull_tid=msg.pull_tid,
+            clones=clones, snapmap=snaprows))
 
     def apply_push(self, msg: M.MOSDPGPush):
         pg, daemon = self.pg, self.pg.daemon
@@ -1374,6 +1491,16 @@ class ReplicatedBackend:
         if msg.omap:
             t.omap_setkeys(cid, msg.oid, {
                 k: bytes.fromhex(v) for k, v in msg.omap.items()})
+        for coid, payload in (msg.clones or {}).items():
+            t.remove(cid, coid)
+            t.write(cid, coid, 0, bytes.fromhex(payload["data"]))
+            if payload.get("attrs"):
+                t.setattrs(cid, coid, {
+                    k: bytes.fromhex(v)
+                    for k, v in payload["attrs"].items()})
+        if msg.snapmap:
+            t.omap_setkeys(cid, SNAPMAP_OID, {
+                k: bytes.fromhex(v) for k, v in msg.snapmap.items()})
         pg.missing.pop(msg.oid, None)
         pg._persist_meta(t)
         daemon.store.queue_transaction(t)
@@ -1606,6 +1733,7 @@ class ECBackend:
         txn = Transaction.from_dict(msg.txn)
         entries = [LogEntry.from_dict(e) for e in msg.log_entries or []]
         self._apply_shard_txn(txn, entries)
+        pg._note_local_object_write()
         daemon.send_to_osd(pg.primary, M.MOSDECSubOpWriteReply(
             reqid=msg.reqid, pgid=msg.pgid, shard=msg.shard,
             epoch=daemon.osdmap.epoch, rc=0, from_osd=daemon.whoami))
@@ -1685,11 +1813,26 @@ class ECBackend:
         return None
 
     def _available_shards(self) -> dict[int, int]:
-        """shard → osd for shards that are live and (for primary-known
-        missing objects) usable."""
+        """shard → osd by acting position, live members only."""
         pg, m = self.pg, self.pg.daemon.osdmap
         return {s: o for s, o in enumerate(pg.acting)
                 if o != CRUSH_ITEM_NONE and m.is_up(o)}
+
+    def _holders_by_shard(self) -> dict[int, list[int]]:
+        """shard → acting members whose shard-s COLLECTION holds data,
+        from peering-time shards_held advertisements (split /
+        re-placement leftovers).  Alternates for when the assigned
+        member lacks an object — sub-reads are collection-addressed,
+        so any holder can serve."""
+        pg, m = self.pg, self.pg.daemon.osdmap
+        held_by: dict[int, list[int]] = {}
+        for s in pg._held_shards():
+            held_by.setdefault(s, []).append(pg.daemon.whoami)
+        for o, pi in pg.peer_info.items():
+            if o in pg.acting and m.is_up(o) and pi.shards_held:
+                for s in pi.shards_held:
+                    held_by.setdefault(s, []).append(o)
+        return held_by
 
     def _start_data_read(self, msg: M.MOSDOp, want=None, on_chunks=None,
                          exclude: set[int] | None = None, on_fail=None):
@@ -1705,11 +1848,24 @@ class ECBackend:
         avail = self._available_shards()
         for s in exclude or ():
             avail.pop(s, None)
-        # skip shards whose OSD is known to still miss this object
+        holders = self._holders_by_shard()
+        # assigned-member-first with alternate-holder fallback: a
+        # member that still misses this object (recovery in flight, or
+        # its shard collection moved in a split/re-placement) is
+        # swapped for an acting member that actually HOLDS the shard
+        # collection; a later -ENOENT sub-read reply retries the
+        # remaining alternates (handle_sub_read_reply)
+        alts: dict[int, list[int]] = {}
         for s, o in list(avail.items()):
+            alts[s] = [h for h in holders.get(s, []) if h != o]
             pm = pg.peer_missing.get(o)
-            if pm and oid in pm:
-                avail.pop(s, None)
+            misses = (pm is not None and oid in pm) or \
+                (o == daemon.whoami and oid in pg.missing)
+            if misses:
+                if alts[s]:
+                    avail[s] = alts[s].pop(0)
+                else:
+                    avail.pop(s, None)
         want = set(range(k)) if want is None else set(want)
         try:
             need = self.engine.minimum_to_decode(want, set(avail))
@@ -1723,29 +1879,52 @@ class ECBackend:
         tid = self._read_tid
         st = {"msg": msg, "need": set(need), "chunks": {},
               "want": want, "on_chunks": on_chunks, "oid": oid,
-              "on_fail": on_fail}
+              "on_fail": on_fail, "alts": alts}
         self._reads[tid] = st
         for s in need:
-            o = avail[s]
-            if o == daemon.whoami:
-                try:
-                    st["chunks"][s] = daemon.store.read(
-                        pg.cid_for_shard(s), oid)
-                    local_meta = self._read_local_meta(oid)
-                    if local_meta is not None:
-                        st.setdefault("meta", local_meta)
-                except KeyError:
-                    del self._reads[tid]
-                    if on_fail is not None:
-                        on_fail()
-                    if msg is not None:
-                        pg._reply(msg, -2, "no such object")
-                    return
-            else:
-                daemon.send_to_osd(o, M.MOSDECSubOpRead(
-                    tid=tid, pgid=str(pg.pgid), shard=s,
-                    epoch=daemon.osdmap.epoch, oid=oid, attrs=True))
+            if not self._issue_shard_read(tid, s, avail[s]):
+                return
         self._maybe_finish_read(tid)
+
+    def _issue_shard_read(self, tid: int, s: int, o: int) -> bool:
+        """Fetch shard s of st's object from osd o (local or remote).
+        → False when the read aborted (state already cleaned up)."""
+        pg, daemon = self.pg, self.pg.daemon
+        st = self._reads.get(tid)
+        if st is None:
+            return False
+        oid = st["oid"]
+        if o != daemon.whoami:
+            daemon.send_to_osd(o, M.MOSDECSubOpRead(
+                tid=tid, pgid=str(pg.pgid), shard=s,
+                epoch=daemon.osdmap.epoch, oid=oid, attrs=True))
+            return True
+        cid = pg.cid_for_shard(s)
+        try:
+            chunk = daemon.store.read(cid, oid)
+        except KeyError:
+            nxt = st["alts"].get(s)
+            if nxt:
+                return self._issue_shard_read(tid, s, nxt.pop(0))
+            del self._reads[tid]
+            if st.get("on_fail") is not None:
+                st["on_fail"]()
+            if st["msg"] is not None:
+                pg._reply(st["msg"], -2, "no such object")
+            return False
+        st["chunks"][s] = chunk
+        try:
+            meta = json.loads(bytes(daemon.store.getattr(cid, oid,
+                                                         "_")))
+            # the mixed-version guard must see LOCAL chunks too — a
+            # stale local shard collection is exactly as dangerous as
+            # a remote one
+            st.setdefault("vers", {})[s] = tuple(
+                meta.get("version", ZERO))
+            st.setdefault("meta", meta)
+        except KeyError:
+            pass
+        return True
 
     def handle_sub_read(self, msg: M.MOSDECSubOpRead):
         pg, daemon = self.pg, self.pg.daemon
@@ -1766,6 +1945,14 @@ class ECBackend:
         if st is None:
             return
         if msg.rc != 0:
+            # the assigned member may simply not hold this object's
+            # chunk yet (split / re-placement): try the remaining
+            # holders of the shard collection before failing
+            nxt = (st.get("alts") or {}).get(msg.shard)
+            if msg.rc == -2 and nxt:
+                self._issue_shard_read(msg.tid, msg.shard, nxt.pop(0))
+                self._maybe_finish_read(msg.tid)
+                return
             del self._reads[msg.tid]
             if st.get("on_fail") is not None:
                 st["on_fail"]()
@@ -1785,12 +1972,26 @@ class ECBackend:
                 self.pg._reply(st["msg"], -5, "chunk crc mismatch")
             return
         st["chunks"][msg.shard] = chunk
+        st.setdefault("vers", {})[msg.shard] = tuple(
+            meta.get("version", ZERO))
         st.setdefault("meta", meta)
         self._maybe_finish_read(msg.tid)
 
     def _maybe_finish_read(self, tid: int):
         st = self._reads.get(tid)
         if st is None or set(st["chunks"]) < st["need"]:
+            return
+        # a stale stray shard collection (pre-re-placement leftover)
+        # must never be decoded against fresh chunks: all gathered
+        # versions have to agree or the decode would be garbage
+        vers = set((st.get("vers") or {}).values())
+        if len(vers) > 1:
+            del self._reads[tid]
+            if st.get("on_fail") is not None:
+                st["on_fail"]()
+            if st["msg"] is not None:
+                self.pg._reply(st["msg"], -5,
+                               "mixed-version shard chunks")
             return
         del self._reads[tid]
         chunks = {s: np.frombuffer(c, dtype=np.uint8)
@@ -1946,5 +2147,6 @@ class ECBackend:
             t.setattrs(cid, msg.oid,
                        {k: bytes.fromhex(v) for k, v in msg.attrs.items()})
         pg.missing.pop(msg.oid, None)
+        pg._note_local_object_write()
         pg._persist_meta(t)
         pg.daemon.store.queue_transaction(t)

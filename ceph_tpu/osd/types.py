@@ -69,6 +69,13 @@ class PGInfo:
     # pg_history_t::last_epoch_started) — the cutoff for which past
     # intervals peering must still account for
     last_epoch_started: int = 0
+    # EC only: which shard collections this member actually holds DATA
+    # for.  After a split or pgp_num re-placement the assigned shard
+    # can differ from the held one (chunk identity is positional); the
+    # primary reads this to re-home reconstruction sources and to mark
+    # mismatched members missing (reference: per-shard pg_info_t —
+    # EC PGs are addressed as pgid.shard upstream).
+    shards_held: list | None = None
 
     def to_dict(self) -> dict:
         return {"pgid": self.pgid,
@@ -77,7 +84,8 @@ class PGInfo:
                 "log_tail": list(self.log_tail),
                 "same_interval_since": self.same_interval_since,
                 "epoch_created": self.epoch_created,
-                "last_epoch_started": self.last_epoch_started}
+                "last_epoch_started": self.last_epoch_started,
+                "shards_held": self.shards_held}
 
     @classmethod
     def from_dict(cls, d: dict) -> "PGInfo":
@@ -87,7 +95,8 @@ class PGInfo:
                    log_tail=tuple(d.get("log_tail", ZERO)),
                    same_interval_since=d.get("same_interval_since", 0),
                    epoch_created=d.get("epoch_created", 0),
-                   last_epoch_started=d.get("last_epoch_started", 0))
+                   last_epoch_started=d.get("last_epoch_started", 0),
+                   shards_held=d.get("shards_held"))
 
 
 MAX_DUPS = 3000     # reference osd_pg_log_dups_tracked (default 3000)

@@ -46,6 +46,7 @@ class MiniCluster:
         self.n_osds = n_osds
         self._clients: list[Rados] = []
         self.mdss: dict[str, MDSDaemon] = {}
+        self.mgrs: dict[str, object] = {}
         self._fs_clients: list = []
 
     # -- lifecycle ---------------------------------------------------------
@@ -89,6 +90,25 @@ class MiniCluster:
 
     def revive_osd(self, i: int, timeout: float = 30.0) -> OSDaemon:
         return self.start_osd(i, timeout=timeout)
+
+    # -- mgr ---------------------------------------------------------------
+    def start_mgr(self, name: str, **kw):
+        from .mgr.daemon import MgrDaemon
+        mgr = MgrDaemon(name, self.monmap, **kw).start()
+        self.mgrs[name] = mgr
+        return mgr
+
+    def kill_mgr(self, name: str):
+        self.mgrs.pop(name).kill()
+
+    def wait_for_active_mgr(self, timeout: float = 20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for name, mgr in self.mgrs.items():
+                if mgr.state == "active":
+                    return name
+            time.sleep(0.05)
+        raise TimeoutError("no active mgr")
 
     # -- mds / cephfs ------------------------------------------------------
     def start_mds(self, name: str, **kw) -> MDSDaemon:
@@ -139,6 +159,11 @@ class MiniCluster:
         for mds in list(self.mdss.values()):
             try:
                 mds.shutdown()
+            except Exception:
+                pass
+        for mgr in list(self.mgrs.values()):
+            try:
+                mgr.shutdown()
             except Exception:
                 pass
         for c in self._clients:
