@@ -37,10 +37,6 @@ class MgrModuleContext:
         d = self._d.monc.osdmap_dict
         return osdmap_from_dict(d) if d else None
 
-    def get_pg_states(self) -> dict:
-        rc, _, out = self._d.monc.command({"prefix": "pg stat"})
-        return out if rc == 0 else {}
-
 
 class MgrModule:
     NAME = "module"
@@ -186,11 +182,10 @@ class MgrDaemon:
         self.monc.shutdown()
 
     def kill(self):
-        """Crash without deregistering (failover fixture)."""
-        self.running = False
-        with self.lock:
-            self._stop_modules()
-        self.monc.shutdown()
+        """Abrupt stop (failover fixture) — mgrs never deregister with
+        the mon either way; the MgrMonitor beacon timeout is what
+        promotes a standby, so kill IS shutdown."""
+        self.shutdown()
 
     def _send_beacon(self):
         self._seq += 1

@@ -340,11 +340,16 @@ class OSDMonitor(PaxosService):
             name = cmd["pool"]
             if name not in self.osdmap.pool_name:
                 return -2, f"pool '{name}' does not exist", None
-            var, val = cmd["var"], cmd["val"]
+            var = cmd.get("var", "")
+            try:
+                val = int(cmd["val"])
+            except (KeyError, ValueError, TypeError):
+                return -22, f"invalid value {cmd.get('val')!r} for " \
+                    f"{var!r} (integer required)", None
             m = self._working()
             pool = m.pools[m.pool_name[name]]
             if var == "pg_num":
-                new = int(val)
+                new = val
                 if new < pool.pg_num:
                     return -22, "pg_num cannot shrink (merge is not " \
                         "supported)", None
@@ -359,9 +364,10 @@ class OSDMonitor(PaxosService):
                 # the reference's two-step split-then-rebalance
                 pool.pg_num = new
             elif var == "pgp_num":
-                new = int(val)
-                if new > pool.pg_num:
-                    return -22, "pgp_num cannot exceed pg_num", None
+                new = val
+                if not 1 <= new <= pool.pg_num:
+                    return -22, "pgp_num must be in " \
+                        f"[1, {pool.pg_num}]", None
                 pool.pgp_num = new
             elif var == "size":
                 if pool.is_erasure():
@@ -370,9 +376,12 @@ class OSDMonitor(PaxosService):
                     # rejects it the same way)
                     return -95, "cannot change size of an " \
                         "erasure-coded pool", None
-                pool.size = int(val)
+                if not 1 <= val <= 10:
+                    return -22, "size must be in [1, 10]", None
+                pool.size = val
+                pool.min_size = min(pool.min_size, val)
             elif var == "min_size":
-                new = int(val)
+                new = val
                 if not 1 <= new <= pool.size:
                     return -22, f"min_size must be in [1, " \
                         f"{pool.size}]", None
@@ -1389,11 +1398,18 @@ class Monitor(Dispatcher):
                 "quorum": self.quorum, "leader": self.elector.leader,
                 "rank": self.rank, "state": self.elector.state}
         else:
-            for svc in self.services.values():
-                res = svc.dispatch_command(cmd)
-                if res is not None:
-                    rc, outs, outb = res
-                    break
+            # a malformed command (missing key, bad type) must produce
+            # a -22 reply, not an unhandled exception: the messenger
+            # swallows dispatcher exceptions, so raising here would
+            # leave the client waiting out its full timeout
+            try:
+                for svc in self.services.values():
+                    res = svc.dispatch_command(cmd)
+                    if res is not None:
+                        rc, outs, outb = res
+                        break
+            except (KeyError, ValueError, TypeError) as e:
+                rc, outs, outb = -22, f"malformed command: {e!r}", None
 
         def reply(rc=rc, outs=outs, outb=outb):
             try:

@@ -189,11 +189,19 @@ class OSDaemon(Dispatcher):
         self.monc.sub_want("osdmap", 1)
         self._send_boot()
         if wait_for_up:
+            # re-send boot while waiting: the first MOSDBoot can race a
+            # mon election (or land on a peon mid-forward) and be
+            # dropped — the reference OSD re-queues boot on every map
+            # update while still marked down (OSD::_send_boot / start_boot)
             deadline = time.monotonic() + timeout
+            next_boot = time.monotonic() + 2.0
             while time.monotonic() < deadline:
                 with self.lock:
                     if self.osdmap.is_up(self.whoami):
                         break
+                if time.monotonic() >= next_boot:
+                    self._send_boot()
+                    next_boot = time.monotonic() + 2.0
                 time.sleep(0.02)
             else:
                 raise TimeoutError(f"osd.{self.whoami} never came up")
@@ -327,6 +335,7 @@ class OSDaemon(Dispatcher):
                 if not children:
                     continue
                 parent = PGid(pid, p_ps)
+                child_set = set(children)
                 for s in shards:
                     pcid = str(parent) if s < 0 else f"{parent}s{s}"
                     if not self.store.collection_exists(pcid):
@@ -343,7 +352,30 @@ class OSDaemon(Dispatcher):
                         snapmap = self.store.omap_get(pcid, SNAPMAP_OID)
                     except KeyError:
                         snapmap = {}
-                    kept_entries = list((plog or {}).get("entries", []))
+                    # one bucketing pass: hash every object / snap-row /
+                    # log entry ONCE and group by destination child
+                    # (not once per child — splits can fan 1→64)
+                    oids_by_child: dict[int, list] = {}
+                    for oid in self.store.list_objects(pcid):
+                        if oid in (META_OID, SNAPMAP_OID):
+                            continue
+                        c = child_ps(oid)
+                        if c in child_set:
+                            oids_by_child.setdefault(c, []).append(oid)
+                    rows_by_child: dict[int, dict] = {}
+                    for key, val in snapmap.items():
+                        c = child_ps(key.split("|", 1)[1]
+                                     .rsplit("|", 1)[0])
+                        if c in child_set:
+                            rows_by_child.setdefault(c, {})[key] = val
+                    entries_by_child: dict[int, list] = {}
+                    kept_entries = []
+                    for e in (plog or {}).get("entries", []):
+                        c = child_ps(e["oid"])
+                        if c in child_set:
+                            entries_by_child.setdefault(c, []).append(e)
+                        else:
+                            kept_entries.append(e)
                     for c in children:
                         child = PGid(pid, c)
                         ccid = str(child) if s < 0 else f"{child}s{s}"
@@ -351,16 +383,10 @@ class OSDaemon(Dispatcher):
                             continue    # idempotent (restart replay)
                         t = Transaction().create_collection(ccid)
                         t.touch(ccid, META_OID)
-                        for oid in self.store.list_objects(pcid):
-                            if oid in (META_OID, SNAPMAP_OID):
-                                continue
-                            if child_ps(oid) == c:
-                                t.coll_move(pcid, oid, ccid)
+                        for oid in oids_by_child.get(c, ()):
+                            t.coll_move(pcid, oid, ccid)
                         # snap-mapper index rows follow their objects
-                        moved_rows = {
-                            key: val for key, val in snapmap.items()
-                            if child_ps(key.split("|", 1)[1]
-                                        .rsplit("|", 1)[0]) == c}
+                        moved_rows = rows_by_child.get(c, {})
                         if moved_rows:
                             t.omap_setkeys(ccid, SNAPMAP_OID,
                                            moved_rows)
@@ -372,12 +398,7 @@ class OSDaemon(Dispatcher):
                         if pinfo is not None:
                             cinfo = dict(pinfo, pgid=str(child))
                             clog = dict(plog or {})
-                            clog["entries"] = [
-                                e for e in kept_entries
-                                if child_ps(e["oid"]) == c]
-                            kept_entries = [
-                                e for e in kept_entries
-                                if child_ps(e["oid"]) != c]
+                            clog["entries"] = entries_by_child.get(c, [])
                             t.omap_setkeys(ccid, META_OID, {
                                 "info": _json.dumps(cinfo).encode(),
                                 "log": _json.dumps(clog).encode()})
@@ -394,15 +415,19 @@ class OSDaemon(Dispatcher):
                         self.store.queue_transaction(
                             Transaction().omap_setkeys(pcid, META_OID, {
                                 "log": _json.dumps(plog).encode()}))
-                # in-memory parent drops the moved objects' log rows;
+                # in-memory parent drops the moved objects' log rows
+                # and missing entries (a re-homed oid must not pin the
+                # parent in 'recovering' — its peers also dropped it);
                 # everything else reloads naturally on advance_map
                 ppg = self.pgs.get(parent)
                 if ppg is not None:
                     ppg._held_cache = None
                     ppg.log.entries = [
                         e for e in ppg.log.entries
-                        if pool.raw_pg_to_pg(int(ceph_str_hash_rjenkins(
-                            head_of(e.oid).encode()))) == p_ps]
+                        if child_ps(e.oid) == p_ps]
+                    for moid in [o for o in ppg.missing
+                                 if child_ps(o) != p_ps]:
+                        ppg.missing.pop(moid, None)
 
     def _update_pg_intervals(self):
         """Track acting-set intervals for every PG of every pool at
