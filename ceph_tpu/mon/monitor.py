@@ -40,6 +40,12 @@ from .paxos import Elector, Paxos, VICTORY
 from .store import MonitorDBStore, StoreTransaction
 
 
+# pool pg_num ceiling (reference mon_max_pool_pg_num default): a fat-
+# fingered `pool set pg_num` must not be able to fan a billion-child
+# split out to every OSD
+MAX_POOL_PG_NUM = 65536
+
+
 def _parse_pgid(s) -> PGid | None:
     try:
         return PGid.parse(s)
@@ -169,6 +175,13 @@ class OSDMonitor(PaxosService):
 
     # -- daemon messages ---------------------------------------------------
     def handle_boot(self, osd: int, addr: str):
+        # already up at this address ⇒ duplicate boot (the OSD resends
+        # while waiting for its subscription push) — do not mint a new
+        # epoch for it (reference OSDMonitor::preprocess_boot)
+        cur = self.pending_map or self.osdmap
+        if osd < cur.max_osd and cur.is_up(osd) \
+                and cur.osd_addrs.get(osd) == addr:
+            return
         m = self._working()
         if osd >= m.max_osd:
             grow = osd + 1 - m.max_osd
@@ -341,6 +354,8 @@ class OSDMonitor(PaxosService):
             if name not in self.osdmap.pool_name:
                 return -2, f"pool '{name}' does not exist", None
             var = cmd.get("var", "")
+            if var not in ("pg_num", "pgp_num", "size", "min_size"):
+                return -22, f"unsupported pool var {var!r}", None
             try:
                 val = int(cmd["val"])
             except (KeyError, ValueError, TypeError):
@@ -350,6 +365,10 @@ class OSDMonitor(PaxosService):
             pool = m.pools[m.pool_name[name]]
             if var == "pg_num":
                 new = val
+                if new > MAX_POOL_PG_NUM:
+                    return -34, f"pg_num {new} exceeds the " \
+                        f"{MAX_POOL_PG_NUM} cap (reference " \
+                        "mon_max_pool_pg_num)", None
                 if new < pool.pg_num:
                     return -22, "pg_num cannot shrink (merge is not " \
                         "supported)", None
@@ -386,8 +405,6 @@ class OSDMonitor(PaxosService):
                     return -22, f"min_size must be in [1, " \
                         f"{pool.size}]", None
                 pool.min_size = new
-            else:
-                return -22, f"unsupported pool var {var!r}", None
             pool.last_change = m.epoch + 1
             self._stage_map(m)
             self.mon.propose()

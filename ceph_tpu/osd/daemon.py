@@ -410,7 +410,8 @@ class OSDaemon(Dispatcher):
                             dict(iv) for iv in
                             self.pg_intervals.get(parent, [])]
                     if pinfo is not None and plog is not None and \
-                            len(kept_entries) != len(plog["entries"]):
+                            len(kept_entries) != \
+                            len(plog.get("entries", [])):
                         plog = dict(plog, entries=kept_entries)
                         self.store.queue_transaction(
                             Transaction().omap_setkeys(pcid, META_OID, {

@@ -1,0 +1,216 @@
+"""Offline tools: monmaptool, ceph-objectstore-tool, ceph-kvstore-tool.
+
+Tier-1/3 coverage of the reference's store-surgery CLIs
+(``src/tools/monmaptool.cc``, ``src/tools/ceph_objectstore_tool.cc``,
+``src/tools/ceph_kvstore_tool.cc``): map-file round-trips, PG
+export/import re-homing a PG between stopped OSD stores, and mon-store
+row surgery — all with no daemon running.
+"""
+
+import json
+
+import pytest
+
+from ceph_tpu.mon.store import MonitorDBStore, StoreTransaction
+from ceph_tpu.os_store import WALStore
+from ceph_tpu.tools import kvstore_tool, monmaptool, objectstore_tool
+from ceph_tpu.vstart import MiniCluster
+
+
+# ---------------------------------------------------------------------------
+# monmaptool
+# ---------------------------------------------------------------------------
+class TestMonmaptool:
+    def test_create_add_rm_print(self, tmp_path, capsys):
+        f = str(tmp_path / "monmap")
+        assert monmaptool.main(["--create", "--add", "0",
+                                "127.0.0.1:6789", f]) == 0
+        assert monmaptool.main(["--add", "1", "127.0.0.1:6790", f]) == 0
+        m = monmaptool.load_monmap(f)
+        assert m.ranks() == [0, 1] and m.epoch == 2
+        assert monmaptool.main(["--rm", "1", f]) == 0
+        assert monmaptool.main(["--print", f]) == 0
+        out = capsys.readouterr().out
+        assert "mon.0 127.0.0.1:6789" in out
+        assert "mon.1" not in out.splitlines()[-1]
+        assert monmaptool.load_monmap(f).epoch == 3
+
+    def test_guards(self, tmp_path):
+        f = str(tmp_path / "monmap")
+        assert monmaptool.main(["--create", f]) == 0
+        # no clobber without the flag
+        assert monmaptool.main(["--create", f]) == 1
+        # duplicate add / missing rm fail
+        assert monmaptool.main(["--add", "0", "127.0.0.1:1", f]) == 0
+        assert monmaptool.main(["--add", "0", "127.0.0.1:2", f]) == 1
+        assert monmaptool.main(["--rm", "7", f]) == 1
+        # missing file
+        assert monmaptool.main(["--print",
+                                str(tmp_path / "nope")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# ceph-objectstore-tool
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="class")
+def populated_store(tmp_path_factory):
+    """Run a real cluster on WALStores, write objects, stop it — the
+    stores are then offline surgery targets."""
+    tmp = tmp_path_factory.mktemp("ost")
+    stores = [WALStore(str(tmp / f"osd{i}.wal")) for i in range(3)]
+    with MiniCluster(n_mons=1, n_osds=3, osd_stores=stores) as c:
+        r = c.rados()
+        r.create_pool("p", pg_num=4)
+        io = r.open_ioctx("p")
+        for i in range(10):
+            io.write_full(f"obj{i}", f"payload-{i}".encode() * 20)
+        io.setxattr("obj0", "tag", b"v1")
+        io.omap_set("obj0", {"row": b"cell"})
+        c.wait_for_clean()
+        r.shutdown()
+    return tmp
+
+
+class TestObjectstoreTool:
+    def _wal(self, tmp, i=0):
+        return str(tmp / f"osd{i}.wal")
+
+    def test_list_pgs_and_objects(self, populated_store, capsys):
+        assert objectstore_tool.main(
+            ["--data-path", self._wal(populated_store),
+             "--op", "list-pgs"]) == 0
+        pgs = capsys.readouterr().out.split()
+        assert pgs and all("." in p for p in pgs)
+        assert objectstore_tool.main(
+            ["--data-path", self._wal(populated_store),
+             "--op", "list"]) == 0
+        rows = [json.loads(line) for line in
+                capsys.readouterr().out.splitlines()]
+        oids = {oid for _, oid in rows}
+        assert any(o.startswith("obj") for o in oids)
+
+    def test_info_and_log(self, populated_store, capsys):
+        objectstore_tool.main(
+            ["--data-path", self._wal(populated_store),
+             "--op", "list-pgs"])
+        pgid = capsys.readouterr().out.split()[0]
+        assert objectstore_tool.main(
+            ["--data-path", self._wal(populated_store),
+             "--op", "info", "--pgid", pgid]) == 0
+        info = json.loads(capsys.readouterr().out)
+        assert info["pgid"] == pgid
+        assert objectstore_tool.main(
+            ["--data-path", self._wal(populated_store),
+             "--op", "log", "--pgid", pgid]) == 0
+        log = json.loads(capsys.readouterr().out)
+        assert isinstance(log["entries"], list)
+
+    def test_export_remove_import_rehome(self, populated_store,
+                                         tmp_path, capsys):
+        """The reference's PG re-home flow: export from one OSD,
+        import into an empty store, bytes identical."""
+        wal = self._wal(populated_store)
+        objectstore_tool.main(["--data-path", wal, "--op", "list-pgs"])
+        pgid = capsys.readouterr().out.split()[0]
+        exp = str(tmp_path / "pg.export")
+        assert objectstore_tool.main(
+            ["--data-path", wal, "--op", "export",
+             "--pgid", pgid, "--file", exp]) == 0
+        capsys.readouterr()
+        # import into a brand-new store
+        dest = str(tmp_path / "fresh.wal")
+        assert objectstore_tool.main(
+            ["--data-path", dest, "--op", "import",
+             "--file", exp]) == 0
+        capsys.readouterr()
+        src_store, dst_store = WALStore(wal), WALStore(dest)
+        src_store.mount(), dst_store.mount()
+        try:
+            src_cids = [c for c in src_store.list_collections()
+                        if c == pgid or c.startswith(f"{pgid}s")]
+            for cid in src_cids:
+                assert set(dst_store.list_objects(cid)) == \
+                    set(src_store.list_objects(cid))
+                for oid in src_store.list_objects(cid):
+                    assert bytes(dst_store.read(cid, oid)) == \
+                        bytes(src_store.read(cid, oid))
+                    assert dst_store.getattrs(cid, oid) == \
+                        src_store.getattrs(cid, oid)
+                    assert dst_store.omap_get(cid, oid) == \
+                        src_store.omap_get(cid, oid)
+        finally:
+            src_store.umount(), dst_store.umount()
+        # import refuses to clobber
+        with pytest.raises(SystemExit):
+            objectstore_tool.main(
+                ["--data-path", dest, "--op", "import",
+                 "--file", exp])
+        # remove, then import succeeds again
+        assert objectstore_tool.main(
+            ["--data-path", dest, "--op", "remove",
+             "--pgid", pgid]) == 0
+        assert objectstore_tool.main(
+            ["--data-path", dest, "--op", "import",
+             "--file", exp]) == 0
+
+    def test_object_dump_and_get_bytes(self, populated_store, capsys):
+        wal = self._wal(populated_store)
+        objectstore_tool.main(["--data-path", wal, "--op", "list"])
+        rows = [json.loads(line) for line in
+                capsys.readouterr().out.splitlines()]
+        target = next((cid, oid) for cid, oid in rows if oid == "obj0")
+        pgid = target[0].split("s", 1)[0]
+        assert objectstore_tool.main(
+            ["--data-path", wal, pgid, "obj0", "dump"]) == 0
+        d = json.loads(capsys.readouterr().out)
+        assert d["oid"] == "obj0" and d["size"] > 0
+        assert "tag" in d["xattrs"] or any(
+            k.endswith("tag") for k in d["xattrs"])
+
+
+# ---------------------------------------------------------------------------
+# ceph-kvstore-tool
+# ---------------------------------------------------------------------------
+class TestKvstoreTool:
+    @pytest.fixture()
+    def mon_wal(self, tmp_path):
+        path = str(tmp_path / "mon.wal")
+        db = MonitorDBStore(path, sync=False)
+        t = StoreTransaction()
+        t.put("paxos", "1", b"\x01\x02")
+        t.put("paxos", "2", b"\x03")
+        t.put("svc_osdmap", "last", "42")
+        db.apply_transaction(t)
+        db.close()
+        return path
+
+    def test_list_get_set_rm(self, mon_wal, tmp_path, capsys):
+        assert kvstore_tool.main([mon_wal, "list"]) == 0
+        rows = capsys.readouterr().out.splitlines()
+        assert "paxos\t1" in rows and "svc_osdmap\tlast" in rows
+        assert kvstore_tool.main([mon_wal, "list", "paxos"]) == 0
+        assert all(line.startswith("paxos")
+                   for line in capsys.readouterr().out.splitlines())
+        assert kvstore_tool.main([mon_wal, "get", "paxos", "1"]) == 0
+        assert capsys.readouterr().out.strip() == "0102"
+        assert kvstore_tool.main(
+            [mon_wal, "get", "nope", "x"]) == 1
+        capsys.readouterr()
+        assert kvstore_tool.main(
+            [mon_wal, "set", "svc_osdmap", "last", "val", "43"]) == 0
+        assert kvstore_tool.main([mon_wal, "rm", "paxos", "2"]) == 0
+        capsys.readouterr()
+        db = MonitorDBStore(mon_wal, sync=False)
+        assert db.get_str("svc_osdmap", "last") == "43"
+        assert db.get("paxos", "2") is None
+        db.close()
+
+    def test_store_copy(self, mon_wal, tmp_path, capsys):
+        dest = str(tmp_path / "copy.wal")
+        assert kvstore_tool.main([mon_wal, "store-copy", dest]) == 0
+        capsys.readouterr()
+        a, b = MonitorDBStore(mon_wal), MonitorDBStore(dest)
+        assert a._data == b._data
+        a.close(), b.close()
+        with pytest.raises(SystemExit):
+            kvstore_tool.main([mon_wal, "store-copy", dest])
