@@ -1,0 +1,70 @@
+"""AOT program export for the native PJRT executor.
+
+The no-Python-in-process contract (SURVEY.md §8 stage 8): Python runs
+**offline** — here — to export the batched EC encode program as
+serialized StableHLO plus serialized compile options; the C++ runtime
+(``native/pjrt_executor.cc``) then loads and executes it against any
+PJRT plugin with no interpreter in the daemon process.  This mirrors
+how the reference ships pre-built ``libec_*.so`` kernels that the OSD
+merely dlopens (``src/erasure-code/ErasureCodePlugin.cc``).
+
+Artifacts written to ``out_dir``:
+- ``program.mlir``  — StableHLO (portable bytecode, or text for the
+  gf256-backed fake plugin, which parses @main's signature);
+- ``options.pb``    — serialized xla.CompileOptionsProto;
+- ``meta.json``     — {k, m, batch, chunk, in_dims, out_dims, format}.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+
+def export_encode_program(out_dir: str, *, k: int = 8, m: int = 3,
+                          batch: int = 64, chunk: int = 4096,
+                          fmt: str = "bytecode") -> dict:
+    """Export encode: [batch, k, chunk] u8 → [batch, m, chunk] u8."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import rs
+    from ..ops.gf_jax import _bit_layout_matrix, gf_matmul_bits
+
+    coding = rs.reed_sol_van_matrix(k, m)
+    bitmat = jnp.asarray(_bit_layout_matrix(coding))
+
+    def encode(data):
+        return gf_matmul_bits(bitmat, data, m)
+
+    spec = jax.ShapeDtypeStruct((batch, k, chunk), jnp.uint8)
+    if fmt == "text":
+        lowered = jax.jit(encode).lower(spec)
+        code = str(lowered.compiler_ir("stablehlo")).encode()
+    elif fmt == "bytecode":
+        exported = jax.export.export(jax.jit(encode))(spec)
+        code = exported.mlir_module_serialized
+    else:
+        raise ValueError(f"unknown export format {fmt!r}")
+
+    from jax._src.lib import xla_client as xc
+    options = xc.CompileOptions().SerializeAsString()
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "program.mlir").write_bytes(code)
+    (out / "options.pb").write_bytes(options)
+    meta = {"k": k, "m": m, "batch": batch, "chunk": chunk,
+            "in_dims": [batch, k, chunk], "out_dims": [batch, m, chunk],
+            "format": fmt}
+    (out / "meta.json").write_text(json.dumps(meta, indent=1))
+    return meta
+
+
+def oracle_encode(k: int, m: int, data: np.ndarray) -> np.ndarray:
+    """NumPy reference bytes for a [batch, k, chunk] input."""
+    from ..ops import rs
+    coding = rs.reed_sol_van_matrix(k, m)
+    return np.stack([rs.encode_oracle(coding, d) for d in data])

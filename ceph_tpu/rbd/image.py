@@ -41,11 +41,16 @@ def _data_oid(name: str, objectno: int) -> str:
     return f"rbd_data.{name}.{objectno:016x}"
 
 
+def _journal_oid(name: str) -> str:
+    return f"rbd_journal.{name}"
+
+
 class RBD:
     """Pool-level image operations (reference ``librbd::RBD``)."""
 
     def create(self, ioctx, name: str, size: int, *, order: int = 22,
-               stripe_unit: int | None = None, stripe_count: int = 1):
+               stripe_unit: int | None = None, stripe_count: int = 1,
+               journaling: bool = False, primary: bool = True):
         if size < 0:
             raise ValueError("image size must be >= 0")
         if _header_oid(name) in ioctx.list_objects():
@@ -59,6 +64,11 @@ class RBD:
             "size": size, "order": order,
             "stripe_unit": su, "stripe_count": stripe_count,
             "snap_seq": 0, "snaps": {},
+            # journaling feature + mirror-primary flag (reference
+            # librbd journaling feature bit / mirror image state).
+            # `primary` is set at create so a mirror bootstrap writes
+            # the non-primary header atomically (no primary window)
+            "journaling": journaling, "primary": primary,
         }
         ioctx.omap_set(_header_oid(name), {
             "header": json.dumps(hdr).encode()})
@@ -86,22 +96,44 @@ class Image:
     snapshot the image is read-only and reads resolve through the COW
     clone chain."""
 
-    def __init__(self, ioctx, name: str, snapshot: str | None = None):
+    def __init__(self, ioctx, name: str, snapshot: str | None = None,
+                 read_only: bool = False):
         self.ioctx = ioctx
         self.name = name
         self._load_header()
         self.snap_id = None
+        self._lock_cookie = None
+        self._read_only = read_only
         if snapshot is not None:
             snap = self._hdr["snaps"].get(snapshot)
             if snap is None:
                 raise ImageNotFound(f"no snapshot {snapshot!r}")
             self.snap_id = snap["id"]
             self._snap_size = snap["size"]
+        elif not read_only and self._hdr.get("journaling") and \
+                self._hdr.get("primary", True):
+            # single-writer contract for journal sequencing: hold the
+            # exclusive advisory lock for the handle's lifetime
+            # (reference librbd exclusive-lock feature, required by
+            # journaling) — a second writable open fails instead of
+            # silently interleaving journal events
+            import uuid
+            cookie = uuid.uuid4().hex
+            try:
+                self.ioctx.lock_exclusive(_header_oid(name),
+                                          "rbd_lock", cookie)
+            except Exception as e:
+                raise ValueError(
+                    f"image {name!r} is locked by another writer "
+                    f"(journaling requires a single writer): {e}"
+                ) from None
+            self._lock_cookie = cookie
 
     def _load_header(self):
+        from ..osdc.librados import ObjectNotFound
         try:
             raw = self.ioctx.omap_get(_header_oid(self.name))["header"]
-        except KeyError:
+        except (KeyError, ObjectNotFound):
             raise ImageNotFound(self.name) from None
         self._hdr = json.loads(bytes(raw))
         self.layout = FileLayout(
@@ -126,6 +158,7 @@ class Image:
 
     def resize(self, new_size: int):
         self._require_writable()
+        self._journal_append({"op": "resize", "size": new_size})
         old = self._hdr["size"]
         self._hdr["size"] = new_size
         self._save_header()
@@ -143,7 +176,13 @@ class Image:
                     pass
 
     def close(self):
-        pass
+        if self._lock_cookie is not None:
+            try:
+                self.ioctx.unlock(_header_oid(self.name), "rbd_lock",
+                                  self._lock_cookie)
+            except Exception:
+                pass
+            self._lock_cookie = None
 
     def __enter__(self):
         return self
@@ -154,12 +193,102 @@ class Image:
     def _require_writable(self):
         if self.snap_id is not None:
             raise ValueError("image opened at a snapshot is read-only")
+        if self._read_only and not getattr(self, "_replaying", False):
+            raise ValueError("image opened read-only")
+        if self._hdr.get("journaling") and \
+                not self._hdr.get("primary", True) and \
+                not getattr(self, "_replaying", False):
+            raise ValueError(
+                "image is non-primary (mirrored): writes only arrive "
+                "via journal replay; promote first")
+
+    # -- journaling / mirroring ------------------------------------------
+    # (reference src/librbd/journal/: every mutation is appended as a
+    # journal event BEFORE being applied; rbd-mirror tails the journal.
+    # Single-writer contract: a journaled primary image takes the
+    # exclusive advisory lock at open — see __init__ — so the cached
+    # head_seq below is sound and appends cannot interleave.)
+    _TRIM_EVERY = 16
+
+    def _journal_append(self, record: dict):
+        if not self._hdr.get("journaling") or \
+                getattr(self, "_replaying", False):
+            return
+        from ..osdc.librados import ObjectNotFound
+        joid = _journal_oid(self.name)
+        if getattr(self, "_journal_head", None) is None:
+            # first append through this handle: one full read seeds
+            # the cache (the exclusive lock guarantees nobody else
+            # advances it); ONLY a missing object may default to
+            # empty — any other error must propagate, or a transient
+            # read failure would restart sequencing at 0 and the new
+            # events would hide behind the mirror's commit position
+            try:
+                rows = self.ioctx.omap_get(joid)
+            except ObjectNotFound:
+                rows = {}
+            self._journal_head = int(rows.get("head_seq", b"0"))
+        self._journal_head += 1
+        head = self._journal_head
+        self.ioctx.omap_set(joid, {
+            f"e{head:016d}": json.dumps(record).encode(),
+            "head_seq": str(head).encode()})
+        # trim entries every consumer has committed (the mirror daemon
+        # reports its position into the same object; reference:
+        # journal commit position + ObjectRecorder trim).  Amortized:
+        # the trim pass re-reads the whole journal, so do it every
+        # _TRIM_EVERY appends, not per write.
+        if head % self._TRIM_EVERY == 0:
+            try:
+                rows = self.ioctx.omap_get(joid)
+            except ObjectNotFound:
+                return
+            committed = int(rows.get("mirror_position", b"0"))
+            dead = [k for k in rows
+                    if k.startswith("e") and int(k[1:]) <= committed]
+            if dead:
+                self.ioctx.omap_rm_keys(joid, dead)
+
+    def journal_entries(self, after: int = 0) -> list[tuple[int, dict]]:
+        """Journal events with seq > after, in order."""
+        try:
+            rows = self.ioctx.omap_get(_journal_oid(self.name))
+        except Exception:
+            return []
+        out = []
+        for key, val in rows.items():
+            if key.startswith("e") and int(key[1:]) > after:
+                out.append((int(key[1:]), json.loads(bytes(val))))
+        return sorted(out)
+
+    def journal_commit(self, position: int):
+        """Record the mirror consumer's commit position (trimming
+        happens lazily on the next append)."""
+        self.ioctx.omap_set(_journal_oid(self.name), {
+            "mirror_position": str(position).encode()})
+
+    def is_primary(self) -> bool:
+        return bool(self._hdr.get("primary", True))
+
+    def promote(self):
+        """Make this side primary (failover; reference
+        ``rbd mirror image promote``)."""
+        self._load_header()
+        self._hdr["primary"] = True
+        self._save_header()
+
+    def demote(self):
+        """Make this side non-primary (planned failback)."""
+        self._load_header()
+        self._hdr["primary"] = False
+        self._save_header()
 
     # -- snapshots -----------------------------------------------------------
     def create_snap(self, snap_name: str):
         self._require_writable()
         if snap_name in self._hdr["snaps"]:
             raise ValueError(f"snapshot {snap_name!r} exists")
+        self._journal_append({"op": "snap_create", "name": snap_name})
         self._hdr["snap_seq"] += 1
         self._hdr["snaps"][snap_name] = {
             "id": self._hdr["snap_seq"], "size": self._hdr["size"]}
@@ -167,9 +296,10 @@ class Image:
 
     def remove_snap(self, snap_name: str):
         self._require_writable()
-        snap = self._hdr["snaps"].pop(snap_name, None)
-        if snap is None:
+        if snap_name not in self._hdr["snaps"]:
             raise ImageNotFound(f"no snapshot {snap_name!r}")
+        self._journal_append({"op": "snap_remove", "name": snap_name})
+        self._hdr["snaps"].pop(snap_name)
         self._save_header()
         self._gc_clones()
 
@@ -261,6 +391,8 @@ class Image:
         self._require_writable()
         if offset + len(data) > self._hdr["size"]:
             raise ValueError("write past end of image")
+        self._journal_append({"op": "write", "off": offset,
+                              "data": data.hex()})
         for ext in file_to_extents(self.layout, offset, len(data)):
             self._cow_preserve(ext.object_no)
             lo = ext.logical_offset - offset
@@ -291,6 +423,8 @@ class Image:
     def discard(self, offset: int, length: int):
         """Zero a range (whole-object removes when aligned)."""
         self._require_writable()
+        self._journal_append({"op": "discard", "off": offset,
+                              "len": length})
         for ext in file_to_extents(self.layout, offset, length):
             oid = _data_oid(self.name, ext.object_no)
             if ext.offset == 0 and ext.length == self.layout.object_size:

@@ -151,6 +151,7 @@ struct ec_ring {
     uint8_t *parity;          /* [capacity][m][chunk] results */
     ec_batch_executor_fn exec = nullptr;
     void *exec_ctx = nullptr;
+    long fallbacks = 0;       /* executor-failed → CPU re-encodes */
     std::mutex mu;
 };
 
@@ -227,7 +228,18 @@ long ec_ring_flush(ec_ring_t *r) {
      * flushing=true and fail cleanly instead of deadlocking) */
     int rc = fn(r->data, r->parity, r->chunk, batch, r->inst->k,
                 r->inst->m, ctx);
+    bool fell_back = false;
+    if (rc && fn != cpu_executor) {
+        /* registered executor refused the batch (geometry mismatch,
+         * device lost): encode on the CPU engine rather than failing
+         * the I/O — the reference's plugin path has the same shape
+         * (ISA-L unavailable ⇒ jerasure fallback) */
+        rc = cpu_executor(r->data, r->parity, r->chunk, batch,
+                          r->inst->k, r->inst->m, r->inst);
+        fell_back = true;
+    }
     std::lock_guard<std::mutex> g(r->mu);
+    if (fell_back) r->fallbacks++;
     r->flushing = false;
     if (rc) return -1;
     long n = (long)batch;
@@ -249,3 +261,5 @@ int ec_ring_get_parity(ec_ring_t *r, long slot, uint8_t *parity) {
 }
 
 size_t ec_ring_pending(const ec_ring_t *r) { return r->pending; }
+
+long ec_ring_fallback_count(const ec_ring_t *r) { return r->fallbacks; }

@@ -75,8 +75,16 @@ void ec_ring_set_executor(ec_ring_t *ring, ec_batch_executor_fn fn,
 long ec_ring_submit(ec_ring_t *ring, const uint8_t *data);
 
 /* Run the executor over everything pending; returns number of stripes
- * encoded, or -1 on executor failure. */
+ * encoded, or -1 on failure.  A registered executor that fails is
+ * retried on the CPU engine (ISA-L→jerasure-style fallback), counted
+ * in ec_ring_fallback_count() — -1 therefore only means the CPU
+ * engine itself failed. */
 long ec_ring_flush(ec_ring_t *ring);
+
+/* Flushes that had to fall back from the registered executor to the
+ * CPU engine since ring creation (operators watch this: a dead device
+ * shows up as throughput collapse + this counter climbing). */
+long ec_ring_fallback_count(const ec_ring_t *ring);
 
 /* Fetch parity for a completed slot ([m][chunk] copied out).
  * Returns 0, or -1 if the slot has not been flushed. */

@@ -1,0 +1,455 @@
+/* PJRT-from-C++ executor implementation — see pjrt_executor.h.
+ *
+ * Everything here is plain C API plumbing against
+ * third_party/pjrt_c_api.h (OpenXLA, Apache-2.0): dlopen →
+ * GetPjrtApi → Plugin_Initialize → Client_Create → Client_Compile,
+ * then per batch BufferFromHostBuffer → LoadedExecutable_Execute →
+ * Buffer_ToHostBuffer with event waits.  No Python, no XLA C++ deps.
+ */
+#include "pjrt_executor.h"
+
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <deque>
+#include <string>
+#include <vector>
+
+#include "third_party/pjrt_c_api.h"
+
+namespace {
+
+std::string error_message(const PJRT_Api *api, PJRT_Error *err) {
+    if (err == nullptr) return "";
+    PJRT_Error_Message_Args margs;
+    memset(&margs, 0, sizeof(margs));
+    margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    margs.error = err;
+    api->PJRT_Error_Message(&margs);
+    std::string out(margs.message, margs.message_size);
+    PJRT_Error_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    dargs.error = err;
+    api->PJRT_Error_Destroy(&dargs);
+    return out;
+}
+
+bool read_file(const char *path, std::string *out) {
+    FILE *f = fopen(path, "rb");
+    if (f == nullptr) return false;
+    fseek(f, 0, SEEK_END);
+    long n = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    out->resize((size_t)n);
+    size_t got = n > 0 ? fread(&(*out)[0], 1, (size_t)n, f) : 0;
+    fclose(f);
+    return got == (size_t)n;
+}
+
+}  // namespace
+
+struct pjrt_exec {
+    void *dl = nullptr;
+    const PJRT_Api *api = nullptr;
+    PJRT_Client *client = nullptr;
+    PJRT_LoadedExecutable *exe = nullptr;
+    PJRT_Device *device = nullptr;
+    std::string platform;
+    std::string last_error;
+    std::vector<int64_t> in_dims, out_dims;
+    size_t in_bytes = 0, out_bytes = 0;
+
+    bool fail(const std::string &msg) {
+        last_error = msg;
+        return false;
+    }
+
+    /* await-and-destroy an event; true on success */
+    bool wait(PJRT_Event *ev, const char *what) {
+        PJRT_Event_Await_Args aw;
+        memset(&aw, 0, sizeof(aw));
+        aw.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+        aw.event = ev;
+        PJRT_Error *err = api->PJRT_Event_Await(&aw);
+        PJRT_Event_Destroy_Args de;
+        memset(&de, 0, sizeof(de));
+        de.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+        de.event = ev;
+        api->PJRT_Event_Destroy(&de);
+        if (err != nullptr)
+            return fail(std::string(what) + ": " +
+                        error_message(api, err));
+        return true;
+    }
+};
+
+namespace {
+
+/* "k=i1;k2=sfoo" → NamedValues.  Strings referenced by the values are
+ * kept alive in `storage` (deque: push_back never moves elements, so
+ * the c_str() pointers stay valid — a vector would invalidate SSO
+ * strings on reallocation). */
+std::vector<PJRT_NamedValue> parse_client_options(
+        const char *spec, std::deque<std::string> *storage) {
+    std::vector<PJRT_NamedValue> out;
+    if (spec == nullptr || *spec == '\0') return out;
+    std::string s(spec);
+    size_t pos = 0;
+    while (pos < s.size()) {
+        size_t end = s.find(';', pos);
+        if (end == std::string::npos) end = s.size();
+        std::string kv = s.substr(pos, end - pos);
+        pos = end + 1;
+        size_t eq = kv.find('=');
+        if (eq == std::string::npos || eq + 1 >= kv.size()) continue;
+        storage->push_back(kv.substr(0, eq));
+        const std::string &key = storage->back();
+        char kind = kv[eq + 1];
+        std::string val = kv.substr(eq + 2);
+        PJRT_NamedValue nv;
+        memset(&nv, 0, sizeof(nv));
+        nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+        nv.name = key.c_str();
+        nv.name_size = key.size();
+        if (kind == 'i') {
+            nv.type = PJRT_NamedValue_kInt64;
+            nv.int64_value = strtoll(val.c_str(), nullptr, 10);
+            nv.value_size = 1;
+        } else {
+            nv.type = PJRT_NamedValue_kString;
+            storage->push_back(val);
+            nv.string_value = storage->back().c_str();
+            nv.value_size = storage->back().size();
+        }
+        out.push_back(nv);
+    }
+    return out;
+}
+
+}  // namespace
+
+extern "C" pjrt_exec_t *pjrt_exec_create(
+        const char *plugin_so, const char *program_path,
+        const char *options_path,
+        const int64_t *in_dims, size_t in_ndims,
+        const int64_t *out_dims, size_t out_ndims,
+        const char *client_options,
+        char *err, size_t errlen) {
+    auto bail = [&](const std::string &msg) -> pjrt_exec_t * {
+        if (err != nullptr && errlen > 0) {
+            snprintf(err, errlen, "%s", msg.c_str());
+        }
+        return nullptr;
+    };
+    auto *ex = new pjrt_exec();
+    ex->in_dims.assign(in_dims, in_dims + in_ndims);
+    ex->out_dims.assign(out_dims, out_dims + out_ndims);
+    ex->in_bytes = 1;
+    for (auto d : ex->in_dims) ex->in_bytes *= (size_t)d;
+    ex->out_bytes = 1;
+    for (auto d : ex->out_dims) ex->out_bytes *= (size_t)d;
+
+    ex->dl = dlopen(plugin_so, RTLD_NOW | RTLD_LOCAL);
+    if (ex->dl == nullptr) {
+        std::string msg = std::string("dlopen: ") + dlerror();
+        delete ex;
+        return bail(msg);
+    }
+    typedef const PJRT_Api *(*get_api_fn)();
+    auto get_api = (get_api_fn)dlsym(ex->dl, "GetPjrtApi");
+    if (get_api == nullptr) {
+        pjrt_exec_free(ex);
+        return bail("no GetPjrtApi symbol in plugin");
+    }
+    ex->api = get_api();
+    if (ex->api == nullptr) {
+        pjrt_exec_free(ex);
+        return bail("GetPjrtApi returned NULL");
+    }
+
+    {
+        PJRT_Plugin_Initialize_Args a;
+        memset(&a, 0, sizeof(a));
+        a.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+        if (PJRT_Error *e = ex->api->PJRT_Plugin_Initialize(&a)) {
+            std::string msg = "Plugin_Initialize: " +
+                              error_message(ex->api, e);
+            pjrt_exec_free(ex);
+            return bail(msg);
+        }
+    }
+    std::deque<std::string> opt_storage;
+    std::vector<PJRT_NamedValue> copts =
+        parse_client_options(client_options, &opt_storage);
+    {
+        PJRT_Client_Create_Args a;
+        memset(&a, 0, sizeof(a));
+        a.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+        a.create_options = copts.data();
+        a.num_options = copts.size();
+        if (PJRT_Error *e = ex->api->PJRT_Client_Create(&a)) {
+            std::string msg = "Client_Create: " +
+                              error_message(ex->api, e);
+            pjrt_exec_free(ex);
+            return bail(msg);
+        }
+        ex->client = a.client;
+    }
+    {
+        PJRT_Client_PlatformName_Args a;
+        memset(&a, 0, sizeof(a));
+        a.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+        a.client = ex->client;
+        if (PJRT_Error *e = ex->api->PJRT_Client_PlatformName(&a)) {
+            error_message(ex->api, e);  // non-fatal
+        } else {
+            ex->platform.assign(a.platform_name, a.platform_name_size);
+        }
+    }
+    {
+        PJRT_Client_AddressableDevices_Args a;
+        memset(&a, 0, sizeof(a));
+        a.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+        a.client = ex->client;
+        if (PJRT_Error *e =
+                ex->api->PJRT_Client_AddressableDevices(&a)) {
+            std::string msg = "AddressableDevices: " +
+                              error_message(ex->api, e);
+            pjrt_exec_free(ex);
+            return bail(msg);
+        }
+        if (a.num_addressable_devices == 0) {
+            pjrt_exec_free(ex);
+            return bail("plugin reports zero addressable devices");
+        }
+        ex->device = a.addressable_devices[0];
+    }
+
+    std::string program, options;
+    if (!read_file(program_path, &program)) {
+        pjrt_exec_free(ex);
+        return bail(std::string("cannot read program ") + program_path);
+    }
+    if (options_path != nullptr &&
+        !read_file(options_path, &options)) {
+        pjrt_exec_free(ex);
+        return bail(std::string("cannot read options ") + options_path);
+    }
+    {
+        PJRT_Program prog;
+        memset(&prog, 0, sizeof(prog));
+        prog.struct_size = PJRT_Program_STRUCT_SIZE;
+        prog.code = &program[0];
+        prog.code_size = program.size();
+        static const char kFormat[] = "mlir";
+        prog.format = kFormat;
+        prog.format_size = sizeof(kFormat) - 1;
+
+        PJRT_Client_Compile_Args a;
+        memset(&a, 0, sizeof(a));
+        a.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+        a.client = ex->client;
+        a.program = &prog;
+        a.compile_options = options.data();
+        a.compile_options_size = options.size();
+        if (PJRT_Error *e = ex->api->PJRT_Client_Compile(&a)) {
+            std::string msg = "Client_Compile: " +
+                              error_message(ex->api, e);
+            pjrt_exec_free(ex);
+            return bail(msg);
+        }
+        ex->exe = a.executable;
+    }
+    /* pjrt_exec_run stacks a 1-element output list; a multi-output
+     * program would make the plugin write past it, so refuse here.
+     * (Fakes/plugins that omit the introspection calls pass — they
+     * are single-output by construction.) */
+    if (ex->api->PJRT_LoadedExecutable_GetExecutable != nullptr &&
+        ex->api->PJRT_Executable_NumOutputs != nullptr) {
+        PJRT_LoadedExecutable_GetExecutable_Args ga;
+        memset(&ga, 0, sizeof(ga));
+        ga.struct_size =
+            PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+        ga.loaded_executable = ex->exe;
+        if (ex->api->PJRT_LoadedExecutable_GetExecutable(&ga) ==
+                nullptr) {
+            PJRT_Executable_NumOutputs_Args na;
+            memset(&na, 0, sizeof(na));
+            na.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+            na.executable = ga.executable;
+            size_t nout = 1;
+            if (ex->api->PJRT_Executable_NumOutputs(&na) == nullptr)
+                nout = na.num_outputs;
+            if (ex->api->PJRT_Executable_Destroy != nullptr) {
+                PJRT_Executable_Destroy_Args da;
+                memset(&da, 0, sizeof(da));
+                da.struct_size =
+                    PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+                da.executable = ga.executable;
+                error_message(ex->api,
+                              ex->api->PJRT_Executable_Destroy(&da));
+            }
+            if (nout != 1) {
+                pjrt_exec_free(ex);
+                return bail("program has " + std::to_string(nout) +
+                            " outputs; exactly 1 required");
+            }
+        }
+    }
+    return ex;
+}
+
+extern "C" void pjrt_exec_free(pjrt_exec_t *ex) {
+    if (ex == nullptr) return;
+    if (ex->api != nullptr) {
+        if (ex->exe != nullptr) {
+            PJRT_LoadedExecutable_Destroy_Args a;
+            memset(&a, 0, sizeof(a));
+            a.struct_size =
+                PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+            a.executable = ex->exe;
+            error_message(ex->api,
+                          ex->api->PJRT_LoadedExecutable_Destroy(&a));
+        }
+        if (ex->client != nullptr) {
+            PJRT_Client_Destroy_Args a;
+            memset(&a, 0, sizeof(a));
+            a.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+            a.client = ex->client;
+            error_message(ex->api, ex->api->PJRT_Client_Destroy(&a));
+        }
+    }
+    if (ex->dl != nullptr) dlclose(ex->dl);
+    delete ex;
+}
+
+extern "C" const char *pjrt_exec_platform(const pjrt_exec_t *ex) {
+    return ex->platform.c_str();
+}
+
+extern "C" const char *pjrt_exec_last_error(const pjrt_exec_t *ex) {
+    return ex->last_error.c_str();
+}
+
+extern "C" int pjrt_exec_run(pjrt_exec_t *ex, const uint8_t *in,
+                             uint8_t *out) {
+    const PJRT_Api *api = ex->api;
+
+    /* host -> device */
+    PJRT_Buffer *in_buf = nullptr;
+    {
+        PJRT_Client_BufferFromHostBuffer_Args a;
+        memset(&a, 0, sizeof(a));
+        a.struct_size =
+            PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+        a.client = ex->client;
+        a.data = in;
+        a.type = PJRT_Buffer_Type_U8;
+        a.dims = ex->in_dims.data();
+        a.num_dims = ex->in_dims.size();
+        a.host_buffer_semantics =
+            PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+        a.device = ex->device;
+        if (PJRT_Error *e =
+                api->PJRT_Client_BufferFromHostBuffer(&a)) {
+            ex->fail("BufferFromHostBuffer: " + error_message(api, e));
+            return -1;
+        }
+        in_buf = a.buffer;
+        if (!ex->wait(a.done_with_host_buffer, "h2d transfer")) {
+            /* fallthrough to destroy below */
+            PJRT_Buffer_Destroy_Args d;
+            memset(&d, 0, sizeof(d));
+            d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+            d.buffer = in_buf;
+            error_message(api, api->PJRT_Buffer_Destroy(&d));
+            return -1;
+        }
+    }
+
+    auto destroy_buf = [&](PJRT_Buffer *b) {
+        if (b == nullptr) return;
+        PJRT_Buffer_Destroy_Args d;
+        memset(&d, 0, sizeof(d));
+        d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+        d.buffer = b;
+        error_message(api, api->PJRT_Buffer_Destroy(&d));
+    };
+
+    /* execute */
+    PJRT_Buffer *out_buf = nullptr;
+    {
+        PJRT_ExecuteOptions opts;
+        memset(&opts, 0, sizeof(opts));
+        opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+        PJRT_Buffer *arg_list[1] = {in_buf};
+        PJRT_Buffer *const *arg_lists[1] = {arg_list};
+        PJRT_Buffer *out_list[1] = {nullptr};
+        PJRT_Buffer **out_lists[1] = {out_list};
+        PJRT_Event *done[1] = {nullptr};
+
+        PJRT_LoadedExecutable_Execute_Args a;
+        memset(&a, 0, sizeof(a));
+        a.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+        a.executable = ex->exe;
+        a.options = &opts;
+        a.argument_lists = arg_lists;
+        a.num_devices = 1;
+        a.num_args = 1;
+        a.output_lists = out_lists;
+        a.device_complete_events = done;
+        if (PJRT_Error *e = api->PJRT_LoadedExecutable_Execute(&a)) {
+            ex->fail("Execute: " + error_message(api, e));
+            destroy_buf(in_buf);
+            return -1;
+        }
+        out_buf = out_list[0];
+        if (done[0] != nullptr &&
+            !ex->wait(done[0], "device execution")) {
+            destroy_buf(in_buf);
+            destroy_buf(out_buf);
+            return -1;
+        }
+    }
+    destroy_buf(in_buf);
+
+    /* device -> host */
+    {
+        PJRT_Buffer_ToHostBuffer_Args a;
+        memset(&a, 0, sizeof(a));
+        a.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+        a.src = out_buf;
+        a.dst = out;
+        a.dst_size = ex->out_bytes;
+        if (PJRT_Error *e = api->PJRT_Buffer_ToHostBuffer(&a)) {
+            ex->fail("ToHostBuffer: " + error_message(api, e));
+            destroy_buf(out_buf);
+            return -1;
+        }
+        if (!ex->wait(a.event, "d2h transfer")) {
+            destroy_buf(out_buf);
+            return -1;
+        }
+    }
+    destroy_buf(out_buf);
+    return 0;
+}
+
+extern "C" int pjrt_exec_as_ring_executor(
+        const uint8_t *data, uint8_t *parity, size_t chunk_size,
+        size_t batch, int k, int m, void *ctx) {
+    auto *ex = (pjrt_exec_t *)ctx;
+    if (ex == nullptr || ex->in_dims.size() != 3 ||
+        ex->out_dims.size() != 3) return -1;
+    if ((size_t)ex->in_dims[0] != batch ||
+        ex->in_dims[1] != k ||
+        (size_t)ex->in_dims[2] != chunk_size ||
+        ex->out_dims[1] != m) {
+        return -1;  /* geometry mismatch: ring falls back to CPU */
+    }
+    return pjrt_exec_run(ex, data, parity);
+}

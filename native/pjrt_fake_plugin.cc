@@ -1,0 +1,268 @@
+/* Fake PJRT plugin — hermetic test double for pjrt_executor.cc.
+ *
+ * Role (SURVEY.md §5 tier 2 — fakes/mocks, the MemStore/
+ * LibRadosTestStub pattern applied to the PJRT seam): a real
+ * `GetPjrtApi` implementation backed by the native gf256 CPU engine,
+ * so the executor's full dlopen → initialize → client → compile →
+ * buffer → execute → fetch path runs in tests with no TPU and no
+ * Python.  "Compile" parses the exported StableHLO's @main signature
+ * for the (B,k,C)->(B,m,C) uint8 shapes; "execute" runs the same
+ * reed_sol_van encode the real program performs, so byte-exactness
+ * against the JAX export is a REAL assertion, not a tautology.
+ *
+ * Only the API subset the executor touches is implemented; everything
+ * else is left NULL so an accidental dependency fails loudly.
+ */
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+#include <string>
+#include <vector>
+
+#include "third_party/pjrt_c_api.h"
+
+#include "ec_plugin.h"
+#include "gf256.h"
+
+namespace {
+
+/* ---- object model ---------------------------------------------------- */
+
+struct FakeError {
+    std::string message;
+};
+
+struct FakeEvent {
+    /* everything in the fake completes synchronously */
+};
+
+struct FakeBuffer {
+    std::vector<uint8_t> bytes;
+    std::vector<int64_t> dims;
+};
+
+struct FakeClient {
+    int device_token = 0;   /* &device_token doubles as PJRT_Device* */
+};
+
+struct FakeExecutable {
+    int B = 0, k = 0, m = 0, C = 0;
+    ec_instance_t *inst = nullptr;
+    ~FakeExecutable() { ec_free(inst); }
+};
+
+PJRT_Error *make_error(const std::string &msg) {
+    auto *e = new FakeError{msg};
+    return reinterpret_cast<PJRT_Error *>(e);
+}
+
+/* ---- error/event ------------------------------------------------------ */
+
+void fake_error_destroy(PJRT_Error_Destroy_Args *args) {
+    delete reinterpret_cast<FakeError *>(args->error);
+}
+
+void fake_error_message(PJRT_Error_Message_Args *args) {
+    auto *e = reinterpret_cast<const FakeError *>(args->error);
+    args->message = e->message.c_str();
+    args->message_size = e->message.size();
+}
+
+PJRT_Error *fake_error_getcode(PJRT_Error_GetCode_Args *args) {
+    args->code = PJRT_Error_Code_INTERNAL;
+    return nullptr;
+}
+
+PJRT_Error *fake_event_destroy(PJRT_Event_Destroy_Args *args) {
+    delete reinterpret_cast<FakeEvent *>(args->event);
+    return nullptr;
+}
+
+PJRT_Error *fake_event_await(PJRT_Event_Await_Args *args) {
+    (void)args;
+    return nullptr;   /* already complete */
+}
+
+/* ---- plugin/client ---------------------------------------------------- */
+
+PJRT_Error *fake_plugin_initialize(PJRT_Plugin_Initialize_Args *args) {
+    (void)args;
+    gf256_init();
+    return nullptr;
+}
+
+PJRT_Error *fake_client_create(PJRT_Client_Create_Args *args) {
+    args->client = reinterpret_cast<PJRT_Client *>(new FakeClient());
+    return nullptr;
+}
+
+PJRT_Error *fake_client_destroy(PJRT_Client_Destroy_Args *args) {
+    delete reinterpret_cast<FakeClient *>(args->client);
+    return nullptr;
+}
+
+PJRT_Error *fake_client_platform_name(
+        PJRT_Client_PlatformName_Args *args) {
+    static const char kName[] = "fake_gf256";
+    args->platform_name = kName;
+    args->platform_name_size = sizeof(kName) - 1;
+    return nullptr;
+}
+
+PJRT_Error *fake_client_addressable_devices(
+        PJRT_Client_AddressableDevices_Args *args) {
+    auto *c = reinterpret_cast<FakeClient *>(args->client);
+    /* one fake device whose handle is a stable pointer into the client */
+    static thread_local PJRT_Device *devs[1];
+    devs[0] = reinterpret_cast<PJRT_Device *>(&c->device_token);
+    args->addressable_devices = devs;
+    args->num_addressable_devices = 1;
+    return nullptr;
+}
+
+/* Parse "tensor<AxBxCxui8>" starting at `p`; returns dims or empty. */
+std::vector<int64_t> parse_tensor_dims(const char *p) {
+    std::vector<int64_t> dims;
+    p = strstr(p, "tensor<");
+    if (p == nullptr) return dims;
+    p += strlen("tensor<");
+    while (*p >= '0' && *p <= '9') {
+        dims.push_back(strtoll(p, const_cast<char **>(&p), 10));
+        if (*p == 'x') p++;
+    }
+    if (strncmp(p, "ui8", 3) != 0 && strncmp(p, "i8", 2) != 0)
+        dims.clear();
+    return dims;
+}
+
+PJRT_Error *fake_client_compile(PJRT_Client_Compile_Args *args) {
+    std::string code(args->program->code, args->program->code_size);
+    /* the fake consumes the TEXT StableHLO export; locate @main's
+     * argument and result uint8 tensor types */
+    size_t main_at = code.find("@main");
+    if (main_at == std::string::npos)
+        return make_error("fake compile: no @main in program "
+                          "(text MLIR required)");
+    std::vector<int64_t> in = parse_tensor_dims(code.c_str() + main_at);
+    size_t arrow = code.find("->", main_at);
+    if (arrow == std::string::npos || in.size() != 3)
+        return make_error("fake compile: cannot parse @main signature");
+    std::vector<int64_t> out = parse_tensor_dims(code.c_str() + arrow);
+    if (out.size() != 3 || out[0] != in[0] || out[2] != in[2])
+        return make_error("fake compile: unsupported program shape");
+    auto *exe = new FakeExecutable();
+    exe->B = (int)in[0];
+    exe->k = (int)in[1];
+    exe->C = (int)in[2];
+    exe->m = (int)out[1];
+    char profile[64];
+    snprintf(profile, sizeof(profile), "k=%d m=%d", exe->k, exe->m);
+    exe->inst = ec_create(profile);
+    if (exe->inst == nullptr) {
+        delete exe;
+        return make_error("fake compile: bad k/m");
+    }
+    args->executable =
+        reinterpret_cast<PJRT_LoadedExecutable *>(exe);
+    return nullptr;
+}
+
+PJRT_Error *fake_loaded_executable_destroy(
+        PJRT_LoadedExecutable_Destroy_Args *args) {
+    delete reinterpret_cast<FakeExecutable *>(args->executable);
+    return nullptr;
+}
+
+/* ---- buffers ---------------------------------------------------------- */
+
+PJRT_Error *fake_buffer_from_host(
+        PJRT_Client_BufferFromHostBuffer_Args *args) {
+    if (args->type != PJRT_Buffer_Type_U8)
+        return make_error("fake supports U8 buffers only");
+    auto *b = new FakeBuffer();
+    b->dims.assign(args->dims, args->dims + args->num_dims);
+    size_t n = 1;
+    for (auto d : b->dims) n *= (size_t)d;
+    b->bytes.assign((const uint8_t *)args->data,
+                    (const uint8_t *)args->data + n);
+    args->buffer = reinterpret_cast<PJRT_Buffer *>(b);
+    args->done_with_host_buffer =
+        reinterpret_cast<PJRT_Event *>(new FakeEvent());
+    return nullptr;
+}
+
+PJRT_Error *fake_buffer_destroy(PJRT_Buffer_Destroy_Args *args) {
+    delete reinterpret_cast<FakeBuffer *>(args->buffer);
+    return nullptr;
+}
+
+PJRT_Error *fake_buffer_to_host(PJRT_Buffer_ToHostBuffer_Args *args) {
+    auto *b = reinterpret_cast<FakeBuffer *>(args->src);
+    if (args->dst == nullptr) {
+        args->dst_size = b->bytes.size();
+        args->event =
+            reinterpret_cast<PJRT_Event *>(new FakeEvent());
+        return nullptr;
+    }
+    if (args->dst_size < b->bytes.size())
+        return make_error("fake to_host: dst too small");
+    memcpy(args->dst, b->bytes.data(), b->bytes.size());
+    args->event = reinterpret_cast<PJRT_Event *>(new FakeEvent());
+    return nullptr;
+}
+
+/* ---- execute ---------------------------------------------------------- */
+
+PJRT_Error *fake_execute(PJRT_LoadedExecutable_Execute_Args *args) {
+    auto *exe = reinterpret_cast<FakeExecutable *>(args->executable);
+    if (args->num_devices != 1 || args->num_args != 1)
+        return make_error("fake execute: 1 device / 1 arg only");
+    auto *in = reinterpret_cast<FakeBuffer *>(args->argument_lists[0][0]);
+    size_t want = (size_t)exe->B * exe->k * exe->C;
+    if (in->bytes.size() != want)
+        return make_error("fake execute: input size mismatch");
+    auto *out = new FakeBuffer();
+    out->dims = {exe->B, exe->m, exe->C};
+    out->bytes.resize((size_t)exe->B * exe->m * exe->C);
+    gf256_rs_encode_batch(ec_coding_matrix(exe->inst), exe->k, exe->m,
+                          in->bytes.data(), out->bytes.data(),
+                          (size_t)exe->C, (size_t)exe->B);
+    args->output_lists[0][0] = reinterpret_cast<PJRT_Buffer *>(out);
+    if (args->device_complete_events != nullptr) {
+        args->device_complete_events[0] =
+            reinterpret_cast<PJRT_Event *>(new FakeEvent());
+    }
+    return nullptr;
+}
+
+PJRT_Api *build_api() {
+    static PJRT_Api api;
+    memset(&api, 0, sizeof(api));
+    api.struct_size = PJRT_Api_STRUCT_SIZE;
+    api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+    api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+    api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+    api.PJRT_Error_Destroy = fake_error_destroy;
+    api.PJRT_Error_Message = fake_error_message;
+    api.PJRT_Error_GetCode = fake_error_getcode;
+    api.PJRT_Plugin_Initialize = fake_plugin_initialize;
+    api.PJRT_Event_Destroy = fake_event_destroy;
+    api.PJRT_Event_Await = fake_event_await;
+    api.PJRT_Client_Create = fake_client_create;
+    api.PJRT_Client_Destroy = fake_client_destroy;
+    api.PJRT_Client_PlatformName = fake_client_platform_name;
+    api.PJRT_Client_AddressableDevices =
+        fake_client_addressable_devices;
+    api.PJRT_Client_Compile = fake_client_compile;
+    api.PJRT_Client_BufferFromHostBuffer = fake_buffer_from_host;
+    api.PJRT_LoadedExecutable_Destroy = fake_loaded_executable_destroy;
+    api.PJRT_LoadedExecutable_Execute = fake_execute;
+    api.PJRT_Buffer_Destroy = fake_buffer_destroy;
+    api.PJRT_Buffer_ToHostBuffer = fake_buffer_to_host;
+    return &api;
+}
+
+}  // namespace
+
+extern "C" const PJRT_Api *GetPjrtApi() { return build_api(); }
