@@ -135,18 +135,24 @@ class PrometheusModule(MgrModule):
         self.service.shutdown()
 
 
-DEFAULT_MODULES = (BalancerModule, PgAutoscalerModule, PrometheusModule)
+def _default_modules():
+    # late import: modules.py subclasses MgrModule from this file
+    from .modules import (CrashModule, IostatModule, StatusModule,
+                          TelemetryModule)
+    return (BalancerModule, PgAutoscalerModule, PrometheusModule,
+            StatusModule, IostatModule, CrashModule, TelemetryModule)
 
 
 class MgrDaemon:
     def __init__(self, name: str, monmap, *,
                  beacon_interval: float = 0.4,
-                 modules=DEFAULT_MODULES,
+                 modules=None,
                  asok_paths: dict[str, str] | None = None):
         self.name = name
         self.monmap = monmap
         self.beacon_interval = beacon_interval
-        self.module_classes = modules
+        self.module_classes = (modules if modules is not None
+                               else _default_modules())
         self.asok_paths = dict(asok_paths or {})
         self.monc = MonClient(monmap, entity=f"mgr.{name}")
         self.state = "boot"           # boot / standby / active

@@ -1,0 +1,197 @@
+"""Small mgr modules: status, iostat, crash, telemetry.
+
+Reference behavior re-created (``src/pybind/mgr/<module>/module.py``
+each; SURVEY.md §3.10 "mgr modules"):
+
+- **status**: ``ceph -s``-shaped cluster summary assembled mgr-side
+  from the mon's status + pg stats (the reference renders fs/osd
+  status tables from the same aggregates);
+- **iostat**: cluster-wide IOPS read off consecutive ``pg dump``
+  osd_stat op-counter deltas (the reference differentiates PGMap
+  counters the same way);
+- **crash**: crash-report archive — daemons (or operators) post
+  crash dumps, ``crash ls``/``info``/``rm`` browse them; stored in
+  RADOS-backed mon config-key storage analog (here: module-local
+  store persisted via mon config-key commands);
+- **telemetry**: an anonymized cluster report (counts and versions,
+  never names/keys) assembled on demand, ``telemetry show`` style.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+
+from .daemon import MgrModule
+
+
+class StatusModule(MgrModule):
+    """`ceph -s` aggregation (reference ``pybind/mgr/status``)."""
+
+    NAME = "status"
+    TICK = 1.0
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self.last: dict = {}
+
+    def serve_tick(self):
+        rc, _, st = self.ctx.mon_command({"prefix": "status"})
+        if rc == 0 and st:
+            self.last = st
+
+    def render(self) -> str:
+        """The human `ceph -s` panel, from the last aggregate."""
+        st = self.last
+        if not st:
+            return "status: no data yet"
+        lines = [
+            f"  health: {st.get('health')}",
+            "",
+            "  services:",
+            f"    mon: quorum {st.get('quorum')} "
+            f"(leader {st.get('leader')})",
+            f"    osd: {st.get('num_up_osds')}/{st.get('num_osds')} up",
+            "",
+            "  data:",
+            f"    pools:   {len(st.get('pools', []))}",
+            f"    objects: {st.get('num_objects')}",
+            f"    pgs:     {st.get('num_pgs')} " + " ".join(
+                f"{n} {s};" for s, n in
+                sorted(st.get("pg_states", {}).items())),
+        ]
+        for chk in st.get("checks", []):
+            lines.insert(1, f"    {chk['code']}: {chk['summary']}")
+        return "\n".join(lines)
+
+
+class IostatModule(MgrModule):
+    """Cluster IOPS from osd_stat op-counter deltas (reference
+    ``pybind/mgr/iostat``)."""
+
+    NAME = "iostat"
+    TICK = 1.0
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._prev: tuple[float, dict] | None = None
+        self.rates = {"op_per_sec": 0.0, "write_op_per_sec": 0.0,
+                      "read_op_per_sec": 0.0}
+
+    def _totals(self) -> dict | None:
+        rc, _, dump = self.ctx.mon_command({"prefix": "pg dump"})
+        if rc != 0 or not dump:
+            return None
+        tot = {"op": 0.0, "op_w": 0.0, "op_r": 0.0}
+        for st in (dump.get("osd_stats") or {}).values():
+            for k in tot:
+                tot[k] += float(st.get(k, 0))
+        return tot
+
+    def serve_tick(self):
+        now = time.monotonic()
+        tot = self._totals()
+        if tot is None:
+            return
+        if self._prev is not None:
+            t0, prev = self._prev
+            dt = max(now - t0, 1e-6)
+            # counters are cumulative; an OSD restart can step one
+            # backwards — clamp at 0 rather than reporting negatives
+            self.rates = {
+                "op_per_sec": max(0.0, (tot["op"] - prev["op"]) / dt),
+                "write_op_per_sec":
+                    max(0.0, (tot["op_w"] - prev["op_w"]) / dt),
+                "read_op_per_sec":
+                    max(0.0, (tot["op_r"] - prev["op_r"]) / dt),
+            }
+        self._prev = (now, tot)
+
+
+class CrashModule(MgrModule):
+    """Crash-report archive (reference ``pybind/mgr/crash``): posts
+    are keyed by crash id (timestamp + entity hash), persisted through
+    the mon's config-key store so they survive mgr failover."""
+
+    NAME = "crash"
+    TICK = 30.0
+    _PREFIX = "mgr/crash/"
+
+    def post(self, report: dict) -> str:
+        """`ceph crash post` — report must carry entity + backtrace."""
+        if "entity" not in report:
+            raise ValueError("crash report requires 'entity'")
+        stamp = report.setdefault("timestamp", time.time())
+        crash_id = "%s_%s" % (
+            time.strftime("%Y-%m-%d_%H:%M:%S", time.gmtime(stamp)),
+            hashlib.sha1(
+                f"{report['entity']}{stamp}".encode()).hexdigest()[:12])
+        report["crash_id"] = crash_id
+        self.ctx.mon_command({
+            "prefix": "config-key put",
+            "key": self._PREFIX + crash_id,
+            "val": json.dumps(report)})
+        return crash_id
+
+    def _keys(self) -> list[str]:
+        rc, _, keys = self.ctx.mon_command({
+            "prefix": "config-key ls"})
+        if rc != 0 or not keys:
+            return []
+        return sorted(k for k in keys if k.startswith(self._PREFIX))
+
+    def ls(self) -> list[dict]:
+        out = []
+        for k in self._keys():
+            rc, _, val = self.ctx.mon_command({
+                "prefix": "config-key get", "key": k})
+            if rc == 0 and val:
+                rep = json.loads(val)
+                out.append({"crash_id": rep["crash_id"],
+                            "entity": rep["entity"],
+                            "timestamp": rep["timestamp"]})
+        return out
+
+    def info(self, crash_id: str) -> dict | None:
+        rc, _, val = self.ctx.mon_command({
+            "prefix": "config-key get",
+            "key": self._PREFIX + crash_id})
+        return json.loads(val) if rc == 0 and val else None
+
+    def rm(self, crash_id: str):
+        self.ctx.mon_command({
+            "prefix": "config-key del", "key": self._PREFIX + crash_id})
+
+
+class TelemetryModule(MgrModule):
+    """Anonymized cluster report (reference ``pybind/mgr/telemetry``):
+    aggregate counts only — never pool/host/entity names, never keys;
+    the cluster id is a salted hash, as upstream sends a UUID."""
+
+    NAME = "telemetry"
+    TICK = 60.0
+
+    def compile_report(self) -> dict:
+        rc, _, st = self.ctx.mon_command({"prefix": "status"})
+        st = st if rc == 0 and st else {}
+        rc, _, keys = self.ctx.mon_command({"prefix": "config-key ls"})
+        crashes = len([k for k in (keys or [])
+                       if k.startswith(CrashModule._PREFIX)]) \
+            if rc == 0 else 0
+        cluster_id = hashlib.sha256(
+            f"ceph-tpu-{sorted(st.get('quorum') or [])}".encode()
+        ).hexdigest()[:32]
+        return {
+            "cluster_id": cluster_id,
+            "report_timestamp": time.time(),
+            "mon": {"count": len(st.get("quorum") or [])},
+            "osd": {"count": st.get("num_osds", 0),
+                    "up": st.get("num_up_osds", 0)},
+            "pools": {"count": len(st.get("pools", []))},
+            "pgs": {"count": st.get("num_pgs", 0),
+                    "states": st.get("pg_states", {})},
+            "objects": {"count": st.get("num_objects", 0)},
+            "health": st.get("health"),
+            "crashes": crashes,
+        }

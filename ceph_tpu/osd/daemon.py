@@ -611,7 +611,13 @@ class OSDaemon(Dispatcher):
             self.monc.send(MM.MPGStats(
                 osd=self.whoami, epoch=self.osdmap.epoch,
                 pg_stats=stats,
-                osd_stats={"num_pgs": len(self.pgs)}))
+                osd_stats={"num_pgs": len(self.pgs),
+                           # cumulative client-op counters: the mgr
+                           # iostat module differentiates these into
+                           # IOPS (reference osd_stat_t op counters)
+                           "op": self.perf.get("op"),
+                           "op_w": self.perf.get("op_w"),
+                           "op_r": self.perf.get("op_r")}))
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:

@@ -11,11 +11,21 @@ frontend, ``rgw_op.cc`` op layer, ``rgw_rados.cc`` store; SURVEY.md
 - objects: ``PUT/GET/HEAD/DELETE /bucket/key``; bytes live in RADOS
   objects ``<bucket>_<key>`` in the ``.rgw.data`` pool, metadata
   (size, etag) in the bucket index;
-- ``GET /`` lists buckets (ListAllMyBucketsResult).
+- ``GET /`` lists buckets (ListAllMyBucketsResult);
+- **multipart upload** (reference ``rgw_op.cc`` InitMultipart/
+  PutObj/CompleteMultipart + the RGW manifest): ``POST ?uploads`` →
+  UploadId, ``PUT ?partNumber&uploadId`` stores each part as its own
+  RADOS object, complete writes a MANIFEST index entry (parts are
+  never rewritten — GET concatenates), abort removes the parts;
+  multipart ETags are S3-style ``md5(part-digests)-N``;
+- **versioning** (reference ``rgw_rados.cc`` olh/versioning): ``PUT
+  ?versioning`` enables per-bucket; each PUT then mints a version id,
+  old versions stay readable via ``?versionId=``, DELETE without a
+  version writes a delete marker, ``GET ?versions`` lists all.
 
-ETags are MD5 hex like S3.  Auth/ACL/multipart/versioning are out of
-scope for this slice; the HTTP dialect is enough for s3-style clients
-that can be pointed at an endpoint with auth disabled.
+ETags are MD5 hex like S3.  Auth/ACL are out of scope for this slice;
+the HTTP dialect is enough for s3-style clients pointed at an
+endpoint with auth disabled.
 """
 
 from __future__ import annotations
@@ -42,6 +52,24 @@ def _data_oid(bucket: str, key: str) -> str:
     return f"{bucket}\x00{key}"
 
 
+def _version_oid(bucket: str, key: str, vid: str) -> str:
+    return f"{bucket}\x00{key}\x00v{vid}"
+
+
+def _versions_oid(bucket: str) -> str:
+    return f"versions.{bucket}"
+
+
+def _mp_oid(bucket: str, upload_id: str) -> str:
+    # NUL separator: bucket names may contain dots, so a dotted
+    # prefix match would bleed across buckets
+    return f"multipart.{bucket}\x00{upload_id}"
+
+
+def _part_oid(bucket: str, upload_id: str, n: int) -> str:
+    return f"{bucket}\x00_mp_{upload_id}\x00{n:05d}"
+
+
 class RGWStore:
     """The op layer (reference rgw_op.cc + rgw_rados.cc, trimmed)."""
 
@@ -54,6 +82,19 @@ class RGWStore:
                 pass        # exists
         self.meta = rados.open_ioctx(META_POOL)
         self.data = rados.open_ioctx(DATA_POOL)
+        # the frontend is a ThreadingHTTPServer: index/version-seq
+        # read-modify-writes must not interleave (the reference gets
+        # this atomicity from cls_rgw ops executing on the OSD)
+        self._lock = threading.Lock()
+
+    def _drop_parts(self, meta: dict | None):
+        """Remove a manifest's part objects (nothing else references
+        them once their manifest row is replaced/deleted)."""
+        for p in (meta or {}).get("parts", []):
+            try:
+                self.data.remove(p)
+            except Exception:
+                pass
 
     # -- buckets -----------------------------------------------------------
     def create_bucket(self, bucket: str):
@@ -84,41 +125,281 @@ class RGWStore:
         except ObjectNotFound:
             return []
 
+    # -- versioning --------------------------------------------------------
+    def set_versioning(self, bucket: str, enabled: bool):
+        self.meta.omap_set(BUCKETS_OID, {
+            bucket: json.dumps({"name": bucket,
+                                "versioning": enabled}).encode()})
+
+    def versioning_enabled(self, bucket: str) -> bool:
+        try:
+            row = self.meta.omap_get(BUCKETS_OID).get(bucket)
+        except ObjectNotFound:
+            return False
+        return bool(row and json.loads(bytes(row)).get("versioning"))
+
+    def _next_version_id(self, bucket: str) -> str:
+        try:
+            rows = self.meta.omap_get(_versions_oid(bucket))
+        except ObjectNotFound:
+            rows = {}
+        seq = int(rows.get("_seq", b"0")) + 1
+        self.meta.omap_set(_versions_oid(bucket), {
+            "_seq": str(seq).encode()})
+        return f"{seq:08d}"
+
+    def list_versions(self, bucket: str) -> list[dict]:
+        """All versions (newest first per key), delete markers
+        included (reference ListObjectVersions)."""
+        try:
+            rows = self.meta.omap_get(_versions_oid(bucket))
+        except ObjectNotFound:
+            return []
+        out = []
+        for k, v in rows.items():
+            if k == "_seq":
+                continue
+            key, _, vid = k.rpartition("\x00")
+            out.append({"key": key, "version_id": vid,
+                        **json.loads(bytes(v))})
+        cur = self._raw_index(bucket)
+        for e in out:
+            m = cur.get(e["key"])
+            e["is_latest"] = bool(
+                m and m.get("version_id") == e["version_id"])
+        return sorted(out, key=lambda e: (e["key"],
+                                          e["version_id"]),
+                      reverse=True)
+
     # -- objects -----------------------------------------------------------
-    def put_object(self, bucket: str, key: str, body: bytes) -> str:
+    def put_object(self, bucket: str, key: str, body: bytes) -> tuple:
+        """→ (etag, version_id|None)."""
         etag = hashlib.md5(body).hexdigest()
-        self.data.write_full(_data_oid(bucket, key), body)
-        self.meta.omap_set(_index_oid(bucket), {
-            key: json.dumps({"size": len(body),
-                             "etag": etag}).encode()})
-        return etag
+        meta = {"size": len(body), "etag": etag}
+        vid = None
+        with self._lock:
+            old = self._raw_index(bucket).get(key)
+            if self.versioning_enabled(bucket):
+                vid = self._next_version_id(bucket)
+                meta["version_id"] = vid
+                self.data.write_full(_version_oid(bucket, key, vid),
+                                     body)
+                self.meta.omap_set(_versions_oid(bucket), {
+                    f"{key}\x00{vid}": json.dumps(meta).encode()})
+                old = None   # prior version still references its parts
+            else:
+                self.data.write_full(_data_oid(bucket, key), body)
+            self.meta.omap_set(_index_oid(bucket), {
+                key: json.dumps(meta).encode()})
+        self._drop_parts(old)   # replaced unversioned manifest
+        return etag, vid
 
-    def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
-        meta = self.head_object(bucket, key)
-        return bytes(self.data.read(_data_oid(bucket, key))), meta
+    def _read_payload(self, bucket: str, key: str,
+                      meta: dict) -> bytes:
+        if "parts" in meta:
+            # multipart manifest: concatenate part objects
+            return b"".join(
+                bytes(self.data.read(p)) for p in meta["parts"])
+        if meta.get("version_id"):
+            return bytes(self.data.read(
+                _version_oid(bucket, key, meta["version_id"])))
+        return bytes(self.data.read(_data_oid(bucket, key)))
 
-    def head_object(self, bucket: str, key: str) -> dict:
+    def get_object(self, bucket: str, key: str,
+                   version_id: str | None = None) -> tuple[bytes, dict]:
+        meta = self.head_object(bucket, key, version_id)
+        return self._read_payload(bucket, key, meta), meta
+
+    def head_object(self, bucket: str, key: str,
+                    version_id: str | None = None) -> dict:
+        if version_id is not None:
+            try:
+                rows = self.meta.omap_get(_versions_oid(bucket))
+            except ObjectNotFound:
+                raise KeyError(key) from None
+            row = rows.get(f"{key}\x00{version_id}")
+            if row is None:
+                raise KeyError(key)
+            meta = json.loads(bytes(row))
+            if meta.get("delete_marker"):
+                raise KeyError(key)
+            return meta
         try:
             idx = self.meta.omap_get(_index_oid(bucket))
         except ObjectNotFound:
             idx = {}        # bucket never indexed anything
         if key not in idx:
             raise KeyError(key)
-        return json.loads(bytes(idx[key]))
+        meta = json.loads(bytes(idx[key]))
+        if meta.get("delete_marker"):
+            raise KeyError(key)   # current version is a delete marker
+        return meta
 
-    def delete_object(self, bucket: str, key: str):
-        self.meta.omap_rm_keys(_index_oid(bucket), [key])
+    def delete_object(self, bucket: str, key: str,
+                      version_id: str | None = None):
+        if version_id is not None:
+            # permanent removal of one version (reference: deleting a
+            # specific versionId bypasses the delete-marker machinery)
+            with self._lock:
+                try:
+                    rows = self.meta.omap_get(_versions_oid(bucket))
+                    vmeta = json.loads(bytes(
+                        rows[f"{key}\x00{version_id}"]))
+                except (ObjectNotFound, KeyError):
+                    vmeta = {}
+                self.meta.omap_rm_keys(_versions_oid(bucket),
+                                       [f"{key}\x00{version_id}"])
+                try:
+                    self.data.remove(
+                        _version_oid(bucket, key, version_id))
+                except Exception:
+                    pass
+                self._drop_parts(vmeta)   # multipart version: parts go
+                # if it was the current version, expose the newest
+                # survivor
+                cur = self._raw_index(bucket).get(key)
+                if cur and cur.get("version_id") == version_id:
+                    survivors = [e for e in self.list_versions(bucket)
+                                 if e["key"] == key]
+                    if survivors:
+                        newest = survivors[0]
+                        self.meta.omap_set(_index_oid(bucket), {
+                            key: json.dumps({
+                                k2: v2 for k2, v2 in newest.items()
+                                if k2 not in ("key", "is_latest")
+                            }).encode()})
+                    else:
+                        self.meta.omap_rm_keys(_index_oid(bucket),
+                                               [key])
+            return None
+        if self.versioning_enabled(bucket):
+            # delete marker becomes the current version; older
+            # versions stay readable via ?versionId=
+            with self._lock:
+                vid = self._next_version_id(bucket)
+                marker = {"size": 0, "etag": "", "version_id": vid,
+                          "delete_marker": True}
+                self.meta.omap_set(_versions_oid(bucket), {
+                    f"{key}\x00{vid}": json.dumps(marker).encode()})
+                self.meta.omap_set(_index_oid(bucket), {
+                    key: json.dumps(marker).encode()})
+            return vid
+        with self._lock:
+            try:
+                meta = self.head_object(bucket, key)
+            except KeyError:
+                meta = {}
+            self.meta.omap_rm_keys(_index_oid(bucket), [key])
+        self._drop_parts(meta)
         try:
             self.data.remove(_data_oid(bucket, key))
         except Exception:
             pass
+        return None
 
-    def list_objects(self, bucket: str) -> dict[str, dict]:
+    # -- multipart upload --------------------------------------------------
+    # (reference rgw_op.cc: RGWInitMultipart / RGWPutObj with
+    # uploadId / RGWCompleteMultipart / RGWAbortMultipart; parts are
+    # first-class RADOS objects referenced by the completed object's
+    # manifest, never copied)
+    def initiate_multipart(self, bucket: str, key: str) -> str:
+        import uuid
+        upload_id = uuid.uuid4().hex[:16]
+        self.meta.omap_set(_mp_oid(bucket, upload_id), {
+            "_key": key.encode()})
+        return upload_id
+
+    def put_part(self, bucket: str, upload_id: str, part_num: int,
+                 body: bytes) -> str:
+        if not 1 <= part_num <= 10000:
+            raise ValueError("part number out of range")
+        rows = self.meta.omap_get(_mp_oid(bucket, upload_id))  # raises
+        del rows
+        etag = hashlib.md5(body).hexdigest()
+        self.data.write_full(_part_oid(bucket, upload_id, part_num),
+                             body)
+        self.meta.omap_set(_mp_oid(bucket, upload_id), {
+            f"{part_num:05d}": json.dumps({
+                "size": len(body), "etag": etag}).encode()})
+        return etag
+
+    def list_parts(self, bucket: str, upload_id: str) -> list[dict]:
+        rows = self.meta.omap_get(_mp_oid(bucket, upload_id))
+        return [{"part": int(k), **json.loads(bytes(v))}
+                for k, v in sorted(rows.items()) if k != "_key"]
+
+    def complete_multipart(self, bucket: str, upload_id: str) -> str:
+        rows = self.meta.omap_get(_mp_oid(bucket, upload_id))
+        key = bytes(rows.pop("_key")).decode()
+        parts = sorted((int(k), json.loads(bytes(v)))
+                       for k, v in rows.items())
+        if not parts:
+            raise ValueError("no parts uploaded")
+        # S3 multipart etag: md5 over the concatenated part digests,
+        # suffixed with the part count
+        digest = hashlib.md5(b"".join(
+            bytes.fromhex(m["etag"]) for _, m in parts)).hexdigest()
+        etag = f"{digest}-{len(parts)}"
+        manifest = {
+            "size": sum(m["size"] for _, m in parts),
+            "etag": etag,
+            "parts": [_part_oid(bucket, upload_id, n)
+                      for n, _ in parts],
+        }
+        with self._lock:
+            old = self._raw_index(bucket).get(key)
+            if self.versioning_enabled(bucket):
+                vid = self._next_version_id(bucket)
+                manifest["version_id"] = vid
+                self.meta.omap_set(_versions_oid(bucket), {
+                    f"{key}\x00{vid}": json.dumps(manifest).encode()})
+                old = None   # prior version keeps its parts
+            self.meta.omap_set(_index_oid(bucket), {
+                key: json.dumps(manifest).encode()})
+            self.meta.remove(_mp_oid(bucket, upload_id))
+        self._drop_parts(old)
+        return etag
+
+    def abort_multipart(self, bucket: str, upload_id: str):
+        try:
+            rows = self.meta.omap_get(_mp_oid(bucket, upload_id))
+        except ObjectNotFound:
+            return
+        for k in rows:
+            if k == "_key":
+                continue
+            try:
+                self.data.remove(
+                    _part_oid(bucket, upload_id, int(k)))
+            except Exception:
+                pass
+        self.meta.remove(_mp_oid(bucket, upload_id))
+
+    def list_multipart_uploads(self, bucket: str) -> list[dict]:
+        out = []
+        pre = f"multipart.{bucket}\x00"
+        for o in self.meta.list_objects():
+            if o.startswith(pre):
+                try:
+                    key = bytes(self.meta.omap_get(o)["_key"]).decode()
+                except (ObjectNotFound, KeyError):
+                    continue
+                out.append({"upload_id": o[len(pre):], "key": key})
+        return sorted(out, key=lambda u: u["upload_id"])
+
+    def _raw_index(self, bucket: str) -> dict[str, dict]:
         try:
             idx = self.meta.omap_get(_index_oid(bucket))
         except ObjectNotFound:
             return {}
         return {k: json.loads(bytes(v)) for k, v in idx.items()}
+
+    def list_objects(self, bucket: str) -> dict[str, dict]:
+        """Visible objects only: keys whose current version is a
+        delete marker are absent (S3 listings hide them; they'd also
+        wedge delete_bucket's emptiness check forever)."""
+        return {k: m for k, m in self._raw_index(bucket).items()
+                if not m.get("delete_marker")}
 
 
 def _xml_list_bucket(bucket: str, objs: dict[str, dict]) -> bytes:
@@ -129,6 +410,21 @@ def _xml_list_bucket(bucket: str, objs: dict[str, dict]) -> bytes:
     return (f'<?xml version="1.0"?><ListBucketResult>'
             f"<Name>{_xesc(bucket)}</Name>{rows}</ListBucketResult>"
             ).encode()
+
+
+def _xml_list_versions(bucket: str, versions: list[dict]) -> bytes:
+    rows = []
+    for e in versions:
+        tag = ("DeleteMarker" if e.get("delete_marker")
+               else "Version")
+        rows.append(
+            f"<{tag}><Key>{_xesc(e['key'])}</Key>"
+            f"<VersionId>{e['version_id']}</VersionId>"
+            f"<IsLatest>{str(e['is_latest']).lower()}</IsLatest>"
+            f"<Size>{e.get('size', 0)}</Size></{tag}>")
+    return (f'<?xml version="1.0"?><ListVersionsResult>'
+            f"<Name>{_xesc(bucket)}</Name>{''.join(rows)}"
+            f"</ListVersionsResult>").encode()
 
 
 def _xml_list_buckets(names: list[str]) -> bytes:
@@ -164,6 +460,14 @@ class _Handler(BaseHTTPRequestHandler):
         parts = path.split("/", 1)
         return parts[0], parts[1] if len(parts) > 1 else None
 
+    def _query(self) -> dict:
+        if "?" not in self.path:
+            return {}
+        from urllib.parse import parse_qs
+        q = parse_qs(self.path.split("?", 1)[1],
+                     keep_blank_values=True)
+        return {k: v[0] for k, v in q.items()}
+
     def handle_one_request(self):
         try:
             super().handle_one_request()
@@ -174,6 +478,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PUT(self):
         bucket, key = self._parse()
+        q = self._query()
         # always drain the request body first: replying while unread
         # bytes sit on a keep-alive connection desyncs the stream
         length = int(self.headers.get("Content-Length", 0))
@@ -181,30 +486,101 @@ class _Handler(BaseHTTPRequestHandler):
         if bucket is None:
             return self._reply(400)
         if key is None:
+            if "versioning" in q:
+                if not self.store.bucket_exists(bucket):
+                    return self._reply(404)
+                self.store.set_versioning(
+                    bucket, b"Enabled" in body)
+                return self._reply(200)
             self.store.create_bucket(bucket)
             return self._reply(200)
         if not self.store.bucket_exists(bucket):
             return self._reply(404)
-        etag = self.store.put_object(bucket, key, body)
-        return self._reply(200, headers={"ETag": f'"{etag}"'})
+        if "partNumber" in q and "uploadId" in q:
+            try:
+                etag = self.store.put_part(
+                    bucket, q["uploadId"], int(q["partNumber"]), body)
+            except ObjectNotFound:
+                return self._reply(404)
+            except ValueError:
+                return self._reply(400)
+            return self._reply(200, headers={"ETag": f'"{etag}"'})
+        etag, vid = self.store.put_object(bucket, key, body)
+        hdrs = {"ETag": f'"{etag}"'}
+        if vid:
+            hdrs["x-amz-version-id"] = vid
+        return self._reply(200, headers=hdrs)
+
+    def do_POST(self):
+        bucket, key = self._parse()
+        q = self._query()
+        length = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(length)   # CompleteMultipartUpload XML: the
+        # part list is authoritative server-side (we complete with
+        # every uploaded part, in part-number order)
+        if bucket is None or key is None:
+            return self._reply(400)
+        if not self.store.bucket_exists(bucket):
+            return self._reply(404)
+        if "uploads" in q:
+            upload_id = self.store.initiate_multipart(bucket, key)
+            xml = (f'<?xml version="1.0"?>'
+                   f"<InitiateMultipartUploadResult>"
+                   f"<Bucket>{_xesc(bucket)}</Bucket>"
+                   f"<Key>{_xesc(key)}</Key>"
+                   f"<UploadId>{upload_id}</UploadId>"
+                   f"</InitiateMultipartUploadResult>").encode()
+            return self._reply(200, xml)
+        if "uploadId" in q:
+            try:
+                etag = self.store.complete_multipart(
+                    bucket, q["uploadId"])
+            except ObjectNotFound:
+                return self._reply(404)
+            except ValueError:
+                return self._reply(400)
+            xml = (f'<?xml version="1.0"?>'
+                   f"<CompleteMultipartUploadResult>"
+                   f"<ETag>&quot;{etag}&quot;</ETag>"
+                   f"</CompleteMultipartUploadResult>").encode()
+            return self._reply(200, xml)
+        return self._reply(400)
 
     def do_GET(self):
         bucket, key = self._parse()
+        q = self._query()
         if bucket is None:
             return self._reply(
                 200, _xml_list_buckets(self.store.list_buckets()))
         if key is None:
             if not self.store.bucket_exists(bucket):
                 return self._reply(404)
+            if "versions" in q:
+                return self._reply(200, _xml_list_versions(
+                    bucket, self.store.list_versions(bucket)))
+            if "uploads" in q:
+                ups = self.store.list_multipart_uploads(bucket)
+                rows = "".join(
+                    f"<Upload><Key>{_xesc(u['key'])}</Key>"
+                    f"<UploadId>{u['upload_id']}</UploadId></Upload>"
+                    for u in ups)
+                return self._reply(200, (
+                    f'<?xml version="1.0"?>'
+                    f"<ListMultipartUploadsResult>{rows}"
+                    f"</ListMultipartUploadsResult>").encode())
             return self._reply(200, _xml_list_bucket(
                 bucket, self.store.list_objects(bucket)))
         try:
-            body, meta = self.store.get_object(bucket, key)
+            body, meta = self.store.get_object(
+                bucket, key, q.get("versionId"))
         except KeyError:
             return self._reply(404)
+        hdrs = {"ETag": f'"{meta["etag"]}"'}
+        if meta.get("version_id"):
+            hdrs["x-amz-version-id"] = meta["version_id"]
         return self._reply(200, body,
                            ctype="application/octet-stream",
-                           headers={"ETag": f'"{meta["etag"]}"'})
+                           headers=hdrs)
 
     def do_HEAD(self):
         bucket, key = self._parse()
@@ -220,13 +596,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         bucket, key = self._parse()
+        q = self._query()
         if bucket is None:
             return self._reply(400)
         if key is None:
             ok = self.store.delete_bucket(bucket)
             return self._reply(204 if ok else 409)
-        self.store.delete_object(bucket, key)
-        return self._reply(204)
+        if "uploadId" in q:
+            self.store.abort_multipart(bucket, q["uploadId"])
+            return self._reply(204)
+        vid = self.store.delete_object(bucket, key,
+                                       q.get("versionId"))
+        hdrs = {"x-amz-version-id": vid} if vid else None
+        return self._reply(204, headers=hdrs)
 
 
 class RGWService:
@@ -272,15 +654,66 @@ class S3Client:
         st, hdr, _ = self._req("PUT", f"/{b}/{k}", data)
         return st, hdr.get("ETag", "").strip('"')
 
-    def get(self, b, k):
-        st, hdr, body = self._req("GET", f"/{b}/{k}")
+    def get(self, b, k, version_id=None):
+        path = f"/{b}/{k}"
+        if version_id:
+            path += f"?versionId={version_id}"
+        st, hdr, body = self._req("GET", path)
         return st, body
 
     def head(self, b, k):
         return self._req("HEAD", f"/{b}/{k}")[0]
 
-    def delete(self, b, k=None):
-        return self._req("DELETE", f"/{b}/{k}" if k else f"/{b}")[0]
+    def delete(self, b, k=None, version_id=None):
+        path = f"/{b}/{k}" if k else f"/{b}"
+        if version_id:
+            path += f"?versionId={version_id}"
+        st, hdr, _ = self._req("DELETE", path)
+        return st
 
     def list(self, b=None):
         return self._req("GET", f"/{b}" if b else "/")
+
+    # -- versioning --------------------------------------------------------
+    def set_versioning(self, b, enabled=True):
+        body = (b"<VersioningConfiguration><Status>Enabled</Status>"
+                b"</VersioningConfiguration>" if enabled else
+                b"<VersioningConfiguration><Status>Suspended</Status>"
+                b"</VersioningConfiguration>")
+        return self._req("PUT", f"/{b}?versioning", body)[0]
+
+    def put_versioned(self, b, k, data: bytes):
+        st, hdr, _ = self._req("PUT", f"/{b}/{k}", data)
+        return st, hdr.get("x-amz-version-id")
+
+    def list_versions(self, b):
+        return self._req("GET", f"/{b}?versions")
+
+    # -- multipart ---------------------------------------------------------
+    def initiate_multipart(self, b, k):
+        st, _hdr, body = self._req("POST", f"/{b}/{k}?uploads")
+        if st != 200:
+            return st, None
+        uid = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0]
+        return st, uid.decode()
+
+    def put_part(self, b, k, upload_id, n, data: bytes):
+        st, hdr, _ = self._req(
+            "PUT", f"/{b}/{k}?partNumber={n}&uploadId={upload_id}",
+            data)
+        return st, hdr.get("ETag", "").strip('"')
+
+    def complete_multipart(self, b, k, upload_id):
+        st, _hdr, body = self._req(
+            "POST", f"/{b}/{k}?uploadId={upload_id}")
+        if st != 200:
+            return st, None
+        etag = body.split(b"&quot;")[1].decode()
+        return st, etag
+
+    def abort_multipart(self, b, k, upload_id):
+        return self._req(
+            "DELETE", f"/{b}/{k}?uploadId={upload_id}")[0]
+
+    def list_uploads(self, b):
+        return self._req("GET", f"/{b}?uploads")

@@ -1,0 +1,118 @@
+"""mgr modules on a live cluster: status, iostat, crash, telemetry.
+
+Covers the reference's ``src/pybind/mgr/{status,iostat,crash,
+telemetry}`` behavior surface at slice scale, all through the real
+mgr module host (active mgr, mon commands, pg-stat aggregation).
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.mgr.modules import (CrashModule, IostatModule,
+                                  StatusModule, TelemetryModule)
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_mons=1, n_osds=3) as c:
+        c.start_mgr("x")
+        c.wait_for_active_mgr()
+        r = c.rados()
+        r.create_pool("p", pg_num=8)
+        io = r.open_ioctx("p")
+        for i in range(10):
+            io.write_full(f"o{i}", b"x" * 100)
+        c.wait_for_clean()
+        yield c, io
+        r.shutdown()
+
+
+def _module(c, name):
+    mgr = c.mgrs["x"]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        mod = mgr.modules.get(name)
+        if mod is not None:
+            return mod
+        time.sleep(0.05)
+    raise TimeoutError(f"module {name} never instantiated")
+
+
+def test_status_module_renders(cluster):
+    c, _ = cluster
+    mod = _module(c, StatusModule.NAME)
+    # the default module set includes the pg_autoscaler, which splits
+    # the pool live (8 → 64 pgs); wait for the cluster to converge to
+    # HEALTH_OK with every PG reported clean
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = mod.last
+        states = st.get("pg_states", {})
+        if st.get("health") == "HEALTH_OK" and states and \
+                set(states) == {"active+clean"}:
+            break
+        time.sleep(0.2)
+    out = mod.render()
+    assert "health: HEALTH_OK" in out
+    assert "osd: 3/3 up" in out
+    assert "pgs:" in out and "active+clean" in out
+
+
+def test_iostat_sees_client_io(cluster):
+    c, io = cluster
+    mod = _module(c, IostatModule.NAME)
+    # a tick to establish the baseline
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and mod._prev is None:
+        time.sleep(0.1)
+    assert mod._prev is not None
+    # drive writes, then wait for a rate > 0 (OSD stats report on
+    # their own tick, so allow a few iostat ticks)
+    saw = 0.0
+    for _ in range(60):
+        for i in range(20):
+            io.write_full(f"io{i}", b"y" * 50)
+        time.sleep(0.25)
+        if mod.rates["op_per_sec"] > 0:
+            saw = mod.rates["op_per_sec"]
+            break
+    assert saw > 0, f"no IOPS observed: {mod.rates}"
+    assert mod.rates["write_op_per_sec"] >= 0
+
+
+def test_crash_module_archive(cluster):
+    c, _ = cluster
+    mod = _module(c, CrashModule.NAME)
+    cid = mod.post({"entity": "osd.1",
+                    "backtrace": ["frame0", "frame1"]})
+    assert cid in [e["crash_id"] for e in mod.ls()]
+    info = mod.info(cid)
+    assert info["backtrace"] == ["frame0", "frame1"]
+    assert info["entity"] == "osd.1"
+    with pytest.raises(ValueError):
+        mod.post({"backtrace": []})
+    mod.rm(cid)
+    assert cid not in [e["crash_id"] for e in mod.ls()]
+    assert mod.info(cid) is None
+
+
+def test_telemetry_report_is_anonymous(cluster):
+    c, _ = cluster
+    crash = _module(c, CrashModule.NAME)
+    cid = crash.post({"entity": "osd.0", "backtrace": ["bt"]})
+    mod = _module(c, TelemetryModule.NAME)
+    rep = mod.compile_report()
+    assert rep["osd"]["count"] == 3 and rep["osd"]["up"] == 3
+    assert rep["mon"]["count"] == 1
+    assert rep["pools"]["count"] >= 1
+    assert rep["crashes"] >= 1
+    assert len(rep["cluster_id"]) == 32
+    # anonymity: no pool names, entities, or addresses anywhere
+    flat = str(rep)
+    assert "p" != flat  # trivially true; the real checks:
+    assert "osd.0" not in flat
+    assert "127.0.0.1" not in flat
+    assert "'pools': {'count'" in flat  # counts, not names
+    crash.rm(cid)

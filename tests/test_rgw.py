@@ -51,3 +51,135 @@ class TestRGW:
         s3.put("raw", "obj", b"stored-in-rados")
         io = gw.store.data
         assert io.read("raw\x00obj") == b"stored-in-rados"
+
+
+class TestMultipart:
+    def test_multipart_lifecycle(self, gateway):
+        c, gw, s3 = gateway
+        s3.make_bucket("mp")
+        st, uid = s3.initiate_multipart("mp", "big.bin")
+        assert st == 200 and uid
+        p1, p2, p3 = b"A" * 70000, b"B" * 70000, b"C" * 100
+        for n, p in ((1, p1), (2, p2), (3, p3)):
+            st, etag = s3.put_part("mp", "big.bin", uid, n, p)
+            assert st == 200 and len(etag) == 32
+        st, etag = s3.complete_multipart("mp", "big.bin", uid)
+        assert st == 200 and etag.endswith("-3")
+        st, body = s3.get("mp", "big.bin")
+        assert st == 200 and body == p1 + p2 + p3
+        # S3 composite etag: md5 of concatenated part digests
+        import hashlib
+        want = hashlib.md5(
+            b"".join(hashlib.md5(p).digest()
+                     for p in (p1, p2, p3))).hexdigest() + "-3"
+        assert etag == want
+        # the upload record is gone
+        st, _h, listing = s3.list_uploads("mp")
+        assert b"big.bin" not in listing
+        # delete cleans the part objects too
+        assert s3.delete("mp", "big.bin") == 204
+        assert s3.get("mp", "big.bin")[0] == 404
+
+    def test_multipart_abort_and_errors(self, gateway):
+        c, gw, s3 = gateway
+        s3.make_bucket("mpa")
+        st, uid = s3.initiate_multipart("mpa", "x")
+        s3.put_part("mpa", "x", uid, 1, b"data")
+        st, _h, listing = s3.list_uploads("mpa")
+        assert uid.encode() in listing
+        assert s3.abort_multipart("mpa", "x", uid) == 204
+        # completing an aborted upload fails
+        assert s3.complete_multipart("mpa", "x", uid)[0] == 404
+        # part upload to unknown upload id fails
+        assert s3.put_part("mpa", "x", "deadbeef", 1, b"z")[0] == 404
+        # zero-part complete fails
+        _, uid2 = s3.initiate_multipart("mpa", "y")
+        assert s3.complete_multipart("mpa", "y", uid2)[0] == 400
+        # bad part number
+        assert s3.put_part("mpa", "x", uid2, 0, b"z")[0] == 400
+
+
+class TestVersioning:
+    def test_versioned_lifecycle(self, gateway):
+        c, gw, s3 = gateway
+        s3.make_bucket("ver")
+        assert s3.set_versioning("ver") == 200
+        st, v1 = s3.put_versioned("ver", "doc", b"first")
+        assert st == 200 and v1
+        st, v2 = s3.put_versioned("ver", "doc", b"second")
+        assert v2 and v2 != v1
+        # current = newest; old version still readable
+        assert s3.get("ver", "doc")[1] == b"second"
+        assert s3.get("ver", "doc", version_id=v1)[1] == b"first"
+        # list-versions shows both, newest marked latest
+        st, _h, xml = s3.list_versions("ver")
+        assert xml.count(b"<Version>") == 2
+        assert f"<VersionId>{v2}</VersionId>".encode() in xml
+
+    def test_delete_marker_and_restore(self, gateway):
+        c, gw, s3 = gateway
+        s3.make_bucket("vdm")
+        s3.set_versioning("vdm")
+        _, v1 = s3.put_versioned("vdm", "k", b"kept")
+        # unversioned DELETE writes a marker: GET 404s, old readable
+        assert s3.delete("vdm", "k") == 204
+        assert s3.get("vdm", "k")[0] == 404
+        assert s3.get("vdm", "k", version_id=v1)[1] == b"kept"
+        st, _h, xml = s3.list_versions("vdm")
+        assert b"<DeleteMarker>" in xml
+        # deleting the marker's version restores the object
+        marker_vid = xml.split(b"<DeleteMarker>")[1].split(
+            b"<VersionId>")[1].split(b"</VersionId>")[0].decode()
+        assert s3.delete("vdm", "k", version_id=marker_vid) == 204
+        assert s3.get("vdm", "k") == (200, b"kept")
+
+    def test_unversioned_bucket_untouched(self, gateway):
+        c, gw, s3 = gateway
+        s3.make_bucket("plainb")
+        st, vid = s3.put_versioned("plainb", "o", b"x")
+        assert st == 200 and vid is None
+        assert s3.get("plainb", "o")[1] == b"x"
+
+
+class TestRGWHardening:
+    def test_versioned_bucket_lists_and_deletes_cleanly(self, gateway):
+        """Delete markers are hidden from listings and an all-deleted
+        versioned bucket can be removed (review r3 finding)."""
+        c, gw, s3 = gateway
+        s3.make_bucket("vclean")
+        s3.set_versioning("vclean")
+        _, v1 = s3.put_versioned("vclean", "k", b"x")
+        assert s3.delete("vclean", "k") == 204   # delete marker
+        st, _h, listing = s3.list("vclean")
+        assert b"<Key>k</Key>" not in listing
+        assert s3.delete("vclean") == 204        # not 409
+
+    def test_multipart_overwrite_frees_parts(self, gateway):
+        """Plain PUT over a completed multipart object must not leak
+        the part objects (review r3 finding)."""
+        c, gw, s3 = gateway
+        s3.make_bucket("mpf")
+        _, uid = s3.initiate_multipart("mpf", "obj")
+        s3.put_part("mpf", "obj", uid, 1, b"Z" * 65536)
+        s3.complete_multipart("mpf", "obj", uid)
+        data_io = gw.store.data
+        parts_before = [o for o in data_io.list_objects()
+                        if "_mp_" in o]
+        assert parts_before
+        s3.put("mpf", "obj", b"small now")
+        parts_after = [o for o in data_io.list_objects()
+                       if "_mp_" in o and uid in o]
+        assert not parts_after
+        assert s3.get("mpf", "obj")[1] == b"small now"
+
+    def test_dotted_bucket_upload_isolation(self, gateway):
+        """multipart listings must not bleed across dotted bucket
+        names (review r3 finding)."""
+        c, gw, s3 = gateway
+        s3.make_bucket("a")
+        s3.make_bucket("a.b")
+        _, uid = s3.initiate_multipart("a.b", "x")
+        st, _h, listing = s3.list_uploads("a")
+        assert uid.encode() not in listing
+        st, _h, listing = s3.list_uploads("a.b")
+        assert uid.encode() in listing
