@@ -1873,6 +1873,14 @@ class ECBackend:
             if on_fail is not None:
                 on_fail()
             if msg is not None:
+                if on_chunks is None and oid is not None and \
+                        pg.is_degraded_object(oid):
+                    # too few consistent shards ONLY because recovery
+                    # is still restoring some member's copy: queue the
+                    # op until the object recovers instead of failing
+                    # it (reference waiting_for_degraded_object)
+                    pg.wait_for_object(oid, lambda: pg.do_op(msg))
+                    return
                 pg._reply(msg, -5, "not enough shards to read")  # EIO
             return
         self._read_tid += 1
@@ -1919,9 +1927,7 @@ class ECBackend:
             # the mixed-version guard must see LOCAL chunks too — a
             # stale local shard collection is exactly as dangerous as
             # a remote one
-            st.setdefault("vers", {})[s] = tuple(
-                meta.get("version", ZERO))
-            st.setdefault("meta", meta)
+            st.setdefault("metas", {})[s] = meta
         except KeyError:
             pass
         return True
@@ -1939,6 +1945,23 @@ class ECBackend:
             tid=msg.tid, pgid=msg.pgid, shard=msg.shard,
             epoch=daemon.osdmap.epoch, rc=rc, data=data.hex(),
             attrs={"_": meta.hex()}, from_osd=daemon.whoami))
+
+    _MIXED_RETRIES = 8
+    _MIXED_RETRY_DELAY = 0.25
+
+    def _retry_read_later(self, msg: M.MOSDOp) -> bool:
+        """Requeue a client read whose shard set is transiently
+        inconsistent (stray holders mid-re-placement).  Bounded: after
+        _MIXED_RETRIES the caller fails the op for real."""
+        tries = getattr(msg, "_mixed_retries", 0)
+        if tries >= self._MIXED_RETRIES:
+            return False
+        msg._mixed_retries = tries + 1
+        pg = self.pg
+        pg.daemon.timer.add_event_after(
+            self._MIXED_RETRY_DELAY,
+            lambda: pg.daemon.op_queue.enqueue("client", msg))
+        return True
 
     def handle_sub_read_reply(self, msg: M.MOSDECSubOpReadReply):
         st = self._reads.get(msg.tid)
@@ -1972,9 +1995,7 @@ class ECBackend:
                 self.pg._reply(st["msg"], -5, "chunk crc mismatch")
             return
         st["chunks"][msg.shard] = chunk
-        st.setdefault("vers", {})[msg.shard] = tuple(
-            meta.get("version", ZERO))
-        st.setdefault("meta", meta)
+        st.setdefault("metas", {})[msg.shard] = meta
         self._maybe_finish_read(msg.tid)
 
     def _maybe_finish_read(self, tid: int):
@@ -1982,17 +2003,77 @@ class ECBackend:
         if st is None or set(st["chunks"]) < st["need"]:
             return
         # a stale stray shard collection (pre-re-placement leftover)
-        # must never be decoded against fresh chunks: all gathered
-        # versions have to agree or the decode would be garbage
-        vers = set((st.get("vers") or {}).values())
+        # must never be decoded against fresh chunks; but mixed
+        # versions are NORMAL under thrash — a shard that was down
+        # during the write still holds the old object until recovery
+        # pushes it.  Decode from the shards at the NEWEST version
+        # when they still satisfy the code (reference ECBackend
+        # get_min_avail_to_read_shards consults the missing set to
+        # the same effect); fail only when they cannot.
+        metas = st.get("metas") or {}
+        vers_map = {s: tuple(m.get("version", ZERO))
+                    for s, m in metas.items()}
+        vers = set(vers_map.values())
         if len(vers) > 1:
-            del self._reads[tid]
-            if st.get("on_fail") is not None:
-                st["on_fail"]()
-            if st["msg"] is not None:
-                self.pg._reply(st["msg"], -5,
-                               "mixed-version shard chunks")
-            return
+            newest = max(vers)
+            fresh = {s: c for s, c in st["chunks"].items()
+                     if vers_map.get(s) == newest}
+            try:
+                need = self.engine.minimum_to_decode(
+                    st["want"], set(fresh))
+                ok = set(need) <= set(fresh)
+            except Exception:
+                ok = False
+            if not ok:
+                # the minimum read set hit a stale holder: EXTEND the
+                # read to shards not yet tried before giving up — the
+                # other acting members usually hold the fresh version
+                # (reference: ECBackend re-issues to remaining shards
+                # on read errors)
+                attempted = st.setdefault("attempted",
+                                          set(st["need"]))
+                avail = self._available_shards()
+                extra = [s for s in avail
+                         if s not in st["chunks"]
+                         and s not in attempted]
+                if extra:
+                    for s in extra:
+                        attempted.add(s)
+                        st["need"].add(s)
+                        if not self._issue_shard_read(tid, s,
+                                                      avail[s]):
+                            return      # read state torn down
+                    if set(st["chunks"]) >= st["need"]:
+                        return self._maybe_finish_read(tid)
+                    return              # await remote sub-reads
+                del self._reads[tid]
+                if st.get("on_fail") is not None:
+                    st["on_fail"]()
+                msg = st["msg"]
+                if msg is not None:
+                    oid = st.get("oid")
+                    if st.get("on_chunks") is None and oid and \
+                            self.pg.is_degraded_object(oid):
+                        # stale shards will be overwritten by the
+                        # in-flight recovery: retry after it lands
+                        self.pg.wait_for_object(
+                            oid, lambda: self.pg.do_op(msg))
+                        return
+                    if st.get("on_chunks") is None and \
+                            self._retry_read_later(msg):
+                        # a stale STRAY holder answered (its copy
+                        # predates a re-placement) and not enough
+                        # acting shards agree yet — recovery isn't
+                        # tracking strays, so back off briefly and
+                        # re-target; fail only when it persists
+                        return
+                    self.pg._reply(msg, -5,
+                                   "mixed-version shard chunks")
+                return
+            st["chunks"] = fresh
+            metas = {s: m for s, m in metas.items()
+                     if vers_map.get(s) == newest}
+        st["meta"] = next(iter(metas.values()), {})
         del self._reads[tid]
         chunks = {s: np.frombuffer(c, dtype=np.uint8)
                   for s, c in st["chunks"].items()}

@@ -84,8 +84,11 @@ class RGWStore:
         self.data = rados.open_ioctx(DATA_POOL)
         # the frontend is a ThreadingHTTPServer: index/version-seq
         # read-modify-writes must not interleave (the reference gets
-        # this atomicity from cls_rgw ops executing on the OSD)
-        self._lock = threading.Lock()
+        # this atomicity from cls_rgw ops executing on the OSD).
+        # Named lockdep mutex: ordering violations against other
+        # named mutexes fail deterministically under tests
+        from ..core.lockdep import Mutex
+        self._lock = Mutex("rgwstore")
 
     def _drop_parts(self, meta: dict | None):
         """Remove a manifest's part objects (nothing else references

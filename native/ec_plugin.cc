@@ -152,7 +152,7 @@ struct ec_ring {
     ec_batch_executor_fn exec = nullptr;
     void *exec_ctx = nullptr;
     long fallbacks = 0;       /* executor-failed → CPU re-encodes */
-    std::mutex mu;
+    mutable std::mutex mu;
 };
 
 static int cpu_executor(const uint8_t *data, uint8_t *parity,
@@ -222,6 +222,10 @@ long ec_ring_flush(ec_ring_t *r) {
         ctx = r->exec ? r->exec_ctx : r->inst;
         batch = r->pending;
         r->flushing = true;
+        /* the executor is about to overwrite the parity buffer with
+         * the lock dropped: invalidate the previous flush's readable
+         * window NOW so a concurrent get_parity can't read torn rows */
+        r->flushed_count = 0;
     }
     /* run the executor unlocked: it may be a Python/JAX trampoline that
      * takes arbitrary time or calls back into ring APIs (which see
@@ -260,6 +264,12 @@ int ec_ring_get_parity(ec_ring_t *r, long slot, uint8_t *parity) {
     return 0;
 }
 
-size_t ec_ring_pending(const ec_ring_t *r) { return r->pending; }
+size_t ec_ring_pending(const ec_ring_t *r) {
+    std::lock_guard<std::mutex> g(r->mu);
+    return r->pending;
+}
 
-long ec_ring_fallback_count(const ec_ring_t *r) { return r->fallbacks; }
+long ec_ring_fallback_count(const ec_ring_t *r) {
+    std::lock_guard<std::mutex> g(r->mu);
+    return r->fallbacks;
+}

@@ -1,8 +1,13 @@
-/* Minimal native self-test (run by `make test`); the thorough
+/* Minimal native self-test (run by `make test`, and under
+ * ThreadSanitizer by `make tsan` — SURVEY.md §6.2); the thorough
  * cross-checks against the Python oracle live in tests/test_native.py. */
 #include <assert.h>
 #include <stdio.h>
 #include <string.h>
+
+#include <atomic>
+#include <thread>
+#include <vector>
 
 #include "ec_plugin.h"
 #include "gf256.h"
@@ -48,6 +53,54 @@ int main() {
         assert(memcmp(p2, parity, sizeof p2) == 0);
     }
     ec_ring_free(ring);
+
+    /* concurrent section (the part TSAN actually checks): N producer
+     * threads submit stripes into one ring while a flusher drains it,
+     * plus parallel un-shared encodes — the OSD's sharded-op-queue
+     * usage shape */
+    {
+        ec_ring_t *r2 = ec_ring_create(ec, 32, chunk);
+        std::atomic<long> submitted{0}, flushed{0};
+        std::atomic<bool> done{false};
+        std::vector<std::thread> producers;
+        for (int t = 0; t < 4; t++) {
+            producers.emplace_back([&, t]() {
+                uint8_t local[4 * 1024];
+                for (size_t i = 0; i < sizeof local; i++)
+                    local[i] = (uint8_t)(i + t);
+                for (int n = 0; n < 64; n++) {
+                    while (ec_ring_submit(r2, local) < 0) {
+                        /* full: wait for the flusher */
+                        std::this_thread::yield();
+                    }
+                    submitted.fetch_add(1);
+                }
+            });
+        }
+        std::thread flusher([&]() {
+            while (!done.load() || ec_ring_pending(r2) > 0) {
+                long n = ec_ring_flush(r2);
+                if (n > 0) flushed.fetch_add(n);
+                else std::this_thread::yield();
+            }
+        });
+        for (auto &p : producers) p.join();
+        done.store(true);
+        flusher.join();
+        assert(submitted.load() == 4 * 64);
+        assert(flushed.load() == submitted.load());
+        ec_ring_free(r2);
+
+        std::vector<std::thread> encoders;
+        for (int t = 0; t < 4; t++) {
+            encoders.emplace_back([&]() {
+                uint8_t p3[2 * 1024];
+                for (int n = 0; n < 32; n++)
+                    assert(ec_encode(ec, data, p3, chunk) == 0);
+            });
+        }
+        for (auto &e : encoders) e.join();
+    }
     ec_free(ec);
     printf("native selftest ok\n");
     return 0;

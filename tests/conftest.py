@@ -28,3 +28,11 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 assert not jax.config.jax_platforms or jax.config.jax_platforms == "cpu"
+
+# lockdep (reference `lockdep = true` config, src/common/lockdep.cc):
+# every named ceph_tpu.core.lockdep.Mutex in product code is order-
+# checked for the whole suite — an ABBA cycle fails deterministically
+# instead of deadlocking once a year
+from ceph_tpu.core.lockdep import lockdep_enable  # noqa: E402
+
+lockdep_enable()

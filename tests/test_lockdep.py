@@ -1,0 +1,115 @@
+"""lockdep + TSAN: the race-detection tier (SURVEY.md §6.2).
+
+- ``core/lockdep.py`` is the reference's ``src/common/lockdep.cc``
+  analog: named mutexes, lock-order graph, deterministic failure on
+  any interleaving that uses two orders (no unlucky timing needed).
+- ``make -C native tsan`` is the reference's ``-DWITH_TSAN`` build
+  flavor: the native selftest's concurrent ring section runs under
+  ThreadSanitizer.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from ceph_tpu.core import lockdep
+from ceph_tpu.core.lockdep import LockOrderError, Mutex
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    """Each test gets an empty order graph (conftest enables lockdep
+    globally; re-enable after the disable test)."""
+    lockdep.lockdep_disable()
+    lockdep.lockdep_enable()
+    yield
+    lockdep.lockdep_disable()
+    lockdep.lockdep_enable()
+
+
+class TestLockdep:
+    def test_abba_detected_without_deadlock_timing(self):
+        a, b = Mutex("A"), Mutex("B")
+        with a:
+            with b:
+                pass            # records A→B
+        with b:
+            with pytest.raises(LockOrderError, match="A -> B"):
+                a.acquire()     # B held, wants A: cycle
+
+    def test_transitive_cycle_detected(self):
+        a, b, c = Mutex("tA"), Mutex("tB"), Mutex("tC")
+        with a:
+            with b:
+                pass            # tA→tB
+        with b:
+            with c:
+                pass            # tB→tC
+        with c:
+            with pytest.raises(LockOrderError):
+                a.acquire()     # tC held, wants tA: tA→tB→tC cycle
+
+    def test_recursive_acquisition_caught(self):
+        m = Mutex("R")
+        with m:
+            with pytest.raises(LockOrderError, match="recursive"):
+                m.acquire()
+
+    def test_consistent_order_is_fine(self):
+        a, b = Mutex("okA"), Mutex("okB")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert not a.locked_by_me()
+
+    def test_per_thread_held_sets(self):
+        """Held state is thread-local: another thread holding X does
+        not make THIS thread's acquisitions ordered after X."""
+        import threading
+        x, y = Mutex("thX"), Mutex("thY")
+        x.acquire()
+        t = threading.Thread(target=lambda: (y.acquire(),
+                                             y.release()))
+        t.start()
+        t.join()
+        x.release()
+        # no x→y edge was recorded (different threads)
+        with y:
+            x.acquire()         # must not raise
+            x.release()
+
+    def test_disabled_means_no_checks(self):
+        lockdep.lockdep_disable()
+        a, b = Mutex("dA"), Mutex("dB")
+        with a:
+            with b:
+                pass
+        with b:
+            a.acquire()         # would raise if enabled
+            a.release()
+
+
+def _tsan_available() -> bool:
+    if shutil.which("g++") is None:
+        return False
+    probe = subprocess.run(
+        ["g++", "-fsanitize=thread", "-x", "c++", "-", "-o",
+         "/tmp/tsan_probe"],
+        input=b"int main(){return 0;}", capture_output=True)
+    return probe.returncode == 0
+
+
+@pytest.mark.skipif(not _tsan_available(),
+                    reason="g++ -fsanitize=thread unavailable")
+def test_native_concurrent_paths_under_tsan():
+    """The native ring's producer/flusher concurrency runs clean
+    under ThreadSanitizer (halt_on_error: any race fails the run)."""
+    rc = subprocess.run(["make", "-C", str(REPO / "native"), "tsan"],
+                        capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 0, rc.stdout[-2000:] + rc.stderr[-2000:]
+    assert "native selftest ok" in rc.stdout
