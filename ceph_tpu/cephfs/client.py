@@ -209,20 +209,43 @@ class CephFS(Dispatcher):
             self._mds_con = None
 
     # -- path resolution ---------------------------------------------------
-    def _resolve_dir(self, parts: list[str]) -> int:
-        """Walk to the directory holding parts[-1]; → its ino."""
+    def _resolve_dir(self, parts: list[str],
+                     _hops: int = 0) -> int:
+        """Walk to the directory holding parts[-1]; → its ino.
+        Directory symlinks in intermediate components are followed
+        (POSIX resolution; bounded: ELOOP)."""
         ino = ROOT_INO
-        for name in parts[:-1]:
+        i = 0
+        while i < len(parts) - 1:
+            name = parts[i]
             rec = self._lookup(ino, name)
+            if rec["type"] == "symlink":
+                _hops += 1
+                if _hops > 8:
+                    raise CephFSError(-40, "too many symlink hops")
+                target = rec["target"]
+                tparts = _split(target)
+                # splice the link target in place of this component;
+                # absolute targets restart from /
+                rest = parts[i + 1:]
+                parts = tparts + rest
+                i = 0
+                ino = ROOT_INO if target.startswith("/") else ino
+                continue
             if rec["type"] != "dir":
                 raise CephFSError(-20, f"{name!r} is not a directory")
             ino = rec["ino"]
+            i += 1
         return ino
 
     def _lookup(self, dino: int, name: str) -> dict:
         key = (dino, name)
         rec = self._dcache.get(key)
-        if rec is None:
+        if rec is None or rec.get("remote"):
+            # never serve a hard-linked inode from the dentry cache:
+            # its size/mtime live on the shared inode row and another
+            # link name may have changed them (reference: cap recall
+            # keeps linked inodes coherent; we re-fetch instead)
             rec = self._request("lookup", {"dir": dino, "name": name})
             self._dcache[key] = rec
         return rec
@@ -281,6 +304,70 @@ class CephFS(Dispatcher):
         self._request("rmdir", {"dir": dino, "name": name})
         self._dcache.pop((dino, name), None)
 
+    def _follow_symlinks(self, dino: int, name: str
+                         ) -> tuple[int, str]:
+        """Resolve (dino, name) through symlink dentries (bounded:
+        ELOOP).  Relative targets resolve against the LINK's parent
+        directory, absolute ones from /.  A missing dentry stops the
+        walk — open('w') may be about to create it."""
+        hops = 0
+        while True:
+            try:
+                rec = self._lookup(dino, name)
+            except CephFSError as e:
+                if e.rc == -2:
+                    return dino, name
+                raise
+            if rec["type"] != "symlink":
+                return dino, name
+            hops += 1
+            if hops > 8:
+                raise CephFSError(-40, "too many symlink hops")
+            target = rec["target"]
+            parts = _split(target)
+            if not parts:
+                raise CephFSError(-21, "/ is a directory")
+            base = ROOT_INO if target.startswith("/") else dino
+            for comp in parts[:-1]:
+                step = self._lookup(base, comp)
+                if step["type"] != "dir":
+                    raise CephFSError(-20,
+                                      f"{comp!r} is not a directory")
+                base = step["ino"]
+            dino, name = base, parts[-1]
+
+    def symlink(self, target: str, path: str):
+        """Create a symbolic link at `path` pointing to `target`
+        (reference Client::symlink)."""
+        parts = _split(path)
+        if not parts:
+            raise CephFSError(-17, "/ exists")
+        dino = self._resolve_dir(parts)
+        rec = self._request("symlink", {
+            "dir": dino, "name": parts[-1], "target": target})
+        self._dcache[(dino, parts[-1])] = rec
+
+    def readlink(self, path: str) -> str:
+        _, _, rec = self._resolve(path)
+        if rec["type"] != "symlink":
+            raise CephFSError(-22, f"{path!r} is not a symlink")
+        return rec["target"]
+
+    def link(self, src: str, dst: str):
+        """Hard link: `dst` becomes another name for `src`'s inode
+        (reference Client::link)."""
+        sparts, dparts = _split(src), _split(dst)
+        if not sparts or not dparts:
+            raise CephFSError(-22, "cannot link /")
+        tdino = self._resolve_dir(sparts)
+        ddino = self._resolve_dir(dparts)
+        self._request("link", {
+            "tdir": tdino, "tname": sparts[-1],
+            "dir": ddino, "name": dparts[-1]})
+        # both names now resolve through the shared inode row
+        self._dcache.pop((tdino, sparts[-1]), None)
+        self._dcache.pop((ddino, dparts[-1]), None)
+
     def rename(self, src: str, dst: str):
         sparts, dparts = _split(src), _split(dst)
         if not sparts or not dparts:
@@ -302,6 +389,9 @@ class CephFS(Dispatcher):
             raise CephFSError(-21, "/ is a directory")
         dino = self._resolve_dir(parts)
         name = parts[-1]
+        # follow symlinks for EVERY open mode — a write through a
+        # link must land on the target, not on the link's own inode
+        dino, name = self._follow_symlinks(dino, name)
         if flags in ("w", "a", "x"):
             lay = layout or self.default_layout
             args = {"dir": dino, "name": name,

@@ -41,6 +41,7 @@ ROOT_INO = 1
 INO_CHUNK = 128          # inode numbers claimed per journal event
 JHEAD = "mds{rank}_journal"
 INOTABLE = "mds{rank}_inotable"
+INODES = "mds{rank}_inodes"   # multi-link inode rows (size/mtime/nlink)
 
 
 def dirfrag_oid(ino: int) -> str:
@@ -222,6 +223,55 @@ class MDSDaemon(Dispatcher):
     def _inotable_oid(self) -> str:
         return INOTABLE.format(rank=max(self.rank, 0))
 
+    @property
+    def _inodes_oid(self) -> str:
+        return INODES.format(rank=max(self.rank, 0))
+
+    # -- multi-link inode rows --------------------------------------------
+    # (reference: a hard link makes the inode shared — the reference
+    # keeps the inode on the primary dentry and "remote" dentries
+    # reference it by ino; here shared inodes move into an inode-row
+    # omap and every dentry becomes a remote stub)
+    def _inode_row(self, ino: int) -> dict | None:
+        cache = getattr(self, "_inode_cache", None)
+        if cache is None:
+            cache = self._inode_cache = {}
+        if ino in cache:
+            return cache[ino]
+        try:
+            raw = self.meta.omap_get(self._inodes_oid).get(str(ino))
+        except ObjectNotFound:
+            raw = None
+        row = json.loads(raw.decode()) if raw else None
+        cache[ino] = row
+        return row
+
+    def _inode_apply(self, sub: list):
+        """'iset'/'irm' journal sub-ops write through (idempotent)."""
+        cache = getattr(self, "_inode_cache", None)
+        if cache is None:
+            cache = self._inode_cache = {}
+        if sub[0] == "iset":
+            _, ino, row = sub
+            cache[int(ino)] = row
+            self.meta.omap_set(self._inodes_oid, {
+                str(ino): json.dumps(row).encode()})
+        elif sub[0] == "irm":
+            _, ino = sub
+            cache[int(ino)] = None
+            try:
+                self.meta.omap_rm_keys(self._inodes_oid, [str(ino)])
+            except ObjectNotFound:
+                pass
+
+    def _resolve_rec(self, rec: dict) -> dict:
+        """Overlay the shared inode row onto a remote dentry stub."""
+        if rec and rec.get("remote"):
+            row = self._inode_row(rec["ino"])
+            if row:
+                rec = dict(rec, **row)
+        return rec
+
     def _replay_journal(self):
         """Apply every journaled event to the backing dirfrags, then
         trim (reference MDLog replay on rank takeover)."""
@@ -255,6 +305,8 @@ class MDSDaemon(Dispatcher):
                 self._dir(dino).pop(name, None)
                 self._dirty_rm.setdefault(dino, set()).add(name)
                 self._dirty_set.get(dino, {}).pop(name, None)
+            elif kind in ("iset", "irm"):
+                self._inode_apply(sub)
             elif kind == "inotable":
                 _, limit = sub
                 cur = self._backing_inotable()
@@ -398,13 +450,14 @@ class MDSDaemon(Dispatcher):
         rec = self._dir(dino).get(name)
         if rec is None:
             return -2, f"no dentry {name!r}", None
-        return 0, "", rec
+        return 0, "", self._resolve_rec(rec)
 
     _op_getattr = _op_lookup
 
     def _op_readdir(self, args, client, tid):
         d = self._dir(args["dir"])
-        return 0, "", sorted([name, rec] for name, rec in d.items())
+        return 0, "", sorted([name, self._resolve_rec(rec)]
+                             for name, rec in d.items())
 
     # -- mutations (journaled, deduped) ------------------------------------
     def _mutate(self, subs, client, tid, result=None):
@@ -426,6 +479,8 @@ class MDSDaemon(Dispatcher):
             self._dir(dino).pop(name, None)
             self._dirty_rm.setdefault(dino, set()).add(name)
             self._dirty_set.get(dino, {}).pop(name, None)
+        elif sub[0] in ("iset", "irm"):
+            self._inode_apply(sub)
 
     def _op_mkdir(self, args, client, tid):
         dino, name = args["dir"], args["name"]
@@ -444,7 +499,7 @@ class MDSDaemon(Dispatcher):
                 return -21, f"{name!r} is a directory", None
             if args.get("excl"):
                 return -17, f"{name!r} exists", None
-            return 0, "", existing
+            return 0, "", self._resolve_rec(existing)
         ino, extra = self._alloc_ino()
         rec = {"ino": ino, "type": "file", "size": 0, "mtime": _now()}
         if args.get("layout"):
@@ -457,6 +512,16 @@ class MDSDaemon(Dispatcher):
         rec = self._dir(dino).get(name)
         if rec is None:
             return -2, f"no dentry {name!r}", None
+        if rec.get("remote"):
+            # shared inode: attrs live on the inode row so every
+            # link sees them (reference: inode, not dentry, state)
+            row = dict(self._inode_row(rec["ino"]) or {})
+            for fld in ("size", "mtime"):
+                if args.get(fld) is not None:
+                    row[fld] = args[fld]
+            rc = self._mutate([["iset", rec["ino"], row]], client,
+                              tid, dict(rec, **row))
+            return rc
         rec = dict(rec)
         for fld in ("size", "mtime"):
             if args.get(fld) is not None:
@@ -470,9 +535,63 @@ class MDSDaemon(Dispatcher):
             return -2, f"no dentry {name!r}", None
         if rec["type"] == "dir":
             return -21, f"{name!r} is a directory", None
+        if rec.get("remote"):
+            row = dict(self._inode_row(rec["ino"]) or {"nlink": 1})
+            nlink = int(row.get("nlink", 1)) - 1
+            if nlink > 0:
+                row["nlink"] = nlink
+                return self._mutate([["rm", dino, name],
+                                     ["iset", rec["ino"], row]],
+                                    client, tid)
+            rc = self._mutate([["rm", dino, name],
+                               ["irm", rec["ino"]]], client, tid)
+            self._purge_file(dict(rec, **row))
+            return rc
         rc = self._mutate([["rm", dino, name]], client, tid)
-        self._purge_file(rec)
+        if rec["type"] == "file":
+            self._purge_file(rec)
         return rc
+
+    def _op_link(self, args, client, tid):
+        """Hard link: args {tdir, tname} (existing file) + {dir, name}
+        (new dentry).  Both dentries become remote stubs over a shared
+        inode row (reference: primary + remote dentry on one inode)."""
+        tdino, tname = args["tdir"], args["tname"]
+        dino, name = args["dir"], args["name"]
+        target = self._dir(tdino).get(tname)
+        if target is None:
+            return -2, f"no dentry {tname!r}", None
+        if target["type"] != "file":
+            return -1, "hard links to non-files are not allowed", None
+        if name in self._dir(dino):
+            return -17, f"{name!r} exists", None
+        subs = []
+        if target.get("remote"):
+            row = dict(self._inode_row(target["ino"]) or {"nlink": 1})
+            row["nlink"] = int(row.get("nlink", 1)) + 1
+            stub = dict(target)
+        else:
+            row = {"size": target.get("size", 0),
+                   "mtime": target.get("mtime", 0), "nlink": 2}
+            stub = {k: v for k, v in target.items()
+                    if k not in ("size", "mtime")}
+            stub["remote"] = True
+            subs.append(["set", tdino, tname, stub])
+        subs.append(["iset", target["ino"], row])
+        subs.append(["set", dino, name, stub])
+        return self._mutate(subs, client, tid,
+                            self._resolve_rec(stub))
+
+    def _op_symlink(self, args, client, tid):
+        dino, name = args["dir"], args["name"]
+        if name in self._dir(dino):
+            return -17, f"{name!r} exists", None
+        ino, extra = self._alloc_ino()
+        rec = {"ino": ino, "type": "symlink",
+               "target": str(args["target"]), "size": 0,
+               "mtime": _now()}
+        return self._mutate(extra + [["set", dino, name, rec]],
+                            client, tid, rec)
 
     def _op_rmdir(self, args, client, tid):
         dino, name = args["dir"], args["name"]
@@ -528,10 +647,24 @@ class MDSDaemon(Dispatcher):
                 return -20, f"{dname!r} is not a directory", None
             else:
                 purge = target
-        rc = self._mutate([["rm", sdino, sname],
-                           ["set", ddino, dname, rec]], client, tid, rec)
+        subs = [["rm", sdino, sname], ["set", ddino, dname, rec]]
+        purge_rec = None
         if purge is not None:
-            self._purge_file(purge)
+            if purge.get("remote"):
+                row = dict(self._inode_row(purge["ino"]) or
+                           {"nlink": 1})
+                nlink = int(row.get("nlink", 1)) - 1
+                if nlink > 0:
+                    row["nlink"] = nlink
+                    subs.append(["iset", purge["ino"], row])
+                else:
+                    subs.append(["irm", purge["ino"]])
+                    purge_rec = dict(purge, **row)
+            elif purge["type"] == "file":
+                purge_rec = purge
+        rc = self._mutate(subs, client, tid, rec)
+        if purge_rec is not None:
+            self._purge_file(purge_rec)
         return rc
 
     def _purge_file(self, rec: dict):

@@ -137,10 +137,13 @@ class PrometheusModule(MgrModule):
 
 def _default_modules():
     # late import: modules.py subclasses MgrModule from this file
+    from .dashboard import DashboardModule
     from .modules import (CrashModule, IostatModule, StatusModule,
                           TelemetryModule)
+    from .volumes import VolumesModule
     return (BalancerModule, PgAutoscalerModule, PrometheusModule,
-            StatusModule, IostatModule, CrashModule, TelemetryModule)
+            StatusModule, IostatModule, CrashModule, TelemetryModule,
+            DashboardModule, VolumesModule)
 
 
 class MgrDaemon:

@@ -1,0 +1,136 @@
+"""mgr dashboard — REST API + HTML cluster status page.
+
+Reference behavior re-created (``src/pybind/mgr/dashboard``; SURVEY.md
+§3.10), reduced to the read-side REST controllers and a single status
+page (the reference's Angular frontend is out of scope — the API
+shape is the parity surface):
+
+- ``GET /api/health``      → health status + checks
+- ``GET /api/summary``     → the `ceph -s` aggregate
+- ``GET /api/osd``         → per-OSD rows (up/in, pgs, ops)
+- ``GET /api/pool``        → per-pool rows (pg_num, objects, bytes)
+- ``GET /api/pg``          → pg state counts
+- ``GET /api/crash``       → archived crash reports
+- ``GET /``                → minimal HTML status page
+
+Runs on the ACTIVE mgr like the prometheus exporter; standbys don't
+bind (reference: the dashboard fails over with the active mgr).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .daemon import MgrModule
+
+
+class DashboardModule(MgrModule):
+    NAME = "dashboard"
+    TICK = 1.0
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        module = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, body: bytes,
+                       ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    route = self.path.split("?", 1)[0].rstrip("/")
+                    if route == "":
+                        return self._reply(
+                            200, module.render_html().encode(),
+                            ctype="text/html")
+                    if route.startswith("/api/"):
+                        out = module.api(route[len("/api/"):])
+                        if out is None:
+                            return self._reply(
+                                404, b'{"error": "no such route"}')
+                        return self._reply(200, json.dumps(
+                            out, default=str).encode())
+                    return self._reply(404, b"not found",
+                                       ctype="text/plain")
+                except Exception as e:   # noqa: BLE001 — a mon
+                    # hiccup must return 503, not kill the server
+                    return self._reply(503, json.dumps(
+                        {"error": repr(e)}).encode())
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="mgr-dashboard",
+            daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- data --------------------------------------------------------------
+    def _status(self) -> dict:
+        rc, _, st = self.ctx.mon_command({"prefix": "status"})
+        return st if rc == 0 and st else {}
+
+    def api(self, route: str):
+        if route == "health":
+            st = self._status()
+            return {"status": st.get("health"),
+                    "checks": st.get("checks", [])}
+        if route == "summary":
+            return self._status()
+        if route == "osd":
+            rc, _, dump = self.ctx.mon_command({"prefix": "osd df"})
+            return dump.get("nodes", []) if rc == 0 and dump else []
+        if route == "pool":
+            rc, _, df = self.ctx.mon_command({"prefix": "df"})
+            return df.get("pools", []) if rc == 0 and df else []
+        if route == "pg":
+            st = self._status()
+            return {"num_pgs": st.get("num_pgs", 0),
+                    "states": st.get("pg_states", {})}
+        if route == "crash":
+            # reuse the daemon's registered crash module (it shares
+            # this module host) rather than wiring a second instance
+            mod = self.ctx._d.modules.get("crash")
+            if mod is None:
+                from .modules import CrashModule
+                mod = CrashModule(self.ctx)
+            return mod.ls()
+        return None
+
+    def render_html(self) -> str:
+        st = self._status()
+        checks = "".join(
+            f"<li>{c['code']}: {c['summary']}</li>"
+            for c in st.get("checks", []))
+        pgs = ", ".join(f"{n} {s}" for s, n in
+                        sorted(st.get("pg_states", {}).items()))
+        color = {"HEALTH_OK": "#0a0", "HEALTH_WARN": "#a80",
+                 "HEALTH_ERR": "#a00"}.get(st.get("health"), "#888")
+        return f"""<!doctype html><html><head>
+<title>ceph_tpu dashboard</title></head><body>
+<h1>Cluster status</h1>
+<p>Health: <b style="color:{color}">{st.get('health', '?')}</b></p>
+<ul>{checks}</ul>
+<p>mon quorum {st.get('quorum')} &middot;
+osd {st.get('num_up_osds')}/{st.get('num_osds')} up &middot;
+{len(st.get('pools', []))} pools &middot;
+{st.get('num_objects')} objects</p>
+<p>pgs: {pgs}</p>
+<p>API: /api/health /api/summary /api/osd /api/pool /api/pg
+/api/crash</p>
+</body></html>"""

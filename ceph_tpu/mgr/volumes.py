@@ -1,0 +1,87 @@
+"""mgr volumes — CephFS subvolume management.
+
+Reference behavior re-created (``src/pybind/mgr/volumes``; SURVEY.md
+§3.10): subvolumes are managed directories under
+``/volumes/<group>/<name>`` in a filesystem, created/listed/removed
+through the mgr so orchestration never hand-rolls paths.  The module
+mounts a CephFS client lazily (only when a filesystem with an active
+MDS exists) and serves:
+
+- ``subvolume_create(fs, name, group="_nogroup")``
+- ``subvolume_ls(fs, group)``
+- ``subvolume_rm(fs, name, group)`` (recursive)
+- ``subvolume_getpath(fs, name, group)``
+"""
+
+from __future__ import annotations
+
+from .daemon import MgrModule
+
+VOLUMES_ROOT = "/volumes"
+
+
+class VolumesModule(MgrModule):
+    NAME = "volumes"
+    TICK = 30.0
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._mounts: dict[str, object] = {}
+
+    def shutdown(self):
+        for fs in list(self._mounts.values()):
+            try:
+                fs.unmount()
+            except Exception:
+                pass
+        self._mounts.clear()
+
+    def _fs(self, fs_name: str):
+        fs = self._mounts.get(fs_name)
+        if fs is None:
+            from ..cephfs.client import CephFS
+            fs = CephFS(self.ctx._d.monmap, fs_name=fs_name).mount()
+            self._mounts[fs_name] = fs
+        return fs
+
+    @staticmethod
+    def _dir(group: str, name: str = "") -> str:
+        base = f"{VOLUMES_ROOT}/{group}"
+        return f"{base}/{name}" if name else base
+
+    def subvolume_create(self, fs_name: str, name: str,
+                         group: str = "_nogroup") -> str:
+        fs = self._fs(fs_name)
+        path = self._dir(group, name)
+        fs.mkdirs(path)
+        return path
+
+    def subvolume_ls(self, fs_name: str,
+                     group: str = "_nogroup") -> list[str]:
+        fs = self._fs(fs_name)
+        try:
+            return [n for n, rec in fs.readdir(self._dir(group))
+                    if rec["type"] == "dir"]
+        except Exception:
+            return []
+
+    def subvolume_getpath(self, fs_name: str, name: str,
+                          group: str = "_nogroup") -> str:
+        fs = self._fs(fs_name)
+        path = self._dir(group, name)
+        fs.stat(path)           # raises if absent
+        return path
+
+    def subvolume_rm(self, fs_name: str, name: str,
+                     group: str = "_nogroup"):
+        fs = self._fs(fs_name)
+        self._rmtree(fs, self._dir(group, name))
+
+    def _rmtree(self, fs, path: str):
+        for entry, rec in fs.readdir(path):
+            child = f"{path}/{entry}"
+            if rec["type"] == "dir":
+                self._rmtree(fs, child)
+            else:
+                fs.unlink(child)
+        fs.rmdir(path)

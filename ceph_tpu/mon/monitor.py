@@ -973,6 +973,47 @@ class HealthMonitor(PaxosService):
                            "osd_stats": {
                                str(o): s for o, s in
                                self.mon.pgmap.osd_stats.items()}}
+        if prefix == "df":
+            # per-pool usage from PGMap (reference `ceph df`:
+            # PGMap::dump_cluster_stats + per-pool sums)
+            osdsvc = self.mon.services["osdmap"]
+            m = osdsvc.osdmap
+            self.mon.pgmap.prune(set(m.pools))
+            pools = {}
+            for pgid_s, st in self.mon.pgmap.pg_stats.items():
+                try:
+                    pid = int(pgid_s.split(".", 1)[0])
+                except ValueError:
+                    continue
+                row = pools.setdefault(pid, {"objects": 0, "bytes": 0})
+                row["objects"] += int(st.get("num_objects", 0))
+                row["bytes"] += int(st.get("num_bytes", 0))
+            out = {"pools": []}
+            for name, pid in sorted(m.pool_name.items()):
+                pool = m.pools.get(pid)
+                row = pools.get(pid, {"objects": 0, "bytes": 0})
+                out["pools"].append({
+                    "name": name, "id": pid,
+                    "pg_num": pool.pg_num if pool else 0,
+                    "objects": row["objects"],
+                    "bytes_used": row["bytes"]})
+            out["total_objects"] = sum(p["objects"]
+                                       for p in out["pools"])
+            out["total_bytes_used"] = sum(p["bytes_used"]
+                                          for p in out["pools"])
+            return 0, "", out
+        if prefix == "osd df":
+            # per-osd utilization (reference `ceph osd df`)
+            osdsvc = self.mon.services["osdmap"]
+            m = osdsvc.osdmap
+            rows = []
+            for o, st in sorted(self.mon.pgmap.osd_stats.items()):
+                rows.append({
+                    "osd": o,
+                    "up": m.is_up(o) if o < m.max_osd else False,
+                    "num_pgs": int(st.get("num_pgs", 0)),
+                    "ops": int(st.get("op", 0))})
+            return 0, "", {"nodes": rows}
         if prefix in ("health", "status", "pg stat"):
             osdsvc: OSDMonitor = self.mon.services["osdmap"]
             m = osdsvc.osdmap

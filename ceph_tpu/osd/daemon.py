@@ -568,6 +568,17 @@ class OSDaemon(Dispatcher):
                 elif pg.is_primary and pg.state == "down" and \
                         len(pg.acting_live()) >= max(1, pg.pool.min_size):
                     pg._start_peering()
+                elif pg.is_primary and pg.state == "active" and \
+                        (pg.missing or pg.backfill_targets or
+                         any(pg.peer_missing.values())):
+                    # recovery retry: a push/pull whose reconstruct
+                    # read failed transiently has no event to re-kick
+                    # it — the tick is the retry engine (reference:
+                    # the recovery work queue re-schedules).  Also
+                    # re-deliver activation: a peer whose map advance
+                    # raced it sits in 'stray' answering nothing.
+                    pg._resend_activation()
+                    pg._kick_recovery()
             for o in self._hb_peers():
                 self._hb_last.setdefault(o, now)
                 self.send_to_osd(o, M.MOSDPing(
@@ -597,10 +608,31 @@ class OSDaemon(Dispatcher):
         for pgid, pg in self.pgs.items():
             if not pg.is_primary:
                 continue
+            # per-PG usage is only rescanned when the PG changed since
+            # the last report — the tick must not stat() every object
+            # of an idle cluster over and over
+            objs = pg._list_objects()
+            cache = getattr(pg, "_usage_cache", None)
+            # keyed on (last_update, object count): splits, recovery
+            # pulls, and backfill move objects WITHOUT bumping
+            # last_update, so the listing length must participate or
+            # the byte count goes stale (review r3)
+            key = (pg.info.last_update, len(objs))
+            if cache is not None and cache[0] == key:
+                nbytes = cache[1]
+            else:
+                nbytes = 0
+                for o in objs:
+                    try:
+                        nbytes += self.store.stat(pg.cid, o)["size"]
+                    except KeyError:
+                        pass
+                pg._usage_cache = (key, nbytes)
             stats[str(pgid)] = {
                 "state": pg.state + ("+scrubbing" if pg.scrubbing
                                      else ""),
-                "num_objects": len(pg._list_objects()),
+                "num_objects": len(objs),
+                "num_bytes": nbytes,
                 "log_size": len(pg.log.entries),
                 "missing": len(pg.missing) + sum(
                     len(pm) for pm in pg.peer_missing.values()),

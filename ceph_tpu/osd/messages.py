@@ -62,18 +62,20 @@ class MOSDPGQuery(_JsonMessage):
 
 @register_message
 class MOSDPGNotify(_JsonMessage):
-    """Peer → primary: my pg_info (reference MOSDPGNotify)."""
+    """Peer → primary: my pg_info + my missing set (reference
+    MOSDPGNotify; pg_missing_t travels with peering info)."""
     TYPE = 45
-    FIELDS = ("pgid", "epoch", "info", "from_osd")
+    FIELDS = ("pgid", "epoch", "info", "from_osd", "missing")
 
 
 @register_message
 class MOSDPGLog(_JsonMessage):
     """Log share / activation (reference MOSDPGLog): when ``activate``
     is set the receiver adopts the authoritative info+log and goes
-    active."""
+    active.  ``missing``: the sender's own missing set (peering)."""
     TYPE = 46
-    FIELDS = ("pgid", "epoch", "info", "entries", "activate", "from_osd")
+    FIELDS = ("pgid", "epoch", "info", "entries", "activate",
+              "from_osd", "missing")
 
 
 @register_message

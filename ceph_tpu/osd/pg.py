@@ -92,6 +92,14 @@ class PG:
         # primary-only peering/recovery state
         self.peer_info: dict[int, PGInfo] = {}
         self.peer_missing: dict[int, dict[str, tuple | None]] = {}
+        # what each peer SAYS it misses (exchanged during peering;
+        # reference pg_missing_t) — unioned into peer_missing at
+        # activation because the log diff can't see gaps behind an
+        # already-adopted log
+        self.peer_reported_missing: dict[int, dict] = {}
+        # acting peers that confirmed THIS interval's activation (they
+        # notify back on activate); _resend_activation skips them
+        self.peer_activated: set[int] = set()
         self.waiting_for_active: list = []
         self.waiting_for_object: dict[str, list] = {}
         self._queried: set[int] = set()
@@ -211,7 +219,15 @@ class PG:
         t = txn if txn is not None else Transaction()
         t.omap_setkeys(self.cid, META_OID, {
             "info": json.dumps(self.info.to_dict()).encode(),
-            "log": json.dumps(self.log.to_dict()).encode()})
+            "log": json.dumps(self.log.to_dict()).encode(),
+            # the missing set MUST survive a restart (reference:
+            # pg_missing_t is persisted in the pg-log omap): a revived
+            # OSD that kept its adopted log but forgot what bytes it
+            # lacks would claim completeness it doesn't have, and the
+            # object would silently never be recovered
+            "missing": json.dumps(
+                {o: list(v) if v is not None else None
+                 for o, v in self.missing.items()}).encode()})
         return t
 
     def load_from_store(self):
@@ -224,6 +240,10 @@ class PG:
             self.info = PGInfo.from_dict(json.loads(meta["info"]))
         if "log" in meta:
             self.log = PGLog.from_dict(json.loads(meta["log"]))
+        if "missing" in meta:
+            self.missing = {
+                o: tuple(v) if v is not None else None
+                for o, v in json.loads(meta["missing"]).items()}
 
     def create_onstore(self):
         if not self.daemon.store.collection_exists(self.cid):
@@ -257,6 +277,8 @@ class PG:
             self._held_cache = None
             self.peer_info.clear()
             self.peer_missing.clear()
+            self.peer_reported_missing.clear()
+            self.peer_activated.clear()
             self._queried.clear()
             self._pulls.clear()     # re-pull in the new interval
             self.backfill_targets.clear()   # re-scan, pushes are
@@ -329,26 +351,57 @@ class PG:
                 return False
         return True
 
+    def _missing_dict(self) -> dict:
+        """Wire form of the local missing set (reference pg_missing_t
+        travels with peering info): only MODIFY gaps — missing deletes
+        self-resolve at activation."""
+        return {o: list(v) for o, v in self.missing.items()
+                if v is not None}
+
     def handle_query(self, msg: M.MOSDPGQuery):
         """Replica side: answer info/log queries."""
         if msg.kind == "info":
             self.daemon.send_to_osd(msg.from_osd, M.MOSDPGNotify(
                 pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
-                info=self._info_dict(), from_osd=self.daemon.whoami))
+                info=self._info_dict(), from_osd=self.daemon.whoami,
+                missing=self._missing_dict()))
         elif msg.kind == "log":
             since = tuple(msg.since) if msg.since else ZERO
             entries = [e.to_dict() for e in self.log.entries_after(since)]
             self.daemon.send_to_osd(msg.from_osd, M.MOSDPGLog(
                 pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
                 info=self._info_dict(), entries=entries,
-                activate=False, from_osd=self.daemon.whoami))
+                activate=False, from_osd=self.daemon.whoami,
+                missing=self._missing_dict()))
 
     def handle_notify(self, msg: M.MOSDPGNotify):
-        """Primary side: collect peer infos (GetInfo)."""
+        """Primary side: collect peer infos (GetInfo), and while
+        ACTIVE, activation acks — the peer confirms it activated and
+        reports what it still misses."""
+        if self.is_primary and self.state == "active" and \
+                msg.from_osd in self.acting:
+            self.peer_activated.add(msg.from_osd)
+            self.peer_info[msg.from_osd] = PGInfo.from_dict(msg.info)
+            pm = self.peer_missing.setdefault(msg.from_osd, {})
+            changed = False
+            for oid, ver in (msg.missing or {}).items():
+                if oid not in pm:
+                    pm[oid] = tuple(ver)
+                    changed = True
+            if changed:
+                self._kick_recovery()
+            return
         if not self.is_primary or self.state not in ("peering",
                                                      "incomplete"):
             return
         self.peer_info[msg.from_osd] = PGInfo.from_dict(msg.info)
+        # the peer's own missing set: a log diff alone can't see it —
+        # log adoption advances last_update BEFORE the bytes arrive,
+        # so a peer re-peering mid-recovery looks complete by version
+        # while still lacking objects (reference: pg_missing_t is
+        # exchanged during peering, not derived)
+        self.peer_reported_missing[msg.from_osd] = {
+            o: tuple(v) for o, v in (msg.missing or {}).items()}
         # only wait on probe targets that are still up — a target that
         # died mid-gather is re-probed (or re-gated) by the tick retry
         m = self.daemon.osdmap
@@ -387,7 +440,13 @@ class PG:
                 continue
             self.log.add(e)
             if e.op == MODIFY:
-                self.missing[e.oid] = e.version
+                # pg_missing_t semantics: missing means the STORE
+                # lacks the bytes — a push/backfill may already have
+                # delivered this version before the log caught up
+                if self.backend._object_version(e.oid) >= e.version:
+                    self.missing.pop(e.oid, None)
+                else:
+                    self.missing[e.oid] = e.version
             elif e.op == DELETE:
                 self.missing[e.oid] = None
         self.info.last_update = max(self.info.last_update,
@@ -398,6 +457,11 @@ class PG:
         entries = [LogEntry.from_dict(e) for e in msg.entries or []]
         info = PGInfo.from_dict(msg.info)
         if msg.activate:
+            if (msg.epoch or 0) < self.interval_epoch:
+                # stale activation from a deposed primary (it can be
+                # re-sent on a tick): must not flip this newer
+                # interval's state
+                return
             # replica activation: adopt authoritative log
             self._merge_authoritative(info, entries)
             self.info.last_epoch_started = max(
@@ -405,9 +469,18 @@ class PG:
             self.state = "active"
             self._apply_local_deletes()
             self.daemon.store.queue_transaction(self._persist_meta())
+            # activation ACK: fresh info + missing back to the primary
+            # (lets it stop re-sending and learn post-adoption gaps)
+            self.daemon.send_to_osd(msg.from_osd, M.MOSDPGNotify(
+                pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
+                info=self._info_dict(), from_osd=self.daemon.whoami,
+                missing=self._missing_dict()))
         else:
             if not self.is_primary or self.state != "peering":
                 return
+            if msg.missing is not None:
+                self.peer_reported_missing[msg.from_osd] = {
+                    o: tuple(v) for o, v in msg.missing.items()}
             self._merge_authoritative(info, entries)
             self._activate()
 
@@ -457,6 +530,12 @@ class PG:
                                             "objs": None}
             else:
                 pm = self.log.missing_for(plu)
+            # union what the peer itself reported missing: bytes it
+            # never received under a log it already adopted
+            for oid, ver in (self.peer_reported_missing.get(o)
+                             or {}).items():
+                if oid not in pm:
+                    pm[oid] = ver
             self.peer_missing[o] = pm
             entries = (self.log.entries_after(plu)
                        if plu >= self.log.tail else
@@ -495,6 +574,26 @@ class PG:
             fn()
         self._kick_recovery()
 
+    def _resend_activation(self):
+        """Re-send the activation log to acting peers (idempotent).
+        An activation can race a peer's own map advance — the peer
+        lands back in 'stray' for the same interval and nothing else
+        would ever deliver it (reference: peering machine re-drives
+        activation; acting peers ack and the primary retries)."""
+        for o in self._peer_osds():
+            if o in self.peer_activated:
+                continue        # confirmed: no traffic needed
+            pi = self.peer_info.get(o)
+            plu = pi.last_update if pi else ZERO
+            entries = (self.log.entries_after(plu)
+                       if plu >= self.log.tail else
+                       list(self.log.entries))
+            self.daemon.send_to_osd(o, M.MOSDPGLog(
+                pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
+                info=self._info_dict(),
+                entries=[e.to_dict() for e in entries],
+                activate=True, from_osd=self.daemon.whoami))
+
     def _list_objects(self, include_snaps: bool = False) -> list[str]:
         """Head objects by default; include_snaps adds clone objects
         (backfill/scrub want them — pgls and clients never do)."""
@@ -513,6 +612,19 @@ class PG:
         if oid in self.missing:
             return True
         return any(oid in pm for pm in self.peer_missing.values())
+
+    @staticmethod
+    def _supersedes_object(msg: M.MOSDOp) -> bool:
+        """True when the op REPLACES the object wholesale — it needs
+        none of the missing bytes, and applying it heals the degraded
+        state (every member gets the fresh full copy and drops its
+        missing entry).  Waiting on recovery here is not just slow, it
+        can deadlock: an interrupted write can leave a version only
+        the primary holds, unrecoverable until exactly such an
+        overwrite arrives."""
+        ops = [op.get("op") for op in msg.ops]
+        return bool(ops) and all(o in ("write_full", "delete")
+                                 for o in ops)
 
     def wait_for_object(self, oid: str, retry):
         self.waiting_for_object.setdefault(oid, []).append(retry)
@@ -693,7 +805,8 @@ class PG:
                         version=dup.version)
             return
         oid = msg.oid
-        if self.is_degraded_object(oid):
+        if self.is_degraded_object(oid) and \
+                not self._supersedes_object(msg):
             self.wait_for_object(oid, lambda: self.do_op(msg))
             self._kick_recovery()
             return
@@ -1479,7 +1592,12 @@ class ReplicatedBackend:
         pg, daemon = self.pg, self.pg.daemon
         cid = pg.cid
         if _push_is_stale(daemon.store, cid, msg):
-            return      # a live write already superseded this push
+            # the bytes are already here at (or past) the pushed
+            # version — the object is NOT missing; forgetting to clear
+            # the entry makes the peer re-report it at every peering
+            # and the cluster re-push forever
+            pg.missing.pop(msg.oid, None)
+            return
         t = Transaction()
         if not daemon.store.collection_exists(cid):
             t.create_collection(cid)
@@ -1556,12 +1674,16 @@ class ECBackend:
             self._rmw[oid].append(
                 lambda: self.submit_write(msg, reqid))
             return
+        # serialize ALL writes per object, not just RMWs: the primary
+        # now applies locally at ACK time (primary-applies-last), so
+        # two in-flight ops on one object could complete out of order
+        # and leave the primary's shard at the older bytes
+        self._rmw[oid] = []
         exists = self._read_local_meta(oid) is not None
         kinds = [op.get("op") for op in msg.ops]
         needs_old = exists and any(k in ("write", "append", "truncate")
                                    for k in kinds)
         if needs_old:
-            self._rmw[oid] = []
             fake = M.MOSDOp(tid=0, client="rmw", pgid=str(pg.pgid),
                             oid=oid, epoch=pg.daemon.osdmap.epoch,
                             ops=[], flags=0)
@@ -1573,7 +1695,8 @@ class ECBackend:
                 old = b"".join(
                     decoded[i].tobytes() for i in range(k))[:size]
                 self._apply_ops(msg, reqid, old)
-                self._release_rmw(oid)
+                # NOT released here: the gate holds until the op acks
+                # (primary-applies-last ordering)
 
             def on_fail():
                 self._release_rmw(oid)
@@ -1658,28 +1781,50 @@ class ECBackend:
                     not pg.backfill_gate(o, oid, is_delete=delete):
                 continue
             live.append((s, o))
-        state = {"waiting": {s for s, _ in live}, "msg": msg,
-                 "version": version, "results": results}
+        if len(live) < max(pg.pool.min_size, self.engine.k) \
+                and not delete:
+            # durability floor (reference: EC PGs don't go active —
+            # and writes don't ack — below min_size): acking after
+            # landing on fewer shards can leave a stripe that a single
+            # later failure makes unrecoverable.  EAGAIN; the client
+            # retries until enough members take the write.  Deletes
+            # are exempt: they remove state and replay from the log.
+            pg._reply(msg, -11, "degraded below min_size")
+            self._release_rmw(oid)
+            return
+        # PRIMARY APPLIES LAST (write-ahead ordering): the local txn +
+        # log entry are deferred until every live peer acked its
+        # sub-write.  An op interrupted mid-fan-out then leaves NO
+        # trace on the primary — the client's resend re-executes at a
+        # fresh version and full-replace fan-out heals any peer
+        # orphans.  The old order (primary first) could strand the
+        # only copy of a stripe on the primary's single shard — m
+        # losses of redundancy in one step and unrecoverable with
+        # k > 1 (the reference avoids this with per-entry rollback
+        # records in the EC log; deferring the primary is the
+        # rollback-free equivalent at our op granularity).
+        local = [(s, o) for s, o in live if o == daemon.whoami]
+        remote = [(s, o) for s, o in live if o != daemon.whoami]
+        local_txns = [self._shard_txn(s, oid, shard_chunks, delete,
+                                      attr_ops, version,
+                                      len(data) if data is not None
+                                      else None)
+                      for s, _ in local]
+        state = {"waiting": {s for s, _ in remote}, "msg": msg,
+                 "version": version, "results": results,
+                 "local_txns": local_txns, "entry": entry,
+                 "oid": oid}
         self._inflight[reqid] = state
-        for s, o in live:
+        for s, o in remote:
             txn = self._shard_txn(s, oid, shard_chunks, delete,
                                   attr_ops, version,
                                   len(data) if data is not None else None)
-            if o == daemon.whoami:
-                # local shard: data only — the log entry is appended
-                # once, below, for the whole PG
-                daemon.store.queue_transaction(txn)
-                state["waiting"].discard(s)
-            else:
-                daemon.send_to_osd(o, M.MOSDECSubOpWrite(
-                    reqid=reqid, pgid=str(pg.pgid), shard=s,
-                    epoch=daemon.osdmap.epoch, txn=txn.to_dict(),
-                    version=list(version),
-                    log_entries=[entry.to_dict()],
-                    pg_info=pg.info.to_dict()))
-        pg.log.add(entry)
-        pg.info.last_update = version
-        daemon.store.queue_transaction(pg._persist_meta())
+            daemon.send_to_osd(o, M.MOSDECSubOpWrite(
+                reqid=reqid, pgid=str(pg.pgid), shard=s,
+                epoch=daemon.osdmap.epoch, txn=txn.to_dict(),
+                version=list(version),
+                log_entries=[entry.to_dict()],
+                pg_info=pg.info.to_dict()))
         self._maybe_ack(reqid)
 
     def _shard_txn(self, shard: int, oid: str, chunks, delete: bool,
@@ -1750,8 +1895,21 @@ class ECBackend:
         if st is None or st["waiting"]:
             return
         del self._inflight[reqid]
-        self.pg._reply(st["msg"], 0, "", results=st["results"],
-                       version=st["version"])
+        pg = self.pg
+        # every live peer committed: NOW apply locally + log + ack
+        # (primary-applies-last -- see submit_write)
+        for txn in st.get("local_txns") or ():
+            pg.daemon.store.queue_transaction(txn)
+        entry = st.get("entry")
+        if entry is not None:
+            pg.missing.pop(st.get("oid"), None)
+            pg.log.add(entry)
+            pg.info.last_update = entry.version
+            pg.daemon.store.queue_transaction(pg._persist_meta())
+        pg._reply(st["msg"], 0, "", results=st["results"],
+                  version=st["version"])
+        if st.get("oid") is not None:
+            self._release_rmw(st["oid"])
 
     # -- object meta helpers ----------------------------------------------
     def _object_version(self, oid: str) -> tuple:
@@ -1856,6 +2014,7 @@ class ECBackend:
         # collection; a later -ENOENT sub-read reply retries the
         # remaining alternates (handle_sub_read_reply)
         alts: dict[int, list[int]] = {}
+        demoted: dict[int, int] = {}
         for s, o in list(avail.items()):
             alts[s] = [h for h in holders.get(s, []) if h != o]
             pm = pg.peer_missing.get(o)
@@ -1865,11 +2024,28 @@ class ECBackend:
                 if alts[s]:
                     avail[s] = alts[s].pop(0)
                 else:
-                    avail.pop(s, None)
+                    # believed-missing with no alternate holder: a
+                    # LAST-RESORT probe target, not a hard exclusion —
+                    # the missing belief can be stale (a peer-reported
+                    # set from before its recovery completed), and a
+                    # probe that truly ENOENTs is handled by the
+                    # extension path; dropping it outright can leave
+                    # fewer than k chunks and wedge recovery
+                    demoted[s] = avail.pop(s)
         want = set(range(k)) if want is None else set(want)
         try:
             need = self.engine.minimum_to_decode(want, set(avail))
         except Exception:
+            if demoted:
+                avail.update(demoted)
+                try:
+                    need = self.engine.minimum_to_decode(
+                        want, set(avail))
+                except Exception:
+                    need = None
+            else:
+                need = None
+        if need is None:
             if on_fail is not None:
                 on_fail()
             if msg is not None:
@@ -1914,6 +2090,8 @@ class ECBackend:
             nxt = st["alts"].get(s)
             if nxt:
                 return self._issue_shard_read(tid, s, nxt.pop(0))
+            if self._shard_unfetchable(tid, s):
+                return True     # read continues on other shards
             del self._reads[tid]
             if st.get("on_fail") is not None:
                 st["on_fail"]()
@@ -1976,6 +2154,9 @@ class ECBackend:
                 self._issue_shard_read(msg.tid, msg.shard, nxt.pop(0))
                 self._maybe_finish_read(msg.tid)
                 return
+            if msg.rc == -2 and self._shard_unfetchable(msg.tid,
+                                                        msg.shard):
+                return          # read continues on other shards
             del self._reads[msg.tid]
             if st.get("on_fail") is not None:
                 st["on_fail"]()
@@ -1998,6 +2179,58 @@ class ECBackend:
         st.setdefault("metas", {})[msg.shard] = meta
         self._maybe_finish_read(msg.tid)
 
+    def _shard_unfetchable(self, tid: int, s: int) -> bool:
+        """Shard s ENOENTed with no alternates: drop it from the read
+        set and extend to other shards if decode stays feasible.
+        → True when the read survives (caller must not tear down)."""
+        st = self._reads.get(tid)
+        if st is None:
+            return True
+        st["need"].discard(s)
+        st.setdefault("attempted", set()).add(s)
+        try:
+            feasible_now = set(self.engine.minimum_to_decode(
+                st["want"], set(st["need"]))) <= set(st["need"])
+        except Exception:
+            feasible_now = False
+        if feasible_now:
+            self._maybe_finish_read(tid)
+            return True
+        return self._extend_read(tid)
+
+    def _extend_read(self, tid: int):
+        """Grow a read's shard set with untried members (preferring
+        ones believed to hold the object; believed-missing members are
+        last-resort probes — the belief can be stale).  → False when no
+        extension is possible (state intact, caller fails the read);
+        True when handled — extended, completed, or torn down."""
+        st = self._reads.get(tid)
+        if st is None:
+            return True     # state already gone: nothing more to do
+        attempted = st.setdefault("attempted", set(st["need"]))
+        avail = self._available_shards()
+        oid = st.get("oid")
+        pg = self.pg
+        preferred, fallback = [], []
+        for s, o in avail.items():
+            if s in st["chunks"] or s in attempted:
+                continue
+            misses = (o == pg.daemon.whoami
+                      and oid in pg.missing) or \
+                (oid in (pg.peer_missing.get(o) or ()))
+            (fallback if misses else preferred).append(s)
+        extra = preferred or fallback
+        if not extra:
+            return False            # no extension possible; state intact
+        for s in extra:
+            attempted.add(s)
+            st["need"].add(s)
+            if not self._issue_shard_read(tid, s, avail[s]):
+                return True         # read state torn down
+        if set(st["chunks"]) >= st["need"]:
+            self._maybe_finish_read(tid)
+        return True                 # handled (completed or awaiting)
+
     def _maybe_finish_read(self, tid: int):
         st = self._reads.get(tid)
         if st is None or set(st["chunks"]) < st["need"]:
@@ -2015,37 +2248,45 @@ class ECBackend:
                     for s, m in metas.items()}
         vers = set(vers_map.values())
         if len(vers) > 1:
-            newest = max(vers)
-            fresh = {s: c for s, c in st["chunks"].items()
-                     if vers_map.get(s) == newest}
-            try:
-                need = self.engine.minimum_to_decode(
-                    st["want"], set(fresh))
-                ok = set(need) <= set(fresh)
-            except Exception:
-                ok = False
-            if not ok:
-                # the minimum read set hit a stale holder: EXTEND the
-                # read to shards not yet tried before giving up — the
-                # other acting members usually hold the fresh version
-                # (reference: ECBackend re-issues to remaining shards
-                # on read errors)
-                attempted = st.setdefault("attempted",
-                                          set(st["need"]))
-                avail = self._available_shards()
-                extra = [s for s in avail
-                         if s not in st["chunks"]
-                         and s not in attempted]
-                if extra:
-                    for s in extra:
-                        attempted.add(s)
-                        st["need"].add(s)
-                        if not self._issue_shard_read(tid, s,
-                                                      avail[s]):
-                            return      # read state torn down
-                    if set(st["chunks"]) >= st["need"]:
-                        return self._maybe_finish_read(tid)
-                    return              # await remote sub-reads
+            # choose the NEWEST version the gathered chunks can
+            # actually decode.  An un-acked interrupted write can
+            # leave a newer version on a MINORITY of shards (fewer
+            # than k) — that version was never acknowledged, so
+            # falling back to the previous feasible one IS the
+            # correct outcome (the reference reaches the same result
+            # via per-entry rollback of uncommitted EC log entries).
+            # never fall below the version the PRIMARY's log carries:
+            # with primary-applies-last, a logged version IS an acked
+            # version, and serving anything older would be silent
+            # rollback of an acknowledged write
+            oid = st.get("oid")
+            committed = ZERO
+            if oid is not None:
+                for e in reversed(self.pg.log.entries):
+                    if e.oid == oid:
+                        committed = e.version
+                        break
+            fresh = None
+            for cand in sorted(vers, reverse=True):
+                if cand < committed:
+                    break
+                cset = {s: c for s, c in st["chunks"].items()
+                        if vers_map.get(s) == cand}
+                try:
+                    need = self.engine.minimum_to_decode(
+                        st["want"], set(cset))
+                    if set(need) <= set(cset):
+                        fresh = cset
+                        newest = cand
+                        break
+                except Exception:
+                    continue
+            if fresh is None:
+                # no gathered version decodes: EXTEND the read to
+                # shards not yet tried before giving up (reference:
+                # ECBackend re-issues to remaining shards on errors)
+                if self._extend_read(tid):
+                    return
                 del self._reads[tid]
                 if st.get("on_fail") is not None:
                     st["on_fail"]()
@@ -2218,7 +2459,8 @@ class ECBackend:
         pg = self.pg
         cid = pg.cid
         if _push_is_stale(pg.daemon.store, cid, msg):
-            return      # a live write already superseded this push
+            pg.missing.pop(msg.oid, None)   # bytes already present:
+            return                          # not missing (see above)
         t = Transaction()
         if not pg.daemon.store.collection_exists(cid):
             t.create_collection(cid)

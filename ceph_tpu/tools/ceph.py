@@ -1,6 +1,7 @@
 """ceph CLI — mon command dispatch (reference ``src/ceph.in``).
 
-    ceph -m HOST:PORT[,...] status | health | pg stat | pg dump
+    ceph -m HOST:PORT[,...] status|-s | health | df | osd df
+    ceph -m ... pg stat | pg dump
     ceph -m ... osd tree | osd dump | osd stat | osd pool ls
     ceph -m ... osd pool create NAME [--pg-num N] [--size N] [--type T]
     ceph -m ... osd out ID | osd in ID | osd down ID
@@ -89,8 +90,29 @@ def _dispatch(args, rest) -> int:
             cmd = {"prefix": "osd reweight", "id": int(rest[2]),
                    "weight": float(rest[3])}
         else:
-            cmd = {"prefix": " ".join(rest)}
+            words = ["status" if w == "-s" else w for w in rest]
+            fmt = None
+            cleaned = []
+            i = 0
+            while i < len(words):
+                w = words[i]
+                if w.startswith("--format="):
+                    fmt = w.split("=", 1)[1]
+                elif w in ("--format", "-f") and i + 1 < len(words):
+                    fmt = words[i + 1]
+                    i += 1
+                else:
+                    cleaned.append(w)
+                i += 1
+            cmd = {"prefix": " ".join(cleaned),
+                   "_render": fmt in (None, "plain")}
+        want_render = cmd.pop("_render", False)
         rc, outs, outb = mc.command(cmd)
+        if rc == 0 and want_render and outb is not None:
+            text = _render(cmd["prefix"], outb)
+            if text is not None:
+                print(text)
+                return 0
         if outb is not None:
             print(json.dumps(outb, indent=2, default=str))
         if outs:
@@ -98,6 +120,55 @@ def _dispatch(args, rest) -> int:
         return 0 if rc == 0 else 1
     finally:
         mc.shutdown()
+
+
+def _render(prefix: str, out) -> str | None:
+    """Human panels for the classic read commands (reference ceph.in
+    plain-format output); None ⇒ caller falls back to JSON."""
+    if prefix == "status":
+        pgs = " ".join(f"{n} {s}" for s, n in
+                       sorted(out.get("pg_states", {}).items()))
+        lines = [
+            "  cluster:",
+            f"    health: {out.get('health')}",
+        ]
+        for chk in out.get("checks", []):
+            lines.append(f"            {chk['code']}: "
+                         f"{chk['summary']}")
+        lines += [
+            "",
+            "  services:",
+            f"    mon: quorum {out.get('quorum')} "
+            f"(leader {out.get('leader')})",
+            f"    osd: {out.get('num_up_osds')}/"
+            f"{out.get('num_osds')} up (epoch "
+            f"{out.get('osdmap_epoch')})",
+            "",
+            "  data:",
+            f"    pools:   {len(out.get('pools', []))} pools, "
+            f"{out.get('num_pgs')} pgs",
+            f"    objects: {out.get('num_objects')} objects",
+            f"    pgs:     {pgs}",
+        ]
+        return "\n".join(lines)
+    if prefix == "df":
+        lines = ["--- POOLS ---",
+                 f"{'NAME':<16}{'ID':>4}{'PGS':>6}{'OBJECTS':>10}"
+                 f"{'USED':>12}"]
+        for p in out.get("pools", []):
+            lines.append(f"{p['name']:<16}{p['id']:>4}"
+                         f"{p['pg_num']:>6}{p['objects']:>10}"
+                         f"{p['bytes_used']:>12}")
+        lines.append(f"TOTAL objects={out.get('total_objects')} "
+                     f"used={out.get('total_bytes_used')}B")
+        return "\n".join(lines)
+    if prefix == "osd df":
+        lines = [f"{'ID':>4}{'UP':>6}{'PGS':>6}{'OPS':>10}"]
+        for n in out.get("nodes", []):
+            lines.append(f"{n['osd']:>4}{str(n['up']):>6}"
+                         f"{n['num_pgs']:>6}{n['ops']:>10}")
+        return "\n".join(lines)
+    return None
 
 
 if __name__ == "__main__":

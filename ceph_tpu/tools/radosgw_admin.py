@@ -1,0 +1,87 @@
+"""radosgw-admin — offline/administrative ops on the RGW store.
+
+Reference behavior re-created (``src/rgw/rgw_admin.cc``; SURVEY.md
+§3.9/§3.10), reduced to the authless gateway's surface: bucket
+inventory and surgery straight against the ``.rgw.*`` pools, no
+gateway process required (exactly how the reference tool talks to
+RADOS directly).
+
+    radosgw-admin -m HOST:PORT[,...] bucket list
+    radosgw-admin ... bucket stats --bucket NAME
+    radosgw-admin ... bucket rm --bucket NAME [--purge-objects]
+    radosgw-admin ... object rm --bucket NAME --object KEY
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..osdc.librados import Rados
+from ..rgw.gateway import RGWStore
+from .rados import _monmap_from_addrs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="radosgw-admin",
+                                description=__doc__)
+    p.add_argument("-m", "--mon", required=True)
+    p.add_argument("target", choices=["bucket", "object"])
+    p.add_argument("op", choices=["list", "stats", "rm"])
+    p.add_argument("--bucket")
+    p.add_argument("--object")
+    p.add_argument("--purge-objects", action="store_true")
+    a = p.parse_args(argv)
+
+    r = Rados(_monmap_from_addrs(a.mon)).connect()
+    try:
+        store = RGWStore(r)
+        if a.target == "bucket" and a.op == "list":
+            print(json.dumps(store.list_buckets(), indent=2))
+            return 0
+        if a.target == "bucket" and a.op == "stats":
+            if not a.bucket:
+                raise SystemExit("--bucket required")
+            if not store.bucket_exists(a.bucket):
+                print(f"no such bucket {a.bucket!r}",
+                      file=sys.stderr)
+                return 2
+            objs = store.list_objects(a.bucket)
+            print(json.dumps({
+                "bucket": a.bucket,
+                "usage": {
+                    "num_objects": len(objs),
+                    "size": sum(m.get("size", 0)
+                                for m in objs.values()),
+                },
+                "versioning": store.versioning_enabled(a.bucket),
+            }, indent=2))
+            return 0
+        if a.target == "bucket" and a.op == "rm":
+            if not a.bucket:
+                raise SystemExit("--bucket required")
+            if a.purge_objects:
+                for key in list(store.list_objects(a.bucket)):
+                    store.delete_object(a.bucket, key)
+                # purge surviving old versions + markers too
+                for e in store.list_versions(a.bucket):
+                    store.delete_object(a.bucket, e["key"],
+                                        e["version_id"])
+            if not store.delete_bucket(a.bucket):
+                print("bucket not empty (use --purge-objects)",
+                      file=sys.stderr)
+                return 2
+            return 0
+        if a.target == "object" and a.op == "rm":
+            if not (a.bucket and a.object):
+                raise SystemExit("--bucket and --object required")
+            store.delete_object(a.bucket, a.object)
+            return 0
+        raise SystemExit(f"unsupported: {a.target} {a.op}")
+    finally:
+        r.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

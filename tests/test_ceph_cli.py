@@ -32,7 +32,11 @@ def _run(c, *argv):
 
 class TestCephCLI:
     def test_status_and_health(self, cluster):
+        # plain `status` renders the human panel; --format=json gives
+        # the machine form (reference ceph -s behavior)
         rc, out = _run(cluster, "status")
+        assert rc == 0 and "osd: 3/3 up" in out
+        rc, out = _run(cluster, "status", "--format=json")
         assert rc == 0
         st = json.loads(out)
         assert st["num_up_osds"] == 3

@@ -7,6 +7,7 @@ import time
 
 import pytest
 
+from ceph_tpu.cephfs.client import CephFSError
 from ceph_tpu.osdc.striper import FileLayout
 from ceph_tpu.vstart import MiniCluster
 
@@ -187,3 +188,149 @@ class TestFailover:
             fs2 = c.cephfs("cephfs")
             assert fs2.read_file("/d/e/f") == b"persist me"
             assert fs2.listdir("/d") == ["e"]
+
+
+class TestLinks:
+    def test_symlink_readlink_follow(self, fs):
+        fs.mkdirs("/sym")
+        fd = fs.open("/sym/real.txt", "w")
+        fs.write(fd, b"pointed-at")
+        fs.close(fd)
+        fs.symlink("/sym/real.txt", "/sym/alias")
+        assert fs.readlink("/sym/alias") == "/sym/real.txt"
+        assert fs.stat("/sym/alias")["type"] == "symlink"
+        # open() follows the link
+        fd = fs.open("/sym/alias", "r")
+        assert fs.read(fd) == b"pointed-at"
+        fs.close(fd)
+        # dangling symlink: readlink works, open fails
+        fs.symlink("/sym/nowhere", "/sym/dangle")
+        assert fs.readlink("/sym/dangle") == "/sym/nowhere"
+        with pytest.raises(CephFSError):
+            fs.open("/sym/dangle", "r")
+        # unlink of a symlink leaves the target alone
+        fs.unlink("/sym/alias")
+        fd = fs.open("/sym/real.txt", "r")
+        assert fs.read(fd) == b"pointed-at"
+        fs.close(fd)
+
+    def test_symlink_loop_detected(self, fs):
+        fs.mkdirs("/loop")
+        fs.symlink("/loop/b", "/loop/a")
+        fs.symlink("/loop/a", "/loop/b")
+        with pytest.raises(CephFSError, match="symlink"):
+            fs.open("/loop/a", "r")
+
+    def test_hardlink_shared_inode(self, fs):
+        fs.mkdirs("/hl")
+        fd = fs.open("/hl/one", "w")
+        fs.write(fd, b"original")
+        fs.close(fd)
+        fs.link("/hl/one", "/hl/two")
+        st1, st2 = fs.stat("/hl/one"), fs.stat("/hl/two")
+        assert st1["ino"] == st2["ino"]
+        assert st1["nlink"] == 2
+        # write through one name, read through the other
+        fd = fs.open("/hl/two", "a")
+        fs.write(fd, b"+more")
+        fs.close(fd)
+        fd = fs.open("/hl/one", "r")
+        assert fs.read(fd) == b"original+more"
+        fs.close(fd)
+        assert fs.stat("/hl/one")["size"] == len(b"original+more")
+        # unlink one name: data survives via the other
+        fs.unlink("/hl/one")
+        fd = fs.open("/hl/two", "r")
+        assert fs.read(fd) == b"original+more"
+        fs.close(fd)
+        assert fs.stat("/hl/two")["nlink"] == 1
+        # unlink the last name: inode + data gone
+        fs.unlink("/hl/two")
+        with pytest.raises(CephFSError):
+            fs.open("/hl/two", "r")
+
+    def test_hardlinks_survive_mds_failover(self, fs_cluster):
+        client = fs_cluster.cephfs("cephfs")
+        try:
+            client.mkdirs("/hlf")
+            fd = client.open("/hlf/f", "w")
+            client.write(fd, b"durable")
+            client.close(fd)
+            client.link("/hlf/f", "/hlf/g")
+            fs_cluster.start_mds("b")
+            fs_cluster.kill_mds("a")
+            fs_cluster.wait_for_active_mds()
+        finally:
+            client.unmount()
+        c2 = fs_cluster.cephfs("cephfs")
+        try:
+            assert c2.stat("/hlf/g")["nlink"] == 2
+            fd = c2.open("/hlf/g", "r")
+            assert c2.read(fd) == b"durable"
+            c2.close(fd)
+        finally:
+            c2.unmount()
+
+
+class TestVolumes:
+    def test_subvolume_lifecycle(self, fs_cluster):
+        from ceph_tpu.mgr.volumes import VolumesModule
+
+        class _Ctx:       # minimal MgrModuleContext stand-in
+            class _D:
+                monmap = fs_cluster.monmap
+            _d = _D()
+
+        mod = VolumesModule(_Ctx())
+        try:
+            path = mod.subvolume_create("cephfs", "vol1")
+            assert path == "/volumes/_nogroup/vol1"
+            mod.subvolume_create("cephfs", "vol2", group="apps")
+            assert mod.subvolume_ls("cephfs") == ["vol1"]
+            assert mod.subvolume_ls("cephfs", "apps") == ["vol2"]
+            assert mod.subvolume_getpath("cephfs", "vol1") == path
+            # a client can use the subvolume path directly
+            client = fs_cluster.cephfs("cephfs")
+            try:
+                fd = client.open(f"{path}/data.bin", "w")
+                client.write(fd, b"payload")
+                client.close(fd)
+            finally:
+                client.unmount()
+            mod.subvolume_rm("cephfs", "vol1")
+            assert mod.subvolume_ls("cephfs") == []
+        finally:
+            mod.shutdown()
+
+
+class TestSymlinkSemantics:
+    def test_write_through_symlink_hits_target(self, fs):
+        """open('w') through a link must write the TARGET (review r3
+        finding: it used to write the symlink's own inode)."""
+        fs.mkdirs("/swt")
+        fd = fs.open("/swt/real", "w")
+        fs.write(fd, b"old")
+        fs.close(fd)
+        fs.symlink("/swt/real", "/swt/lnk")
+        fd = fs.open("/swt/lnk", "w")
+        fs.write(fd, b"NEW")
+        fs.close(fd)
+        fd = fs.open("/swt/real", "r")
+        assert fs.read(fd) == b"NEW"
+        fs.close(fd)
+        assert fs.stat("/swt/lnk")["type"] == "symlink"
+
+    def test_relative_symlink_target(self, fs):
+        """Relative targets resolve against the link's directory."""
+        fs.mkdirs("/rel/sub")
+        fd = fs.open("/rel/sub/data", "w")
+        fs.write(fd, b"relative!")
+        fs.close(fd)
+        fs.symlink("data", "/rel/sub/alias")
+        fd = fs.open("/rel/sub/alias", "r")
+        assert fs.read(fd) == b"relative!"
+        fs.close(fd)
+        fs.symlink("sub/data", "/rel/deep")
+        fd = fs.open("/rel/deep", "r")
+        assert fs.read(fd) == b"relative!"
+        fs.close(fd)

@@ -1,0 +1,157 @@
+"""rbd CLI + radosgw-admin + ceph df/osd-df panels on a live cluster
+(reference src/tools/rbd, src/rgw/rgw_admin.cc, src/ceph.in)."""
+
+import json
+import time
+
+import pytest
+
+from ceph_tpu.rgw import RGWService, S3Client
+from ceph_tpu.tools import ceph as ceph_cli
+from ceph_tpu.tools import radosgw_admin
+from ceph_tpu.tools import rbd as rbd_cli
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_mons=1, n_osds=3) as c:
+        yield c
+
+
+@pytest.fixture(scope="module")
+def mon_addr(cluster):
+    return f"127.0.0.1:{cluster.monmap.mons[0].port}"
+
+
+class TestRbdCli:
+    def test_lifecycle(self, mon_addr, capsys, tmp_path):
+        m = ["-m", mon_addr, "-p", "vols"]
+        assert rbd_cli.main(m + ["create", "disk1",
+                                 "--size", str(1 << 20),
+                                 "--order", "16"]) == 0
+        assert rbd_cli.main(m + ["ls"]) == 0
+        assert "disk1" in capsys.readouterr().out
+        assert rbd_cli.main(m + ["info", "disk1"]) == 0
+        info = json.loads(capsys.readouterr().out)
+        assert info["size"] == 1 << 20 and info["order"] == 16
+        assert rbd_cli.main(m + ["resize", "disk1",
+                                 "--size", str(2 << 20)]) == 0
+        # snapshots via the CLI
+        assert rbd_cli.main(m + ["snap", "create",
+                                 "disk1@before"]) == 0
+        assert rbd_cli.main(m + ["snap", "ls", "disk1"]) == 0
+        assert "before" in capsys.readouterr().out
+        # export, mutate, export-at-snap round-trip
+        f1 = str(tmp_path / "img.bin")
+        assert rbd_cli.main(m + ["export", "disk1", f1]) == 0
+        capsys.readouterr()
+        assert rbd_cli.main(m + ["snap", "rm", "disk1@before"]) == 0
+        assert rbd_cli.main(m + ["rm", "disk1"]) == 0
+        assert rbd_cli.main(m + ["ls"]) == 0
+        assert "disk1" not in capsys.readouterr().out
+
+    def test_import_export_roundtrip(self, mon_addr, capsys,
+                                     tmp_path):
+        m = ["-m", mon_addr, "-p", "vols"]
+        src = tmp_path / "payload"
+        src.write_bytes(bytes(range(256)) * 300)
+        assert rbd_cli.main(m + ["import", str(src), "imp"]) == 0
+        out = str(tmp_path / "back")
+        assert rbd_cli.main(m + ["export", "imp", out]) == 0
+        assert open(out, "rb").read() == src.read_bytes()
+        capsys.readouterr()
+
+    def test_bench(self, mon_addr, capsys):
+        m = ["-m", mon_addr, "-p", "vols"]
+        assert rbd_cli.main(m + ["create", "bimg",
+                                 "--size", str(1 << 20),
+                                 "--order", "16"]) == 0
+        assert rbd_cli.main(m + ["bench", "bimg",
+                                 "--io-type", "write",
+                                 "--io-size", "8192",
+                                 "--io-total", str(256 << 10),
+                                 "--seconds", "15"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["bytes"] == 256 << 10
+        assert rep["ops_per_sec"] > 0 and rep["mb_per_sec"] > 0
+        assert rbd_cli.main(m + ["bench", "bimg",
+                                 "--io-type", "read",
+                                 "--io-size", "8192",
+                                 "--io-total", str(256 << 10),
+                                 "--seconds", "15"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["io_type"] == "read" and rep["ops_per_sec"] > 0
+
+
+class TestRadosgwAdmin:
+    @pytest.fixture(scope="class")
+    def gw(self, cluster):
+        r = cluster.rados()
+        gw = RGWService(r).start()
+        s3 = S3Client("127.0.0.1", gw.port)
+        yield s3
+        gw.shutdown()
+        r.shutdown()
+
+    def test_bucket_admin(self, gw, mon_addr, capsys):
+        gw.make_bucket("adm")
+        gw.put("adm", "k1", b"x" * 100)
+        gw.put("adm", "k2", b"y" * 50)
+        m = ["-m", mon_addr]
+        assert radosgw_admin.main(m + ["bucket", "list"]) == 0
+        assert "adm" in capsys.readouterr().out
+        assert radosgw_admin.main(
+            m + ["bucket", "stats", "--bucket", "adm"]) == 0
+        stats = json.loads(capsys.readouterr().out)
+        assert stats["usage"]["num_objects"] == 2
+        assert stats["usage"]["size"] == 150
+        # refuse rm while non-empty
+        assert radosgw_admin.main(
+            m + ["bucket", "rm", "--bucket", "adm"]) == 2
+        capsys.readouterr()
+        assert radosgw_admin.main(
+            m + ["object", "rm", "--bucket", "adm",
+                 "--object", "k1"]) == 0
+        assert radosgw_admin.main(
+            m + ["bucket", "rm", "--bucket", "adm",
+                 "--purge-objects"]) == 0
+        assert radosgw_admin.main(m + ["bucket", "list"]) == 0
+        assert "adm" not in capsys.readouterr().out
+
+    def test_purge_versioned_bucket(self, gw, mon_addr, capsys):
+        gw.make_bucket("vadm")
+        gw.set_versioning("vadm")
+        gw.put_versioned("vadm", "doc", b"v1")
+        gw.put_versioned("vadm", "doc", b"v2")
+        gw.delete("vadm", "doc")      # delete marker
+        m = ["-m", mon_addr]
+        assert radosgw_admin.main(
+            m + ["bucket", "rm", "--bucket", "vadm",
+                 "--purge-objects"]) == 0
+        assert radosgw_admin.main(m + ["bucket", "list"]) == 0
+        assert "vadm" not in capsys.readouterr().out
+
+
+class TestCephDf:
+    def test_df_and_osd_df(self, cluster, mon_addr, capsys):
+        r = cluster.rados()
+        try:
+            r.create_pool("dfp", pg_num=4)
+            io = r.open_ioctx("dfp")
+            for i in range(5):
+                io.write_full(f"d{i}", b"q" * 1000)
+            cluster.wait_for_clean()
+            time.sleep(1.6)        # next stats tick carries bytes
+            assert ceph_cli.main(["-m", mon_addr, "df"]) == 0
+            out = capsys.readouterr().out
+            assert "dfp" in out
+            row = [ln for ln in out.splitlines() if "dfp" in ln][0]
+            assert "5" in row.split() and "5000" in row.split()
+            assert ceph_cli.main(["-m", mon_addr, "osd", "df"]) == 0
+            out = capsys.readouterr().out
+            assert "PGS" in out
+            assert ceph_cli.main(["-m", mon_addr, "-s"]) == 0
+            assert "health:" in capsys.readouterr().out
+        finally:
+            r.shutdown()

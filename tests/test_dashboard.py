@@ -1,0 +1,77 @@
+"""mgr dashboard REST API + HTML page on a live cluster (reference
+src/pybind/mgr/dashboard controllers, read-side subset)."""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def dash():
+    with MiniCluster(n_mons=1, n_osds=3) as c:
+        c.start_mgr("d")
+        c.wait_for_active_mgr()
+        r = c.rados()
+        r.create_pool("p", pg_num=8)
+        io = r.open_ioctx("p")
+        for i in range(6):
+            io.write_full(f"o{i}", b"x" * 500)
+        c.wait_for_clean()
+        deadline = time.monotonic() + 15
+        mod = None
+        while time.monotonic() < deadline:
+            mod = c.mgrs["d"].modules.get("dashboard")
+            if mod is not None:
+                break
+            time.sleep(0.1)
+        assert mod is not None, "dashboard module never started"
+        time.sleep(1.5)           # one stats tick for pool bytes
+        yield c, mod.port
+        r.shutdown()
+
+
+def _get(port, path):
+    con = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        con.request("GET", path)
+        resp = con.getresponse()
+        return resp.status, resp.read()
+    finally:
+        con.close()
+
+
+def test_api_health_and_summary(dash):
+    _, port = dash
+    st, body = _get(port, "/api/health")
+    assert st == 200
+    h = json.loads(body)
+    assert h["status"] in ("HEALTH_OK", "HEALTH_WARN")
+    st, body = _get(port, "/api/summary")
+    s = json.loads(body)
+    assert s["num_osds"] == 3
+
+
+def test_api_osd_pool_pg(dash):
+    _, port = dash
+    st, body = _get(port, "/api/osd")
+    assert st == 200 and len(json.loads(body)) == 3
+    st, body = _get(port, "/api/pool")
+    pools = json.loads(body)
+    row = next(p for p in pools if p["name"] == "p")
+    assert row["objects"] == 6 and row["bytes_used"] == 3000
+    st, body = _get(port, "/api/pg")
+    pg = json.loads(body)
+    assert pg["num_pgs"] >= 8 and "states" in pg
+
+
+def test_html_page_and_404(dash):
+    _, port = dash
+    st, body = _get(port, "/")
+    assert st == 200
+    assert b"Cluster status" in body and b"HEALTH_" in body
+    st, body = _get(port, "/api/nope")
+    assert st == 404

@@ -35,6 +35,11 @@ class RadosModel:
         self.verifies = 0
         self.allow_append = allow_append
 
+    # ceph_test_rados runs with NO op timeout — ops simply block while
+    # a PG is below min_size and complete when it reactivates.  30s
+    # comfortably covers a kill/revive/re-peer cycle.
+    OP_TIMEOUT = 30.0
+
     def _oid(self) -> str:
         return f"obj{self.rng.randrange(self.OBJECTS)}"
 
@@ -51,15 +56,19 @@ class RadosModel:
         self.ops += 1
         if choice < 0.45:
             data = self._payload()
-            self.io.write_full(oid, data)
+            self.io._sync(oid, [{"op": "write_full",
+                                 "data": data.hex()}],
+                          timeout=self.OP_TIMEOUT)
             self.model[oid] = data
         elif choice < 0.60 and self.allow_append:
             data = self._payload()
-            self.io.append(oid, data)
+            self.io._sync(oid, [{"op": "append", "data": data.hex()}],
+                          timeout=self.OP_TIMEOUT)
             self.model[oid] = self.model.get(oid, b"") + data
         elif choice < 0.75:
             try:
-                self.io.remove(oid)
+                self.io._sync(oid, [{"op": "delete"}],
+                              timeout=self.OP_TIMEOUT)
             except ObjectNotFound:
                 assert oid not in self.model, \
                     f"{oid}: cluster lost an object the model has"
@@ -70,7 +79,9 @@ class RadosModel:
     def verify_one(self, oid: str):
         self.verifies += 1
         try:
-            got = self.io.read(oid)
+            results, _ = self.io._sync(oid, [{"op": "read", "off": 0}],
+                                       timeout=self.OP_TIMEOUT)
+            got = bytes.fromhex(results[0]["data"])
         except ObjectNotFound:
             assert oid not in self.model, \
                 f"{oid}: exists in model ({len(self.model[oid])}B) " \
@@ -172,14 +183,15 @@ def test_model_ops_survive_thrashing(thrash_cluster):
 
 
 def test_model_ops_ec_pool_thrashed(thrash_cluster):
-    """Same audit on an EC pool (k=2,m=1): write-once objects (EC
-    appends go through the RMW path; keep the op mix aligned with
-    what the pool supports under thrash)."""
+    """Same audit on an EC pool (k=2,m=2 — the config the reference
+    thrashes: min_size=k+1=3, so a single failure keeps the PG
+    writable; m=1 under a 2s kill cadence starves writes by design
+    because EC writes refuse to ack below min_size)."""
     c = thrash_cluster
     r = c.rados()
     rc, outs, _ = r.mon_command({
         "prefix": "osd erasure-code-profile set", "name": "thrashec",
-        "profile": ["k=2", "m=1", "plugin=jerasure"]})
+        "profile": ["k=2", "m=2", "plugin=jerasure"]})
     assert rc == 0, outs
     r.create_pool("thrashec", pg_num=4, pool_type="erasure",
                   erasure_code_profile="thrashec")
