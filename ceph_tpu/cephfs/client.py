@@ -210,11 +210,11 @@ class CephFS(Dispatcher):
 
     # -- path resolution ---------------------------------------------------
     def _resolve_dir(self, parts: list[str],
-                     _hops: int = 0) -> int:
+                     _hops: int = 0, base: int = ROOT_INO) -> int:
         """Walk to the directory holding parts[-1]; → its ino.
         Directory symlinks in intermediate components are followed
         (POSIX resolution; bounded: ELOOP)."""
-        ino = ROOT_INO
+        ino = base
         i = 0
         while i < len(parts) - 1:
             name = parts[i]
@@ -328,13 +328,10 @@ class CephFS(Dispatcher):
             if not parts:
                 raise CephFSError(-21, "/ is a directory")
             base = ROOT_INO if target.startswith("/") else dino
-            for comp in parts[:-1]:
-                step = self._lookup(base, comp)
-                if step["type"] != "dir":
-                    raise CephFSError(-20,
-                                      f"{comp!r} is not a directory")
-                base = step["ino"]
-            dino, name = base, parts[-1]
+            # _resolve_dir follows directory symlinks in the target's
+            # intermediate components too (POSIX resolution)
+            dino = self._resolve_dir(parts, _hops=hops, base=base)
+            name = parts[-1]
 
     def symlink(self, target: str, path: str):
         """Create a symbolic link at `path` pointing to `target`
@@ -389,9 +386,13 @@ class CephFS(Dispatcher):
             raise CephFSError(-21, "/ is a directory")
         dino = self._resolve_dir(parts)
         name = parts[-1]
-        # follow symlinks for EVERY open mode — a write through a
-        # link must land on the target, not on the link's own inode
-        dino, name = self._follow_symlinks(dino, name)
+        if flags != "x":
+            # follow symlinks for read/write/append — a write through
+            # a link must land on the target, not on the link's own
+            # inode.  O_CREAT|O_EXCL ('x') must NOT follow: POSIX
+            # requires EEXIST when the final component is a symlink,
+            # even a dangling one
+            dino, name = self._follow_symlinks(dino, name)
         if flags in ("w", "a", "x"):
             lay = layout or self.default_layout
             args = {"dir": dino, "name": name,

@@ -495,10 +495,12 @@ class MDSDaemon(Dispatcher):
         dino, name = args["dir"], args["name"]
         existing = self._dir(dino).get(name)
         if existing is not None:
+            if args.get("excl"):
+                # O_CREAT|O_EXCL: EEXIST whatever the dentry is —
+                # including a (possibly dangling) symlink (POSIX)
+                return -17, f"{name!r} exists", None
             if existing["type"] != "file":
                 return -21, f"{name!r} is a directory", None
-            if args.get("excl"):
-                return -17, f"{name!r} exists", None
             return 0, "", self._resolve_rec(existing)
         ino, extra = self._alloc_ino()
         rec = {"ino": ino, "type": "file", "size": 0, "mtime": _now()}
@@ -536,21 +538,26 @@ class MDSDaemon(Dispatcher):
         if rec["type"] == "dir":
             return -21, f"{name!r} is a directory", None
         if rec.get("remote"):
-            row = dict(self._inode_row(rec["ino"]) or {"nlink": 1})
-            nlink = int(row.get("nlink", 1)) - 1
-            if nlink > 0:
-                row["nlink"] = nlink
-                return self._mutate([["rm", dino, name],
-                                     ["iset", rec["ino"], row]],
-                                    client, tid)
-            rc = self._mutate([["rm", dino, name],
-                               ["irm", rec["ino"]]], client, tid)
-            self._purge_file(dict(rec, **row))
+            subs, purge_rec = self._drop_remote_link(rec)
+            rc = self._mutate([["rm", dino, name]] + subs, client, tid)
+            if purge_rec is not None:
+                self._purge_file(purge_rec)
             return rc
         rc = self._mutate([["rm", dino, name]], client, tid)
         if rec["type"] == "file":
             self._purge_file(rec)
         return rc
+
+    def _drop_remote_link(self, rec: dict):
+        """One link to a shared inode goes away: → (journal subs,
+        purge_rec-or-None) — shared by unlink and rename-overwrite so
+        the nlink bookkeeping cannot diverge between them."""
+        row = dict(self._inode_row(rec["ino"]) or {"nlink": 1})
+        nlink = int(row.get("nlink", 1)) - 1
+        if nlink > 0:
+            row["nlink"] = nlink
+            return [["iset", rec["ino"], row]], None
+        return [["irm", rec["ino"]]], dict(rec, **row)
 
     def _op_link(self, args, client, tid):
         """Hard link: args {tdir, tname} (existing file) + {dir, name}
@@ -651,15 +658,8 @@ class MDSDaemon(Dispatcher):
         purge_rec = None
         if purge is not None:
             if purge.get("remote"):
-                row = dict(self._inode_row(purge["ino"]) or
-                           {"nlink": 1})
-                nlink = int(row.get("nlink", 1)) - 1
-                if nlink > 0:
-                    row["nlink"] = nlink
-                    subs.append(["iset", purge["ino"], row])
-                else:
-                    subs.append(["irm", purge["ino"]])
-                    purge_rec = dict(purge, **row)
+                extra, purge_rec = self._drop_remote_link(purge)
+                subs.extend(extra)
             elif purge["type"] == "file":
                 purge_rec = purge
         rc = self._mutate(subs, client, tid, rec)

@@ -1679,6 +1679,16 @@ class ECBackend:
         # two in-flight ops on one object could complete out of order
         # and leave the primary's shard at the older bytes
         self._rmw[oid] = []
+        try:
+            self._submit_gated(msg, reqid, oid)
+        except Exception as e:   # noqa: BLE001 — a poisoned op (bad
+            # op kind, encode failure) must release the gate and fail
+            # the op, not wedge every later write to this object
+            self._release_rmw(oid)
+            pg._reply(msg, -22, f"write failed: {e!r}")
+
+    def _submit_gated(self, msg: M.MOSDOp, reqid: str, oid: str):
+        pg = self.pg
         exists = self._read_local_meta(oid) is not None
         kinds = [op.get("op") for op in msg.ops]
         needs_old = exists and any(k in ("write", "append", "truncate")

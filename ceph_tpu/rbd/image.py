@@ -77,6 +77,42 @@ class RBD:
              ) -> "Image":
         return Image(ioctx, name, snapshot=snapshot)
 
+    def clone(self, ioctx, parent: str, snap_name: str, child: str):
+        """COW child image from a protected parent snapshot
+        (reference ``librbd::clone``): the child starts empty — reads
+        of unwritten objects fall through to parent@snap; the first
+        write to an object copies it up (reference copyup)."""
+        with Image(ioctx, parent, read_only=True) as p:
+            snap = p._hdr["snaps"].get(snap_name)
+            if snap is None:
+                raise ImageNotFound(f"no snapshot {snap_name!r}")
+            if not snap.get("protected"):
+                raise ValueError(
+                    f"snapshot {snap_name!r} is not protected "
+                    "(clone requires protection so the parent data "
+                    "cannot vanish under the child)")
+            self.create(ioctx, child, snap["size"],
+                        order=p._hdr["order"],
+                        stripe_unit=p._hdr["stripe_unit"],
+                        stripe_count=p._hdr["stripe_count"])
+        with Image(ioctx, child) as c:
+            c._hdr["parent"] = {"image": parent, "snap": snap_name,
+                                "snap_id": snap["id"],
+                                "overlap": snap["size"]}
+            c._save_header()
+        with Image(ioctx, parent, read_only=True) as p:
+            kids = p._hdr["snaps"][snap_name].setdefault(
+                "children", [])
+            if child not in kids:
+                kids.append(child)
+            p._save_header()
+
+    def children(self, ioctx, parent: str, snap_name: str
+                 ) -> list[str]:
+        with Image(ioctx, parent, read_only=True) as p:
+            snap = p._hdr["snaps"].get(snap_name)
+            return list((snap or {}).get("children", []))
+
     def list(self, ioctx) -> list[str]:
         pre = "rbd_header."
         return sorted(o[len(pre):] for o in ioctx.list_objects()
@@ -294,10 +330,33 @@ class Image:
             "id": self._hdr["snap_seq"], "size": self._hdr["size"]}
         self._save_header()
 
+    def protect_snap(self, snap_name: str):
+        """Required before cloning (reference snap protect)."""
+        self._require_writable()
+        snap = self._hdr["snaps"].get(snap_name)
+        if snap is None:
+            raise ImageNotFound(f"no snapshot {snap_name!r}")
+        snap["protected"] = True
+        self._save_header()
+
+    def unprotect_snap(self, snap_name: str):
+        self._require_writable()
+        snap = self._hdr["snaps"].get(snap_name)
+        if snap is None:
+            raise ImageNotFound(f"no snapshot {snap_name!r}")
+        if snap.get("children"):
+            raise ValueError(
+                f"snapshot has children: {snap['children']} "
+                "(flatten them first)")
+        snap["protected"] = False
+        self._save_header()
+
     def remove_snap(self, snap_name: str):
         self._require_writable()
         if snap_name not in self._hdr["snaps"]:
             raise ImageNotFound(f"no snapshot {snap_name!r}")
+        if self._hdr["snaps"][snap_name].get("protected"):
+            raise ValueError(f"snapshot {snap_name!r} is protected")
         self._journal_append({"op": "snap_remove", "name": snap_name})
         self._hdr["snaps"].pop(snap_name)
         self._save_header()
@@ -386,6 +445,87 @@ class Image:
         except Exception:
             return b""
 
+    # -- clone / parent fall-through --------------------------------------
+    def _parent_covers(self, objno: int) -> bool:
+        """Cheap (no I/O) test: does the parent overlap back any byte
+        of this child object?"""
+        parent = self._hdr.get("parent")
+        if parent is None:
+            return False
+        lay = self.layout
+        sc = lay.stripe_count
+        su = lay.stripe_unit
+        su_per_object = lay.object_size // su
+        # first logical byte an object holds: its first stripe unit
+        objectsetno, stripepos = objno // sc, objno % sc
+        first_stripeno = objectsetno * su_per_object
+        first_logical = (first_stripeno * sc + stripepos) * su
+        return first_logical < parent["overlap"]
+
+    def _parent_bytes(self, objno: int) -> bytes | None:
+        """The parent@snap bytes backing this child object, laid out
+        in the OBJECT's internal order, or None when no parent covers
+        it (reference: reads below the overlap fall through the parent
+        chain).  Stripe-aware: with stripe_count > 1 an object holds
+        interleaved stripe units from non-contiguous logical ranges,
+        so each unit is fetched at its own logical offset."""
+        if not self._parent_covers(objno):
+            return None
+        parent = self._hdr.get("parent")
+        lay = self.layout
+        sc, su = lay.stripe_count, lay.stripe_unit
+        su_per_object = lay.object_size // su
+        objectsetno, stripepos = objno // sc, objno % sc
+        out = bytearray()
+        with Image(self.ioctx, parent["image"],
+                   snapshot=parent["snap"]) as p:
+            for u in range(su_per_object):
+                stripeno = objectsetno * su_per_object + u
+                logical = (stripeno * sc + stripepos) * su
+                if logical >= parent["overlap"]:
+                    break
+                n = min(su, parent["overlap"] - logical)
+                piece = p.read(logical, n)
+                out.extend(piece)
+                if len(piece) < su:
+                    break
+        return bytes(out) if out else None
+
+    def _copy_up(self, objno: int):
+        """First write to a parent-backed object copies the parent
+        bytes into the child first (reference copyup)."""
+        if self._hdr.get("parent") is None:
+            return
+        oid = _data_oid(self.name, objno)
+        try:
+            self.ioctx.stat(oid)
+            return              # child already owns this object
+        except Exception:
+            pass
+        base = self._parent_bytes(objno)
+        if base:
+            self.ioctx.write_full(oid, base)
+
+    def flatten(self):
+        """Copy all parent-backed data into the child and detach it
+        (reference ``rbd flatten``)."""
+        self._require_writable()
+        parent = self._hdr.get("parent")
+        if parent is None:
+            return
+        nobj = -(-parent["overlap"] // self.layout.object_size)
+        for objno in range(nobj):
+            self._copy_up(objno)
+        with Image(self.ioctx, parent["image"]) as p:
+            snap = p._hdr["snaps"].get(parent["snap"])
+            if snap is not None:
+                kids = snap.get("children", [])
+                if self.name in kids:
+                    kids.remove(self.name)
+                p._save_header()
+        self._hdr.pop("parent", None)
+        self._save_header()
+
     # -- data path ------------------------------------------------------------
     def write(self, offset: int, data: bytes) -> int:
         self._require_writable()
@@ -394,6 +534,7 @@ class Image:
         self._journal_append({"op": "write", "off": offset,
                               "data": data.hex()})
         for ext in file_to_extents(self.layout, offset, len(data)):
+            self._copy_up(ext.object_no)
             self._cow_preserve(ext.object_no)
             lo = ext.logical_offset - offset
             self.ioctx.write(_data_oid(self.name, ext.object_no),
@@ -409,12 +550,14 @@ class Image:
         for ext in file_to_extents(self.layout, offset, length):
             if self.snap_id is not None:
                 obj = self._read_object_at_snap(ext.object_no)
+                if not obj:
+                    obj = self._parent_bytes(ext.object_no) or b""
             else:
                 try:
                     obj = self.ioctx.read(
                         _data_oid(self.name, ext.object_no))
                 except Exception:
-                    obj = b""
+                    obj = self._parent_bytes(ext.object_no) or b""
             piece = obj[ext.offset:ext.offset + ext.length]
             lo = ext.logical_offset - offset
             out[lo:lo + len(piece)] = piece
@@ -427,12 +570,19 @@ class Image:
                               "len": length})
         for ext in file_to_extents(self.layout, offset, length):
             oid = _data_oid(self.name, ext.object_no)
-            if ext.offset == 0 and ext.length == self.layout.object_size:
+            parent_backed = self._parent_covers(ext.object_no)
+            if ext.offset == 0 and \
+                    ext.length == self.layout.object_size and \
+                    not parent_backed:
                 self._cow_preserve(ext.object_no)
                 try:
                     self.ioctx.remove(oid)
                 except Exception:
                     pass
             else:
+                # parent-backed objects must be ZEROED, not removed —
+                # removal would resurrect the parent bytes on read
+                if parent_backed:
+                    self._copy_up(ext.object_no)
                 self._cow_preserve(ext.object_no)
                 self.ioctx.write(oid, b"\x00" * ext.length, ext.offset)

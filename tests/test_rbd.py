@@ -134,3 +134,66 @@ class TestImage:
         leftovers = [o for o in io.list_objects()
                      if o.startswith("rbd_data.gcimg.") and "@" in o]
         assert leftovers == []
+
+
+class TestClone:
+    def test_clone_cow_and_flatten(self, rbd_cluster):
+        _c, _r, io = rbd_cluster
+        rbd = RBD()
+        rbd.create(io, "base", 1 << 18, order=16)
+        with Image(io, "base") as p:
+            p.write(0, b"parentdata" * 100)
+            p.write(70000, b"tail")
+            p.create_snap("gold")
+            # clone requires protection
+            with pytest.raises(ValueError, match="not protected"):
+                rbd.clone(io, "base", "gold", "childX")
+            p.protect_snap("gold")
+        rbd.clone(io, "base", "gold", "child")
+        assert rbd.children(io, "base", "gold") == ["child"]
+        with Image(io, "child") as c:
+            # unwritten objects fall through to parent@snap
+            assert c.read(0, 10) == b"parentdata"
+            assert c.read(70000, 4) == b"tail"
+            # copy-up: a partial write preserves surrounding parent bytes
+            c.write(4, b"XY")
+            assert c.read(0, 10) == b"pareXYdata"
+        # parent unchanged, and parent writes after the snap are
+        # invisible to the child
+        with Image(io, "base") as p:
+            assert p.read(0, 10) == b"parentdata"
+            p.write(0, b"NEWPARENT!")
+        with Image(io, "child") as c:
+            assert c.read(0, 10) == b"pareXYdata"
+            # object 1 (65536..) holds zeros before b"tail"@70000
+            assert c.read(65536, 4) == b"\x00\x00\x00\x00"
+        # snapshot can't be removed/unprotected while children exist
+        with Image(io, "base") as p:
+            with pytest.raises(ValueError, match="protected"):
+                p.remove_snap("gold")
+            with pytest.raises(ValueError, match="children"):
+                p.unprotect_snap("gold")
+        # flatten detaches; child keeps its bytes standalone
+        with Image(io, "child") as c:
+            c.flatten()
+            assert c.read(0, 10) == b"pareXYdata"
+            assert c.read(70000, 4) == b"tail"
+        assert rbd.children(io, "base", "gold") == []
+        with Image(io, "base") as p:
+            p.unprotect_snap("gold")
+            p.remove_snap("gold")
+
+    def test_clone_discard_zeroes_not_resurrects(self, rbd_cluster):
+        _c, _r, io = rbd_cluster
+        rbd = RBD()
+        rbd.create(io, "base2", 1 << 17, order=16)
+        with Image(io, "base2") as p:
+            p.write(0, b"Z" * (1 << 16))
+            p.create_snap("s")
+            p.protect_snap("s")
+        rbd.clone(io, "base2", "s", "c2")
+        with Image(io, "c2") as c:
+            c.discard(0, 1 << 16)
+            # removing the object would resurrect parent bytes; a
+            # correct discard reads back zeros
+            assert c.read(0, 100) == b"\x00" * 100

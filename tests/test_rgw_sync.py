@@ -1,0 +1,86 @@
+"""RGW multisite sync e2e: two zones (clusters), master→secondary
+(reference src/rgw/rgw_data_sync.cc at slice scale)."""
+
+import time
+
+import pytest
+
+from ceph_tpu.rgw import RGWService, S3Client
+from ceph_tpu.rgw.sync import RGWSyncDaemon
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def zones():
+    with MiniCluster(n_mons=1, n_osds=2) as a, \
+            MiniCluster(n_mons=1, n_osds=2) as b:
+        ra, rb = a.rados(), b.rados()
+        gw = RGWService(ra).start()          # master zone gateway
+        s3 = S3Client("127.0.0.1", gw.port)
+        daemon = RGWSyncDaemon(ra, rb, interval=0.1)
+        yield s3, daemon
+        gw.shutdown()
+        ra.shutdown()
+        rb.shutdown()
+
+
+def test_objects_replicate_and_converge(zones):
+    s3, d = zones
+    s3.make_bucket("docs")
+    s3.put("docs", "a.txt", b"alpha")
+    s3.put("docs", "b.bin", b"B" * 50000)
+    assert d.sync_once() >= 2
+    assert d.secondary.get_object("docs", "a.txt")[0] == b"alpha"
+    assert d.secondary.get_object("docs", "b.bin")[0] == b"B" * 50000
+    # idempotent: unchanged objects move no data
+    assert d.sync_once() == 0
+    # update propagates (ETag diff)
+    s3.put("docs", "a.txt", b"alpha-v2")
+    assert d.sync_once() == 1
+    assert d.secondary.get_object("docs", "a.txt")[0] == b"alpha-v2"
+    # delete propagates
+    s3.delete("docs", "b.bin")
+    assert d.sync_once() == 1
+    assert "b.bin" not in d.secondary.list_objects("docs")
+
+
+def test_background_daemon_and_bucket_delete(zones):
+    s3, d = zones
+    s3.make_bucket("tmp")
+    s3.put("tmp", "x", b"payload")
+    d.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                if d.secondary.get_object("tmp", "x")[0] == b"payload":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("object never replicated")
+        # bucket deletion propagates
+        s3.delete("tmp", "x")
+        s3.delete("tmp")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if "tmp" not in d.secondary.list_buckets():
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("bucket delete never replicated")
+    finally:
+        d.stop()
+
+
+def test_multipart_object_replicates(zones):
+    s3, d = zones
+    s3.make_bucket("mp")
+    _, uid = s3.initiate_multipart("mp", "big")
+    s3.put_part("mp", "big", uid, 1, b"P" * 70000)
+    s3.put_part("mp", "big", uid, 2, b"Q" * 100)
+    s3.complete_multipart("mp", "big", uid)
+    d.sync_once()
+    got = d.secondary.get_object("mp", "big")[0]
+    assert got == b"P" * 70000 + b"Q" * 100

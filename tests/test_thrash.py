@@ -211,5 +211,8 @@ def test_model_ops_ec_pool_thrashed(thrash_cluster):
             c.revive_osd(i)
     c.wait_for_clean(timeout=60.0)
     model.verify_all()
-    assert model.ops > 50
+    # primary-applies-last adds a full fan-out round trip per
+    # write and kill windows stall ops ~3-4s each — the op
+    # count is a liveness floor, not a throughput benchmark
+    assert model.ops > 30
     r.shutdown()
