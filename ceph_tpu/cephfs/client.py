@@ -68,8 +68,11 @@ class CephFS(Dispatcher):
         self.data: IoCtx | None = None
         self.fsmap = FSMap()
         self.fscid = -1
-        self._mds_con = None
+        self._mds_cons: dict[int, object] = {}
         self._lock = threading.Lock()
+        # ino → owning MDS rank (subtree partition by top-level dir;
+        # populated as paths resolve — rank 0 owns the root)
+        self._owner: dict[int, int] = {ROOT_INO: 0}
         self._tid = 0
         self._waiters: dict[int, tuple[threading.Event, list]] = {}
         self._dcache: dict[tuple[int, str], dict] = {}
@@ -99,7 +102,7 @@ class CephFS(Dispatcher):
         self.rados = Rados(self.monmap,
                            name=f"{self.entity}-data").connect()
         self.data = IoCtx(self.rados, fs.data_pool, "")
-        self._connect_mds(timeout)
+        self._connect_mds(timeout, rank=0)
         self.mounted = True
         return self
 
@@ -110,12 +113,13 @@ class CephFS(Dispatcher):
                 self.close(fd)
             except (CephFSError, TimeoutError, ConnectionError):
                 pass
-        if self._mds_con is not None:
+        for con in list(self._mds_cons.values()):
             try:
-                self._mds_con.send_message(M.MClientSession(
+                con.send_message(M.MClientSession(
                     op="request_close", client=self.entity, seq=0))
             except ConnectionError:
                 pass
+        self._mds_cons.clear()
         if self.rados is not None:
             self.rados.shutdown()
             self.rados = None
@@ -126,28 +130,52 @@ class CephFS(Dispatcher):
         with self._lock:
             self.fsmap = FSMap.from_dict(fsmap_dict)
 
-    def _connect_mds(self, timeout: float = 20.0):
+    def _connect_mds(self, timeout: float = 20.0, rank: int = 0):
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                active = self.fsmap.active_for(self.fscid)
+                active = self.fsmap.active_for(self.fscid, rank)
             if active is not None:
                 try:
                     con = self.msgr.connect_to(
                         EntityAddr(active.addr[0], active.addr[1]))
                     con.send_message(M.MClientSession(
                         op="request_open", client=self.entity, seq=1))
-                    self._mds_con = con
+                    self._mds_cons[rank] = con
                     return
                 except (ConnectionError, OSError):
                     pass
             time.sleep(0.1)
-        raise TimeoutError("could not reach an active MDS")
+        raise TimeoutError(f"could not reach active MDS rank {rank}")
+
+    def _max_mds(self) -> int:
+        fs = self.fsmap.filesystems.get(self.fscid)
+        return max(1, fs.max_mds) if fs is not None else 1
+
+    def _rank_of_dir(self, dino: int) -> int:
+        """The rank owning ops INSIDE directory `dino` (ranks
+        partition by top-level directory; root itself is rank 0)."""
+        return self._owner.get(dino, 0) % self._max_mds()
+
+    def _note_child(self, parent_ino: int, name: str, child_ino: int):
+        """Record subtree ownership as paths resolve: a top-level
+        directory starts its own subtree (crc32 % max_mds); deeper
+        entries inherit."""
+        import zlib
+        if parent_ino == ROOT_INO:
+            self._owner[child_ino] = \
+                zlib.crc32(name.encode()) % self._max_mds()
+        else:
+            self._owner[child_ino] = self._owner.get(parent_ino, 0)
 
     # -- RPC ---------------------------------------------------------------
-    def _request(self, op: str, args: dict, timeout: float = 20.0):
-        """Send one metadata op; survive MDS failover by re-resolving
-        the active and resending under the same tid."""
+    def _request(self, op: str, args: dict, timeout: float = 20.0,
+                 rank: int | None = None):
+        """Send one metadata op to its subtree's rank; survive MDS
+        failover by re-resolving the active and resending under the
+        same tid."""
+        if rank is None:
+            rank = self._rank_of_dir(args.get("dir", ROOT_INO))
         with self._lock:
             self._tid += 1
             tid = self._tid
@@ -157,17 +185,18 @@ class CephFS(Dispatcher):
                                args=args)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            con = self._mds_con
+            con = self._mds_cons.get(rank)
             try:
                 if con is None:
                     raise ConnectionError("no mds session")
                 con.send_message(msg)
             except (ConnectionError, OSError):
-                self._mds_con = None
+                self._mds_cons.pop(rank, None)
                 self._dcache.clear()
                 try:
                     self._connect_mds(
-                        max(0.2, deadline - time.monotonic()))
+                        max(0.2, deadline - time.monotonic()),
+                        rank=rank)
                 except TimeoutError:
                     break
                 continue
@@ -180,17 +209,17 @@ class CephFS(Dispatcher):
                         self._waiters[tid] = (ev, box)
                         box.clear()
                         ev.clear()
-                    self._mds_con = None
+                    self._mds_cons.pop(rank, None)
                     continue
                 if reply.rc != 0:
                     raise CephFSError(reply.rc, reply.outs or "")
                 return reply.result
             # silence: connection may be dead (killed MDS) — probe it
             if con is not None and not con.is_connected:
-                self._mds_con = None
+                self._mds_cons.pop(rank, None)
         with self._lock:
             self._waiters.pop(tid, None)
-        raise TimeoutError(f"mds op {op} timed out")
+        raise TimeoutError(f"mds op {op} timed out (rank {rank})")
 
     def ms_dispatch(self, msg) -> bool:
         if isinstance(msg, M.MClientReply):
@@ -205,8 +234,9 @@ class CephFS(Dispatcher):
         return False
 
     def ms_handle_reset(self, con):
-        if con is self._mds_con:
-            self._mds_con = None
+        for rank, c in list(self._mds_cons.items()):
+            if c is con:
+                self._mds_cons.pop(rank, None)
 
     # -- path resolution ---------------------------------------------------
     def _resolve_dir(self, parts: list[str],
@@ -248,6 +278,7 @@ class CephFS(Dispatcher):
             # keeps linked inodes coherent; we re-fetch instead)
             rec = self._request("lookup", {"dir": dino, "name": name})
             self._dcache[key] = rec
+        self._note_child(dino, name, rec["ino"])
         return rec
 
     def _resolve(self, path: str) -> tuple[int, str, dict]:
@@ -267,6 +298,7 @@ class CephFS(Dispatcher):
         dino = self._resolve_dir(parts)
         rec = self._request("mkdir", {"dir": dino, "name": parts[-1]})
         self._dcache[(dino, parts[-1])] = rec
+        self._note_child(dino, parts[-1], rec["ino"])
 
     def mkdirs(self, path: str):
         parts = _split(path)
@@ -358,6 +390,8 @@ class CephFS(Dispatcher):
             raise CephFSError(-22, "cannot link /")
         tdino = self._resolve_dir(sparts)
         ddino = self._resolve_dir(dparts)
+        if self._rank_of_dir(tdino) != self._rank_of_dir(ddino):
+            raise CephFSError(-18, "hard link across MDS subtrees")
         self._request("link", {
             "tdir": tdino, "tname": sparts[-1],
             "dir": ddino, "name": dparts[-1]})
@@ -371,9 +405,18 @@ class CephFS(Dispatcher):
             raise CephFSError(-22, "cannot rename /")
         sdino = self._resolve_dir(sparts)
         ddino = self._resolve_dir(dparts)
+        if self._rank_of_dir(sdino) != self._rank_of_dir(ddino):
+            # the two directories live in different MDS subtrees:
+            # cross-rank rename would need the reference Migrator's
+            # distributed transaction — EXDEV, like rename across
+            # mounts (callers fall back to copy+unlink)
+            raise CephFSError(-18, "rename across MDS subtrees")
+        # rename args carry sdir/ddir (no "dir" key), so the rank
+        # must be explicit or _request would default to rank 0
         self._request("rename", {
             "sdir": sdino, "sname": sparts[-1],
-            "ddir": ddino, "dname": dparts[-1]})
+            "ddir": ddino, "dname": dparts[-1]},
+            rank=self._rank_of_dir(sdino))
         self._dcache.pop((sdino, sparts[-1]), None)
         self._dcache.pop((ddino, dparts[-1]), None)
 
@@ -403,6 +446,7 @@ class CephFS(Dispatcher):
                 args["excl"] = True
             rec = self._request("create", args)
             self._dcache[(dino, name)] = rec
+            self._note_child(dino, name, rec["ino"])
             if flags == "w" and rec.get("size", 0):
                 rec = self._truncate_fd_rec(dino, name, rec, 0)
         else:

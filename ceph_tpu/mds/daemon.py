@@ -41,7 +41,10 @@ ROOT_INO = 1
 INO_CHUNK = 128          # inode numbers claimed per journal event
 JHEAD = "mds{rank}_journal"
 INOTABLE = "mds{rank}_inotable"
-INODES = "mds{rank}_inodes"   # multi-link inode rows (size/mtime/nlink)
+INODES = "mds_inodes"   # multi-link inode rows (size/mtime/nlink) —
+# SHARED across ranks: ino spaces are rank-disjoint so rows never
+# collide, and a subtree re-homed by a max_mds change keeps its
+# hard-link state visible to the new owner
 
 
 def dirfrag_oid(ino: int) -> str:
@@ -72,6 +75,7 @@ class MDSDaemon(Dispatcher):
         self.state = "boot"           # boot / standby / active
         self.fsmap = FSMap()
         self.rank = -1
+        self.fscid = -1
         self.addr = None
         self.running = False
         self._beacon_seq = 0
@@ -163,6 +167,25 @@ class MDSDaemon(Dispatcher):
     def _on_fsmap(self, epoch: int, fsmap_dict: dict):
         with self.lock:
             self.fsmap = FSMap.from_dict(fsmap_dict)
+            # subtree ownership is a pure function of max_mds: when it
+            # changes, flush everything journaled to the dirfrags and
+            # drop caches so the NEW owner of any re-homed subtree
+            # reads current state from RADOS (the static-partition
+            # stand-in for the reference Migrator's export flush)
+            if self.state == "active" and self.meta is not None:
+                fs = self.fsmap.filesystems.get(self.fscid)
+                if fs is not None and \
+                        fs.max_mds != getattr(self, "_last_max_mds",
+                                              fs.max_mds):
+                    try:
+                        self._flush(trim=True)
+                    except Exception:   # noqa: BLE001
+                        pass
+                    self._dirs.clear()
+                    if getattr(self, "_inode_cache", None):
+                        self._inode_cache.clear()
+                if fs is not None:
+                    self._last_max_mds = fs.max_mds
             me = self.fsmap.mds_info.get(self.name)
             if me is not None and me.state == STATE_ACTIVE \
                     and self.state != "active":
@@ -187,6 +210,8 @@ class MDSDaemon(Dispatcher):
             self.meta = IoCtx(self.rados, fs.metadata_pool, "")
             self.data = IoCtx(self.rados, fs.data_pool, "")
             self.rank = rank
+            self.fscid = fscid
+            self._last_max_mds = fs.max_mds
             self._dirs.clear()
             self._dirty_set.clear()
             self._dirty_rm.clear()
@@ -225,7 +250,7 @@ class MDSDaemon(Dispatcher):
 
     @property
     def _inodes_oid(self) -> str:
-        return INODES.format(rank=max(self.rank, 0))
+        return INODES
 
     # -- multi-link inode rows --------------------------------------------
     # (reference: a hard link makes the inode shared — the reference
@@ -324,7 +349,11 @@ class MDSDaemon(Dispatcher):
             return 0
 
     def _load_inotable(self):
-        base = max(self._backing_inotable(), ROOT_INO + 1,
+        # rank-disjoint inode number spaces (reference: per-rank
+        # inotables partition a prealloc range): rank r allocates from
+        # r << 40, so two ranks can never mint the same ino
+        rank_base = (max(self.rank, 0) << 40) + ROOT_INO + 1
+        base = max(self._backing_inotable(), rank_base,
                    self._ino_limit)
         self._next_ino = base
         self._ino_limit = base

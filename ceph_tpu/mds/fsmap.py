@@ -9,9 +9,13 @@ way they subscribe to the OSDMap — clients find the active MDS's
 address here, and a beacon timeout triggers the standby promotion that
 drives failover.
 
-Single-rank (max_mds=1) per filesystem: rank 0 owns the whole
-namespace.  Multi-rank subtree partitioning (reference
-``src/mds/Migrator.cc``) is out of scope for this slice.
+Multi-rank (``max_mds`` > 1): the namespace is partitioned by
+TOP-LEVEL directory — rank = crc32(top-level name) % max_mds, rank 0
+owning the root itself (a static form of the reference's subtree
+delegation, ``src/mds/Migrator.cc``; dynamic load-driven migration is
+out of scope).  Clients route each metadata op to its subtree's rank;
+each rank journals its own subtree (per-rank journal/inotable
+objects) and allocates inodes from a rank-disjoint number space.
 """
 
 from __future__ import annotations
@@ -78,13 +82,18 @@ class FSMap:
                 return fs
         return None
 
-    def active_for(self, fscid: int) -> MDSInfo | None:
-        """The rank-0 active MDS of a filesystem, if any."""
+    def active_for(self, fscid: int, rank: int = 0) -> MDSInfo | None:
+        """The active MDS holding `rank` of a filesystem, if any."""
         for info in self.mds_info.values():
-            if info.fscid == fscid and info.rank == 0 \
+            if info.fscid == fscid and info.rank == rank \
                     and info.state == STATE_ACTIVE:
                 return info
         return None
+
+    def actives_for(self, fscid: int) -> dict[int, MDSInfo]:
+        """rank → active MDS for a filesystem."""
+        return {i.rank: i for i in self.mds_info.values()
+                if i.fscid == fscid and i.state == STATE_ACTIVE}
 
     def standbys(self) -> list[MDSInfo]:
         return [i for i in self.mds_info.values()

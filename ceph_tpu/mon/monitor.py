@@ -565,19 +565,24 @@ class MDSMonitor(PaxosService):
 
     @staticmethod
     def _assign_ranks(m: FSMap) -> bool:
-        """Promote standbys into any filesystem missing its rank-0
-        active (the takeover path of reference
-        MDSMonitor::maybe_promote_standby)."""
+        """Promote standbys into every unfilled rank < max_mds of
+        every filesystem (the takeover path of reference
+        MDSMonitor::maybe_promote_standby, multi-rank)."""
         changed = False
         for fs in m.filesystems.values():
-            if m.active_for(fs.fscid) is None:
+            held = m.actives_for(fs.fscid)
+            for rank in range(fs.max_mds):
+                if rank in held:
+                    continue
                 sbs = sorted(m.standbys(), key=lambda i: i.name)
-                if sbs:
-                    sb = sbs[0]
-                    sb.state = STATE_ACTIVE
-                    sb.rank = 0
-                    sb.fscid = fs.fscid
-                    changed = True
+                if not sbs:
+                    break
+                sb = sbs[0]
+                sb.state = STATE_ACTIVE
+                sb.rank = rank
+                sb.fscid = fs.fscid
+                held[rank] = sb
+                changed = True
         return changed
 
     # -- beacons (leader) --------------------------------------------------
@@ -607,7 +612,7 @@ class MDSMonitor(PaxosService):
         # read-only probe first: copying the map 4×/sec in steady
         # state is pointless work
         needs_promotion = any(
-            cur.active_for(fs.fscid) is None
+            len(cur.actives_for(fs.fscid)) < fs.max_mds
             for fs in cur.filesystems.values()) and cur.standbys()
         if not stale and not needs_promotion:
             return
@@ -660,6 +665,32 @@ class MDSMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, f"removed filesystem {cmd['fs_name']!r}", None
+        if prefix == "fs set":
+            fs = self.fsmap.fs_by_name(cmd["fs_name"])
+            if fs is None:
+                return -2, f"no filesystem {cmd['fs_name']!r}", None
+            if cmd.get("var") != "max_mds":
+                return -22, f"unsupported fs var {cmd.get('var')!r}", \
+                    None
+            try:
+                n = int(cmd["val"])
+            except (KeyError, ValueError, TypeError):
+                return -22, "max_mds wants an integer", None
+            if not 1 <= n <= 16:
+                return -22, "max_mds must be in [1, 16]", None
+            m = self._working()
+            m.filesystems[fs.fscid].max_mds = n
+            # shrink: ranks >= n drop back to standby (the reference
+            # stops+deactivates them; clients stop routing there)
+            for info in m.mds_info.values():
+                if info.fscid == fs.fscid and info.rank >= n:
+                    info.state = STATE_STANDBY
+                    info.rank = -1
+                    info.fscid = -1
+            self._assign_ranks(m)
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"max_mds = {n}", None
         if prefix == "fs ls":
             osdmap = self.mon.services["osdmap"].osdmap
             pname = {v: k for k, v in osdmap.pool_name.items()}

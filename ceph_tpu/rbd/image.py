@@ -120,6 +120,15 @@ class RBD:
 
     def remove(self, ioctx, name: str):
         img = Image(ioctx, name)
+        for sname, snap in img._hdr.get("snaps", {}).items():
+            if snap.get("protected") or snap.get("children"):
+                img.close()
+                raise ValueError(
+                    f"image {name!r} has protected snapshot "
+                    f"{sname!r}"
+                    + (f" with children {snap['children']}"
+                       if snap.get("children") else "")
+                    + " — flatten children and unprotect first")
         for o in ioctx.list_objects():
             if o.startswith(f"rbd_data.{name}."):
                 ioctx.remove(o)
@@ -195,6 +204,12 @@ class Image:
     def resize(self, new_size: int):
         self._require_writable()
         self._journal_append({"op": "resize", "size": new_size})
+        parent = self._hdr.get("parent")
+        if parent is not None and new_size < parent["overlap"]:
+            # shrinking a clone clamps the parent overlap: a later
+            # grow must read zeros, never resurrect parent bytes
+            # (reference librbd shrinks the parent overlap the same way)
+            parent["overlap"] = new_size
         old = self._hdr["size"]
         self._hdr["size"] = new_size
         self._save_header()
@@ -513,7 +528,12 @@ class Image:
         parent = self._hdr.get("parent")
         if parent is None:
             return
-        nobj = -(-parent["overlap"] // self.layout.object_size)
+        # exact object set: with striping, an object's logical bytes
+        # are interleaved — derive the covered objects from the layout
+        nobj = 1 + max(
+            (e.object_no for e in
+             file_to_extents(self.layout, 0, parent["overlap"])),
+            default=-1)
         for objno in range(nobj):
             self._copy_up(objno)
         with Image(self.ioctx, parent["image"]) as p:

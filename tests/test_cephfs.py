@@ -334,3 +334,100 @@ class TestSymlinkSemantics:
         fd = fs.open("/rel/deep", "r")
         assert fs.read(fd) == b"relative!"
         fs.close(fd)
+
+
+class TestMultiMDS:
+    def _wait_ranks(self, c, n, timeout=30.0):
+        r = c.rados()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rc, _, out = r.mon_command({"prefix": "mds stat"})
+            if rc == 0 and len(out["up"]) >= n:
+                r.shutdown()
+                return out["up"]
+            time.sleep(0.1)
+        r.shutdown()
+        raise TimeoutError(f"never reached {n} active ranks")
+
+    def test_two_ranks_partition_and_failover(self):
+        with MiniCluster(n_mons=1, n_osds=3) as c:
+            c.fs_new("cephfs")
+            c.start_mds("a")
+            c.start_mds("b")
+            c.start_mds("c")          # standby
+            c.wait_for_active_mds()
+            r = c.rados()
+            rc, outs, _ = r.mon_command({
+                "prefix": "fs set", "fs_name": "cephfs",
+                "var": "max_mds", "val": "2"})
+            assert rc == 0, outs
+            up = self._wait_ranks(c, 2)
+            assert "cephfs:mds.0" in up and "cephfs:mds.1" in up
+
+            fs = c.cephfs("cephfs")
+            # find two top-level dirs owned by DIFFERENT ranks
+            import zlib
+            names = {}
+            for cand in ("alpha", "beta", "gamma", "delta"):
+                names.setdefault(zlib.crc32(cand.encode()) % 2, cand)
+                if len(names) == 2:
+                    break
+            d0, d1 = names[0], names[1]
+            fs.mkdirs(f"/{d0}/sub")
+            fs.mkdirs(f"/{d1}/sub")
+            fs.write_file(f"/{d0}/sub/f", b"rank0 data")
+            fs.write_file(f"/{d1}/sub/f", b"rank1 data")
+            assert fs.read_file(f"/{d0}/sub/f") == b"rank0 data"
+            assert fs.read_file(f"/{d1}/sub/f") == b"rank1 data"
+            # the client really talks to two different MDS daemons
+            assert len(fs._mds_cons) == 2
+            # inode spaces are rank-disjoint
+            st0 = fs.stat(f"/{d0}/sub/f")
+            st1 = fs.stat(f"/{d1}/sub/f")
+            assert (st0["ino"] >> 40) != (st1["ino"] >> 40)
+            # cross-subtree rename is EXDEV (static partition)
+            with pytest.raises(CephFSError):
+                fs.rename(f"/{d0}/sub/f", f"/{d1}/sub/moved")
+
+            # failover: kill rank 1's daemon; the standby takes the
+            # rank and journaled metadata replays
+            up = dict(up)
+            rank1_name = up["cephfs:mds.1"].split(".", 1)[-1]
+            c.kill_mds(rank1_name)
+            self._wait_ranks(c, 2, timeout=30.0)
+            assert fs.read_file(f"/{d1}/sub/f") == b"rank1 data"
+            fs.write_file(f"/{d1}/sub/g", b"post-failover")
+            assert fs.read_file(f"/{d1}/sub/g") == b"post-failover"
+            fs.unmount()
+
+    def test_shrink_back_to_one_rank(self):
+        with MiniCluster(n_mons=1, n_osds=3) as c:
+            c.fs_new("cephfs")
+            c.start_mds("a")
+            c.start_mds("b")
+            c.wait_for_active_mds()
+            r = c.rados()
+            r.mon_command({"prefix": "fs set", "fs_name": "cephfs",
+                           "var": "max_mds", "val": "2"})
+            self._wait_ranks(c, 2)
+            fs = c.cephfs("cephfs")
+            fs.mkdirs("/data")
+            fs.write_file("/data/f", b"before shrink")
+            fs.unmount()
+            c._fs_clients.remove(fs)
+            rc, outs, _ = r.mon_command({
+                "prefix": "fs set", "fs_name": "cephfs",
+                "var": "max_mds", "val": "1"})
+            assert rc == 0, outs
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                rc, _, out = r.mon_command({"prefix": "mds stat"})
+                if len(out["up"]) == 1:
+                    break
+                time.sleep(0.1)
+            r.shutdown()
+            # everything is reachable through the single remaining rank
+            fs2 = c.cephfs("cephfs")
+            assert fs2.read_file("/data/f") == b"before shrink"
+            fs2.write_file("/data/g", b"after shrink")
+            assert fs2.read_file("/data/g") == b"after shrink"

@@ -197,3 +197,38 @@ class TestClone:
             # removing the object would resurrect parent bytes; a
             # correct discard reads back zeros
             assert c.read(0, 100) == b"\x00" * 100
+
+    def test_clone_shrink_regrow_reads_zeros(self, rbd_cluster):
+        """Shrinking a clone clamps the parent overlap — a later grow
+        must read zeros, not resurrect parent bytes (review r3)."""
+        _c, _r, io = rbd_cluster
+        rbd = RBD()
+        rbd.create(io, "base3", 1 << 17, order=16)
+        with Image(io, "base3") as p:
+            p.write(0, b"P" * 1000)
+            p.create_snap("s")
+            p.protect_snap("s")
+        rbd.clone(io, "base3", "s", "c3")
+        with Image(io, "c3") as c:
+            c.resize(0)
+            c.resize(1 << 17)
+            assert c.read(0, 1000) == b"\x00" * 1000
+
+    def test_remove_parent_with_children_refused(self, rbd_cluster):
+        _c, _r, io = rbd_cluster
+        rbd = RBD()
+        rbd.create(io, "base4", 1 << 16, order=16)
+        with Image(io, "base4") as p:
+            p.write(0, b"x")
+            p.create_snap("s")
+            p.protect_snap("s")
+        rbd.clone(io, "base4", "s", "c4")
+        with pytest.raises(ValueError, match="children"):
+            rbd.remove(io, "base4")
+        with Image(io, "c4") as c:
+            c.flatten()
+        with pytest.raises(ValueError, match="protected"):
+            rbd.remove(io, "base4")   # still protected, no children
+        with Image(io, "base4") as p:
+            p.unprotect_snap("s")
+        rbd.remove(io, "base4")
