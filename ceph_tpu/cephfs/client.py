@@ -154,17 +154,19 @@ class CephFS(Dispatcher):
 
     def _rank_of_dir(self, dino: int) -> int:
         """The rank owning ops INSIDE directory `dino` (ranks
-        partition by top-level directory; root itself is rank 0)."""
+        partition by top-level directory; root itself is rank 0).
+        The owner map stores the RAW subtree hash and reduces by the
+        CURRENT max_mds here — a max_mds change instantly re-routes
+        even fd-based ops (fsync/close) that skip path resolution."""
         return self._owner.get(dino, 0) % self._max_mds()
 
     def _note_child(self, parent_ino: int, name: str, child_ino: int):
         """Record subtree ownership as paths resolve: a top-level
-        directory starts its own subtree (crc32 % max_mds); deeper
-        entries inherit."""
+        directory starts its own subtree (raw crc32, reduced at use
+        time); deeper entries inherit."""
         import zlib
         if parent_ino == ROOT_INO:
-            self._owner[child_ino] = \
-                zlib.crc32(name.encode()) % self._max_mds()
+            self._owner[child_ino] = zlib.crc32(name.encode())
         else:
             self._owner[child_ino] = self._owner.get(parent_ino, 0)
 

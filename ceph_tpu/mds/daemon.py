@@ -184,6 +184,14 @@ class MDSDaemon(Dispatcher):
                     self._dirs.clear()
                     if getattr(self, "_inode_cache", None):
                         self._inode_cache.clear()
+                    if self.rank == 0:
+                        # a live shrink orphans demoted ranks'
+                        # journals (their daemons may be dead):
+                        # adopt them now, not only at activation
+                        try:
+                            self._replay_orphan_journals(fs.max_mds)
+                        except Exception:   # noqa: BLE001
+                            pass
                 if fs is not None:
                     self._last_max_mds = fs.max_mds
             me = self.fsmap.mds_info.get(self.name)
@@ -217,6 +225,8 @@ class MDSDaemon(Dispatcher):
             self._dirty_rm.clear()
             self._completed.clear()
             self._replay_journal()
+            if rank == 0:
+                self._replay_orphan_journals(fs.max_mds)
             self._load_inotable()
         except Exception:
             if self.rados is not None:
@@ -229,6 +239,14 @@ class MDSDaemon(Dispatcher):
         self._send_beacon()
 
     def _deactivate(self):
+        if self.meta is not None:
+            try:
+                # a demoted rank's journaled metadata must land in the
+                # dirfrags — nobody replays a demoted rank's journal
+                # while it stays within max_mds bounds
+                self._flush(trim=True)
+            except Exception:   # noqa: BLE001 — pools may be gone
+                pass
         self.state = "standby"
         self.rank = -1
         self._dirs.clear()
@@ -315,6 +333,34 @@ class MDSDaemon(Dispatcher):
         self._jseq = (seqs[-1] + 1) if seqs else 1
         self._jfirst = seqs[0] if seqs else self._jseq
         self._flush(trim=True)
+
+    def _replay_orphan_journals(self, max_mds: int):
+        """Shrink with a dead rank: its journal would be orphaned
+        (acked metadata lost).  Rank 0 adopts journals of every rank
+        >= max_mds at activation — events are idempotent sub-op lists,
+        so replay + trim is safe (reference: the stopping rank drains
+        its own journal; a dead one is recovered the same way)."""
+        for r in range(max_mds, 16):
+            oid = JHEAD.format(rank=r)
+            try:
+                entries = self.meta.omap_get(oid)
+            except ObjectNotFound:
+                continue
+            seqs = sorted(int(k[1:]) for k in entries
+                          if k.startswith("e"))
+            for seq in seqs:
+                ev = json.loads(entries[f"e{seq:020d}"].decode())
+                self._apply_event(ev)
+                if ev.get("client") is not None:
+                    self._completed[(ev["client"], ev["tid"])] = \
+                        ev.get("reply", {"rc": 0})
+            if seqs:
+                self._flush()
+                try:
+                    self.meta.omap_rm_keys(
+                        oid, [f"e{s:020d}" for s in seqs])
+                except ObjectNotFound:
+                    pass
 
     def _apply_event(self, ev: dict):
         """Events are lists of idempotent sub-ops, safe to re-apply."""
@@ -629,6 +675,14 @@ class MDSDaemon(Dispatcher):
         return self._mutate(extra + [["set", dino, name, rec]],
                             client, tid, rec)
 
+    def _subtree_owner(self, top_name: str) -> int:
+        """The rank owning a top-level directory's subtree (the
+        static partition rule clients route by)."""
+        import zlib
+        fs = self.fsmap.filesystems.get(self.fscid)
+        n = max(1, fs.max_mds) if fs is not None else 1
+        return zlib.crc32(top_name.encode()) % n
+
     def _op_rmdir(self, args, client, tid):
         dino, name = args["dir"], args["name"]
         rec = self._dir(dino).get(name)
@@ -636,7 +690,22 @@ class MDSDaemon(Dispatcher):
             return -2, f"no dentry {name!r}", None
         if rec["type"] != "dir":
             return -20, f"{name!r} is not a directory", None
-        if self._dir(rec["ino"]):
+        if dino == ROOT_INO and \
+                self._subtree_owner(name) != self.rank:
+            # the dir's CONTENTS are another rank's subtree: check
+            # emptiness on a FRESH uncached read (our cached copy can
+            # be stale and must never stick — the owner's unflushed
+            # journal window remains the slice's known gap vs the
+            # reference's cross-MDS slave requests)
+            try:
+                raw = self.meta.omap_get(dirfrag_oid(rec["ino"]))
+                fresh = {k: v for k, v in raw.items()}
+            except ObjectNotFound:
+                fresh = {}
+            self._dirs.pop(rec["ino"], None)
+            if fresh:
+                return -39, f"{name!r} not empty", None
+        elif self._dir(rec["ino"]):
             return -39, f"{name!r} not empty", None
         rc = self._mutate([["rm", dino, name]], client, tid)
         try:

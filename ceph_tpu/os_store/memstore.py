@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
+from ..core.mempool import pool as _mempool
 from ..core.threading_utils import Finisher
 from .objectstore import (Collection, ObjectStore, StoredObject,
                           Transaction, OP_CLONE, OP_COLL_MOVE,
@@ -26,14 +27,28 @@ class MemStore(ObjectStore):
         self.colls: dict[str, Collection] = {}
         self.lock = threading.RLock()
         self.finisher = Finisher(f"{name}-fin")
+        # live data-byte accounting (reference mempool::bluestore_*):
+        # one pool per store instance + items on the shared pool
+        self.mempool = _mempool(f"objectstore::{name}")
+        self._tracked_bytes = 0   # this instance's pool contribution
 
     # -- lifecycle ---------------------------------------------------------
     def mkfs(self):
         with self.lock:
             self.colls.clear()
+            self._drop_tracking()
 
     def umount(self):
+        with self.lock:
+            self._drop_tracking()
         self.finisher.shutdown()
+
+    def _drop_tracking(self):
+        """This store's data is gone (or being abandoned): give its
+        bytes back to the pool — pools are process-global by name, so
+        a leaked residue would count dead stores as live forever."""
+        self.mempool.adjust(-self._tracked_bytes)
+        self._tracked_bytes = 0
 
     # -- write path --------------------------------------------------------
     def queue_transaction(self, txn: Transaction,
@@ -59,7 +74,37 @@ class MemStore(ObjectStore):
             o = c.objects[oid] = StoredObject()
         return o
 
+    def _obj_bytes(self, cid: str, oid: str) -> int:
+        c = self.colls.get(cid)
+        o = c.objects.get(oid) if c is not None else None
+        return len(o.data) if o is not None else 0
+
     def _apply_op(self, op: list):
+        code, cid, oid = op[0], op[1], op[2]
+        track = code in (OP_WRITE, OP_ZERO, OP_TRUNCATE, OP_REMOVE,
+                        OP_CLONE, OP_RMCOLL)
+        before = 0
+        if track:
+            if code == OP_RMCOLL:
+                c = self.colls.get(cid)
+                before = sum(len(o.data)
+                             for o in c.objects.values()) if c else 0
+            elif code == OP_CLONE:
+                before = self._obj_bytes(cid, op[3])
+            else:
+                before = self._obj_bytes(cid, oid)
+        self._apply_op_inner(op)
+        if track:
+            if code == OP_RMCOLL:
+                after = 0
+            elif code == OP_CLONE:
+                after = self._obj_bytes(cid, op[3])
+            else:
+                after = self._obj_bytes(cid, oid)
+            self._tracked_bytes += after - before
+            self.mempool.adjust(after - before)
+
+    def _apply_op_inner(self, op: list):
         code, cid, oid = op[0], op[1], op[2]
         if code == OP_MKCOLL:
             self.colls.setdefault(cid, Collection(cid))

@@ -169,6 +169,9 @@ class OSDaemon(Dispatcher):
         )[1], "set a config override")
         a.register("config help", lambda c: self.config.help(c["key"]),
                    "option metadata")
+        from ..core.mempool import dump_mempools
+        a.register("dump_mempools", lambda c: dump_mempools(),
+                   "per-pool live allocation accounting")
         a.register("status", lambda c: {
             "whoami": self.whoami, "epoch": self.osdmap.epoch,
             "num_pgs": len(self.pgs),

@@ -120,6 +120,21 @@ class RBD:
 
     def remove(self, ioctx, name: str):
         img = Image(ioctx, name)
+        parent = img._hdr.get("parent")
+        if parent is not None:
+            # detach from the parent snapshot's children list, or the
+            # protected/children guard would wedge the parent forever
+            # behind a child that no longer exists
+            try:
+                with Image(ioctx, parent["image"],
+                           read_only=True) as p:
+                    snap = p._hdr["snaps"].get(parent["snap"])
+                    if snap is not None and \
+                            name in snap.get("children", []):
+                        snap["children"].remove(name)
+                        p._save_header()
+            except ImageNotFound:
+                pass
         for sname, snap in img._hdr.get("snaps", {}).items():
             if snap.get("protected") or snap.get("children"):
                 img.close()

@@ -86,6 +86,12 @@ def _dispatch(args, rest) -> int:
         elif rest[0] == "osd" and rest[1:2] in (["out"], ["in"],
                                                 ["down"]):
             cmd = {"prefix": f"osd {rest[1]}", "ids": [int(rest[2])]}
+        elif rest[0] == "fs" and rest[1:2] == ["set"]:
+            cmd = {"prefix": "fs set", "fs_name": rest[2],
+                   "var": rest[3], "val": rest[4]}
+        elif rest[0] == "fs" and rest[1:2] == ["new"]:
+            cmd = {"prefix": "fs new", "fs_name": rest[2],
+                   "metadata": rest[3], "data": rest[4]}
         elif rest[0] == "osd" and rest[1:2] == ["reweight"]:
             cmd = {"prefix": "osd reweight", "id": int(rest[2]),
                    "weight": float(rest[3])}

@@ -48,8 +48,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--size", type=int, required=True)
 
     s = sub.add_parser("snap")
-    s.add_argument("op", choices=["create", "ls", "rm"])
+    s.add_argument("op", choices=["create", "ls", "rm", "protect",
+                                  "unprotect"])
     s.add_argument("spec", help="NAME or NAME@SNAP")
+
+    s = sub.add_parser("clone")
+    s.add_argument("parent_spec", help="PARENT@SNAP")
+    s.add_argument("child")
+    s = sub.add_parser("flatten")
+    s.add_argument("name")
+    s = sub.add_parser("children")
+    s.add_argument("parent_spec", help="PARENT@SNAP")
 
     s = sub.add_parser("export")
     s.add_argument("name")
@@ -144,12 +153,30 @@ def main(argv=None) -> int:
                 return 0
             name, _, snap = a.spec.partition("@")
             if not snap:
-                raise SystemExit("snap create/rm wants NAME@SNAP")
+                raise SystemExit("snap ops want NAME@SNAP")
             with Image(io, name) as img:
                 if a.op == "create":
                     img.create_snap(snap)
-                else:
+                elif a.op == "rm":
                     img.remove_snap(snap)
+                elif a.op == "protect":
+                    img.protect_snap(snap)
+                else:
+                    img.unprotect_snap(snap)
+            return 0
+        if a.cmd == "clone":
+            parent, _, snap = a.parent_spec.partition("@")
+            if not snap:
+                raise SystemExit("clone wants PARENT@SNAP CHILD")
+            rbd.clone(io, parent, snap, a.child)
+            return 0
+        if a.cmd == "flatten":
+            with Image(io, a.name) as img:
+                img.flatten()
+            return 0
+        if a.cmd == "children":
+            parent, _, snap = a.parent_spec.partition("@")
+            print("\n".join(rbd.children(io, parent, snap)))
             return 0
         if a.cmd == "export":
             name, _, snap = a.name.partition("@")

@@ -431,3 +431,38 @@ class TestMultiMDS:
             assert fs2.read_file("/data/f") == b"before shrink"
             fs2.write_file("/data/g", b"after shrink")
             assert fs2.read_file("/data/g") == b"after shrink"
+
+    def test_shrink_with_dead_rank_recovers_journal(self):
+        """Shrink while rank 1's daemon is DEAD: rank 0 adopts the
+        orphan journal so rank-1-acked metadata survives."""
+        with MiniCluster(n_mons=1, n_osds=3) as c:
+            c.fs_new("cephfs")
+            # long flush interval: rank 1's metadata lives ONLY in
+            # its journal when it dies
+            c.start_mds("a", flush_interval=3600.0)
+            c.start_mds("b", flush_interval=3600.0)
+            c.wait_for_active_mds()
+            r = c.rados()
+            r.mon_command({"prefix": "fs set", "fs_name": "cephfs",
+                           "var": "max_mds", "val": "2"})
+            TestMultiMDS._wait_ranks(TestMultiMDS(), c, 2)
+            import zlib
+            d1 = next(n for n in ("alpha", "beta", "gamma")
+                      if zlib.crc32(n.encode()) % 2 == 1)
+            fs = c.cephfs("cephfs")
+            fs.mkdirs(f"/{d1}")
+            fs.write_file(f"/{d1}/precious", b"journal-only")
+            fs.unmount()
+            c._fs_clients.remove(fs)
+            # find + kill rank 1's daemon, then shrink
+            rc, _, out = r.mon_command({"prefix": "mds stat"})
+            victim = out["up"]["cephfs:mds.1"].split(".", 1)[-1]
+            c.kill_mds(victim)
+            rc, outs, _ = r.mon_command({
+                "prefix": "fs set", "fs_name": "cephfs",
+                "var": "max_mds", "val": "1"})
+            assert rc == 0, outs
+            time.sleep(1.0)     # fsmap push reaches rank 0
+            fs2 = c.cephfs("cephfs")
+            assert fs2.read_file(f"/{d1}/precious") == b"journal-only"
+            r.shutdown()

@@ -75,3 +75,43 @@ class TestAdminSocket:
         assert dump["mon.0"]["paxos_commits"] > 0
         q = admin_command(mon.admin_socket.path, "quorum_status")
         assert q["state"] == "leader"
+
+
+class TestMempools:
+    def test_store_bytes_tracked(self):
+        from ceph_tpu.core.mempool import dump_mempools
+        from ceph_tpu.os_store import MemStore
+        from ceph_tpu.os_store.objectstore import Transaction
+        st = MemStore(name="mp-test")
+        st.mount()
+        base = st.mempool.bytes
+        t = Transaction().create_collection("c")
+        t.write("c", "o", 0, b"x" * 1000)
+        st.queue_transaction(t)
+        assert st.mempool.bytes - base == 1000
+        st.queue_transaction(Transaction().truncate("c", "o", 400))
+        assert st.mempool.bytes - base == 400
+        st.queue_transaction(Transaction().clone("c", "o", "o2"))
+        assert st.mempool.bytes - base == 800
+        st.queue_transaction(Transaction().remove("c", "o"))
+        st.queue_transaction(Transaction().remove("c", "o2"))
+        assert st.mempool.bytes - base == 0
+        assert "objectstore::mp-test" in dump_mempools()
+        st.umount()
+
+    def test_asok_dump_mempools(self):
+        import time
+        from ceph_tpu.core.admin_socket import admin_command
+        from ceph_tpu.vstart import MiniCluster
+        with MiniCluster(n_mons=1, n_osds=1) as c:
+            r = c.rados()
+            r.create_pool("p", pg_num=2, size=1, min_size=1)
+            io = r.open_ioctx("p")
+            io.write_full("obj", b"z" * 5000)
+            time.sleep(0.3)
+            out = admin_command(c.osds[0].admin_socket.path,
+                                "dump_mempools")
+            stores = {k: v for k, v in out.items()
+                      if k.startswith("objectstore::")}
+            assert any(v["bytes"] > 0 for v in stores.values())
+            r.shutdown()
