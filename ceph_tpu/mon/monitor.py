@@ -419,6 +419,32 @@ class OSDMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, f"pool '{name}' removed", None
+        if prefix in ("pg scrub", "pg repair"):
+            pgid = _parse_pgid(cmd.get("pgid"))
+            if pgid is None:
+                return -22, f"invalid pgid {cmd.get('pgid')!r}", None
+            m = self.osdmap
+            if pgid.pool not in m.pools or \
+                    pgid.seed >= m.pools[pgid.pool].pg_num:
+                return -2, f"pg {pgid} does not exist", None
+            _up, _upp, _acting, primary = m.pg_to_up_acting_osds(pgid)
+            if primary < 0 or not m.is_up(primary):
+                return -11, f"pg {pgid} has no live primary", None
+            addr_s = m.osd_addrs.get(primary)
+            if not addr_s:
+                return -11, f"osd.{primary} has no address", None
+            from ..osd import messages as OM
+            host, _, port = addr_s.rpartition(":")
+            try:
+                con = self.mon.msgr.connect_to_lazy(
+                    EntityAddr(host, int(port)))
+                con.send_message(OM.MOSDScrubCommand(
+                    pgid=str(pgid), epoch=m.epoch,
+                    repair=(prefix == "pg repair")))
+            except ConnectionError:
+                return -11, f"osd.{primary} unreachable", None
+            return 0, f"instructing pg {pgid} on osd.{primary} to " \
+                f"{prefix.split()[1]}", None
         if prefix == "osd pool ls":
             return 0, "", sorted(self.osdmap.pool_name)
         if prefix == "osd erasure-code-profile set":
@@ -1499,6 +1525,12 @@ class Monitor(Dispatcher):
                         break
             except (KeyError, ValueError, TypeError) as e:
                 rc, outs, outb = -22, f"malformed command: {e!r}", None
+            except Exception as e:   # noqa: BLE001 — other handler
+                # failures are TRANSIENT states (mid-election staging,
+                # half-refreshed service) or internal bugs: reply
+                # EAGAIN so the client retries instead of waiting out
+                # its timeout on silence or failing fast on a blip
+                rc, outs, outb = -11, f"internal: {e!r}", None
 
         def reply(rc=rc, outs=outs, outb=outb):
             try:

@@ -66,6 +66,7 @@ _SCHED_CLASS = {
     M.MOSDPGPull: RECOVERY,
     M.MOSDRepScrub: SCRUB,
     M.MOSDRepScrubMap: SCRUB,
+    M.MOSDScrubCommand: SCRUB,
 }
 
 
@@ -710,6 +711,8 @@ class OSDaemon(Dispatcher):
                     lambda pg: pg.handle_notify_ack(msg),
                 M.MOSDPGBackfillPrune:
                     lambda pg: pg.handle_backfill_prune(msg),
+                M.MOSDScrubCommand:
+                    lambda pg: pg.start_scrub(),
             }
             fn = handlers.get(type(msg))
             if fn is None:

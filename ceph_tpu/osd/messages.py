@@ -141,6 +141,15 @@ class MOSDPGPull(_JsonMessage):
 
 
 @register_message
+class MOSDScrubCommand(_JsonMessage):
+    """Mon → primary OSD: operator-requested scrub/repair of one PG
+    (reference MOSDScrub, the `ceph pg scrub|repair` path; our scrub
+    repairs inconsistencies it finds, so repair == scrub here)."""
+    TYPE = 70
+    FIELDS = ("pgid", "epoch", "repair")
+
+
+@register_message
 class MOSDRepScrub(_JsonMessage):
     """Primary → acting member: build and return your scrub map for
     this PG (reference MOSDRepScrub → replica ScrubMap build)."""

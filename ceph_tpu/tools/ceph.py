@@ -86,6 +86,8 @@ def _dispatch(args, rest) -> int:
         elif rest[0] == "osd" and rest[1:2] in (["out"], ["in"],
                                                 ["down"]):
             cmd = {"prefix": f"osd {rest[1]}", "ids": [int(rest[2])]}
+        elif rest[0] == "pg" and rest[1:2] in (["scrub"], ["repair"]):
+            cmd = {"prefix": f"pg {rest[1]}", "pgid": rest[2]}
         elif rest[0] == "fs" and rest[1:2] == ["set"]:
             cmd = {"prefix": "fs set", "fs_name": rest[2],
                    "var": rest[3], "val": rest[4]}
