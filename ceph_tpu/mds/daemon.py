@@ -95,10 +95,43 @@ class MDSDaemon(Dispatcher):
         self._ino_limit = 0
         self._last_flush = 0.0
         self.sessions: dict[str, int] = {}
+        # observability (reference: every daemon has PerfCounters +
+        # an AdminSocket — `ceph daemon mds.X perf dump / session ls`)
+        import os as _os
+        from ..core.admin_socket import AdminSocket
+        from ..core.perf_counters import PerfCountersBuilder
+        pb = PerfCountersBuilder(f"mds.{name}")
+        pb.add_u64_counter("request", "client requests served")
+        pb.add_u64_counter("reply", "client replies sent")
+        pb.add_u64_counter("journal_events", "journal events appended")
+        pb.add_u64_counter("replays", "journal replays performed")
+        self.perf = pb.create_perf_counters()
+        self.admin_socket = AdminSocket(
+            f"/tmp/ceph_tpu-mds.{name}.{_os.getpid()}.asok")
+        self.admin_socket.register(
+            "perf dump", lambda c: self.perf.dump(),
+            "dump perf counters")
+        self.admin_socket.register(
+            "status", lambda c: {
+                "name": self.name, "state": self.state,
+                "rank": self.rank, "fscid": self.fscid,
+                "journal_seq": self._jseq,
+                "cached_dirs": len(self._dirs)},
+            "daemon status")
+        self.admin_socket.register(
+            "session ls", lambda c: [
+                {"client": cl, "seq": seq}
+                for cl, seq in sorted(self.sessions.items())],
+            "open client sessions")
+        from ..core.mempool import dump_mempools
+        self.admin_socket.register(
+            "dump_mempools", lambda c: dump_mempools(),
+            "per-pool allocation accounting")
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         self.addr = self.msgr.bind()
+        self.admin_socket.start()
         self.running = True
         self.monc.on_fsmap = self._on_fsmap
         self.monc.sub_want("fsmap", 0)
@@ -121,6 +154,7 @@ class MDSDaemon(Dispatcher):
         if self.rados is not None:
             self.rados.shutdown()
             self.rados = None
+        self.admin_socket.shutdown()
         self.monc.shutdown()
         self.msgr.shutdown()
 
@@ -128,6 +162,7 @@ class MDSDaemon(Dispatcher):
         """Hard-stop without flushing — the failover test's crash:
         journaled-but-unflushed metadata must survive via replay."""
         self.running = False
+        self.admin_socket.shutdown()
         if self.rados is not None:
             self.rados.shutdown()
             self.rados = None
@@ -318,6 +353,7 @@ class MDSDaemon(Dispatcher):
     def _replay_journal(self):
         """Apply every journaled event to the backing dirfrags, then
         trim (reference MDLog replay on rank takeover)."""
+        self.perf.inc("replays")
         try:
             entries = self.meta.omap_get(self._journal_oid)
         except ObjectNotFound:
@@ -430,6 +466,7 @@ class MDSDaemon(Dispatcher):
         ev = {"subs": subs, "client": client, "tid": tid}
         if reply is not None:
             ev["reply"] = reply
+        self.perf.inc("journal_events")
         seq = self._jseq
         self._jseq += 1
         self.meta.omap_set(self._journal_oid,
@@ -487,10 +524,12 @@ class MDSDaemon(Dispatcher):
             return True
         if isinstance(msg, M.MClientRequest):
             with self.lock:
+                self.perf.inc("request")
                 rc, outs, result = self._handle_request(msg)
             try:
                 msg.connection.send_message(M.MClientReply(
                     tid=msg.tid, rc=rc, outs=outs, result=result))
+                self.perf.inc("reply")
             except ConnectionError:
                 pass
             return True

@@ -158,6 +158,19 @@ class MgrDaemon:
                                else _default_modules())
         self.asok_paths = dict(asok_paths or {})
         self.monc = MonClient(monmap, entity=f"mgr.{name}")
+        # observability (reference: the mgr serves its own asok)
+        import os as _os
+        from ..core.admin_socket import AdminSocket
+        self.admin_socket = AdminSocket(
+            f"/tmp/ceph_tpu-mgr.{name}.{_os.getpid()}.asok")
+        self.admin_socket.register(
+            "status", lambda c: {
+                "name": self.name, "state": self.state,
+                "modules": sorted(self.modules)},
+            "daemon status")
+        self.admin_socket.register(
+            "mgr module ls", lambda c: sorted(self.modules),
+            "loaded modules")
         self.state = "boot"           # boot / standby / active
         self.modules: dict[str, MgrModule] = {}
         self.running = False
@@ -174,6 +187,7 @@ class MgrDaemon:
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         self.running = True
+        self.admin_socket.start()
         self.monc.on_mgrmap = self._on_mgrmap
         self.monc.sub_want("mgrmap", 0)
         self.monc.sub_want("osdmap", 0)
@@ -186,6 +200,7 @@ class MgrDaemon:
 
     def shutdown(self):
         self.running = False
+        self.admin_socket.shutdown()
         with self.lock:
             self._stop_modules()
         self.monc.shutdown()

@@ -74,6 +74,7 @@ class MonClient(Dispatcher):
         if isinstance(cmd, str):
             cmd = {"prefix": cmd}
         deadline = timeout if timeout is not None else self.timeout
+        last_outs = ""
         for _attempt in range(4):
             self._ensure()
             with self._lock:
@@ -94,13 +95,18 @@ class MonClient(Dispatcher):
             with self._lock:
                 _, box = self._waiters.pop(tid)
             reply = box[0]
-            if reply.rc == -11:      # not leader: follow the referral
+            if reply.rc == -11:      # not leader (referral) or a
+                # transient internal error: remember the reason so a
+                # persistent failure surfaces it, then retry
+                last_outs = reply.outs or last_outs
                 leader = (reply.outb or {}).get("leader")
                 self._con = None
                 self._connect(leader if leader is not None else None)
                 continue
             return reply.rc, reply.outs, reply.outb
-        raise TimeoutError(f"mon command {cmd.get('prefix')!r} failed")
+        raise TimeoutError(
+            f"mon command {cmd.get('prefix')!r} failed"
+            + (f": {last_outs}" if last_outs else ""))
 
     def send(self, msg):
         """Fire-and-forget daemon→mon message (MOSDBoot/MOSDFailure —

@@ -173,6 +173,81 @@ class OSDMonitor(PaxosService):
             else self.osdmap
         return osdmap_from_dict(osdmap_to_dict(base))
 
+    # seconds without ANY report (stats tick ≈1s) before the mon
+    # itself marks an OSD down — the failure-report path needs live
+    # PEERS, so a whole-cluster outage would otherwise never be
+    # noticed (reference mon_osd_report_timeout, scaled to this
+    # suite's clock)
+    REPORT_TIMEOUT = 30.0
+
+    def note_osd_report(self, osd: int):
+        t = getattr(self, "_last_report", None)
+        if t is None:
+            t = self._last_report = {}
+        t[osd] = time.monotonic()
+
+    def tick(self):
+        if not self.mon.is_leader:
+            return
+        t = getattr(self, "_last_report", None)
+        if t is None:
+            t = self._last_report = {}
+        now = time.monotonic()
+        # stall guard: everything here shares one process (and the
+        # GIL) with JAX compiles that can freeze ALL threads for tens
+        # of seconds — the OSDs' report timers stalled exactly as long
+        # as we did, so a big gap since OUR last tick must not be
+        # counted against them
+        last_tick = getattr(self, "_last_live_tick", now)
+        self._last_live_tick = now
+        gap = now - last_tick
+        if gap > 5.0:
+            for o in list(t):
+                t[o] += gap
+        cur = self.pending_map or self.osdmap
+        # every up OSD gets a grace window from when this leader first
+        # saw it up — an OSD that dies before its first stats report
+        # (or a whole-cluster outage with no surviving peers to report
+        # failures) must still be noticed
+        for o in range(cur.max_osd):
+            if cur.is_up(o):
+                t.setdefault(o, now)
+        dead = [o for o, ts in t.items()
+                if now - ts > self.REPORT_TIMEOUT
+                and o < cur.max_osd and cur.is_up(o)]
+        if not dead:
+            return
+        m = self._working()
+        for o in dead:
+            m.osd_state[o] &= ~UP
+        # entries are NOT popped: if this proposal loses a race the
+        # next tick re-marks (idempotent); once the map shows the OSD
+        # down the is_up filter skips it, and a revive refreshes the
+        # timestamp via note_osd_report
+        self._stage_map(m)
+        self.mon.propose()
+
+    def _osd_send(self, osd: int, msg):
+        """Cached per-OSD connection (the _peer_send pattern): a lazy
+        connection per command would grow mon.msgr.connections without
+        bound under periodic scrub scripting."""
+        cons = getattr(self, "_osd_cons", None)
+        if cons is None:
+            cons = self._osd_cons = {}
+        addr_s = self.osdmap.osd_addrs.get(osd)
+        cached = cons.get(osd)
+        if cached is not None:
+            cached_addr, con = cached
+            if cached_addr == addr_s and not con._closed:
+                con.send_message(msg)
+                return
+            con.mark_down()
+        host, _, port = addr_s.rpartition(":")
+        con = self.mon.msgr.connect_to_lazy(
+            EntityAddr(host, int(port)))
+        cons[osd] = (addr_s, con)
+        con.send_message(msg)
+
     # -- daemon messages ---------------------------------------------------
     def handle_boot(self, osd: int, addr: str):
         # already up at this address ⇒ duplicate boot (the OSD resends
@@ -429,20 +504,16 @@ class OSDMonitor(PaxosService):
                 return -2, f"pg {pgid} does not exist", None
             _up, _upp, _acting, primary = m.pg_to_up_acting_osds(pgid)
             if primary < 0 or not m.is_up(primary):
-                return -11, f"pg {pgid} has no live primary", None
+                # NOT -11: that errno is the not-leader referral the
+                # client retries on — the operator needs this message
+                return -16, f"pg {pgid} has no live primary", None
             addr_s = m.osd_addrs.get(primary)
             if not addr_s:
-                return -11, f"osd.{primary} has no address", None
+                return -16, f"osd.{primary} has no address", None
             from ..osd import messages as OM
-            host, _, port = addr_s.rpartition(":")
-            try:
-                con = self.mon.msgr.connect_to_lazy(
-                    EntityAddr(host, int(port)))
-                con.send_message(OM.MOSDScrubCommand(
-                    pgid=str(pgid), epoch=m.epoch,
-                    repair=(prefix == "pg repair")))
-            except ConnectionError:
-                return -11, f"osd.{primary} unreachable", None
+            self._osd_send(primary, OM.MOSDScrubCommand(
+                pgid=str(pgid), epoch=m.epoch,
+                repair=(prefix == "pg repair")))
             return 0, f"instructing pg {pgid} on osd.{primary} to " \
                 f"{prefix.split()[1]}", None
         if prefix == "osd pool ls":
@@ -1487,6 +1558,7 @@ class Monitor(Dispatcher):
             # receiving mon keeps `status` answerable everywhere)
             self.pgmap.apply_report(msg.osd, msg.pg_stats,
                                     msg.osd_stats)
+            self.services["osdmap"].note_osd_report(msg.osd)
             if not self.is_leader and self.elector.leader is not None \
                     and not msg.fwd:
                 self._peer_send(self.elector.leader, M.MPGStats(

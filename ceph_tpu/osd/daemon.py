@@ -256,6 +256,19 @@ class OSDaemon(Dispatcher):
         map's arrival re-drives the waiting PGs' peering)."""
         self.monc.send(MM.MOSDAlive(osd=self.whoami, want=want))
 
+    def _start_scrub_or_retry(self, pg, msg, *, max_tries: int = 20):
+        """An operator scrub refused (writes in flight, already
+        scrubbing, mid-peering) requeues itself instead of silently
+        dropping — the mon already acked the command."""
+        if pg.start_scrub():
+            return
+        tries = getattr(msg, "_scrub_tries", 0)
+        if tries >= max_tries:
+            return
+        msg._scrub_tries = tries + 1
+        self.timer.add_event_after(
+            0.5, lambda: self.op_queue.enqueue("scrub", msg))
+
     def scrub_pg(self, pgid: PGid) -> bool:
         """Kick a scrub on a PG this OSD is primary for."""
         with self.lock:
@@ -712,7 +725,7 @@ class OSDaemon(Dispatcher):
                 M.MOSDPGBackfillPrune:
                     lambda pg: pg.handle_backfill_prune(msg),
                 M.MOSDScrubCommand:
-                    lambda pg: pg.start_scrub(),
+                    lambda pg: self._start_scrub_or_retry(pg, msg),
             }
             fn = handlers.get(type(msg))
             if fn is None:

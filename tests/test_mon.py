@@ -339,3 +339,34 @@ class TestFailover:
         finally:
             for m in mons2:
                 m.shutdown()
+
+
+class TestReportTimeout:
+    def test_whole_cluster_outage_marked_down(self):
+        """Every OSD dying at once leaves no peers to report failures;
+        the mon's report timeout (reference mon_osd_report_timeout)
+        must notice on its own."""
+        import time
+        from ceph_tpu.mon.monitor import OSDMonitor
+        from ceph_tpu.vstart import MiniCluster
+        old = OSDMonitor.REPORT_TIMEOUT
+        OSDMonitor.REPORT_TIMEOUT = 6.0    # keep the test quick
+        try:
+            with MiniCluster(n_mons=1, n_osds=3) as c:
+                r = c.rados()
+                r.create_pool("p", pg_num=1, size=3)
+                io = r.open_ioctx("p")
+                io.write_full("o", b"x")
+                c.wait_for_clean()
+                for i in list(c.osds):
+                    c.kill_osd(i)
+                svc = c.mons[0].services["osdmap"]
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    if not any(svc.osdmap.is_up(o) for o in range(3)):
+                        break
+                    time.sleep(0.3)
+                assert not any(svc.osdmap.is_up(o) for o in range(3))
+                r.shutdown()
+        finally:
+            OSDMonitor.REPORT_TIMEOUT = old

@@ -115,3 +115,35 @@ class TestMempools:
                       if k.startswith("objectstore::")}
             assert any(v["bytes"] > 0 for v in stores.values())
             r.shutdown()
+
+
+class TestDaemonAsoks:
+    def test_mds_and_mgr_admin_sockets(self):
+        import time
+        from ceph_tpu.core.admin_socket import admin_command
+        from ceph_tpu.vstart import MiniCluster
+        with MiniCluster(n_mons=1, n_osds=2) as c:
+            c.fs_new("cephfs")
+            mds = c.start_mds("a")
+            c.wait_for_active_mds()
+            c.start_mgr("m")
+            c.wait_for_active_mgr()
+            fs = c.cephfs("cephfs")
+            fs.mkdirs("/obs")
+            fs.write_file("/obs/f", b"x")
+            out = admin_command(mds.admin_socket.path, "status")
+            assert out["state"] == "active" and out["rank"] == 0
+            perf = admin_command(mds.admin_socket.path, "perf dump")
+            counters = perf["mds.a"]
+            assert counters["request"] > 0
+            assert counters["journal_events"] > 0
+            sess = admin_command(mds.admin_socket.path, "session ls")
+            assert any(s_["client"] == fs.entity for s_ in sess)
+            mgr = c.mgrs["m"]
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not mgr.modules:
+                time.sleep(0.1)
+            out = admin_command(mgr.admin_socket.path, "status")
+            assert out["state"] == "active"
+            assert "balancer" in out["modules"]
+            fs.unmount()
