@@ -219,7 +219,8 @@ class OSDMonitor(PaxosService):
             return
         m = self._working()
         for o in dead:
-            m.osd_state[o] &= ~UP
+            m.mark_down(o)
+            self.failure_reports.pop(o, None)
         # entries are NOT popped: if this proposal loses a race the
         # next tick re-marks (idempotent); once the map shows the OSD
         # down the is_up filter skips it, and a revive refreshes the
@@ -300,6 +301,10 @@ class OSDMonitor(PaxosService):
                 m.crush.names.setdefault(dev, f"osd.{dev}")
             m.crush.max_devices = m.max_osd
         m.osd_state[osd] |= EXISTS | UP
+        # fresh grace window: the stale pre-outage report timestamp
+        # must not trip the report timeout before the revived OSD's
+        # first stats report (~1s) arrives
+        self.note_osd_report(osd)
         if addr:
             m.osd_addrs[osd] = addr
         if m.is_out(osd):

@@ -1,0 +1,190 @@
+"""cephadm — spec-driven cluster deployment.
+
+Reference behavior re-created (``src/cephadm/cephadm.py``; SURVEY.md
+§3.10): bootstrap a whole cluster from a service spec and inspect
+what's deployed.  The reference's deployment unit is a container per
+daemon; ours is an in-process daemon object per spec entry (the same
+single-host posture as ``vstart.sh``, driven by a spec instead of
+flags), with a STATE FILE recording what runs where — monmap,
+admin-socket paths, service ports — so other tools (``ceph -m``,
+``ceph daemon``, s3 clients) can find everything.
+
+    cephadm bootstrap --spec spec.json [--state /tmp/ceph_tpu.state] \
+        [--hold]
+    cephadm ls --state /tmp/ceph_tpu.state
+
+Spec format (JSON)::
+
+    {"mons": 3, "osds": 4, "mgrs": ["x"], "mds": ["a", "b"],
+     "fs": "cephfs", "rgw": true,
+     "pools": [{"name": "data", "pg_num": 16, "size": 3}]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+class Deployment:
+    """A running spec (returned by bootstrap; the CLI holds on it)."""
+
+    def __init__(self, cluster, state_path: str, state: dict,
+                 rgw=None):
+        self.cluster = cluster
+        self.state_path = state_path
+        self.state = state
+        self.rgw = rgw
+        self._rados = None
+
+    def stop(self):
+        if self.rgw is not None:
+            self.rgw.shutdown()
+        if self._rados is not None:
+            self._rados.shutdown()
+        self.cluster.stop()
+
+
+def bootstrap(spec: dict, state_path: str) -> Deployment:
+    from ..vstart import MiniCluster
+    n_mons = int(spec.get("mons", 1))
+    n_osds = int(spec.get("osds", 3))
+    cluster = MiniCluster(n_mons=n_mons, n_osds=n_osds).start()
+    try:
+        return _bootstrap_services(cluster, spec, state_path)
+    except Exception:
+        cluster.stop()      # never leak a half-deployed cluster
+        raise
+
+
+def _bootstrap_services(cluster, spec: dict,
+                        state_path: str) -> Deployment:
+    n_mons = int(spec.get("mons", 1))
+    state = {
+        "mon_addrs": [f"{a.host}:{a.port}"
+                      for a in cluster.monmap.mons.values()],
+        "daemons": {},
+        "created": time.time(),
+    }
+    for r in range(n_mons):
+        state["daemons"][f"mon.{r}"] = {
+            "type": "mon",
+            "asok": cluster.mons[r].admin_socket.path}
+    for i, osd in cluster.osds.items():
+        state["daemons"][f"osd.{i}"] = {
+            "type": "osd", "asok": osd.admin_socket.path}
+    for name in spec.get("mgrs", []):
+        mgr = cluster.start_mgr(name)
+        state["daemons"][f"mgr.{name}"] = {
+            "type": "mgr", "asok": mgr.admin_socket.path}
+    if spec.get("mgrs"):
+        cluster.wait_for_active_mgr()
+    dep = Deployment(cluster, state_path, state)
+    try:
+        _deploy_rest(dep, cluster, spec, state)
+    except Exception:
+        if dep.rgw is not None:
+            dep.rgw.shutdown()
+        if dep._rados is not None:
+            dep._rados.shutdown()
+        raise
+    with open(state_path, "w") as f:
+        json.dump(state, f, indent=1)
+    return dep
+
+
+def _deploy_rest(dep: Deployment, cluster, spec: dict, state: dict):
+    if spec.get("mds"):
+        fs_name = spec.get("fs", "cephfs")
+        cluster.fs_new(fs_name)
+        for name in spec["mds"]:
+            mds = cluster.start_mds(name)
+            state["daemons"][f"mds.{name}"] = {
+                "type": "mds", "asok": mds.admin_socket.path}
+        cluster.wait_for_active_mds(fs_name)
+        state["fs"] = fs_name
+    if spec.get("pools") or spec.get("rgw"):
+        from ..osdc.librados import Rados
+        dep._rados = Rados(cluster.monmap).connect()
+        for p in spec.get("pools", []):
+            dep._rados.create_pool(
+                p["name"], pg_num=int(p.get("pg_num", 8)),
+                size=int(p.get("size", 3)),
+                pool_type=p.get("type", "replicated"),
+                erasure_code_profile=p.get("profile", ""))
+        if spec.get("rgw"):
+            from ..rgw import RGWService
+            dep.rgw = RGWService(dep._rados).start()
+            state["daemons"]["rgw.0"] = {
+                "type": "rgw",
+                "endpoint": f"http://127.0.0.1:{dep.rgw.port}"}
+
+
+def _ls(state_path: str) -> int:
+    from ..core.admin_socket import admin_command
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+    except FileNotFoundError:
+        print(f"cephadm: no state at {state_path}", file=sys.stderr)
+        return 1
+    rows = []
+    for name, d in sorted(state["daemons"].items()):
+        alive = "-"
+        if d.get("asok"):
+            try:
+                admin_command(d["asok"], "status")
+                alive = "running"
+            except Exception:
+                alive = "dead"
+        rows.append((name, d["type"], alive,
+                     d.get("asok") or d.get("endpoint", "")))
+    w = max(len(r[0]) for r in rows) + 2
+    print(f"{'NAME':<{w}}{'TYPE':<6}{'STATUS':<9}WHERE")
+    for r in rows:
+        print(f"{r[0]:<{w}}{r[1]:<6}{r[2]:<9}{r[3]}")
+    print(f"mons: {','.join(state['mon_addrs'])}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cephadm", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    b = sub.add_parser("bootstrap")
+    b.add_argument("--spec", required=True)
+    b.add_argument("--state", default="/tmp/ceph_tpu.state")
+    b.add_argument("--hold", action="store_true",
+                   help="stay in the foreground until interrupted "
+                        "(in-process daemons live only as long as "
+                        "this process — the reference's containers "
+                        "don't need this)")
+    ls = sub.add_parser("ls")
+    ls.add_argument("--state", default="/tmp/ceph_tpu.state")
+    a = p.parse_args(argv)
+
+    if a.cmd == "ls":
+        return _ls(a.state)
+    with open(a.spec) as f:
+        spec = json.load(f)
+    dep = bootstrap(spec, a.state)
+    n = len(dep.state["daemons"])
+    print(f"cephadm: bootstrapped {n} daemons "
+          f"(mons {','.join(dep.state['mon_addrs'])}); "
+          f"state → {a.state}")
+    if a.hold:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            dep.stop()
+    else:
+        dep.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
