@@ -29,9 +29,10 @@ class ClsContext:
     reads against the object's current state, staged writes that join
     the surrounding op's transaction."""
 
-    def __init__(self, read_xattr, exists):
+    def __init__(self, read_xattr, exists, read_omap=None):
         self._read_xattr = read_xattr
         self._exists = exists
+        self._read_omap = read_omap
         self.staged_ops: list[dict] = []
 
     # -- reads -------------------------------------------------------------
@@ -41,6 +42,11 @@ class ClsContext:
     def get_xattr(self, name: str) -> bytes | None:
         return self._read_xattr(name)
 
+    def get_omap(self) -> dict[str, bytes]:
+        if self._read_omap is None:
+            return {}
+        return self._read_omap()
+
     # -- staged writes ------------------------------------------------------
     def set_xattr(self, name: str, value: bytes):
         self.staged_ops.append({"op": "setxattr", "name": name,
@@ -48,6 +54,13 @@ class ClsContext:
 
     def rm_xattr(self, name: str):
         self.staged_ops.append({"op": "rmxattr", "name": name})
+
+    def set_omap(self, kv: dict[str, bytes]):
+        self.staged_ops.append({"op": "omap_set", "kv": {
+            k: v.hex() for k, v in kv.items()}})
+
+    def rm_omap(self, keys: list[str]):
+        self.staged_ops.append({"op": "omap_rm", "keys": list(keys)})
 
     def create(self):
         """Ensure the object exists (zero-length write)."""
@@ -156,3 +169,66 @@ def _version_inc(ctx: ClsContext, inp: bytes) -> bytes:
 def _version_read(ctx: ClsContext, inp: bytes) -> bytes:
     raw = ctx.get_xattr("cls.version")
     return bytes(raw) if raw else b"0"
+
+
+# --------------------------------------------------------------------------
+# cls_log — time-indexed log entries in omap (reference src/cls/log)
+# --------------------------------------------------------------------------
+# Keys sort by (timestamp, sub-second counter) so `list` pages in time
+# order; `trim` drops everything up to a marker — the structure RGW
+# multisite mdlog/datalog shards are built on.
+
+def _log_key(ts: float, seq: int) -> str:
+    return f"log.{ts:020.6f}.{seq:08d}"
+
+
+@method("log", "add")
+def _log_add(ctx: ClsContext, inp: bytes) -> bytes:
+    import time as _time
+    req = json.loads(inp.decode())
+    entries = req["entries"] if "entries" in req else [req]
+    rows = {}
+    existing = ctx.get_omap()
+    # persisted MONOTONIC counter: deriving seq from a key count
+    # would re-mint a surviving key's seq after a partial trim and
+    # silently overwrite its entry
+    seq = int(existing.get("log_seq", b"0"))
+    for e in entries:
+        ts = float(e.get("timestamp", _time.time()))
+        rows[_log_key(ts, seq)] = json.dumps(
+            {"timestamp": ts, "section": e.get("section", ""),
+             "name": e.get("name", ""),
+             "data": e.get("data", "")}).encode()
+        seq += 1
+    rows["log_seq"] = str(seq).encode()
+    ctx.create()
+    ctx.set_omap(rows)
+    return b""
+
+
+@method("log", "list")
+def _log_list(ctx: ClsContext, inp: bytes) -> bytes:
+    req = json.loads(inp.decode()) if inp else {}
+    marker = req.get("marker", "")
+    limit = int(req.get("max_entries", 100))
+    rows = ctx.get_omap()
+    keys = sorted(k for k in rows if k.startswith("log.")
+                  and k > marker)
+    page = keys[:limit]
+    out = {"entries": [dict(json.loads(bytes(rows[k])), key=k)
+                       for k in page],
+           "truncated": len(keys) > limit,
+           "marker": page[-1] if page else marker}
+    return json.dumps(out).encode()
+
+
+@method("log", "trim")
+def _log_trim(ctx: ClsContext, inp: bytes) -> bytes:
+    req = json.loads(inp.decode())
+    upto = req["to_marker"]
+    rows = ctx.get_omap()
+    dead = [k for k in rows if k.startswith("log.") and k <= upto]
+    if not dead:
+        raise ClsError(-2, "nothing to trim")
+    ctx.rm_omap(dead)
+    return b""

@@ -886,13 +886,19 @@ class PG:
         def exists():
             return store.exists(cid, oid)
 
+        def read_omap():
+            try:
+                return store.omap_get(cid, oid)
+            except KeyError:
+                return {}
+
         new_ops = []
         call_results = {}
         for i, op in enumerate(msg.ops):
             if op.get("op") != "call":
                 new_ops.append(op)
                 continue
-            ctx = ClsContext(read_xattr, exists)
+            ctx = ClsContext(read_xattr, exists, read_omap)
             try:
                 out = cls_call(op["cls"], op["method"], ctx,
                                bytes.fromhex(op.get("data", "")))

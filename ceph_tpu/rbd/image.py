@@ -561,6 +561,89 @@ class Image:
         self._hdr.pop("parent", None)
         self._save_header()
 
+    # -- incremental diff (reference rbd export-diff / import-diff) -----
+    def export_diff(self, from_snap: str | None = None) -> dict:
+        """Changed extents since `from_snap` (None ⇒ everything) up
+        to this handle's view (a snapshot handle diffs to that snap,
+        a head handle to the current data) — the transport behind
+        incremental backup/mirroring (reference ``rbd export-diff``).
+        Extent granularity: differing byte ranges within each object,
+        so unchanged objects cost two reads and no output."""
+        size = self.size()
+        base = None
+        if from_snap is not None:
+            if from_snap not in self._hdr["snaps"]:
+                raise ImageNotFound(f"no snapshot {from_snap!r}")
+            base = Image(self.ioctx, self.name, snapshot=from_snap)
+        try:
+            extents = []
+            step = self.layout.object_size
+            off = 0
+            chunk = 4096
+            while off < size:
+                n = min(step, size - off)
+                new = self.read(off, n)
+                if base is not None:
+                    old = base.read(off, n)
+                    if len(old) < n:
+                        old += b"\x00" * (n - len(old))
+                else:
+                    old = b"\x00" * n
+                if new != old:
+                    # narrow by C-speed chunk comparisons, then
+                    # byte-trim only inside the boundary chunks — a
+                    # per-byte Python walk over a 4 MiB object costs
+                    # seconds per changed object
+                    lo = 0
+                    while lo < n and \
+                            new[lo:lo + chunk] == old[lo:lo + chunk]:
+                        lo += chunk
+                    hi = n
+                    while hi > lo and new[max(hi - chunk, lo):hi] == \
+                            old[max(hi - chunk, lo):hi]:
+                        hi -= chunk
+                    hi = min(hi, n)
+                    while lo < hi and new[lo] == old[lo]:
+                        lo += 1
+                    while hi > lo and new[hi - 1] == old[hi - 1]:
+                        hi -= 1
+                    extents.append({"off": off + lo,
+                                    "data": new[lo:hi].hex()})
+                off += n
+        finally:
+            if base is not None:
+                base.close()
+        return {"image": self.name, "size": size,
+                "from_snap": from_snap,
+                "to_snap": next(
+                    (nm for nm, sn in self._hdr["snaps"].items()
+                     if sn["id"] == self.snap_id), None),
+                "extents": extents}
+
+    def import_diff(self, diff: dict):
+        """Apply an exported diff (reference ``rbd import-diff``):
+        validate the base snapshot, resize, write each extent, then
+        stamp the end snapshot — the chain discipline that makes
+        out-of-order incrementals fail loudly instead of silently
+        corrupting the restore."""
+        self._require_writable()
+        if diff.get("from_snap") and \
+                diff["from_snap"] not in self._hdr["snaps"]:
+            raise ValueError(
+                f"diff is based on snapshot {diff['from_snap']!r} "
+                "which this image does not have — apply the earlier "
+                "diffs first")
+        if diff["size"] != self._hdr["size"]:
+            self.resize(diff["size"])
+        for ext in diff["extents"]:
+            self.write(ext["off"], bytes.fromhex(ext["data"]))
+        to_snap = diff.get("to_snap")
+        if to_snap and to_snap not in self._hdr["snaps"]:
+            # stamp the chain endpoint so the NEXT incremental's
+            # from_snap check passes (reference import-diff creates
+            # the end snap after applying)
+            self.create_snap(to_snap)
+
     # -- data path ------------------------------------------------------------
     def write(self, offset: int, data: bytes) -> int:
         self._require_writable()

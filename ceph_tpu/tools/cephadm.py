@@ -84,14 +84,16 @@ def _bootstrap_services(cluster, spec: dict,
     dep = Deployment(cluster, state_path, state)
     try:
         _deploy_rest(dep, cluster, spec, state)
+        with open(state_path, "w") as f:
+            json.dump(state, f, indent=1)
     except Exception:
+        # rgw/rados started by _deploy_rest must not outlive a failed
+        # bootstrap (incl. a state-file write failure)
         if dep.rgw is not None:
             dep.rgw.shutdown()
         if dep._rados is not None:
             dep._rados.shutdown()
         raise
-    with open(state_path, "w") as f:
-        json.dump(state, f, indent=1)
     return dep
 
 

@@ -63,6 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("export")
     s.add_argument("name")
     s.add_argument("path")
+    s = sub.add_parser("export-diff")
+    s.add_argument("name", help="NAME or NAME@SNAP (diff endpoint)")
+    s.add_argument("path")
+    s.add_argument("--from-snap", default=None)
+    s = sub.add_parser("import-diff")
+    s.add_argument("path")
+    s.add_argument("name")
     s = sub.add_parser("import")
     s.add_argument("path")
     s.add_argument("name")
@@ -186,6 +193,24 @@ def main(argv=None) -> int:
             with open(a.path, "wb") as f:
                 f.write(data)
             print(f"exported {len(data)} bytes")
+            return 0
+        if a.cmd == "export-diff":
+            name, _, snap = a.name.partition("@")
+            with Image(io, name, snapshot=snap or None,
+                       read_only=True) as img:
+                diff = img.export_diff(from_snap=a.from_snap)
+            with open(a.path, "w") as f:
+                json.dump(diff, f)
+            nb = sum(len(e["data"]) // 2 for e in diff["extents"])
+            print(f"exported diff: {len(diff['extents'])} extents, "
+                  f"{nb} bytes")
+            return 0
+        if a.cmd == "import-diff":
+            with open(a.path) as f:
+                diff = json.load(f)
+            with Image(io, a.name) as img:
+                img.import_diff(diff)
+            print("applied diff")
             return 0
         if a.cmd == "import":
             with open(a.path, "rb") as f:

@@ -54,3 +54,36 @@ class TestCls:
         c, r, io = cluster
         with pytest.raises(Error):
             io.execute("x", "nope", "nothing")
+
+
+class TestClsLog:
+    def test_log_add_list_trim(self, cluster):
+        _c, _r, io = cluster
+        import json
+        io.execute("logobj", "log", "add", json.dumps({
+            "entries": [
+                {"section": "data", "name": "e1", "data": "one",
+                 "timestamp": 100.0},
+                {"section": "data", "name": "e2", "data": "two",
+                 "timestamp": 200.0},
+                {"section": "meta", "name": "e3", "data": "three",
+                 "timestamp": 300.0},
+            ]}).encode())
+        out = json.loads(io.execute("logobj", "log", "list", b""))
+        assert [e["name"] for e in out["entries"]] == \
+            ["e1", "e2", "e3"]
+        assert not out["truncated"]
+        # pagination from a marker
+        out1 = json.loads(io.execute("logobj", "log", "list",
+                                     json.dumps({"max_entries": 2})
+                                     .encode()))
+        assert len(out1["entries"]) == 2 and out1["truncated"]
+        out2 = json.loads(io.execute(
+            "logobj", "log", "list",
+            json.dumps({"marker": out1["marker"]}).encode()))
+        assert [e["name"] for e in out2["entries"]] == ["e3"]
+        # trim up to the first page's marker
+        io.execute("logobj", "log", "trim", json.dumps({
+            "to_marker": out1["marker"]}).encode())
+        out3 = json.loads(io.execute("logobj", "log", "list", b""))
+        assert [e["name"] for e in out3["entries"]] == ["e3"]
