@@ -75,7 +75,8 @@ class CephFS(Dispatcher):
         self._owner: dict[int, int] = {ROOT_INO: 0}
         self._tid = 0
         self._waiters: dict[int, tuple[threading.Event, list]] = {}
-        self._dcache: dict[tuple[int, str], dict] = {}
+        self._dcache: dict[tuple[int, str],
+                   tuple[dict, float]] = {}
         self._fds: dict[int, _Fd] = {}
         self._next_fd = 3
         self.mounted = False
@@ -270,16 +271,26 @@ class CephFS(Dispatcher):
             i += 1
         return ino
 
+    DCACHE_LEASE = 1.0   # seconds a cached dentry stays trusted
+    # (reference: MDS-issued dentry leases / caps bound client cache
+    # staleness; a fixed client-side lease is the slice analog — two
+    # clients of one fs converge within a lease, not never)
+
     def _lookup(self, dino: int, name: str) -> dict:
         key = (dino, name)
-        rec = self._dcache.get(key)
-        if rec is None or rec.get("remote"):
-            # never serve a hard-linked inode from the dentry cache:
-            # its size/mtime live on the shared inode row and another
-            # link name may have changed them (reference: cap recall
-            # keeps linked inodes coherent; we re-fetch instead)
+        hit = self._dcache.get(key)
+        rec = None
+        if hit is not None:
+            rec, stamp = hit
+            if rec.get("remote") or \
+                    time.monotonic() - stamp > self.DCACHE_LEASE:
+                # hard-linked inodes always re-fetch (their size lives
+                # on the shared inode row); plain entries expire with
+                # the lease
+                rec = None
+        if rec is None:
             rec = self._request("lookup", {"dir": dino, "name": name})
-            self._dcache[key] = rec
+            self._dcache[key] = (rec, time.monotonic())
         self._note_child(dino, name, rec["ino"])
         return rec
 
@@ -299,7 +310,7 @@ class CephFS(Dispatcher):
             raise CephFSError(-17, "/ exists")
         dino = self._resolve_dir(parts)
         rec = self._request("mkdir", {"dir": dino, "name": parts[-1]})
-        self._dcache[(dino, parts[-1])] = rec
+        self._dcache[(dino, parts[-1])] = (rec, time.monotonic())
         self._note_child(dino, parts[-1], rec["ino"])
 
     def mkdirs(self, path: str):
@@ -376,7 +387,7 @@ class CephFS(Dispatcher):
         dino = self._resolve_dir(parts)
         rec = self._request("symlink", {
             "dir": dino, "name": parts[-1], "target": target})
-        self._dcache[(dino, parts[-1])] = rec
+        self._dcache[(dino, parts[-1])] = (rec, time.monotonic())
 
     def readlink(self, path: str) -> str:
         _, _, rec = self._resolve(path)
@@ -447,7 +458,7 @@ class CephFS(Dispatcher):
             if flags == "x":
                 args["excl"] = True
             rec = self._request("create", args)
-            self._dcache[(dino, name)] = rec
+            self._dcache[(dino, name)] = (rec, time.monotonic())
             self._note_child(dino, name, rec["ino"])
             if flags == "w" and rec.get("size", 0):
                 rec = self._truncate_fd_rec(dino, name, rec, 0)
@@ -514,7 +525,7 @@ class CephFS(Dispatcher):
                 "dir": f.parent_ino, "name": f.name,
                 "size": f.rec["size"], "mtime": f.rec["mtime"]})
             f.rec = dict(rec)
-            self._dcache[(f.parent_ino, f.name)] = rec
+            self._dcache[(f.parent_ino, f.name)] = (rec, time.monotonic())
             f.dirty = False
 
     def close(self, fd: int):
@@ -530,7 +541,7 @@ class CephFS(Dispatcher):
         new = self._request("setattr", {"dir": dino, "name": name,
                                         "size": size,
                                         "mtime": time.time()})
-        self._dcache[(dino, name)] = new
+        self._dcache[(dino, name)] = (new, time.monotonic())
         if size < old:
             layout = self._layout_of(rec)
             first_dead = -(-size // layout.object_size)

@@ -215,18 +215,48 @@ class OSDMonitor(PaxosService):
         dead = [o for o, ts in t.items()
                 if now - ts > self.REPORT_TIMEOUT
                 and o < cur.max_osd and cur.is_up(o)]
-        if not dead:
+        quota_flips = self._check_quotas(cur)
+        if not dead and not quota_flips:
             return
         m = self._working()
         for o in dead:
             m.mark_down(o)
             self.failure_reports.pop(o, None)
-        # entries are NOT popped: if this proposal loses a race the
-        # next tick re-marks (idempotent); once the map shows the OSD
-        # down the is_up filter skips it, and a revive refreshes the
-        # timestamp via note_osd_report
+        # report entries are NOT popped: if this proposal loses a race
+        # the next tick re-marks (idempotent); once the map shows the
+        # OSD down the is_up filter skips it, and a revive refreshes
+        # the timestamp via note_osd_report
+        for pid, full in quota_flips:
+            if pid in m.pools:
+                m.pools[pid].full = full
+                m.pools[pid].last_change = m.epoch + 1
         self._stage_map(m)
         self.mon.propose()
+
+    def _check_quotas(self, cur) -> list:
+        """Pools whose FULL flag must flip, from PGMap usage vs quota
+        (reference OSDMonitor pool-quota check → FLAG_FULL_QUOTA)."""
+        if not any(p.quota_max_objects or p.quota_max_bytes
+                   for p in cur.pools.values()):
+            return []    # common case: no quotas — skip aggregation
+        usage = self.mon.pgmap.pool_usage(set(cur.pools))
+        flips = []
+        for pid, pool in cur.pools.items():
+            if not (pool.quota_max_objects or pool.quota_max_bytes):
+                continue
+            if pid not in usage:
+                # zero reported stats ≠ empty: a freshly-elected
+                # leader's in-memory PGMap starts blank — never lift
+                # a FULL flag on missing data
+                continue
+            objs, nbytes = usage[pid]
+            over = (pool.quota_max_objects and
+                    objs >= pool.quota_max_objects) or \
+                (pool.quota_max_bytes and
+                 nbytes >= pool.quota_max_bytes)
+            if bool(over) != pool.full:
+                flips.append((pid, bool(over)))
+        return flips
 
     def _osd_send(self, osd: int, msg):
         """Cached per-OSD connection (the _peer_send pattern): a lazy
@@ -499,6 +529,29 @@ class OSDMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, f"pool '{name}' removed", None
+        if prefix == "osd pool set-quota":
+            name = cmd.get("pool")
+            if name not in self.osdmap.pool_name:
+                return -2, f"pool {name!r} does not exist", None
+            field = cmd.get("field")
+            if field not in ("max_objects", "max_bytes"):
+                return -22, "field must be max_objects|max_bytes", None
+            try:
+                val = int(cmd["val"])
+            except (KeyError, ValueError, TypeError):
+                return -22, "quota wants an integer (0 clears)", None
+            if val < 0:
+                return -22, "quota must be >= 0", None
+            m = self._working()
+            pool = m.pools[m.pool_name[name]]
+            setattr(pool, f"quota_{field}", val)
+            if val == 0 and not (pool.quota_max_objects or
+                                 pool.quota_max_bytes):
+                pool.full = False
+            pool.last_change = m.epoch + 1
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"set-quota {field}={val} on pool {name}", None
         if prefix in ("pg scrub", "pg repair"):
             pgid = _parse_pgid(cmd.get("pgid"))
             if pgid is None:
@@ -1093,6 +1146,22 @@ class PGMap:
         return sum(int(st.get("num_objects", 0))
                    for st in self.pg_stats.values())
 
+    def pool_usage(self, live_pools: set[int]) -> dict[int, list]:
+        """pool id → [objects, bytes], pruned to live pools first so
+        a deleted pool's stale stats can't count against a reused
+        id."""
+        self.prune(live_pools)
+        usage: dict[int, list] = {}
+        for pgid_s, st in self.pg_stats.items():
+            try:
+                pid = int(pgid_s.split(".", 1)[0])
+            except ValueError:
+                continue
+            row = usage.setdefault(pid, [0, 0])
+            row[0] += int(st.get("num_objects", 0))
+            row[1] += int(st.get("num_bytes", 0))
+        return usage
+
 
 class HealthMonitor(PaxosService):
     NAME = "health"
@@ -1111,25 +1180,16 @@ class HealthMonitor(PaxosService):
             # PGMap::dump_cluster_stats + per-pool sums)
             osdsvc = self.mon.services["osdmap"]
             m = osdsvc.osdmap
-            self.mon.pgmap.prune(set(m.pools))
-            pools = {}
-            for pgid_s, st in self.mon.pgmap.pg_stats.items():
-                try:
-                    pid = int(pgid_s.split(".", 1)[0])
-                except ValueError:
-                    continue
-                row = pools.setdefault(pid, {"objects": 0, "bytes": 0})
-                row["objects"] += int(st.get("num_objects", 0))
-                row["bytes"] += int(st.get("num_bytes", 0))
+            usage = self.mon.pgmap.pool_usage(set(m.pools))
             out = {"pools": []}
             for name, pid in sorted(m.pool_name.items()):
                 pool = m.pools.get(pid)
-                row = pools.get(pid, {"objects": 0, "bytes": 0})
+                row = usage.get(pid, [0, 0])
                 out["pools"].append({
                     "name": name, "id": pid,
                     "pg_num": pool.pg_num if pool else 0,
-                    "objects": row["objects"],
-                    "bytes_used": row["bytes"]})
+                    "objects": row[0],
+                    "bytes_used": row[1]})
             out["total_objects"] = sum(p["objects"]
                                        for p in out["pools"])
             out["total_bytes_used"] = sum(p["bytes_used"]

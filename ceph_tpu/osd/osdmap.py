@@ -84,6 +84,12 @@ class PGPool:
     # stamp writes with the pool SnapContext; OSDs clone-on-write
     snap_seq: int = 0
     snaps: dict = field(default_factory=dict)   # id → name
+    # quotas (reference pg_pool_t quota_max_objects/bytes): 0 = none.
+    # `full` is set by the mon when PGMap usage exceeds a quota;
+    # OSDs reply -EDQUOT to writes while it holds.
+    quota_max_objects: int = 0
+    quota_max_bytes: int = 0
+    full: bool = False
 
     def __post_init__(self):
         if self.pgp_num == 0:

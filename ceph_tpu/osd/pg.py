@@ -826,6 +826,13 @@ class PG:
             if msg is None:
                 return      # class method failed; error already sent
         is_write = any(op.get("op") in _WRITE_OPS for op in msg.ops)
+        if is_write and self.pool.full and \
+                not all(op.get("op") == "delete" for op in msg.ops):
+            # quota exceeded (reference: FULL_QUOTA pools reply
+            # -EDQUOT; deletes stay allowed so the operator can free
+            # space)
+            self._reply(msg, -122, "pool quota exceeded")
+            return
         if is_write and self.scrubbing:
             # writes quiesce during scrub (reference blocks the scrub
             # chunk range; PG granularity here) — released by

@@ -86,6 +86,10 @@ def _dispatch(args, rest) -> int:
         elif rest[0] == "osd" and rest[1:2] in (["out"], ["in"],
                                                 ["down"]):
             cmd = {"prefix": f"osd {rest[1]}", "ids": [int(rest[2])]}
+        elif rest[0] == "osd" and rest[1:2] == ["pool"] and \
+                rest[2:3] == ["set-quota"]:
+            cmd = {"prefix": "osd pool set-quota", "pool": rest[3],
+                   "field": rest[4], "val": rest[5]}
         elif rest[0] == "pg" and rest[1:2] in (["scrub"], ["repair"]):
             cmd = {"prefix": f"pg {rest[1]}", "pgid": rest[2]}
         elif rest[0] == "fs" and rest[1:2] == ["set"]:

@@ -51,6 +51,9 @@ def osdmap_to_dict(m: OSDMap) -> dict:
             "erasure_code_profile": p.erasure_code_profile,
             "snap_seq": p.snap_seq,
             "snaps": {str(i): n for i, n in p.snaps.items()},
+            "quota_max_objects": p.quota_max_objects,
+            "quota_max_bytes": p.quota_max_bytes,
+            "full": p.full,
         } for p in m.pools.values()],
         "pg_temp": {str(pg): osds for pg, osds in m.pg_temp.items()},
         "primary_temp": {str(pg): o for pg, o in m.primary_temp.items()},

@@ -466,3 +466,37 @@ class TestMultiMDS:
             fs2 = c.cephfs("cephfs")
             assert fs2.read_file(f"/{d1}/precious") == b"journal-only"
             r.shutdown()
+
+
+class TestCrossClientCoherence:
+    def test_two_clients_converge_within_lease(self, fs_cluster):
+        """Client B sees client A's changes once its dentry lease
+        expires (reference: MDS leases/caps bound staleness)."""
+        a = fs_cluster.cephfs("cephfs")
+        b = fs_cluster.cephfs("cephfs")
+        try:
+            a.mkdirs("/coh")
+            a.write_file("/coh/f", b"v1")
+            assert b.read_file("/coh/f") == b"v1"   # B caches the rec
+            a.write_file("/coh/f", b"v2-longer")
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if b.read_file("/coh/f") == b"v2-longer":
+                    break
+                time.sleep(0.3)
+            assert b.read_file("/coh/f") == b"v2-longer"
+            # deletions propagate too
+            a.unlink("/coh/f")
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                try:
+                    b.read_file("/coh/f")
+                except OSError:
+                    break
+                time.sleep(0.3)
+            with pytest.raises(OSError):
+                b.read_file("/coh/f")
+        finally:
+            for cl in (a, b):
+                cl.unmount()
+                fs_cluster._fs_clients.remove(cl)

@@ -391,3 +391,51 @@ class TestECPartialWriteDegraded:
             assert io.read("rmw") == bytes(want)
         finally:
             c.stop()
+
+
+class TestPoolQuota:
+    def test_quota_blocks_writes_until_space_freed(self):
+        """`osd pool set-quota` (reference pg_pool_t quotas +
+        FULL_QUOTA flag): writes over quota get -EDQUOT, deletes stay
+        allowed, and freeing space lifts the flag."""
+        from ceph_tpu.osdc.librados import Error
+        with MiniCluster(n_mons=1, n_osds=2) as c:
+            r = c.rados()
+            r.create_pool("q", pg_num=2, size=2)
+            io = r.open_ioctx("q")
+            rc, outs, _ = r.mon_command({
+                "prefix": "osd pool set-quota", "pool": "q",
+                "field": "max_objects", "val": "3"})
+            assert rc == 0, outs
+            for i in range(3):
+                io.write_full(f"o{i}", b"x" * 100)
+            # the mon notices usage >= quota on a stats tick
+            deadline = time.monotonic() + 20
+            blocked = False
+            while time.monotonic() < deadline:
+                try:
+                    io.write_full("overflow", b"y")
+                    io.remove("overflow")     # slipped in pre-flag
+                    time.sleep(0.3)
+                except Error as e:
+                    assert e.rc == -122, e
+                    blocked = True
+                    break
+            assert blocked, "quota never enforced"
+            # deletes still work, and freeing space lifts the flag
+            io.remove("o0")
+            io.remove("o1")
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                try:
+                    io.write_full("after", b"z")
+                    break
+                except Error:
+                    time.sleep(0.3)
+            assert io.read("after") == b"z"
+            # bad input errors
+            rc, _, _ = r.mon_command({
+                "prefix": "osd pool set-quota", "pool": "q",
+                "field": "bogus", "val": "1"})
+            assert rc == -22
+            r.shutdown()
