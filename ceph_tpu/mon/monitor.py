@@ -212,9 +212,16 @@ class OSDMonitor(PaxosService):
         for o in range(cur.max_osd):
             if cur.is_up(o):
                 t.setdefault(o, now)
-        dead = [o for o, ts in t.items()
-                if now - ts > self.REPORT_TIMEOUT
-                and o < cur.max_osd and cur.is_up(o)]
+        from ..osd.osdmap import CLUSTER_FLAGS
+        if cur.flags & CLUSTER_FLAGS["nodown"]:
+            dead = []
+            # refresh windows so lifting nodown doesn't mass-expire
+            for o in list(t):
+                t[o] = now
+        else:
+            dead = [o for o, ts in t.items()
+                    if now - ts > self.REPORT_TIMEOUT
+                    and o < cur.max_osd and cur.is_up(o)]
         quota_flips = self._check_quotas(cur)
         if not dead and not quota_flips:
             return
@@ -356,6 +363,10 @@ class OSDMonitor(PaxosService):
         self.mon.propose()
 
     def handle_failure(self, target: int, reporter: int):
+        from ..osd.osdmap import CLUSTER_FLAGS
+        cur = self.pending_map or self.osdmap
+        if cur.flags & CLUSTER_FLAGS["nodown"]:
+            return      # operator suppressed down-marking
         self.failure_reports.setdefault(target, set()).add(reporter)
         # mark down on a single report when the cluster is tiny, else 2
         need = 1 if self.osdmap.num_up_osds() <= 2 else 2
@@ -529,6 +540,20 @@ class OSDMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, f"pool '{name}' removed", None
+        if prefix in ("osd set", "osd unset"):
+            from ..osd.osdmap import CLUSTER_FLAGS
+            flag = cmd.get("key")
+            if flag not in CLUSTER_FLAGS:
+                return -22, f"unknown flag {flag!r} (know: " \
+                    f"{sorted(CLUSTER_FLAGS)})", None
+            m = self._working()
+            if prefix == "osd set":
+                m.flags |= CLUSTER_FLAGS[flag]
+            else:
+                m.flags &= ~CLUSTER_FLAGS[flag]
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"{flag} is {'set' if prefix == 'osd set' else 'unset'}", None
         if prefix == "osd pool set-quota":
             name = cmd.get("pool")
             if name not in self.osdmap.pool_name:

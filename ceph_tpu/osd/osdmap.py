@@ -33,6 +33,16 @@ TYPE_ERASURE = 3
 # pool flags (subset)
 FLAG_HASHPSPOOL = 1 << 0
 
+# cluster-wide OSDMap flags (reference CEPH_OSDMAP_*): operator
+# switches set via `ceph osd set <flag>`
+CLUSTER_FLAGS = {
+    "pause": 1 << 0,     # block client I/O (pauserd|pausewr)
+    "nodown": 1 << 1,    # suppress marking OSDs down
+    "noout": 1 << 2,     # suppress auto-out (stored; nothing
+                         # auto-outs at this scale yet)
+    "noscrub": 1 << 3,   # suppress scheduled scrubs
+}
+
 # osd state bits (reference CEPH_OSD_EXISTS/UP)
 EXISTS = 1
 UP = 2

@@ -291,6 +291,17 @@ class PG:
             # regained without an acting change, or a prior-interval
             # holder came back up without changing our acting set)
             self._start_peering()
+        elif self.state.startswith("active") and \
+                self.waiting_for_active:
+            from .osdmap import CLUSTER_FLAGS
+            if not (self.daemon.osdmap.flags &
+                    CLUSTER_FLAGS["pause"]):
+                # an unpause epoch (same interval) releases the ops
+                # the pause gate queued
+                waiters, self.waiting_for_active = \
+                    self.waiting_for_active, []
+                for fn in waiters:
+                    fn()
 
     def _peer_osds(self) -> list[int]:
         me = self.daemon.whoami
@@ -825,6 +836,12 @@ class PG:
             msg = self._expand_class_calls(msg)
             if msg is None:
                 return      # class method failed; error already sent
+        from .osdmap import CLUSTER_FLAGS
+        if self.daemon.osdmap.flags & CLUSTER_FLAGS["pause"]:
+            # operator paused client I/O (reference pauserd|pausewr):
+            # queue, don't fail — unpausing releases everything
+            self.waiting_for_active.append(lambda: self.do_op(msg))
+            return
         is_write = any(op.get("op") in _WRITE_OPS for op in msg.ops)
         if is_write and self.pool.full and \
                 not all(op.get("op") == "delete" for op in msg.ops):
@@ -1058,9 +1075,12 @@ class PG:
         """Primary: kick a scrub round.  False if the PG can't scrub
         now (not primary / not active / already scrubbing / writes in
         flight — scrub maps must not race uncommitted writes)."""
+        from .osdmap import CLUSTER_FLAGS
         busy = (self.backend._inflight
                 or getattr(self.backend, "_rmw", None)
                 or getattr(self.backend, "_reads", None))
+        if self.daemon.osdmap.flags & CLUSTER_FLAGS["noscrub"]:
+            return False    # operator suppressed scrubbing
         if not self.is_primary or not self.state.startswith("active") \
                 or self.scrubbing or busy:
             return False

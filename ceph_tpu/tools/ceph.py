@@ -86,6 +86,9 @@ def _dispatch(args, rest) -> int:
         elif rest[0] == "osd" and rest[1:2] in (["out"], ["in"],
                                                 ["down"]):
             cmd = {"prefix": f"osd {rest[1]}", "ids": [int(rest[2])]}
+        elif rest[0] == "osd" and rest[1:2] in (["set"], ["unset"]) \
+                and len(rest) == 3:
+            cmd = {"prefix": f"osd {rest[1]}", "key": rest[2]}
         elif rest[0] == "osd" and rest[1:2] == ["pool"] and \
                 rest[2:3] == ["set-quota"]:
             cmd = {"prefix": "osd pool set-quota", "pool": rest[3],

@@ -439,3 +439,60 @@ class TestPoolQuota:
                 "field": "bogus", "val": "1"})
             assert rc == -22
             r.shutdown()
+
+
+class TestClusterFlags:
+    def test_pause_and_nodown(self):
+        """`ceph osd set pause|nodown` (reference CEPH_OSDMAP_* flags):
+        pause queues client I/O until unset; nodown suppresses
+        down-marking while set."""
+        from ceph_tpu.tools import ceph as ceph_cli
+        with MiniCluster(n_mons=1, n_osds=3) as c:
+            r = c.rados()
+            r.create_pool("p", pg_num=2, size=2)
+            io = r.open_ioctx("p")
+            io.write_full("pre", b"1")
+            addr = f"127.0.0.1:{c.monmap.mons[0].port}"
+            assert ceph_cli.main(["-m", addr, "osd", "set",
+                                  "pause"]) == 0
+            # a paused write must NOT complete...
+            done = []
+            import threading
+            t = threading.Thread(
+                target=lambda: done.append(
+                    io.write_full("during", b"2")), daemon=True)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if r.objecter.osdmap.flags:    # flag propagated
+                    break
+                time.sleep(0.1)
+            t.start()
+            time.sleep(1.5)
+            assert not done, "write completed while paused"
+            # ...until unpause releases it
+            assert ceph_cli.main(["-m", addr, "osd", "unset",
+                                  "pause"]) == 0
+            t.join(timeout=20)
+            assert done, "unpause never released the write"
+            assert io.read("during") == b"2"
+            # nodown: killing an OSD doesn't mark it down while set
+            assert ceph_cli.main(["-m", addr, "osd", "set",
+                                  "nodown"]) == 0
+            time.sleep(0.5)
+            c.kill_osd(2)
+            time.sleep(5.0)
+            assert r.objecter.osdmap.is_up(2) or \
+                c.mons[0].services["osdmap"].osdmap.is_up(2)
+            # unset → failure reports resume → marked down
+            assert ceph_cli.main(["-m", addr, "osd", "unset",
+                                  "nodown"]) == 0
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if not c.mons[0].services["osdmap"].osdmap.is_up(2):
+                    break
+                time.sleep(0.3)
+            assert not c.mons[0].services["osdmap"].osdmap.is_up(2)
+            # unknown flag errors
+            assert ceph_cli.main(["-m", addr, "osd", "set",
+                                  "bogus"]) == 1
+            r.shutdown()
