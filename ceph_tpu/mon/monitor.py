@@ -179,6 +179,11 @@ class OSDMonitor(PaxosService):
     # noticed (reference mon_osd_report_timeout, scaled to this
     # suite's clock)
     REPORT_TIMEOUT = 30.0
+    # seconds an OSD stays down before the mon marks it OUT so CRUSH
+    # re-places its data (reference mon_osd_down_out_interval, 600s —
+    # kept at the reference scale so kill/revive tests never trip it;
+    # the targeted test shortens it)
+    DOWN_OUT_INTERVAL = 600.0
 
     def note_osd_report(self, osd: int):
         t = getattr(self, "_last_report", None)
@@ -223,7 +228,23 @@ class OSDMonitor(PaxosService):
                     if now - ts > self.REPORT_TIMEOUT
                     and o < cur.max_osd and cur.is_up(o)]
         quota_flips = self._check_quotas(cur)
-        if not dead and not quota_flips:
+        # auto-out: down long enough ⇒ weight 0, CRUSH re-places and
+        # backfill restores redundancy elsewhere (reference
+        # OSDMonitor::tick down-out handling); noout suppresses
+        down_t = getattr(self, "_down_since", None)
+        if down_t is None:
+            down_t = self._down_since = {}
+        outs = []
+        if not (cur.flags & CLUSTER_FLAGS["noout"]):
+            for o in range(cur.max_osd):
+                if cur.exists(o) and not cur.is_up(o):
+                    down_t.setdefault(o, now)
+                    if not cur.is_out(o) and \
+                            now - down_t[o] > self.DOWN_OUT_INTERVAL:
+                        outs.append(o)
+                else:
+                    down_t.pop(o, None)
+        if not dead and not quota_flips and not outs:
             return
         m = self._working()
         for o in dead:
@@ -237,6 +258,8 @@ class OSDMonitor(PaxosService):
             if pid in m.pools:
                 m.pools[pid].full = full
                 m.pools[pid].last_change = m.epoch + 1
+        for o in outs:
+            m.mark_out(o)
         self._stage_map(m)
         self.mon.propose()
 

@@ -100,16 +100,23 @@ class RGWStore:
                 pass
 
     # -- buckets -----------------------------------------------------------
-    def create_bucket(self, bucket: str):
+    def create_bucket(self, bucket: str) -> bool:
+        if bucket.startswith("lc."):
+            # the lifecycle rows share this omap; a literal "lc.x"
+            # bucket would collide with them and poison every
+            # lifecycle pass
+            return False
         self.meta.omap_set(BUCKETS_OID, {
             bucket: json.dumps({"name": bucket}).encode()})
+        return True
 
     def delete_bucket(self, bucket: str) -> bool:
         if self.list_objects(bucket):
             return False            # 409 BucketNotEmpty
         # (list_objects raises on cluster outage, so an unreachable
         # index can never masquerade as an empty bucket here)
-        self.meta.omap_rm_keys(BUCKETS_OID, [bucket])
+        self.meta.omap_rm_keys(BUCKETS_OID,
+                               [bucket, f"lc.{bucket}"])
         try:
             self.meta.remove(_index_oid(bucket))
         except Exception:
@@ -118,15 +125,68 @@ class RGWStore:
 
     def bucket_exists(self, bucket: str) -> bool:
         try:
-            return bucket in self.meta.omap_get(BUCKETS_OID)
+            rows = self.meta.omap_get(BUCKETS_OID)
         except ObjectNotFound:
             return False        # nothing registered yet
+        return bucket in rows and not bucket.startswith("lc.")
 
     def list_buckets(self) -> list[str]:
         try:
-            return sorted(self.meta.omap_get(BUCKETS_OID))
+            return sorted(b for b in self.meta.omap_get(BUCKETS_OID)
+                          if not b.startswith("lc."))
         except ObjectNotFound:
             return []
+
+    # -- lifecycle ---------------------------------------------------------
+    # (reference RGWLC: per-bucket rules in a lifecycle omap; a
+    # worker pass expires objects whose mtime passed the rule's age)
+    def set_lifecycle(self, bucket: str, rules: list[dict]):
+        """rules: [{"id", "prefix", "days"}] — expiration only."""
+        self.meta.omap_set(BUCKETS_OID, {
+            f"lc.{bucket}": json.dumps(rules).encode()})
+
+    def get_lifecycle(self, bucket: str) -> list[dict]:
+        try:
+            raw = self.meta.omap_get(BUCKETS_OID).get(f"lc.{bucket}")
+        except ObjectNotFound:
+            return []
+        return json.loads(bytes(raw)) if raw else []
+
+    def lifecycle_pass(self, now: float | None = None) -> int:
+        """Expire objects per the buckets' rules; → number expired
+        (reference RGWLC::process)."""
+        import time as _time
+        now = _time.time() if now is None else now
+        expired = 0
+        for bucket in self.list_buckets():
+            try:
+                rules = self.get_lifecycle(bucket)
+                if not rules:
+                    continue
+                for key, meta in list(
+                        self.list_objects(bucket).items()):
+                    mtime = float(meta.get("mtime", now))
+                    for rule in rules:
+                        if not key.startswith(
+                                rule.get("prefix", "")):
+                            continue
+                        age_limit = float(rule["days"]) * 86400.0
+                        if now - mtime < age_limit:
+                            continue
+                        # re-check under the lock: a concurrent
+                        # overwrite refreshed mtime and must not be
+                        # expired off this stale snapshot
+                        with self._lock:
+                            cur = self._raw_index(bucket).get(key)
+                            stale = (cur is not None and float(
+                                cur.get("mtime", now)) == mtime)
+                        if stale:
+                            self.delete_object(bucket, key)
+                            expired += 1
+                        break
+            except Exception:   # noqa: BLE001 — one poisoned bucket
+                continue        # must not stop the whole pass
+        return expired
 
     # -- versioning --------------------------------------------------------
     def set_versioning(self, bucket: str, enabled: bool):
@@ -177,8 +237,10 @@ class RGWStore:
     # -- objects -----------------------------------------------------------
     def put_object(self, bucket: str, key: str, body: bytes) -> tuple:
         """→ (etag, version_id|None)."""
+        import time as _time
         etag = hashlib.md5(body).hexdigest()
-        meta = {"size": len(body), "etag": etag}
+        meta = {"size": len(body), "etag": etag,
+                "mtime": _time.time()}
         vid = None
         with self._lock:
             old = self._raw_index(bucket).get(key)
@@ -343,9 +405,11 @@ class RGWStore:
         digest = hashlib.md5(b"".join(
             bytes.fromhex(m["etag"]) for _, m in parts)).hexdigest()
         etag = f"{digest}-{len(parts)}"
+        import time as _time
         manifest = {
             "size": sum(m["size"] for _, m in parts),
             "etag": etag,
+            "mtime": _time.time(),
             "parts": [_part_oid(bucket, upload_id, n)
                       for n, _ in parts],
         }
@@ -495,7 +559,28 @@ class _Handler(BaseHTTPRequestHandler):
                 self.store.set_versioning(
                     bucket, b"Enabled" in body)
                 return self._reply(200)
-            self.store.create_bucket(bucket)
+            if "lifecycle" in q:
+                if not self.store.bucket_exists(bucket):
+                    return self._reply(404)
+                import xml.etree.ElementTree as ET
+                try:
+                    root = ET.fromstring(body.decode())
+                    rules = []
+                    for rule in root.iter("Rule"):
+                        days = rule.findtext(".//Days")
+                        if days is None:
+                            continue
+                        rules.append({
+                            "id": rule.findtext("ID") or "",
+                            "prefix": rule.findtext(".//Prefix")
+                            or rule.findtext("Prefix") or "",
+                            "days": int(float(days))})
+                except ET.ParseError:
+                    return self._reply(400)
+                self.store.set_lifecycle(bucket, rules)
+                return self._reply(200)
+            if not self.store.create_bucket(bucket):
+                return self._reply(400)
             return self._reply(200)
         if not self.store.bucket_exists(bucket):
             return self._reply(404)
@@ -561,6 +646,17 @@ class _Handler(BaseHTTPRequestHandler):
             if "versions" in q:
                 return self._reply(200, _xml_list_versions(
                     bucket, self.store.list_versions(bucket)))
+            if "lifecycle" in q:
+                rules = self.store.get_lifecycle(bucket)
+                rows = "".join(
+                    f"<Rule><ID>{_xesc(r.get('id', ''))}</ID>"
+                    f"<Prefix>{_xesc(r.get('prefix', ''))}</Prefix>"
+                    f"<Expiration><Days>{r['days']}</Days>"
+                    f"</Expiration></Rule>" for r in rules)
+                return self._reply(200, (
+                    '<?xml version="1.0"?>'
+                    f"<LifecycleConfiguration>{rows}"
+                    "</LifecycleConfiguration>").encode())
             if "uploads" in q:
                 ups = self.store.list_multipart_uploads(bucket)
                 rows = "".join(
@@ -615,7 +711,10 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class RGWService:
-    """The gateway daemon: HTTP frontend bound to a RADOS cluster."""
+    """The gateway daemon: HTTP frontend bound to a RADOS cluster,
+    plus the lifecycle worker (reference RGWLC thread)."""
+
+    LC_INTERVAL = 5.0
 
     def __init__(self, rados, host: str = "127.0.0.1", port: int = 0):
         self.store = RGWStore(rados)
@@ -627,9 +726,22 @@ class RGWService:
 
     def start(self):
         self._thread.start()
+        self._lc_stop = threading.Event()
+        self._lc_thread = threading.Thread(
+            target=self._lc_loop, name="rgw-lc", daemon=True)
+        self._lc_thread.start()
         return self
 
+    def _lc_loop(self):
+        while not self._lc_stop.wait(self.LC_INTERVAL):
+            try:
+                self.store.lifecycle_pass()
+            except Exception:   # noqa: BLE001 — cluster churn; the
+                pass            # next pass retries
+
     def shutdown(self):
+        if getattr(self, "_lc_stop", None) is not None:
+            self._lc_stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
 
@@ -691,6 +803,19 @@ class S3Client:
 
     def list_versions(self, b):
         return self._req("GET", f"/{b}?versions")
+
+    def put_lifecycle(self, b, rules):
+        rows = "".join(
+            f"<Rule><ID>{r.get('id', '')}</ID>"
+            f"<Prefix>{r.get('prefix', '')}</Prefix>"
+            f"<Expiration><Days>{r['days']}</Days></Expiration>"
+            f"</Rule>" for r in rules)
+        body = (f"<LifecycleConfiguration>{rows}"
+                f"</LifecycleConfiguration>").encode()
+        return self._req("PUT", f"/{b}?lifecycle", body)[0]
+
+    def get_lifecycle(self, b):
+        return self._req("GET", f"/{b}?lifecycle")
 
     # -- multipart ---------------------------------------------------------
     def initiate_multipart(self, b, k):

@@ -496,3 +496,49 @@ class TestClusterFlags:
             assert ceph_cli.main(["-m", addr, "osd", "set",
                                   "bogus"]) == 1
             r.shutdown()
+
+
+class TestAutoOut:
+    def test_down_osd_marked_out_and_data_rebalances(self):
+        """A long-down OSD is auto-outed (reference
+        mon_osd_down_out_interval) so CRUSH re-places its data;
+        `noout` suppresses it."""
+        from ceph_tpu.mon.monitor import OSDMonitor
+        old_interval = OSDMonitor.DOWN_OUT_INTERVAL
+        OSDMonitor.DOWN_OUT_INTERVAL = 3.0
+        try:
+            with MiniCluster(n_mons=1, n_osds=4) as c:
+                r = c.rados()
+                r.create_pool("ao", pg_num=4, size=3)
+                io = r.open_ioctx("ao")
+                for i in range(8):
+                    io.write_full(f"o{i}", b"d" * 200)
+                c.wait_for_clean()
+                c.kill_osd(0)
+                svc = c.mons[0].services["osdmap"]
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    m = svc.osdmap
+                    if not m.is_up(0) and m.is_out(0):
+                        break
+                    time.sleep(0.3)
+                m = svc.osdmap
+                assert not m.is_up(0) and m.is_out(0), \
+                    (m.is_up(0), m.is_out(0))
+                # CRUSH now re-places onto the survivors; the cluster
+                # heals to clean WITHOUT osd.0
+                c.wait_for_clean(timeout=60)
+                for i in range(8):
+                    assert io.read(f"o{i}") == b"d" * 200
+                # noout: a second kill is never outed
+                rc, _, _ = r.mon_command({"prefix": "osd set",
+                                          "key": "noout"})
+                assert rc == 0
+                time.sleep(0.3)
+                c.kill_osd(1)
+                time.sleep(6.0)
+                m = svc.osdmap
+                assert not m.is_up(1) and not m.is_out(1)
+                r.shutdown()
+        finally:
+            OSDMonitor.DOWN_OUT_INTERVAL = old_interval
