@@ -1281,6 +1281,23 @@ class HealthMonitor(PaxosService):
                 checks.append({"code": "OSD_DOWN",
                                "summary": f"{len(down)} osds down",
                                "detail": [f"osd.{o} down" for o in down]})
+            from ..osd.osdmap import CLUSTER_FLAGS
+            flags_set = sorted(n for n, bit in CLUSTER_FLAGS.items()
+                               if m.flags & bit)
+            if flags_set:
+                checks.append({
+                    "code": "OSDMAP_FLAGS",
+                    "summary": f"{','.join(flags_set)} flag(s) set",
+                    "detail": [f"{f} is set" for f in flags_set]})
+            full_pools = [n for n, pid in m.pool_name.items()
+                          if m.pools[pid].full]
+            if full_pools:
+                checks.append({
+                    "code": "POOL_FULL",
+                    "summary": f"{len(full_pools)} pool(s) over "
+                               "quota",
+                    "detail": [f"pool '{n}' is full (quota)"
+                               for n in sorted(full_pools)]})
             unhealthy = {s: n for s, n in states.items()
                          if s not in ("active", "active+clean")}
             degraded = {s: n for s, n in states.items()
