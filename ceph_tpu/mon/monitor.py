@@ -32,8 +32,8 @@ from ..crush.compiler import crushmap_from_dict
 from ..mds.fsmap import (FSMap, Filesystem, MDSInfo, STATE_ACTIVE,
                          STATE_STANDBY)
 from ..msg import Dispatcher, EntityAddr, Messenger
-from ..osd.osdmap import (EXISTS, OSDMap, PGid, TYPE_ERASURE,
-                          TYPE_REPLICATED, UP)
+from ..osd.osdmap import (CLUSTER_FLAGS, EXISTS, OSDMap, PGid,
+                          TYPE_ERASURE, TYPE_REPLICATED, UP)
 from ..tools.osdmaptool import osdmap_from_dict, osdmap_to_dict
 from . import messages as M
 from .paxos import Elector, Paxos, VICTORY
@@ -217,7 +217,6 @@ class OSDMonitor(PaxosService):
         for o in range(cur.max_osd):
             if cur.is_up(o):
                 t.setdefault(o, now)
-        from ..osd.osdmap import CLUSTER_FLAGS
         if cur.flags & CLUSTER_FLAGS["nodown"]:
             dead = []
             # refresh windows so lifting nodown doesn't mass-expire
@@ -386,7 +385,6 @@ class OSDMonitor(PaxosService):
         self.mon.propose()
 
     def handle_failure(self, target: int, reporter: int):
-        from ..osd.osdmap import CLUSTER_FLAGS
         cur = self.pending_map or self.osdmap
         if cur.flags & CLUSTER_FLAGS["nodown"]:
             return      # operator suppressed down-marking
@@ -564,7 +562,6 @@ class OSDMonitor(PaxosService):
             self.mon.propose()
             return 0, f"pool '{name}' removed", None
         if prefix in ("osd set", "osd unset"):
-            from ..osd.osdmap import CLUSTER_FLAGS
             flag = cmd.get("key")
             if flag not in CLUSTER_FLAGS:
                 return -22, f"unknown flag {flag!r} (know: " \
@@ -1281,7 +1278,6 @@ class HealthMonitor(PaxosService):
                 checks.append({"code": "OSD_DOWN",
                                "summary": f"{len(down)} osds down",
                                "detail": [f"osd.{o} down" for o in down]})
-            from ..osd.osdmap import CLUSTER_FLAGS
             flags_set = sorted(n for n, bit in CLUSTER_FLAGS.items()
                                if m.flags & bit)
             if flags_set:

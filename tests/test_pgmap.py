@@ -101,3 +101,41 @@ class TestMonHealth:
             assert out["health"] == "HEALTH_WARN"
         finally:
             c.stop()
+
+
+class TestHealthFlags:
+    def test_osdmap_flags_and_pool_full_checks(self):
+        """OSDMAP_FLAGS and POOL_FULL health checks fire and clear."""
+        import time
+        from ceph_tpu.vstart import MiniCluster
+        with MiniCluster(n_mons=1, n_osds=2) as c:
+            r = c.rados()
+            r.create_pool("hf", pg_num=2, size=2)
+            rc, _, _ = r.mon_command({"prefix": "osd set",
+                                      "key": "noout"})
+            assert rc == 0
+            deadline = time.monotonic() + 10
+            codes = []
+            while time.monotonic() < deadline:
+                rc, _, st = r.mon_command({"prefix": "health"})
+                codes = [chk["code"] for chk in st["checks"]]
+                if "OSDMAP_FLAGS" in codes:
+                    break
+                time.sleep(0.2)
+            assert "OSDMAP_FLAGS" in codes
+            r.mon_command({"prefix": "osd unset", "key": "noout"})
+            # quota full check
+            r.mon_command({"prefix": "osd pool set-quota",
+                           "pool": "hf", "field": "max_objects",
+                           "val": "1"})
+            io = r.open_ioctx("hf")
+            io.write_full("one", b"x")
+            deadline = time.monotonic() + 25
+            while time.monotonic() < deadline:
+                rc, _, st = r.mon_command({"prefix": "health"})
+                codes = [chk["code"] for chk in st["checks"]]
+                if "POOL_FULL" in codes:
+                    break
+                time.sleep(0.3)
+            assert "POOL_FULL" in codes
+            r.shutdown()
