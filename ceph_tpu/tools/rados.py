@@ -166,6 +166,18 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("ls")
     st = sub.add_parser("stat")
     st.add_argument("obj")
+    for name in ("listomapkeys", "listxattr"):
+        x = sub.add_parser(name)
+        x.add_argument("obj")
+    for name in ("getomapval", "getxattr"):
+        x = sub.add_parser(name)
+        x.add_argument("obj")
+        x.add_argument("key")
+    for name in ("setomapval", "setxattr"):
+        x = sub.add_parser(name)
+        x.add_argument("obj")
+        x.add_argument("key")
+        x.add_argument("value")
     be = sub.add_parser("bench")
     be.add_argument("seconds", type=int)
     be.add_argument("mode", choices=["write", "seq", "rand"])
@@ -175,6 +187,16 @@ def build_parser() -> argparse.ArgumentParser:
     be.add_argument("--no-cleanup", action="store_true")
     be.add_argument("--json", action="store_true")
     return p
+
+
+def _write_bytes(data: bytes):
+    """Binary-safe stdout write that degrades to text when stdout has
+    been swapped for a StringIO (test capture)."""
+    buf = getattr(sys.stdout, "buffer", None)
+    if buf is not None:
+        buf.write(data)
+    else:
+        sys.stdout.write(data.decode(errors="replace"))
 
 
 def main(argv=None) -> int:
@@ -212,6 +234,25 @@ def main(argv=None) -> int:
         elif args.cmd == "stat":
             st = io.stat(args.obj)
             print(f"{args.pool}/{args.obj} size {st['size']}")
+        elif args.cmd == "listomapkeys":
+            for k in sorted(io.omap_get(args.obj)):
+                print(k)
+        elif args.cmd == "getomapval":
+            kv = io.omap_get(args.obj)
+            if args.key not in kv:
+                raise SystemExit(f"no omap key {args.key!r}")
+            _write_bytes(bytes(kv[args.key]))
+            print()
+        elif args.cmd == "setomapval":
+            io.omap_set(args.obj, {args.key: args.value.encode()})
+        elif args.cmd == "listxattr":
+            for k in sorted(io.getxattrs(args.obj)):
+                print(k)
+        elif args.cmd == "getxattr":
+            _write_bytes(bytes(io.getxattr(args.obj, args.key)))
+            print()
+        elif args.cmd == "setxattr":
+            io.setxattr(args.obj, args.key, args.value.encode())
         elif args.cmd == "bench":
             bench = ObjBencher(io, block_size=args.block_size,
                                concurrency=args.concurrency)

@@ -77,3 +77,27 @@ class TestRadosCLI:
         assert rc == 0
         summary = json.loads(out.strip().splitlines()[-1])
         assert summary["mode"] == "rand" and summary["ops"] > 0
+
+
+class TestOmapXattrVerbs:
+    def test_omap_and_xattr_cli(self, cluster, capsys):
+        c = cluster
+        from ceph_tpu.tools import rados as rados_cli
+        base = ["-m", _addrs(c), "-p", "clip"]
+        rados_cli.main(["-m", _addrs(c), "mkpool", "clip"])
+        capsys.readouterr()
+        assert rados_cli.main(base + ["setomapval", "o1", "k1",
+                                      "v1"]) == 0
+        assert rados_cli.main(base + ["setomapval", "o1", "k2",
+                                      "v2"]) == 0
+        assert rados_cli.main(base + ["listomapkeys", "o1"]) == 0
+        assert capsys.readouterr().out.split() == ["k1", "k2"]
+        assert rados_cli.main(base + ["getomapval", "o1", "k2"]) == 0
+        assert capsys.readouterr().out.strip() == "v2"
+        assert rados_cli.main(base + ["setxattr", "o1", "color",
+                                      "teal"]) == 0
+        assert rados_cli.main(base + ["listxattr", "o1"]) == 0
+        assert "color" in capsys.readouterr().out
+        assert rados_cli.main(base + ["getxattr", "o1",
+                                      "color"]) == 0
+        assert capsys.readouterr().out.strip() == "teal"
