@@ -1689,6 +1689,7 @@ class ECBackend:
         self._inflight.clear()
         self._reads.clear()
         self._rmw.clear()
+        getattr(self, "_active_reqids", set()).clear()
 
     # -- writes ------------------------------------------------------------
     def submit_write(self, msg: M.MOSDOp, reqid: str):
@@ -1700,12 +1701,24 @@ class ECBackend:
         + the extent cache, at object granularity)."""
         pg = self.pg
         oid = msg.oid
+        active = getattr(self, "_active_reqids", None)
+        if active is None:
+            active = self._active_reqids = set()
+        if reqid in active:
+            # a client resend raced the IN-FLIGHT original: with
+            # primary-applies-last the log entry (and so the dup
+            # check) only lands at ack time, so without this the
+            # resend would queue behind the RMW gate and APPLY AGAIN
+            # (double append).  Drop it — the original replies with
+            # the same tid (reference: in-progress repop dup check).
+            return
+        active.add(reqid)
         if oid in self._rmw:
             # an RMW is mid-flight on this object: EVERY write to it
             # queues behind it (a write_full/delete slipping past
             # would be clobbered when the RMW's splice commits)
             self._rmw[oid].append(
-                lambda: self.submit_write(msg, reqid))
+                lambda: self._resubmit_queued(msg, reqid))
             return
         # serialize ALL writes per object, not just RMWs: the primary
         # now applies locally at ACK time (primary-applies-last), so
@@ -1717,6 +1730,7 @@ class ECBackend:
         except Exception as e:   # noqa: BLE001 — a poisoned op (bad
             # op kind, encode failure) must release the gate and fail
             # the op, not wedge every later write to this object
+            active.discard(reqid)
             self._release_rmw(oid)
             pg._reply(msg, -22, f"write failed: {e!r}")
 
@@ -1742,6 +1756,7 @@ class ECBackend:
                 # (primary-applies-last ordering)
 
             def on_fail():
+                getattr(self, "_active_reqids", set()).discard(reqid)
                 self._release_rmw(oid)
                 pg._reply(msg, -5, "rmw read failed")
 
@@ -1749,6 +1764,13 @@ class ECBackend:
                                   on_fail=on_fail)
             return
         self._apply_ops(msg, reqid, b"" if not exists else None)
+
+    def _resubmit_queued(self, msg, reqid: str):
+        """Re-enter submit for a write that waited behind the RMW
+        gate (clearing its active mark so the re-entry isn't treated
+        as its own duplicate)."""
+        getattr(self, "_active_reqids", set()).discard(reqid)
+        self.submit_write(msg, reqid)
 
     def _release_rmw(self, oid: str):
         waiters = self._rmw.pop(oid, [])
@@ -1833,6 +1855,7 @@ class ECBackend:
             # retries until enough members take the write.  Deletes
             # are exempt: they remove state and replay from the log.
             pg._reply(msg, -11, "degraded below min_size")
+            getattr(self, "_active_reqids", set()).discard(reqid)
             self._release_rmw(oid)
             return
         # PRIMARY APPLIES LAST (write-ahead ordering): the local txn +
@@ -1951,6 +1974,7 @@ class ECBackend:
             pg.daemon.store.queue_transaction(pg._persist_meta())
         pg._reply(st["msg"], 0, "", results=st["results"],
                   version=st["version"])
+        getattr(self, "_active_reqids", set()).discard(reqid)
         if st.get("oid") is not None:
             self._release_rmw(st["oid"])
 

@@ -186,7 +186,9 @@ def test_model_ops_ec_pool_thrashed(thrash_cluster):
     """Same audit on an EC pool (k=2,m=2 — the config the reference
     thrashes: min_size=k+1=3, so a single failure keeps the PG
     writable; m=1 under a 2s kill cadence starves writes by design
-    because EC writes refuse to ack below min_size)."""
+    because EC writes refuse to ack below min_size).  Appends are ON:
+    they exercise the EC read-modify-write path (gather stripe →
+    splice → re-encode) under churn."""
     c = thrash_cluster
     r = c.rados()
     rc, outs, _ = r.mon_command({
@@ -196,7 +198,7 @@ def test_model_ops_ec_pool_thrashed(thrash_cluster):
     r.create_pool("thrashec", pg_num=4, pool_type="erasure",
                   erasure_code_profile="thrashec")
     io = r.open_ioctx("thrashec")
-    model = RadosModel(io, seed=0xEC, allow_append=False)
+    model = RadosModel(io, seed=0xEC, allow_append=True)
     for _ in range(20):
         model.step()
     th = Thrasher(c, seed=0x5EED).start()
