@@ -1153,6 +1153,18 @@ _WRITE_OPS = {"write", "write_full", "append", "delete", "truncate",
 _NOOP_OPS = {"cls_noop"}
 
 
+def _omap_read_result(kv: dict, op: dict) -> dict:
+    """Shared omap_get result shaping: optional server-side key
+    filter (reference omap_get_vals_by_keys) and keys-only mode
+    (omap_get_keys) — one implementation for both backends."""
+    want = op.get("keys")
+    if want is not None:
+        kv = {k: kv[k] for k in want if k in kv}
+    if op.get("keys_only"):
+        return {"kv": {k: "" for k in kv}}
+    return {"kv": {k: v.hex() for k, v in kv.items()}}
+
+
 def _push_is_stale(store, cid: str, msg) -> bool:
     """A backfill/recovery push racing live writes must never regress
     an object: skip apply when the local copy is already at or past
@@ -1424,9 +1436,8 @@ class ReplicatedBackend:
                     k: v.hex() for k, v in store.getattrs(cid, oid).items()
                     if k != "_"}})
             elif kind == "omap_get":
-                results.append({"kv": {
-                    k: v.hex()
-                    for k, v in store.omap_get(cid, oid).items()}})
+                results.append(_omap_read_result(
+                    store.omap_get(cid, oid), op))
             elif kind == "pgls":
                 results.append({"objects": self.pg._list_objects()})
             else:
@@ -1676,6 +1687,10 @@ class ECBackend:
         # (reference ECBackend's extent cache serializes RMW per
         # object; PG-object granularity here)
         self._rmw: dict[str, list] = {}
+        # reqids anywhere between submit and ack — resends dup-drop
+        # against this (the log can't dup-detect pre-ack ops under
+        # primary-applies-last)
+        self._active_reqids: set = set()
 
     @property
     def engine(self):
@@ -1689,7 +1704,7 @@ class ECBackend:
         self._inflight.clear()
         self._reads.clear()
         self._rmw.clear()
-        getattr(self, "_active_reqids", set()).clear()
+        self._active_reqids.clear()
 
     # -- writes ------------------------------------------------------------
     def submit_write(self, msg: M.MOSDOp, reqid: str):
@@ -1701,9 +1716,7 @@ class ECBackend:
         + the extent cache, at object granularity)."""
         pg = self.pg
         oid = msg.oid
-        active = getattr(self, "_active_reqids", None)
-        if active is None:
-            active = self._active_reqids = set()
+        active = self._active_reqids
         if reqid in active:
             # a client resend raced the IN-FLIGHT original: with
             # primary-applies-last the log entry (and so the dup
@@ -1751,12 +1764,20 @@ class ECBackend:
                 k = self.engine.k
                 old = b"".join(
                     decoded[i].tobytes() for i in range(k))[:size]
-                self._apply_ops(msg, reqid, old)
-                # NOT released here: the gate holds until the op acks
-                # (primary-applies-last ordering)
+                try:
+                    self._apply_ops(msg, reqid, old)
+                except Exception as e:   # noqa: BLE001 — same
+                    # poisoned-op handling as the synchronous path:
+                    # release the gate + reqid mark and FAIL the op,
+                    # or every later write to this object wedges
+                    self._active_reqids.discard(reqid)
+                    self._release_rmw(oid)
+                    pg._reply(msg, -22, f"write failed: {e!r}")
+                # gate NOT released on success: it holds until the
+                # op acks (primary-applies-last ordering)
 
             def on_fail():
-                getattr(self, "_active_reqids", set()).discard(reqid)
+                self._active_reqids.discard(reqid)
                 self._release_rmw(oid)
                 pg._reply(msg, -5, "rmw read failed")
 
@@ -1769,7 +1790,7 @@ class ECBackend:
         """Re-enter submit for a write that waited behind the RMW
         gate (clearing its active mark so the re-entry isn't treated
         as its own duplicate)."""
-        getattr(self, "_active_reqids", set()).discard(reqid)
+        self._active_reqids.discard(reqid)
         self.submit_write(msg, reqid)
 
     def _release_rmw(self, oid: str):
@@ -1855,7 +1876,7 @@ class ECBackend:
             # retries until enough members take the write.  Deletes
             # are exempt: they remove state and replay from the log.
             pg._reply(msg, -11, "degraded below min_size")
-            getattr(self, "_active_reqids", set()).discard(reqid)
+            self._active_reqids.discard(reqid)
             self._release_rmw(oid)
             return
         # PRIMARY APPLIES LAST (write-ahead ordering): the local txn +
@@ -1974,7 +1995,7 @@ class ECBackend:
             pg.daemon.store.queue_transaction(pg._persist_meta())
         pg._reply(st["msg"], 0, "", results=st["results"],
                   version=st["version"])
-        getattr(self, "_active_reqids", set()).discard(reqid)
+        self._active_reqids.discard(reqid)
         if st.get("oid") is not None:
             self._release_rmw(st["oid"])
 
@@ -2023,9 +2044,8 @@ class ECBackend:
                     self.pg.daemon.store.getattrs(pg.cid, oid).items()
                     if k != "_"}})
             elif kind == "omap_get":
-                simple.append({"kv": {
-                    k: v.hex() for k, v in
-                    self.pg.daemon.store.omap_get(pg.cid, oid).items()}})
+                simple.append(_omap_read_result(
+                    self.pg.daemon.store.omap_get(pg.cid, oid), op))
             elif kind == "pgls":
                 simple.append({"objects": pg._list_objects()})
             else:

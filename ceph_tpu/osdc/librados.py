@@ -309,9 +309,22 @@ class IoCtx:
         return {k: bytes.fromhex(v)
                 for k, v in results[0]["attrs"].items()}
 
-    def omap_get(self, oid: str) -> dict[str, bytes]:
-        results, _ = self._sync(oid, [{"op": "omap_get"}])
+    def omap_get(self, oid: str, keys: list[str] | None = None
+                 ) -> dict[str, bytes]:
+        """Full map, or just `keys` (reference
+        omap_get_vals_by_keys — the OSD filters server-side)."""
+        op = {"op": "omap_get"}
+        if keys is not None:
+            op["keys"] = list(keys)
+        results, _ = self._sync(oid, [op])
         return {k: bytes.fromhex(v) for k, v in results[0]["kv"].items()}
+
+    def omap_get_keys(self, oid: str) -> list[str]:
+        """Key names only (reference omap_get_keys): no values cross
+        the wire."""
+        results, _ = self._sync(oid, [{"op": "omap_get",
+                                       "keys_only": True}])
+        return sorted(results[0]["kv"])
 
     def list_objects(self, timeout: float = 20.0) -> list[str]:
         """Pool listing = pgls over every PG (reference pool listing

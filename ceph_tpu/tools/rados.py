@@ -235,10 +235,10 @@ def main(argv=None) -> int:
             st = io.stat(args.obj)
             print(f"{args.pool}/{args.obj} size {st['size']}")
         elif args.cmd == "listomapkeys":
-            for k in sorted(io.omap_get(args.obj)):
+            for k in io.omap_get_keys(args.obj):
                 print(k)
         elif args.cmd == "getomapval":
-            kv = io.omap_get(args.obj)
+            kv = io.omap_get(args.obj, keys=[args.key])
             if args.key not in kv:
                 raise SystemExit(f"no omap key {args.key!r}")
             _write_bytes(bytes(kv[args.key]))

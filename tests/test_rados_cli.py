@@ -101,3 +101,22 @@ class TestOmapXattrVerbs:
         assert rados_cli.main(base + ["getxattr", "o1",
                                       "color"]) == 0
         assert capsys.readouterr().out.strip() == "teal"
+
+    def test_server_side_omap_filters(self, cluster):
+        """omap_get(keys=...) and omap_get_keys filter on the OSD —
+        reference omap_get_vals_by_keys / omap_get_keys."""
+        c = cluster
+        from ceph_tpu.osdc.librados import Rados
+        r = Rados(c.monmap).connect()
+        try:
+            r.create_pool("omf", pg_num=2)
+            io = r.open_ioctx("omf")
+            io.omap_set("o", {f"k{i}": f"v{i}".encode() * 100
+                              for i in range(20)})
+            assert io.omap_get_keys("o") == [f"k{i}" for i in
+                                             sorted(range(20),
+                                                    key=str)]
+            got = io.omap_get("o", keys=["k3", "k7", "nope"])
+            assert got == {"k3": b"v3" * 100, "k7": b"v7" * 100}
+        finally:
+            r.shutdown()
