@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 
 from ..mds import messages as M
 from ..mds.daemon import ROOT_INO, data_oid
@@ -59,7 +60,11 @@ class CephFS(Dispatcher):
                  default_layout: FileLayout | None = None):
         self.monmap = monmap
         self.fs_name = fs_name
-        self.entity = entity or f"client.fs{id(self) & 0xFFFF:04x}"
+        # entity names MUST be process-unique: the MDS dedups
+        # requests by (client, tid), and an id()-derived name can
+        # recur when Python reuses a freed address — a later client
+        # then gets answered from an earlier client's completed map
+        self.entity = entity or f"client.fs{uuid.uuid4().hex[:12]}"
         self.default_layout = default_layout or FileLayout()
         self.monc = MonClient(monmap, entity=self.entity)
         self.msgr = Messenger(self.entity)

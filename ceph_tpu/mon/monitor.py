@@ -726,7 +726,11 @@ class MDSMonitor(PaxosService):
     beacon-timeout failover (reference ``src/mon/MDSMonitor.cc``)."""
 
     NAME = "fsmap"
-    BEACON_GRACE = 3.0   # seconds without a beacon → MDS failed
+    # seconds without a beacon → MDS failed.  Not too tight: every
+    # daemon in the suite shares one process and one GIL, and a long
+    # JAX compile elsewhere stalls beacon threads — a 3s grace caused
+    # spurious failovers (and downstream test flakes) under load
+    BEACON_GRACE = 6.0
 
     def __init__(self, mon):
         super().__init__(mon)
@@ -922,7 +926,7 @@ class MgrMonitor(PaxosService):
     is a flat dict: {epoch, active_name, active_addr, standbys}."""
 
     NAME = "mgrmap"
-    BEACON_GRACE = 3.0
+    BEACON_GRACE = 6.0   # see MDSMonitor: GIL stalls must not flap
 
     def __init__(self, mon):
         super().__init__(mon)

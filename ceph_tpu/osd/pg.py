@@ -1742,7 +1742,9 @@ class ECBackend:
             self._submit_gated(msg, reqid, oid)
         except Exception as e:   # noqa: BLE001 — a poisoned op (bad
             # op kind, encode failure) must release the gate and fail
-            # the op, not wedge every later write to this object
+            # the op, not wedge every later write to this object —
+            # and must clear its half-registered inflight state
+            self._inflight.pop(reqid, None)
             active.discard(reqid)
             self._release_rmw(oid)
             pg._reply(msg, -22, f"write failed: {e!r}")
@@ -1768,8 +1770,11 @@ class ECBackend:
                     self._apply_ops(msg, reqid, old)
                 except Exception as e:   # noqa: BLE001 — same
                     # poisoned-op handling as the synchronous path:
-                    # release the gate + reqid mark and FAIL the op,
-                    # or every later write to this object wedges
+                    # release the gate + reqid mark + inflight state
+                    # and FAIL the op, or every later write to this
+                    # object wedges (and a stale inflight entry could
+                    # ack a future resend early off late sub-replies)
+                    self._inflight.pop(reqid, None)
                     self._active_reqids.discard(reqid)
                     self._release_rmw(oid)
                     pg._reply(msg, -22, f"write failed: {e!r}")

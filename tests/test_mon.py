@@ -316,7 +316,7 @@ class TestFailover:
             # the rest of the suite and flakes under load otherwise
             # (a full-suite run stacks dozens of daemon threads)
             assert wait_for(lambda: any(m.is_leader for m in mons),
-                            timeout=60)
+                            timeout=60), "phase1: no leader elected"
             mc = MonClient(monmap)
             rc = -1
             for _ in range(3):      # command retry absorbs election
@@ -325,10 +325,13 @@ class TestFailover:
                                        "pg_num": 8}, timeout=30)
                 if rc in (0, -17):
                     break
-            assert rc in (0, -17)
+            assert rc in (0, -17), f"phase1: pool create rc={rc}"
             assert wait_for(lambda: all(
                 "persist" in m.services["osdmap"].osdmap.pool_name
-                for m in mons), timeout=60)
+                for m in mons), timeout=60), \
+                "phase1: pool not visible on all mons: " + str(
+                    [sorted(m.services["osdmap"].osdmap.pool_name)
+                     for m in mons])
             mc.shutdown()
         finally:
             for m in mons:
@@ -340,7 +343,11 @@ class TestFailover:
         try:
             assert wait_for(lambda: all(
                 "persist" in m.services["osdmap"].osdmap.pool_name
-                for m in mons2), timeout=60)
+                for m in mons2), timeout=60), \
+                "phase2: replay missing pool: " + str(
+                    [(m.is_leader,
+                      sorted(m.services["osdmap"].osdmap.pool_name),
+                      m.paxos.last_committed) for m in mons2])
         finally:
             for m in mons2:
                 m.shutdown()
