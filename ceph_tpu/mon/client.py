@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import random
 import threading
+import time
 
 from ..msg import Dispatcher, Messenger
 from . import messages as M
@@ -74,20 +75,26 @@ class MonClient(Dispatcher):
         if isinstance(cmd, str):
             cmd = {"prefix": cmd}
         deadline = timeout if timeout is not None else self.timeout
-        last_outs = ""
-        for _attempt in range(4):
-            self._ensure()
-            with self._lock:
-                self._tid += 1
-                tid = self._tid
-                ev = threading.Event()
-                self._waiters[tid] = (ev, [])
+        end = time.monotonic() + deadline   # TOTAL budget: retries,
+        last_outs = ""                      # waits and reconnects all
+        while time.monotonic() < end:       # share it
             try:
-                self._con.send_message(M.MMonCommand(tid=tid, cmd=cmd))
-            except ConnectionError:
+                self._ensure()
+                con = self._con
+                with self._lock:
+                    self._tid += 1
+                    tid = self._tid
+                    ev = threading.Event()
+                    self._waiters[tid] = (ev, [])
+                con.send_message(M.MMonCommand(tid=tid, cmd=cmd))
+            except (ConnectionError, OSError, AttributeError):
+                # no mon reachable right now, or another thread hunted
+                # (_con = None) between _ensure and the send: back off
+                # a beat and keep hunting within the budget
                 self._con = None
+                time.sleep(0.3)
                 continue
-            if not ev.wait(deadline):
+            if not ev.wait(max(0.05, end - time.monotonic())):
                 with self._lock:
                     self._waiters.pop(tid, None)
                 self._con = None     # mon silent: hunt a new one
@@ -100,8 +107,19 @@ class MonClient(Dispatcher):
                 # persistent failure surfaces it, then retry
                 last_outs = reply.outs or last_outs
                 leader = (reply.outb or {}).get("leader")
+                if leader is None or leader == self._cur_rank:
+                    # leaderless churn, or "retry" from the mon we are
+                    # already on (recovering): give the election a beat
+                    # (instant retries burn the budget inside one
+                    # churn window)
+                    time.sleep(0.3)
                 self._con = None
-                self._connect(leader if leader is not None else None)
+                try:
+                    self._connect(leader if leader is not None
+                                  else None)
+                except ConnectionError:
+                    # referred to a dead mon: hunt any live one
+                    self._con = None
                 continue
             return reply.rc, reply.outs, reply.outb
         raise TimeoutError(
@@ -129,7 +147,6 @@ class MonClient(Dispatcher):
 
     def _wait_for_map(self, what: str, min_epoch: int,
                       timeout: float) -> dict:
-        import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             d = getattr(self, f"{what}_dict")

@@ -107,6 +107,12 @@ class PaxosService:
         handlers stage ops and the monitor proposes after."""
         return None
 
+    def on_election_start(self):
+        """Leadership lost or in doubt: staged-but-unproposed ops and
+        any pending (uncommitted) working state are dead.  Subclasses
+        with extra pending fields clear them here too."""
+        self.pending_ops = []
+
     def tick(self):
         """Periodic leader-side work (liveness checks etc.)."""
 
@@ -147,6 +153,10 @@ class OSDMonitor(PaxosService):
             steps=[Step("take", -1), Step("choose_indep", 0, 0),
                    Step("emit")]))
         return crush
+
+    def on_election_start(self):
+        super().on_election_start()
+        self.pending_map = None
 
     def update_from_store(self):
         epoch = self.mon.store.get_int(self.prefix, "last_epoch")
@@ -743,6 +753,10 @@ class MDSMonitor(PaxosService):
         self.stage("put", 1, json.dumps(self.fsmap.to_dict()))
         self.stage("put", "last_epoch", "1")
 
+    def on_election_start(self):
+        super().on_election_start()
+        self.pending_fsmap = None
+
     def update_from_store(self):
         epoch = self.mon.store.get_int(self.prefix, "last_epoch")
         if epoch > self.fsmap.epoch:
@@ -940,6 +954,10 @@ class MgrMonitor(PaxosService):
         self.mgrmap["epoch"] = 1
         self.stage("put", 1, json.dumps(self.mgrmap))
         self.stage("put", "last_epoch", "1")
+
+    def on_election_start(self):
+        super().on_election_start()
+        self.pending_mgrmap = None
 
     def update_from_store(self):
         epoch = self.mon.store.get_int(self.prefix, "last_epoch")
@@ -1450,30 +1468,31 @@ class Monitor(Dispatcher):
         self.paxos.outbox = []
 
     # -- election / paxos --------------------------------------------------
-    def _start_election(self):
-        self.perf.inc("elections")
-        self._election_started = time.monotonic()
-        was_leader = self.elector.state == "leader"
-        # leadership is in doubt: any not-yet-committed round may be
-        # dropped by the next leader's collect, so a success reply would
-        # lie — fail waiters with -11 and let MonClient retry (services
-        # are idempotent-enough: a re-run sees the committed state)
+    def _drop_leader_state(self):
+        """Leadership is in doubt or lost: every leader-side artifact
+        is now invalid.  Any not-yet-committed round may be dropped (or
+        superseded at the SAME version by the next leader's history), so
+        a success reply would lie — fail waiters with -11 and let
+        MonClient retry (services are idempotent-enough: a re-run sees
+        the committed state).  Paxos leaves active/updating too: a late
+        ACCEPT landing on a demoted leader whose round is still open
+        must not fire a commit the new quorum never agreed to."""
         waiters, self._commit_waiters = self._commit_waiters, []
         for _v, fn in waiters:
             fn(rc=-11, outs="leadership changed, retry", outb=None)
         self._proposal_queue.clear()
+        self.paxos.abort_round()
         # any staged-but-uncommitted create_initial round died with the
         # queue; let the next activation re-run it
         self._initial_created = False
-        osdsvc = self.services.get("osdmap")
-        if osdsvc is not None:
-            osdsvc.pending_map = None
-        fssvc = self.services.get("fsmap")
-        if fssvc is not None:
-            fssvc.pending_fsmap = None
-        mgrsvc = self.services.get("mgrmap")
-        if mgrsvc is not None:
-            mgrsvc.pending_mgrmap = None
+        for svc in self.services.values():
+            svc.on_election_start()
+
+    def _start_election(self):
+        self.perf.inc("elections")
+        self._election_started = time.monotonic()
+        was_leader = self.elector.state == "leader"
+        self._drop_leader_state()
         self.elector.start()
         if self.elector.state == "leader" and not was_leader:
             self.paxos.leader_collect(self.elector.quorum)
@@ -1576,6 +1595,14 @@ class Monitor(Dispatcher):
                 # tick's 2s restart fire immediately (same-epoch
                 # re-campaign after a deferral = possible double vote)
                 self._election_started = time.monotonic()
+            if was_leader and self.elector.state != "leader":
+                # demoted WITHOUT going through _start_election (we
+                # learned of another's VICTORY, or deferred to a better
+                # candidate inside elector.handle): the cleanup there
+                # must still happen, or our commit waiters survive into
+                # the new term and mature on the new leader's commits —
+                # answering rc=0 for rounds that died with our queue
+                self._drop_leader_state()
             if self.elector.state == "leader" and not was_leader:
                 self.paxos.leader_collect(self.elector.quorum)
             elif self.elector.state == "peon" and was_state != "peon":
@@ -1705,6 +1732,18 @@ class Monitor(Dispatcher):
         if not self.is_leader and _is_mutating(cmd):
             reply = M.MMonCommandReply(
                 tid=msg.tid, rc=-11, outs="not leader",
+                outb={"leader": self.elector.leader})
+            msg.connection.send_message(reply)
+            return
+        if _is_mutating(cmd) and not self.paxos.is_writeable():
+            # not writeable yet (mid-collect, or before create_initial
+            # seeded the first maps): staging now would build on
+            # pre-seed state — create_initial's round, staged at the
+            # same epoch, would then commit right after and stomp the
+            # command's ops (reference: PaxosService::dispatch waits
+            # for is_writeable()).  Tell the client to retry instead.
+            reply = M.MMonCommandReply(
+                tid=msg.tid, rc=-11, outs="paxos recovering, retry",
                 outb={"leader": self.elector.leader})
             msg.connection.send_message(reply)
             return

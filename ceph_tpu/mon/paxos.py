@@ -206,7 +206,8 @@ class Paxos:
         self.on_active = None        # cb() when a round finishes
         # leader collect state
         self._collect_pn = 0
-        self._num_last = 0
+        self._collecting = False   # a collect WE started is open
+        self._last_from: set[int] = set()
         self._uncommitted_v = None
         self._uncommitted_pn = 0
         self._uncommitted_value = None
@@ -233,6 +234,23 @@ class Paxos:
     def is_active(self) -> bool:
         return self.state == "active"
 
+    def is_writeable(self) -> bool:
+        """Safe to stage new service mutations: an open round may be
+        in flight ("updating"), but never mid-recovery — a value
+        staged before create_initial's activation seeding commits
+        would be stomped by it (same version, same keys)."""
+        return self.state in ("active", "updating") \
+            and not self._collecting
+
+    def abort_round(self):
+        """Leadership lost: whatever round is open can never gather
+        full-quorum accepts under our pn again, and a LATE accept must
+        not fire a commit the new quorum never agreed to."""
+        self.state = "recovering"
+        self._collecting = False
+        self._pending_value = None
+        self._accepts = set()
+
     # -- leader ------------------------------------------------------------
     def leader_collect(self, quorum: list[int]):
         """Phase 1 after winning an election."""
@@ -242,7 +260,8 @@ class Paxos:
         self.state = "recovering"
         pn = self._new_pn()
         self._collect_pn = pn
-        self._num_last = 1
+        self._collecting = True
+        self._last_from = {self.rank}
         self._uncommitted_v = None
         self._uncommitted_pn = 0
         self._uncommitted_value = None
@@ -263,7 +282,7 @@ class Paxos:
         self._maybe_collect_done()
 
     def _maybe_collect_done(self):
-        if self._num_last >= len(self.quorum):
+        if len(self._last_from) >= len(self.quorum):
             if self._uncommitted_value is not None:
                 # re-propose the in-flight value (Paxos safety)
                 self._do_begin(self._uncommitted_v,
@@ -273,6 +292,7 @@ class Paxos:
 
     def _go_active(self):
         self.state = "active"
+        self._collecting = False
         self.extend_lease()
         if self.on_active:
             self.on_active()
@@ -388,13 +408,23 @@ class Paxos:
                 reply["pn"] = self.accepted_pn   # NACK with higher pn
             self.outbox.append((frm, reply))
         elif op == LAST:
-            if self.state != "recovering":
+            # only while a collect WE started is open: a leader demoted
+            # mid-collect is back in "recovering", and late LASTs from
+            # its dead round must not walk it to active as a phantom
+            # leader (nor may their pn-NACKs restart its collect)
+            if self.state != "recovering" or not self._collecting:
                 return
             if msg["pn"] > self._collect_pn:
                 # NACK: someone promised a higher pn; restart collect
                 # above it (adopting it ensures _new_pn goes higher)
                 self.accepted_pn = msg["pn"]
                 self.leader_collect(self.quorum)
+                return
+            if msg["pn"] != self._collect_pn:
+                # stale LAST from a superseded collect of OURS: counting
+                # it could complete the restarted round without the
+                # restarted promises — and miss an uncommitted value a
+                # peon accepted in between (divergent re-propose)
                 return
             # learn newer commits from the peon
             for vs, blob in sorted(msg["values"].items(),
@@ -407,7 +437,7 @@ class Paxos:
                 self._uncommitted_pn = msg["uncommitted_pn"]
                 self._uncommitted_value = bytes.fromhex(
                     msg["uncommitted_value"])
-            self._num_last += 1
+            self._last_from.add(frm)
             self._maybe_collect_done()
         elif op == BEGIN:
             if msg["pn"] >= self.accepted_pn:

@@ -318,20 +318,24 @@ class TestFailover:
             assert wait_for(lambda: any(m.is_leader for m in mons),
                             timeout=60), "phase1: no leader elected"
             mc = MonClient(monmap)
-            rc = -1
+            rcs = []
             for _ in range(3):      # command retry absorbs election
-                rc, _, _ = mc.command({"prefix": "osd pool create",
-                                       "pool": "persist",
-                                       "pg_num": 8}, timeout=30)
+                rc, _, outs = mc.command({"prefix": "osd pool create",
+                                          "pool": "persist",
+                                          "pg_num": 8}, timeout=30)
+                rcs.append((rc, outs))
                 if rc in (0, -17):
                     break
-            assert rc in (0, -17), f"phase1: pool create rc={rc}"
+            assert rcs[-1][0] in (0, -17), f"phase1: pool create {rcs}"
             assert wait_for(lambda: all(
                 "persist" in m.services["osdmap"].osdmap.pool_name
                 for m in mons), timeout=60), \
-                "phase1: pool not visible on all mons: " + str(
-                    [sorted(m.services["osdmap"].osdmap.pool_name)
-                     for m in mons])
+                f"phase1: pool not visible on all mons, rcs={rcs}: " \
+                + str([(m.elector.state, m.paxos.state,
+                        m.paxos.last_committed,
+                        m.store.get_int("svc_osdmap", "last_epoch"),
+                        sorted(m.services["osdmap"].osdmap.pool_name))
+                       for m in mons])
             mc.shutdown()
         finally:
             for m in mons:
