@@ -78,6 +78,7 @@ class MonClient(Dispatcher):
         end = time.monotonic() + deadline   # TOTAL budget: retries,
         last_outs = ""                      # waits and reconnects all
         while time.monotonic() < end:       # share it
+            tid = None
             try:
                 self._ensure()
                 con = self._con
@@ -89,8 +90,13 @@ class MonClient(Dispatcher):
                 con.send_message(M.MMonCommand(tid=tid, cmd=cmd))
             except (ConnectionError, OSError, AttributeError):
                 # no mon reachable right now, or another thread hunted
-                # (_con = None) between _ensure and the send: back off
-                # a beat and keep hunting within the budget
+                # (_con = None) between _ensure and the send: drop the
+                # registered waiter (if we got that far — a late reply
+                # must not land in a dead box), back off a beat and
+                # keep hunting within the budget
+                if tid is not None:
+                    with self._lock:
+                        self._waiters.pop(tid, None)
                 self._con = None
                 time.sleep(0.3)
                 continue

@@ -1062,6 +1062,14 @@ class AuthMonitor(PaxosService):
                caps={"mon": "allow *", "osd": "allow *"})
         self.stage("put", "keyring", kr.dump())
 
+    def on_election_start(self):
+        # the in-memory keyring may hold entities whose staged round
+        # just died with the queue — an unrevertable rc=0 key nobody
+        # committed.  Reload from the committed store (or start empty).
+        super().on_election_start()
+        self.keyring = KeyRing()
+        self.update_from_store()
+
     def update_from_store(self):
         blob = self.mon.store.get_str(self.prefix, "keyring")
         if blob:
@@ -1126,6 +1134,12 @@ class LogMonitor(PaxosService):
     def __init__(self, mon):
         super().__init__(mon)
         self._staged_seq = 0   # beyond the committed 'seq'
+
+    def on_election_start(self):
+        # staged entries died with the queue; keeping their seqs would
+        # commit the next entry past a permanent hole in the log
+        super().on_election_start()
+        self._staged_seq = 0
 
     def update_from_store(self):
         committed = self.mon.store.get_int(self.prefix, "seq")

@@ -166,6 +166,100 @@ class TestElectorUnit:
         assert not [m for _, m in e.outbox if m["op"] == PROPOSE]
 
 
+class TestPaxosUnit:
+    """Direct Paxos round-state checks for the demotion / stale-message
+    holes behind the restart-test flake (reference: Paxos::restart and
+    the collect-phase pn checks in src/mon/Paxos.cc)."""
+
+    @staticmethod
+    def _paxos(rank=0):
+        return Paxos(MonitorDBStore(), rank)
+
+    def test_stale_last_from_superseded_collect_ignored(self):
+        px = self._paxos()
+        px.leader_collect([0, 1, 2])
+        first_pn = px._collect_pn
+        # peon 1 NACKs with a higher promise: collect restarts higher
+        px.handle({"op": "last", "pn": first_pn + 100, "from": 1,
+                   "last_committed": 0, "values": {}})
+        assert px._collect_pn > first_pn and px._last_from == {0}
+        # peon 2's LATE reply for the superseded round must not count
+        px.handle({"op": "last", "pn": first_pn, "from": 2,
+                   "last_committed": 0, "values": {}})
+        assert px._last_from == {0}
+        assert px.state == "recovering"
+
+    def test_duplicate_last_counts_once(self):
+        px = self._paxos()
+        px.leader_collect([0, 1, 2])
+        pn = px._collect_pn
+        for _ in range(2):   # resent reply from the same peon
+            px.handle({"op": "last", "pn": pn, "from": 1,
+                       "last_committed": 0, "values": {}})
+        assert px._last_from == {0, 1}
+        assert px.state == "recovering"   # still waiting on peon 2
+
+    def test_abort_round_blocks_late_accept_commit(self):
+        px = self._paxos()
+        px.leader_collect([0, 1])
+        px.handle({"op": "last", "pn": px._collect_pn, "from": 1,
+                   "last_committed": 0, "values": {}})
+        assert px.is_active()
+        px.propose(b"value")
+        assert px.state == "updating"
+        px.abort_round()   # demoted before peon 1's accept landed
+        px.handle({"op": "accept", "pn": px.accepted_pn, "v": 1,
+                   "from": 1})
+        assert px.last_committed == 0   # no phantom commit
+
+    def test_abort_round_blocks_late_last_activation(self):
+        px = self._paxos()
+        px.leader_collect([0, 1])
+        px.abort_round()   # demoted mid-collect
+        px.handle({"op": "last", "pn": px._collect_pn, "from": 1,
+                   "last_committed": 0, "values": {}})
+        assert px.state == "recovering"   # no phantom leadership
+
+    def test_writeable_gate_states(self):
+        px = self._paxos()
+        assert not px.is_writeable()          # fresh: recovering
+        px.leader_collect([0, 1])
+        assert not px.is_writeable()          # mid-collect
+        px.handle({"op": "last", "pn": px._collect_pn, "from": 1,
+                   "last_committed": 0, "values": {}})
+        assert px.is_writeable()              # active
+        px.propose(b"v")
+        assert px.is_writeable()              # updating still writeable
+
+
+class TestMutatingCommandGate:
+    def test_refused_until_writeable(self, cluster):
+        """A mutating command during recovery must bounce -11, never
+        stage against pre-seed state (the create_initial stomp)."""
+        monmap, mons = cluster
+        assert wait_for(lambda: any(m.is_leader for m in mons))
+        leader = next(m for m in mons if m.is_leader)
+        sent = []
+
+        class FakeCon:
+            def send_message(self, m):
+                sent.append(m)
+        from ceph_tpu.mon import messages as M
+
+        with leader.lock:
+            leader.paxos.abort_round()   # simulate mid-recovery
+            msg = M.MMonCommand(tid=7, cmd={"prefix": "osd pool create",
+                                            "pool": "gated",
+                                            "pg_num": 8})
+            msg.connection = FakeCon()
+            leader._handle_command(msg)
+            # un-wedge the simulated recovery before releasing the lock
+            leader.paxos.state = "active"
+        assert sent and sent[0].rc == -11
+        assert not leader.services["osdmap"].pending_ops
+        assert wait_for(lambda: leader.paxos.is_writeable(), timeout=15)
+
+
 class TestQuorum:
     def test_leader_elected(self, cluster):
         monmap, mons = cluster
