@@ -6,6 +6,20 @@ whose headline is encode GB/s at k=8,m=3 with 1 MiB stripes; the
 per-size speedups (BASELINE.md rows 1/2/5), and "crush" carries the
 BatchMapper PGs/sec vs the native-C scalar (row 4).
 
+Un-hangable contract (VERDICT r3 weak #1): the parent process NEVER
+imports jax — device discovery and every dispatch happen in
+bounded-time subprocesses.  The TPU tunnel (axon) can wedge
+indefinitely inside `import site` / backend init when the relay is
+down, so:
+
+- a probe subprocess lists devices under a hard deadline;
+- the measurement child runs under its own wall-clock budget;
+- if either times out or fails, the CPU legs re-run in a subprocess
+  whose PYTHONPATH drops the axon sitecustomize (which phones the
+  relay before main() starts) and whose JAX_PLATFORMS=cpu;
+- the parent ALWAYS prints one parseable JSON line and exits 0,
+  annotating `"tpu": "unreachable"` when the relay was down.
+
 Reference harnesses: ``ceph_erasure_code_benchmark`` (SURVEY.md §4.4)
 and ``osdmaptool --test-map-pgs`` (§4.5); their binaries are
 unavailable (reference mount empty — SURVEY.md §0), so the
@@ -18,18 +32,117 @@ timing — a wrong-bytes kernel can't post a number.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
+REPO = os.path.dirname(os.path.abspath(__file__))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90))
+TPU_BUDGET_S = float(os.environ.get("BENCH_TPU_BUDGET_S", 600))
+CPU_BUDGET_S = float(os.environ.get("BENCH_CPU_BUDGET_S", 420))
 
 K, M = 8, 3
 SIZES = [4096, 65536, 1 << 20]       # logical stripe bytes
-TARGET_BYTES = 64 << 20              # data per device launch
-ITERS = 10
 DECODE_ERASURES = (0, 9)             # one data, one parity shard lost
 
+
+# --------------------------------------------------------------------------
+# parent: orchestration only — no jax, no unbounded waits
+# --------------------------------------------------------------------------
+
+def _cpu_env() -> dict:
+    """Child env that cannot touch the TPU tunnel: JAX_PLATFORMS=cpu
+    AND the axon sitecustomize dropped from PYTHONPATH (it contacts
+    the relay at `import site`, before any user code runs)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + parts)
+    return env
+
+
+def _probe_tpu() -> tuple[bool, str]:
+    """Can a child even list a TPU device before the deadline?"""
+    code = ("import jax; d = jax.devices(); "
+            "print('PLATFORM=' + d[0].platform)")
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT_S, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timeout after {PROBE_TIMEOUT_S:.0f}s"
+    except Exception as e:                      # noqa: BLE001
+        return False, f"probe error: {str(e)[:120]}"
+    for line in (p.stdout or "").splitlines():
+        if line.startswith("PLATFORM="):
+            plat = line.split("=", 1)[1].strip().lower()
+            if plat == "tpu":
+                return True, "tpu"
+            return False, f"probe found platform {plat!r}"
+    tail = ((p.stderr or "").strip().splitlines() or ["no output"])[-1]
+    return False, f"probe rc={p.returncode}: {tail[:160]}"
+
+
+def _run_child(env: dict, budget_s: float) -> tuple[dict | None, str]:
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--child"],
+            capture_output=True, text=True, timeout=budget_s,
+            cwd=REPO, env=env)
+    except subprocess.TimeoutExpired:
+        return None, f"child timeout after {budget_s:.0f}s"
+    except Exception as e:                      # noqa: BLE001
+        return None, f"child error: {str(e)[:160]}"
+    for line in (p.stderr or "").strip().splitlines()[-4:]:
+        print(f"# child: {line}", file=sys.stderr)
+    for line in reversed((p.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), "ok"
+            except json.JSONDecodeError:
+                continue
+    tail = ((p.stderr or "").strip().splitlines() or ["no output"])[-1]
+    return None, f"child rc={p.returncode}: {tail[:160]}"
+
+
+def main():
+    t0 = time.time()
+    forced_cpu = os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+    note = "JAX_PLATFORMS=cpu set by caller"
+    tpu_ok = False
+    if not forced_cpu:
+        tpu_ok, note = _probe_tpu()
+    out = None
+    if tpu_ok:
+        out, child_note = _run_child(dict(os.environ), TPU_BUDGET_S)
+        if out is None:
+            note = child_note
+    if out is None:
+        out, child_note = _run_child(_cpu_env(), CPU_BUDGET_S)
+        if out is None:           # even the CPU legs failed: still a line
+            out = {"metric": "ec_encode_k8m3_1MiB_GBps", "value": 0,
+                   "unit": "GB/s", "vs_baseline": 0,
+                   "error": f"cpu legs: {child_note}"}
+        if forced_cpu:
+            out["tpu"] = "skipped (JAX_PLATFORMS=cpu)"
+        elif tpu_ok:
+            # relay answered the probe; the measurement child is what
+            # failed — do not misreport a budget overrun as an outage
+            out["tpu"] = f"probe ok, measurement failed: {note}"
+        else:
+            out["tpu"] = "unreachable"
+            out["tpu_probe"] = note
+    out["bench_wall_s"] = round(time.time() - t0, 1)
+    print(json.dumps(out))
+
+
+# --------------------------------------------------------------------------
+# child: the actual measurement (runs under the parent's deadline)
+# --------------------------------------------------------------------------
 
 def _native_ec():
     from ceph_tpu import native
@@ -40,6 +153,7 @@ def _native_ec():
 
 def _cpu_encode_gbps(coding, chunk, nat):
     from ceph_tpu.ops import rs
+    import numpy as np
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, size=(K, chunk), dtype=np.uint8)
     encode = nat.encode if nat else (lambda d: rs.encode_oracle(coding, d))
@@ -54,6 +168,7 @@ def _cpu_encode_gbps(coding, chunk, nat):
 
 def _cpu_decode_gbps(coding, chunk, nat):
     from ceph_tpu.ops import rs
+    import numpy as np
     rng = np.random.default_rng(1)
     data = rng.integers(0, 256, size=(K, chunk), dtype=np.uint8)
     parity = (nat.encode(data) if nat else rs.encode_oracle(coding, data))
@@ -75,10 +190,10 @@ def _cpu_decode_gbps(coding, chunk, nat):
     return (n * K * chunk) / dt / 1e9
 
 
-def _device_leg(gflin, data, logical_bytes):
+def _device_leg(gflin, data, logical_bytes, iters):
     """On-device throughput of a GFLinear map.
 
-    The ITERS applications are chained inside ONE jit (each iteration
+    The iterations are chained inside ONE jit (each iteration
     xor-folds its output back into the input) and completion is forced
     by fetching a checksum.  This is deliberate: through the axon
     relay, `block_until_ready` returns before execution finishes and
@@ -89,6 +204,7 @@ def _device_leg(gflin, data, logical_bytes):
     """
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     rows = gflin.m
 
@@ -99,7 +215,7 @@ def _device_leg(gflin, data, logical_bytes):
             r = min(rows, dd.shape[-2])
             return dd.at[..., :r, :].set(
                 jnp.bitwise_xor(dd[..., :r, :], p[..., :r, :]))
-        out = jax.lax.fori_loop(0, ITERS, body, d)
+        out = jax.lax.fori_loop(0, iters, body, d)
         return jnp.sum(out.astype(jnp.uint32))
 
     darr = jax.device_put(data)
@@ -108,16 +224,22 @@ def _device_leg(gflin, data, logical_bytes):
     t0 = time.perf_counter()
     int(loop(darr))
     dt = time.perf_counter() - t0
-    gbps = ITERS * logical_bytes / dt / 1e9
+    gbps = iters * logical_bytes / dt / 1e9
     # achieved int8 tensor-op rate: 2 * (8m)(8k) MACs per k input bytes
-    tops = ITERS * 2 * 64 * rows * logical_bytes / dt / 1e12
+    tops = iters * 2 * 64 * rows * logical_bytes / dt / 1e12
     return gbps, tops
 
 
-def _ec_sweep():
-    import jax
+def _ec_sweep(on_tpu: bool):
+    import numpy as np
     from ceph_tpu.ops import rs
     from ceph_tpu.ops.gf_jax import GFLinear
+
+    # CPU legs exist to prove the HARNESS end-to-end on a relay-down
+    # day, not to set records: shrink the launch so the child finishes
+    # well inside its budget
+    target_bytes = (64 << 20) if on_tpu else (8 << 20)
+    iters = 10 if on_tpu else 3
 
     coding = rs.reed_sol_van_matrix(K, M)
     nat, base_label = _native_ec()
@@ -129,14 +251,15 @@ def _ec_sweep():
     sweep = {}
     for size in SIZES:
         chunk = size // K
-        batch = max(1, TARGET_BYTES // size)
+        batch = max(1, target_bytes // size)
         data = rng.integers(0, 256, size=(batch, K, chunk),
                             dtype=np.uint8)
         # verify bytes BEFORE timing (stripe 0 vs oracle)
         parity0 = rs.encode_oracle(coding, data[0])
         got = np.asarray(enc(data[:2]))[0]
         assert np.array_equal(got, parity0), f"parity mismatch @{size}"
-        e_gbps, e_tops = _device_leg(enc, data, batch * K * chunk)
+        e_gbps, e_tops = _device_leg(enc, data, batch * K * chunk,
+                                     iters)
 
         # decode leg input: each stripe's k surviving shards (ids in
         # `surv`; parity identical across stripes would be unrealistic,
@@ -152,13 +275,16 @@ def _ec_sweep():
                 sdata[min(batch, 3):, j] = parity[0, s - K]
         got0 = np.asarray(dec(sdata[:2]))[0]
         assert np.array_equal(got0, data[0]), f"decode mismatch @{size}"
-        d_gbps, d_tops = _device_leg(dec, sdata, batch * K * chunk)
+        d_gbps, d_tops = _device_leg(dec, sdata, batch * K * chunk,
+                                     iters)
 
         e_base = _cpu_encode_gbps(coding, chunk, nat)
         d_base = _cpu_decode_gbps(coding, chunk, nat)
         sweep[str(size)] = {
             "encode_GBps": round(e_gbps, 3),
             "decode_GBps": round(d_gbps, 3),
+            "encode_baseline_GBps": round(e_base, 3),
+            "decode_baseline_GBps": round(d_base, 3),
             "encode_vs_baseline": round(e_gbps / e_base, 2),
             "decode_vs_baseline": round(d_gbps / d_base, 2),
             "encode_int8_TOPS": round(e_tops, 3),
@@ -177,20 +303,14 @@ def _crush_leg():
         return {"error": str(e)[:200]}
 
 
-def main():
-    try:
-        from ceph_tpu.utils import honor_jax_platforms_env
-        honor_jax_platforms_env()
-        import jax
-    except Exception as e:
-        print(json.dumps({"metric": "ec_encode_k8m3_1MiB_GBps",
-                          "value": 0, "unit": "GB/s",
-                          "vs_baseline": 0,
-                          "error": f"jax init: {str(e)[:200]}"}))
-        return
+def child_main():
+    from ceph_tpu.utils import honor_jax_platforms_env
+    honor_jax_platforms_env()
+    import jax
 
+    on_tpu = jax.default_backend() == "tpu"
     try:
-        sweep, base_label, backend = _ec_sweep()
+        sweep, base_label, backend = _ec_sweep(on_tpu)
         head = sweep[str(1 << 20)]
         out = {
             "metric": "ec_encode_k8m3_1MiB_GBps",
@@ -199,21 +319,28 @@ def main():
             "vs_baseline": head["encode_vs_baseline"],
             "baseline": base_label,
             "backend": backend,
+            "platform": jax.default_backend(),
             "sweep": sweep,
         }
-    except Exception as e:      # still emit a line the driver can log
+    except Exception as e:      # still emit a line the parent can use
         out = {"metric": "ec_encode_k8m3_1MiB_GBps", "value": 0,
                "unit": "GB/s", "vs_baseline": 0,
+               "platform": jax.default_backend(),
                "error": str(e)[:300]}
+    if not on_tpu and "CRUSH_BENCH_BUDGET_S" not in os.environ:
+        os.environ["CRUSH_BENCH_BUDGET_S"] = "30"
     out["crush"] = _crush_leg()
     print(json.dumps(out))
     try:
         dev = jax.devices()[0].device_kind
-    except Exception:
+    except Exception:                           # noqa: BLE001
         dev = "unknown"
-    print(f"# device={dev} backend={out.get('backend')} iters={ITERS} "
+    print(f"# device={dev} backend={out.get('backend')} "
           f"baseline={out.get('baseline')}", file=sys.stderr)
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv[1:]:
+        child_main()
+    else:
+        main()
