@@ -51,6 +51,12 @@ def _load():
         lib.ec_decode.argtypes = [ctypes.c_void_p,
                                   ctypes.POINTER(ctypes.c_int), u8p, u8p,
                                   ctypes.c_size_t]
+        lib.gf256_rs_encode_batch.restype = None
+        lib.gf256_rs_encode_batch.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, u8p, u8p,
+            ctypes.c_size_t, ctypes.c_size_t]
+        lib.gf256_set_tier.restype = ctypes.c_int
+        lib.gf256_set_tier.argtypes = [ctypes.c_int]
         lib.ec_ring_create.restype = ctypes.c_void_p
         lib.ec_ring_create.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
                                        ctypes.c_size_t]
@@ -123,6 +129,12 @@ def gf256_mul_table() -> np.ndarray:
     return np.ctypeslib.as_array(ptr, shape=(256, 256)).copy()
 
 
+def gf256_set_tier(tier: int) -> int:
+    """Force the region-kernel dispatch tier (0=auto, 1=scalar,
+    2=avx2, 3=gfni) for tests; → tier in force, -1 if unavailable."""
+    return _load().gf256_set_tier(tier)
+
+
 class NativeEC:
     """The native plugin instance + coalescing ring, Python view."""
 
@@ -169,6 +181,36 @@ class NativeEC:
         if rc:
             raise RuntimeError("ec_encode failed")
         return parity
+
+    def encode_batch(self, data: np.ndarray,
+                     matrix: np.ndarray | None = None) -> np.ndarray:
+        """data [B, k, chunk] uint8 → out [B, rows, chunk], one
+        library call for the whole batch — the fair denominator for
+        small stripes, where per-call ctypes overhead would otherwise
+        dominate the measurement (the reference benchmark's loop is
+        all inside one C process).  With `matrix` (any [rows, k]
+        GF(2^8) matrix) the same region kernel applies that map
+        instead of the coding matrix — decode is exactly this with
+        the inverted survivor submatrix."""
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        b, k, chunk = data.shape
+        if k != self.k:
+            raise ValueError(f"data rows {k} != k={self.k}")
+        mat = (np.ascontiguousarray(self.coding_matrix())
+               if matrix is None
+               else np.ascontiguousarray(matrix, dtype=np.uint8))
+        if mat.ndim != 2 or mat.shape[1] != self.k:
+            raise ValueError(
+                f"matrix shape {mat.shape} incompatible with k={self.k}")
+        rows = mat.shape[0]
+        if not 1 <= rows <= 256:
+            # the C encode path stages at most 256 row pointers
+            raise ValueError(f"matrix rows {rows} out of range 1..256")
+        out = np.empty((b, rows, chunk), dtype=np.uint8)
+        self._lib.gf256_rs_encode_batch(
+            _as_u8p(mat), self.k, rows, _as_u8p(data),
+            _as_u8p(out), chunk, b)
+        return out
 
     def decode(self, chunks: dict[int, np.ndarray]) -> np.ndarray:
         """any k survivors → data [k, chunk]."""

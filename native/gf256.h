@@ -34,10 +34,16 @@ const uint8_t *gf256_inv_table(void);   /* [256] */
 uint8_t gf256_mul(uint8_t a, uint8_t b);
 
 /* region ops: dst[i] (op)= src[i] * c over GF(2^8), n bytes.
- * The inner loop is a 2x 256-byte table pair (low/high nibble) walk the
- * compiler autovectorizes with pshufb-style gathers where available. */
+ * Dispatched over self-checked SIMD tiers: GFNI/AVX-512
+ * (vgf2p8affineqb bit-matrix), AVX2 vpshufb split-nibble (the
+ * gf-complete technique), scalar fallback. */
 void gf256_region_mul(uint8_t *dst, const uint8_t *src, uint8_t c,
                       size_t n);
+
+/* Force a dispatch tier for testing: 0=auto, 1=scalar, 2=avx2,
+ * 3=gfni.  Returns the tier now in force, or -1 if the requested
+ * tier is unavailable on this CPU (state unchanged). */
+int gf256_set_tier(int tier);
 void gf256_region_mul_xor(uint8_t *dst, const uint8_t *src, uint8_t c,
                           size_t n);
 
