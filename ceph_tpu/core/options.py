@@ -42,6 +42,45 @@ def build_options() -> list[Option]:
                "whose gap exceeds the log is backfilled)"),
         Option("osd_op_queue", str, "wpq", "op scheduler",
                enum_allowed=("wpq", "mclock")),
+        # dmclock QoS knobs (reference osd_mclock_scheduler_*): per
+        # op class, reservation (guaranteed ops/s, 0=none), weight
+        # (share of the excess), limit (ops/s ceiling, 0=none)
+        Option("osd_mclock_scheduler_client_res", float, 200.0,
+               "client ops: reserved ops/s",
+               min=0.0),
+        Option("osd_mclock_scheduler_client_wgt", float, 100.0,
+               "client ops: weight",
+               min=0.0),
+        Option("osd_mclock_scheduler_client_lim", float, 0.0,
+               "client ops: limit ops/s (0 = unlimited)",
+               min=0.0),
+        Option("osd_mclock_scheduler_subop_res", float, 200.0,
+               "replication sub-ops: reserved ops/s",
+               min=0.0),
+        Option("osd_mclock_scheduler_subop_wgt", float, 100.0,
+               "replication sub-ops: weight",
+               min=0.0),
+        Option("osd_mclock_scheduler_subop_lim", float, 0.0,
+               "replication sub-ops: limit ops/s (0 = unlimited)",
+               min=0.0),
+        Option("osd_mclock_scheduler_recovery_res", float, 20.0,
+               "recovery: reserved ops/s",
+               min=0.0),
+        Option("osd_mclock_scheduler_recovery_wgt", float, 10.0,
+               "recovery: weight",
+               min=0.0),
+        Option("osd_mclock_scheduler_recovery_lim", float, 200.0,
+               "recovery: limit ops/s (0 = unlimited)",
+               min=0.0),
+        Option("osd_mclock_scheduler_scrub_res", float, 5.0,
+               "scrub: reserved ops/s",
+               min=0.0),
+        Option("osd_mclock_scheduler_scrub_wgt", float, 5.0,
+               "scrub: weight",
+               min=0.0),
+        Option("osd_mclock_scheduler_scrub_lim", float, 100.0,
+               "scrub: limit ops/s (0 = unlimited)",
+               min=0.0),
         Option("osd_recovery_max_active", int, 3,
                "concurrent recovery ops per OSD"),
         Option("osd_scrub_interval", float, 86400.0,

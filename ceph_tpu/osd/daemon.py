@@ -41,7 +41,7 @@ from . import messages as M
 from .osdmap import OSDMap, PGid
 from .pg import PG, ECBackend, ReplicatedBackend, _WRITE_OPS
 from .scheduler import (CLIENT, PEERING, RECOVERY, SCRUB, SUBOP,
-                        WeightedPriorityQueue)
+                        make_op_queue)
 
 
 # message type → scheduler class (reference op_scheduler_class
@@ -137,12 +137,13 @@ class OSDaemon(Dispatcher):
         self._stats_last = 0.0
         self.timer = SafeTimer(f"osd.{whoami}-tick")
         self._tick_token = None
-        # the op scheduler (reference ShardedOpWQ + WPQ): dispatch
-        # classifies work, one worker drains by weighted priority so
-        # recovery/scrub storms can't bury client I/O (heartbeats
-        # bypass the queue entirely — their latency IS the failure
-        # detector)
-        self.op_queue = WeightedPriorityQueue()
+        # the op scheduler (reference ShardedOpWQ + OpScheduler):
+        # dispatch classifies work, one worker drains by weighted
+        # priority (wpq) or dmclock QoS tags (mclock) per
+        # `osd_op_queue`, so recovery/scrub storms can't bury client
+        # I/O (heartbeats bypass the queue entirely — their latency
+        # IS the failure detector)
+        self.op_queue = make_op_queue(self.config)
         self._op_worker = threading.Thread(
             target=self._op_worker_loop, name=f"osd.{whoami}-opwq",
             daemon=True)

@@ -1,23 +1,34 @@
-"""Op scheduler — weighted-priority dequeue of OSD work.
+"""Op schedulers — priority dequeue of OSD work.
 
-Reference behavior re-created (``src/osd/scheduler/OpScheduler.h`` /
+Reference behavior re-created (``src/osd/scheduler/OpScheduler.h``,
+``src/osd/scheduler/mClockScheduler.cc`` + ``src/dmclock/``,
 ``src/common/WeightedPriorityQueue.h``; SURVEY.md §3.5): incoming work
 is classified (client ops, peer sub-ops, recovery, scrub, background)
-and drained by a scheduler that picks among non-empty priority classes
-with probability proportional to weight — strict priority for the
-highest class would starve recovery; pure FIFO would let recovery
-storms bury client I/O.  This is the WPQ flavor; the reference's
-mClock QoS scheduler is a possible future refinement.
+and drained by a scheduler that keeps recovery storms from burying
+client I/O.  Two flavors behind ``osd_op_queue``:
 
-Deterministic weighted round-robin (no RNG): each class accrues
-credit += weight on every dequeue round; the non-empty class with the
-most credit is served and pays cost 1.  Within a class, FIFO.
+- **wpq** (`WeightedPriorityQueue`): deterministic weighted
+  round-robin — each class accrues credit += weight per dequeue
+  round, the non-empty class with the most credit is served and pays
+  cost 1.  Within a class, FIFO.
+
+- **mclock** (`MClockScheduler`): dmclock-style QoS.  Every op gets
+  three tags at arrival — reservation (spaced 1/res apart: the
+  guaranteed minimum rate), proportional (spaced 1/weight: the excess
+  share), limit (spaced 1/lim: the cap).  Dequeue serves, in order:
+  any op whose reservation tag is due (earliest first — this is what
+  makes the minimum unconditionally hold under adverse load), else
+  the earliest proportional tag among classes not past their limit.
+  Peering traffic bypasses QoS entirely (the control plane IS the
+  failure detector's dependency; the reference gives it
+  ``op_scheduler_class::immediate``).
 """
 
 from __future__ import annotations
 
 import collections
 import threading
+import time
 
 # priority classes (reference op_scheduler_class)
 CLIENT = "client"          # MOSDOp
@@ -84,3 +95,170 @@ class WeightedPriorityQueue:
     def depths(self) -> dict[str, int]:
         with self._cv:
             return {c: len(q) for c, q in self._queues.items() if q}
+
+
+_MCLOCK_FALLBACK = (0.0, 1.0, 0.0)      # unknown classes: weight-only
+_INF = float("inf")
+
+
+def default_mclock_profiles() -> dict[str, tuple[float, float,
+                                                 float]]:
+    """The balanced profile, read from the option-table defaults so
+    there is exactly ONE source of truth for the per-class
+    (res ops/s, weight, limit ops/s) triples (0 ⇒ no reservation /
+    no limit): client and replication sub-ops share the bulk,
+    recovery gets a floor so it always makes progress but a ceiling
+    so a storm cannot take over, scrub is best-effort."""
+    from ..core.config import ConfigProxy
+    from ..core.options import build_options
+    return profiles_from_config(ConfigProxy(build_options()))
+
+
+class MClockScheduler:
+    """dmclock single-server scheduler with the same blocking-queue
+    surface as `WeightedPriorityQueue` (enqueue/dequeue/close/len/
+    depths), so the OSD op worker is scheduler-agnostic.
+
+    `clock` is injectable so tests drive virtual time and assert the
+    reservation/limit behavior deterministically.
+    """
+
+    def __init__(self,
+                 profiles: dict[str, tuple[float, float, float]]
+                 | None = None,
+                 clock=time.monotonic):
+        self.profiles = dict(profiles or default_mclock_profiles())
+        self.clock = clock
+        # per class: deque of (r_tag, p_tag, l_tag, item)
+        self._queues: dict[str, collections.deque] = {}
+        self._prev: dict[str, tuple[float, float, float]] = {}
+        self._peering: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def enqueue(self, klass: str, item):
+        with self._cv:
+            if klass == PEERING:
+                self._peering.append(item)
+                self._cv.notify()
+                return
+            now = self.clock()
+            res, wgt, lim = self.profiles.get(klass, _MCLOCK_FALLBACK)
+            pr, pp, pl = self._prev.get(klass, (-_INF, -_INF, -_INF))
+            r = max(now, pr + 1.0 / res) if res > 0 else _INF
+            p = max(now, pp + 1.0 / max(wgt, 1e-9))
+            lt = max(now, pl + 1.0 / lim) if lim > 0 else 0.0
+            self._prev[klass] = (r if res > 0 else pr, p, lt)
+            self._queues.setdefault(klass,
+                                    collections.deque()).append(
+                (r, p, lt, item))
+            self._cv.notify()
+
+    def _pick(self, now: float):
+        """→ (klass, item) to serve now, or (None, wake_at)."""
+        if self._peering:
+            return PEERING, self._peering.popleft()
+        best_r = best_p = None
+        wake = _INF
+        for c, q in self._queues.items():
+            if not q:
+                continue
+            r_tag, p_tag, l_tag, _ = q[0]
+            if r_tag <= now:
+                if best_r is None or r_tag < best_r[0]:
+                    best_r = (r_tag, c)
+            elif r_tag < _INF:
+                wake = min(wake, r_tag)
+            if l_tag <= now:
+                if best_p is None or p_tag < best_p[0]:
+                    best_p = (p_tag, c)
+            else:
+                wake = min(wake, l_tag)
+        choice = best_r or best_p
+        if choice is None:
+            return None, wake
+        c = choice[1]
+        _, _, _, item = self._queues[c].popleft()
+        return c, item
+
+    def dequeue(self, timeout: float | None = None):
+        """→ (class, item) or None on timeout/close."""
+        deadline = (None if timeout is None
+                    else self.clock() + timeout)
+        with self._cv:
+            while True:
+                now = self.clock()
+                klass, item_or_wake = self._pick(now)
+                if klass is not None:
+                    return klass, item_or_wake
+                if self._closed and not len(self):
+                    return None
+                if deadline is not None and now >= deadline:
+                    return None
+                # sleep until the earliest due tag, the deadline, or a
+                # new arrival — whichever first (wake > now holds: any
+                # due tag would have been picked above)
+                waits = [w - now for w in (item_or_wake, deadline)
+                         if w is not None and w < _INF]
+                self._cv.wait(min(waits) if waits else None)
+
+    def reload_profiles(self, profiles: dict[str, tuple[float, float,
+                                                        float]]):
+        """Apply new QoS triples to a LIVE scheduler (runtime
+        `config set osd_mclock_scheduler_*`).  Already-queued ops
+        keep their tags; new arrivals use the new spacing (max(now,
+        prev+1/rate) re-converges immediately)."""
+        with self._cv:
+            self.profiles.update(profiles)
+            self._cv.notify_all()
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def __len__(self):
+        with self._cv:
+            return (len(self._peering)
+                    + sum(len(q) for q in self._queues.values()))
+
+    def depths(self) -> dict[str, int]:
+        with self._cv:
+            d = {c: len(q) for c, q in self._queues.items() if q}
+            if self._peering:
+                d[PEERING] = len(self._peering)
+            return d
+
+
+def profiles_from_config(config) -> dict[str, tuple[float, float,
+                                                    float]]:
+    """Read the osd_mclock_scheduler_* option family."""
+    out = {}
+    for klass, opt in ((CLIENT, "client"), (SUBOP, "subop"),
+                       (RECOVERY, "recovery"), (SCRUB, "scrub")):
+        out[klass] = (
+            float(config.get(f"osd_mclock_scheduler_{opt}_res")),
+            float(config.get(f"osd_mclock_scheduler_{opt}_wgt")),
+            float(config.get(f"osd_mclock_scheduler_{opt}_lim")))
+    return out
+
+
+def make_op_queue(config):
+    """The `osd_op_queue` seam (reference OpScheduler::make_scheduler):
+    the option enum is honest — "mclock" builds the QoS scheduler,
+    and the osd_mclock_scheduler_* knobs stay live via config
+    observers (a `config set` on a running daemon retunes the queue,
+    matching the reference's runtime-adjustable dmclock options)."""
+    kind = config.get("osd_op_queue")
+    if kind == "mclock":
+        q = MClockScheduler(profiles_from_config(config))
+
+        def _retune(_name, _val):
+            q.reload_profiles(profiles_from_config(config))
+
+        for opt in ("client", "subop", "recovery", "scrub"):
+            for suffix in ("res", "wgt", "lim"):
+                config.add_observer(
+                    f"osd_mclock_scheduler_{opt}_{suffix}", _retune)
+        return q
+    return WeightedPriorityQueue()

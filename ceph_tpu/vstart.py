@@ -34,7 +34,11 @@ def _free_ports(n: int) -> list[int]:
 
 class MiniCluster:
     def __init__(self, n_mons: int = 3, n_osds: int = 3, *,
-                 osd_stores=None, mon_stores=None):
+                 osd_stores=None, mon_stores=None,
+                 osd_config: dict | None = None):
+        # option overrides applied to every OSD BEFORE construction
+        # (some, e.g. osd_op_queue, are consumed in the ctor)
+        self._osd_config = dict(osd_config or {})
         ports = _free_ports(n_mons)
         self.monmap = MonMap(mons={r: EntityAddr("127.0.0.1", ports[r])
                                    for r in range(n_mons)})
@@ -66,7 +70,14 @@ class MiniCluster:
 
     def start_osd(self, i: int, timeout: float = 30.0) -> OSDaemon:
         store = self._osd_stores[i] if self._osd_stores else None
-        osd = OSDaemon(i, self.monmap, store=store)
+        cfg = None
+        if self._osd_config:
+            from .core.config import ConfigProxy
+            from .core.options import build_options
+            cfg = ConfigProxy(build_options())
+            for k, v in self._osd_config.items():
+                cfg.set(k, v)
+        osd = OSDaemon(i, self.monmap, store=store, config=cfg)
         osd.start(wait_for_up=True, timeout=timeout)
         self.osds[i] = osd
         return osd
