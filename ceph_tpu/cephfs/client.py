@@ -57,17 +57,21 @@ class CephFS(Dispatcher):
 
     def __init__(self, monmap, fs_name: str | None = None,
                  entity: str | None = None,
-                 default_layout: FileLayout | None = None):
+                 default_layout: FileLayout | None = None,
+                 auth=None):
         self.monmap = monmap
         self.fs_name = fs_name
+        self.auth = auth
         # entity names MUST be process-unique: the MDS dedups
         # requests by (client, tid), and an id()-derived name can
         # recur when Python reuses a freed address — a later client
         # then gets answered from an earlier client's completed map
         self.entity = entity or f"client.fs{uuid.uuid4().hex[:12]}"
         self.default_layout = default_layout or FileLayout()
-        self.monc = MonClient(monmap, entity=self.entity)
-        self.msgr = Messenger(self.entity)
+        self.monc = MonClient(monmap, entity=self.entity, auth=auth)
+        self.msgr = Messenger(
+            self.entity,
+            **(auth.msgr_kwargs(self.entity) if auth else {}))
         self.msgr.add_dispatcher(self)
         self.rados: Rados | None = None
         self.data: IoCtx | None = None
@@ -106,7 +110,8 @@ class CephFS(Dispatcher):
             raise TimeoutError(f"no active MDS for {self.fs_name!r}")
         self.fscid = fs.fscid
         self.rados = Rados(self.monmap,
-                           name=f"{self.entity}-data").connect()
+                           name=f"{self.entity}-data",
+                           auth=self.auth).connect()
         self.data = IoCtx(self.rados, fs.data_pool, "")
         self._connect_mds(timeout, rank=0)
         self.mounted = True

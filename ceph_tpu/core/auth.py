@@ -220,3 +220,47 @@ class ServiceVerifier:
         if not session.verify(nonce, authorizer["proof"]):
             raise AuthError("bad authorizer proof")
         return payload["entity"], session, payload["caps"]
+
+
+class ClusterAuth:
+    """Shared-secret security bundle for one cluster — the deployment
+    analog of a keyring file installed on every host (reference: each
+    daemon's on-disk keyring + the mon KDC; ``src/auth/cephx/``).
+
+    One service key; every daemon derives a `verifier()` for its
+    accepting side and a pre-issued `ticket(entity)` for its
+    connecting side, so any daemon can authenticate to any other.
+    Pair with ``Messenger(mode="secure")`` for AES-GCM frame
+    encryption keyed by the per-connection session key.
+    """
+
+    SERVICE = "cluster"
+
+    def __init__(self, secret: bytes | None = None):
+        self.key = CryptoKey(secret)
+
+    def verifier(self) -> ServiceVerifier:
+        return ServiceVerifier(self.SERVICE, self.key)
+
+    def ticket(self, entity: str,
+               ttl: float = TICKET_TTL) -> SessionTicket:
+        session = CryptoKey()
+        expires = time.time() + ttl
+        blob = json.dumps({
+            "entity": entity,
+            "session_key": session.to_str(),
+            "caps": "allow *",
+            "expires": expires,
+        }).encode()
+        return SessionTicket(entity, session,
+                             self.key.encrypt(blob, aad=b"ticket"),
+                             expires)
+
+    def msgr_kwargs(self, entity: str, mode: str = "secure") -> dict:
+        """Keyword bundle for ``Messenger(entity, **kwargs)``.  The
+        ticket is a FACTORY (re-minted per connection attempt): a
+        static ticket would expire after TICKET_TTL and leave every
+        later reconnect permanently refused."""
+        return {"verifier": self.verifier(),
+                "session_ticket": lambda: self.ticket(entity),
+                "mode": mode}

@@ -63,13 +63,17 @@ def _now() -> float:
 class MDSDaemon(Dispatcher):
     def __init__(self, name: str, monmap, *,
                  beacon_interval: float = 0.4,
-                 flush_interval: float = 2.0):
+                 flush_interval: float = 2.0, auth=None):
         self.name = name
         self.monmap = monmap
+        self.auth = auth
         self.beacon_interval = beacon_interval
         self.flush_interval = flush_interval
-        self.monc = MonClient(monmap, entity=f"mds.{name}")
-        self.msgr = Messenger(f"mds.{name}")
+        self.monc = MonClient(monmap, entity=f"mds.{name}",
+                              auth=auth)
+        self.msgr = Messenger(
+            f"mds.{name}",
+            **(auth.msgr_kwargs(f"mds.{name}") if auth else {}))
         self.msgr.add_dispatcher(self)
         self.lock = threading.RLock()
         self.state = "boot"           # boot / standby / active
@@ -249,7 +253,8 @@ class MDSDaemon(Dispatcher):
         fs = self.fsmap.filesystems[fscid]
         try:
             self.rados = Rados(self.monmap,
-                               name=f"client.mds-{self.name}").connect()
+                               name=f"client.mds-{self.name}",
+                               auth=self.auth).connect()
             self.meta = IoCtx(self.rados, fs.metadata_pool, "")
             self.data = IoCtx(self.rados, fs.data_pool, "")
             self.rank = rank

@@ -150,14 +150,17 @@ class MgrDaemon:
     def __init__(self, name: str, monmap, *,
                  beacon_interval: float = 0.4,
                  modules=None,
-                 asok_paths: dict[str, str] | None = None):
+                 asok_paths: dict[str, str] | None = None,
+                 auth=None):
         self.name = name
         self.monmap = monmap
+        self.auth = auth
         self.beacon_interval = beacon_interval
         self.module_classes = (modules if modules is not None
                                else _default_modules())
         self.asok_paths = dict(asok_paths or {})
-        self.monc = MonClient(monmap, entity=f"mgr.{name}")
+        self.monc = MonClient(monmap, entity=f"mgr.{name}",
+                              auth=auth)
         # observability (reference: the mgr serves its own asok)
         import os as _os
         from ..core.admin_socket import AdminSocket

@@ -40,7 +40,8 @@ class VolumesModule(MgrModule):
         fs = self._mounts.get(fs_name)
         if fs is None:
             from ..cephfs.client import CephFS
-            fs = CephFS(self.ctx._d.monmap, fs_name=fs_name).mount()
+            fs = CephFS(self.ctx._d.monmap, fs_name=fs_name,
+                        auth=getattr(self.ctx._d, "auth", None)).mount()
             self._mounts[fs_name] = fs
         return fs
 

@@ -21,11 +21,12 @@ from .monitor import MonMap
 
 class MonClient(Dispatcher):
     def __init__(self, monmap: MonMap, entity: str = "client.admin",
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, auth=None):
         self.monmap = monmap
         self.entity = entity
         self.timeout = timeout
-        self.msgr = Messenger(entity)
+        self.msgr = Messenger(
+            entity, **(auth.msgr_kwargs(entity) if auth else {}))
         self.msgr.add_dispatcher(self)
         self._con = None
         self._cur_rank: int | None = None

@@ -1372,13 +1372,15 @@ class HealthMonitor(PaxosService):
 class Monitor(Dispatcher):
     def __init__(self, rank: int, monmap: MonMap,
                  store: MonitorDBStore | None = None,
-                 tick_interval: float = 0.25):
+                 tick_interval: float = 0.25, auth=None):
         self.rank = rank
         self.name = f"mon.{rank}"
         self.monmap = monmap
         self.store = store if store is not None else MonitorDBStore()
         self.lock = threading.RLock()
-        self.msgr = Messenger(self.name)
+        self.msgr = Messenger(
+            self.name,
+            **(auth.msgr_kwargs(self.name) if auth else {}))
         self.msgr.add_dispatcher(self)
         self.elector = Elector(rank, monmap.ranks())
         self.paxos = Paxos(self.store, rank)

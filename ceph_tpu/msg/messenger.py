@@ -3,12 +3,22 @@
 Reference behavior re-created (``src/msg/async/AsyncMessenger.cc``,
 ``ProtocolV2.{h,cc}``, ``frames_v2``; SURVEY.md §3.2):
 
-- banner + hello exchange (entity name, address, features) on connect;
+- banner + hello exchange (entity name, address, features, mode) on
+  connect;
 - optional CephX-style authorizer check during the handshake
   (``core.auth``): the accepting side verifies the ticket, both sides
-  then share a session key and every frame carries an 8-byte signature
-  (the reference's "crc" vs "secure" modes map to sign=None/session);
-- frames: ``u32 len | u8 tag | payload | u32 crc [| 8B sig]``;
+  then share a session key;
+- connection modes, negotiated in the handshake and required to match
+  (the reference's ``ms_mode`` crc/secure pair,
+  ``ProtocolV2.cc``):
+  * **crc**: frames ``u32 len | u8 tag | u32 crc | payload [| 8B
+    sig]`` — integrity only; with a session key each frame is also
+    HMAC-signed;
+  * **secure**: post-handshake frames are AES-128-GCM encrypted with
+    the session key (nonce ‖ ciphertext ‖ gcm-tag, AAD = frame tag),
+    crc over the ciphertext; confidentiality AND tamper rejection —
+    a flipped bit fails the GCM tag and faults the transport.  Secure
+    mode refuses to come up without an authenticated session key.
 - per-connection ordered delivery with sequence numbers, acks, replay
   of unacked messages after reconnect, and receive-side dedup — the
   msgr2 session-resume contract;
@@ -96,6 +106,7 @@ class Connection:
         self.peer_nonce: int | None = None  # peer process incarnation
         self.outgoing = outgoing
         self.session_key: CryptoKey | None = None
+        self.secure = False          # negotiated AES-GCM frame mode
         self.out_seq = 0
         self.in_seq = 0
         self._unacked: dict[int, Message] = {}
@@ -145,12 +156,20 @@ class Connection:
                 # state stays for resume
                 w.transport.abort()
                 raise ConnectionError("injected socket failure")
-        crc = zlib.crc32(payload) & 0xFFFFFFFF
-        frame = struct.pack("<IBI", len(payload) + 5 +
-                            (8 if self.session_key else 0), tag, crc)
-        frame += payload
-        if self.session_key:
-            frame += self.session_key.sign(payload)
+        if self.secure:
+            # AES-GCM with the frame tag as AAD: moving a ciphertext
+            # under a different tag fails authentication, same as a
+            # flipped payload bit
+            wire = self.session_key.encrypt(payload, aad=bytes([tag]))
+            crc = zlib.crc32(wire) & 0xFFFFFFFF
+            frame = struct.pack("<IBI", len(wire) + 5, tag, crc) + wire
+        else:
+            crc = zlib.crc32(payload) & 0xFFFFFFFF
+            frame = struct.pack("<IBI", len(payload) + 5 +
+                                (8 if self.session_key else 0), tag, crc)
+            frame += payload
+            if self.session_key:
+                frame += self.session_key.sign(payload)
         w.write(frame)
         await w.drain()
 
@@ -171,6 +190,18 @@ class Connection:
         hdr = await r.readexactly(9)
         length, tag, crc = struct.unpack("<IBI", hdr)
         body = await r.readexactly(length - 5)
+        if self.secure:
+            if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                raise ConnectionError("frame crc mismatch")
+            try:
+                payload = self.session_key.decrypt(body,
+                                                   aad=bytes([tag]))
+            except AuthError as e:
+                # tampered or spliced ciphertext: GCM authentication
+                # failed — poison the transport, never deliver
+                raise ConnectionError(f"secure frame rejected: {e}") \
+                    from None
+            return tag, payload
         siglen = 8 if self.session_key else 0
         payload = body[:len(body) - siglen]
         if zlib.crc32(payload) & 0xFFFFFFFF != crc:
@@ -306,13 +337,30 @@ class Messenger:
                  keyring_key: CryptoKey | None = None,
                  verifier: ServiceVerifier | None = None,
                  session_ticket=None,
+                 mode: str = "crc",
                  inject_socket_failures: int = 0,
                  reconnect: bool = True,
                  reconnect_backoff_max: float = 2.0,
                  max_queued: int = 4096):
         """`verifier` makes the accepting side demand an authorizer;
-        `session_ticket` (core.auth.SessionTicket) makes the connecting
-        side present one.  Both None ⇒ AUTH_NONE mode."""
+        `session_ticket` (core.auth.SessionTicket, or a zero-arg
+        callable returning one — a factory lets long-lived daemons
+        present FRESH tickets so reconnects keep working past the
+        ticket TTL) makes the connecting side present one.  Both
+        None ⇒ AUTH_NONE mode.
+
+        `mode` is the reference's ms_mode: "crc" (integrity) or
+        "secure" (AES-GCM frame encryption; requires auth on both
+        roles — secure peers refuse to talk to crc peers and vice
+        versa, so a cluster runs one mode)."""
+        if mode not in ("crc", "secure"):
+            raise ValueError(f"unknown ms_mode {mode!r}")
+        if mode == "secure" and verifier is None and \
+                session_ticket is None:
+            raise ValueError(
+                "secure mode requires auth (verifier and/or ticket): "
+                "there is no session key to encrypt with otherwise")
+        self.mode = mode
         self.entity_name = entity_name
         self.my_addr: EntityAddr | None = None
         self.verifier = verifier
@@ -438,14 +486,20 @@ class Messenger:
             "nonce": self._nonce,
             "in_seq": con.in_seq if resume else 0,
             "resume": resume,
+            "mode": self.mode,
         }
-        if self.session_ticket is not None:
+        # resolve the ticket per attempt: a factory re-mints, so a
+        # reconnect hours later presents a fresh (unexpired) ticket
+        ticket = (self.session_ticket()
+                  if callable(self.session_ticket)
+                  else self.session_ticket)
+        if ticket is not None:
             # ticket only; the proof answers the SERVER's challenge in
             # the next round (a client-chosen nonce would make captured
             # handshakes replayable)
             hello["authorizer"] = {
-                "entity": self.session_ticket.entity,
-                "ticket": self.session_ticket.ticket.hex(),
+                "entity": ticket.entity,
+                "ticket": ticket.ticket.hex(),
             }
         payload = json.dumps(hello).encode()
         w.write(struct.pack("<I", len(payload)) + payload)
@@ -455,9 +509,9 @@ class Messenger:
             raise ConnectionError("bad banner")
         reply = await _read_json(r)
         if "challenge" in reply:
-            if self.session_ticket is None:
+            if ticket is None:
                 raise ConnectionError("server demands auth, no ticket")
-            proof = self.session_ticket.session_key.sign(
+            proof = ticket.session_key.sign(
                 bytes.fromhex(reply["challenge"]))
             payload = json.dumps({"proof": proof.hex()}).encode()
             w.write(struct.pack("<I", len(payload)) + payload)
@@ -465,9 +519,16 @@ class Messenger:
             reply = await _read_json(r)
         if reply.get("error"):
             raise ConnectionError(f"handshake refused: {reply['error']}")
+        if reply.get("mode", "crc") != self.mode:
+            raise ConnectionError(
+                f"ms_mode mismatch: we={self.mode} "
+                f"peer={reply.get('mode', 'crc')}")
         con.peer_name = reply.get("entity")
-        if self.session_ticket is not None:
-            con.session_key = self.session_ticket.session_key
+        if ticket is not None:
+            con.session_key = ticket.session_key
+        con.secure = (self.mode == "secure")
+        if con.secure and con.session_key is None:
+            raise ConnectionError("secure mode without a session key")
         await con._start_io(r, w, reply.get("in_seq", 0))
 
     # -- accepting ---------------------------------------------------------
@@ -481,6 +542,16 @@ class Messenger:
             hello = await _read_json(r)
             session_key = None
             banner_sent = False
+            if hello.get("mode", "crc") != self.mode:
+                payload = json.dumps({
+                    "error": f"ms_mode mismatch: we={self.mode} "
+                             f"peer={hello.get('mode', 'crc')}"}
+                ).encode()
+                w.write(BANNER + struct.pack("<I", len(payload))
+                        + payload)
+                await w.drain()
+                w.close()
+                return
             if self.verifier is not None:
                 try:
                     authz = hello.get("authorizer")
@@ -540,7 +611,20 @@ class Messenger:
             for d in self.dispatchers:
                 d.ms_handle_accept(con)
         con.session_key = session_key
-        reply = {"entity": self.entity_name, "in_seq": con.in_seq}
+        con.secure = (self.mode == "secure")
+        if con.secure and session_key is None:
+            # secure without an authenticated key is a contradiction;
+            # the ctor enforces verifier-presence, so this only trips
+            # if auth was skipped by a code path change — refuse loudly
+            payload = json.dumps(
+                {"error": "secure mode without session key"}).encode()
+            prefix = b"" if banner_sent else BANNER
+            w.write(prefix + struct.pack("<I", len(payload)) + payload)
+            await w.drain()
+            w.close()
+            return
+        reply = {"entity": self.entity_name, "in_seq": con.in_seq,
+                 "mode": self.mode}
         payload = json.dumps(reply).encode()
         prefix = b"" if banner_sent else BANNER
         w.write(prefix + struct.pack("<I", len(payload)) + payload)

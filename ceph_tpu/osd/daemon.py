@@ -90,15 +90,21 @@ class OSDaemon(Dispatcher):
                  heartbeat_interval: float = 0.5,
                  heartbeat_grace: float = 3.0,
                  config: ConfigProxy | None = None,
-                 admin_socket_path: str | None = None):
+                 admin_socket_path: str | None = None,
+                 auth=None):
         self.whoami = whoami
         self.monmap = monmap
         # every knob below reads through the typed option table
         # (reference md_config_t; ctor kwargs land as overrides so
         # `config set` / injectargs can retune a live daemon)
         self.config = config or ConfigProxy(build_options())
-        self.config.set("osd_heartbeat_interval", heartbeat_interval)
-        self.config.set("osd_heartbeat_grace", heartbeat_grace)
+        # ctor kwargs are the TEST-friendly fast defaults, but an
+        # explicit override already present in a caller-supplied
+        # config (MiniCluster osd_config=...) wins — do not clobber it
+        for key, val in (("osd_heartbeat_interval", heartbeat_interval),
+                         ("osd_heartbeat_grace", heartbeat_grace)):
+            if self.config.source_of(key) == "default":
+                self.config.set(key, val)
         self.perf = _build_osd_perf(f"osd.{whoami}")
         self.op_tracker = OpTracker()
         self.admin_socket = AdminSocket(
@@ -107,9 +113,12 @@ class OSDaemon(Dispatcher):
         self._register_admin_commands()
         self.store = store if store is not None else MemStore(
             name=f"osd.{whoami}")
-        self.msgr = Messenger(f"osd.{whoami}")
+        self.msgr = Messenger(
+            f"osd.{whoami}",
+            **(auth.msgr_kwargs(f"osd.{whoami}") if auth else {}))
         self.msgr.add_dispatcher(self)
-        self.monc = MonClient(monmap, entity=f"osd.{whoami}")
+        self.monc = MonClient(monmap, entity=f"osd.{whoami}",
+                              auth=auth)
         self.osdmap = OSDMap()
         self.pgs: dict[PGid, PG] = {}
         # interval history per PG, built by walking EVERY map epoch in

@@ -123,11 +123,25 @@ class MClockScheduler:
     reservation/limit behavior deterministically.
     """
 
+    @staticmethod
+    def _normalize(profiles):
+        """dmclock invariant: reservation ≤ limit.  The reservation
+        path serves whenever its tag is due, bypassing the limit
+        check, so res > lim would silently void the cap — clamp to
+        keep the operator's ceiling authoritative."""
+        out = {}
+        for klass, (res, wgt, lim) in profiles.items():
+            if lim > 0:
+                res = min(res, lim)
+            out[klass] = (res, wgt, lim)
+        return out
+
     def __init__(self,
                  profiles: dict[str, tuple[float, float, float]]
                  | None = None,
                  clock=time.monotonic):
-        self.profiles = dict(profiles or default_mclock_profiles())
+        self.profiles = self._normalize(
+            profiles or default_mclock_profiles())
         self.clock = clock
         # per class: deque of (r_tag, p_tag, l_tag, item)
         self._queues: dict[str, collections.deque] = {}
@@ -209,7 +223,7 @@ class MClockScheduler:
         keep their tags; new arrivals use the new spacing (max(now,
         prev+1/rate) re-converges immediately)."""
         with self._cv:
-            self.profiles.update(profiles)
+            self.profiles.update(self._normalize(profiles))
             self._cv.notify_all()
 
     def close(self):

@@ -55,14 +55,16 @@ class Completion:
 class Rados:
     """Cluster handle (reference ``librados::Rados``)."""
 
-    def __init__(self, monmap, name: str = "client.admin"):
+    def __init__(self, monmap, name: str = "client.admin", auth=None):
         self.monmap = monmap
         self.name = name
-        self.monc = MonClient(monmap, entity=name)
+        self.auth = auth
+        self.monc = MonClient(monmap, entity=name, auth=auth)
         self.objecter: Objecter | None = None
 
     def connect(self, timeout: float = 15.0):
-        self.objecter = Objecter(self.monmap, entity=self.name)
+        self.objecter = Objecter(self.monmap, entity=self.name,
+                                 auth=self.auth)
         self.objecter.wait_for_osdmap(1, timeout)
         return self
 

@@ -45,15 +45,16 @@ class _Op:
 
 class Objecter(Dispatcher):
     def __init__(self, monmap, entity: str = "client.objecter", *,
-                 resend_interval: float = 2.0):
+                 resend_interval: float = 2.0, auth=None):
         # a per-session nonce joins the entity name in every reqid:
         # two sessions of the same client name must never collide in
         # the OSDs' dup-op log (the reference's osd_reqid_t carries
         # the session GID the mon hands out at authentication)
         import uuid
         self.entity = f"{entity}:{uuid.uuid4().hex[:12]}"
-        self.monc = MonClient(monmap, entity=entity)
-        self.msgr = Messenger(entity)
+        self.monc = MonClient(monmap, entity=entity, auth=auth)
+        self.msgr = Messenger(
+            entity, **(auth.msgr_kwargs(entity) if auth else {}))
         self.msgr.add_dispatcher(self)
         self.osdmap = OSDMap()
         self.lock = threading.RLock()

@@ -35,15 +35,25 @@ def _free_ports(n: int) -> list[int]:
 class MiniCluster:
     def __init__(self, n_mons: int = 3, n_osds: int = 3, *,
                  osd_stores=None, mon_stores=None,
-                 osd_config: dict | None = None):
+                 osd_config: dict | None = None,
+                 secure: bool = False):
         # option overrides applied to every OSD BEFORE construction
         # (some, e.g. osd_op_queue, are consumed in the ctor)
         self._osd_config = dict(osd_config or {})
+        # secure=True: one ClusterAuth (the deployed-keyring analog)
+        # shared by every daemon and client; all messengers run
+        # ms_mode=secure (AES-GCM frames) — reference ProtocolV2
+        # secure mode cluster-wide
+        self.auth = None
+        if secure:
+            from .core.auth import ClusterAuth
+            self.auth = ClusterAuth()
         ports = _free_ports(n_mons)
         self.monmap = MonMap(mons={r: EntityAddr("127.0.0.1", ports[r])
                                    for r in range(n_mons)})
         self.mons = [Monitor(r, self.monmap,
-                             store=mon_stores[r] if mon_stores else None)
+                             store=mon_stores[r] if mon_stores else None,
+                             auth=self.auth)
                      for r in range(n_mons)]
         self._osd_stores = osd_stores
         self.osds: dict[int, OSDaemon] = {}
@@ -77,7 +87,8 @@ class MiniCluster:
             cfg = ConfigProxy(build_options())
             for k, v in self._osd_config.items():
                 cfg.set(k, v)
-        osd = OSDaemon(i, self.monmap, store=store, config=cfg)
+        osd = OSDaemon(i, self.monmap, store=store, config=cfg,
+                       auth=self.auth)
         osd.start(wait_for_up=True, timeout=timeout)
         self.osds[i] = osd
         return osd
@@ -105,6 +116,7 @@ class MiniCluster:
     # -- mgr ---------------------------------------------------------------
     def start_mgr(self, name: str, **kw):
         from .mgr.daemon import MgrDaemon
+        kw.setdefault("auth", self.auth)
         mgr = MgrDaemon(name, self.monmap, **kw).start()
         self.mgrs[name] = mgr
         return mgr
@@ -123,6 +135,7 @@ class MiniCluster:
 
     # -- mds / cephfs ------------------------------------------------------
     def start_mds(self, name: str, **kw) -> MDSDaemon:
+        kw.setdefault("auth", self.auth)
         mds = MDSDaemon(name, self.monmap, **kw).start()
         self.mdss[name] = mds
         return mds
@@ -146,6 +159,7 @@ class MiniCluster:
 
     def cephfs(self, fs_name: str = "cephfs", **kw):
         from .cephfs.client import CephFS
+        kw.setdefault("auth", self.auth)
         fs = CephFS(self.monmap, fs_name=fs_name, **kw).mount()
         self._fs_clients.append(fs)
         return fs
@@ -201,7 +215,7 @@ class MiniCluster:
 
     # -- clients -----------------------------------------------------------
     def rados(self, name: str = "client.admin") -> Rados:
-        r = Rados(self.monmap, name=name).connect()
+        r = Rados(self.monmap, name=name, auth=self.auth).connect()
         self._clients.append(r)
         return r
 

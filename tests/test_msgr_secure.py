@@ -1,0 +1,300 @@
+"""msgr2 secure mode — AES-GCM frame encryption (reference
+ProtocolV2.cc secure mode; VERDICT r3 missing #2).
+
+Proof obligations:
+- confidentiality: a wire sniffer between two secure peers never sees
+  the message plaintext (it DOES see it in crc mode — the control);
+- tamper rejection: a flipped ciphertext bit or a frame spliced under
+  a different tag fails GCM authentication and never dispatches;
+- mode negotiation: secure↔crc pairs refuse each other loudly;
+- secure requires auth: no session key ⇒ constructor refusal;
+- the whole MiniCluster runs with secure mode on.
+"""
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.core.auth import AuthError, ClusterAuth, CryptoKey
+from ceph_tpu.msg import Dispatcher, MGenericPing, MGenericReply, Messenger
+
+SECRET = b"sixteen byte key"
+MARKER = "tell-no-one-secret-payload"
+
+
+class Collector(Dispatcher):
+    def __init__(self):
+        self.got = []
+        self.event = threading.Event()
+
+    def ms_dispatch(self, msg):
+        self.got.append(msg)
+        self.event.set()
+        return True
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class SniffingRelay:
+    """TCP proxy recording every byte both ways (the wire tap)."""
+
+    def __init__(self, target_host, target_port):
+        self.target = (target_host, target_port)
+        self.captured = bytearray()
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(4)
+        self.port = self._srv.getsockname()[1]
+        self._threads = []
+        self._stop = False
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                c, _ = self._srv.accept()
+            except OSError:
+                return
+            up = socket.create_connection(self.target)
+            for a, b in ((c, up), (up, c)):
+                t = threading.Thread(target=self._pump, args=(a, b),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def _pump(self, src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                self.captured.extend(data)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            try:
+                dst.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop = True
+        self._srv.close()
+
+
+def _authed_pair(mode):
+    auth = ClusterAuth(SECRET)
+    server = Messenger("osd.0", **auth.msgr_kwargs("osd.0", mode))
+    client = Messenger("client.a",
+                       **auth.msgr_kwargs("client.a", mode))
+    return server, client
+
+
+class TestConfidentiality:
+    @pytest.mark.parametrize("mode,leaks", [("crc", True),
+                                            ("secure", False)])
+    def test_wire_plaintext(self, mode, leaks):
+        server, client = _authed_pair(mode)
+        coll = Collector()
+        server.add_dispatcher(coll)
+        addr = server.bind()
+        relay = SniffingRelay(addr.host, addr.port)
+        try:
+            con = client.connect_to(type(addr)(
+                "127.0.0.1", relay.port))
+            assert con.secure == (mode == "secure")
+            con.send_message(MGenericReply(MARKER, 7))
+            assert wait_for(lambda: coll.got)
+            # delivered intact either way...
+            assert coll.got[0].what == MARKER
+            # ...but the wire only carries it in crc mode
+            assert (MARKER.encode() in bytes(relay.captured)) is leaks
+        finally:
+            relay.close()
+            client.shutdown()
+            server.shutdown()
+
+
+class TestTamper:
+    def _frame(self, key, tag, payload):
+        wire = key.encrypt(payload, aad=bytes([tag]))
+        import zlib
+        crc = zlib.crc32(wire) & 0xFFFFFFFF
+        return struct.pack("<IBI", len(wire) + 5, tag, crc) + wire
+
+    def _read(self, key, frame):
+        """Run Connection._read_frame against a crafted byte stream."""
+        from ceph_tpu.msg.messenger import Connection, Messenger
+
+        async def go():
+            r = asyncio.StreamReader()
+            r.feed_data(frame)
+            r.feed_eof()
+            con = Connection.__new__(Connection)
+            con.session_key = key
+            con.secure = True
+            return await con._read_frame(r)
+
+        return asyncio.run(go())
+
+    def test_clean_frame_decrypts(self):
+        key = CryptoKey(SECRET)
+        tag, payload = 4, b"payload-bytes"
+        got_tag, got = self._read(key, self._frame(key, tag, payload))
+        assert (got_tag, got) == (tag, payload)
+
+    def test_flipped_bit_rejected(self):
+        import zlib
+        key = CryptoKey(SECRET)
+        frame = bytearray(self._frame(key, 4, b"payload-bytes"))
+        frame[-3] ^= 0x01                   # corrupt ciphertext tail
+        # fix the transport crc so ONLY GCM can catch it
+        body = bytes(frame[9:])
+        frame[5:9] = struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+        with pytest.raises(ConnectionError, match="secure frame"):
+            self._read(key, bytes(frame))
+
+    def test_spliced_tag_rejected(self):
+        """Re-labeling a valid ciphertext under another tag must fail:
+        the frame tag is authenticated as AAD."""
+        import zlib
+        key = CryptoKey(SECRET)
+        frame = bytearray(self._frame(key, 4, b"payload-bytes"))
+        frame[4] = 5                        # TAG_MSG → TAG_ACK
+        body = bytes(frame[9:])
+        frame[5:9] = struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+        with pytest.raises(ConnectionError, match="secure frame"):
+            self._read(key, bytes(frame))
+
+    def test_wrong_key_rejected(self):
+        key = CryptoKey(SECRET)
+        other = CryptoKey(b"another 16b key!")
+        frame = self._frame(key, 4, b"payload-bytes")
+        with pytest.raises(ConnectionError, match="secure frame"):
+            self._read(other, frame)
+
+
+class TestNegotiation:
+    def test_secure_requires_auth(self):
+        with pytest.raises(ValueError, match="secure mode requires"):
+            Messenger("osd.0", mode="secure")
+        with pytest.raises(ValueError, match="unknown ms_mode"):
+            Messenger("osd.0", mode="tls")
+
+    def test_mode_mismatch_refused_both_ways(self):
+        auth = ClusterAuth(SECRET)
+        for smode, cmode in (("crc", "secure"), ("secure", "crc")):
+            server = Messenger("osd.0",
+                               **auth.msgr_kwargs("osd.0", smode))
+            client = Messenger("client.a",
+                               **auth.msgr_kwargs("client.a", cmode))
+            try:
+                addr = server.bind()
+                with pytest.raises(ConnectionError,
+                                   match="ms_mode mismatch"):
+                    client.connect_to(addr)
+            finally:
+                client.shutdown()
+                server.shutdown()
+
+
+class TestSecureCluster:
+    def test_minicluster_runs_secure(self):
+        """The whole control+data plane over encrypted frames: pool
+        create, replicated writes/reads, OSD kill/revive recovery."""
+        from ceph_tpu.vstart import MiniCluster
+        c = MiniCluster(n_mons=1, n_osds=3, secure=True)
+        try:
+            c.start()
+            # every daemon messenger is in secure mode
+            for osd in c.osds.values():
+                assert osd.msgr.mode == "secure"
+                assert all(con.secure
+                           for con in osd.msgr.connections
+                           if con.is_connected)
+            r = c.rados()
+            r.create_pool("sec", pg_num=4, size=3)
+            io = r.open_ioctx("sec")
+            c.wait_for_clean()
+            for i in range(10):
+                io.write_full(f"o{i}", f"v{i}".encode())
+            for i in range(10):
+                assert bytes(io.read(f"o{i}")) == f"v{i}".encode()
+            c.kill_osd(2)
+            c.wait_for_osd_down(2)
+            io.write_full("post-fail", b"still-works")
+            c.revive_osd(2)
+            c.wait_for_clean(timeout=60)
+        finally:
+            c.stop()
+
+
+class TestTicketRenewal:
+    def test_reconnect_after_ticket_expiry(self):
+        """A daemon alive past TICKET_TTL must still reconnect: the
+        ClusterAuth msgr bundle mints a FRESH ticket per attempt
+        (review r4: a static ticket partitioned the cluster after 1h)."""
+        auth = ClusterAuth(SECRET)
+        kw = auth.msgr_kwargs("client.a")
+        assert callable(kw["session_ticket"])
+        t1, t2 = kw["session_ticket"](), kw["session_ticket"]()
+        assert t1.ticket != t2.ticket          # fresh session keys
+        # an EXPIRED static ticket is refused by the verifier (control)
+        stale = auth.ticket("client.a", ttl=-1.0)
+        server = Messenger("osd.0", **auth.msgr_kwargs("osd.0"))
+        client = Messenger("client.a", verifier=auth.verifier(),
+                           session_ticket=stale, mode="secure")
+        try:
+            addr = server.bind()
+            with pytest.raises(ConnectionError):
+                client.connect_to(addr)
+            # the factory-based client connects fine
+            client2 = Messenger("client.a",
+                                **auth.msgr_kwargs("client.a"))
+            try:
+                con = client2.connect_to(addr)
+                assert con.secure
+            finally:
+                client2.shutdown()
+        finally:
+            client.shutdown()
+            server.shutdown()
+
+
+class TestOsdConfigNotClobbered:
+    def test_heartbeat_override_survives_ctor(self):
+        """MiniCluster osd_config heartbeat overrides must not be
+        clobbered by the OSDaemon ctor's kwarg defaults (review r4)."""
+        from ceph_tpu.core.config import ConfigProxy
+        from ceph_tpu.core.options import build_options
+        from ceph_tpu.osd.daemon import OSDaemon
+        from ceph_tpu.mon.monitor import MonMap
+        from ceph_tpu.msg.messenger import EntityAddr
+        cfg = ConfigProxy(build_options())
+        cfg.set("osd_heartbeat_grace", 10.0)
+        monmap = MonMap(mons={0: EntityAddr("127.0.0.1", 1)})
+        osd = OSDaemon(0, monmap, config=cfg)
+        try:
+            assert osd.config.get("osd_heartbeat_grace") == 10.0
+            assert osd._hb_grace == 10.0
+            # un-overridden option still takes the fast ctor default
+            assert osd.config.get("osd_heartbeat_interval") == 0.5
+        finally:
+            osd.msgr.shutdown()
+            osd.monc.shutdown()
+            osd.admin_socket.shutdown()
+            osd.timer.shutdown()

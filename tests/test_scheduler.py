@@ -218,3 +218,14 @@ class TestMClockCluster:
         assert q.profiles[CLIENT][0] == 55.0
         with pytest.raises(ConfigError):
             cfg.set("osd_mclock_scheduler_client_wgt", -100.0)
+
+    def test_reservation_clamped_to_limit(self):
+        """res > lim would let the reservation path void the cap —
+        the invariant res <= lim is enforced on install and reload."""
+        from ceph_tpu.osd.scheduler import CLIENT, MClockScheduler
+        s = MClockScheduler({CLIENT: (300.0, 10.0, 100.0)})
+        assert s.profiles[CLIENT] == (100.0, 10.0, 100.0)
+        s.reload_profiles({CLIENT: (500.0, 10.0, 50.0)})
+        assert s.profiles[CLIENT] == (50.0, 10.0, 50.0)
+        s.reload_profiles({CLIENT: (10.0, 10.0, 0.0)})   # no limit
+        assert s.profiles[CLIENT] == (10.0, 10.0, 0.0)
