@@ -5,9 +5,11 @@ frontend, ``rgw_op.cc`` op layer, ``rgw_rados.cc`` store; SURVEY.md
 §3.9), reduced to the core S3 data path:
 
 - buckets: ``PUT/DELETE /bucket``, ``GET /bucket`` lists keys
-  (XML ListBucketResult like S3); the bucket index is an omap on a
-  per-bucket index object (the reference's ``cls_rgw`` bucket-index
-  omap, without sharding);
+  (XML ListBucketResult like S3); the bucket index is **sharded**
+  across N omap objects by key hash (the reference's ``cls_rgw``
+  sharded bucket index): writes touch only the key's shard under a
+  per-shard lock — concurrent PUTs to different shards do not
+  serialize — and listings merge all shards;
 - objects: ``PUT/GET/HEAD/DELETE /bucket/key``; bytes live in RADOS
   objects ``<bucket>_<key>`` in the ``.rgw.data`` pool, metadata
   (size, etag) in the bucket index;
@@ -34,18 +36,30 @@ import hashlib
 import http.client
 import json
 import threading
+import zlib
 from xml.sax.saxutils import escape as _xesc
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..core.lockdep import Mutex
 from ..osdc.librados import ObjectNotFound
 
 DATA_POOL = ".rgw.data"
 META_POOL = ".rgw.meta"
 BUCKETS_OID = "buckets"          # omap: bucket name → meta json
+USERS_OID = "users"              # omap: uid → user json, ak\0<key> → uid
+
+
+DEFAULT_INDEX_SHARDS = 16       # reference rgw_override_bucket_index_max_shards
 
 
 def _index_oid(bucket: str) -> str:
+    """Legacy (pre-sharding) single index object; buckets whose meta
+    row carries no num_shards keep using it."""
     return f"index.{bucket}"
+
+
+def _shard_oid(bucket: str, shard: int) -> str:
+    return f"index.{bucket}.{shard:04x}"
 
 
 def _data_oid(bucket: str, key: str) -> str:
@@ -85,10 +99,13 @@ class RGWStore:
         # the frontend is a ThreadingHTTPServer: index/version-seq
         # read-modify-writes must not interleave (the reference gets
         # this atomicity from cls_rgw ops executing on the OSD).
-        # Named lockdep mutex: ordering violations against other
-        # named mutexes fail deterministically under tests
-        from ..core.lockdep import Mutex
-        self._lock = Mutex("rgwstore")
+        # Named lockdep mutexes; lock ORDER is shard → verseq, and
+        # ordering violations against other named mutexes fail
+        # deterministically under tests
+        self._lock = Mutex("rgwstore")          # buckets/multipart misc
+        self._locks_guard = threading.Lock()    # protects the maps below
+        self._shard_locks: dict[tuple, Mutex] = {}
+        self._ver_locks: dict[str, Mutex] = {}
 
     def _drop_parts(self, meta: dict | None):
         """Remove a manifest's part objects (nothing else references
@@ -99,15 +116,153 @@ class RGWStore:
             except Exception:
                 pass
 
+    # -- sharded bucket index ----------------------------------------------
+    # (reference cls_rgw: per-shard index objects whose omap ops run
+    # server-side; here the shard objects live in the meta pool and a
+    # per-shard host-side lock provides the RMW atomicity)
+    def _bucket_shards(self, bucket: str) -> int:
+        """Shard count from the bucket meta row; 0 ⇒ legacy single
+        index object (pre-sharding buckets keep working).  Read fresh
+        each time (single-row server-side fetch): caching here raced
+        create_bucket and went permanently stale across RGWStore
+        instances (gateway vs sync daemon vs radosgw-admin)."""
+        try:
+            row = self.meta.omap_get(BUCKETS_OID,
+                                     keys=[bucket]).get(bucket)
+        except ObjectNotFound:
+            row = None
+        return (int(json.loads(bytes(row)).get("num_shards", 0))
+                if row else 0)
+
+    def _key_shard(self, bucket: str, key: str) -> int:
+        n = self._bucket_shards(bucket)
+        return (zlib.crc32(key.encode()) % n) if n else 0
+
+    def _key_index_oid(self, bucket: str, key: str) -> str:
+        n = self._bucket_shards(bucket)
+        if not n:
+            return _index_oid(bucket)
+        return _shard_oid(bucket, zlib.crc32(key.encode()) % n)
+
+    def _all_index_oids(self, bucket: str) -> list[str]:
+        n = self._bucket_shards(bucket)
+        if not n:
+            return [_index_oid(bucket)]
+        return [_shard_oid(bucket, s) for s in range(n)]
+
+    def _index_get(self, bucket: str, key: str) -> dict | None:
+        try:
+            row = self.meta.omap_get(
+                self._key_index_oid(bucket, key), keys=[key]).get(key)
+        except ObjectNotFound:
+            return None
+        return json.loads(bytes(row)) if row else None
+
+    def _index_set(self, bucket: str, key: str, meta: dict):
+        self.meta.omap_set(self._key_index_oid(bucket, key),
+                           {key: json.dumps(meta).encode()})
+
+    def _index_rm(self, bucket: str, key: str):
+        self.meta.omap_rm_keys(self._key_index_oid(bucket, key),
+                               [key])
+
+    def _shard_lock(self, bucket: str, key: str):
+        """The write lock for `key`'s index shard: PUT/DELETE on
+        different shards proceed concurrently."""
+        sid = (bucket, self._key_shard(bucket, key))
+        with self._locks_guard:
+            lk = self._shard_locks.get(sid)
+            if lk is None:
+                lk = self._shard_locks[sid] = Mutex("rgw-shard")
+        return lk
+
+    def _ver_lock(self, bucket: str):
+        """Version-sequence lock (one per bucket); always taken INSIDE
+        the key's shard lock when both are needed."""
+        with self._locks_guard:
+            lk = self._ver_locks.get(bucket)
+            if lk is None:
+                lk = self._ver_locks[bucket] = Mutex("rgw-verseq")
+        return lk
+
+    # -- users (reference RGWUserAdminOp / rgw_user.cc) --------------------
+    # stored in the meta pool: "users" omap uid → user json, plus an
+    # access-key → uid row for O(1) SigV4 lookup
+    def create_user(self, uid: str, display_name: str = "") -> dict:
+        import secrets
+        with self._lock:
+            try:
+                rows = self.meta.omap_get(USERS_OID)
+            except ObjectNotFound:
+                rows = {}
+            if uid in rows:
+                return json.loads(bytes(rows[uid]))
+            user = {
+                "uid": uid,
+                "display_name": display_name or uid,
+                "access_key": secrets.token_hex(10).upper(),
+                "secret_key": secrets.token_urlsafe(30),
+            }
+            self.meta.omap_set(USERS_OID, {
+                uid: json.dumps(user).encode(),
+                f"ak\x00{user['access_key']}": uid.encode(),
+            })
+        return user
+
+    def get_user(self, uid: str) -> dict | None:
+        try:
+            row = self.meta.omap_get(USERS_OID, keys=[uid]).get(uid)
+        except ObjectNotFound:
+            return None
+        return json.loads(bytes(row)) if row else None
+
+    def list_users(self) -> list[dict]:
+        try:
+            rows = self.meta.omap_get(USERS_OID)
+        except ObjectNotFound:
+            return []
+        return sorted((json.loads(bytes(v)) for k, v in rows.items()
+                       if not k.startswith("ak\x00")),
+                      key=lambda u: u["uid"])
+
+    def remove_user(self, uid: str) -> bool:
+        with self._lock:
+            user = self.get_user(uid)
+            if user is None:
+                return False
+            self.meta.omap_rm_keys(USERS_OID, [
+                uid, f"ak\x00{user['access_key']}"])
+        return True
+
+    def secret_for_access_key(self, access_key: str) -> str | None:
+        """SigV4 verifier hook: access key → secret key (two
+        single-row server-side fetches, not a full user-table
+        scan per request)."""
+        akey = f"ak\x00{access_key}"
+        try:
+            uid_row = self.meta.omap_get(USERS_OID,
+                                         keys=[akey]).get(akey)
+        except ObjectNotFound:
+            return None
+        if uid_row is None:
+            return None
+        uid = bytes(uid_row).decode()
+        user = self.get_user(uid)
+        return user["secret_key"] if user else None
+
     # -- buckets -----------------------------------------------------------
-    def create_bucket(self, bucket: str) -> bool:
+    def create_bucket(self, bucket: str,
+                      index_shards: int = DEFAULT_INDEX_SHARDS) -> bool:
         if bucket.startswith("lc."):
             # the lifecycle rows share this omap; a literal "lc.x"
             # bucket would collide with them and poison every
             # lifecycle pass
             return False
+        if self.bucket_exists(bucket):
+            return True     # re-create keeps the existing shard count
         self.meta.omap_set(BUCKETS_OID, {
-            bucket: json.dumps({"name": bucket}).encode()})
+            bucket: json.dumps({"name": bucket,
+                                "num_shards": index_shards}).encode()})
         return True
 
     def delete_bucket(self, bucket: str) -> bool:
@@ -115,12 +270,14 @@ class RGWStore:
             return False            # 409 BucketNotEmpty
         # (list_objects raises on cluster outage, so an unreachable
         # index can never masquerade as an empty bucket here)
+        oids = self._all_index_oids(bucket)
         self.meta.omap_rm_keys(BUCKETS_OID,
                                [bucket, f"lc.{bucket}"])
-        try:
-            self.meta.remove(_index_oid(bucket))
-        except Exception:
-            pass
+        for oid in {*oids, _index_oid(bucket)}:
+            try:
+                self.meta.remove(oid)
+            except Exception:
+                pass
         return True
 
     def bucket_exists(self, bucket: str) -> bool:
@@ -187,8 +344,8 @@ class RGWStore:
         the lifecycle scan saw — re-check AND removal in ONE critical
         section, so a racing PUT (which takes the same lock) can never
         have its brand-new object expired out from under it."""
-        with self._lock:
-            cur = self._raw_index(bucket).get(key)
+        with self._shard_lock(bucket, key):
+            cur = self._index_get(bucket, key)
             if cur is None or cur.get("delete_marker") or \
                     float(cur.get("mtime", -1.0)) != mtime:
                 return False
@@ -202,13 +359,21 @@ class RGWStore:
 
     # -- versioning --------------------------------------------------------
     def set_versioning(self, bucket: str, enabled: bool):
+        # merge into the existing meta row: overwriting would drop
+        # num_shards and silently re-route the index to the legacy oid
+        try:
+            raw = self.meta.omap_get(BUCKETS_OID).get(bucket)
+        except ObjectNotFound:
+            raw = None
+        row = json.loads(bytes(raw)) if raw else {"name": bucket}
+        row["versioning"] = enabled
         self.meta.omap_set(BUCKETS_OID, {
-            bucket: json.dumps({"name": bucket,
-                                "versioning": enabled}).encode()})
+            bucket: json.dumps(row).encode()})
 
     def versioning_enabled(self, bucket: str) -> bool:
         try:
-            row = self.meta.omap_get(BUCKETS_OID).get(bucket)
+            row = self.meta.omap_get(BUCKETS_OID,
+                                     keys=[bucket]).get(bucket)
         except ObjectNotFound:
             return False
         return bool(row and json.loads(bytes(row)).get("versioning"))
@@ -254,20 +419,21 @@ class RGWStore:
         meta = {"size": len(body), "etag": etag,
                 "mtime": _time.time()}
         vid = None
-        with self._lock:
-            old = self._raw_index(bucket).get(key)
+        with self._shard_lock(bucket, key):
+            old = self._index_get(bucket, key)
             if self.versioning_enabled(bucket):
-                vid = self._next_version_id(bucket)
-                meta["version_id"] = vid
-                self.data.write_full(_version_oid(bucket, key, vid),
-                                     body)
-                self.meta.omap_set(_versions_oid(bucket), {
-                    f"{key}\x00{vid}": json.dumps(meta).encode()})
+                with self._ver_lock(bucket):
+                    vid = self._next_version_id(bucket)
+                    meta["version_id"] = vid
+                    self.data.write_full(
+                        _version_oid(bucket, key, vid), body)
+                    self.meta.omap_set(_versions_oid(bucket), {
+                        f"{key}\x00{vid}":
+                            json.dumps(meta).encode()})
                 old = None   # prior version still references its parts
             else:
                 self.data.write_full(_data_oid(bucket, key), body)
-            self.meta.omap_set(_index_oid(bucket), {
-                key: json.dumps(meta).encode()})
+            self._index_set(bucket, key, meta)
         self._drop_parts(old)   # replaced unversioned manifest
         return etag, vid
 
@@ -301,13 +467,9 @@ class RGWStore:
             if meta.get("delete_marker"):
                 raise KeyError(key)
             return meta
-        try:
-            idx = self.meta.omap_get(_index_oid(bucket))
-        except ObjectNotFound:
-            idx = {}        # bucket never indexed anything
-        if key not in idx:
+        meta = self._index_get(bucket, key)
+        if meta is None:
             raise KeyError(key)
-        meta = json.loads(bytes(idx[key]))
         if meta.get("delete_marker"):
             raise KeyError(key)   # current version is a delete marker
         return meta
@@ -317,7 +479,7 @@ class RGWStore:
         if version_id is not None:
             # permanent removal of one version (reference: deleting a
             # specific versionId bypasses the delete-marker machinery)
-            with self._lock:
+            with self._shard_lock(bucket, key), self._ver_lock(bucket):
                 try:
                     rows = self.meta.omap_get(_versions_oid(bucket))
                     vmeta = json.loads(bytes(
@@ -334,28 +496,25 @@ class RGWStore:
                 self._drop_parts(vmeta)   # multipart version: parts go
                 # if it was the current version, expose the newest
                 # survivor
-                cur = self._raw_index(bucket).get(key)
+                cur = self._index_get(bucket, key)
                 if cur and cur.get("version_id") == version_id:
                     survivors = [e for e in self.list_versions(bucket)
                                  if e["key"] == key]
                     if survivors:
                         newest = survivors[0]
-                        self.meta.omap_set(_index_oid(bucket), {
-                            key: json.dumps({
-                                k2: v2 for k2, v2 in newest.items()
-                                if k2 not in ("key", "is_latest")
-                            }).encode()})
+                        self._index_set(bucket, key, {
+                            k2: v2 for k2, v2 in newest.items()
+                            if k2 not in ("key", "is_latest")})
                     else:
-                        self.meta.omap_rm_keys(_index_oid(bucket),
-                                               [key])
+                        self._index_rm(bucket, key)
             return None
         if self.versioning_enabled(bucket):
             # delete marker becomes the current version; older
             # versions stay readable via ?versionId=
-            with self._lock:
+            with self._shard_lock(bucket, key):
                 vid = self._write_delete_marker_locked(bucket, key)
             return vid
-        with self._lock:
+        with self._shard_lock(bucket, key):
             try:
                 meta = self.head_object(bucket, key)
             except KeyError:
@@ -365,25 +524,25 @@ class RGWStore:
 
     def _write_delete_marker_locked(self, bucket: str,
                                     key: str) -> str:
-        """Caller holds self._lock."""
-        vid = self._next_version_id(bucket)
-        marker = {"size": 0, "etag": "", "version_id": vid,
-                  "delete_marker": True}
-        self.meta.omap_set(_versions_oid(bucket), {
-            f"{key}\x00{vid}": json.dumps(marker).encode()})
-        self.meta.omap_set(_index_oid(bucket), {
-            key: json.dumps(marker).encode()})
+        """Caller holds the key's shard lock."""
+        with self._ver_lock(bucket):
+            vid = self._next_version_id(bucket)
+            marker = {"size": 0, "etag": "", "version_id": vid,
+                      "delete_marker": True}
+            self.meta.omap_set(_versions_oid(bucket), {
+                f"{key}\x00{vid}": json.dumps(marker).encode()})
+        self._index_set(bucket, key, marker)
         return vid
 
     def _remove_current_locked(self, bucket: str, key: str,
                                meta: dict):
         """Remove the current unversioned object — index row,
-        manifest parts, data — with the caller holding self._lock
-        through ALL of it: a racing PUT (same lock) can otherwise
-        re-create the data object between our index removal and data
-        removal and have its fresh bytes deleted under a live index
-        row."""
-        self.meta.omap_rm_keys(_index_oid(bucket), [key])
+        manifest parts, data — with the caller holding the key's
+        shard lock through ALL of it: a racing PUT (same lock) can
+        otherwise re-create the data object between our index removal
+        and data removal and have its fresh bytes deleted under a
+        live index row."""
+        self._index_rm(bucket, key)
         self._drop_parts(meta)
         try:
             self.data.remove(_data_oid(bucket, key))
@@ -441,16 +600,17 @@ class RGWStore:
             "parts": [_part_oid(bucket, upload_id, n)
                       for n, _ in parts],
         }
-        with self._lock:
-            old = self._raw_index(bucket).get(key)
+        with self._shard_lock(bucket, key):
+            old = self._index_get(bucket, key)
             if self.versioning_enabled(bucket):
-                vid = self._next_version_id(bucket)
-                manifest["version_id"] = vid
-                self.meta.omap_set(_versions_oid(bucket), {
-                    f"{key}\x00{vid}": json.dumps(manifest).encode()})
+                with self._ver_lock(bucket):
+                    vid = self._next_version_id(bucket)
+                    manifest["version_id"] = vid
+                    self.meta.omap_set(_versions_oid(bucket), {
+                        f"{key}\x00{vid}":
+                            json.dumps(manifest).encode()})
                 old = None   # prior version keeps its parts
-            self.meta.omap_set(_index_oid(bucket), {
-                key: json.dumps(manifest).encode()})
+            self._index_set(bucket, key, manifest)
             self.meta.remove(_mp_oid(bucket, upload_id))
         self._drop_parts(old)
         return etag
@@ -483,11 +643,17 @@ class RGWStore:
         return sorted(out, key=lambda u: u["upload_id"])
 
     def _raw_index(self, bucket: str) -> dict[str, dict]:
-        try:
-            idx = self.meta.omap_get(_index_oid(bucket))
-        except ObjectNotFound:
-            return {}
-        return {k: json.loads(bytes(v)) for k, v in idx.items()}
+        """Merged view of every index shard (listings; reference
+        cls_rgw list merges shard results the same way)."""
+        out: dict[str, dict] = {}
+        for oid in self._all_index_oids(bucket):
+            try:
+                idx = self.meta.omap_get(oid)
+            except ObjectNotFound:
+                continue
+            for k, v in idx.items():
+                out[k] = json.loads(bytes(v))
+        return out
 
     def list_objects(self, bucket: str) -> dict[str, dict]:
         """Visible objects only: keys whose current version is a
@@ -532,10 +698,32 @@ def _xml_list_buckets(names: list[str]) -> bytes:
 
 class _Handler(BaseHTTPRequestHandler):
     store: RGWStore = None      # set by RGWService
+    require_auth = False        # set by RGWService(require_auth=True)
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *a):   # quiet
         pass
+
+    def _check_auth(self, body: bytes) -> bool:
+        """SigV4 gate (reference rgw_auth_s3.cc): with auth required,
+        every request must carry a valid AWS4-HMAC-SHA256 signature
+        from a known user; unsigned/garbled/forged → 403 and the
+        handler stops.  → True when the request may proceed."""
+        if not self.require_auth:
+            return True
+        from . import sigv4
+        path = self.path.split("?", 1)[0]
+        try:
+            self._auth_access_key = sigv4.verify(
+                self.command, path, self._query(),
+                dict(self.headers.items()), body,
+                self.store.secret_for_access_key)
+            return True
+        except sigv4.SigError as e:
+            self._reply(403, f"<Error><Code>AccessDenied</Code>"
+                             f"<Message>{_xesc(str(e))}</Message>"
+                             f"</Error>".encode())
+            return False
 
     def _reply(self, code: int, body: bytes = b"",
                ctype: str = "application/xml", headers: dict = None):
@@ -545,7 +733,9 @@ class _Handler(BaseHTTPRequestHandler):
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
-        if body:
+        # HEAD responses are bodyless by spec: writing the error XML
+        # would desync the next response on a keep-alive connection
+        if body and self.command != "HEAD":
             self.wfile.write(body)
 
     def _parse(self):
@@ -578,6 +768,8 @@ class _Handler(BaseHTTPRequestHandler):
         # bytes sit on a keep-alive connection desyncs the stream
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if not self._check_auth(body):
+            return
         if bucket is None:
             return self._reply(400)
         if key is None:
@@ -631,9 +823,11 @@ class _Handler(BaseHTTPRequestHandler):
         bucket, key = self._parse()
         q = self._query()
         length = int(self.headers.get("Content-Length", 0))
-        self.rfile.read(length)   # CompleteMultipartUpload XML: the
-        # part list is authoritative server-side (we complete with
-        # every uploaded part, in part-number order)
+        post_body = self.rfile.read(length)  # CompleteMultipartUpload
+        # XML: the part list is authoritative server-side (we
+        # complete with every uploaded part, in part-number order)
+        if not self._check_auth(post_body):
+            return
         if bucket is None or key is None:
             return self._reply(400)
         if not self.store.bucket_exists(bucket):
@@ -665,6 +859,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         bucket, key = self._parse()
         q = self._query()
+        if not self._check_auth(b""):
+            return
         if bucket is None:
             return self._reply(
                 200, _xml_list_buckets(self.store.list_buckets()))
@@ -711,6 +907,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_HEAD(self):
         bucket, key = self._parse()
+        if not self._check_auth(b""):
+            return
         if bucket is None or key is None:
             return self._reply(400)
         try:
@@ -724,6 +922,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         bucket, key = self._parse()
         q = self._query()
+        if not self._check_auth(b""):
+            return
         if bucket is None:
             return self._reply(400)
         if key is None:
@@ -744,9 +944,11 @@ class RGWService:
 
     LC_INTERVAL = 5.0
 
-    def __init__(self, rados, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, rados, host: str = "127.0.0.1", port: int = 0,
+                 require_auth: bool = False):
         self.store = RGWStore(rados)
-        handler = type("Handler", (_Handler,), {"store": self.store})
+        handler = type("Handler", (_Handler,), {
+            "store": self.store, "require_auth": require_auth})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(
@@ -775,16 +977,32 @@ class RGWService:
 
 
 class S3Client:
-    """Tiny S3-dialect client for tests/tools."""
+    """Tiny S3-dialect client for tests/tools.  With credentials it
+    SigV4-signs every request (reference: any AWS SDK client)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 access_key: str | None = None,
+                 secret_key: str | None = None):
         self.host, self.port = host, port
+        self.access_key, self.secret_key = access_key, secret_key
 
     def _req(self, method: str, path: str, body: bytes = b""):
         con = http.client.HTTPConnection(self.host, self.port,
                                          timeout=10)
+        headers = {}
+        if self.access_key and self.secret_key:
+            from . import sigv4
+            from urllib.parse import parse_qs
+            raw_path, _, qs = path.partition("?")
+            query = {k: v[0] for k, v in
+                     parse_qs(qs, keep_blank_values=True).items()}
+            headers["Host"] = f"{self.host}:{self.port}"
+            headers.update(sigv4.sign(
+                method, raw_path, query, headers, body,
+                self.access_key, self.secret_key))
         try:
-            con.request(method, path, body=body or None)
+            con.request(method, path, body=body or None,
+                        headers=headers)
             resp = con.getresponse()
             return resp.status, dict(resp.getheaders()), resp.read()
         finally:

@@ -10,6 +10,10 @@ RADOS directly).
     radosgw-admin ... bucket stats --bucket NAME
     radosgw-admin ... bucket rm --bucket NAME [--purge-objects]
     radosgw-admin ... object rm --bucket NAME --object KEY
+    radosgw-admin ... user create --uid UID [--display-name NAME]
+    radosgw-admin ... user list
+    radosgw-admin ... user info --uid UID
+    radosgw-admin ... user rm --uid UID
 """
 
 from __future__ import annotations
@@ -27,10 +31,13 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="radosgw-admin",
                                 description=__doc__)
     p.add_argument("-m", "--mon", required=True)
-    p.add_argument("target", choices=["bucket", "object"])
-    p.add_argument("op", choices=["list", "stats", "rm"])
+    p.add_argument("target", choices=["bucket", "object", "user"])
+    p.add_argument("op", choices=["list", "stats", "rm", "create",
+                                  "info"])
     p.add_argument("--bucket")
     p.add_argument("--object")
+    p.add_argument("--uid")
+    p.add_argument("--display-name", default="")
     p.add_argument("--purge-objects", action="store_true")
     a = p.parse_args(argv)
 
@@ -73,6 +80,31 @@ def main(argv=None) -> int:
                       file=sys.stderr)
                 return 2
             return 0
+        if a.target == "user":
+            # reference RGWUserAdminOp: users + their S3 keypairs
+            if a.op == "create":
+                if not a.uid:
+                    raise SystemExit("--uid required")
+                print(json.dumps(store.create_user(
+                    a.uid, a.display_name), indent=2))
+                return 0
+            if a.op == "list":
+                print(json.dumps(
+                    [u["uid"] for u in store.list_users()], indent=2))
+                return 0
+            if a.op == "info":
+                if not a.uid:
+                    raise SystemExit("--uid required")
+                user = store.get_user(a.uid)
+                if user is None:
+                    print(f"no such user {a.uid!r}", file=sys.stderr)
+                    return 2
+                print(json.dumps(user, indent=2))
+                return 0
+            if a.op == "rm":
+                if not a.uid:
+                    raise SystemExit("--uid required")
+                return 0 if store.remove_user(a.uid) else 2
         if a.target == "object" and a.op == "rm":
             if not (a.bucket and a.object):
                 raise SystemExit("--bucket and --object required")

@@ -200,12 +200,9 @@ class TestLifecycle:
         s3.put("lc", "keep/fresh", b"y")
         # backdate tmp/old via the store (a day has not really passed)
         store = gw.store
-        idx = store._raw_index("lc")
-        meta = idx["tmp/old"]
+        meta = store._index_get("lc", "tmp/old")
         meta["mtime"] = _time.time() - 2 * 86400
-        import json as _json
-        store.meta.omap_set("index.lc", {
-            "tmp/old": _json.dumps(meta).encode()})
+        store._index_set("lc", "tmp/old", meta)
         n = store.lifecycle_pass()
         assert n == 1
         assert s3.get("lc", "tmp/old")[0] == 404
