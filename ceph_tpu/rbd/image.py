@@ -45,12 +45,26 @@ def _journal_oid(name: str) -> str:
     return f"rbd_journal.{name}"
 
 
+def _objmap_oid(name: str, snap_id: int | None = None) -> str:
+    """Object-map object (reference src/librbd/object_map/): the head
+    map plus one frozen copy per snapshot."""
+    base = f"rbd_object_map.{name}"
+    return base if snap_id is None else f"{base}.{snap_id}"
+
+
+# object-map states (reference OBJECT_{NONEXISTENT,EXISTS,EXISTS_CLEAN})
+OM_NONE = 0        # no data object
+OM_DIRTY = 1       # exists, written since the last snapshot
+OM_CLEAN = 2       # exists, unchanged since the last snapshot
+
+
 class RBD:
     """Pool-level image operations (reference ``librbd::RBD``)."""
 
     def create(self, ioctx, name: str, size: int, *, order: int = 22,
                stripe_unit: int | None = None, stripe_count: int = 1,
-               journaling: bool = False, primary: bool = True):
+               journaling: bool = False, primary: bool = True,
+               object_map: bool = True):
         if size < 0:
             raise ValueError("image size must be >= 0")
         if _header_oid(name) in ioctx.list_objects():
@@ -69,6 +83,9 @@ class RBD:
             # `primary` is set at create so a mirror bootstrap writes
             # the non-primary header atomically (no primary window)
             "journaling": journaling, "primary": primary,
+            # object-map + fast-diff feature (reference librbd
+            # object-map/fast-diff feature bits, on by default)
+            "object_map": object_map,
         }
         ioctx.omap_set(_header_oid(name), {
             "header": json.dumps(hdr).encode()})
@@ -161,6 +178,14 @@ class RBD:
             ioctx.remove(_journal_oid(name))
         except ObjectNotFound:
             pass
+        # and every object-map object (head + per-snap copies)
+        om_base = _objmap_oid(name)
+        for o in ioctx.list_objects():
+            if o == om_base or o.startswith(om_base + "."):
+                try:
+                    ioctx.remove(o)
+                except ObjectNotFound:
+                    pass
         ioctx.remove(_header_oid(name))
         img.close()
 
@@ -254,6 +279,10 @@ class Image:
                     self.ioctx.remove(_data_oid(self.name, objno))
                 except Exception:
                     pass
+        if self._objmap_enabled():
+            # re-persist at the new length: shrink drops the dead
+            # objects' states, grow pads OM_NONE
+            self._objmap_save(self._objmap_load())
 
     def close(self):
         if self._lock_cookie is not None:
@@ -363,6 +392,101 @@ class Image:
         self._hdr["primary"] = False
         self._save_header()
 
+    # -- object map / fast-diff --------------------------------------------
+    # (reference src/librbd/object_map/ + the fast-diff feature: one
+    # state byte per data object; the head map tracks what exists and
+    # what was written since the last snapshot, each snapshot freezes
+    # a copy.  export-diff consults the maps instead of scanning every
+    # data object.)
+    def _objmap_enabled(self) -> bool:
+        return bool(self._hdr.get("object_map"))
+
+    def _objmap_nobj(self, size: int | None = None) -> int:
+        s = self._hdr["size"] if size is None else size
+        return -(-s // self.layout.object_size)
+
+    def _objmap_load(self, snap_id: int | None = None,
+                     nobj: int | None = None) -> bytearray:
+        """The map, padded/truncated to `nobj` entries (missing map
+        object ⇒ all OM_NONE: a fresh image has no data objects)."""
+        n = self._objmap_nobj() if nobj is None else nobj
+        try:
+            raw = bytes(self.ioctx.read(
+                _objmap_oid(self.name, snap_id)))
+        except Exception:       # noqa: BLE001 — absent map
+            raw = b""
+        m = bytearray(raw[:n])
+        m.extend(b"\x00" * (n - len(m)))
+        return m
+
+    def _objmap_save(self, m: bytearray,
+                     snap_id: int | None = None):
+        self.ioctx.write_full(_objmap_oid(self.name, snap_id),
+                              bytes(m))
+
+    def _objmap_mark(self, objnos, state: int = OM_DIRTY):
+        if not self._objmap_enabled():
+            return
+        m = self._objmap_load()
+        changed = False
+        for objno in objnos:
+            if objno < len(m) and m[objno] != state:
+                m[objno] = state
+                changed = True
+        if changed:
+            self._objmap_save(m)
+
+    def _fast_diff_objects(self, from_snap: str | None) -> set | None:
+        """Objects possibly changed between `from_snap` and this
+        handle's view — the union of every intervening map's dirty
+        set plus existence flips; → None when the maps can't answer
+        (feature off, or a full export of a clone whose unwritten
+        objects are parent-backed and absent from the map)."""
+        if not self._objmap_enabled():
+            return None
+        if from_snap is None:
+            # a full export of parent-backed data can't come from the
+            # maps: unwritten clone objects are OM_NONE yet readable.
+            # For a snapshot handle, what matters is whether the image
+            # had a parent AT SNAP TIME (flatten may have popped the
+            # header's parent since) — recorded per snap; absent field
+            # (pre-feature snaps) is treated as "had one": fallback
+            # scan is slow but never wrong
+            if self._hdr.get("parent") is not None:
+                return None
+            if self.snap_id is not None:
+                snap = next(
+                    (s for s in self._hdr["snaps"].values()
+                     if s["id"] == self.snap_id), {})
+                if snap.get("has_parent", True):
+                    return None
+        from_id = (self._hdr["snaps"][from_snap]["id"]
+                   if from_snap else 0)
+        end_id = self.snap_id            # None ⇒ head
+        nobj = self._objmap_nobj(self.size())
+        # maps strictly after from_id up to (and including) the end
+        mid_ids = sorted(
+            s["id"] for s in self._hdr["snaps"].values()
+            if s["id"] > from_id
+            and (end_id is None or s["id"] <= end_id))
+        maps = [self._objmap_load(sid, nobj) for sid in mid_ids]
+        end_map = (self._objmap_load(None, nobj) if end_id is None
+                   else self._objmap_load(end_id, nobj))
+        if end_id is None:
+            maps.append(end_map)
+        cand = set()
+        for m in maps:
+            cand.update(i for i, v in enumerate(m) if v == OM_DIRTY)
+        if from_snap is not None:
+            base_map = self._objmap_load(from_id, nobj)
+            cand.update(i for i in range(nobj)
+                        if (end_map[i] == OM_NONE)
+                        != (base_map[i] == OM_NONE))
+        else:
+            cand.update(i for i, v in enumerate(end_map)
+                        if v != OM_NONE)
+        return cand
+
     # -- snapshots -----------------------------------------------------------
     def create_snap(self, snap_name: str):
         self._require_writable()
@@ -371,8 +495,21 @@ class Image:
         self._journal_append({"op": "snap_create", "name": snap_name})
         self._hdr["snap_seq"] += 1
         self._hdr["snaps"][snap_name] = {
-            "id": self._hdr["snap_seq"], "size": self._hdr["size"]}
+            "id": self._hdr["snap_seq"], "size": self._hdr["size"],
+            # fast-diff needs to know whether this snap's view has
+            # parent-backed bytes the object map can't see
+            "has_parent": self._hdr.get("parent") is not None}
         self._save_header()
+        if self._objmap_enabled():
+            # freeze the map for the snap, then mark the head clean:
+            # future writes flip objects back to dirty, which is
+            # exactly what fast-diff reads off the next interval
+            m = self._objmap_load()
+            self._objmap_save(m, self._hdr["snap_seq"])
+            for i, v in enumerate(m):
+                if v == OM_DIRTY:
+                    m[i] = OM_CLEAN
+            self._objmap_save(m)
 
     def protect_snap(self, snap_name: str):
         """Required before cloning (reference snap protect)."""
@@ -402,8 +539,38 @@ class Image:
         if self._hdr["snaps"][snap_name].get("protected"):
             raise ValueError(f"snapshot {snap_name!r} is protected")
         self._journal_append({"op": "snap_remove", "name": snap_name})
-        self._hdr["snaps"].pop(snap_name)
+        gone = self._hdr["snaps"].pop(snap_name)
         self._save_header()
+        if self._objmap_enabled():
+            # merge the removed snap's DIRTY bits into the next newer
+            # map (or the head map): its interval's changes must stay
+            # visible to fast-diff, or an incremental spanning the
+            # removed snap silently loses them (reference
+            # object_map::SnapshotRemoveRequest does the same merge)
+            removed = self._objmap_load(gone["id"],
+                                        self._objmap_nobj(
+                                            gone["size"]))
+            newer = sorted(
+                (s["id"], s["size"])
+                for s in self._hdr["snaps"].values()
+                if s["id"] > gone["id"])
+            tgt_sid = newer[0][0] if newer else None
+            # load the target at ITS OWN length (snap maps keep their
+            # snap-time size; the head map the current size)
+            tgt = self._objmap_load(
+                tgt_sid,
+                self._objmap_nobj(newer[0][1]) if newer else None)
+            changed = False
+            for i in range(min(len(removed), len(tgt))):
+                if removed[i] == OM_DIRTY and tgt[i] == OM_CLEAN:
+                    tgt[i] = OM_DIRTY
+                    changed = True
+            if changed:
+                self._objmap_save(tgt, tgt_sid)
+            try:
+                self.ioctx.remove(_objmap_oid(self.name, gone["id"]))
+            except Exception:       # noqa: BLE001 — map may be absent
+                pass
         self._gc_clones()
 
     def _gc_clones(self):
@@ -535,6 +702,14 @@ class Image:
                     break
         return bytes(out) if out else None
 
+    def _object_exists(self, objno: int) -> bool:
+        from ..osdc.librados import ObjectNotFound
+        try:
+            self.ioctx.stat(_data_oid(self.name, objno))
+            return True
+        except ObjectNotFound:
+            return False
+
     def _copy_up(self, objno: int):
         """First write to a parent-backed object copies the parent
         bytes into the child first (reference copyup)."""
@@ -570,6 +745,13 @@ class Image:
             default=-1)
         for objno in range(nobj):
             self._copy_up(objno)
+        if self._objmap_enabled():
+            # the copied-up objects now hold the image's only copy of
+            # the parent bytes: they must enter the object map, or the
+            # first post-flatten export-diff would skip them
+            self._objmap_mark({
+                objno for objno in range(nobj)
+                if self._object_exists(objno)})
         with Image(self.ioctx, parent["image"]) as p:
             snap = p._hdr["snaps"].get(parent["snap"])
             if snap is not None:
@@ -586,20 +768,27 @@ class Image:
         to this handle's view (a snapshot handle diffs to that snap,
         a head handle to the current data) — the transport behind
         incremental backup/mirroring (reference ``rbd export-diff``).
-        Extent granularity: differing byte ranges within each object,
-        so unchanged objects cost two reads and no output."""
+        Extent granularity: differing byte ranges within each object.
+        With the object-map feature the candidate objects come from
+        the maps (fast-diff): unchanged objects are SKIPPED without
+        any data read — the map lookup replaces the full scan."""
         size = self.size()
         base = None
         if from_snap is not None:
             if from_snap not in self._hdr["snaps"]:
                 raise ImageNotFound(f"no snapshot {from_snap!r}")
             base = Image(self.ioctx, self.name, snapshot=from_snap)
+        candidates = self._fast_diff_objects(from_snap)
         try:
             extents = []
             step = self.layout.object_size
             off = 0
             chunk = 4096
             while off < size:
+                if candidates is not None and \
+                        (off // step) not in candidates:
+                    off += step
+                    continue
                 n = min(step, size - off)
                 new = self.read(off, n)
                 if base is not None:
@@ -670,7 +859,13 @@ class Image:
             raise ValueError("write past end of image")
         self._journal_append({"op": "write", "off": offset,
                               "data": data.hex()})
-        for ext in file_to_extents(self.layout, offset, len(data)):
+        exts = file_to_extents(self.layout, offset, len(data))
+        # mark BEFORE the data writes (reference object-map ordering):
+        # a mid-loop failure then leaves objects dirty-but-unwritten
+        # (harmless extra diff reads), never written-but-clean (lost
+        # from the next incremental)
+        self._objmap_mark({e.object_no for e in exts})
+        for ext in exts:
             self._copy_up(ext.object_no)
             self._cow_preserve(ext.object_no)
             lo = ext.logical_offset - offset
@@ -705,7 +900,15 @@ class Image:
         self._require_writable()
         self._journal_append({"op": "discard", "off": offset,
                               "len": length})
-        for ext in file_to_extents(self.layout, offset, length):
+        from ..osdc.librados import ObjectNotFound
+        exts = file_to_extents(self.layout, offset, length)
+        # conservative ordering: everything touched goes DIRTY first;
+        # only a CONFIRMED removal (ok or already-absent) may demote
+        # to NONE afterwards — a swallowed transient error must not
+        # leave live data invisible to fast-diff
+        self._objmap_mark({e.object_no for e in exts})
+        gone = set()
+        for ext in exts:
             oid = _data_oid(self.name, ext.object_no)
             parent_backed = self._parent_covers(ext.object_no)
             if ext.offset == 0 and \
@@ -714,7 +917,10 @@ class Image:
                 self._cow_preserve(ext.object_no)
                 try:
                     self.ioctx.remove(oid)
-                except Exception:
+                    gone.add(ext.object_no)
+                except ObjectNotFound:
+                    gone.add(ext.object_no)
+                except Exception:       # noqa: BLE001 — stays DIRTY
                     pass
             else:
                 # parent-backed objects must be ZEROED, not removed —
@@ -723,3 +929,4 @@ class Image:
                     self._copy_up(ext.object_no)
                 self._cow_preserve(ext.object_no)
                 self.ioctx.write(oid, b"\x00" * ext.length, ext.offset)
+        self._objmap_mark(gone, OM_NONE)

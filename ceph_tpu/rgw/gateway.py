@@ -151,16 +151,12 @@ class RGWStore:
         return [_shard_oid(bucket, s) for s in range(n)]
 
     def _index_get(self, bucket: str, key: str) -> dict | None:
-        try:
-            row = self.meta.omap_get(
-                self._key_index_oid(bucket, key), keys=[key]).get(key)
-        except ObjectNotFound:
-            return None
-        return json.loads(bytes(row)) if row else None
+        return self._index_get_at(
+            self._key_index_oid(bucket, key), key)
 
     def _index_set(self, bucket: str, key: str, meta: dict):
-        self.meta.omap_set(self._key_index_oid(bucket, key),
-                           {key: json.dumps(meta).encode()})
+        self._index_set_at(self._key_index_oid(bucket, key), key,
+                           meta)
 
     def _index_rm(self, bucket: str, key: str):
         self.meta.omap_rm_keys(self._key_index_oid(bucket, key),
@@ -169,12 +165,31 @@ class RGWStore:
     def _shard_lock(self, bucket: str, key: str):
         """The write lock for `key`'s index shard: PUT/DELETE on
         different shards proceed concurrently."""
-        sid = (bucket, self._key_shard(bucket, key))
+        return self._key_index_ref(bucket, key)[1]
+
+    def _key_index_ref(self, bucket: str, key: str):
+        """→ (shard oid, shard lock) with ONE bucket-meta fetch —
+        the write paths resolve this once per op instead of paying
+        three identical single-row round trips."""
+        n = self._bucket_shards(bucket)
+        shard = (zlib.crc32(key.encode()) % n) if n else 0
+        oid = _shard_oid(bucket, shard) if n else _index_oid(bucket)
+        sid = (bucket, shard)
         with self._locks_guard:
             lk = self._shard_locks.get(sid)
             if lk is None:
                 lk = self._shard_locks[sid] = Mutex("rgw-shard")
-        return lk
+        return oid, lk
+
+    def _index_get_at(self, oid: str, key: str) -> dict | None:
+        try:
+            row = self.meta.omap_get(oid, keys=[key]).get(key)
+        except ObjectNotFound:
+            return None
+        return json.loads(bytes(row)) if row else None
+
+    def _index_set_at(self, oid: str, key: str, meta: dict):
+        self.meta.omap_set(oid, {key: json.dumps(meta).encode()})
 
     def _ver_lock(self, bucket: str):
         """Version-sequence lock (one per bucket); always taken INSIDE
@@ -362,7 +377,8 @@ class RGWStore:
         # merge into the existing meta row: overwriting would drop
         # num_shards and silently re-route the index to the legacy oid
         try:
-            raw = self.meta.omap_get(BUCKETS_OID).get(bucket)
+            raw = self.meta.omap_get(BUCKETS_OID,
+                                     keys=[bucket]).get(bucket)
         except ObjectNotFound:
             raw = None
         row = json.loads(bytes(raw)) if raw else {"name": bucket}
@@ -419,8 +435,9 @@ class RGWStore:
         meta = {"size": len(body), "etag": etag,
                 "mtime": _time.time()}
         vid = None
-        with self._shard_lock(bucket, key):
-            old = self._index_get(bucket, key)
+        oid, lk = self._key_index_ref(bucket, key)
+        with lk:
+            old = self._index_get_at(oid, key)
             if self.versioning_enabled(bucket):
                 with self._ver_lock(bucket):
                     vid = self._next_version_id(bucket)
@@ -433,7 +450,7 @@ class RGWStore:
                 old = None   # prior version still references its parts
             else:
                 self.data.write_full(_data_oid(bucket, key), body)
-            self._index_set(bucket, key, meta)
+            self._index_set_at(oid, key, meta)
         self._drop_parts(old)   # replaced unversioned manifest
         return etag, vid
 
@@ -600,8 +617,9 @@ class RGWStore:
             "parts": [_part_oid(bucket, upload_id, n)
                       for n, _ in parts],
         }
-        with self._shard_lock(bucket, key):
-            old = self._index_get(bucket, key)
+        oid, lk = self._key_index_ref(bucket, key)
+        with lk:
+            old = self._index_get_at(oid, key)
             if self.versioning_enabled(bucket):
                 with self._ver_lock(bucket):
                     vid = self._next_version_id(bucket)
@@ -610,7 +628,7 @@ class RGWStore:
                         f"{key}\x00{vid}":
                             json.dumps(manifest).encode()})
                 old = None   # prior version keeps its parts
-            self._index_set(bucket, key, manifest)
+            self._index_set_at(oid, key, manifest)
             self.meta.remove(_mp_oid(bucket, upload_id))
         self._drop_parts(old)
         return etag

@@ -1,0 +1,254 @@
+"""RBD object-map + fast-diff (reference src/librbd/object_map/;
+VERDICT r3 missing #4): export-diff must consult the object map and
+skip unchanged objects WITHOUT reading their data.
+"""
+
+import pytest
+
+from ceph_tpu.rbd import Image, RBD
+from ceph_tpu.rbd.image import (OM_CLEAN, OM_DIRTY, OM_NONE,
+                                _objmap_oid)
+from ceph_tpu.vstart import MiniCluster
+
+OBJ = 1 << 16           # order=16: 64 KiB objects
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    r = c.rados()
+    r.create_pool("rbd", pg_num=8, size=2)
+    io = r.open_ioctx("rbd")
+    c.wait_for_clean()
+    yield c, r, io
+    c.stop()
+
+
+class ReadCounter:
+    """Wrap an ioctx: count data-object reads per image."""
+
+    def __init__(self, ioctx, image_name):
+        self._io = ioctx
+        self._prefix = f"rbd_data.{image_name}."
+        self.data_reads = 0
+
+    def __getattr__(self, name):
+        return getattr(self._io, name)
+
+    def read(self, oid, *a, **kw):
+        if oid.startswith(self._prefix):
+            self.data_reads += 1
+        return self._io.read(oid, *a, **kw)
+
+
+class TestObjectMapStates:
+    def test_map_tracks_writes_and_snapshots(self, cluster):
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "om", 8 * OBJ, order=16)
+        with Image(io, "om") as im:
+            assert im._objmap_enabled()
+            im.write(0, b"a" * 100)              # object 0
+            im.write(3 * OBJ, b"b" * 100)        # object 3
+            m = im._objmap_load()
+            assert m[0] == OM_DIRTY and m[3] == OM_DIRTY
+            assert m[1] == OM_NONE and m[7] == OM_NONE
+            im.create_snap("s1")
+            m = im._objmap_load()
+            assert m[0] == OM_CLEAN and m[3] == OM_CLEAN
+            # the snapshot froze the pre-clean state
+            sid = im._hdr["snaps"]["s1"]["id"]
+            frozen = im._objmap_load(sid)
+            assert frozen[0] == OM_DIRTY and frozen[3] == OM_DIRTY
+            im.write(5 * OBJ, b"c")
+            m = im._objmap_load()
+            assert m[5] == OM_DIRTY and m[0] == OM_CLEAN
+
+    def test_whole_object_discard_clears_state(self, cluster):
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "omd", 4 * OBJ, order=16)
+        with Image(io, "omd") as im:
+            im.write(0, b"x" * OBJ)
+            assert im._objmap_load()[0] == OM_DIRTY
+            im.discard(0, OBJ)
+            assert im._objmap_load()[0] == OM_NONE
+
+    def test_remove_cleans_map_objects(self, cluster):
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "omr", 2 * OBJ, order=16)
+        with Image(io, "omr") as im:
+            im.write(0, b"z")
+            im.create_snap("s")
+        assert _objmap_oid("omr") in io.list_objects()
+        rbd.remove(io, "omr")
+        left = [o for o in io.list_objects()
+                if o.startswith("rbd_object_map.omr")]
+        assert left == []
+
+
+class TestFastDiff:
+    def test_diff_skips_unchanged_objects(self, cluster):
+        """The headline requirement: between two snapshots only ONE
+        of 32 objects changed; export-diff must read only that object
+        (plus its base-side counterpart), never scan all 32."""
+        _c, _r, io = cluster
+        rbd = RBD()
+        nobj = 32
+        rbd.create(io, "fd", nobj * OBJ, order=16)
+        with Image(io, "fd") as im:
+            for i in range(nobj):
+                im.write(i * OBJ, bytes([i]) * 1000)
+            im.create_snap("s1")
+            im.write(17 * OBJ + 11, b"CHANGED")
+            im.create_snap("s2")
+        counter = ReadCounter(io, "fd")
+        im2 = Image(counter, "fd", snapshot="s2")
+        diff = im2.export_diff(from_snap="s1")
+        im2.close()
+        assert len(diff["extents"]) == 1
+        assert diff["extents"][0]["off"] == 17 * OBJ + 11
+        assert bytes.fromhex(diff["extents"][0]["data"]) == b"CHANGED"
+        # object-granular proof: reads touched object 17's lineage
+        # only — a full scan would need >= 32 data reads
+        assert counter.data_reads <= 4, counter.data_reads
+
+    def test_full_export_uses_map_but_finds_everything(self, cluster):
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "fe", 16 * OBJ, order=16)
+        with Image(io, "fe") as im:
+            im.write(2 * OBJ, b"two")
+            im.write(9 * OBJ, b"nine")
+        counter = ReadCounter(io, "fe")
+        with Image(counter, "fe", read_only=True) as im2:
+            diff = im2.export_diff()
+        offs = sorted(e["off"] for e in diff["extents"])
+        assert offs == [2 * OBJ, 9 * OBJ]
+        assert counter.data_reads <= 4, counter.data_reads
+
+    def test_diff_sees_disappeared_objects(self, cluster):
+        """Whole-object discard between snaps must appear in the diff
+        (existence flip), zeroing the range on restore."""
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "dz", 8 * OBJ, order=16)
+        with Image(io, "dz") as im:
+            im.write(4 * OBJ, b"D" * OBJ)
+            im.create_snap("a")
+            im.discard(4 * OBJ, OBJ)
+            im.create_snap("b")
+        with Image(io, "dz", snapshot="b") as im2:
+            diff = im2.export_diff(from_snap="a")
+        assert diff["extents"], "disappearance must produce extents"
+        assert all(set(bytes.fromhex(e["data"])) == {0}
+                   for e in diff["extents"])
+
+    def test_multi_interval_union(self, cluster):
+        """Changes across SEVERAL snapshots between from and to are
+        all found (the dirty-union rule, not just the last map)."""
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "mi", 8 * OBJ, order=16)
+        with Image(io, "mi") as im:
+            im.create_snap("s0")
+            im.write(1 * OBJ, b"one")
+            im.create_snap("s1")
+            im.write(6 * OBJ, b"six")
+            im.create_snap("s2")
+        with Image(io, "mi", snapshot="s2") as im2:
+            diff = im2.export_diff(from_snap="s0")
+        offs = sorted(e["off"] for e in diff["extents"])
+        assert offs == [1 * OBJ, 6 * OBJ]
+
+    def test_flattened_clone_exports_parent_bytes(self, cluster):
+        """flatten() must enter the copied-up objects into the map —
+        a post-flatten full export may not lose the parent data."""
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "fbase", 4 * OBJ, order=16)
+        with Image(io, "fbase") as p:
+            p.write(0, b"parent-bytes")
+            p.create_snap("g")
+            p.protect_snap("g")
+        rbd.clone(io, "fbase", "g", "fkid")
+        with Image(io, "fkid") as ch:
+            ch.flatten()
+            diff = ch.export_diff()
+        assert any(
+            bytes.fromhex(e["data"]).startswith(b"parent-bytes")
+            for e in diff["extents"])
+
+    def test_feature_off_falls_back_to_scan(self, cluster):
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "noom", 4 * OBJ, order=16, object_map=False)
+        with Image(io, "noom") as im:
+            assert not im._objmap_enabled()
+            im.write(0, b"plain")
+            diff = im.export_diff()
+        assert diff["extents"][0]["off"] == 0
+
+
+class TestReviewRegressions:
+    def test_remove_snap_merges_dirty_into_next_map(self, cluster):
+        """Removing a middle snapshot must not lose its interval's
+        dirty bits: diff(s1 → head) still sees a write that was only
+        recorded in the removed snap's map (review r4 #1)."""
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "rsm", 8 * OBJ, order=16)
+        with Image(io, "rsm") as im:
+            im.write(2 * OBJ, b"1111")
+            im.create_snap("s1")
+            im.write(2 * OBJ, b"2222")      # dirty only in s2's map
+            im.create_snap("s2")
+            im.remove_snap("s2")
+            diff = im.export_diff(from_snap="s1")
+        assert any(e["off"] == 2 * OBJ and
+                   bytes.fromhex(e["data"]) == b"2222"
+                   for e in diff["extents"]), diff["extents"]
+
+    def test_snapshot_of_flattened_clone_full_export(self, cluster):
+        """A snapshot taken on a clone BEFORE flatten must still
+        export the parent bytes after flatten pops the header's
+        parent (review r4 #2)."""
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "pfb", 4 * OBJ, order=16)
+        with Image(io, "pfb") as p:
+            p.write(0, b"ancestral-data")
+            p.create_snap("g")
+            p.protect_snap("g")
+        rbd.clone(io, "pfb", "g", "pfk")
+        with Image(io, "pfk") as ch:
+            ch.create_snap("pre")           # clone still parent-backed
+            ch.flatten()
+        with Image(io, "pfk", snapshot="pre") as snapv:
+            diff = snapv.export_diff()
+        assert any(bytes.fromhex(e["data"]).startswith(b"ancestral")
+                   for e in diff["extents"]), diff["extents"]
+
+    def test_failed_whole_object_remove_stays_visible(self, cluster):
+        """A transient remove error during discard must leave the
+        object DIRTY (visible to diff), not NONE (review r4 #4)."""
+        _c, _r, io = cluster
+        rbd = RBD()
+        rbd.create(io, "fr", 4 * OBJ, order=16)
+        with Image(io, "fr") as im:
+            im.write(0, b"keepme" * 100)
+            real_remove = im.ioctx.remove
+
+            def flaky_remove(oid):
+                raise RuntimeError("transient")
+
+            im.ioctx.remove = flaky_remove
+            try:
+                im.discard(0, OBJ)
+            finally:
+                im.ioctx.remove = real_remove
+            assert im._objmap_load()[0] == OM_DIRTY
+            diff = im.export_diff()
+            assert diff["extents"], "live bytes must stay exportable"
