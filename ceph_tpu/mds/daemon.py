@@ -47,8 +47,25 @@ INODES = "mds_inodes"   # multi-link inode rows (size/mtime/nlink) —
 # hard-link state visible to the new owner
 
 
-def dirfrag_oid(ino: int) -> str:
-    return f"{ino:x}.00000000"
+def dirfrag_oid(ino: int, frag: int = 0) -> str:
+    """Fragment object of a directory (reference CDir backing store:
+    ``<ino-hex>.<frag-hex>``); fragment 0 is also where an over-size
+    directory's fragtree row lives."""
+    return f"{ino:x}.{frag:08x}"
+
+
+# the fragtree row inside fragment 0's omap.  NUL is illegal in a
+# dentry name, so this key can never collide with a real entry.
+FRAGTREE_KEY = "\x00fragtree"
+DIRFRAG_MAX = 256               # split ceiling (2^8 fragments)
+
+
+def frag_of(name: str, nfrags: int) -> int:
+    """Dentry → fragment (reference ceph_frag hash placement; a
+    power-of-two modulo keeps redistribution local on split: a row in
+    frag f moves to f or f+old_n, nowhere else)."""
+    import zlib
+    return zlib.crc32(name.encode()) % nfrags if nfrags > 1 else 0
 
 
 def data_oid(ino: int, objno: int) -> str:
@@ -90,6 +107,10 @@ class MDSDaemon(Dispatcher):
         self.data: IoCtx | None = None
         # dir ino → {dentry: inode record}; dirty deltas per dir
         self._dirs: dict[int, dict[str, dict]] = {}
+        self._frags_cache: dict[int, int] = {}
+        # split a dirfrag when its entry count exceeds this
+        # (reference mds_bal_split_size)
+        self.dirfrag_split_size = 10000
         self._dirty_set: dict[int, dict[str, dict]] = {}
         self._dirty_rm: dict[int, set[str]] = {}
         self._jseq = 0                # next journal event seq
@@ -221,6 +242,7 @@ class MDSDaemon(Dispatcher):
                     except Exception:   # noqa: BLE001
                         pass
                     self._dirs.clear()
+                    self._frags_cache.clear()
                     if getattr(self, "_inode_cache", None):
                         self._inode_cache.clear()
                     if self.rank == 0:
@@ -261,6 +283,7 @@ class MDSDaemon(Dispatcher):
             self.fscid = fscid
             self._last_max_mds = fs.max_mds
             self._dirs.clear()
+            self._frags_cache.clear()
             self._dirty_set.clear()
             self._dirty_rm.clear()
             self._completed.clear()
@@ -290,6 +313,7 @@ class MDSDaemon(Dispatcher):
         self.state = "standby"
         self.rank = -1
         self._dirs.clear()
+        self._frags_cache.clear()
         self._dirty_set.clear()
         self._dirty_rm.clear()
         self.sessions.clear()
@@ -456,15 +480,59 @@ class MDSDaemon(Dispatcher):
         return ino, subs
 
     # -- dirfrag cache -----------------------------------------------------
+    def _nfrags(self, ino: int) -> int:
+        """Fragment count from the directory's fragtree row (frag 0);
+        1 ⇒ unfragmented."""
+        n = self._frags_cache.get(ino)
+        if n is None:
+            try:
+                row = self.meta.omap_get(
+                    dirfrag_oid(ino), keys=[FRAGTREE_KEY]
+                ).get(FRAGTREE_KEY)
+                n = int(json.loads(bytes(row))["nfrags"]) if row else 1
+            except ObjectNotFound:
+                n = 1
+            self._frags_cache[ino] = n
+        return n
+
     def _dir(self, ino: int) -> dict[str, dict]:
         d = self._dirs.get(ino)
         if d is None:
-            try:
-                raw = self.meta.omap_get(dirfrag_oid(ino))
-                d = {k: json.loads(v.decode()) for k, v in raw.items()}
-            except ObjectNotFound:
-                d = {}
+            d = self._read_dir_backing(ino)
             self._dirs[ino] = d
+        return d
+
+    def _read_dir_backing(self, ino: int) -> dict[str, dict]:
+        """Uncached merged view of every fragment.  A row found in a
+        fragment its hash no longer points at is an interrupted
+        split's leftover: the correctly-placed copy wins the merge and
+        the stale one is removed on the spot (self-healing — without
+        this, a later unlink would only reach the new home and the
+        stale copy would resurrect on the next cache drop)."""
+        nf = self._nfrags(ino)
+        d: dict[str, dict] = {}
+        stale: dict[int, list[str]] = {}
+        for f in range(nf):
+            try:
+                raw = self.meta.omap_get(dirfrag_oid(ino, f))
+            except ObjectNotFound:
+                continue
+            for k, v in raw.items():
+                if k == FRAGTREE_KEY:
+                    continue
+                if frag_of(k, nf) != f:
+                    # never authoritative: the split wrote the new
+                    # home BEFORE bumping the fragtree, so a live row
+                    # always has a correctly-placed copy
+                    stale.setdefault(f, []).append(k)
+                    continue
+                d[k] = json.loads(v.decode())
+        for f, names in stale.items():
+            try:
+                self.meta.omap_rm_keys(dirfrag_oid(ino, f),
+                                       sorted(names))
+            except Exception:   # noqa: BLE001 — healing is best-effort
+                pass
         return d
 
     def _journal(self, subs: list, client=None, tid=None, reply=None):
@@ -486,22 +554,38 @@ class MDSDaemon(Dispatcher):
                                        {"next": str(sub[1]).encode()})
 
     def _flush(self, trim: bool = False):
-        """Write dirty dirfrag deltas to their objects; optionally trim
-        the journal entries they cover (reference MDLog trim)."""
+        """Write dirty dirfrag deltas to their fragment objects (each
+        dentry routed by hash); optionally trim the journal entries
+        they cover (reference MDLog trim).  Over-size directories
+        split afterwards."""
         upto = self._jseq
+        touched = set()
         for dino, sets in list(self._dirty_set.items()):
             if sets:
-                self.meta.omap_set(
-                    dirfrag_oid(dino),
-                    {n: json.dumps(r).encode() for n, r in sets.items()})
+                nf = self._nfrags(dino)
+                per: dict[int, dict] = {}
+                for n, r in sets.items():
+                    per.setdefault(frag_of(n, nf), {})[n] = \
+                        json.dumps(r).encode()
+                for f, rows in per.items():
+                    self.meta.omap_set(dirfrag_oid(dino, f), rows)
+                touched.add(dino)
             self._dirty_set.pop(dino, None)
         for dino, rms in list(self._dirty_rm.items()):
             if rms:
-                try:
-                    self.meta.omap_rm_keys(dirfrag_oid(dino), sorted(rms))
-                except ObjectNotFound:
-                    pass
+                nf = self._nfrags(dino)
+                per_rm: dict[int, list] = {}
+                for n in rms:
+                    per_rm.setdefault(frag_of(n, nf), []).append(n)
+                for f, names in per_rm.items():
+                    try:
+                        self.meta.omap_rm_keys(dirfrag_oid(dino, f),
+                                               sorted(names))
+                    except ObjectNotFound:
+                        pass
             self._dirty_rm.pop(dino, None)
+        for dino in touched:
+            self._maybe_split(dino)
         if trim and upto > self._jfirst:
             keys = [f"e{s:020d}" for s in range(self._jfirst, upto)]
             try:
@@ -510,6 +594,51 @@ class MDSDaemon(Dispatcher):
                 pass
             self._jfirst = upto
         self._last_flush = _now()
+
+    def _maybe_split(self, dino: int):
+        """Double the fragment count when a directory outgrows the
+        split size (reference MDBalancer/CDir::split).  Redistribution
+        is local by construction: a dentry in frag f moves to f or
+        f + old_n (exactly, for power-of-two counts).  Crash safety:
+        (1) write moved rows to their NEW fragments, (2) bump the
+        fragtree, (3) remove the old copies — an interruption leaves
+        at worst a row duplicated in its old fragment, which
+        _read_dir_backing detects by re-hashing and lazily removes."""
+        old_n = self._nfrags(dino)
+        d = self._dir(dino)
+        if old_n >= DIRFRAG_MAX or \
+                len(d) <= self.dirfrag_split_size * old_n:
+            return
+        new_n = old_n * 2
+        per: dict[int, dict[str, bytes]] = {}
+        for name, rec in d.items():
+            per.setdefault(frag_of(name, new_n), {})[name] = \
+                json.dumps(rec).encode()
+        # (1) the moved rows land in their new homes first
+        for f in range(old_n, new_n):
+            if per.get(f):
+                self.meta.omap_set(dirfrag_oid(dino, f), per[f])
+        # (2) only now does the fragtree say the split happened
+        self.meta.omap_set(dirfrag_oid(dino, 0), {
+            FRAGTREE_KEY: json.dumps({"nfrags": new_n}).encode()})
+        self._frags_cache[dino] = new_n
+        # (3) drop the moved rows from their old fragments
+        for f in range(old_n):
+            dead = sorted(per.get(f + old_n, {}))
+            if dead:
+                try:
+                    self.meta.omap_rm_keys(dirfrag_oid(dino, f), dead)
+                except ObjectNotFound:
+                    pass
+
+    def _remove_dir_backing(self, ino: int):
+        """Remove every fragment object of a (now empty) directory."""
+        for f in range(max(self._nfrags(ino), 1)):
+            try:
+                self.meta.remove(dirfrag_oid(ino, f))
+            except ObjectNotFound:
+                pass
+        self._frags_cache.pop(ino, None)
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:
@@ -548,6 +677,14 @@ class MDSDaemon(Dispatcher):
             done = self._completed[key]
             return done.get("rc", 0), "", done.get("result")
         args = msg.args or {}
+        # dentry-name hygiene, enforced once for every op: NUL is the
+        # fragtree row's namespace (FRAGTREE_KEY) and '/' would break
+        # path resolution — both are illegal in POSIX names anyway
+        for k in ("name", "sname", "dname"):
+            n = args.get(k)
+            if isinstance(n, str) and \
+                    ("\x00" in n or "/" in n or n in ("", ".", "..")):
+                return -22, f"invalid dentry name {n!r}", None
         handler = getattr(self, f"_op_{msg.op}", None)
         if handler is None:
             return -22, f"unknown mds op {msg.op!r}", None
@@ -741,21 +878,14 @@ class MDSDaemon(Dispatcher):
             # be stale and must never stick — the owner's unflushed
             # journal window remains the slice's known gap vs the
             # reference's cross-MDS slave requests)
-            try:
-                raw = self.meta.omap_get(dirfrag_oid(rec["ino"]))
-                fresh = {k: v for k, v in raw.items()}
-            except ObjectNotFound:
-                fresh = {}
             self._dirs.pop(rec["ino"], None)
-            if fresh:
+            self._frags_cache.pop(rec["ino"], None)
+            if self._read_dir_backing(rec["ino"]):
                 return -39, f"{name!r} not empty", None
         elif self._dir(rec["ino"]):
             return -39, f"{name!r} not empty", None
         rc = self._mutate([["rm", dino, name]], client, tid)
-        try:
-            self.meta.remove(dirfrag_oid(rec["ino"]))
-        except ObjectNotFound:
-            pass
+        self._remove_dir_backing(rec["ino"])
         self._dirs.pop(rec["ino"], None)
         return rc
 

@@ -45,6 +45,18 @@ def _journal_oid(name: str) -> str:
     return f"rbd_journal.{name}"
 
 
+def _is_data_suffix(rest: str) -> bool:
+    """True iff `rest` is '<16-hex-objno>' or '<16-hex-objno>@<int>'
+    (a snapshot clone) — the only shapes this image's data objects
+    take.  Guards every prefix scan against sibling images whose name
+    extends ours ("foo" vs "foo.123")."""
+    base, _, clone = rest.partition("@")
+    if len(base) != 16 or any(c not in "0123456789abcdef"
+                              for c in base):
+        return False
+    return clone == "" or clone.isdigit()
+
+
 def _objmap_oid(name: str, snap_id: int | None = None) -> str:
     """Object-map object (reference src/librbd/object_map/): the head
     map plus one frozen copy per snapshot."""
@@ -167,8 +179,13 @@ class RBD:
                         p._save_header()
             except ImageNotFound:
                 pass
+        # data objects: the suffix after "rbd_data.<name>." must be
+        # the 16-hex objno (optionally "@<snapclone>") — a plain
+        # prefix match would also destroy image "foo.123"'s objects
+        # when removing "foo"
+        pre = f"rbd_data.{name}."
         for o in ioctx.list_objects():
-            if o.startswith(f"rbd_data.{name}."):
+            if o.startswith(pre) and _is_data_suffix(o[len(pre):]):
                 ioctx.remove(o)
         # drop the journal object too: a re-created image under the
         # same name must not inherit stale head_seq/mirror_position/
@@ -178,14 +195,16 @@ class RBD:
             ioctx.remove(_journal_oid(name))
         except ObjectNotFound:
             pass
-        # and every object-map object (head + per-snap copies)
-        om_base = _objmap_oid(name)
-        for o in ioctx.list_objects():
-            if o == om_base or o.startswith(om_base + "."):
-                try:
-                    ioctx.remove(o)
-                except ObjectNotFound:
-                    pass
+        # and the object maps: head + exactly the header's snap ids
+        # (never a prefix scan — "rbd_object_map.foo.123" is image
+        # foo.123's HEAD map, not one of foo's snap maps)
+        for om in [_objmap_oid(name)] + [
+                _objmap_oid(name, s["id"])
+                for s in img._hdr.get("snaps", {}).values()]:
+            try:
+                ioctx.remove(om)
+            except ObjectNotFound:
+                pass
         ioctx.remove(_header_oid(name))
         img.close()
 
@@ -493,19 +512,26 @@ class Image:
         if snap_name in self._hdr["snaps"]:
             raise ValueError(f"snapshot {snap_name!r} exists")
         self._journal_append({"op": "snap_create", "name": snap_name})
-        self._hdr["snap_seq"] += 1
+        sid = self._hdr["snap_seq"] + 1
+        m = None
+        if self._objmap_enabled():
+            # freeze the map under the NEW id BEFORE the header
+            # registers the snap: a crash in between leaves only an
+            # orphan map object (the retry overwrites it) — the other
+            # order would register a snap whose map loads as all-NONE
+            # and silently drop objects from incrementals
+            m = self._objmap_load()
+            self._objmap_save(m, sid)
+        self._hdr["snap_seq"] = sid
         self._hdr["snaps"][snap_name] = {
-            "id": self._hdr["snap_seq"], "size": self._hdr["size"],
+            "id": sid, "size": self._hdr["size"],
             # fast-diff needs to know whether this snap's view has
             # parent-backed bytes the object map can't see
             "has_parent": self._hdr.get("parent") is not None}
         self._save_header()
-        if self._objmap_enabled():
-            # freeze the map for the snap, then mark the head clean:
-            # future writes flip objects back to dirty, which is
-            # exactly what fast-diff reads off the next interval
-            m = self._objmap_load()
-            self._objmap_save(m, self._hdr["snap_seq"])
+        if m is not None:
+            # clean the head LAST: a crash before this leaves extra
+            # dirty bits (conservative — more diff reads, never fewer)
             for i, v in enumerate(m):
                 if v == OM_DIRTY:
                     m[i] = OM_CLEAN
@@ -584,7 +610,8 @@ class Image:
         prefix = f"rbd_data.{self.name}."
         clones: dict[str, list[int]] = {}
         for o in self.ioctx.list_objects():
-            if o.startswith(prefix) and "@" in o:
+            if o.startswith(prefix) and "@" in o and \
+                    _is_data_suffix(o[len(prefix):]):
                 base, _, cid = o.rpartition("@")
                 clones.setdefault(base, []).append(int(cid))
         for base, cids in clones.items():
@@ -710,16 +737,18 @@ class Image:
         except ObjectNotFound:
             return False
 
-    def _copy_up(self, objno: int):
+    def _copy_up(self, objno: int) -> bool:
         """First write to a parent-backed object copies the parent
-        bytes into the child first (reference copyup)."""
+        bytes into the child first (reference copyup).  → True iff
+        the child owns the object afterwards (flatten uses this to
+        build the object map without re-statting everything)."""
         if self._hdr.get("parent") is None:
-            return
+            return self._object_exists(objno)
         oid = _data_oid(self.name, objno)
         from ..osdc.librados import ObjectNotFound
         try:
             self.ioctx.stat(oid)
-            return              # child already owns this object
+            return True         # child already owns this object
         except ObjectNotFound:
             # only a definitive "absent" may fall through to the
             # copyup write: a transient error on an object the child
@@ -729,6 +758,8 @@ class Image:
         base = self._parent_bytes(objno)
         if base:
             self.ioctx.write_full(oid, base)
+            return True
+        return False
 
     def flatten(self):
         """Copy all parent-backed data into the child and detach it
@@ -743,15 +774,13 @@ class Image:
             (e.object_no for e in
              file_to_extents(self.layout, 0, parent["overlap"])),
             default=-1)
-        for objno in range(nobj):
-            self._copy_up(objno)
+        owned = {objno for objno in range(nobj)
+                 if self._copy_up(objno)}
         if self._objmap_enabled():
             # the copied-up objects now hold the image's only copy of
             # the parent bytes: they must enter the object map, or the
             # first post-flatten export-diff would skip them
-            self._objmap_mark({
-                objno for objno in range(nobj)
-                if self._object_exists(objno)})
+            self._objmap_mark(owned)
         with Image(self.ioctx, parent["image"]) as p:
             snap = p._hdr["snaps"].get(parent["snap"])
             if snap is not None:

@@ -1,0 +1,210 @@
+"""CephFS directory fragmentation (reference CDir split + MDBalancer
+dirfrags; VERDICT r3 missing #5): a directory over the split size
+spreads its dentries across fragment objects; lookups, readdir,
+rename across frags, rmdir, and MDS failover replay all keep working.
+"""
+
+import pytest
+
+from ceph_tpu.mds.daemon import (DIRFRAG_MAX, FRAGTREE_KEY, dirfrag_oid,
+                                 frag_of)
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def fscluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    c.fs_new("cephfs")
+    mds = c.start_mds("a")
+    c.wait_for_active_mds()
+    # small split size so tests fragment with tens of entries
+    mds.dirfrag_split_size = 8
+    fs = c.cephfs()
+    yield c, mds, fs
+    c.stop()
+
+
+def _active_mds(c):
+    for m in c.mdss.values():
+        if m.state == "active":
+            return m
+    raise AssertionError("no active MDS")
+
+
+class TestDirfragSplit:
+    def test_big_dir_splits_and_stays_correct(self, fscluster):
+        c, mds, fs = fscluster
+        fs.mkdir("/big")
+        names = [f"file-{i:04d}" for i in range(40)]
+        for n in names:
+            fd = fs.open(f"/big/{n}", "w")
+            fs.write(fd, f"payload-{n}".encode())
+            fs.close(fd)
+        mds = _active_mds(c)
+        with mds.lock:
+            mds._flush(trim=True)
+        ino = mds._dir(1)["big"]["ino"]
+        nf = mds._nfrags(ino)
+        assert nf >= 2, f"directory did not split (nfrags={nf})"
+        # dentries really spread across fragment objects
+        used = set()
+        for f in range(nf):
+            try:
+                rows = mds.meta.omap_get(dirfrag_oid(ino, f))
+            except Exception:
+                continue
+            ks = [k for k in rows if k != FRAGTREE_KEY]
+            if ks:
+                used.add(f)
+                for k in ks:
+                    assert frag_of(k, nf) == f   # routed correctly
+        assert len(used) >= 2, used
+        # readdir merges every fragment
+        assert sorted(fs.listdir("/big")) == names
+        # lookups hit the right frag
+        assert fs.read_file("/big/file-0017") == b"payload-file-0017"
+
+    def test_rename_across_frags(self, fscluster):
+        """Rename where source and destination dentries hash to
+        DIFFERENT fragments of the same (split) directory, and into
+        another directory."""
+        c, mds, fs = fscluster
+        mds = _active_mds(c)
+        ino = mds._dir(1)["big"]["ino"]
+        nf = mds._nfrags(ino)
+        src = "file-0003"
+        # find a new name landing in a different frag than src
+        dst = next(f"renamed-{i}" for i in range(1000)
+                   if frag_of(f"renamed-{i}", nf)
+                   != frag_of(src, nf))
+        fs.rename(f"/big/{src}", f"/big/{dst}")
+        with mds.lock:
+            mds._flush(trim=True)
+        listing = fs.listdir("/big")
+        assert dst in listing and src not in listing
+        assert fs.read_file(f"/big/{dst}") == b"payload-file-0003"
+        # and across directories (frag'd → unfragmented)
+        fs.mkdir("/side")
+        fs.rename(f"/big/{dst}", "/side/moved")
+        assert "moved" in fs.listdir("/side")
+        assert dst not in fs.listdir("/big")
+        fs.rename("/side/moved", f"/big/{src}")   # restore
+
+    def test_unlink_and_rmdir_fragmented(self, fscluster):
+        c, mds, fs = fscluster
+        fs.mkdir("/gone")
+        for i in range(40):
+            fd = fs.open(f"/gone/f{i:03d}", "w")
+            fs.close(fd)
+        mds = _active_mds(c)
+        with mds.lock:
+            mds._flush(trim=True)
+        ino = mds._dir(1)["gone"]["ino"]
+        assert mds._nfrags(ino) >= 2
+        with pytest.raises(Exception):
+            fs.rmdir("/gone")                   # not empty
+        for i in range(40):
+            fs.unlink(f"/gone/f{i:03d}")
+        fs.rmdir("/gone")
+        assert "gone" not in fs.listdir("/")
+        # every fragment object is gone from the metadata pool
+        for f in range(DIRFRAG_MAX):
+            try:
+                rows = mds.meta.omap_get(dirfrag_oid(ino, f))
+            except Exception:
+                rows = {}
+            assert not rows, (f, rows)
+
+    def test_failover_replays_into_fragments(self, fscluster):
+        """Journaled-but-unflushed entries of a fragmented directory
+        survive an MDS crash: the standby replays them and routes the
+        rows to the correct fragments."""
+        c, mds, fs = fscluster
+        c.start_mds("b").dirfrag_split_size = 8
+        active = _active_mds(c)
+        fs.mkdir("/crashy")
+        for i in range(40):
+            fd = fs.open(f"/crashy/pre{i:03d}", "w")
+            fs.close(fd)
+        with active.lock:
+            active._flush(trim=True)
+        # unflushed tail: journaled only
+        fd = fs.open("/crashy/tail-entry", "w")
+        fs.write(fd, b"survives")
+        fs.close(fd)
+        victim = active.name
+        c.kill_mds(victim)
+        c.wait_for_active_mds(timeout=30)
+        survivor = _active_mds(c)
+        survivor.dirfrag_split_size = 8
+        import time
+        deadline = time.monotonic() + 20
+        names = []
+        while time.monotonic() < deadline:
+            try:
+                names = fs.listdir("/crashy")
+                if "tail-entry" in names:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        assert "tail-entry" in names, names
+        assert fs.read_file("/crashy/tail-entry") == b"survives"
+
+    def test_multi_mds_subtree_with_fragments(self):
+        """A fragmented directory inside a subtree re-homed by a
+        max_mds change stays fully readable/writable from the new
+        owner: fragment objects live in the shared metadata pool and
+        migrate with the subtree."""
+        import time
+        import zlib
+        with MiniCluster(n_mons=1, n_osds=3) as c:
+            c.fs_new("cephfs")
+            for n in ("a", "b"):
+                c.start_mds(n).dirfrag_split_size = 8
+            c.wait_for_active_mds()
+            fs = c.cephfs()
+            # a top-level dir owned by rank 1 AFTER the grow
+            top = next(n for n in ("alpha", "beta", "gamma", "delta")
+                       if zlib.crc32(n.encode()) % 2 == 1)
+            fs.mkdir(f"/{top}")
+            names = [f"e{i:03d}" for i in range(40)]
+            for n in names:
+                fs.write_file(f"/{top}/{n}", f"v-{n}".encode())
+            active = _active_mds(c)
+            with active.lock:
+                active._flush(trim=True)
+            ino = active._dir(1)[top]["ino"]
+            assert active._nfrags(ino) >= 2
+            # grow to two ranks: /top re-homes to rank 1
+            r = c.rados()
+            rc, outs, _ = r.mon_command({
+                "prefix": "fs set", "fs_name": "cephfs",
+                "var": "max_mds", "val": "2"})
+            assert rc == 0, outs
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                states = [m for m in c.mdss.values()
+                          if m.state == "active"]
+                if len(states) == 2:
+                    break
+                time.sleep(0.2)
+            assert len(states) == 2
+            # the NEW owner serves the fragmented directory intact
+            deadline = time.monotonic() + 20
+            listing = []
+            while time.monotonic() < deadline:
+                try:
+                    listing = fs.listdir(f"/{top}")
+                    if sorted(listing) == names:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.3)
+            assert sorted(listing) == names
+            assert fs.read_file(f"/{top}/e017") == b"v-e017"
+            fs.write_file(f"/{top}/post-move", b"new-owner-write")
+            assert fs.read_file(f"/{top}/post-move") == \
+                b"new-owner-write"
+            fs.unmount()
