@@ -509,14 +509,20 @@ class MDSDaemon(Dispatcher):
         the stale one is removed on the spot (self-healing — without
         this, a later unlink would only reach the new home and the
         stale copy would resurrect on the next cache drop)."""
-        nf = self._nfrags(ino)
+        # fragment 0 carries the fragtree row: one read serves both
+        # the count and the rows (the split-count probe and the data
+        # read used to be two round trips)
+        try:
+            raw0 = self.meta.omap_get(dirfrag_oid(ino, 0))
+        except ObjectNotFound:
+            raw0 = {}
+        ft = raw0.get(FRAGTREE_KEY)
+        nf = int(json.loads(bytes(ft))["nfrags"]) if ft else 1
+        self._frags_cache[ino] = nf
         d: dict[str, dict] = {}
         stale: dict[int, list[str]] = {}
-        for f in range(nf):
-            try:
-                raw = self.meta.omap_get(dirfrag_oid(ino, f))
-            except ObjectNotFound:
-                continue
+
+        def absorb(f: int, raw: dict):
             for k, v in raw.items():
                 if k == FRAGTREE_KEY:
                     continue
@@ -527,6 +533,20 @@ class MDSDaemon(Dispatcher):
                     stale.setdefault(f, []).append(k)
                     continue
                 d[k] = json.loads(v.decode())
+
+        absorb(0, raw0)
+        for f in range(1, nf):
+            try:
+                absorb(f, self.meta.omap_get(dirfrag_oid(ino, f)))
+            except ObjectNotFound:
+                continue
+        # NB: rows a pre-bump-interrupted split left in [nf, 2nf) are
+        # NOT probed here — they are invisible to reads (loops stop at
+        # nf) and _maybe_split sanitizes its target fragments before
+        # merging, so they can never resurrect.  Probing them from a
+        # reader would both cost an extra round trip per load and race
+        # the OWNER rank's in-flight split (sweeping rows it just
+        # wrote, before the bump makes them authoritative).
         for f, names in stale.items():
             try:
                 self.meta.omap_rm_keys(dirfrag_oid(ino, f),
@@ -614,8 +634,20 @@ class MDSDaemon(Dispatcher):
         for name, rec in d.items():
             per.setdefault(frag_of(name, new_n), {})[name] = \
                 json.dumps(rec).encode()
-        # (1) the moved rows land in their new homes first
+        # (1) the moved rows land in their new homes first — after
+        # dropping any leftovers a previously-interrupted split left
+        # there (omap_set merges; a stale row would otherwise ride
+        # into the new fragment as a resurrected dentry)
         for f in range(old_n, new_n):
+            try:
+                existing = set(self.meta.omap_get(
+                    dirfrag_oid(dino, f)))
+            except ObjectNotFound:
+                existing = set()
+            dead = sorted(existing - set(per.get(f, {}))
+                          - {FRAGTREE_KEY})
+            if dead:
+                self.meta.omap_rm_keys(dirfrag_oid(dino, f), dead)
             if per.get(f):
                 self.meta.omap_set(dirfrag_oid(dino, f), per[f])
         # (2) only now does the fragtree say the split happened
@@ -679,11 +711,16 @@ class MDSDaemon(Dispatcher):
         args = msg.args or {}
         # dentry-name hygiene, enforced once for every op: NUL is the
         # fragtree row's namespace (FRAGTREE_KEY) and '/' would break
-        # path resolution — both are illegal in POSIX names anyway
+        # path resolution — both are illegal in POSIX names anyway.
+        # ""/"."/".." are refused only for mutations: the read path
+        # deliberately uses name="" for the root lookup
+        mutating = msg.op not in ("lookup", "getattr", "readdir")
         for k in ("name", "sname", "dname"):
             n = args.get(k)
-            if isinstance(n, str) and \
-                    ("\x00" in n or "/" in n or n in ("", ".", "..")):
+            if not isinstance(n, str):
+                continue
+            if "\x00" in n or "/" in n or \
+                    (mutating and n in ("", ".", "..")):
                 return -22, f"invalid dentry name {n!r}", None
         handler = getattr(self, f"_op_{msg.op}", None)
         if handler is None:

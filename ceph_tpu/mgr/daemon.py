@@ -17,6 +17,7 @@ import time
 
 from ..mon import messages as MM
 from ..mon.client import MonClient
+from ..msg import Dispatcher, Messenger
 from ..osd.osdmap import OSDMap, PGid
 from ..tools.osdmaptool import osdmap_from_dict
 from .balancer import UpmapBalancer
@@ -140,10 +141,51 @@ def _default_modules():
     from .dashboard import DashboardModule
     from .modules import (CrashModule, IostatModule, StatusModule,
                           TelemetryModule)
+    from .orchestrator import OrchestratorModule
     from .volumes import VolumesModule
     return (BalancerModule, PgAutoscalerModule, PrometheusModule,
             StatusModule, IostatModule, CrashModule, TelemetryModule,
-            DashboardModule, VolumesModule)
+            DashboardModule, VolumesModule, OrchestratorModule)
+
+
+class _MgrCommandServer(Dispatcher):
+    """Serves MMonCommand frames arriving on the mgr's own
+    messenger (reference DaemonServer handling `ceph tell mgr` /
+    orchestrator commands).  Modules answer via handle_command."""
+
+    def __init__(self, daemon: "MgrDaemon"):
+        self.d = daemon
+
+    def ms_dispatch(self, msg) -> bool:
+        if not isinstance(msg, MM.MMonCommand):
+            return False
+        cmd = msg.cmd if isinstance(msg.cmd, dict) else {}
+        rc, outs, outb = -22, f"unknown mgr command "                               f"{cmd.get('prefix')!r}", None
+        if self.d.state != "active":
+            rc, outs = -11, "mgr not active"
+        else:
+            # NB: deliberately NOT under self.d.lock — a slow module
+            # command would stall the loop thread at its lock acquire
+            # and starve beacons (mon demotes us mid-command).
+            # Modules doing slow work serialize internally
+            # (OrchestratorModule defers deploys to a worker).
+            for mod in list(self.d.modules.values()):
+                handler = getattr(mod, "handle_command", None)
+                if handler is None:
+                    continue
+                try:
+                    res = handler(cmd)
+                except Exception as e:      # noqa: BLE001 — module
+                    res = (-22, f"module error: {e!r}", None)
+                if res is not None:
+                    rc, outs, outb = res
+                    break
+        try:
+            msg.connection.send_message(MM.MMonCommandReply(
+                tid=msg.tid, rc=rc, outs=outs, outb=outb))
+        except ConnectionError:
+            pass
+        return True
 
 
 class MgrDaemon:
@@ -161,6 +203,14 @@ class MgrDaemon:
         self.asok_paths = dict(asok_paths or {})
         self.monc = MonClient(monmap, entity=f"mgr.{name}",
                               auth=auth)
+        # the mgr's own command server (reference DaemonServer): the
+        # `ceph orch ...` / `ceph tell mgr` path connects HERE, found
+        # via the mgrmap's active_addr
+        self.msgr = Messenger(
+            f"mgr.{name}",
+            **(auth.msgr_kwargs(f"mgr.{name}") if auth else {}))
+        self.msgr.add_dispatcher(_MgrCommandServer(self))
+        self.addr = None
         # observability (reference: the mgr serves its own asok)
         import os as _os
         from ..core.admin_socket import AdminSocket
@@ -190,6 +240,7 @@ class MgrDaemon:
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         self.running = True
+        self.addr = self.msgr.bind()
         self.admin_socket.start()
         self.monc.on_mgrmap = self._on_mgrmap
         self.monc.sub_want("mgrmap", 0)
@@ -206,6 +257,7 @@ class MgrDaemon:
         self.admin_socket.shutdown()
         with self.lock:
             self._stop_modules()
+        self.msgr.shutdown()
         self.monc.shutdown()
 
     def kill(self):
@@ -216,7 +268,8 @@ class MgrDaemon:
 
     def _send_beacon(self):
         self._seq += 1
-        self.monc.send(MM.MMgrBeacon(name=self.name, addr=[],
+        addr = [self.addr.host, self.addr.port] if self.addr else []
+        self.monc.send(MM.MMgrBeacon(name=self.name, addr=addr,
                                      seq=self._seq))
 
     # -- map handling ------------------------------------------------------

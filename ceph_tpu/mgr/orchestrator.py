@@ -1,0 +1,262 @@
+"""mgr orchestrator — declarative service specs reconciled into the
+deployer.
+
+Reference behavior re-created (``src/pybind/mgr/orchestrator/`` +
+``src/pybind/mgr/cephadm/``; SURVEY.md §3.10): ``ceph orch apply``
+declares a service's desired shape, the module persists the spec in
+the mon's config-key store and continuously reconciles reality toward
+it through a deployment backend; ``ceph orch ls`` shows declared vs
+running, ``ceph orch ps`` lists daemons.  The command transport is the
+mgr's own command server (reference DaemonServer), reached via the
+mgrmap's active_addr — exactly the `ceph orch` → mon → mgr → cephadm
+round trip, minus the ssh/container layer (our deployment unit is the
+in-process daemon, as in ``tools/cephadm.py``).
+
+Spec shape: ``{"service_type": "mds"|"rgw"|"osd", "count": N}``.
+Orchestrator-managed daemons are named ``orch-<type>-<i>`` so
+reconciliation only ever removes what it created.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from .daemon import MgrModule
+
+SPEC_PREFIX = "orch/spec/"          # config-key namespace
+MANAGED = ("mds", "rgw", "osd")
+
+
+class OrchestratorModule(MgrModule):
+    NAME = "orchestrator"
+    TICK = 1.0
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        # the deployment backend (reference: the cephadm module's ssh
+        # connection pool; here: a MiniCluster wrapper) is injected by
+        # whoever owns the deployment — no backend ⇒ specs are stored
+        # and listed but reconciliation reports itself paused
+        self.backend = getattr(ctx._d, "orch_backend", None)
+        self._specs: dict[str, dict] | None = None
+        # deploys run on a dedicated worker (reference: the cephadm
+        # module's serve thread): starting an OSD/MDS blocks for
+        # seconds, which must stall neither the mgr tick loop (beacon
+        # starvation ⇒ spurious failover) nor the command server.
+        # _rec_lock serializes reconciles so a command-triggered pass
+        # can't double-deploy against the worker's
+        self._rec_lock = threading.Lock()
+        self._kick = threading.Event()
+        self._stop = False
+        self._worker: threading.Thread | None = None
+
+    # -- spec store (mon config-key; survives mgr failover) ----------------
+    def _load_specs(self) -> dict[str, dict]:
+        if self._specs is None:
+            specs = {}
+            rc, _, keys = self.ctx.mon_command(
+                {"prefix": "config-key ls"})
+            for k in (keys or []) if rc == 0 else []:
+                if not k.startswith(SPEC_PREFIX):
+                    continue
+                rc2, _, val = self.ctx.mon_command(
+                    {"prefix": "config-key get", "key": k})
+                if rc2 == 0 and val:
+                    specs[k[len(SPEC_PREFIX):]] = json.loads(val)
+            self._specs = specs
+        return self._specs
+
+    def _store_spec(self, stype: str, spec: dict):
+        self.ctx.mon_command({
+            "prefix": "config-key put",
+            "key": f"{SPEC_PREFIX}{stype}",
+            "val": json.dumps(spec)})
+        self._load_specs()[stype] = spec
+
+    def _drop_spec(self, stype: str):
+        self.ctx.mon_command({
+            "prefix": "config-key del",
+            "key": f"{SPEC_PREFIX}{stype}"})
+        self._load_specs().pop(stype, None)
+
+    # -- command surface (reference `ceph orch ...`) -----------------------
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix == "orch apply":
+            stype = cmd.get("service_type")
+            if stype not in MANAGED:
+                return (-22, f"unsupported service_type {stype!r} "
+                             f"(supported: {', '.join(MANAGED)})",
+                        None)
+            try:
+                count = int(cmd.get("count", 1))
+            except (TypeError, ValueError):
+                return -22, "count must be an integer", None
+            if count < 0:
+                return -22, "count must be >= 0", None
+            spec = {"service_type": stype, "count": count}
+            self._store_spec(stype, spec)
+            self._kick_worker()
+            return 0, f"Scheduled {stype} update: count {count}" + \
+                ("" if self.backend is not None
+                 else " (no backend: deferred)"), spec
+        if prefix == "orch ls":
+            out = []
+            for stype, spec in sorted(self._load_specs().items()):
+                out.append({
+                    "service_type": stype,
+                    "count": spec.get("count", 0),
+                    "running": self._running_count(stype),
+                })
+            return 0, "", out
+        if prefix == "orch ps":
+            if self.backend is None:
+                return 0, "no backend attached", []
+            return 0, "", self.backend.daemon_inventory()
+        if prefix == "orch rm":
+            stype = cmd.get("service_type")
+            if stype not in self._load_specs():
+                return -2, f"no spec for {stype!r}", None
+            self._drop_spec(stype)
+            return 0, f"Removed service spec {stype}", None
+        return None
+
+    # -- reconciliation ----------------------------------------------------
+    def _running_count(self, stype: str) -> int:
+        if self.backend is None:
+            return 0
+        return sum(1 for d in self.backend.daemon_inventory()
+                   if d["type"] == stype)
+
+    def _reconcile(self) -> bool:
+        """Move reality toward the declared specs; → False when no
+        backend is attached (specs stay pending)."""
+        if self.backend is None:
+            return False
+        with self._rec_lock:
+            # snapshot: handle_command (messenger thread) mutates the
+            # spec dict mid-pass, and a changed-size RuntimeError
+            # would kill the worker outside the per-spec try
+            for stype, spec in list(self._load_specs().items()):
+                try:
+                    self.backend.ensure(stype,
+                                        int(spec.get("count", 0)))
+                except Exception:   # noqa: BLE001 — retried next pass
+                    pass
+        return True
+
+    def _kick_worker(self):
+        if self.backend is None:
+            return
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="orch-reconcile",
+                daemon=True)
+            self._worker.start()
+        self._kick.set()
+
+    def _worker_loop(self):
+        while not self._stop:
+            self._kick.wait(timeout=2.0)
+            self._kick.clear()
+            if self._stop:
+                return
+            self._reconcile()
+
+    def serve_tick(self):
+        # non-blocking: the tick (which runs under the mgr-wide lock)
+        # only nudges the worker
+        self._kick_worker()
+
+    def shutdown(self):
+        self._stop = True
+        self._kick.set()
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+
+
+class MiniClusterBackend:
+    """Deployment backend over a MiniCluster — the in-process analog
+    of the cephadm module's ssh/container deployer.  Only daemons it
+    created (``orch-*`` names / OSD ids it added) are ever removed."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._rgw = None
+        self._rados = None
+        self._added_osds: list[int] = []
+
+    def daemon_inventory(self) -> list[dict]:
+        out = []
+        for r in range(len(self.cluster.mons)):
+            out.append({"name": f"mon.{r}", "type": "mon",
+                        "status": "running"})
+        for i in self.cluster.osds:
+            out.append({"name": f"osd.{i}", "type": "osd",
+                        "status": "running"})
+        for name, mds in self.cluster.mdss.items():
+            out.append({"name": f"mds.{name}", "type": "mds",
+                        "status": mds.state})
+        for name in self.cluster.mgrs:
+            out.append({"name": f"mgr.{name}", "type": "mgr",
+                        "status": "running"})
+        if self._rgw is not None:
+            out.append({"name": "rgw.orch-0", "type": "rgw",
+                        "status": "running",
+                        "endpoint":
+                            f"http://127.0.0.1:{self._rgw.port}"})
+        return sorted(out, key=lambda d: d["name"])
+
+    def ensure(self, stype: str, count: int):
+        if stype == "mds":
+            self._ensure_mds(count)
+        elif stype == "rgw":
+            self._ensure_rgw(count)
+        elif stype == "osd":
+            self._ensure_osd(count)
+
+    def _ensure_mds(self, count: int):
+        running = list(self.cluster.mdss)
+        if len(running) < count:
+            taken = set(running)
+            i = 0
+            while len(self.cluster.mdss) < count:
+                name = f"orch-mds-{i}"
+                i += 1
+                if name in taken:
+                    continue
+                self.cluster.start_mds(name)
+        elif len(running) > count:
+            # shrink only what we created, newest first
+            managed = sorted((n for n in running
+                              if n.startswith("orch-mds-")),
+                             reverse=True)
+            for name in managed[:len(running) - count]:
+                self.cluster.kill_mds(name)
+
+    def _ensure_rgw(self, count: int):
+        if count > 0 and self._rgw is None:
+            from ..rgw import RGWService
+            if self._rados is None:
+                self._rados = self.cluster.rados()
+            self._rgw = RGWService(self._rados).start()
+        elif count == 0 and self._rgw is not None:
+            self._rgw.shutdown()
+            self._rgw = None
+
+    def _ensure_osd(self, count: int):
+        cur = len(self.cluster.osds)
+        if cur < count:
+            next_id = max(self.cluster.osds, default=-1) + 1
+            for i in range(next_id, next_id + (count - cur)):
+                self.cluster.start_osd(i)
+                self._added_osds.append(i)
+        # shrink is deliberately unsupported: draining an OSD needs
+        # rebalancing orchestration (reference `ceph orch osd rm`
+        # drains first); report-only here
+
+    def shutdown(self):
+        if self._rgw is not None:
+            self._rgw.shutdown()
+            self._rgw = None

@@ -15,6 +15,7 @@ import threading
 import time
 
 from ..msg import Dispatcher, Messenger
+from ..msg.messenger import EntityAddr
 from . import messages as M
 from .monitor import MonMap
 
@@ -30,6 +31,8 @@ class MonClient(Dispatcher):
         self.msgr.add_dispatcher(self)
         self._con = None
         self._cur_rank: int | None = None
+        self._mgr_con = None
+        self._mgr_addr: tuple | None = None
         self._tid = 0
         self._waiters: dict[int, tuple[threading.Event, list]] = {}
         self._subs: dict[str, int] = {}
@@ -70,6 +73,30 @@ class MonClient(Dispatcher):
         self.msgr.shutdown()
 
     # -- commands ----------------------------------------------------------
+    def _send_and_wait(self, con, cmd: dict, end: float):
+        """Register a tid waiter, send MMonCommand on `con`, await the
+        reply until `end` → reply message or None (timeout).  Shared
+        by the mon and mgr command paths so the waiter/timeout
+        machinery cannot drift between them."""
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+            ev = threading.Event()
+            self._waiters[tid] = (ev, [])
+        try:
+            con.send_message(M.MMonCommand(tid=tid, cmd=cmd))
+        except Exception:
+            with self._lock:
+                self._waiters.pop(tid, None)
+            raise
+        if not ev.wait(max(0.05, end - time.monotonic())):
+            with self._lock:
+                self._waiters.pop(tid, None)
+            return None
+        with self._lock:
+            _, box = self._waiters.pop(tid)
+        return box[0]
+
     def command(self, cmd: dict | str, timeout: float | None = None):
         """→ (rc, status_str, output).  Retries against the leader when
         a peon refuses a mutating command."""
@@ -79,36 +106,19 @@ class MonClient(Dispatcher):
         end = time.monotonic() + deadline   # TOTAL budget: retries,
         last_outs = ""                      # waits and reconnects all
         while time.monotonic() < end:       # share it
-            tid = None
             try:
                 self._ensure()
-                con = self._con
-                with self._lock:
-                    self._tid += 1
-                    tid = self._tid
-                    ev = threading.Event()
-                    self._waiters[tid] = (ev, [])
-                con.send_message(M.MMonCommand(tid=tid, cmd=cmd))
+                reply = self._send_and_wait(self._con, cmd, end)
             except (ConnectionError, OSError, AttributeError):
                 # no mon reachable right now, or another thread hunted
-                # (_con = None) between _ensure and the send: drop the
-                # registered waiter (if we got that far — a late reply
-                # must not land in a dead box), back off a beat and
-                # keep hunting within the budget
-                if tid is not None:
-                    with self._lock:
-                        self._waiters.pop(tid, None)
+                # (_con = None) between _ensure and the send: back off
+                # a beat and keep hunting within the budget
                 self._con = None
                 time.sleep(0.3)
                 continue
-            if not ev.wait(max(0.05, end - time.monotonic())):
-                with self._lock:
-                    self._waiters.pop(tid, None)
+            if reply is None:
                 self._con = None     # mon silent: hunt a new one
                 continue
-            with self._lock:
-                _, box = self._waiters.pop(tid)
-            reply = box[0]
             if reply.rc == -11:      # not leader (referral) or a
                 # transient internal error: remember the reason so a
                 # persistent failure surfaces it, then retry
@@ -131,6 +141,53 @@ class MonClient(Dispatcher):
             return reply.rc, reply.outs, reply.outb
         raise TimeoutError(
             f"mon command {cmd.get('prefix')!r} failed"
+            + (f": {last_outs}" if last_outs else ""))
+
+    def mgr_command(self, cmd: dict | str,
+                    timeout: float | None = None):
+        """→ (rc, status_str, output) from the ACTIVE mgr's command
+        server (reference librados mgr_command / `ceph tell mgr`):
+        resolve active_addr from the mgrmap, connect, correlate the
+        reply by tid through the shared waiter table."""
+        if isinstance(cmd, str):
+            cmd = {"prefix": cmd}
+        deadline = timeout if timeout is not None else self.timeout
+        end = time.monotonic() + deadline
+        last_outs = ""
+        while time.monotonic() < end:
+            rc, outs, mgrmap = self.command(
+                "mgr dump", timeout=max(0.1, end - time.monotonic()))
+            if rc != 0 or not (mgrmap or {}).get("active_addr"):
+                last_outs = outs or "no active mgr"
+                time.sleep(0.3)
+                continue
+            host, port = mgrmap["active_addr"]
+            try:
+                con = self._mgr_con
+                if con is None or not con.is_connected \
+                        or self._mgr_addr != (host, port):
+                    if con is not None:
+                        con.mark_down()
+                    con = self.msgr.connect_to(
+                        EntityAddr(host, int(port)))
+                    self._mgr_con = con
+                    self._mgr_addr = (host, port)
+                reply = self._send_and_wait(con, cmd, end)
+            except (ConnectionError, OSError, AttributeError):
+                self._mgr_con = None
+                time.sleep(0.3)
+                continue
+            if reply is None:
+                self._mgr_con = None
+                continue
+            if reply.rc == -11:     # mgr mid-failover: re-resolve
+                last_outs = reply.outs or last_outs
+                self._mgr_con = None
+                time.sleep(0.3)
+                continue
+            return reply.rc, reply.outs, reply.outb
+        raise TimeoutError(
+            f"mgr command {cmd.get('prefix')!r} failed"
             + (f": {last_outs}" if last_outs else ""))
 
     def send(self, msg):

@@ -984,7 +984,16 @@ class MgrMonitor(PaxosService):
         self.last_beacon[name] = time.monotonic()
         self._addrs[name] = list(addr or [])
         cur = self._cur()
-        if cur["active_name"] == name or name in cur["standbys"]:
+        if cur["active_name"] == name:
+            # a restarted active mgr re-binds: keep its command-server
+            # address current or `ceph orch` connects into the void
+            if addr and list(addr) != (cur["active_addr"] or []):
+                m = dict(cur, standbys=list(cur["standbys"]),
+                         active_addr=list(addr))
+                self._stage_map(m)
+                self.mon.propose()
+            return
+        if name in cur["standbys"]:
             return
         m = dict(cur, standbys=list(cur["standbys"]))
         if not m["active_name"]:

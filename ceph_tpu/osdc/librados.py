@@ -73,6 +73,13 @@ class Rados:
             self.objecter.shutdown()
         self.monc.shutdown()
 
+    def mgr_command(self, cmd: dict | str,
+                    timeout: float | None = None):
+        """Command served by the active mgr (reference
+        ``rados_mgr_command`` — the `ceph orch`/`ceph tell mgr`
+        transport)."""
+        return self.monc.mgr_command(cmd, timeout=timeout)
+
     # -- pool ops (mon plane) ---------------------------------------------
     def create_pool(self, name: str, *, pg_num: int = 8,
                     pool_type: str = "replicated", size: int = 3,

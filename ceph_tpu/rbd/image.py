@@ -195,12 +195,15 @@ class RBD:
             ioctx.remove(_journal_oid(name))
         except ObjectNotFound:
             pass
-        # and the object maps: head + exactly the header's snap ids
-        # (never a prefix scan — "rbd_object_map.foo.123" is image
-        # foo.123's HEAD map, not one of foo's snap maps)
-        for om in [_objmap_oid(name)] + [
-                _objmap_oid(name, s["id"])
-                for s in img._hdr.get("snaps", {}).values()]:
+        # and the object maps: head + every possible snap id (snap
+        # ids are 1..snap_seq; enumerating exactly also collects the
+        # orphan a crash-interrupted create_snap may have left, and —
+        # unlike a prefix scan — can never touch a sibling image's
+        # maps: "rbd_object_map.foo.123" is image foo.123's HEAD map)
+        maps = [_objmap_oid(name)] + [
+            _objmap_oid(name, sid)
+            for sid in range(1, img._hdr.get("snap_seq", 0) + 2)]
+        for om in maps:
             try:
                 ioctx.remove(om)
             except ObjectNotFound:

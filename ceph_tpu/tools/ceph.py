@@ -59,6 +59,22 @@ def _dispatch(args, rest) -> int:
         raise SystemExit("ceph: -m HOST:PORT required")
     mc = MonClient(_monmap_from_addrs(args.mon))
     try:
+        if rest[0] == "orch":
+            # mgr-hosted orchestrator commands (reference `ceph orch`
+            # → mon → active mgr → cephadm); transport: mgr_command
+            cmd = {"prefix": f"orch {rest[1]}"}
+            if rest[1] == "apply":
+                cmd["service_type"] = rest[2]
+                if len(rest) > 3:
+                    cmd["count"] = int(rest[3])
+            elif rest[1] == "rm":
+                cmd["service_type"] = rest[2]
+            rc, outs, outb = mc.mgr_command(cmd)
+            if outb is not None:
+                print(json.dumps(outb, indent=2, default=str))
+            if outs:
+                print(outs, file=sys.stderr)
+            return 0 if rc == 0 else 1
         cmd: dict = {}
         if rest[0] == "osd" and rest[1:2] == ["pool"] and \
                 rest[2:3] == ["create"]:

@@ -116,8 +116,13 @@ class MiniCluster:
     # -- mgr ---------------------------------------------------------------
     def start_mgr(self, name: str, **kw):
         from .mgr.daemon import MgrDaemon
+        from .mgr.orchestrator import MiniClusterBackend
         kw.setdefault("auth", self.auth)
-        mgr = MgrDaemon(name, self.monmap, **kw).start()
+        mgr = MgrDaemon(name, self.monmap, **kw)
+        # the orchestrator module's deployment backend: this cluster
+        # (the cephadm-deployer analog — `ceph orch apply` lands here)
+        mgr.orch_backend = MiniClusterBackend(self)
+        mgr.start()
         self.mgrs[name] = mgr
         return mgr
 
@@ -188,6 +193,9 @@ class MiniCluster:
                 pass
         for mgr in list(self.mgrs.values()):
             try:
+                backend = getattr(mgr, "orch_backend", None)
+                if backend is not None:
+                    backend.shutdown()
                 mgr.shutdown()
             except Exception:
                 pass
