@@ -43,13 +43,15 @@ def _split(path: str) -> list[str]:
 
 
 class _Fd:
-    def __init__(self, path, parent_ino, name, rec, mode):
+    def __init__(self, path, parent_ino, name, rec, mode,
+                 snap: str | None = None):
         self.path = path
         self.parent_ino = parent_ino
         self.name = name
         self.rec = dict(rec)
         self.mode = mode
         self.dirty = False
+        self.snap = snap        # pool-snap name when opened via .snap
 
 
 class CephFS(Dispatcher):
@@ -318,6 +320,14 @@ class CephFS(Dispatcher):
         parts = _split(path)
         if not parts:
             raise CephFSError(-17, "/ exists")
+        sp = self._snap_split(parts)
+        if sp is not None:
+            base, snap, rest = sp
+            if snap is not None and not rest:
+                # `mkdir dir/.snap/name` IS snapshot creation
+                self.mksnap("/".join(base), snap)
+                return
+            raise CephFSError(-30, "snapshots are read-only")
         dino = self._resolve_dir(parts)
         rec = self._request("mkdir", {"dir": dino, "name": parts[-1]})
         self._dcache[(dino, parts[-1])] = (rec, time.monotonic())
@@ -333,6 +343,21 @@ class CephFS(Dispatcher):
                     raise
 
     def readdir(self, path: str) -> list[tuple[str, dict]]:
+        sp = self._snap_split(_split(path))
+        if sp is not None:
+            base, snap, rest = sp
+            if snap is None:
+                # listing the .snap pseudo-dir: the snapshots
+                return [(s["name"], {"ino": 0, "type": "dir",
+                                     "size": 0,
+                                     "mtime": s.get("created", 0)})
+                        for s in self.lssnap("/".join(base))]
+            info, rec = self._snap_resolve(base, snap, rest)
+            if rec["type"] != "dir":
+                raise CephFSError(-20, f"{path!r} is not a directory")
+            out = self._request("snap_readdir", {
+                "snapid": info["snapid"], "dir": rec["ino"]})
+            return [(name, r) for name, r in out]
         _, _, rec = self._resolve(path)
         if rec["type"] != "dir":
             raise CephFSError(-20, f"{path!r} is not a directory")
@@ -342,7 +367,68 @@ class CephFS(Dispatcher):
     def listdir(self, path: str) -> list[str]:
         return [name for name, _ in self.readdir(path)]
 
+    # -- snapshots (.snap; reference kernel-client .snap dirs) -------------
+    def _dir_ino(self, parts: list[str]) -> int:
+        """Resolve a full path to a DIRECTORY ino."""
+        if not parts:
+            return ROOT_INO
+        dino = self._resolve_dir(parts)
+        rec = self._lookup(dino, parts[-1])
+        if rec["type"] != "dir":
+            raise CephFSError(-20, "not a directory")
+        return rec["ino"]
+
+    def _snap_split(self, parts: list[str]):
+        """Path containing ``.snap`` → (base_parts, snapname|None,
+        rest_parts); None when the path has no .snap component."""
+        if ".snap" not in parts:
+            return None
+        i = parts.index(".snap")
+        snap = parts[i + 1] if len(parts) > i + 1 else None
+        return parts[:i], snap, parts[i + 2:]
+
+    def _snap_resolve(self, base: list[str], snap: str,
+                      rest: list[str]):
+        """→ (info, rec) for a path inside a snapshot: walk `rest`
+        through the frozen manifests starting at the snapped dir."""
+        dino = self._dir_ino(base)
+        info = self._request("snapinfo", {"dir": dino, "snap": snap})
+        rec = {"ino": dino, "type": "dir", "size": 0, "mtime": 0}
+        cur = dino
+        for j, name in enumerate(rest):
+            rec = self._request("snap_lookup", {
+                "snapid": info["snapid"], "dir": cur, "name": name})
+            if rec["type"] == "dir":
+                cur = rec["ino"]
+            elif j != len(rest) - 1:
+                raise CephFSError(-20, f"{name!r} is not a directory")
+        return info, rec
+
+    def mksnap(self, path: str, name: str) -> dict:
+        """Snapshot the directory at `path` (``mkdir dir/.snap/name``
+        equivalent)."""
+        return self._request("mksnap", {
+            "dir": self._dir_ino(_split(path)), "name": name})
+
+    def rmsnap(self, path: str, name: str):
+        self._request("rmsnap", {
+            "dir": self._dir_ino(_split(path)), "name": name})
+
+    def lssnap(self, path: str) -> list[dict]:
+        return self._request("lssnap", {
+            "dir": self._dir_ino(_split(path))})
+
     def stat(self, path: str) -> dict:
+        parts = _split(path)
+        sp = self._snap_split(parts)
+        if sp is not None:
+            base, snap, rest = sp
+            if snap is None:
+                self._dir_ino(base)      # ENOENT on a phantom base
+                return {"ino": 0, "type": "dir", "size": 0,
+                        "mtime": 0}      # the .snap pseudo-dir
+            _info, rec = self._snap_resolve(base, snap, rest)
+            return rec
         _, _, rec = self._resolve(path)
         for fd in self._fds.values():
             if fd.rec["ino"] == rec["ino"] and fd.dirty:
@@ -350,11 +436,21 @@ class CephFS(Dispatcher):
         return rec
 
     def unlink(self, path: str):
+        if ".snap" in _split(path):
+            raise CephFSError(-30, "snapshots are read-only")
         dino, name, _rec = self._resolve(path)
         self._request("unlink", {"dir": dino, "name": name})
         self._dcache.pop((dino, name), None)
 
     def rmdir(self, path: str):
+        sp = self._snap_split(_split(path))
+        if sp is not None:
+            base, snap, rest = sp
+            if snap is not None and not rest:
+                # `rmdir dir/.snap/name` IS snapshot removal
+                self.rmsnap("/".join(base), snap)
+                return
+            raise CephFSError(-30, "snapshots are read-only")
         dino, name, _rec = self._resolve(path)
         self._request("rmdir", {"dir": dino, "name": name})
         self._dcache.pop((dino, name), None)
@@ -423,6 +519,8 @@ class CephFS(Dispatcher):
         self._dcache.pop((ddino, dparts[-1]), None)
 
     def rename(self, src: str, dst: str):
+        if ".snap" in _split(src) or ".snap" in _split(dst):
+            raise CephFSError(-30, "snapshots are read-only")
         sparts, dparts = _split(src), _split(dst)
         if not sparts or not dparts:
             raise CephFSError(-22, "cannot rename /")
@@ -450,6 +548,21 @@ class CephFS(Dispatcher):
         parts = _split(path)
         if not parts:
             raise CephFSError(-21, "/ is a directory")
+        sp = self._snap_split(parts)
+        if sp is not None:
+            if flags != "r":
+                raise CephFSError(-30, "snapshots are read-only")
+            base, snap, rest = sp
+            if snap is None or not rest:
+                raise CephFSError(-21, f"{path!r} is a directory")
+            info, rec = self._snap_resolve(base, snap, rest)
+            if rec["type"] != "file":
+                raise CephFSError(-21, f"{path!r} is a directory")
+            fd = self._next_fd
+            self._next_fd += 1
+            self._fds[fd] = _Fd(path, 0, rest[-1], rec, "r",
+                                snap=info["pool_snap"])
+            return fd
         dino = self._resolve_dir(parts)
         name = parts[-1]
         if flags != "x":
@@ -518,10 +631,17 @@ class CephFS(Dispatcher):
         layout = self._layout_of(f.rec)
         out = bytearray(size)
         for ext in file_to_extents(layout, offset, size):
+            oid = data_oid(f.rec["ino"], ext.object_no)
             try:
-                chunk = self.data.read(
-                    data_oid(f.rec["ino"], ext.object_no),
-                    length=ext.length, off=ext.offset)
+                if f.snap is not None:
+                    # snapshot read: the OSD serves the pool-snap
+                    # clone (COW — reference SnapContext reads)
+                    chunk = self.data.snap_read(
+                        oid, f.snap, length=ext.length,
+                        off=ext.offset)
+                else:
+                    chunk = self.data.read(
+                        oid, length=ext.length, off=ext.offset)
             except ObjectNotFound:
                 chunk = b""                  # hole
             lo = ext.logical_offset - offset

@@ -73,6 +73,17 @@ def data_oid(ino: int, objno: int) -> str:
     return f"{ino:x}.{objno:08x}"
 
 
+SNAPS_OID = "mds_snaps"     # registry omap: "<dino-hex>\0<name>" → json
+
+
+def snap_manifest_oid(snapid: int, ino: int) -> str:
+    """Frozen dentry table of directory `ino` as of snapshot
+    `snapid` (reference: snapshotted metadata lives in the dirfrag
+    objects keyed by snapid; a separate manifest object is the
+    eager-copy analog)."""
+    return f"snapmeta.{snapid}.{ino:x}"
+
+
 def _now() -> float:
     return time.time()
 
@@ -729,6 +740,10 @@ class MDSDaemon(Dispatcher):
             rc, outs, result = handler(args, msg.client, msg.tid)
         except ObjectNotFound:
             return -2, "metadata object vanished", None
+        except Exception as e:      # noqa: BLE001 — a RADOS error
+            # (pool churn, mon timeout) must become a reply, not a
+            # swallowed dispatcher exception the client times out on
+            return -5, f"mds op {msg.op!r} failed: {e!r}", None
         return rc, outs, result
 
     # -- read ops ----------------------------------------------------------
@@ -892,6 +907,146 @@ class MDSDaemon(Dispatcher):
                "mtime": _now()}
         return self._mutate(extra + [["set", dino, name, rec]],
                             client, tid, rec)
+
+    # -- snapshots (.snap; reference SnapServer + snaprealms) --------------
+    # The data plane rides pool snapshots (OSD-side COW clones, exactly
+    # the reference's SnapContext machinery); the metadata plane is an
+    # eager copy of the subtree's RESOLVED dentry tables into manifest
+    # objects (the reference COWs dirfrags lazily per snapid — same
+    # observable behavior, simpler recovery story).
+    def _data_pool_ioctx(self):
+        from ..osdc.librados import IoCtx
+        pid = self.data.pool_id
+        pname = self.data.objecter.osdmap.pools[pid].name
+        return IoCtx(self.rados, pid, pname)
+
+    def _snap_registry(self) -> dict[str, dict]:
+        try:
+            rows = self.meta.omap_get(SNAPS_OID)
+        except ObjectNotFound:
+            return {}
+        return {k: json.loads(bytes(v)) for k, v in rows.items()}
+
+    def _op_mksnap(self, args, client, tid):
+        from ..osdc.librados import Error as RadosError
+        dino, name = args["dir"], args["name"]
+        if dino == ROOT_INO and \
+                getattr(self, "_last_max_mds", 1) > 1:
+            # "/" spans subtree ranks; freezing it would need a
+            # cross-rank journal flush (reference: snap realms span
+            # ranks via the SnapServer's global table) — refuse
+            # loudly rather than snapshot other ranks' unflushed state
+            return (-22, "snapshot of / needs max_mds=1 "
+                         "(subtrees span ranks)", None)
+        key = f"{dino:x}\x00{name}"
+        if key in self._snap_registry():
+            return -17, f"snapshot {name!r} exists", None
+        # journaled-but-unflushed metadata must reach the backing
+        # store first: the manifest copy below reads the dirfrags
+        # (this op was routed to the subtree's OWNER rank, so our
+        # journal is the only one covering it)
+        self._flush(trim=True)
+        psnap = f"cfs-{dino:x}-{name}"
+        ioctx = self._data_pool_ioctx()
+        try:
+            ioctx.create_snap(psnap)
+        except RadosError:
+            # a crash between pool-snap creation and the registry
+            # write left this pool snap behind: adopt it instead of
+            # poisoning the name forever
+            pass
+        snapid = ioctx.snap_lookup(psnap)
+        stack = [dino]
+        while stack:
+            d = stack.pop()
+            rows = {}
+            for n, rec in self._dir(d).items():
+                rec = self._resolve_rec(rec)
+                rows[n] = json.dumps(rec).encode()
+                if rec["type"] == "dir":
+                    stack.append(rec["ino"])
+            if rows:
+                self.meta.omap_set(snap_manifest_oid(snapid, d), rows)
+        info = {"snapid": snapid, "pool_snap": psnap,
+                "created": _now()}
+        self.meta.omap_set(SNAPS_OID, {
+            key: json.dumps(info).encode()})
+        result = dict(info, name=name)
+        # journal the completion so a client RESEND (lost reply,
+        # failover) replays the original answer instead of -17
+        self._journal([], client=client, tid=tid,
+                      reply={"rc": 0, "result": result})
+        self._completed[(client, tid)] = {"rc": 0, "result": result}
+        return 0, "", result
+
+    def _op_rmsnap(self, args, client, tid):
+        dino, name = args["dir"], args["name"]
+        key = f"{dino:x}\x00{name}"
+        info = self._snap_registry().get(key)
+        if info is None:
+            return -2, f"no snapshot {name!r}", None
+        snapid = info["snapid"]
+        # drop the manifests by walking the frozen tree itself
+        stack = [dino]
+        while stack:
+            d = stack.pop()
+            try:
+                rows = self.meta.omap_get(snap_manifest_oid(snapid, d))
+            except ObjectNotFound:
+                continue
+            for v in rows.values():
+                rec = json.loads(bytes(v))
+                if rec.get("type") == "dir":
+                    stack.append(rec["ino"])
+            try:
+                self.meta.remove(snap_manifest_oid(snapid, d))
+            except ObjectNotFound:
+                pass
+        try:
+            self._data_pool_ioctx().remove_snap(info["pool_snap"])
+        except Exception:   # noqa: BLE001 — pool snap may be gone
+            pass
+        self.meta.omap_rm_keys(SNAPS_OID, [key])
+        self._journal([], client=client, tid=tid,
+                      reply={"rc": 0, "result": None})
+        self._completed[(client, tid)] = {"rc": 0, "result": None}
+        return 0, "", None
+
+    def _op_lssnap(self, args, client, tid):
+        dino = args["dir"]
+        pre = f"{dino:x}\x00"
+        out = [dict(info, name=k[len(pre):])
+               for k, info in self._snap_registry().items()
+               if k.startswith(pre)]
+        return 0, "", sorted(out, key=lambda s: s["snapid"])
+
+    def _op_snapinfo(self, args, client, tid):
+        key = f"{args['dir']:x}\x00{args['snap']}"
+        info = self._snap_registry().get(key)
+        if info is None:
+            return -2, f"no snapshot {args['snap']!r}", None
+        return 0, "", dict(info, name=args["snap"])
+
+    def _op_snap_readdir(self, args, client, tid):
+        try:
+            rows = self.meta.omap_get(
+                snap_manifest_oid(args["snapid"], args["dir"]))
+        except ObjectNotFound:
+            rows = {}
+        return 0, "", sorted(
+            [n, json.loads(bytes(v))] for n, v in rows.items())
+
+    def _op_snap_lookup(self, args, client, tid):
+        try:
+            rows = self.meta.omap_get(
+                snap_manifest_oid(args["snapid"], args["dir"]),
+                keys=[args["name"]])
+        except ObjectNotFound:
+            rows = {}
+        row = rows.get(args["name"])
+        if row is None:
+            return -2, f"no snapped dentry {args['name']!r}", None
+        return 0, "", json.loads(bytes(row))
 
     def _subtree_owner(self, top_name: str) -> int:
         """The rank owning a top-level directory's subtree (the

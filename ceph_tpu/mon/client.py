@@ -143,6 +143,17 @@ class MonClient(Dispatcher):
             f"mon command {cmd.get('prefix')!r} failed"
             + (f": {last_outs}" if last_outs else ""))
 
+    def _drop_mgr_con(self):
+        """Abandon the mgr connection properly: mark_down stops the
+        messenger's reconnect loop from retrying a dead mgr's port
+        forever (one immortal loop per failover otherwise)."""
+        con, self._mgr_con = self._mgr_con, None
+        if con is not None:
+            try:
+                con.mark_down()
+            except Exception:   # noqa: BLE001 — already dead
+                pass
+
     def mgr_command(self, cmd: dict | str,
                     timeout: float | None = None):
         """→ (rc, status_str, output) from the ACTIVE mgr's command
@@ -174,15 +185,15 @@ class MonClient(Dispatcher):
                     self._mgr_addr = (host, port)
                 reply = self._send_and_wait(con, cmd, end)
             except (ConnectionError, OSError, AttributeError):
-                self._mgr_con = None
+                self._drop_mgr_con()
                 time.sleep(0.3)
                 continue
             if reply is None:
-                self._mgr_con = None
+                self._drop_mgr_con()
                 continue
             if reply.rc == -11:     # mgr mid-failover: re-resolve
                 last_outs = reply.outs or last_outs
-                self._mgr_con = None
+                self._drop_mgr_con()
                 time.sleep(0.3)
                 continue
             return reply.rc, reply.outs, reply.outb

@@ -62,12 +62,25 @@ def _dispatch(args, rest) -> int:
         if rest[0] == "orch":
             # mgr-hosted orchestrator commands (reference `ceph orch`
             # → mon → active mgr → cephadm); transport: mgr_command
+            usage = ("usage: ceph orch ls|ps | "
+                     "orch apply TYPE [COUNT] | orch rm TYPE")
+            if len(rest) < 2 or rest[1] not in ("ls", "ps", "apply",
+                                                "rm"):
+                print(usage, file=sys.stderr)
+                return 1
             cmd = {"prefix": f"orch {rest[1]}"}
             if rest[1] == "apply":
+                if len(rest) < 3 or (len(rest) > 3
+                                     and not rest[3].isdigit()):
+                    print(usage, file=sys.stderr)
+                    return 1
                 cmd["service_type"] = rest[2]
                 if len(rest) > 3:
                     cmd["count"] = int(rest[3])
             elif rest[1] == "rm":
+                if len(rest) < 3:
+                    print(usage, file=sys.stderr)
+                    return 1
                 cmd["service_type"] = rest[2]
             rc, outs, outb = mc.mgr_command(cmd)
             if outb is not None:

@@ -119,9 +119,13 @@ class MiniCluster:
         from .mgr.orchestrator import MiniClusterBackend
         kw.setdefault("auth", self.auth)
         mgr = MgrDaemon(name, self.monmap, **kw)
-        # the orchestrator module's deployment backend: this cluster
-        # (the cephadm-deployer analog — `ceph orch apply` lands here)
-        mgr.orch_backend = MiniClusterBackend(self)
+        # ONE deployment backend per cluster, shared by every mgr
+        # (the cephadm-deployer analog — `ceph orch apply` lands
+        # here): a per-mgr backend would leak its RGW on failover and
+        # make the promoted standby double-deploy the same spec
+        if getattr(self, "_orch_backend", None) is None:
+            self._orch_backend = MiniClusterBackend(self)
+        mgr.orch_backend = self._orch_backend
         mgr.start()
         self.mgrs[name] = mgr
         return mgr
@@ -191,11 +195,14 @@ class MiniCluster:
                 mds.shutdown()
             except Exception:
                 pass
+        backend = getattr(self, "_orch_backend", None)
+        if backend is not None:
+            try:
+                backend.shutdown()
+            except Exception:
+                pass
         for mgr in list(self.mgrs.values()):
             try:
-                backend = getattr(mgr, "orch_backend", None)
-                if backend is not None:
-                    backend.shutdown()
                 mgr.shutdown()
             except Exception:
                 pass

@@ -1,0 +1,137 @@
+"""CephFS snapshots (.snap; reference SnapServer + snaprealms;
+VERDICT r3 missing #5 second half): metadata freezes into manifests,
+file data rides pool-snapshot COW clones, snapshots are read-only and
+browsable via dir/.snap/<name>/...
+"""
+
+import pytest
+
+from ceph_tpu.cephfs.client import CephFSError
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def fscluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    c.fs_new("cephfs")
+    c.start_mds("a")
+    c.wait_for_active_mds()
+    fs = c.cephfs()
+    yield c, fs
+    c.stop()
+
+
+class TestSnapshots:
+    def test_snapshot_freezes_data_and_metadata(self, fscluster):
+        c, fs = fscluster
+        fs.mkdirs("/proj/sub")
+        fs.write_file("/proj/a.txt", b"version-one")
+        fs.write_file("/proj/sub/b.txt", b"deep-one")
+        fs.mksnap("/proj", "s1")
+        # mutate everything after the snap
+        fs.write_file("/proj/a.txt", b"version-TWO!")
+        fs.write_file("/proj/new.txt", b"post-snap")
+        fs.unlink("/proj/sub/b.txt")
+        # the snapshot still shows the frozen world
+        assert sorted(fs.listdir("/proj/.snap/s1")) == ["a.txt",
+                                                        "sub"]
+        assert fs.read_file("/proj/.snap/s1/a.txt") == b"version-one"
+        assert fs.read_file("/proj/.snap/s1/sub/b.txt") == b"deep-one"
+        # the live tree moved on
+        assert fs.read_file("/proj/a.txt") == b"version-TWO!"
+        assert "new.txt" in fs.listdir("/proj")
+        assert "b.txt" not in fs.listdir("/proj/sub")
+
+    def test_snap_listing_and_mkdir_interface(self, fscluster):
+        c, fs = fscluster
+        fs.mkdirs("/iface")
+        fs.write_file("/iface/f", b"x")
+        # the faithful interface: mkdir dir/.snap/<name>
+        fs.mkdir("/iface/.snap/first")
+        assert [s["name"] for s in fs.lssnap("/iface")] == ["first"]
+        assert fs.listdir("/iface/.snap") == ["first"]
+        # rmdir dir/.snap/<name> removes it
+        fs.rmdir("/iface/.snap/first")
+        assert fs.lssnap("/iface") == []
+
+    def test_snapshots_are_read_only(self, fscluster):
+        c, fs = fscluster
+        fs.mkdirs("/ro")
+        fs.write_file("/ro/f", b"data")
+        fs.mksnap("/ro", "s")
+        with pytest.raises(CephFSError):
+            fs.open("/ro/.snap/s/f", "w")
+        with pytest.raises(CephFSError):
+            fs.unlink("/ro/.snap/s/f")
+        with pytest.raises(CephFSError):
+            fs.mkdir("/ro/.snap/s/newdir")
+        with pytest.raises(CephFSError):
+            fs.rename("/ro/.snap/s/f", "/ro/g")
+        # stat works read-only
+        st = fs.stat("/ro/.snap/s/f")
+        assert st["type"] == "file" and st["size"] == 4
+
+    def test_multiple_snapshots_independent(self, fscluster):
+        c, fs = fscluster
+        fs.mkdirs("/multi")
+        fs.write_file("/multi/f", b"gen1")
+        fs.mksnap("/multi", "t1")
+        fs.write_file("/multi/f", b"gen2")
+        fs.mksnap("/multi", "t2")
+        fs.write_file("/multi/f", b"gen3")
+        assert fs.read_file("/multi/.snap/t1/f") == b"gen1"
+        assert fs.read_file("/multi/.snap/t2/f") == b"gen2"
+        assert fs.read_file("/multi/f") == b"gen3"
+        # duplicate name refused
+        with pytest.raises(CephFSError):
+            fs.mksnap("/multi", "t1")
+        # removal frees the name, other snaps unaffected
+        fs.rmsnap("/multi", "t1")
+        assert fs.read_file("/multi/.snap/t2/f") == b"gen2"
+        with pytest.raises(CephFSError):
+            fs.read_file("/multi/.snap/t1/f")
+
+    def test_snapshot_of_fragmented_dir(self, fscluster):
+        """Snapshot manifests capture a fragmented directory whole."""
+        c, fs = fscluster
+        mds = next(m for m in c.mdss.values() if m.state == "active")
+        mds.dirfrag_split_size = 8
+        fs.mkdirs("/frag")
+        names = [f"e{i:03d}" for i in range(40)]
+        for n in names:
+            fs.write_file(f"/frag/{n}", f"v-{n}".encode())
+        with mds.lock:
+            mds._flush(trim=True)
+        ino = mds._dir(1)["frag"]["ino"]
+        assert mds._nfrags(ino) >= 2
+        fs.mksnap("/frag", "fsnap")
+        for n in names[:5]:
+            fs.unlink(f"/frag/{n}")
+        assert sorted(fs.listdir("/frag/.snap/fsnap")) == names
+        assert fs.read_file("/frag/.snap/fsnap/e002") == b"v-e002"
+
+    def test_snapshot_survives_mds_failover(self, fscluster):
+        """Snapshot state (registry + manifests + pool snap) lives in
+        RADOS: a promoted standby serves it."""
+        c, fs = fscluster
+        c.start_mds("b")
+        fs.mkdirs("/ha")
+        fs.write_file("/ha/f", b"pre-crash")
+        fs.mksnap("/ha", "keep")
+        fs.write_file("/ha/f", b"post-snap")
+        victim = next(n for n, m in c.mdss.items()
+                      if m.state == "active")
+        c.kill_mds(victim)
+        c.wait_for_active_mds(timeout=30)
+        import time
+        deadline = time.monotonic() + 20
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                got = fs.read_file("/ha/.snap/keep/f")
+                break
+            except Exception:
+                time.sleep(0.3)
+        assert got == b"pre-crash"
+        assert fs.read_file("/ha/f") == b"post-snap"
