@@ -45,6 +45,35 @@ def _journal_oid(name: str) -> str:
     return f"rbd_journal.{name}"
 
 
+# -- encryption (reference src/librbd/crypto/: LUKS-style envelope) ----
+# A random data-encryption key (DEK) is wrapped by a key-encryption
+# key derived from the passphrase (PBKDF2-SHA256); the wrapped DEK
+# lives in the header, so the passphrase can be verified (and in
+# principle rotated) without re-encrypting data.  Data objects hold
+# AES-256-GCM envelopes of the object's logical plaintext — partial
+# writes read-modify-write the object (the reference's LUKS layer uses
+# XTS sectors for in-place writes; whole-object GCM trades that for
+# authenticated reads at slice scale).
+
+def _derive_kek(passphrase: str, salt: bytes) -> bytes:
+    import hashlib
+    return hashlib.pbkdf2_hmac("sha256", passphrase.encode(), salt,
+                               100_000, dklen=32)
+
+
+def _seal(key: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
+    import os as _os
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    nonce = _os.urandom(12)
+    return nonce + AESGCM(key).encrypt(nonce, plaintext, aad)
+
+
+def _unseal(key: bytes, blob: bytes, aad: bytes = b"") -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    return AESGCM(key).decrypt(bytes(blob[:12]), bytes(blob[12:]),
+                               aad)
+
+
 def _is_data_suffix(rest: str) -> bool:
     """True iff `rest` is '<16-hex-objno>' or '<16-hex-objno>@<int>'
     (a snapshot clone) — the only shapes this image's data objects
@@ -102,9 +131,10 @@ class RBD:
         ioctx.omap_set(_header_oid(name), {
             "header": json.dumps(hdr).encode()})
 
-    def open(self, ioctx, name: str, snapshot: str | None = None
-             ) -> "Image":
-        return Image(ioctx, name, snapshot=snapshot)
+    def open(self, ioctx, name: str, snapshot: str | None = None,
+             passphrase: str | None = None) -> "Image":
+        return Image(ioctx, name, snapshot=snapshot,
+                     passphrase=passphrase)
 
     def clone(self, ioctx, parent: str, snap_name: str, child: str):
         """COW child image from a protected parent snapshot
@@ -146,6 +176,120 @@ class RBD:
         pre = "rbd_header."
         return sorted(o[len(pre):] for o in ioctx.list_objects()
                       if o.startswith(pre))
+
+    # -- live migration (reference rbd migration prepare/execute/
+    # commit/abort, src/librbd/migration/) --------------------------------
+    def migration_prepare(self, src_ioctx, src: str, dst_ioctx,
+                          dst: str):
+        """Link a new target image to the source: clients switch to
+        the target immediately (reads of uncopied objects fall
+        through to the source; writes copy-up first), while the
+        source refuses writes for the duration."""
+        with Image(src_ioctx, src, read_only=True) as s:
+            if s._hdr.get("encryption") is not None:
+                raise ValueError(
+                    "migrate after decrypting (encrypted migration "
+                    "is unsupported)")
+            if s._hdr.get("snaps"):
+                raise ValueError(
+                    "remove/flatten snapshots before migrating")
+            if s._hdr.get("migrating"):
+                raise ValueError(f"{src!r} is already migrating")
+            if s._hdr.get("parent") is not None:
+                # migration reads only the source's LOCAL objects;
+                # parent-backed bytes would silently vanish
+                raise ValueError("flatten the clone before migrating")
+            self.create(dst_ioctx, dst, s._hdr["size"],
+                        order=s._hdr["order"],
+                        stripe_unit=s._hdr["stripe_unit"],
+                        stripe_count=s._hdr["stripe_count"],
+                        journaling=bool(s._hdr.get("journaling")),
+                        primary=bool(s._hdr.get("primary", True)))
+            src_size = s._hdr["size"]
+            s._hdr["migrating"] = True
+            s._save_header()
+        with Image(dst_ioctx, dst) as d:
+            d._hdr["migration_source"] = {
+                "pool": src_ioctx.pool_name, "image": src,
+                # like a clone's parent overlap: a shrink clamps it so
+                # regrown space reads zeros, never stale source bytes
+                "overlap": src_size}
+            d._save_header()
+
+    def _migration_pair(self, dst_ioctx, dst):
+        d = Image(dst_ioctx, dst)
+        mig = d._hdr.get("migration_source")
+        if mig is None:
+            d.close()
+            raise ValueError(f"{dst!r} is not a migration target")
+        src_io = dst_ioctx.rados.open_ioctx(mig["pool"])
+        return d, src_io, mig["image"]
+
+    def migration_execute(self, dst_ioctx, dst: str) -> int:
+        """Background copy of every not-yet-copied object; → number
+        copied this pass."""
+        from ..osdc.librados import ObjectNotFound
+        d, src_io, src = self._migration_pair(dst_ioctx, dst)
+        copied: set[int] = set()
+        try:
+            limit = min(
+                d._hdr["size"],
+                d._hdr["migration_source"].get("overlap",
+                                               d._hdr["size"]))
+            nobj = -(-limit // d.layout.object_size)
+            for objno in range(nobj):
+                if d._object_exists(objno):
+                    continue
+                raw = d._migration_bytes(objno)
+                if not raw:
+                    continue            # sparse in the source too
+                dst_ioctx.write_full(_data_oid(dst, objno), raw)
+                copied.add(objno)
+            d._objmap_mark(copied)      # ONE map rewrite per pass
+        finally:
+            d.close()
+        return len(copied)
+
+    def migration_commit(self, dst_ioctx, dst: str):
+        """Finish: every object must be local; the source image is
+        removed and the target stands alone."""
+        from ..osdc.librados import ObjectNotFound
+        d, src_io, src = self._migration_pair(dst_ioctx, dst)
+        try:
+            limit = min(
+                d._hdr["size"],
+                d._hdr["migration_source"].get("overlap",
+                                               d._hdr["size"]))
+            nobj = -(-limit // d.layout.object_size)
+            for objno in range(nobj):
+                if d._object_exists(objno):
+                    continue
+                try:
+                    src_io.stat(_data_oid(src, objno))
+                except ObjectNotFound:
+                    continue            # sparse: nothing to copy
+                raise ValueError(
+                    f"object {objno} not copied yet — run "
+                    "migration_execute to completion first")
+            d._hdr.pop("migration_source", None)
+            d._save_header()
+        finally:
+            d.close()
+        with Image(src_io, src) as s:
+            s._hdr.pop("migrating", None)
+            s._save_header()
+        self.remove(src_io, src)
+
+    def migration_abort(self, dst_ioctx, dst: str):
+        """Back out: the target disappears, the source resumes."""
+        d, src_io, src = self._migration_pair(dst_ioctx, dst)
+        d._hdr.pop("migration_source", None)
+        d._save_header()
+        d.close()
+        self.remove(dst_ioctx, dst)
+        with Image(src_io, src) as s:
+            s._hdr.pop("migrating", None)
+            s._save_header()
 
     def remove(self, ioctx, name: str):
         from ..osdc.librados import ObjectNotFound
@@ -218,13 +362,34 @@ class Image:
     clone chain."""
 
     def __init__(self, ioctx, name: str, snapshot: str | None = None,
-                 read_only: bool = False):
+                 read_only: bool = False,
+                 passphrase: str | None = None):
         self.ioctx = ioctx
         self.name = name
         self._load_header()
         self.snap_id = None
         self._lock_cookie = None
         self._read_only = read_only
+        self._passphrase = passphrase
+        self._dek: bytes | None = None
+        self._locked = False
+        enc = self._hdr.get("encryption")
+        if enc is not None:
+            if passphrase is None:
+                # header-only use (remove, migration bookkeeping,
+                # list_snaps) needs no DEK: lock the DATA path instead
+                # of refusing the open — an image whose passphrase is
+                # lost must still be removable
+                self._locked = True
+            else:
+                kek = _derive_kek(passphrase,
+                                  bytes.fromhex(enc["salt"]))
+                try:
+                    self._dek = _unseal(
+                        kek, bytes.fromhex(enc["wrapped_dek"]),
+                        aad=b"rbd-dek")
+                except Exception:
+                    raise ValueError("wrong passphrase") from None
         if snapshot is not None:
             snap = self._hdr["snaps"].get(snapshot)
             if snap is None:
@@ -277,6 +442,84 @@ class Image:
                               self.layout.object_size),
                 "snaps": sorted(self._hdr["snaps"])}
 
+    # -- encryption --------------------------------------------------------
+    def encryption_format(self, passphrase: str):
+        """Turn encryption on (reference ``rbd encryption format``,
+        LUKS-style).  Only an image with no data yet may be formatted
+        — formatting does not re-encrypt existing bytes."""
+        self._require_writable()
+        if self._hdr.get("encryption") is not None:
+            raise ValueError("image is already encrypted")
+        if self._hdr.get("parent") is not None:
+            raise ValueError("cannot format a clone")
+        if self._hdr.get("journaling"):
+            # the journal carries write payloads; pairing it with
+            # at-rest encryption would leak every plaintext write
+            raise ValueError(
+                "encryption and journaling are mutually exclusive")
+        if self._hdr.get("migration_source") is not None:
+            # copy-up pulls PLAINTEXT source bytes into local
+            # objects; mixing them with encrypted envelopes wedges
+            # every later read
+            raise ValueError(
+                "finish the migration before formatting encryption")
+        pre = f"rbd_data.{self.name}."
+        if any(o.startswith(pre) and _is_data_suffix(o[len(pre):])
+               for o in self.ioctx.list_objects()):
+            raise ValueError(
+                "image already has data; format before first write")
+        import os as _os
+        salt = _os.urandom(16)
+        dek = _os.urandom(32)
+        kek = _derive_kek(passphrase, salt)
+        self._hdr["encryption"] = {
+            "cipher": "aes-256-gcm",
+            "salt": salt.hex(),
+            "wrapped_dek": _seal(kek, dek, aad=b"rbd-dek").hex(),
+        }
+        self._save_header()
+        self._dek = dek
+        self._passphrase = passphrase
+
+    def _require_unlocked(self):
+        if self._locked:
+            raise ValueError(
+                f"image {self.name!r} is encrypted: passphrase "
+                "required for data access")
+
+    def _decrypt_obj(self, oid: str, raw: bytes) -> bytes:
+        if self._dek is None or not raw:
+            return raw
+        try:
+            return _unseal(self._dek, raw, aad=oid.encode())
+        except Exception as e:
+            raise ValueError(
+                f"corrupt or tampered encrypted object {oid}: {e}"
+            ) from None
+
+    def _encrypt_obj(self, oid: str, plain: bytes) -> bytes:
+        return _seal(self._dek, plain, aad=oid.encode())
+
+    def _obj_patch(self, objno: int, payload: bytes, off: int):
+        """Object-level write primitive: plain images write at the
+        offset; encrypted images read-modify-write the whole envelope
+        (GCM cannot be patched in place)."""
+        oid = _data_oid(self.name, objno)
+        if self._dek is None:
+            self.ioctx.write(oid, payload, off)
+            return
+        from ..osdc.librados import ObjectNotFound
+        try:
+            raw = bytes(self.ioctx.read(oid))
+        except ObjectNotFound:
+            raw = b""
+        cur = bytearray(self._decrypt_obj(oid, raw))
+        end = off + len(payload)
+        if len(cur) < end:
+            cur.extend(b"\x00" * (end - len(cur)))
+        cur[off:end] = payload
+        self.ioctx.write_full(oid, self._encrypt_obj(oid, bytes(cur)))
+
     def resize(self, new_size: int):
         self._require_writable()
         self._journal_append({"op": "resize", "size": new_size})
@@ -286,6 +529,10 @@ class Image:
             # grow must read zeros, never resurrect parent bytes
             # (reference librbd shrinks the parent overlap the same way)
             parent["overlap"] = new_size
+        mig = self._hdr.get("migration_source")
+        if mig is not None and new_size < mig.get("overlap",
+                                                  new_size):
+            mig["overlap"] = new_size
         old = self._hdr["size"]
         self._hdr["size"] = new_size
         self._save_header()
@@ -324,6 +571,10 @@ class Image:
     def _require_writable(self):
         if self.snap_id is not None:
             raise ValueError("image opened at a snapshot is read-only")
+        if self._hdr.get("migrating"):
+            raise ValueError(
+                "image is mid-migration: writes go to the target")
+        self._require_unlocked()
         if self._read_only and not getattr(self, "_replaying", False):
             raise ValueError("image opened read-only")
         if self._hdr.get("journaling") and \
@@ -465,6 +716,9 @@ class Image:
         (feature off, or a full export of a clone whose unwritten
         objects are parent-backed and absent from the map)."""
         if not self._objmap_enabled():
+            return None
+        if self._hdr.get("migration_source") is not None:
+            # uncopied objects are readable but absent from the map
             return None
         if from_snap is None:
             # a full export of parent-backed data can't come from the
@@ -732,6 +986,40 @@ class Image:
                     break
         return bytes(out) if out else None
 
+    # -- migration fall-through -------------------------------------------
+    def _migration_bytes(self, objno: int) -> bytes | None:
+        """Plaintext bytes of a not-yet-copied object from the
+        migration source (reads fall through like a clone's parent)."""
+        mig = self._hdr.get("migration_source")
+        if mig is None:
+            return None
+        base = objno * self.layout.object_size
+        ov = mig.get("overlap")
+        if ov is not None and base >= ov:
+            return None         # beyond the clamped overlap: zeros
+        src_io = getattr(self, "_mig_io", None)
+        if src_io is None:
+            src_io = self._mig_io = self.ioctx.rados.open_ioctx(
+                mig["pool"])
+        try:
+            raw = bytes(src_io.read(_data_oid(mig["image"], objno)))
+        except Exception:       # noqa: BLE001 — absent or transient
+            return None
+        if ov is not None and base + len(raw) > ov:
+            raw = raw[:ov - base]
+        return raw
+
+    def _migration_copy_up(self, objno: int):
+        """First write to an uncopied object pulls the source bytes
+        in first (the copyup discipline, reference deep-copyup)."""
+        if self._hdr.get("migration_source") is None:
+            return
+        if self._object_exists(objno):
+            return
+        base = self._migration_bytes(objno)
+        if base:
+            self.ioctx.write_full(_data_oid(self.name, objno), base)
+
     def _object_exists(self, objno: int) -> bool:
         from ..osdc.librados import ObjectNotFound
         try:
@@ -809,7 +1097,8 @@ class Image:
         if from_snap is not None:
             if from_snap not in self._hdr["snaps"]:
                 raise ImageNotFound(f"no snapshot {from_snap!r}")
-            base = Image(self.ioctx, self.name, snapshot=from_snap)
+            base = Image(self.ioctx, self.name, snapshot=from_snap,
+                         passphrase=self._passphrase)
         candidates = self._fast_diff_objects(from_snap)
         try:
             extents = []
@@ -899,29 +1188,39 @@ class Image:
         self._objmap_mark({e.object_no for e in exts})
         for ext in exts:
             self._copy_up(ext.object_no)
+            self._migration_copy_up(ext.object_no)
             self._cow_preserve(ext.object_no)
             lo = ext.logical_offset - offset
-            self.ioctx.write(_data_oid(self.name, ext.object_no),
-                             data[lo:lo + ext.length], ext.offset)
+            self._obj_patch(ext.object_no,
+                            data[lo:lo + ext.length], ext.offset)
         return len(data)
 
     def read(self, offset: int, length: int) -> bytes:
+        self._require_unlocked()
         end = min(offset + length, self.size())
         if end <= offset:
             return b""
         length = end - offset
         out = bytearray(length)
         for ext in file_to_extents(self.layout, offset, length):
+            raw = True      # raw object bytes (decrypt if encrypted)
             if self.snap_id is not None:
                 obj = self._read_object_at_snap(ext.object_no)
                 if not obj:
                     obj = self._parent_bytes(ext.object_no) or b""
+                    raw = False     # parent returns plaintext
             else:
                 try:
                     obj = self.ioctx.read(
                         _data_oid(self.name, ext.object_no))
                 except Exception:
-                    obj = self._parent_bytes(ext.object_no) or b""
+                    obj = (self._parent_bytes(ext.object_no)
+                           or self._migration_bytes(ext.object_no)
+                           or b"")
+                    raw = False     # source image returns plaintext
+            if raw and self._dek is not None:
+                obj = self._decrypt_obj(
+                    _data_oid(self.name, ext.object_no), bytes(obj))
             piece = obj[ext.offset:ext.offset + ext.length]
             lo = ext.logical_offset - offset
             out[lo:lo + len(piece)] = piece
@@ -942,7 +1241,9 @@ class Image:
         gone = set()
         for ext in exts:
             oid = _data_oid(self.name, ext.object_no)
-            parent_backed = self._parent_covers(ext.object_no)
+            parent_backed = (
+                self._parent_covers(ext.object_no)
+                or self._hdr.get("migration_source") is not None)
             if ext.offset == 0 and \
                     ext.length == self.layout.object_size and \
                     not parent_backed:
@@ -955,10 +1256,12 @@ class Image:
                 except Exception:       # noqa: BLE001 — stays DIRTY
                     pass
             else:
-                # parent-backed objects must be ZEROED, not removed —
-                # removal would resurrect the parent bytes on read
+                # parent-/source-backed objects must be ZEROED, not
+                # removed — removal would resurrect the backing bytes
                 if parent_backed:
                     self._copy_up(ext.object_no)
+                    self._migration_copy_up(ext.object_no)
                 self._cow_preserve(ext.object_no)
-                self.ioctx.write(oid, b"\x00" * ext.length, ext.offset)
+                self._obj_patch(ext.object_no,
+                                b"\x00" * ext.length, ext.offset)
         self._objmap_mark(gone, OM_NONE)

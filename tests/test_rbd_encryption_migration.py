@@ -1,0 +1,186 @@
+"""RBD at-rest encryption (LUKS-style envelope) + live migration
+(reference src/librbd/crypto/ and src/librbd/migration/; VERDICT r3
+missing #4 remainder).
+"""
+
+import pytest
+
+from ceph_tpu.rbd import Image, RBD
+from ceph_tpu.rbd.image import _data_oid
+from ceph_tpu.vstart import MiniCluster
+
+OBJ = 1 << 16
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    r = c.rados()
+    r.create_pool("rbd", pg_num=8, size=2)
+    r.create_pool("rbd2", pg_num=8, size=2)
+    io = r.open_ioctx("rbd")
+    io2 = r.open_ioctx("rbd2")
+    c.wait_for_clean()
+    yield c, r, io, io2
+    c.stop()
+
+
+class TestEncryption:
+    def test_roundtrip_and_at_rest_ciphertext(self, cluster):
+        _c, _r, io, _ = cluster
+        rbd = RBD()
+        rbd.create(io, "enc", 4 * OBJ, order=16)
+        secret = b"TOP-SECRET-PAYLOAD" * 100
+        with Image(io, "enc") as im:
+            im.encryption_format("hunter2")
+            im.write(1000, secret)
+            assert im.read(1000, len(secret)) == secret
+        # the raw RADOS object never contains the plaintext
+        raw = bytes(io.read(_data_oid("enc", 0)))
+        assert b"TOP-SECRET" not in raw
+        # reopen WITH the passphrase: readable
+        with Image(io, "enc", passphrase="hunter2") as im:
+            assert im.read(1000, len(secret)) == secret
+
+    def test_wrong_or_missing_passphrase(self, cluster):
+        _c, _r, io, _ = cluster
+        # header-only open works (remove must not need the DEK)...
+        with Image(io, "enc", read_only=True) as im:
+            # ...but the data path is locked
+            with pytest.raises(ValueError,
+                               match="passphrase required"):
+                im.read(0, 10)
+        with pytest.raises(ValueError, match="wrong passphrase"):
+            Image(io, "enc", passphrase="letmein")
+
+    def test_encrypted_image_removable_without_passphrase(
+            self, cluster):
+        """A lost passphrase must not make the image undeletable."""
+        _c, _r, io, _ = cluster
+        rbd = RBD()
+        rbd.create(io, "enclost", OBJ, order=16)
+        with Image(io, "enclost") as im:
+            im.encryption_format("forgotten")
+            im.write(0, b"unreachable")
+        rbd.remove(io, "enclost")
+        assert "enclost" not in rbd.list(io)
+
+    def test_partial_writes_and_discard(self, cluster):
+        _c, _r, io, _ = cluster
+        rbd = RBD()
+        rbd.create(io, "encp", 2 * OBJ, order=16)
+        with Image(io, "encp") as im:
+            im.encryption_format("pw")
+            im.write(0, b"A" * 1000)
+            im.write(500, b"B" * 100)         # overlapping RMW
+            assert im.read(0, 1000) == \
+                b"A" * 500 + b"B" * 100 + b"A" * 400
+            im.discard(200, 100)
+            got = im.read(0, 1000)
+            assert got[200:300] == b"\x00" * 100
+            assert got[:200] == b"A" * 200
+
+    def test_snapshots_of_encrypted_image(self, cluster):
+        _c, _r, io, _ = cluster
+        rbd = RBD()
+        rbd.create(io, "encs", 2 * OBJ, order=16)
+        with Image(io, "encs") as im:
+            im.encryption_format("pw2")
+            im.write(0, b"gen-one!")
+            im.create_snap("s1")
+            im.write(0, b"gen-two!")
+        with Image(io, "encs", snapshot="s1",
+                   passphrase="pw2") as sv:
+            assert sv.read(0, 8) == b"gen-one!"
+        with Image(io, "encs", passphrase="pw2") as im:
+            diff = im.export_diff(from_snap="s1")
+            assert diff["extents"]
+
+    def test_format_guards(self, cluster):
+        _c, _r, io, _ = cluster
+        rbd = RBD()
+        rbd.create(io, "encg", OBJ, order=16)
+        with Image(io, "encg") as im:
+            im.write(0, b"data-first")
+        with Image(io, "encg") as im:
+            with pytest.raises(ValueError, match="already has data"):
+                im.encryption_format("pw")
+        rbd.create(io, "encj", OBJ, order=16, journaling=True)
+        with Image(io, "encj") as im:
+            with pytest.raises(ValueError, match="mutually"):
+                im.encryption_format("pw")
+
+
+class TestLiveMigration:
+    def test_prepare_execute_commit(self, cluster):
+        _c, _r, io, io2 = cluster
+        rbd = RBD()
+        rbd.create(io, "vmdisk", 8 * OBJ, order=16)
+        with Image(io, "vmdisk") as s:
+            s.write(0, b"boot-sector" * 100)
+            s.write(5 * OBJ, b"tail-data")
+        rbd.migration_prepare(io, "vmdisk", io2, "vmdisk-new")
+        # source refuses writes mid-migration
+        with Image(io, "vmdisk") as s:
+            with pytest.raises(ValueError, match="mid-migration"):
+                s.write(0, b"x")
+        # target serves reads immediately (fall-through)
+        with Image(io2, "vmdisk-new") as d:
+            assert d.read(0, 11) == b"boot-sector"
+            assert d.read(5 * OBJ, 9) == b"tail-data"
+            # and writes (copy-up first: surrounding bytes survive)
+            d.write(4, b"PATCH")
+            assert d.read(0, 4) == b"boot"
+            assert d.read(4, 5) == b"PATCH"
+            assert d.read(9, 2) == b"or"
+        copied = rbd.migration_execute(io2, "vmdisk-new")
+        assert copied >= 1
+        rbd.migration_commit(io2, "vmdisk-new")
+        # source image is gone; target stands alone
+        assert "vmdisk" not in rbd.list(io)
+        with Image(io2, "vmdisk-new") as d:
+            assert d._hdr.get("migration_source") is None
+            assert d.read(4, 5) == b"PATCH"
+            assert d.read(5 * OBJ, 9) == b"tail-data"
+
+    def test_commit_requires_full_copy(self, cluster):
+        _c, _r, io, io2 = cluster
+        rbd = RBD()
+        rbd.create(io, "mslow", 4 * OBJ, order=16)
+        with Image(io, "mslow") as s:
+            s.write(0, b"one")
+            s.write(2 * OBJ, b"three")
+        rbd.migration_prepare(io, "mslow", io2, "mslow-new")
+        with pytest.raises(ValueError, match="not copied yet"):
+            rbd.migration_commit(io2, "mslow-new")
+        rbd.migration_execute(io2, "mslow-new")
+        rbd.migration_commit(io2, "mslow-new")
+
+    def test_abort_restores_source(self, cluster):
+        _c, _r, io, io2 = cluster
+        rbd = RBD()
+        rbd.create(io, "mab", 2 * OBJ, order=16)
+        with Image(io, "mab") as s:
+            s.write(0, b"keep-me")
+        rbd.migration_prepare(io, "mab", io2, "mab-new")
+        rbd.migration_abort(io2, "mab-new")
+        assert "mab-new" not in rbd.list(io2)
+        with Image(io, "mab") as s:
+            s.write(7, b"!")            # writable again
+            assert s.read(0, 8) == b"keep-me!"
+
+    def test_discard_on_target_does_not_resurrect(self, cluster):
+        _c, _r, io, io2 = cluster
+        rbd = RBD()
+        rbd.create(io, "mz", 2 * OBJ, order=16)
+        with Image(io, "mz") as s:
+            s.write(0, b"Z" * OBJ)
+        rbd.migration_prepare(io, "mz", io2, "mz-new")
+        with Image(io2, "mz-new") as d:
+            d.discard(0, OBJ)
+            assert d.read(0, 100) == b"\x00" * 100
+        rbd.migration_execute(io2, "mz-new")
+        rbd.migration_commit(io2, "mz-new")
+        with Image(io2, "mz-new") as d:
+            assert d.read(0, 100) == b"\x00" * 100
