@@ -110,11 +110,15 @@ class AdminSocket:
         return handler(req)
 
 
-def admin_command(sock_path: str, prefix: str, **kwargs):
-    """Client side: `ceph daemon <sock> <cmd>` (tools use this)."""
+def admin_command(sock_path: str, prefix: str, *,
+                  timeout: float = 10.0, **kwargs):
+    """Client side: `ceph daemon <sock> <cmd>` (tools use this).
+    Bounded: a wedged daemon (accepts, never replies) must not hang
+    the caller — mgr modules scrape on threads that feed beacons."""
     req = dict(kwargs)
     req["prefix"] = prefix
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout)
     try:
         s.connect(sock_path)
         s.sendall(json.dumps(req).encode() + b"\0")

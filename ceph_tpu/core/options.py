@@ -102,6 +102,9 @@ def build_options() -> list[Option]:
                "fsync the WAL on each transaction commit"),
         Option("bluestore_debug_inject_read_err", bool, False,
                "fault injection: EIO on reads", Level.DEV),
+        Option("osd_debug_smart_media_errors", int, 0,
+               "fault injection: synthetic SMART media errors",
+               Level.DEV, min=0),
         # -- client -------------------------------------------------------
         Option("client_mount_timeout", float, 30.0,
                "initial mon hunt timeout (s)"),

@@ -141,11 +141,14 @@ def _default_modules():
     from .dashboard import DashboardModule
     from .modules import (CrashModule, IostatModule, StatusModule,
                           TelemetryModule)
+    from .devicehealth import DeviceHealthModule
     from .orchestrator import OrchestratorModule
+    from .rbd_support import RbdSupportModule
     from .volumes import VolumesModule
     return (BalancerModule, PgAutoscalerModule, PrometheusModule,
             StatusModule, IostatModule, CrashModule, TelemetryModule,
-            DashboardModule, VolumesModule, OrchestratorModule)
+            DashboardModule, VolumesModule, OrchestratorModule,
+            DeviceHealthModule, RbdSupportModule)
 
 
 class _MgrCommandServer(Dispatcher):

@@ -1,0 +1,146 @@
+"""mgr devicehealth — device inventory, SMART-style scraping, life
+expectancy.
+
+Reference behavior re-created (``src/pybind/mgr/devicehealth``;
+SURVEY.md §3.10): every OSD reports the device backing it; the module
+scrapes health metrics on a cadence, stores the time series, computes
+a life-expectancy verdict, and raises a cluster-log warning when a
+device is expected to fail.  Real SMART comes from smartctl on the
+host; here each OSD serves a ``smart`` admin-socket command whose
+counters tests (and fault injection) can steer — the module logic
+(scrape → store → predict → warn) is the same.
+
+Commands (via the mgr command server, i.e. ``ceph device ...``):
+- ``device ls`` — inventory with health verdicts
+- ``device info`` {devid} — stored metric history
+- ``device check-health`` — scrape + evaluate now
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from .daemon import MgrModule
+
+import threading
+
+STORE_PREFIX = "devicehealth/"
+# media-error thresholds for the verdicts (reference uses a life
+# expectancy model over SMART attributes; the shape is what matters)
+WARN_ERRORS = 10
+FAIL_ERRORS = 100
+HISTORY_KEPT = 24
+
+
+class DeviceHealthModule(MgrModule):
+    NAME = "devicehealth"
+    TICK = 5.0
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._last_scrape = 0.0
+        self.scrape_interval = 60.0
+        # single-flight: the tick thread and the command thread must
+        # not interleave the config-key read-modify-write (lost
+        # history entries, duplicated clog warnings)
+        self._scrape_lock = threading.Lock()
+
+    # -- scraping ----------------------------------------------------------
+    def _osd_asoks(self) -> dict[str, str]:
+        return {name: path
+                for name, path in self.ctx._d.asok_paths.items()
+                if name.startswith("osd.")}
+
+    def _scrape_one(self, osd_name: str, asok: str) -> dict | None:
+        from ..core.admin_socket import admin_command
+        try:
+            return admin_command(asok, "smart", timeout=5.0)
+        except Exception:   # noqa: BLE001 — daemon down; next pass
+            return None
+
+    def scrape(self) -> dict[str, dict]:
+        """Scrape every OSD's device → {devid: reading}; store."""
+        readings = {}
+        for osd_name, asok in self._osd_asoks().items():
+            r = self._scrape_one(osd_name, asok)
+            if r is None:
+                continue
+            devid = r.get("devid", f"dev-{osd_name}")
+            r = dict(r, osd=osd_name, stamp=time.time())
+            readings[devid] = r
+            key = f"{STORE_PREFIX}{devid}"
+            rc, _, blob = self.ctx.mon_command(
+                {"prefix": "config-key get", "key": key})
+            hist = json.loads(blob) if rc == 0 and blob else []
+            hist.append(r)
+            self.ctx.mon_command({
+                "prefix": "config-key put", "key": key,
+                "val": json.dumps(hist[-HISTORY_KEPT:])})
+        return readings
+
+    # -- evaluation --------------------------------------------------------
+    @staticmethod
+    def life_expectancy(reading: dict) -> str:
+        errs = int(reading.get("media_errors", 0))
+        if errs >= FAIL_ERRORS:
+            return "failing"
+        if errs >= WARN_ERRORS:
+            return "warning"
+        return "good"
+
+    def check_health(self) -> list[dict]:
+        """Scrape now, evaluate, clog-warn on bad devices; → verdicts."""
+        out = []
+        with self._scrape_lock:
+            readings = self.scrape()
+        for devid, r in sorted(readings.items()):
+            verdict = self.life_expectancy(r)
+            out.append({"devid": devid, "osd": r.get("osd"),
+                        "life_expectancy": verdict,
+                        "media_errors": r.get("media_errors", 0)})
+            if verdict != "good":
+                self.ctx.mon_command({
+                    "prefix": "log",
+                    "logtext": f"DEVICE_HEALTH {devid} "
+                               f"({r.get('osd')}): {verdict} "
+                               f"({r.get('media_errors', 0)} media "
+                               f"errors)"})
+        return out
+
+    # -- commands ----------------------------------------------------------
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix == "device ls":
+            return 0, "", self.check_health()
+        if prefix == "device check-health":
+            bad = [d for d in self.check_health()
+                   if d["life_expectancy"] != "good"]
+            return 0, f"{len(bad)} device(s) unhealthy", bad
+        if prefix == "device info":
+            key = f"{STORE_PREFIX}{cmd.get('devid', '')}"
+            rc, _, blob = self.ctx.mon_command(
+                {"prefix": "config-key get", "key": key})
+            if rc != 0 or not blob:
+                return -2, f"no device {cmd.get('devid')!r}", None
+            return 0, "", json.loads(blob)
+        return None
+
+    def serve_tick(self):
+        # scrape OFF the loop thread: serve_tick runs under the mgr
+        # lock on the beacon-sending thread, and a slow daemon would
+        # starve beacons into a spurious failover.  The asok timeout
+        # bounds the worker; the single-flight lock keeps it from
+        # overlapping a command-triggered scrape.
+        now = time.monotonic()
+        if now - self._last_scrape >= self.scrape_interval:
+            self._last_scrape = now
+            threading.Thread(target=self._safe_check,
+                             name="devicehealth-scrape",
+                             daemon=True).start()
+
+    def _safe_check(self):
+        try:
+            self.check_health()
+        except Exception:   # noqa: BLE001 — next cadence retries
+            pass

@@ -1,0 +1,226 @@
+"""mgr rbd_support — background RBD task queue + snapshot schedules.
+
+Reference behavior re-created (``src/pybind/mgr/rbd_support``;
+SURVEY.md §3.10): long-running image maintenance (flatten, remove,
+migration execute) is queued with ``rbd task add ...`` and executed by
+the module's worker so clients don't block; ``rbd snapshot schedule``
+takes periodic snapshots of an image.  State (queue + schedules)
+lives in the mon config-key store and survives mgr failover.
+
+Commands (via the mgr command server):
+- ``rbd task add`` {task: flatten|remove|migration execute,
+  image: pool/name} — enqueue
+- ``rbd task list`` — queue with statuses
+- ``rbd snapshot schedule add`` {image, interval} / ``remove`` /
+  ``list``
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from .daemon import MgrModule
+
+TASKS_KEY = "rbd_support/tasks"
+SCHED_KEY = "rbd_support/schedules"
+TASK_KINDS = ("flatten", "remove", "migration execute")
+
+
+class RbdSupportModule(MgrModule):
+    NAME = "rbd_support"
+    TICK = 1.0
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._rados = None
+        self._lock = threading.Lock()
+        self._worker: threading.Thread | None = None
+        self._kick = threading.Event()
+        self._stop = False
+        self._last_snap: dict[str, float] = {}
+
+    # -- persistence -------------------------------------------------------
+    def _load(self, key: str) -> list[dict]:
+        rc, _, blob = self.ctx.mon_command(
+            {"prefix": "config-key get", "key": key})
+        return json.loads(blob) if rc == 0 and blob else []
+
+    def _store(self, key: str, rows: list[dict]):
+        self.ctx.mon_command({"prefix": "config-key put", "key": key,
+                              "val": json.dumps(rows)})
+
+    # -- worker ------------------------------------------------------------
+    def _get_rados(self):
+        if self._rados is None:
+            from ..osdc.librados import Rados
+            d = self.ctx._d
+            self._rados = Rados(
+                d.monmap, name=f"client.rbd-support-{d.name}",
+                auth=getattr(d, "auth", None)).connect()
+        return self._rados
+
+    def _split_image(self, spec: str):
+        pool, _, image = spec.partition("/")
+        if not pool or not image:
+            raise ValueError(f"image must be pool/name, got {spec!r}")
+        return pool, image
+
+    def _run_task(self, task: dict):
+        from ..rbd import RBD, Image
+        pool, image = self._split_image(task["image"])
+        io = self._get_rados().open_ioctx(pool)
+        rbd = RBD()
+        kind = task["task"]
+        if kind == "flatten":
+            with Image(io, image) as im:
+                im.flatten()
+        elif kind == "remove":
+            from ..rbd import ImageNotFound
+            try:
+                rbd.remove(io, image)
+            except ImageNotFound:
+                if not task.get("_adopted"):
+                    raise
+                # an adopted (failover-requeued) remove may find the
+                # image already gone: the task succeeded
+        elif kind == "migration execute":
+            while rbd.migration_execute(io, image):
+                pass
+        else:
+            raise ValueError(f"unknown task kind {kind!r}")
+
+    def _worker_loop(self):
+        while not self._stop:
+            self._kick.wait(timeout=1.0)
+            self._kick.clear()
+            if self._stop:
+                return
+            with self._lock:
+                tasks = self._load(TASKS_KEY)
+                # "running" tasks are adopted too: they were in
+                # flight when a previous active mgr died and nothing
+                # else will ever finish them (single worker, so no
+                # double-execution within one mgr)
+                pending = [t for t in tasks
+                           if t["status"] in ("pending", "running")]
+            for task in pending:
+                task["_adopted"] = task["status"] == "running"
+                task["status"] = "running"
+                self._update_task(task)
+                try:
+                    self._run_task(task)
+                    task["status"] = "complete"
+                except Exception as e:      # noqa: BLE001
+                    task["status"] = "failed"
+                    task["error"] = str(e)[:200]
+                task.pop("_adopted", None)
+                self._update_task(task)
+            self._snapshot_pass()
+
+    def _update_task(self, task: dict):
+        with self._lock:
+            tasks = self._load(TASKS_KEY)
+            for i, t in enumerate(tasks):
+                if t["id"] == task["id"]:
+                    tasks[i] = task
+                    break
+            self._store(TASKS_KEY, tasks)
+
+    def _snapshot_pass(self):
+        from ..rbd import Image
+        now = time.time()
+        for sched in self._load(SCHED_KEY):
+            spec = sched["image"]
+            last = self._last_snap.get(spec, 0.0)
+            if now - last < float(sched["interval"]):
+                continue
+            try:
+                pool, image = self._split_image(spec)
+                io = self._get_rados().open_ioctx(pool)
+                with Image(io, image) as im:
+                    im.create_snap(
+                        f"scheduled-{int(now)}")
+                self._last_snap[spec] = now
+            except Exception:   # noqa: BLE001 — retried next pass
+                pass
+
+    def _kick_worker(self):
+        # check-and-start under the lock: the tick thread and the
+        # command-dispatch thread both call this, and two workers
+        # would run the same task twice
+        with self._lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._worker_loop, name="rbd-support",
+                    daemon=True)
+                self._worker.start()
+        self._kick.set()
+
+    # -- commands ----------------------------------------------------------
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix == "rbd task add":
+            kind = cmd.get("task")
+            if kind not in TASK_KINDS:
+                return (-22, f"unknown task {kind!r} (supported: "
+                             f"{', '.join(TASK_KINDS)})", None)
+            try:
+                self._split_image(cmd.get("image", ""))
+            except ValueError as e:
+                return -22, str(e), None
+            with self._lock:
+                tasks = self._load(TASKS_KEY)
+                task = {"id": (max((t["id"] for t in tasks),
+                                   default=0) + 1),
+                        "task": kind, "image": cmd["image"],
+                        "status": "pending",
+                        "created": time.time()}
+                tasks.append(task)
+                self._store(TASKS_KEY, tasks)
+            self._kick_worker()
+            return 0, f"queued task {task['id']}", task
+        if prefix == "rbd task list":
+            return 0, "", self._load(TASKS_KEY)
+        if prefix == "rbd snapshot schedule add":
+            import math
+            try:
+                self._split_image(cmd.get("image", ""))
+                interval = float(cmd["interval"])
+            except (ValueError, KeyError, TypeError) as e:
+                return -22, f"bad schedule: {e}", None
+            if not math.isfinite(interval) or interval <= 0:
+                return -22, "interval must be a positive number", None
+            with self._lock:
+                scheds = [s for s in self._load(SCHED_KEY)
+                          if s["image"] != cmd["image"]]
+                scheds.append({"image": cmd["image"],
+                               "interval": interval})
+                self._store(SCHED_KEY, scheds)
+            self._kick_worker()
+            return 0, "schedule added", None
+        if prefix == "rbd snapshot schedule remove":
+            with self._lock:
+                scheds = [s for s in self._load(SCHED_KEY)
+                          if s["image"] != cmd.get("image")]
+                self._store(SCHED_KEY, scheds)
+            return 0, "schedule removed", None
+        if prefix == "rbd snapshot schedule list":
+            return 0, "", self._load(SCHED_KEY)
+        return None
+
+    def serve_tick(self):
+        self._kick_worker()
+
+    def shutdown(self):
+        self._stop = True
+        self._kick.set()
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+        if self._rados is not None:
+            try:
+                self._rados.shutdown()
+            except Exception:   # noqa: BLE001
+                pass
+            self._rados = None

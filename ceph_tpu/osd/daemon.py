@@ -188,6 +188,17 @@ class OSDaemon(Dispatcher):
             "num_pgs": len(self.pgs),
             "state": "active" if self.running else "stopped"},
             "daemon status")
+        # SMART-style device health (reference: the OSD shells out to
+        # smartctl; here synthetic counters steered by a DEV option so
+        # devicehealth's scrape→predict→warn pipeline is testable).
+        # Raw counters only: the verdict thresholds live in ONE place
+        # (mgr devicehealth), never here
+        a.register("smart", lambda c: {
+            "devid": f"SYNTH-osd{self.whoami}",
+            "media_errors": self.config.get(
+                "osd_debug_smart_media_errors"),
+            "temperature_c": 35,
+        }, "device health metrics")
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, wait_for_up: bool = True, timeout: float = 15.0):

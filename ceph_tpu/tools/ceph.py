@@ -41,6 +41,17 @@ def main(argv=None) -> int:
         return 1
 
 
+def _run_mgr_command(mc, cmd: dict) -> int:
+    """Send one mgr-hosted command and print the standard output
+    shape (shared by the orch and device branches)."""
+    rc, outs, outb = mc.mgr_command(cmd)
+    if outb is not None:
+        print(json.dumps(outb, indent=2, default=str))
+    if outs:
+        print(outs, file=sys.stderr)
+    return 0 if rc == 0 else 1
+
+
 def _dispatch(args, rest) -> int:
     if rest[0] == "daemon":
         # `ceph daemon <asok> <cmd> [k=v ...]` — local admin socket
@@ -59,6 +70,12 @@ def _dispatch(args, rest) -> int:
         raise SystemExit("ceph: -m HOST:PORT required")
     mc = MonClient(_monmap_from_addrs(args.mon))
     try:
+        if rest[0] == "device" and len(rest) >= 2:
+            # mgr-hosted devicehealth commands
+            cmd = {"prefix": f"device {rest[1]}"}
+            if rest[1] == "info" and len(rest) > 2:
+                cmd["devid"] = rest[2]
+            return _run_mgr_command(mc, cmd)
         if rest[0] == "orch":
             # mgr-hosted orchestrator commands (reference `ceph orch`
             # → mon → active mgr → cephadm); transport: mgr_command
@@ -82,12 +99,7 @@ def _dispatch(args, rest) -> int:
                     print(usage, file=sys.stderr)
                     return 1
                 cmd["service_type"] = rest[2]
-            rc, outs, outb = mc.mgr_command(cmd)
-            if outb is not None:
-                print(json.dumps(outb, indent=2, default=str))
-            if outs:
-                print(outs, file=sys.stderr)
-            return 0 if rc == 0 else 1
+            return _run_mgr_command(mc, cmd)
         cmd: dict = {}
         if rest[0] == "osd" and rest[1:2] == ["pool"] and \
                 rest[2:3] == ["create"]:

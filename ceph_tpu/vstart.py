@@ -118,6 +118,11 @@ class MiniCluster:
         from .mgr.daemon import MgrDaemon
         from .mgr.orchestrator import MiniClusterBackend
         kw.setdefault("auth", self.auth)
+        # per-daemon admin sockets, for modules that scrape daemons
+        # directly (exporter, devicehealth)
+        kw.setdefault("asok_paths", {
+            f"osd.{i}": osd.admin_socket.path
+            for i, osd in self.osds.items()})
         mgr = MgrDaemon(name, self.monmap, **kw)
         # ONE deployment backend per cluster, shared by every mgr
         # (the cephadm-deployer analog — `ceph orch apply` lands

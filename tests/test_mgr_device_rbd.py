@@ -1,0 +1,151 @@
+"""mgr devicehealth + rbd_support modules (reference
+src/pybind/mgr/{devicehealth,rbd_support}; VERDICT r3 missing #6
+remainder).
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.rbd import Image, RBD
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    c.start_mgr("x")
+    c.wait_for_active_mgr()
+    r = c.rados()
+    r.create_pool("rbd", pg_num=8, size=2)
+    c.wait_for_clean()
+    yield c, r
+    c.stop()
+
+
+def _wait(pred, timeout=25.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.25)
+    return False
+
+
+class TestDeviceHealth:
+    def test_inventory_and_verdicts(self, cluster):
+        c, r = cluster
+        rc, _, devices = r.mgr_command("device ls")
+        assert rc == 0
+        assert len(devices) == 3
+        assert all(d["life_expectancy"] == "good" for d in devices)
+        devids = {d["devid"] for d in devices}
+        assert devids == {f"SYNTH-osd{i}" for i in range(3)}
+
+    def test_failing_device_warns(self, cluster):
+        c, r = cluster
+        # inject media errors on osd.1's synthetic device
+        c.osds[1].config.set("osd_debug_smart_media_errors", 150)
+        rc, outs, bad = r.mgr_command("device check-health")
+        assert rc == 0
+        assert len(bad) == 1 and bad[0]["devid"] == "SYNTH-osd1"
+        assert bad[0]["life_expectancy"] == "failing"
+        # the warning reached the cluster log
+        rc, _, entries = r.mon_command({"prefix": "log last",
+                                        "num": 10})
+        assert any("DEVICE_HEALTH SYNTH-osd1" in e["text"]
+                   for e in entries)
+        # history accumulates per device
+        rc, _, hist = r.mgr_command({"prefix": "device info",
+                                     "devid": "SYNTH-osd1"})
+        assert rc == 0 and len(hist) >= 1
+        assert hist[-1]["media_errors"] == 150
+        c.osds[1].config.set("osd_debug_smart_media_errors", 0)
+
+    def test_unknown_device(self, cluster):
+        c, r = cluster
+        rc, _, _ = r.mgr_command({"prefix": "device info",
+                                  "devid": "ghost"})
+        assert rc == -2
+
+
+class TestRbdSupport:
+    def test_task_queue_remove(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("rbd")
+        RBD().create(io, "doomed", 1 << 16, order=16)
+        rc, _, task = r.mgr_command({
+            "prefix": "rbd task add", "task": "remove",
+            "image": "rbd/doomed"})
+        assert rc == 0 and task["status"] == "pending"
+        assert _wait(lambda: "doomed" not in RBD().list(io))
+        rc, _, tasks = r.mgr_command("rbd task list")
+        done = next(t for t in tasks if t["id"] == task["id"])
+        assert done["status"] == "complete"
+
+    def test_task_queue_flatten(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("rbd")
+        rbd = RBD()
+        rbd.create(io, "fbase", 1 << 16, order=16)
+        with Image(io, "fbase") as p:
+            p.write(0, b"parent-data")
+            p.create_snap("g")
+            p.protect_snap("g")
+        rbd.clone(io, "fbase", "g", "fchild")
+        rc, _, task = r.mgr_command({
+            "prefix": "rbd task add", "task": "flatten",
+            "image": "rbd/fchild"})
+        assert rc == 0
+
+        def flattened():
+            with Image(io, "fchild", read_only=True) as ch:
+                return ch._hdr.get("parent") is None
+
+        assert _wait(flattened)
+        with Image(io, "fchild") as ch:
+            assert ch.read(0, 11) == b"parent-data"
+
+    def test_task_failure_recorded(self, cluster):
+        c, r = cluster
+        rc, _, task = r.mgr_command({
+            "prefix": "rbd task add", "task": "remove",
+            "image": "rbd/does-not-exist"})
+        assert rc == 0
+        assert _wait(lambda: next(
+            (t for t in r.mgr_command("rbd task list")[2]
+             if t["id"] == task["id"]), {}).get("status") == "failed")
+
+    def test_bad_task_rejected(self, cluster):
+        c, r = cluster
+        rc, outs, _ = r.mgr_command({
+            "prefix": "rbd task add", "task": "explode",
+            "image": "rbd/x"})
+        assert rc == -22 and "unknown task" in outs
+        rc, _, _ = r.mgr_command({
+            "prefix": "rbd task add", "task": "remove",
+            "image": "no-slash"})
+        assert rc == -22
+
+    def test_snapshot_schedule(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("rbd")
+        RBD().create(io, "sched", 1 << 16, order=16)
+        rc, _, _ = r.mgr_command({
+            "prefix": "rbd snapshot schedule add",
+            "image": "rbd/sched", "interval": 1.0})
+        assert rc == 0
+        rc, _, scheds = r.mgr_command("rbd snapshot schedule list")
+        assert scheds == [{"image": "rbd/sched", "interval": 1.0}]
+
+        def has_snap():
+            with Image(io, "sched", read_only=True) as im:
+                return any(s["name"].startswith("scheduled-")
+                           for s in im.list_snaps())
+
+        assert _wait(has_snap)
+        rc, _, _ = r.mgr_command({
+            "prefix": "rbd snapshot schedule remove",
+            "image": "rbd/sched"})
+        assert r.mgr_command("rbd snapshot schedule list")[2] == []
