@@ -25,9 +25,12 @@ frontend, ``rgw_op.cc`` op layer, ``rgw_rados.cc`` store; SURVEY.md
   old versions stay readable via ``?versionId=``, DELETE without a
   version writes a delete marker, ``GET ?versions`` lists all.
 
-ETags are MD5 hex like S3.  Auth/ACL are out of scope for this slice;
-the HTTP dialect is enough for s3-style clients pointed at an
-endpoint with auth disabled.
+ETags are MD5 hex like S3.  With ``require_auth=True`` the gateway
+enforces SigV4 signatures, per-user keys, bucket ownership, and
+IAM-style bucket policies (``?policy``); STS session tokens
+(``?Action=GetSessionToken``) mint temporary credentials.  A **Swift
+frontend** (``/auth/v1.0`` tempauth + ``/swift/v1/...``) serves the
+same buckets/objects as the S3 dialect.
 """
 
 from __future__ import annotations
@@ -250,9 +253,33 @@ class RGWStore:
         return True
 
     def secret_for_access_key(self, access_key: str) -> str | None:
-        """SigV4 verifier hook: access key → secret key (two
-        single-row server-side fetches, not a full user-table
-        scan per request)."""
+        """SigV4 verifier hook: access key → secret key (single-row
+        server-side fetches, not a full user-table scan)."""
+        found = self.resolve_access_key(access_key)
+        return found[1] if found else None
+
+    def resolve_access_key(self, access_key: str
+                           ) -> tuple[str, str, bool] | None:
+        """→ (uid, secret, is_temporary) for a permanent or
+        unexpired temporary (STS) access key; None otherwise.
+        Expired temporary rows are pruned on sight so the user table
+        cannot grow without bound."""
+        import time as _time
+        tkey = f"tmp\x00{access_key}"
+        try:
+            tmp_row = self.meta.omap_get(USERS_OID,
+                                         keys=[tkey]).get(tkey)
+        except ObjectNotFound:
+            tmp_row = None
+        if tmp_row is not None:
+            creds = json.loads(bytes(tmp_row))
+            if creds["expires"] < _time.time():
+                try:
+                    self.meta.omap_rm_keys(USERS_OID, [tkey])
+                except ObjectNotFound:
+                    pass
+                return None     # expired session token
+            return creds["uid"], creds["secret_key"], True
         akey = f"ak\x00{access_key}"
         try:
             uid_row = self.meta.omap_get(USERS_OID,
@@ -263,22 +290,137 @@ class RGWStore:
             return None
         uid = bytes(uid_row).decode()
         user = self.get_user(uid)
-        return user["secret_key"] if user else None
+        return (uid, user["secret_key"], False) if user else None
 
     # -- buckets -----------------------------------------------------------
     def create_bucket(self, bucket: str,
-                      index_shards: int = DEFAULT_INDEX_SHARDS) -> bool:
-        if bucket.startswith("lc."):
-            # the lifecycle rows share this omap; a literal "lc.x"
-            # bucket would collide with them and poison every
-            # lifecycle pass
+                      index_shards: int = DEFAULT_INDEX_SHARDS,
+                      owner: str | None = None) -> bool:
+        if bucket.startswith("lc.") or bucket.startswith("policy."):
+            # these namespaces share the buckets omap; a literal
+            # "lc.x"/"policy.x" bucket would collide and poison the
+            # lifecycle pass / policy lookups
             return False
         if self.bucket_exists(bucket):
             return True     # re-create keeps the existing shard count
+        row = {"name": bucket, "num_shards": index_shards}
+        if owner:
+            row["owner"] = owner
         self.meta.omap_set(BUCKETS_OID, {
-            bucket: json.dumps({"name": bucket,
-                                "num_shards": index_shards}).encode()})
+            bucket: json.dumps(row).encode()})
         return True
+
+    def bucket_owner(self, bucket: str) -> str | None:
+        try:
+            raw = self.meta.omap_get(BUCKETS_OID,
+                                     keys=[bucket]).get(bucket)
+        except ObjectNotFound:
+            return None
+        return json.loads(bytes(raw)).get("owner") if raw else None
+
+    # -- bucket policies (reference rgw IAM-ish policies) ------------------
+    def set_bucket_policy(self, bucket: str, policy: dict):
+        self.meta.omap_set(BUCKETS_OID, {
+            f"policy.{bucket}": json.dumps(policy).encode()})
+
+    def get_bucket_policy(self, bucket: str) -> dict | None:
+        key = f"policy.{bucket}"
+        try:
+            raw = self.meta.omap_get(BUCKETS_OID, keys=[key]).get(key)
+        except ObjectNotFound:
+            return None
+        return json.loads(bytes(raw)) if raw else None
+
+    def delete_bucket_policy(self, bucket: str):
+        self.meta.omap_rm_keys(BUCKETS_OID, [f"policy.{bucket}"])
+
+    def authorize(self, uid: str | None, action: str, bucket: str,
+                  key: str = "") -> bool:
+        """IAM-style decision (reference rgw_iam_policy evaluation,
+        reduced): the bucket owner (or, for pre-auth buckets with no
+        recorded owner, any authenticated user) may do everything;
+        otherwise the bucket policy's Allow statements decide —
+        Principal "*" or a listed uid, Action exact or "s3:*",
+        Resource the bucket arn or bucket/*."""
+        owner = self.bucket_owner(bucket)
+        if uid is not None and (owner is None or owner == uid):
+            return True
+        policy = self.get_bucket_policy(bucket)
+        if not policy:
+            return False
+        arn_bucket = f"arn:aws:s3:::{bucket}"
+        arn_obj = f"{arn_bucket}/{key}" if key else arn_bucket
+        for st in policy.get("Statement", []):
+            if st.get("Effect") != "Allow":
+                continue
+            principal = st.get("Principal", {})
+            allowed = principal in ("*", {"AWS": "*"})
+            if not allowed and isinstance(principal, dict):
+                aws = principal.get("AWS", [])
+                aws = [aws] if isinstance(aws, str) else aws
+                allowed = uid is not None and uid in aws
+            if not allowed:
+                continue
+            actions = st.get("Action", [])
+            actions = ([actions] if isinstance(actions, str)
+                       else actions)
+            if action not in actions and "s3:*" not in actions:
+                continue
+            resources = st.get("Resource", [])
+            resources = ([resources] if isinstance(resources, str)
+                         else resources)
+            for res in resources:
+                if res in ("*", arn_obj) or res == f"{arn_bucket}/*":
+                    return True
+                if res == arn_bucket and not key:
+                    return True
+        return False
+
+    # -- STS (reference rgw STS GetSessionToken) ---------------------------
+    def sts_get_session_token(self, uid: str,
+                              duration_s: float = 3600.0) -> dict:
+        import secrets
+        import time as _time
+        creds = {
+            "access_key": "TMP" + secrets.token_hex(8).upper(),
+            "secret_key": secrets.token_urlsafe(30),
+            "uid": uid,
+            "expires": _time.time() + min(max(duration_s, 60.0),
+                                          12 * 3600.0),
+        }
+        self.meta.omap_set(USERS_OID, {
+            f"tmp\x00{creds['access_key']}":
+                json.dumps(creds).encode()})
+        return creds
+
+    # -- swift tempauth tokens ---------------------------------------------
+    def swift_issue_token(self, uid: str) -> str:
+        import secrets
+        import time as _time
+        token = "AUTH_tk" + secrets.token_hex(16)
+        self.meta.omap_set(USERS_OID, {
+            f"swtok\x00{token}": json.dumps({
+                "uid": uid,
+                "expires": _time.time() + 3600.0}).encode()})
+        return token
+
+    def swift_token_uid(self, token: str) -> str | None:
+        import time as _time
+        key = f"swtok\x00{token}"
+        try:
+            row = self.meta.omap_get(USERS_OID, keys=[key]).get(key)
+        except ObjectNotFound:
+            return None
+        if row is None:
+            return None
+        info = json.loads(bytes(row))
+        if info["expires"] < _time.time():
+            try:
+                self.meta.omap_rm_keys(USERS_OID, [key])
+            except ObjectNotFound:
+                pass
+            return None
+        return info["uid"]
 
     def delete_bucket(self, bucket: str) -> bool:
         if self.list_objects(bucket):
@@ -287,7 +429,8 @@ class RGWStore:
         # index can never masquerade as an empty bucket here)
         oids = self._all_index_oids(bucket)
         self.meta.omap_rm_keys(BUCKETS_OID,
-                               [bucket, f"lc.{bucket}"])
+                               [bucket, f"lc.{bucket}",
+                                f"policy.{bucket}"])
         for oid in {*oids, _index_oid(bucket)}:
             try:
                 self.meta.remove(oid)
@@ -300,12 +443,12 @@ class RGWStore:
             rows = self.meta.omap_get(BUCKETS_OID)
         except ObjectNotFound:
             return False        # nothing registered yet
-        return bucket in rows and not bucket.startswith("lc.")
+        return bucket in rows and not bucket.startswith(("lc.", "policy."))
 
     def list_buckets(self) -> list[str]:
         try:
             return sorted(b for b in self.meta.omap_get(BUCKETS_OID)
-                          if not b.startswith("lc."))
+                          if not b.startswith(("lc.", "policy.")))
         except ObjectNotFound:
             return []
 
@@ -722,26 +865,70 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):   # quiet
         pass
 
+    @staticmethod
+    def _action_of(method: str, key: str | None) -> str:
+        if key:
+            return {"GET": "s3:GetObject", "HEAD": "s3:GetObject",
+                    "PUT": "s3:PutObject", "POST": "s3:PutObject",
+                    "DELETE": "s3:DeleteObject"}.get(method,
+                                                     "s3:Unknown")
+        return {"GET": "s3:ListBucket", "HEAD": "s3:ListBucket",
+                "PUT": "s3:CreateBucket", "POST": "s3:PutObject",
+                "DELETE": "s3:DeleteBucket"}.get(method,
+                                                 "s3:Unknown")
+
+    def _deny(self, msg: str) -> bool:
+        self._reply(403, f"<Error><Code>AccessDenied</Code>"
+                         f"<Message>{_xesc(msg)}</Message>"
+                         f"</Error>".encode())
+        return False
+
     def _check_auth(self, body: bytes) -> bool:
-        """SigV4 gate (reference rgw_auth_s3.cc): with auth required,
-        every request must carry a valid AWS4-HMAC-SHA256 signature
-        from a known user; unsigned/garbled/forged → 403 and the
-        handler stops.  → True when the request may proceed."""
+        """Auth + authorization gate (reference rgw_auth_s3.cc +
+        rgw_iam_policy): a signed request resolves to its user; an
+        UNSIGNED request proceeds as anonymous and may only do what a
+        bucket policy explicitly grants.  A present-but-invalid
+        signature is always 403.  → True when the request may
+        proceed; self._auth_uid carries the caller identity."""
+        self._auth_uid = None
+        self._auth_temp = False
         if not self.require_auth:
             return True
         from . import sigv4
         path = self.path.split("?", 1)[0]
-        try:
-            self._auth_access_key = sigv4.verify(
-                self.command, path, self._query(),
-                dict(self.headers.items()), body,
-                self.store.secret_for_access_key)
+        hdrs = dict(self.headers.items())
+        has_authz = any(k.lower() == "authorization" for k in hdrs)
+        if has_authz:
+            resolved: dict = {}
+
+            def lookup(ak: str):
+                found = self.store.resolve_access_key(ak)
+                if found is not None:
+                    resolved[ak] = found
+                    return found[1]
+                return None
+
+            try:
+                ak = sigv4.verify(
+                    self.command, path, self._query(), hdrs, body,
+                    lookup)
+            except sigv4.SigError as e:
+                return self._deny(str(e))
+            self._auth_uid = resolved[ak][0]
+            self._auth_temp = resolved[ak][2]
+        bucket, key = self._parse()
+        if bucket is None:
+            # account-level ops (list buckets, STS) need identity
+            if self._auth_uid is None:
+                return self._deny("authentication required")
             return True
-        except sigv4.SigError as e:
-            self._reply(403, f"<Error><Code>AccessDenied</Code>"
-                             f"<Message>{_xesc(str(e))}</Message>"
-                             f"</Error>".encode())
-            return False
+        action = self._action_of(self.command, key)
+        if not self.store.authorize(self._auth_uid, action, bucket,
+                                    key or ""):
+            return self._deny(
+                f"{action} on {bucket!r} denied for "
+                f"{self._auth_uid or 'anonymous'}")
+        return True
 
     def _reply(self, code: int, body: bytes = b"",
                ctype: str = "application/xml", headers: dict = None):
@@ -779,7 +966,121 @@ class _Handler(BaseHTTPRequestHandler):
             # fabricate 404s (clients retry)
             self.close_connection = True
 
+    # -- Swift frontend (reference rgw_rest_swift.cc + tempauth) -----------
+    # /auth/v1.0 issues an X-Auth-Token against the SAME user table
+    # the S3 side uses; /swift/v1[/container[/object]] maps onto the
+    # same buckets/objects, so both dialects see one namespace.
+    def _swift_route(self) -> bool:
+        """→ True when this request was a Swift/auth request and has
+        been fully handled."""
+        path = self.path.split("?", 1)[0]
+        if path == "/auth/v1.0":
+            self._swift_auth()
+            return True
+        if path == "/swift/v1" or path.startswith("/swift/v1/"):
+            self._swift_op(path[len("/swift/v1"):].strip("/"))
+            return True
+        return False
+
+    def _swift_auth(self):
+        uid = self.headers.get("X-Auth-User", "")
+        key = self.headers.get("X-Auth-Key", "")
+        user = self.store.get_user(uid)
+        if user is None or user["secret_key"] != key:
+            return self._reply(401)
+        token = self.store.swift_issue_token(uid)
+        host = self.headers.get("Host", "")
+        return self._reply(200, headers={
+            "X-Auth-Token": token,
+            "X-Storage-Url": f"http://{host}/swift/v1"})
+
+    def _swift_identity(self) -> tuple[bool, str | None]:
+        """→ (authorized-to-proceed, uid)."""
+        if not self.require_auth:
+            return True, None
+        token = self.headers.get("X-Auth-Token", "")
+        uid = self.store.swift_token_uid(token) if token else None
+        return uid is not None or not token, uid
+
+    def _swift_op(self, rest: str):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        ok, uid = self._swift_identity()
+        if not ok:
+            return self._reply(401)
+        parts = rest.split("/", 1) if rest else []
+        container = parts[0] if parts else None
+        obj = parts[1] if len(parts) > 1 else None
+        method = self.command
+        if self.require_auth and container is not None:
+            action = self._action_of(method, obj)
+            if not self.store.authorize(uid, action, container,
+                                        obj or ""):
+                return self._reply(403)
+        if container is None:
+            if uid is None and self.require_auth:
+                # account-level ops (incl. the bucket listing) need a
+                # token — same bar as the S3 side's 403
+                return self._reply(401)
+            if method == "GET":
+                names = "\n".join(self.store.list_buckets())
+                return self._reply(200, (names + "\n").encode()
+                                   if names else b"",
+                                   ctype="text/plain")
+            return self._reply(400)
+        if obj is None:
+            if method == "PUT":
+                if not self.store.create_bucket(container,
+                                                owner=uid):
+                    return self._reply(400)
+                return self._reply(201)
+            if method == "GET":
+                if not self.store.bucket_exists(container):
+                    return self._reply(404)
+                names = "\n".join(sorted(
+                    self.store.list_objects(container)))
+                return self._reply(200, (names + "\n").encode()
+                                   if names else b"",
+                                   ctype="text/plain")
+            if method == "HEAD":
+                return self._reply(
+                    204 if self.store.bucket_exists(container)
+                    else 404)
+            if method == "DELETE":
+                if not self.store.bucket_exists(container):
+                    return self._reply(404)
+                return self._reply(
+                    204 if self.store.delete_bucket(container)
+                    else 409)
+            return self._reply(400)
+        if method == "PUT":
+            if not self.store.bucket_exists(container):
+                return self._reply(404)
+            etag, _vid = self.store.put_object(container, obj, body)
+            return self._reply(201, headers={"ETag": etag})
+        if method in ("GET", "HEAD"):
+            try:
+                data, meta = self.store.get_object(container, obj)
+            except (KeyError, ObjectNotFound):
+                return self._reply(404)
+            if method == "HEAD":
+                return self._reply(200, headers={
+                    "ETag": meta["etag"],
+                    "Content-Length": str(meta["size"])})
+            return self._reply(200, data,
+                               ctype="application/octet-stream")
+        if method == "DELETE":
+            try:
+                self.store.head_object(container, obj)
+            except (KeyError, ObjectNotFound):
+                return self._reply(404)
+            self.store.delete_object(container, obj)
+            return self._reply(204)
+        return self._reply(400)
+
     def do_PUT(self):
+        if self._swift_route():
+            return
         bucket, key = self._parse()
         q = self._query()
         # always drain the request body first: replying while unread
@@ -797,6 +1098,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self.store.set_versioning(
                     bucket, b"Enabled" in body)
                 return self._reply(200)
+            if "policy" in q:
+                if not self.store.bucket_exists(bucket):
+                    return self._reply(404)
+                try:
+                    policy = json.loads(body.decode())
+                except (ValueError, UnicodeDecodeError):
+                    return self._reply(400)
+                self.store.set_bucket_policy(bucket, policy)
+                return self._reply(204)
             if "lifecycle" in q:
                 if not self.store.bucket_exists(bucket):
                     return self._reply(404)
@@ -817,7 +1127,8 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._reply(400)
                 self.store.set_lifecycle(bucket, rules)
                 return self._reply(200)
-            if not self.store.create_bucket(bucket):
+            if not self.store.create_bucket(
+                    bucket, owner=getattr(self, "_auth_uid", None)):
                 return self._reply(400)
             return self._reply(200)
         if not self.store.bucket_exists(bucket):
@@ -838,8 +1149,32 @@ class _Handler(BaseHTTPRequestHandler):
         return self._reply(200, headers=hdrs)
 
     def do_POST(self):
+        if self._swift_route():
+            return
         bucket, key = self._parse()
         q = self._query()
+        if bucket is None and q.get("Action") == "GetSessionToken":
+            length = int(self.headers.get("Content-Length", 0))
+            sts_body = self.rfile.read(length)
+            if not self._check_auth(sts_body):
+                return
+            if getattr(self, "_auth_temp", False):
+                # a leaked session token must not launder itself into
+                # rolling credentials (AWS STS refuses this too)
+                return self._deny(
+                    "GetSessionToken requires permanent credentials")
+            import math
+            try:
+                duration = float(q.get("DurationSeconds", 3600))
+            except ValueError:
+                return self._reply(400)
+            if not math.isfinite(duration) or duration <= 0:
+                return self._reply(400)
+            creds = self.store.sts_get_session_token(
+                self._auth_uid, duration)
+            return self._reply(
+                200, json.dumps(creds).encode(),
+                ctype="application/json")
         length = int(self.headers.get("Content-Length", 0))
         post_body = self.rfile.read(length)  # CompleteMultipartUpload
         # XML: the part list is authoritative server-side (we
@@ -875,6 +1210,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self._reply(400)
 
     def do_GET(self):
+        if self._swift_route():
+            return
         bucket, key = self._parse()
         q = self._query()
         if not self._check_auth(b""):
@@ -885,6 +1222,12 @@ class _Handler(BaseHTTPRequestHandler):
         if key is None:
             if not self.store.bucket_exists(bucket):
                 return self._reply(404)
+            if "policy" in q:
+                policy = self.store.get_bucket_policy(bucket)
+                if policy is None:
+                    return self._reply(404)
+                return self._reply(200, json.dumps(policy).encode(),
+                                   ctype="application/json")
             if "versions" in q:
                 return self._reply(200, _xml_list_versions(
                     bucket, self.store.list_versions(bucket)))
@@ -924,6 +1267,8 @@ class _Handler(BaseHTTPRequestHandler):
                            headers=hdrs)
 
     def do_HEAD(self):
+        if self._swift_route():
+            return
         bucket, key = self._parse()
         if not self._check_auth(b""):
             return
@@ -938,6 +1283,8 @@ class _Handler(BaseHTTPRequestHandler):
             "X-Object-Size": str(meta["size"])})
 
     def do_DELETE(self):
+        if self._swift_route():
+            return
         bucket, key = self._parse()
         q = self._query()
         if not self._check_auth(b""):
@@ -945,6 +1292,11 @@ class _Handler(BaseHTTPRequestHandler):
         if bucket is None:
             return self._reply(400)
         if key is None:
+            if "policy" in q:
+                if not self.store.bucket_exists(bucket):
+                    return self._reply(404)
+                self.store.delete_bucket_policy(bucket)
+                return self._reply(204)
             ok = self.store.delete_bucket(bucket)
             return self._reply(204 if ok else 409)
         if "uploadId" in q:

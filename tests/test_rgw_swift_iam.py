@@ -1,0 +1,259 @@
+"""RGW Swift frontend + bucket policies + STS (reference
+rgw_rest_swift.cc, rgw_iam_policy, rgw STS; VERDICT r3 missing #3
+remainder).
+"""
+
+import http.client
+import json
+
+import pytest
+
+from ceph_tpu.rgw import RGWService, S3Client
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    r = c.rados()
+    yield c, r
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def authed(cluster):
+    _c, r = cluster
+    gw = RGWService(r, require_auth=True).start()
+    alice = gw.store.create_user("alice")
+    bob = gw.store.create_user("bob")
+    yield gw, alice, bob
+    gw.shutdown()
+
+
+def _req(port, method, path, body=b"", headers=None):
+    con = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        con.request(method, path, body=body or None,
+                    headers=headers or {})
+        resp = con.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        con.close()
+
+
+class TestSwift:
+    def test_tempauth_and_container_object_crud(self, authed):
+        gw, alice, _bob = authed
+        # bad creds refused
+        st, _, _ = _req(gw.port, "GET", "/auth/v1.0", headers={
+            "X-Auth-User": "alice", "X-Auth-Key": "wrong"})
+        assert st == 401
+        st, hdr, _ = _req(gw.port, "GET", "/auth/v1.0", headers={
+            "X-Auth-User": "alice",
+            "X-Auth-Key": alice["secret_key"]})
+        assert st == 200
+        token = hdr["X-Auth-Token"]
+        assert hdr["X-Storage-Url"].endswith("/swift/v1")
+        auth = {"X-Auth-Token": token}
+        # container + object CRUD
+        assert _req(gw.port, "PUT", "/swift/v1/photos",
+                    headers=auth)[0] == 201
+        st, hdr, _ = _req(gw.port, "PUT", "/swift/v1/photos/cat.jpg",
+                          body=b"meow-bytes", headers=auth)
+        assert st == 201
+        st, _, body = _req(gw.port, "GET", "/swift/v1/photos/cat.jpg",
+                           headers=auth)
+        assert st == 200 and body == b"meow-bytes"
+        st, _, listing = _req(gw.port, "GET", "/swift/v1/photos",
+                              headers=auth)
+        assert st == 200 and b"cat.jpg" in listing
+        st, _, containers = _req(gw.port, "GET", "/swift/v1",
+                                 headers=auth)
+        assert b"photos" in containers
+        assert _req(gw.port, "HEAD", "/swift/v1/photos/cat.jpg",
+                    headers=auth)[0] == 200
+        # non-empty delete refused, then drained
+        assert _req(gw.port, "DELETE", "/swift/v1/photos",
+                    headers=auth)[0] == 409
+        assert _req(gw.port, "DELETE", "/swift/v1/photos/cat.jpg",
+                    headers=auth)[0] == 204
+        assert _req(gw.port, "DELETE", "/swift/v1/photos",
+                    headers=auth)[0] == 204
+
+    def test_swift_and_s3_share_namespace(self, authed):
+        gw, alice, _bob = authed
+        s3 = S3Client("127.0.0.1", gw.port,
+                      access_key=alice["access_key"],
+                      secret_key=alice["secret_key"])
+        assert s3.make_bucket("shared") == 200
+        s3.put("shared", "from-s3", b"s3-wrote-this")
+        st, hdr, _ = _req(gw.port, "GET", "/auth/v1.0", headers={
+            "X-Auth-User": "alice",
+            "X-Auth-Key": alice["secret_key"]})
+        auth = {"X-Auth-Token": hdr["X-Auth-Token"]}
+        st, _, body = _req(gw.port, "GET",
+                           "/swift/v1/shared/from-s3", headers=auth)
+        assert st == 200 and body == b"s3-wrote-this"
+        _req(gw.port, "PUT", "/swift/v1/shared/from-swift",
+             body=b"swift-wrote-this", headers=auth)
+        st, body2 = s3.get("shared", "from-swift")
+        assert st == 200 and body2 == b"swift-wrote-this"
+
+    def test_swift_token_required(self, authed):
+        gw, _alice, _bob = authed
+        st, _, _ = _req(gw.port, "PUT", "/swift/v1/noauth",
+                        headers={"X-Auth-Token": "AUTH_tkbogus"})
+        assert st == 401
+
+
+class TestBucketPolicy:
+    def test_owner_only_by_default(self, authed):
+        gw, alice, bob = authed
+        s3a = S3Client("127.0.0.1", gw.port,
+                       access_key=alice["access_key"],
+                       secret_key=alice["secret_key"])
+        s3b = S3Client("127.0.0.1", gw.port,
+                       access_key=bob["access_key"],
+                       secret_key=bob["secret_key"])
+        assert s3a.make_bucket("private") == 200
+        s3a.put("private", "secret.txt", b"alices-data")
+        # bob is authenticated but not the owner: denied
+        assert s3b.get("private", "secret.txt")[0] == 403
+        assert s3b.put("private", "x", b"y")[0] == 403
+        # anonymous: denied
+        anon = S3Client("127.0.0.1", gw.port)
+        assert anon.get("private", "secret.txt")[0] == 403
+
+    def test_policy_grants_user_and_public(self, authed):
+        gw, alice, bob = authed
+        s3a = S3Client("127.0.0.1", gw.port,
+                       access_key=alice["access_key"],
+                       secret_key=alice["secret_key"])
+        s3b = S3Client("127.0.0.1", gw.port,
+                       access_key=bob["access_key"],
+                       secret_key=bob["secret_key"])
+        assert s3a.make_bucket("shared-rw") == 200
+        s3a.put("shared-rw", "doc", b"v1")
+        policy = {"Version": "2012-10-17", "Statement": [
+            {"Effect": "Allow", "Principal": {"AWS": "bob"},
+             "Action": ["s3:GetObject", "s3:PutObject"],
+             "Resource": "arn:aws:s3:::shared-rw/*"}]}
+        st, _, _ = s3a._req(
+            "PUT", "/shared-rw?policy",
+            body=json.dumps(policy).encode())
+        assert st == 204
+        # bob can now read and write objects...
+        assert s3b.get("shared-rw", "doc") == (200, b"v1")
+        assert s3b.put("shared-rw", "doc2", b"bob-wrote")[0] == 200
+        # ...but not list (no s3:ListBucket grant) or delete
+        assert s3b.list("shared-rw")[0] == 403
+        assert s3b.delete("shared-rw", "doc") == 403
+        # public read via Principal "*"
+        policy["Statement"].append(
+            {"Effect": "Allow", "Principal": "*",
+             "Action": "s3:GetObject",
+             "Resource": "arn:aws:s3:::shared-rw/*"})
+        s3a._req("PUT", "/shared-rw?policy",
+                 body=json.dumps(policy).encode())
+        anon = S3Client("127.0.0.1", gw.port)
+        assert anon.get("shared-rw", "doc") == (200, b"v1")
+        assert anon.put("shared-rw", "nope", b"x")[0] == 403
+        # get/delete policy round trip
+        st, _, got = s3a._req("GET", "/shared-rw?policy")
+        assert st == 200 and json.loads(got) == policy
+        assert s3a._req("DELETE", "/shared-rw?policy")[0] == 204
+        assert anon.get("shared-rw", "doc")[0] == 403
+
+
+class TestSTS:
+    def test_session_token_flow(self, authed):
+        gw, alice, _bob = authed
+        s3a = S3Client("127.0.0.1", gw.port,
+                       access_key=alice["access_key"],
+                       secret_key=alice["secret_key"])
+        assert s3a.make_bucket("stsb") == 200
+        s3a.put("stsb", "k", b"sts-read")
+        # unsigned GetSessionToken refused
+        st, _, _ = _req(gw.port, "POST", "/?Action=GetSessionToken")
+        assert st == 403
+        st, _, body = s3a._req("POST", "/?Action=GetSessionToken")
+        assert st == 200
+        creds = json.loads(body)
+        assert creds["access_key"].startswith("TMP")
+        # the temporary credentials act as alice
+        tmp = S3Client("127.0.0.1", gw.port,
+                       access_key=creds["access_key"],
+                       secret_key=creds["secret_key"])
+        assert tmp.get("stsb", "k") == (200, b"sts-read")
+        assert tmp.put("stsb", "k2", b"by-temp")[0] == 200
+        # expired token refused
+        gw.store.meta.omap_set(
+            "users", {f"tmp\x00{creds['access_key']}":
+                      json.dumps(dict(creds, expires=1.0)).encode()})
+        assert tmp.get("stsb", "k")[0] == 403
+
+
+class TestReviewRegressions:
+    def test_anonymous_swift_account_listing_denied(self, authed):
+        """With auth required, the account-level container listing
+        needs a token (review r4: it leaked every bucket name)."""
+        gw, _alice, _bob = authed
+        st, _, _ = _req(gw.port, "GET", "/swift/v1")
+        assert st == 401
+
+    def test_policy_does_not_survive_bucket_delete(self, authed):
+        """A deleted bucket's policy must die with it — a later
+        bucket of the same name must not inherit public access."""
+        gw, alice, _bob = authed
+        s3a = S3Client("127.0.0.1", gw.port,
+                       access_key=alice["access_key"],
+                       secret_key=alice["secret_key"])
+        assert s3a.make_bucket("reborn") == 200
+        s3a._req("PUT", "/reborn?policy", body=json.dumps({
+            "Statement": [{"Effect": "Allow", "Principal": "*",
+                           "Action": "s3:*",
+                           "Resource": "*"}]}).encode())
+        assert s3a.delete("reborn") == 204
+        assert s3a.make_bucket("reborn") == 200
+        anon = S3Client("127.0.0.1", gw.port)
+        assert anon.get("reborn", "x")[0] == 403
+        assert gw.store.get_bucket_policy("reborn") is None
+
+    def test_temp_creds_cannot_mint_more(self, authed):
+        """A session token must not launder itself into rolling
+        credentials."""
+        gw, alice, _bob = authed
+        s3a = S3Client("127.0.0.1", gw.port,
+                       access_key=alice["access_key"],
+                       secret_key=alice["secret_key"])
+        st, _, body = s3a._req("POST", "/?Action=GetSessionToken")
+        creds = json.loads(body)
+        tmp = S3Client("127.0.0.1", gw.port,
+                       access_key=creds["access_key"],
+                       secret_key=creds["secret_key"])
+        st, _, _ = tmp._req("POST", "/?Action=GetSessionToken")
+        assert st == 403
+
+    def test_sts_duration_validation(self, authed):
+        gw, alice, _bob = authed
+        s3a = S3Client("127.0.0.1", gw.port,
+                       access_key=alice["access_key"],
+                       secret_key=alice["secret_key"])
+        for bad in ("abc", "nan", "-5", "inf"):
+            st, _, _ = s3a._req(
+                "POST", f"/?Action=GetSessionToken"
+                        f"&DurationSeconds={bad}")
+            assert st == 400, bad
+
+    def test_s3_bucket_named_auth_usable(self, authed):
+        """Only the exact /auth/v1.0 tempauth endpoint is special: an
+        S3 bucket literally named 'auth' keeps working."""
+        gw, alice, _bob = authed
+        s3a = S3Client("127.0.0.1", gw.port,
+                       access_key=alice["access_key"],
+                       secret_key=alice["secret_key"])
+        assert s3a.make_bucket("auth") == 200
+        st, _ = s3a.put("auth", "report.csv", b"a,b,c")
+        assert st == 200
+        assert s3a.get("auth", "report.csv") == (200, b"a,b,c")
