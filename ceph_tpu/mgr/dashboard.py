@@ -1,17 +1,26 @@
-"""mgr dashboard — REST API + HTML cluster status page.
+"""mgr dashboard — operational web UI + REST API.
 
 Reference behavior re-created (``src/pybind/mgr/dashboard``; SURVEY.md
-§3.10), reduced to the read-side REST controllers and a single status
-page (the reference's Angular frontend is out of scope — the API
-shape is the parity surface):
+§3.10): the REST controllers plus a self-contained operational
+frontend (the reference ships an Angular app; here a single
+server-rendered page with auto-refreshing panels fetches the same API
+— the API shape and the operator workflows are the parity surface):
 
 - ``GET /api/health``      → health status + checks
 - ``GET /api/summary``     → the `ceph -s` aggregate
-- ``GET /api/osd``         → per-OSD rows (up/in, pgs, ops)
+- ``GET /api/osd``         → per-OSD rows (up/in, pgs, usage)
+- ``GET /api/osd/tree``    → the CRUSH tree
 - ``GET /api/pool``        → per-pool rows (pg_num, objects, bytes)
 - ``GET /api/pg``          → pg state counts
+- ``GET /api/mon``         → quorum / leader
+- ``GET /api/mgr``         → active + standbys
+- ``GET /api/fs``          → filesystems + MDS ranks
+- ``GET /api/log``         → recent cluster log
 - ``GET /api/crash``       → archived crash reports
-- ``GET /``                → minimal HTML status page
+- ``GET /api/device``      → device health verdicts (devicehealth)
+- ``GET /api/rbd/task``    → background task queue (rbd_support)
+- ``GET /api/orch``        → declared services (orchestrator)
+- ``GET /``                → the dashboard page
 
 Runs on the ACTIVE mgr like the prometheus exporter; standbys don't
 bind (reference: the dashboard fails over with the active mgr).
@@ -85,6 +94,14 @@ class DashboardModule(MgrModule):
         rc, _, st = self.ctx.mon_command({"prefix": "status"})
         return st if rc == 0 and st else {}
 
+    def _mon(self, cmd: str) -> dict | list:
+        rc, _, out = self.ctx.mon_command({"prefix": cmd})
+        return out if rc == 0 and out is not None else {}
+
+    def _sibling(self, name: str):
+        """Another module hosted by this mgr (shared instances)."""
+        return self.ctx._d.modules.get(name)
+
     def api(self, route: str):
         if route == "health":
             st = self._status()
@@ -93,44 +110,155 @@ class DashboardModule(MgrModule):
         if route == "summary":
             return self._status()
         if route == "osd":
-            rc, _, dump = self.ctx.mon_command({"prefix": "osd df"})
-            return dump.get("nodes", []) if rc == 0 and dump else []
+            out = self._mon("osd df")
+            return out.get("nodes", []) if isinstance(out, dict) \
+                else []
+        if route == "osd/tree":
+            return self._mon("osd tree")
         if route == "pool":
-            rc, _, df = self.ctx.mon_command({"prefix": "df"})
-            return df.get("pools", []) if rc == 0 and df else []
+            out = self._mon("df")
+            return out.get("pools", []) if isinstance(out, dict) \
+                else []
         if route == "pg":
             st = self._status()
             return {"num_pgs": st.get("num_pgs", 0),
                     "states": st.get("pg_states", {})}
+        if route == "mon":
+            st = self._status()
+            return {"quorum": st.get("quorum"),
+                    "leader": st.get("leader")}
+        if route == "mgr":
+            return self._mon("mgr dump")
+        if route == "fs":
+            return self._mon("fs dump")
+        if route == "log":
+            rc, _, entries = self.ctx.mon_command(
+                {"prefix": "log last", "num": 20})
+            return entries if rc == 0 else []
         if route == "crash":
-            # reuse the daemon's registered crash module (it shares
-            # this module host) rather than wiring a second instance
-            mod = self.ctx._d.modules.get("crash")
+            mod = self._sibling("crash")
             if mod is None:
                 from .modules import CrashModule
                 mod = CrashModule(self.ctx)
             return mod.ls()
+        if route == "device":
+            # the module's LAST verdicts — a dashboard poll must not
+            # trigger scrapes, config-key writes, or clog warnings
+            mod = self._sibling("devicehealth")
+            return mod.last_verdicts() if mod is not None else []
+        if route == "rbd/task":
+            mod = self._sibling("rbd_support")
+            if mod is None:
+                return []
+            res = mod.handle_command({"prefix": "rbd task list"})
+            return res[2] if res else []
+        if route == "orch":
+            mod = self._sibling("orchestrator")
+            if mod is None:
+                return []
+            res = mod.handle_command({"prefix": "orch ls"})
+            return res[2] if res else []
         return None
 
+    # -- frontend ----------------------------------------------------------
     def render_html(self) -> str:
-        st = self._status()
-        checks = "".join(
-            f"<li>{c['code']}: {c['summary']}</li>"
-            for c in st.get("checks", []))
-        pgs = ", ".join(f"{n} {s}" for s, n in
-                        sorted(st.get("pg_states", {}).items()))
-        color = {"HEALTH_OK": "#0a0", "HEALTH_WARN": "#a80",
-                 "HEALTH_ERR": "#a00"}.get(st.get("health"), "#888")
-        return f"""<!doctype html><html><head>
-<title>ceph_tpu dashboard</title></head><body>
-<h1>Cluster status</h1>
-<p>Health: <b style="color:{color}">{st.get('health', '?')}</b></p>
-<ul>{checks}</ul>
-<p>mon quorum {st.get('quorum')} &middot;
-osd {st.get('num_up_osds')}/{st.get('num_osds')} up &middot;
-{len(st.get('pools', []))} pools &middot;
-{st.get('num_objects')} objects</p>
-<p>pgs: {pgs}</p>
-<p>API: /api/health /api/summary /api/osd /api/pool /api/pg
-/api/crash</p>
-</body></html>"""
+        """One self-contained page: server renders the shell, a small
+        script polls the API and fills the panels (the reference's
+        Angular SPA, minus the build system)."""
+        return """<!doctype html><html><head>
+<title>ceph_tpu dashboard</title>
+<style>
+body{font-family:system-ui,sans-serif;margin:0;background:#f4f5f7}
+header{background:#24303c;color:#fff;padding:10px 16px}
+header b{font-size:1.1em}
+#health{padding:2px 10px;border-radius:10px;margin-left:10px}
+.ok{background:#0a6b2c}.warn{background:#a87000}.err{background:#a00}
+main{display:grid;grid-template-columns:repeat(auto-fit,minmax(340px,
+1fr));gap:12px;padding:12px}
+section{background:#fff;border-radius:6px;padding:10px 14px;
+box-shadow:0 1px 3px rgba(0,0,0,.15)}
+h2{font-size:.95em;margin:2px 0 8px;color:#445}
+table{border-collapse:collapse;width:100%;font-size:.85em}
+td,th{text-align:left;padding:2px 8px 2px 0;border-bottom:1px solid
+#eee}
+#log td{font-family:monospace;font-size:.8em}
+.muted{color:#888}
+</style></head><body>
+<header><b>ceph_tpu</b> dashboard
+<span id="health" class="ok">...</span>
+<span id="svc" class="muted"></span></header>
+<main>
+<section><h2>Health checks</h2><ul id="checks"></ul></section>
+<section><h2>PGs</h2><div id="pgs"></div></section>
+<section><h2>OSDs</h2><table id="osds"><thead><tr><th>id</th>
+<th>status</th><th>pgs</th><th>ops</th></tr></thead>
+<tbody></tbody></table></section>
+<section><h2>Pools</h2><table id="pools"><thead><tr><th>pool</th>
+<th>objects</th><th>bytes</th></tr></thead>
+<tbody></tbody></table></section>
+<section><h2>Filesystems</h2><div id="fs"></div></section>
+<section><h2>Devices</h2><table id="devices"><thead><tr>
+<th>device</th><th>osd</th><th>verdict</th></tr></thead>
+<tbody></tbody></table></section>
+<section><h2>Orchestrator services</h2><table id="orch"><thead><tr>
+<th>service</th><th>target</th><th>running</th></tr></thead>
+<tbody></tbody></table></section>
+<section><h2>RBD tasks</h2><table id="tasks"><thead><tr><th>id</th>
+<th>task</th><th>image</th><th>status</th></tr></thead>
+<tbody></tbody></table></section>
+<section style="grid-column:1/-1"><h2>Cluster log</h2>
+<table id="log"><tbody></tbody></table></section>
+</main>
+<script>
+async function j(r){const x=await fetch('/api/'+r);
+  return x.ok?x.json():null}
+function esc(v){return String(v??'').replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',
+       "'":'&#39;'}[c]))}
+function rows(el,data,f){const b=document.querySelector(el+' tbody');
+  if(!b||!data)return;b.innerHTML=data.map(f).join('')}
+async function refresh(){
+  const s=await j('summary');if(s){
+    const h=document.getElementById('health');
+    h.textContent=s.health||'?';
+    h.className=s.health==='HEALTH_OK'?'ok':
+      (s.health==='HEALTH_WARN'?'warn':'err');
+    document.getElementById('svc').textContent=
+      ' mon quorum '+JSON.stringify(s.quorum)+' | osd '+
+      s.num_up_osds+'/'+s.num_osds+' up | '+
+      (s.pools?s.pools.length:0)+' pools | '+
+      s.num_objects+' objects';
+    document.getElementById('checks').innerHTML=
+      (s.checks&&s.checks.length)?s.checks.map(c=>'<li>'+
+        esc(c.code)+': '+esc(c.summary)+'</li>').join(''):
+        '<li class="muted">none</li>';
+    const pg=await j('pg');
+    document.getElementById('pgs').textContent=
+      pg?pg.num_pgs+' pgs: '+Object.entries(pg.states||{}).map(
+        ([k,v])=>v+' '+k).join(', '):'';}
+  rows('#osds',await j('osd'),n=>'<tr><td>osd.'+esc(n.osd)+
+    '</td><td>'+(n.up?'up':'down')+'</td><td>'+esc(n.num_pgs)+
+    '</td><td>'+esc(n.ops)+'</td></tr>');
+  rows('#pools',await j('pool'),p=>'<tr><td>'+esc(p.name)+
+    '</td><td>'+esc(p.objects)+'</td><td>'+esc(p.bytes_used)+
+    '</td></tr>');
+  const fs=await j('fs');
+  document.getElementById('fs').textContent=
+    fs&&fs.filesystems?Object.values(fs.filesystems).map(
+      f=>f.name+' (max_mds '+f.max_mds+')').join(', ')||'none':
+      'none';
+  rows('#devices',await j('device'),d=>'<tr><td>'+esc(d.devid)+
+    '</td><td>'+esc(d.osd)+'</td><td>'+esc(d.life_expectancy)+
+    '</td></tr>');
+  rows('#orch',await j('orch'),s=>'<tr><td>'+esc(s.service_type)+
+    '</td><td>'+esc(s.count)+'</td><td>'+esc(s.running)+
+    '</td></tr>');
+  rows('#tasks',await j('rbd/task'),t=>'<tr><td>'+esc(t.id)+
+    '</td><td>'+esc(t.task)+'</td><td>'+esc(t.image)+'</td><td>'+
+    esc(t.status)+'</td></tr>');
+  rows('#log',await j('log'),e=>'<tr><td>'+
+    new Date(e.stamp*1000).toISOString()+' '+esc(e.text)+
+    '</td></tr>');
+}
+refresh();setInterval(refresh,3000);
+</script></body></html>"""

@@ -45,6 +45,7 @@ class DeviceHealthModule(MgrModule):
         # not interleave the config-key read-modify-write (lost
         # history entries, duplicated clog warnings)
         self._scrape_lock = threading.Lock()
+        self._verdicts: list[dict] = []
 
     # -- scraping ----------------------------------------------------------
     def _osd_asoks(self) -> dict[str, str]:
@@ -106,7 +107,13 @@ class DeviceHealthModule(MgrModule):
                                f"({r.get('osd')}): {verdict} "
                                f"({r.get('media_errors', 0)} media "
                                f"errors)"})
+        self._verdicts = out
         return out
+
+    def last_verdicts(self) -> list[dict]:
+        """Most recent check_health result — a side-effect-free read
+        for dashboards/pollers."""
+        return list(self._verdicts)
 
     # -- commands ----------------------------------------------------------
     def handle_command(self, cmd: dict):

@@ -296,6 +296,10 @@ class RGWStore:
     def create_bucket(self, bucket: str,
                       index_shards: int = DEFAULT_INDEX_SHARDS,
                       owner: str | None = None) -> bool:
+        if bucket == "swift":
+            # reserved: /swift/v1 is the Swift dialect mount; an S3
+            # bucket of that name would have its keys hijacked
+            return False
         if bucket.startswith("lc.") or bucket.startswith("policy."):
             # these namespaces share the buckets omap; a literal
             # "lc.x"/"policy.x" bucket would collide and poison the
@@ -444,6 +448,23 @@ class RGWStore:
         except ObjectNotFound:
             return False        # nothing registered yet
         return bucket in rows and not bucket.startswith(("lc.", "policy."))
+
+    def list_buckets_for(self, uid: str | None) -> list[str]:
+        """Account listing: only the caller's buckets (plus unowned
+        pre-auth buckets) — the reference's per-tenant listing; other
+        tenants' bucket NAMES must not leak."""
+        out = []
+        try:
+            rows = self.meta.omap_get(BUCKETS_OID)
+        except ObjectNotFound:
+            return []
+        for b, raw in rows.items():
+            if b.startswith(("lc.", "policy.")):
+                continue
+            owner = json.loads(bytes(raw)).get("owner")
+            if owner is None or owner == uid:
+                out.append(b)
+        return sorted(out)
 
     def list_buckets(self) -> list[str]:
         try:
@@ -974,7 +995,10 @@ class _Handler(BaseHTTPRequestHandler):
         """→ True when this request was a Swift/auth request and has
         been fully handled."""
         path = self.path.split("?", 1)[0]
-        if path == "/auth/v1.0":
+        if path == "/auth/v1.0" and "X-Auth-User" in self.headers:
+            # tempauth clients always send X-Auth-User; without it
+            # this is an S3 op on an object literally named v1.0 in a
+            # bucket named auth — let it through
             self._swift_auth()
             return True
         if path == "/swift/v1" or path.startswith("/swift/v1/"):
@@ -1023,7 +1047,10 @@ class _Handler(BaseHTTPRequestHandler):
                 # token — same bar as the S3 side's 403
                 return self._reply(401)
             if method == "GET":
-                names = "\n".join(self.store.list_buckets())
+                names = "\n".join(
+                    self.store.list_buckets_for(uid)
+                    if self.require_auth
+                    else self.store.list_buckets())
                 return self._reply(200, (names + "\n").encode()
                                    if names else b"",
                                    ctype="text/plain")
@@ -1217,8 +1244,10 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._check_auth(b""):
             return
         if bucket is None:
-            return self._reply(
-                200, _xml_list_buckets(self.store.list_buckets()))
+            names = (self.store.list_buckets_for(self._auth_uid)
+                     if self.require_auth
+                     else self.store.list_buckets())
+            return self._reply(200, _xml_list_buckets(names))
         if key is None:
             if not self.store.bucket_exists(bucket):
                 return self._reply(404)

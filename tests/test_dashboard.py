@@ -72,6 +72,29 @@ def test_html_page_and_404(dash):
     _, port = dash
     st, body = _get(port, "/")
     assert st == 200
-    assert b"Cluster status" in body and b"HEALTH_" in body
+    # the operational shell: panels + the polling script
+    for marker in (b"dashboard", b"OSDs", b"Pools", b"Cluster log",
+                   b"refresh()"):
+        assert marker in body, marker
     st, body = _get(port, "/api/nope")
     assert st == 404
+
+
+def test_operational_api_routes(dash):
+    _, port = dash
+    st, body = _get(port, "/api/osd/tree")
+    assert st == 200
+    st, body = _get(port, "/api/mon")
+    assert st == 200 and "quorum" in json.loads(body)
+    st, body = _get(port, "/api/mgr")
+    assert st == 200 and json.loads(body).get("active_name")
+    st, body = _get(port, "/api/fs")
+    assert st == 200
+    st, body = _get(port, "/api/log")
+    assert st == 200 and isinstance(json.loads(body), list)
+    st, body = _get(port, "/api/device")
+    assert st == 200
+    st, body = _get(port, "/api/rbd/task")
+    assert st == 200 and isinstance(json.loads(body), list)
+    st, body = _get(port, "/api/orch")
+    assert st == 200 and isinstance(json.loads(body), list)
