@@ -561,10 +561,102 @@ class OSDMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, f"set pool {name} {var} to {val}", None
+        if prefix == "osd tier add":
+            # reference OSDMonitor tier commands: attach `tierpool`
+            # as a cache tier of `pool`
+            base, tier = cmd.get("pool"), cmd.get("tierpool")
+            for n in (base, tier):
+                if n not in self.osdmap.pool_name:
+                    return -2, f"pool '{n}' does not exist", None
+            if base == tier:
+                return -22, "a pool cannot tier itself", None
+            m = self._working()
+            bp = m.pools[m.pool_name[base]]
+            tp = m.pools[m.pool_name[tier]]
+            if tp.tier_of >= 0:
+                return -22, f"'{tier}' is already a tier", None
+            if bp.tier_of >= 0 or tp.tiers:
+                return -22, "nested tiering is not supported", None
+            tp.tier_of = bp.id
+            bp.tiers = sorted(set(bp.tiers) | {tp.id})
+            bp.last_change = tp.last_change = m.epoch + 1
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"pool '{tier}' is now a tier of '{base}'", None
+        if prefix == "osd tier remove":
+            base, tier = cmd.get("pool"), cmd.get("tierpool")
+            for n in (base, tier):
+                if n not in self.osdmap.pool_name:
+                    return -2, f"pool '{n}' does not exist", None
+            m = self._working()
+            bp = m.pools[m.pool_name[base]]
+            tp = m.pools[m.pool_name[tier]]
+            if tp.tier_of != bp.id:
+                return -22, f"'{tier}' is not a tier of '{base}'", None
+            if bp.read_tier == tp.id or bp.write_tier == tp.id:
+                return -16, "remove the overlay first", None
+            tp.tier_of = -1
+            tp.cache_mode = "none"
+            bp.tiers = [t for t in bp.tiers if t != tp.id]
+            bp.last_change = tp.last_change = m.epoch + 1
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"pool '{tier}' removed as tier of '{base}'", \
+                None
+        if prefix == "osd tier cache-mode":
+            name, mode = cmd.get("pool"), cmd.get("mode")
+            if name not in self.osdmap.pool_name:
+                return -2, f"pool '{name}' does not exist", None
+            if mode not in ("none", "writeback"):
+                return -22, f"unsupported cache mode {mode!r}", None
+            m = self._working()
+            pool = m.pools[m.pool_name[name]]
+            if pool.tier_of < 0:
+                return -22, f"'{name}' is not a tier", None
+            pool.cache_mode = mode
+            pool.last_change = m.epoch + 1
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"set cache-mode of '{name}' to {mode}", None
+        if prefix == "osd tier set-overlay":
+            base, overlay = cmd.get("pool"), cmd.get("overlaypool")
+            for n in (base, overlay):
+                if n not in self.osdmap.pool_name:
+                    return -2, f"pool '{n}' does not exist", None
+            m = self._working()
+            bp = m.pools[m.pool_name[base]]
+            op_ = m.pools[m.pool_name[overlay]]
+            if op_.tier_of != bp.id:
+                return -22, f"'{overlay}' is not a tier of " \
+                            f"'{base}'", None
+            if op_.cache_mode == "none":
+                return -22, "set a cache-mode first", None
+            bp.read_tier = bp.write_tier = op_.id
+            bp.last_change = m.epoch + 1
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"overlay for '{base}' is now '{overlay}'", None
+        if prefix == "osd tier remove-overlay":
+            name = cmd.get("pool")
+            if name not in self.osdmap.pool_name:
+                return -2, f"pool '{name}' does not exist", None
+            m = self._working()
+            pool = m.pools[m.pool_name[name]]
+            pool.read_tier = pool.write_tier = -1
+            pool.last_change = m.epoch + 1
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, f"overlay for '{name}' removed", None
         if prefix == "osd pool delete":
             name = cmd["pool"]
             if name not in self.osdmap.pool_name:
                 return -2, f"pool '{name}' does not exist", None
+            cand = self.osdmap.pools[self.osdmap.pool_name[name]]
+            if cand.tier_of >= 0 or cand.tiers:
+                # unflushed writeback data / dangling tier refs
+                # (reference: EBUSY until tiers are torn down)
+                return -16, f"pool '{name}' participates in a tier " \
+                            "relationship; remove the tier first", None
             m = self._working()
             pid = m.pool_name.pop(name)
             m.pools.pop(pid)

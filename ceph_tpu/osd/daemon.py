@@ -113,6 +113,7 @@ class OSDaemon(Dispatcher):
         self._register_admin_commands()
         self.store = store if store is not None else MemStore(
             name=f"osd.{whoami}")
+        self.auth = auth
         self.msgr = Messenger(
             f"osd.{whoami}",
             **(auth.msgr_kwargs(f"osd.{whoami}") if auth else {}))
@@ -233,6 +234,76 @@ class OSDaemon(Dispatcher):
         self._tick_token = self.timer.add_event_after(
             self._hb_interval, self._tick)
 
+    # -- cache-tier agent --------------------------------------------------
+    def _tier_rados(self):
+        """Lazy internal client for tiering (reference: the OSD's own
+        Objecter drives promotes).  The entity name's `client.tier-`
+        prefix is the recursion guard the cache PGs check."""
+        # guarded: two concurrent promotes (or a promote racing
+        # shutdown) must not each connect a client and orphan one
+        with self.lock:
+            if not self.running:
+                raise ConnectionError("osd shutting down")
+            if getattr(self, "_tier_client", None) is None:
+                import uuid
+                from ..osdc.librados import Rados
+                self._tier_client = Rados(
+                    self.monmap,
+                    name=f"client.tier-osd{self.whoami}-"
+                         f"{uuid.uuid4().hex[:8]}",
+                    auth=self.auth).connect()
+            return self._tier_client
+
+    def tier_agent(self, pg, oid: str, base_pool_id: int,
+                   delete: bool = False):
+        """Background promote (copy base→cache) or base-delete for a
+        parked op; runs OFF the op worker so the agent's own client
+        ops (which come back through this OSD's queue) can't
+        deadlock.  Completion requeues the parked ops under the
+        daemon lock."""
+        import threading as _threading
+        from ..osdc.librados import ObjectNotFound
+
+        def run():
+            try:
+                r = self._tier_rados()
+                base_name = r.objecter.osdmap.pools[base_pool_id].name
+                base_io = r.open_ioctx_direct(base_name)
+                if delete:
+                    try:
+                        base_io.remove(oid)
+                    except ObjectNotFound:
+                        pass
+                else:
+                    cache_name = \
+                        r.objecter.osdmap.pools[pg.pool.id].name
+                    cache_io = r.open_ioctx_direct(cache_name)
+                    try:
+                        data = bytes(base_io.read(oid))
+                    except ObjectNotFound:
+                        data = None     # miss in base too: plain ENOENT
+                    if data is not None:
+                        cache_io.write_full(oid, data)
+                        try:
+                            for k, v in base_io.getxattrs(oid).items():
+                                cache_io.setxattr(oid, k, v)
+                        except Exception:   # noqa: BLE001 — optional
+                            pass
+                        try:
+                            rows = base_io.omap_get(oid)
+                            if rows:
+                                cache_io.omap_set(oid, rows)
+                        except Exception:   # noqa: BLE001 — optional
+                            pass
+            except Exception:   # noqa: BLE001 — a failed promote
+                pass            # releases the op; it runs as a miss
+            finally:
+                with self.lock:
+                    pg._promote_done(oid)
+
+        _threading.Thread(target=run, daemon=True,
+                          name=f"osd.{self.whoami}-tier").start()
+
     def _op_worker_loop(self):
         while True:
             got = self.op_queue.dequeue(timeout=1.0)
@@ -264,6 +335,13 @@ class OSDaemon(Dispatcher):
         self.op_queue.close()
         self.timer.shutdown()
         self.admin_socket.shutdown()
+        tier = getattr(self, "_tier_client", None)
+        if tier is not None:
+            try:
+                tier.shutdown()
+            except Exception:   # noqa: BLE001
+                pass
+            self._tier_client = None
         self.monc.shutdown()
         self.msgr.shutdown()
         self.store.umount()

@@ -100,6 +100,15 @@ class PGPool:
     quota_max_objects: int = 0
     quota_max_bytes: int = 0
     full: bool = False
+    # cache tiering (reference pg_pool_t tier fields): a cache pool
+    # has tier_of = base pool id; the BASE pool's read/write_tier
+    # point at the cache once the overlay is set, redirecting client
+    # ops there (the Objecter honors this like the reference).
+    tier_of: int = -1
+    read_tier: int = -1
+    write_tier: int = -1
+    cache_mode: str = "none"         # none | writeback
+    tiers: list = field(default_factory=list)
 
     def __post_init__(self):
         if self.pgp_num == 0:

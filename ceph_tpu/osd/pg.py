@@ -101,6 +101,7 @@ class PG:
         # notify back on activate); _resend_activation skips them
         self.peer_activated: set[int] = set()
         self.waiting_for_active: list = []
+        self._promote_waiters: dict[str, list] = {}
         self.waiting_for_object: dict[str, list] = {}
         self._queried: set[int] = set()
         # closed acting intervals, maintained by the daemon from the
@@ -801,6 +802,44 @@ class PG:
         e = self.daemon.osdmap.epoch
         return (e, self.info.last_update[1] + 1)
 
+    # -- cache tiering (reference PrimaryLogPG promote/agent paths) -------
+    def _maybe_promote(self, msg: M.MOSDOp) -> bool:
+        """Writeback cache-pool PGs promote on miss: an op on an
+        object absent locally but present in the base pool parks
+        while a background copy-up runs (the reference blocks the op
+        on a promote too).  DELETEs propagate to the base first so an
+        evicted cache can't resurrect them.  → True when parked."""
+        pool = self.pool
+        if pool is None or pool.tier_of < 0 or \
+                pool.cache_mode != "writeback":
+            return False
+        if str(msg.client).startswith("client.tier-"):
+            return False        # the agent's own ops must not recurse
+        if getattr(msg, "_tier_done", False):
+            return False        # agent already ran for this op
+        oid = msg.oid
+        is_delete = any(op.get("op") == "delete" for op in msg.ops)
+        if not is_delete and \
+                self.daemon.store.exists(self.cid, oid):
+            return False
+        waiters = self._promote_waiters.setdefault(oid, [])
+
+        def requeue():
+            msg._tier_done = True
+            self.do_op(msg)
+
+        waiters.append(requeue)
+        if len(waiters) > 1:
+            return True         # a promote is already in flight
+        self.daemon.tier_agent(self, oid, pool.tier_of,
+                               delete=is_delete)
+        return True
+
+    def _promote_done(self, oid: str):
+        """Agent callback (daemon lock held): release parked ops."""
+        for w in self._promote_waiters.pop(oid, []):
+            w()
+
     def do_op(self, msg: M.MOSDOp):
         if not self.is_primary:
             self._reply(msg, -11, "not primary")   # EAGAIN: client remaps
@@ -828,6 +867,8 @@ class PG:
             self.wait_for_object(oid, lambda: self.do_op(msg))
             self._kick_recovery()
             return
+        if self._maybe_promote(msg):
+            return      # parked; requeued when the promote lands
         watchish = [op.get("op") in ("watch", "unwatch", "notify")
                     for op in msg.ops]
         if any(watchish):
@@ -1354,6 +1395,14 @@ class ReplicatedBackend:
                 txn.truncate(cid, oid, size)
                 results.append({})
             elif kind == "delete":
+                want = op.get("if_version")
+                if want is not None and \
+                        list(self._object_version(oid)) != list(want):
+                    # the flush agent's guarded evict: the object
+                    # changed since it was read — do NOT discard the
+                    # newer write (reference assert_version semantics)
+                    raise ValueError(
+                        "if_version mismatch: object changed")
                 txn.remove(cid, oid)
                 delete = True
                 results.append({})

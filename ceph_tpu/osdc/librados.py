@@ -118,28 +118,98 @@ class Rados:
     def open_ioctx(self, pool_name: str) -> "IoCtx":
         return IoCtx(self, self.pool_lookup(pool_name), pool_name)
 
+    def open_ioctx_direct(self, pool_name: str) -> "IoCtx":
+        """IoCtx that bypasses cache-tier overlay redirects."""
+        return IoCtx(self, self.pool_lookup(pool_name), pool_name,
+                     direct=True)
+
     def mon_command(self, cmd: dict):
         return self.monc.command(cmd)
+
+    def cache_flush_evict_all(self, base_pool: str) -> int:
+        """Flush every cache-pool object back to `base_pool` and
+        evict it (reference ``rados cache-flush-evict-all``).  Runs
+        under a dedicated `client.tier-` agent identity so its
+        cache-pool deletes are not themselves tier-propagated, and
+        reaches the base pool directly (bypassing the overlay
+        redirect).  → objects flushed."""
+        import uuid
+        m = self.objecter.osdmap
+        if base_pool not in m.pool_name:
+            raise ObjectNotFound(-2, f"pool {base_pool!r}")
+        bp = m.pools[m.pool_name[base_pool]]
+        if bp.read_tier < 0 or bp.read_tier not in m.pools:
+            raise Error(-22, f"pool {base_pool!r} has no overlay")
+        cache_pool = m.pools[bp.read_tier].name
+        agent = Rados(
+            self.monmap,
+            name=f"client.tier-flush-{uuid.uuid4().hex[:8]}",
+            auth=self.auth).connect()
+        try:
+            cache_io = agent.open_ioctx_direct(cache_pool)
+            base_io = agent.open_ioctx_direct(base_pool)
+            n = 0
+            for oid in cache_io.list_objects():
+                try:
+                    # ONE compound op: the version and the bytes come
+                    # from the same serialized execution
+                    res, _ = cache_io._sync(oid, [
+                        {"op": "stat"}, {"op": "read"}])
+                except ObjectNotFound:
+                    continue    # raced a delete
+                ver = res[0].get("version")
+                data = bytes.fromhex(res[1].get("data", ""))
+                base_io.write_full(oid, data)
+                try:
+                    for k, v in cache_io.getxattrs(oid).items():
+                        base_io.setxattr(oid, k, v)
+                except Exception:   # noqa: BLE001 — optional
+                    pass
+                try:
+                    rows = cache_io.omap_get(oid)
+                    if rows:
+                        base_io.omap_set(oid, rows)
+                except Exception:   # noqa: BLE001 — optional
+                    pass
+                try:
+                    # guarded evict: refuse if a client write landed
+                    # after our read — that write must not be lost
+                    cache_io._sync(oid, [
+                        {"op": "delete", "if_version": ver}])
+                    n += 1
+                except Error as e:
+                    if "if_version" not in str(e):
+                        raise
+                    # changed underneath us: leave it dirty; the next
+                    # flush pass picks it up
+            return n
+        finally:
+            agent.shutdown()
 
 
 class IoCtx:
     """Per-pool I/O context (reference ``librados::IoCtx``)."""
 
-    def __init__(self, rados: Rados, pool_id: int, pool_name: str):
+    def __init__(self, rados: Rados, pool_id: int, pool_name: str,
+                 direct: bool = False):
         self.rados = rados
         self.pool_id = pool_id
         self.pool_name = pool_name
         self.objecter = rados.objecter
+        # direct: bypass the cache-tier overlay redirect (the flush/
+        # promote agents must reach the BASE pool itself)
+        self.direct = direct
 
     # -- async engine ------------------------------------------------------
     def _aio(self, oid: str, ops: list[dict]) -> Completion:
         c = Completion()
-        self.objecter.op_submit(self.pool_id, oid, ops, c._complete)
+        self.objecter.op_submit(self.pool_id, oid, ops, c._complete,
+                                direct=self.direct)
         return c
 
     def _sync(self, oid: str, ops: list[dict], timeout: float = 10.0):
         rc, outs, results, version = self.objecter.operate(
-            self.pool_id, oid, ops, timeout)
+            self.pool_id, oid, ops, timeout, direct=self.direct)
         _raise(rc, outs)
         return results, version
 

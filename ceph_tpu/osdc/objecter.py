@@ -29,9 +29,9 @@ from ..tools.osdmaptool import osdmap_from_dict
 
 class _Op:
     __slots__ = ("tid", "pool", "oid", "ops", "on_reply", "pgid",
-                 "target_osd", "attempts", "submitted")
+                 "target_osd", "attempts", "submitted", "direct")
 
-    def __init__(self, tid, pool, oid, ops, on_reply):
+    def __init__(self, tid, pool, oid, ops, on_reply, direct=False):
         self.tid = tid
         self.pool = pool
         self.oid = oid
@@ -41,6 +41,7 @@ class _Op:
         self.target_osd = -1
         self.attempts = 0
         self.submitted = time.monotonic()
+        self.direct = direct        # skip cache-tier overlay redirect
 
 
 class Objecter(Dispatcher):
@@ -94,7 +95,9 @@ class Objecter(Dispatcher):
                 for op in list(self.inflight.values()):
                     if now - op.submitted <= self._resend_interval:
                         continue
-                    pgid, primary = self._calc_target(op.pool, op.oid)
+                    pgid, primary = self._calc_target(
+                        self._effective_pool(op.pool, op.direct),
+                        op.oid)
                     moved = (pgid != op.pgid
                              or primary != op.target_osd)
                     if moved or self._idempotent(op):
@@ -131,7 +134,9 @@ class Objecter(Dispatcher):
                 if self._idempotent(op):
                     self._send_op(op)       # re-targets internally
                 else:
-                    pgid, primary = self._calc_target(op.pool, op.oid)
+                    pgid, primary = self._calc_target(
+                        self._effective_pool(op.pool, op.direct),
+                        op.oid)
                     if pgid != op.pgid or primary != op.target_osd:
                         self._send_op(op)
             for ev in self._map_waiters:
@@ -148,16 +153,32 @@ class Objecter(Dispatcher):
 
     # -- submission --------------------------------------------------------
     def op_submit(self, pool: int, oid: str, ops: list[dict],
-                  on_reply) -> int:
+                  on_reply, direct: bool = False) -> int:
         with self.lock:
             self._tid += 1
-            op = _Op(self._tid, pool, oid, list(ops), on_reply)
+            op = _Op(self._tid, pool, oid, list(ops), on_reply,
+                     direct=direct)
             self.inflight[op.tid] = op
             self._send_op(op)
             return op.tid
 
+    def _effective_pool(self, pool: int, direct: bool) -> int:
+        """Cache-tier overlay redirect (reference Objecter
+        _calc_target read_tier/write_tier handling): client ops on a
+        base pool with an overlay land on the cache pool.  Resolved
+        per send, so map-change resends re-honor it; `direct` (the
+        tiering agent / flush path) bypasses it."""
+        if direct:
+            return pool
+        p = self.osdmap.pools.get(pool)
+        if p is not None and p.read_tier >= 0 \
+                and p.read_tier in self.osdmap.pools:
+            return p.read_tier
+        return pool
+
     def _send_op(self, op: _Op):
-        pgid, primary = self._calc_target(op.pool, op.oid)
+        pgid, primary = self._calc_target(
+            self._effective_pool(op.pool, op.direct), op.oid)
         op.pgid, op.target_osd = pgid, primary
         op.attempts += 1
         if primary < 0:
@@ -256,7 +277,7 @@ class Objecter(Dispatcher):
 
     # -- sync convenience --------------------------------------------------
     def operate(self, pool: int, oid: str, ops: list[dict],
-                timeout: float = 10.0):
+                timeout: float = 10.0, direct: bool = False):
         """→ (rc, outs, results, version) with resend-until-timeout."""
         ev = threading.Event()
         box: list = []
@@ -265,7 +286,7 @@ class Objecter(Dispatcher):
             box.append((rc, outs, results, version))
             ev.set()
 
-        tid = self.op_submit(pool, oid, ops, on_reply)
+        tid = self.op_submit(pool, oid, ops, on_reply, direct=direct)
         if not ev.wait(timeout):
             with self.lock:
                 self.inflight.pop(tid, None)

@@ -142,6 +142,22 @@ def _dispatch(args, rest) -> int:
         elif rest[0] == "fs" and rest[1:2] == ["new"]:
             cmd = {"prefix": "fs new", "fs_name": rest[2],
                    "metadata": rest[3], "data": rest[4]}
+        elif rest[0] == "osd" and rest[1:2] == ["tier"]:
+            verb = rest[2]
+            if verb in ("add", "remove"):
+                cmd = {"prefix": f"osd tier {verb}",
+                       "pool": rest[3], "tierpool": rest[4]}
+            elif verb == "cache-mode":
+                cmd = {"prefix": "osd tier cache-mode",
+                       "pool": rest[3], "mode": rest[4]}
+            elif verb == "set-overlay":
+                cmd = {"prefix": "osd tier set-overlay",
+                       "pool": rest[3], "overlaypool": rest[4]}
+            elif verb == "remove-overlay":
+                cmd = {"prefix": "osd tier remove-overlay",
+                       "pool": rest[3]}
+            else:
+                raise ValueError(verb)
         elif rest[0] == "osd" and rest[1:2] == ["reweight"]:
             cmd = {"prefix": "osd reweight", "id": int(rest[2]),
                    "weight": float(rest[3])}

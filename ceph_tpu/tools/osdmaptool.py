@@ -54,6 +54,9 @@ def osdmap_to_dict(m: OSDMap) -> dict:
             "quota_max_objects": p.quota_max_objects,
             "quota_max_bytes": p.quota_max_bytes,
             "full": p.full,
+            "tier_of": p.tier_of, "read_tier": p.read_tier,
+            "write_tier": p.write_tier, "cache_mode": p.cache_mode,
+            "tiers": list(p.tiers),
         } for p in m.pools.values()],
         "pg_temp": {str(pg): osds for pg, osds in m.pg_temp.items()},
         "primary_temp": {str(pg): o for pg, o in m.primary_temp.items()},

@@ -178,6 +178,8 @@ def build_parser() -> argparse.ArgumentParser:
         x.add_argument("obj")
         x.add_argument("key")
         x.add_argument("value")
+    cf = sub.add_parser("cache-flush-evict-all")
+    cf.add_argument("base_pool")
     be = sub.add_parser("bench")
     be.add_argument("seconds", type=int)
     be.add_argument("mode", choices=["write", "seq", "rand"])
@@ -215,6 +217,10 @@ def main(argv=None) -> int:
         if args.cmd == "rmpool":
             r.delete_pool(args.name)
             print(f"successfully deleted pool {args.name}")
+            return 0
+        if args.cmd == "cache-flush-evict-all":
+            n = r.cache_flush_evict_all(args.base_pool)
+            print(f"flushed and evicted {n} objects")
             return 0
         if not args.pool:
             raise SystemExit("rados: -p POOL required")

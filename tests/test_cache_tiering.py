@@ -1,0 +1,256 @@
+"""Cache tiering (reference PrimaryLogPG promote/agent paths +
+OSDMonitor tier commands; the last VERDICT r3 missing row): a
+writeback cache pool in front of a base pool — client ops redirect to
+the cache via the overlay, misses promote from the base, deletes
+propagate, and cache-flush-evict-all writes everything back.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.osdc.librados import Error, ObjectNotFound
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def tiered():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    r = c.rados()
+    r.create_pool("base", pg_num=8, size=2)
+    r.create_pool("hot", pg_num=8, size=2)
+    c.wait_for_clean()
+    # seed the base BEFORE the overlay exists
+    io = r.open_ioctx("base")
+    for i in range(8):
+        io.write_full(f"cold{i}", f"cold-data-{i}".encode())
+    for rc_cmd in (
+        {"prefix": "osd tier add", "pool": "base",
+         "tierpool": "hot"},
+        {"prefix": "osd tier cache-mode", "pool": "hot",
+         "mode": "writeback"},
+        {"prefix": "osd tier set-overlay", "pool": "base",
+         "overlaypool": "hot"},
+    ):
+        rc, outs, _ = r.mon_command(rc_cmd)
+        assert rc == 0, outs
+    # clients must see the overlay before ops redirect
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        m = r.objecter.osdmap
+        bp = m.pools.get(m.pool_name.get("base"))
+        if bp is not None and bp.read_tier >= 0:
+            break
+        time.sleep(0.1)
+    yield c, r
+    c.stop()
+
+
+class TestTierCommands:
+    def test_tier_state_in_map(self, tiered):
+        _c, r = tiered
+        m = r.objecter.osdmap
+        bp = m.pools[m.pool_name["base"]]
+        hp = m.pools[m.pool_name["hot"]]
+        assert hp.tier_of == bp.id
+        assert bp.read_tier == hp.id and bp.write_tier == hp.id
+        assert hp.cache_mode == "writeback"
+        assert hp.id in bp.tiers
+
+    def test_bad_tier_commands(self, tiered):
+        _c, r = tiered
+        rc, _, _ = r.mon_command({
+            "prefix": "osd tier add", "pool": "base",
+            "tierpool": "hot"})
+        assert rc == -22                    # already a tier
+        rc, _, _ = r.mon_command({
+            "prefix": "osd tier remove", "pool": "base",
+            "tierpool": "hot"})
+        assert rc == -16                    # overlay still set
+        rc, _, _ = r.mon_command({
+            "prefix": "osd tier cache-mode", "pool": "base",
+            "mode": "writeback"})
+        assert rc == -22                    # base is not a tier
+        rc, _, _ = r.mon_command({
+            "prefix": "osd tier add", "pool": "ghost",
+            "tierpool": "hot"})
+        assert rc == -2
+
+
+class TestTieredIO:
+    def test_writes_land_in_cache(self, tiered):
+        c, r = tiered
+        io = r.open_ioctx("base")           # clients talk to base
+        io.write_full("hotobj", b"written-through-overlay")
+        assert bytes(io.read("hotobj")) == b"written-through-overlay"
+        # the bytes physically live in the CACHE pool, not the base
+        cache_io = r.open_ioctx_direct("hot")
+        base_io = r.open_ioctx_direct("base")
+        assert bytes(cache_io.read("hotobj")) == \
+            b"written-through-overlay"
+        with pytest.raises(ObjectNotFound):
+            base_io.read("hotobj")
+
+    def test_read_miss_promotes(self, tiered):
+        c, r = tiered
+        io = r.open_ioctx("base")
+        # cold0 was written pre-overlay: only in the base pool
+        assert bytes(io.read("cold0")) == b"cold-data-0"
+        # the miss promoted it into the cache
+        cache_io = r.open_ioctx_direct("hot")
+        deadline = time.monotonic() + 10
+        promoted = None
+        while time.monotonic() < deadline:
+            try:
+                promoted = bytes(cache_io.read("cold0"))
+                break
+            except ObjectNotFound:
+                time.sleep(0.1)
+        assert promoted == b"cold-data-0"
+
+    def test_partial_write_miss_promotes_first(self, tiered):
+        c, r = tiered
+        io = r.open_ioctx("base")
+        io.write(f"cold1", b"HOT", 0)      # partial write on a miss
+        assert bytes(io.read("cold1")) == b"HOT" + b"d-data-1"
+
+    def test_delete_propagates_to_base(self, tiered):
+        c, r = tiered
+        io = r.open_ioctx("base")
+        assert bytes(io.read("cold2")) == b"cold-data-2"  # promote
+        io.remove("cold2")
+        with pytest.raises(ObjectNotFound):
+            io.read("cold2")
+        # gone from the BASE too — an evict must not resurrect it
+        base_io = r.open_ioctx_direct("base")
+        with pytest.raises(ObjectNotFound):
+            base_io.read("cold2")
+
+    def test_flush_evict_all(self, tiered):
+        c, r = tiered
+        io = r.open_ioctx("base")
+        io.write_full("dirty1", b"must-reach-base-1")
+        io.write_full("dirty2", b"must-reach-base-2")
+        n = r.cache_flush_evict_all("base")
+        assert n >= 2
+        base_io = r.open_ioctx_direct("base")
+        assert bytes(base_io.read("dirty1")) == b"must-reach-base-1"
+        assert bytes(base_io.read("dirty2")) == b"must-reach-base-2"
+        # evicted from the cache (checked via listing — a READ of the
+        # cache pool would itself promote-on-miss, which is correct
+        # tier behavior)
+        cache_io = r.open_ioctx_direct("hot")
+        assert "dirty1" not in cache_io.list_objects()
+        assert "dirty2" not in cache_io.list_objects()
+        # reads still work (promote-on-miss brings them back)
+        assert bytes(io.read("dirty1")) == b"must-reach-base-1"
+
+    def test_flush_requires_overlay(self, tiered):
+        c, r = tiered
+        with pytest.raises(Error):
+            r.cache_flush_evict_all("hot")   # not an overlaid pool
+
+    def test_overlay_teardown(self, tiered):
+        c, r = tiered
+        # flush, drop the overlay, detach — base serves directly again
+        r.cache_flush_evict_all("base")
+        rc, outs, _ = r.mon_command({
+            "prefix": "osd tier remove-overlay", "pool": "base"})
+        assert rc == 0, outs
+        rc, outs, _ = r.mon_command({
+            "prefix": "osd tier remove", "pool": "base",
+            "tierpool": "hot"})
+        assert rc == 0, outs
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            m = r.objecter.osdmap
+            bp = m.pools[m.pool_name["base"]]
+            if bp.read_tier < 0:
+                break
+            time.sleep(0.1)
+        io = r.open_ioctx("base")
+        assert bytes(io.read("dirty1")) == b"must-reach-base-1"
+        io.write_full("post-tier", b"direct-again")
+        base_io = r.open_ioctx_direct("base")
+        assert bytes(base_io.read("post-tier")) == b"direct-again"
+
+
+class TestReviewRegressions:
+    def test_pool_delete_refused_while_tiered(self, tiered):
+        """Deleting either side of a LIVE tier relationship is EBUSY
+        (unflushed writeback data / dangling refs)."""
+        c, r = tiered
+        r.create_pool("b2", pg_num=4, size=2)
+        r.create_pool("h2", pg_num=4, size=2)
+        assert r.mon_command({"prefix": "osd tier add", "pool": "b2",
+                              "tierpool": "h2"})[0] == 0
+        assert r.mon_command({"prefix": "osd pool delete",
+                              "pool": "h2"})[0] == -16
+        assert r.mon_command({"prefix": "osd pool delete",
+                              "pool": "b2"})[0] == -16
+        assert r.mon_command({"prefix": "osd tier remove",
+                              "pool": "b2",
+                              "tierpool": "h2"})[0] == 0
+        assert r.mon_command({"prefix": "osd pool delete",
+                              "pool": "h2"})[0] == 0
+
+    def test_self_tier_rejected(self, tiered):
+        c, r = tiered
+        r.create_pool("selfy", pg_num=4, size=2)
+        rc, outs, _ = r.mon_command({
+            "prefix": "osd tier add", "pool": "selfy",
+            "tierpool": "selfy"})
+        assert rc == -22 and "itself" in outs
+
+    def test_guarded_delete_refuses_stale_version(self, tiered):
+        """The flush agent's evict guard: a delete with a stale
+        if_version must fail instead of discarding a newer write."""
+        c, r = tiered
+        io = r.open_ioctx("base")
+        io.write_full("guarded", b"v1")
+        res, _ = io._sync("guarded", [{"op": "stat"},
+                                      {"op": "read"}])
+        old_ver = res[0]["version"]
+        io.write_full("guarded", b"v2-newer")     # bump the version
+        with pytest.raises(Error, match="if_version"):
+            io._sync("guarded", [{"op": "delete",
+                                  "if_version": old_ver}])
+        assert bytes(io.read("guarded")) == b"v2-newer"
+
+    def test_tiering_on_secure_cluster(self):
+        """The OSD's internal tier agent must authenticate like any
+        other client: promote-on-miss works under ClusterAuth."""
+        c = MiniCluster(n_mons=1, n_osds=3, secure=True)
+        try:
+            c.start()
+            r = c.rados()
+            r.create_pool("sb", pg_num=4, size=2)
+            r.create_pool("sh", pg_num=4, size=2)
+            c.wait_for_clean()
+            io = r.open_ioctx("sb")
+            io.write_full("pre", b"sealed-cold-data")
+            for cmd in (
+                {"prefix": "osd tier add", "pool": "sb",
+                 "tierpool": "sh"},
+                {"prefix": "osd tier cache-mode", "pool": "sh",
+                 "mode": "writeback"},
+                {"prefix": "osd tier set-overlay", "pool": "sb",
+                 "overlaypool": "sh"},
+            ):
+                rc, outs, _ = r.mon_command(cmd)
+                assert rc == 0, outs
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                m = r.objecter.osdmap
+                bp = m.pools.get(m.pool_name.get("sb"))
+                if bp is not None and bp.read_tier >= 0:
+                    break
+                time.sleep(0.1)
+            # a miss through the overlay promotes via the agent's
+            # AUTHENTICATED internal client
+            assert bytes(io.read("pre")) == b"sealed-cold-data"
+            io.write_full("hot", b"to-cache")
+            assert r.cache_flush_evict_all("sb") >= 1
+        finally:
+            c.stop()
