@@ -45,7 +45,9 @@ class DeviceHealthModule(MgrModule):
         # not interleave the config-key read-modify-write (lost
         # history entries, duplicated clog warnings)
         self._scrape_lock = threading.Lock()
-        self._verdicts: list[dict] = []
+        # None = never scraped; [] is a valid "no devices" result and
+        # must not make every 'device ls' poll re-scrape
+        self._verdicts: list[dict] | None = None
 
     # -- scraping ----------------------------------------------------------
     def _osd_asoks(self) -> dict[str, str]:
@@ -113,13 +115,18 @@ class DeviceHealthModule(MgrModule):
     def last_verdicts(self) -> list[dict]:
         """Most recent check_health result — a side-effect-free read
         for dashboards/pollers."""
-        return list(self._verdicts)
+        return list(self._verdicts or [])
 
     # -- commands ----------------------------------------------------------
     def handle_command(self, cmd: dict):
         prefix = cmd.get("prefix", "")
         if prefix == "device ls":
-            return 0, "", self.check_health()
+            # inventory is a read: serve the last verdicts (scrape
+            # only before the first scrape ever) so dashboard polls
+            # don't re-scrape every OSD and duplicate clog warnings
+            if self._verdicts is None:
+                return 0, "", self.check_health()
+            return 0, "", self.last_verdicts()
         if prefix == "device check-health":
             bad = [d for d in self.check_health()
                    if d["life_expectancy"] != "good"]

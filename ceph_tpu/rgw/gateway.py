@@ -53,6 +53,11 @@ USERS_OID = "users"              # omap: uid → user json, ak\0<key> → uid
 
 
 DEFAULT_INDEX_SHARDS = 16       # reference rgw_override_bucket_index_max_shards
+# dedicated ?policy subresource actions (reference rgw_iam_policy
+# s3:{Get,Put,Delete}BucketPolicy): never satisfied by s3:* grants
+POLICY_ACTIONS = frozenset({
+    "s3:GetBucketPolicy", "s3:PutBucketPolicy",
+    "s3:DeleteBucketPolicy"})
 
 
 def _index_oid(bucket: str) -> str:
@@ -314,13 +319,20 @@ class RGWStore:
             bucket: json.dumps(row).encode()})
         return True
 
-    def bucket_owner(self, bucket: str) -> str | None:
+    def _bucket_row(self, bucket: str) -> dict | None:
+        """The bucket's meta row, or None when the bucket does not
+        exist — one single-key omap read (bucket_exists() fetches the
+        whole omap; the per-request auth path must not)."""
         try:
             raw = self.meta.omap_get(BUCKETS_OID,
                                      keys=[bucket]).get(bucket)
         except ObjectNotFound:
             return None
-        return json.loads(bytes(raw)).get("owner") if raw else None
+        return json.loads(bytes(raw)) if raw else None
+
+    def bucket_owner(self, bucket: str) -> str | None:
+        row = self._bucket_row(bucket)
+        return row.get("owner") if row else None
 
     # -- bucket policies (reference rgw IAM-ish policies) ------------------
     def set_bucket_policy(self, bucket: str, policy: dict):
@@ -338,22 +350,42 @@ class RGWStore:
     def delete_bucket_policy(self, bucket: str):
         self.meta.omap_rm_keys(BUCKETS_OID, [f"policy.{bucket}"])
 
+    def _set_bucket_owner(self, bucket: str, owner: str):
+        # under _lock, re-reading the row first: a concurrent
+        # delete_bucket (also under _lock) must not have its row
+        # resurrected by this read-modify-write
+        with self._lock:
+            row = self._bucket_row(bucket)
+            if row is None:
+                return
+            row["owner"] = owner
+            self.meta.omap_set(BUCKETS_OID, {
+                bucket: json.dumps(row).encode()})
+
     def authorize(self, uid: str | None, action: str, bucket: str,
                   key: str = "") -> bool:
         """IAM-style decision (reference rgw_iam_policy evaluation,
-        reduced): the bucket owner (or, for pre-auth buckets with no
-        recorded owner, any authenticated user) may do everything;
-        otherwise the bucket policy's Allow statements decide —
-        Principal "*" or a listed uid, Action exact or "s3:*",
-        Resource the bucket arn or bucket/*."""
-        owner = self.bucket_owner(bucket)
+        reduced): the bucket owner may do everything; otherwise the
+        bucket policy's Allow statements decide — Principal "*" or a
+        listed uid, Action exact or "s3:*" (the dedicated
+        *BucketPolicy actions require an exact grant), Resource "*",
+        the bare bucket arn for bucket-level requests, or
+        arn/key / arn/* for object-level requests.
+
+        Buckets with no recorded owner (created pre-auth or via an
+        untokened Swift path) are claimed by the first authenticated
+        caller rather than staying world-writable."""
+        row = self._bucket_row(bucket)
+        owner = row.get("owner") if row else None
+        if uid is not None and owner is None and row is not None:
+            self._set_bucket_owner(bucket, uid)
+            owner = uid
         if uid is not None and (owner is None or owner == uid):
             return True
         policy = self.get_bucket_policy(bucket)
         if not policy:
             return False
         arn_bucket = f"arn:aws:s3:::{bucket}"
-        arn_obj = f"{arn_bucket}/{key}" if key else arn_bucket
         for st in policy.get("Statement", []):
             if st.get("Effect") != "Allow":
                 continue
@@ -368,15 +400,30 @@ class RGWStore:
             actions = st.get("Action", [])
             actions = ([actions] if isinstance(actions, str)
                        else actions)
-            if action not in actions and "s3:*" not in actions:
+            if action in POLICY_ACTIONS:
+                # reading/rewriting the policy itself is never
+                # implied by s3:* — an object-scope grantee must not
+                # be able to escalate to policy control
+                if action not in actions:
+                    continue
+            elif action not in actions and "s3:*" not in actions:
                 continue
             resources = st.get("Resource", [])
             resources = ([resources] if isinstance(resources, str)
                          else resources)
             for res in resources:
-                if res in ("*", arn_obj) or res == f"{arn_bucket}/*":
+                if res == "*":
                     return True
-                if res == arn_bucket and not key:
+                if key:
+                    # object-level request: bucket-only ARNs do not
+                    # match, and bucket/* matches objects only
+                    if res in (f"{arn_bucket}/{key}",
+                               f"{arn_bucket}/*"):
+                        return True
+                elif res == arn_bucket:
+                    # bucket-level request: requires the bare bucket
+                    # ARN — bucket/* grants object access only (AWS
+                    # semantics; advisor r4 privilege-escalation fix)
                     return True
         return False
 
@@ -432,9 +479,10 @@ class RGWStore:
         # (list_objects raises on cluster outage, so an unreachable
         # index can never masquerade as an empty bucket here)
         oids = self._all_index_oids(bucket)
-        self.meta.omap_rm_keys(BUCKETS_OID,
-                               [bucket, f"lc.{bucket}",
-                                f"policy.{bucket}"])
+        with self._lock:       # excludes _set_bucket_owner's RMW
+            self.meta.omap_rm_keys(BUCKETS_OID,
+                                   [bucket, f"lc.{bucket}",
+                                    f"policy.{bucket}"])
         for oid in {*oids, _index_oid(bucket)}:
             try:
                 self.meta.remove(oid)
@@ -881,13 +929,23 @@ def _xml_list_buckets(names: list[str]) -> bytes:
 class _Handler(BaseHTTPRequestHandler):
     store: RGWStore = None      # set by RGWService
     require_auth = False        # set by RGWService(require_auth=True)
+    allow_unsigned_payload = False   # opt-in; see sigv4.verify
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *a):   # quiet
         pass
 
     @staticmethod
-    def _action_of(method: str, key: str | None) -> str:
+    def _action_of(method: str, key: str | None,
+                   query: dict | None = None) -> str:
+        if not key and query is not None and "policy" in query:
+            # the ?policy subresource has dedicated IAM actions —
+            # authorizing it as List/Create/DeleteBucket let any
+            # s3:ListBucket grantee read the principal list
+            return {"GET": "s3:GetBucketPolicy",
+                    "PUT": "s3:PutBucketPolicy",
+                    "DELETE": "s3:DeleteBucketPolicy"}.get(
+                        method, "s3:Unknown")
         if key:
             return {"GET": "s3:GetObject", "HEAD": "s3:GetObject",
                     "PUT": "s3:PutObject", "POST": "s3:PutObject",
@@ -932,7 +990,8 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 ak = sigv4.verify(
                     self.command, path, self._query(), hdrs, body,
-                    lookup)
+                    lookup,
+                    allow_unsigned_payload=self.allow_unsigned_payload)
             except sigv4.SigError as e:
                 return self._deny(str(e))
             self._auth_uid = resolved[ak][0]
@@ -943,7 +1002,7 @@ class _Handler(BaseHTTPRequestHandler):
             if self._auth_uid is None:
                 return self._deny("authentication required")
             return True
-        action = self._action_of(self.command, key)
+        action = self._action_of(self.command, key, self._query())
         if not self.store.authorize(self._auth_uid, action, bucket,
                                     key or ""):
             return self._deny(
@@ -1344,10 +1403,12 @@ class RGWService:
     LC_INTERVAL = 5.0
 
     def __init__(self, rados, host: str = "127.0.0.1", port: int = 0,
-                 require_auth: bool = False):
+                 require_auth: bool = False,
+                 allow_unsigned_payload: bool = False):
         self.store = RGWStore(rados)
         handler = type("Handler", (_Handler,), {
-            "store": self.store, "require_auth": require_auth})
+            "store": self.store, "require_auth": require_auth,
+            "allow_unsigned_payload": allow_unsigned_payload})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(

@@ -96,7 +96,8 @@ def sign(method: str, path: str, query: dict[str, str],
 
 def verify(method: str, path: str, query: dict[str, str],
            headers: dict[str, str], body: bytes,
-           secret_for_access_key, now: float | None = None) -> str:
+           secret_for_access_key, now: float | None = None,
+           allow_unsigned_payload: bool = False) -> str:
     """Authenticate one request → the access key id that signed it.
 
     `secret_for_access_key(ak)` → secret string or None (unknown).
@@ -133,8 +134,14 @@ def verify(method: str, path: str, query: dict[str, str],
     if abs(wall - ts) > MAX_SKEW_S:
         raise SigError("request time skew too large")
     payload_hash = hdrs.get("x-amz-content-sha256", "")
-    if payload_hash != UNSIGNED and \
-            payload_hash != hashlib.sha256(body).hexdigest():
+    if payload_hash == UNSIGNED:
+        # with the payload unhashed, a captured signature authorizes
+        # an arbitrary replacement body for the whole skew window and
+        # there is no TLS layer here to compensate; no in-repo client
+        # sends it, so it is rejected unless explicitly opted in
+        if not allow_unsigned_payload:
+            raise SigError("UNSIGNED-PAYLOAD not permitted")
+    elif payload_hash != hashlib.sha256(body).hexdigest():
         raise SigError("payload hash mismatch")
     secret = secret_for_access_key(access_key)
     if secret is None:

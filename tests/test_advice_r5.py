@@ -1,0 +1,271 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4):
+
+1. medium — a bucket/* object-scope policy grant must not authorize
+   bucket-level requests (policy rewrite / bucket delete escalation).
+2. low — the ?policy subresource has dedicated *BucketPolicy actions
+   that s3:* and s3:ListBucket grants do not imply.
+3. low — ownerless (pre-auth) buckets are claimed by the first
+   authenticated caller instead of staying world-writable.
+4. low — SigV4 rejects UNSIGNED-PAYLOAD unless explicitly opted in.
+5. low — 'device ls' serves cached verdicts; it does not re-scrape
+   and re-warn on every poll.
+"""
+
+import hashlib
+import hmac
+import json
+import time
+
+import pytest
+
+from ceph_tpu.rgw import RGWService, S3Client
+from ceph_tpu.rgw import sigv4
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3)
+    c.start()
+    r = c.rados()
+    yield c, r
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def authed(cluster):
+    _c, r = cluster
+    gw = RGWService(r, require_auth=True).start()
+    alice = gw.store.create_user("alice")
+    bob = gw.store.create_user("bob")
+    yield gw, alice, bob
+    gw.shutdown()
+
+
+def _client(gw, creds):
+    return S3Client("127.0.0.1", gw.port,
+                    access_key=creds["access_key"],
+                    secret_key=creds["secret_key"])
+
+
+class TestPolicyEscalation:
+    def test_object_grant_cannot_touch_bucket_or_policy(self, authed):
+        """ADVICE r4 medium: Action s3:*, Resource bucket/* gave a
+        grantee bucket-level powers (policy rewrite, bucket delete)
+        because key=="" made the object arn equal the bucket arn."""
+        gw, alice, bob = authed
+        s3a, s3b = _client(gw, alice), _client(gw, bob)
+        assert s3a.make_bucket("esc") == 200
+        s3a.put("esc", "doc", b"v1")
+        s3a._req("PUT", "/esc?policy", body=json.dumps({
+            "Statement": [{"Effect": "Allow",
+                           "Principal": {"AWS": "bob"},
+                           "Action": "s3:*",
+                           "Resource": "arn:aws:s3:::esc/*"}],
+        }).encode())
+        # the object scope works...
+        assert s3b.get("esc", "doc") == (200, b"v1")
+        assert s3b.put("esc", "doc2", b"bob")[0] == 200
+        # ...but nothing bucket-level does
+        assert s3b.list("esc")[0] == 403
+        assert s3b.delete("esc") == 403
+        evil = {"Statement": [{"Effect": "Allow", "Principal": "*",
+                               "Action": "s3:*", "Resource": "*"}]}
+        st, _, _ = s3b._req("PUT", "/esc?policy",
+                            body=json.dumps(evil).encode())
+        assert st == 403
+        st, _, _ = s3b._req("GET", "/esc?policy")
+        assert st == 403
+        st, _, _ = s3b._req("DELETE", "/esc?policy")
+        assert st == 403
+        # owner still intact and in control
+        assert gw.store.bucket_owner("esc") == "alice"
+        assert s3a._req("GET", "/esc?policy")[0] == 200
+
+    def test_bucket_level_needs_bare_bucket_arn(self, authed):
+        gw, alice, bob = authed
+        s3a, s3b = _client(gw, alice), _client(gw, bob)
+        assert s3a.make_bucket("lvl") == 200
+        s3a.put("lvl", "k", b"v")
+        s3a._req("PUT", "/lvl?policy", body=json.dumps({
+            "Statement": [{"Effect": "Allow",
+                           "Principal": {"AWS": "bob"},
+                           "Action": "s3:ListBucket",
+                           "Resource": "arn:aws:s3:::lvl"}],
+        }).encode())
+        # bare bucket arn grants the bucket-level action...
+        assert s3b.list("lvl")[0] == 200
+        # ...and nothing object-level
+        assert s3b.get("lvl", "k")[0] == 403
+
+    def test_star_action_does_not_imply_policy_actions(self, authed):
+        """ADVICE r4 low: ?policy must require its dedicated actions;
+        s3:* on every resource shape still must not leak the policy
+        (its principal list) to a non-owner."""
+        gw, alice, bob = authed
+        s3a, s3b = _client(gw, alice), _client(gw, bob)
+        assert s3a.make_bucket("polb") == 200
+        s3a._req("PUT", "/polb?policy", body=json.dumps({
+            "Statement": [{"Effect": "Allow",
+                           "Principal": {"AWS": "bob"},
+                           "Action": "s3:*",
+                           "Resource": ["arn:aws:s3:::polb",
+                                        "arn:aws:s3:::polb/*"]}],
+        }).encode())
+        assert s3b.list("polb")[0] == 200          # s3:* still works
+        assert s3b._req("GET", "/polb?policy")[0] == 403
+        assert s3b._req("PUT", "/polb?policy",
+                        body=b"{}")[0] == 403
+        assert s3b._req("DELETE", "/polb?policy")[0] == 403
+        # an explicit dedicated grant does work
+        s3a._req("PUT", "/polb?policy", body=json.dumps({
+            "Statement": [{"Effect": "Allow",
+                           "Principal": {"AWS": "bob"},
+                           "Action": "s3:GetBucketPolicy",
+                           "Resource": "arn:aws:s3:::polb"}],
+        }).encode())
+        st, _, got = s3b._req("GET", "/polb?policy")
+        assert st == 200 and "GetBucketPolicy" in got.decode()
+
+
+class TestOwnerlessBackfill:
+    def test_first_authenticated_access_claims_bucket(self, authed):
+        """ADVICE r4 low: a bucket created with no owner (pre-auth /
+        untokened Swift) was writable and deletable by every tenant
+        forever.  Now the first authenticated caller claims it."""
+        gw, alice, bob = authed
+        assert gw.store.create_bucket("legacy") is True
+        assert gw.store.bucket_owner("legacy") is None
+        s3a, s3b = _client(gw, alice), _client(gw, bob)
+        assert s3a.put("legacy", "k", b"v")[0] == 200
+        assert gw.store.bucket_owner("legacy") == "alice"
+        # bob no longer gets a free pass
+        assert s3b.get("legacy", "k")[0] == 403
+        assert s3b.delete("legacy") == 403
+        assert s3a.get("legacy", "k") == (200, b"v")
+
+
+class TestUnsignedPayload:
+    @staticmethod
+    def _sign_unsigned(method, path, headers, access_key, secret,
+                       now):
+        """A SigV4 signature whose canonical request declares
+        UNSIGNED-PAYLOAD (what the in-repo signer never does)."""
+        t = time.gmtime(now)
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", t)
+        date = amz_date[:8]
+        hdrs = {k.lower(): v for k, v in headers.items()}
+        hdrs["x-amz-date"] = amz_date
+        hdrs["x-amz-content-sha256"] = sigv4.UNSIGNED
+        signed = sorted({"host", "x-amz-date",
+                         "x-amz-content-sha256"})
+        scope = f"{date}/{sigv4.REGION}/{sigv4.SERVICE}/aws4_request"
+        canonical = sigv4._canonical_request(
+            method, path, {}, hdrs, signed, sigv4.UNSIGNED)
+        sts = sigv4._string_to_sign(amz_date, scope, canonical)
+        sig = hmac.new(sigv4._signing_key(secret, date),
+                       sts.encode(), hashlib.sha256).hexdigest()
+        hdrs["authorization"] = (
+            f"{sigv4.ALGORITHM} Credential={access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+        return hdrs
+
+    def test_rejected_by_default_allowed_by_optin(self):
+        now = time.time()
+        hdrs = self._sign_unsigned("PUT", "/b/k", {"host": "h"},
+                                   "AK", "sk", now)
+        lookup = {"AK": "sk"}.get
+        with pytest.raises(sigv4.SigError, match="UNSIGNED-PAYLOAD"):
+            sigv4.verify("PUT", "/b/k", {}, hdrs, b"captured-body",
+                         lookup, now=now)
+        # opted in, the signature verifies — and demonstrably covers
+        # ANY body, which is exactly why the default must reject it
+        for body in (b"captured-body", b"attacker-swapped-body"):
+            ak = sigv4.verify("PUT", "/b/k", {}, hdrs, body, lookup,
+                              now=now, allow_unsigned_payload=True)
+            assert ak == "AK"
+
+    def test_signed_payload_still_bound_to_body(self):
+        now = time.time()
+        hdrs = dict(sigv4.sign("PUT", "/b/k", {}, {"host": "h"},
+                               b"real", "AK", "sk", now=now),
+                    host="h")
+        lookup = {"AK": "sk"}.get
+        assert sigv4.verify("PUT", "/b/k", {}, hdrs, b"real",
+                            lookup, now=now) == "AK"
+        with pytest.raises(sigv4.SigError, match="payload hash"):
+            sigv4.verify("PUT", "/b/k", {}, hdrs, b"tampered",
+                         lookup, now=now)
+
+
+class TestDeviceLsSideEffects:
+    def test_device_ls_serves_cache_without_rescrape(self):
+        """ADVICE r4 low: 'device ls' invoked check_health() — every
+        dashboard poll scraped all OSDs and re-emitted clog
+        warnings."""
+        from ceph_tpu.mgr.devicehealth import DeviceHealthModule
+
+        class _Ctx:
+            def __init__(self):
+                class _D:
+                    asok_paths = {}
+                self._d = _D()
+                self.mon_cmds = []
+
+            def mon_command(self, cmd):
+                self.mon_cmds.append(cmd)
+                return 0, "", ""
+
+        ctx = _Ctx()
+        mod = DeviceHealthModule(ctx)
+        scrapes = []
+        verdict = [{"devid": "SYNTH-osd0", "osd": "osd.0",
+                    "life_expectancy": "warning",
+                    "media_errors": 42}]
+
+        def fake_check():
+            scrapes.append(1)
+            mod._verdicts = list(verdict)
+            return list(verdict)
+
+        mod.check_health = fake_check
+        # first ls with an empty cache scrapes once
+        rc, _, out = mod.handle_command({"prefix": "device ls"})
+        assert rc == 0 and out == verdict and len(scrapes) == 1
+        # subsequent polls serve the cache — no new scrape
+        for _ in range(5):
+            rc, _, out = mod.handle_command({"prefix": "device ls"})
+            assert rc == 0 and out == verdict
+        assert len(scrapes) == 1
+        # the explicit command still scrapes
+        rc, _, _ = mod.handle_command(
+            {"prefix": "device check-health"})
+        assert rc == 0 and len(scrapes) == 2
+
+    def test_empty_inventory_does_not_rescrape_every_poll(self):
+        """[] (no devices) is a valid cached result, distinct from
+        'never scraped' — review r5: the empty-list fallback would
+        have re-scraped on every poll of a deviceless cluster."""
+        from ceph_tpu.mgr.devicehealth import DeviceHealthModule
+
+        class _Ctx:
+            class _D:
+                asok_paths = {}
+            _d = _D()
+
+            def mon_command(self, cmd):
+                return 0, "", ""
+
+        mod = DeviceHealthModule(_Ctx())
+        scrapes = []
+
+        def fake_check():
+            scrapes.append(1)
+            mod._verdicts = []
+            return []
+
+        mod.check_health = fake_check
+        for _ in range(4):
+            rc, _, out = mod.handle_command({"prefix": "device ls"})
+            assert rc == 0 and out == []
+        assert len(scrapes) == 1
