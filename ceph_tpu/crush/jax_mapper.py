@@ -147,9 +147,14 @@ class BatchMapper:
         import jax
 
         if not jax.config.jax_enable_x64:
+            # straw2 draws are 64-bit fixed point.  Entry points
+            # (CLIs, balancer, bench) opt in via utils.ensure_x64();
+            # flipping the process-global flag from inside a library
+            # constructor would silently change dtype semantics for
+            # the whole embedding process
             raise RuntimeError(
-                "BatchMapper needs 64-bit ints: set JAX_ENABLE_X64=1 or "
-                "jax.config.update('jax_enable_x64', True)")
+                "BatchMapper needs 64-bit ints: call "
+                "ceph_tpu.utils.ensure_x64() (or set JAX_ENABLE_X64=1)")
         if isinstance(rule, int):
             rule = cmap.rule_by_id(rule)
         self.cmap = cmap

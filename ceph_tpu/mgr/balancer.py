@@ -27,7 +27,13 @@ from ..osd.osdmap import OSDMap, PGid
 
 
 class UpmapBalancer:
-    def __init__(self, osdmap: OSDMap, pool_id: int):
+    def __init__(self, osdmap: OSDMap, pool_id: int,
+                 use_jax: bool = True, require_batched: bool = False):
+        from ..utils.platform import ensure_x64
+        if use_jax:
+            ensure_x64()        # BatchMapper needs 64-bit straw2 draws
+        self.use_jax = use_jax
+        self.require_batched = require_batched
         self.m = osdmap
         self.pool = osdmap.pools[pool_id]
         self.rule = osdmap.crush.rule_by_id(self.pool.crush_rule)
@@ -67,7 +73,8 @@ class UpmapBalancer:
     # -- placement snapshot ------------------------------------------------
     def _placements(self) -> dict[PGid, list[int]]:
         from ..tools.osdmaptool import map_pool_pgs
-        raw = map_pool_pgs(self.m, self.pool)
+        raw = map_pool_pgs(self.m, self.pool, use_jax=self.use_jax,
+                           require_batched=self.require_batched)
         place: dict[PGid, list[int]] = {}
         for seed in range(self.pool.pg_num):
             pgid = PGid(self.pool.id, seed)

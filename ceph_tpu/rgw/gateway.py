@@ -383,23 +383,30 @@ class RGWStore:
         if uid is not None and (owner is None or owner == uid):
             return True
         policy = self.get_bucket_policy(bucket)
-        if not policy:
+        if not isinstance(policy, dict):
+            return False
+        statements = policy.get("Statement", [])
+        if not isinstance(statements, list):
             return False
         arn_bucket = f"arn:aws:s3:::{bucket}"
-        for st in policy.get("Statement", []):
-            if st.get("Effect") != "Allow":
+        for st in statements:
+            # stored policies are validated at PUT time, but older or
+            # directly-written rows must fail closed, not 500
+            if not isinstance(st, dict) or st.get("Effect") != "Allow":
                 continue
             principal = st.get("Principal", {})
             allowed = principal in ("*", {"AWS": "*"})
             if not allowed and isinstance(principal, dict):
                 aws = principal.get("AWS", [])
-                aws = [aws] if isinstance(aws, str) else aws
+                aws = ([aws] if isinstance(aws, str)
+                       else aws if isinstance(aws, list) else [])
                 allowed = uid is not None and uid in aws
             if not allowed:
                 continue
             actions = st.get("Action", [])
             actions = ([actions] if isinstance(actions, str)
-                       else actions)
+                       else actions if isinstance(actions, list)
+                       else [])
             if action in POLICY_ACTIONS:
                 # reading/rewriting the policy itself is never
                 # implied by s3:* — an object-scope grantee must not
@@ -410,7 +417,8 @@ class RGWStore:
                 continue
             resources = st.get("Resource", [])
             resources = ([resources] if isinstance(resources, str)
-                         else resources)
+                         else resources if isinstance(resources, list)
+                         else [])
             for res in resources:
                 if res == "*":
                     return True
@@ -1190,6 +1198,11 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     policy = json.loads(body.decode())
                 except (ValueError, UnicodeDecodeError):
+                    return self._reply(400)
+                if not isinstance(policy, dict) or not isinstance(
+                        policy.get("Statement", []), list) or not all(
+                        isinstance(st, dict)
+                        for st in policy.get("Statement", [])):
                     return self._reply(400)
                 self.store.set_bucket_policy(bucket, policy)
                 return self._reply(204)

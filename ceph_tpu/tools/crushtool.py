@@ -48,16 +48,25 @@ def save_map(cmap: CrushMap, path: str):
 
 
 def batch_map(cmap: CrushMap, rule: Rule, xs, num_rep: int,
-              weights=None) -> list[list[int]]:
-    """Map a batch of inputs; JAX path with scalar fallback."""
+              weights=None, require_batched: bool = False,
+              engines: list | None = None) -> list[list[int]]:
+    """Map a batch of inputs; JAX path with a LOUD scalar fallback
+    (or a hard error under --require-batched)."""
+    from ._engine import fallback
     try:
         from ..crush.jax_mapper import BatchMapper
         bm = BatchMapper(cmap, rule, result_max=num_rep)
         res = bm(xs, weights)
+        if engines is not None:
+            engines.append("tpu-batched")
         return [[int(o) for o in row] for row in res]
-    except (NotImplementedError, ValueError, RuntimeError):
-        wl = list(weights) if weights is not None else None
-        return [mapper.do_rule(cmap, rule, int(x), num_rep, wl) for x in xs]
+    except (NotImplementedError, ValueError, RuntimeError) as e:
+        fallback("crushtool", f"rule {rule.id} ({rule.name})", e,
+                 require_batched)
+    if engines is not None:
+        engines.append("scalar-oracle")
+    wl = list(weights) if weights is not None else None
+    return [mapper.do_rule(cmap, rule, int(x), num_rep, wl) for x in xs]
 
 
 def build_hierarchy_args(num_osds: int, layers: list[tuple[str, str, int]],
@@ -113,11 +122,14 @@ def cmd_test(cmap: CrushMap, args) -> int:
             weights[int(osd)] = int(float(w) * 0x10000)
     min_x, max_x = args.min_x, args.max_x
     xs = list(range(min_x, max_x + 1))
+    engines: list[str] = []
     for rule in rules:
         reps = ([args.num_rep] if args.num_rep
                 else list(range(rule.min_size, rule.max_size + 1)))
         for num_rep in reps:
-            rows = batch_map(cmap, rule, xs, num_rep, weights)
+            rows = batch_map(cmap, rule, xs, num_rep, weights,
+                             require_batched=args.require_batched,
+                             engines=engines)
             if args.show_mappings:
                 for x, row in zip(xs, rows):
                     shown = [o for o in row if o != CRUSH_ITEM_NONE] \
@@ -147,6 +159,9 @@ def cmd_test(cmap: CrushMap, args) -> int:
                 for got in sorted(sizes):
                     print(f"rule {rule.id} ({rule.name}) num_rep {num_rep} "
                           f"result size == {got}:\t{sizes[got]}/{len(xs)}")
+    from ._engine import announce
+    announce("crushtool", "+".join(sorted(set(engines)))
+             if engines else "scalar-oracle")
     return 0
 
 
@@ -176,13 +191,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--show-mappings", action="store_true")
     p.add_argument("--show-utilization", action="store_true")
     p.add_argument("--show-statistics", action="store_true")
+    p.add_argument("--require-batched", action="store_true",
+                   help="error instead of falling back to the scalar "
+                        "oracle when the batched mapper declines a rule")
     return p
+
+
+def _run_test(cmap: CrushMap, args) -> int:
+    from ._engine import BatchedRequired
+    try:
+        return cmd_test(cmap, args)
+    except BatchedRequired as e:
+        print(e, file=sys.stderr)
+        return 2
 
 
 def main(argv=None) -> int:
     from ..utils import honor_jax_platforms_env
+    from ..utils.platform import ensure_x64
     honor_jax_platforms_env()
     args = build_parser().parse_args(argv)
+    if args.test:
+        ensure_x64()       # BatchMapper needs 64-bit straw2 draws
     if args.compile:
         with open(args.compile) as f:
             cmap = compile_crushmap(f.read())
@@ -208,13 +238,13 @@ def main(argv=None) -> int:
         if args.out_file:
             save_map(cmap, args.out_file)
         if args.test:
-            return cmd_test(cmap, args)
+            return _run_test(cmap, args)
         return 0
     if args.test:
         if not args.in_file:
             print("--test needs -i MAP", file=sys.stderr)
             return 1
-        return cmd_test(load_map(args.in_file), args)
+        return _run_test(load_map(args.in_file), args)
     build_parser().print_usage()
     return 1
 

@@ -108,11 +108,18 @@ def save_osdmap(m: OSDMap, path: str):
 
 
 def map_pool_pgs(m: OSDMap, pool: PGPool,
-                 use_jax: bool = True) -> np.ndarray:
+                 use_jax: bool = True,
+                 require_batched: bool = False,
+                 engines: list | None = None) -> np.ndarray:
     """Map every PG of a pool → [pg_num, size] int32 device matrix
     (CRUSH only — upmap/pg_temp overrides applied by the caller if
     needed).  The batched path computes the pps seeds vectorized, then
-    one BatchMapper launch."""
+    one BatchMapper launch.
+
+    A batched-mapper refusal warns loudly (or raises under
+    require_batched) instead of silently timing the Python oracle;
+    `engines`, when given, collects which engine ran."""
+    from ._engine import fallback
     seeds = np.arange(pool.pg_num, dtype=np.uint32)
     pps = pool.raw_pg_to_pps_batch(seeds)
     rule = m.crush.rule_by_id(pool.crush_rule)
@@ -120,9 +127,19 @@ def map_pool_pgs(m: OSDMap, pool: PGPool,
         try:
             from ..crush.jax_mapper import BatchMapper
             bm = BatchMapper(m.crush, rule, result_max=pool.size)
-            return bm(pps, np.asarray(m.osd_weight, dtype=np.uint32))
-        except (NotImplementedError, ValueError, RuntimeError):
-            pass
+            out = bm(pps, np.asarray(m.osd_weight, dtype=np.uint32))
+            if engines is not None:
+                engines.append("tpu-batched")
+            return out
+        except (NotImplementedError, ValueError, RuntimeError) as e:
+            fallback("osdmaptool", f"pool {pool.id} rule {rule.id}",
+                     e, require_batched)
+    elif require_batched:
+        from ._engine import BatchedRequired
+        raise BatchedRequired(
+            "osdmaptool: --require-batched with --no-jax")
+    if engines is not None:
+        engines.append("scalar-oracle")
     rows = [do_rule(m.crush, rule, int(x), pool.size, m.osd_weight)
             for x in pps]
     out = np.full((len(rows), pool.size), CRUSH_ITEM_NONE, dtype=np.int32)
@@ -132,11 +149,12 @@ def map_pool_pgs(m: OSDMap, pool: PGPool,
 
 
 def run_test_map_pgs(m: OSDMap, pool_id: int | None, *, use_jax: bool = True,
-                 out=sys.stdout) -> dict:
+                 require_batched: bool = False, out=sys.stdout) -> dict:
     """The reference's --test-map-pgs report: per-OSD PG counts,
     first/primary counts, min/max/avg/stddev, size histogram."""
     pools = ([m.pools[pool_id]] if pool_id is not None
              else list(m.pools.values()))
+    engines: list[str] = []
     count = np.zeros(m.max_osd, dtype=np.int64)
     first = np.zeros(m.max_osd, dtype=np.int64)
     primary = np.zeros(m.max_osd, dtype=np.int64)
@@ -146,7 +164,9 @@ def run_test_map_pgs(m: OSDMap, pool_id: int | None, *, use_jax: bool = True,
     for pool in pools:
         print(f"pool {pool.id} pg_num {pool.pg_num}", file=out)
         total_pgs += pool.pg_num
-        res = map_pool_pgs(m, pool, use_jax=use_jax)
+        res = map_pool_pgs(m, pool, use_jax=use_jax,
+                           require_batched=require_batched,
+                           engines=engines)
         # apply upmap/pg_temp overrides (host-side; they are sparse)
         overrides = (set(m.pg_upmap) | set(m.pg_upmap_items)
                      | set(m.pg_temp) | set(m.primary_temp))
@@ -190,8 +210,10 @@ def run_test_map_pgs(m: OSDMap, pool_id: int | None, *, use_jax: bool = True,
     rate = total_pgs / dt if dt > 0 else float("inf")
     print(f"mapped {total_pgs} pgs in {dt:.3f}s = {rate:,.0f} pg/s",
           file=out)
+    engine = ("+".join(sorted(set(engines)))
+              if engines else "scalar-oracle")
     return {"pgs": total_pgs, "seconds": dt, "pgs_per_sec": rate,
-            "count": count, "size_hist": size_hist}
+            "count": count, "size_hist": size_hist, "engine": engine}
 
 
 def _osd_crush_weight(m: OSDMap, osd: int) -> float:
@@ -228,6 +250,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--upmap-max", type=int, default=100)
     p.add_argument("--no-jax", action="store_true",
                    help="force the scalar oracle path")
+    p.add_argument("--require-batched", action="store_true",
+                   help="error instead of falling back to the scalar "
+                        "oracle when the batched mapper declines a rule")
     p.add_argument("-o", "--out-file", metavar="FILE")
     p.add_argument("--print", dest="print_map", action="store_true")
     return p
@@ -237,6 +262,9 @@ def main(argv=None) -> int:
     from ..utils import honor_jax_platforms_env
     honor_jax_platforms_env()
     args = build_parser().parse_args(argv)
+    if not args.no_jax:
+        from ..utils.platform import ensure_x64
+        ensure_x64()       # BatchMapper needs 64-bit straw2 draws
     if not args.mapfile:
         build_parser().print_usage()
         return 1
@@ -288,18 +316,33 @@ def main(argv=None) -> int:
         print(f" object '{args.test_map_object}' -> {pg} -> up {up} "
               f"acting {acting}")
     if args.test_map_pgs:
-        run_test_map_pgs(m, args.pool, use_jax=not args.no_jax)
+        from ._engine import BatchedRequired, announce
+        try:
+            rep = run_test_map_pgs(m, args.pool,
+                                   use_jax=not args.no_jax,
+                                   require_batched=args.require_batched)
+            announce("osdmaptool", rep["engine"])
+        except BatchedRequired as e:
+            print(e, file=sys.stderr)
+            return 2
     if args.upmap:
         # reference `osdmaptool --upmap out.txt`: emit the balancer's
         # proposed commands (and keep them applied in -o output)
         from ..mgr.balancer import UpmapBalancer
         pools = ([args.upmap_pool] if args.upmap_pool is not None
                  else list(m.pools))
+        from ._engine import BatchedRequired
         lines = []
         for pid in pools:
-            bal = UpmapBalancer(m, pid)
-            before = bal.stddev()
-            props = bal.optimize(max_changes=args.upmap_max)
+            try:
+                bal = UpmapBalancer(
+                    m, pid, use_jax=not args.no_jax,
+                    require_batched=args.require_batched)
+                before = bal.stddev()
+                props = bal.optimize(max_changes=args.upmap_max)
+            except BatchedRequired as e:
+                print(e, file=sys.stderr)
+                return 2
             for pgid, items in sorted(props.items(),
                                       key=lambda kv: str(kv[0])):
                 pairs = " ".join(f"{a} {b}" for a, b in items)

@@ -17,3 +17,13 @@ def honor_jax_platforms_env() -> None:
     if want and "axon" not in want:
         import jax
         jax.config.update("jax_platforms", want)
+
+
+def ensure_x64() -> None:
+    """Enable 64-bit JAX ints — required by the CRUSH mapper (straw2
+    draws are 64-bit fixed point).  Called by entry points (CLIs, the
+    balancer) so the global-config flip is a deliberate top-level
+    choice, not a side effect buried in a library constructor."""
+    import jax
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)

@@ -128,6 +128,41 @@ class TestPolicyEscalation:
         assert st == 200 and "GetBucketPolicy" in got.decode()
 
 
+class TestMalformedPolicy:
+    def test_put_rejects_non_object_policies(self, authed):
+        """Review r5: a stored non-dict policy (or non-dict
+        statements) crashed authorize() with AttributeError, dropping
+        the connection instead of returning 403."""
+        gw, alice, _bob = authed
+        s3a = _client(gw, alice)
+        assert s3a.make_bucket("malp") == 200
+        for bad in (b"[1]", b'{"Statement": "abc"}',
+                    b'{"Statement": [1, 2]}', b'"str"'):
+            st, _, _ = s3a._req("PUT", "/malp?policy", body=bad)
+            assert st == 400, bad
+
+    def test_garbage_stored_policy_fails_closed(self, authed):
+        """Rows written before validation (or directly) must deny,
+        not 500."""
+        gw, alice, bob = authed
+        s3a, s3b = _client(gw, alice), _client(gw, bob)
+        assert s3a.make_bucket("oldrow") == 200
+        s3a.put("oldrow", "k", b"v")
+        for garbage in ([1], "abc", {"Statement": "xyz"},
+                        {"Statement": [5]},
+                        {"Statement": [{"Effect": "Allow",
+                                        "Principal": {"AWS": 7},
+                                        "Action": 9,
+                                        "Resource": 3.5}]}):
+            gw.store.meta.omap_set("buckets", {
+                "policy.oldrow": json.dumps(garbage).encode()})
+            # non-owner request exercises the policy evaluation path
+            st, _, _ = s3b._req("GET", "/oldrow/k")
+            assert st == 403, garbage
+        # owner unaffected throughout
+        assert s3a.get("oldrow", "k") == (200, b"v")
+
+
 class TestOwnerlessBackfill:
     def test_first_authenticated_access_claims_bucket(self, authed):
         """ADVICE r4 low: a bucket created with no owner (pre-auth /
