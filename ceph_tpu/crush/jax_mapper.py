@@ -24,39 +24,23 @@ import functools
 import numpy as np
 
 from .hash import crush_hash32_2, crush_hash32_3
-from .ln import LL_TBL, RH_LH_TBL
+from .ln import crush_ln
 from .map import CRUSH_ITEM_NONE, CrushMap, Rule
 
 _NONE = CRUSH_ITEM_NONE
 _I64_MIN = -(1 << 63)
 
 
-def _floor_log2(x):
-    """Integer floor(log2(x)) for x ≥ 1 (works on jnp uint32 arrays)."""
-    import jax.numpy as jnp
-    r = jnp.zeros_like(x)
-    for shift in (16, 8, 4, 2, 1):
-        m = x >= (1 << shift)
-        r = r + jnp.where(m, np.uint32(shift), np.uint32(0))
-        x = jnp.where(m, x >> shift, x)
-    return r
-
-
-def _crush_ln_jnp(u, rh_lh, ll):
-    """JAX twin of ceph_tpu.crush.ln.crush_ln (same generated tables)."""
-    import jax.numpy as jnp
-    x = u.astype(jnp.uint32) + np.uint32(1)            # [1, 0x10000]
-    fl2 = _floor_log2(x)
-    bits = jnp.maximum(np.uint32(15) - jnp.minimum(fl2, np.uint32(15)),
-                       np.uint32(0))
-    xn = (x << bits).astype(jnp.uint64)
-    iexpon = (np.uint64(15) - bits.astype(jnp.uint64))
-    index1 = (xn >> np.uint64(8)) << np.uint64(1)       # [256, 512]
-    rh = rh_lh[(index1 - np.uint64(256)).astype(jnp.int32)]
-    lh = rh_lh[(index1 - np.uint64(255)).astype(jnp.int32)]
-    xl64 = (xn * rh) >> np.uint64(48)
-    llv = ll[(xl64 & np.uint64(0xFF)).astype(jnp.int32)]
-    return (iexpon << np.uint64(44)) + ((lh + llv) >> np.uint64(4))
+@functools.lru_cache(maxsize=1)
+def _ln16_s_tbl() -> np.ndarray:
+    """The straw2 numerator for every possible 16-bit hash: the whole
+    `(crush_ln(u) - 2^48) << 16` chain (floor-log2, two coarse/fine
+    table gathers, a u64 multiply) collapses into ONE 64 Ki-entry i64
+    gather per item — u only has 65536 values.  Values wrap mod 2^64
+    exactly as the scalar oracle's shift does."""
+    u = np.arange(0x10000, dtype=np.uint64)
+    lnv = crush_ln(u).astype(np.int64) - np.int64(1 << 48)
+    return (lnv.astype(np.uint64) << np.uint64(16)).astype(np.int64)
 
 
 @functools.lru_cache(maxsize=None)
@@ -96,7 +80,7 @@ def _mulhi_u64(a, b):
             + (cross >> np.uint64(32)))
 
 
-def _straw2_draws(u, w, wmagic=None):
+def _straw2_draws(u, w, wmagic=None, any_add=True, ln16=None):
     """Per-item draws: u [.., S] hashes (0..0xffff), w [.., S] int64 weights.
 
     Returns int64 draws; w==0 ⇒ INT64_MIN (never wins except at index 0
@@ -104,16 +88,18 @@ def _straw2_draws(u, w, wmagic=None):
 
     wmagic: optional (M, s, add) uint64/int32 arrays matching w, from
     `_magicu64` — the division-free path for static weight tables.
+    any_add: False when the static table contains no add-case magics
+    (the common case) so the add branch compiles away entirely.
+    ln16: the _ln16_s_tbl array, passed as a traced argument so the
+    512 KiB table is a program parameter, not an inline HLO literal
+    (inlining it tripled compile time).
     """
     import jax
     import jax.numpy as jnp
-    rh_lh = jnp.asarray(RH_LH_TBL)
-    ll = jnp.asarray(LL_TBL)
-    lnv = _crush_ln_jnp(u, rh_lh, ll).astype(jnp.int64) - np.int64(1 << 48)
-    # draw = (ln << 16) / w — divide by the 16.16 weight; the s64 shift
-    # wraps mod 2^64 exactly as the scalar oracle emulates
-    shifted_u = jax.lax.bitcast_convert_type(lnv, jnp.uint64) << np.uint64(16)
-    s = jax.lax.bitcast_convert_type(shifted_u, jnp.int64)
+    # draw = (ln << 16) / w — the numerator comes straight from the
+    # 64Ki-entry table (see _ln16_s_tbl); divide by the 16.16 weight
+    tbl = jnp.asarray(_ln16_s_tbl()) if ln16 is None else ln16
+    s = tbl[u.astype(jnp.int32)]
     neg = s < 0
     mag = jax.lax.bitcast_convert_type(jnp.abs(s), jnp.uint64)
     if wmagic is None:
@@ -122,13 +108,14 @@ def _straw2_draws(u, w, wmagic=None):
     else:
         M, sh, add = wmagic
         t = _mulhi_u64(mag, M)
-        q_plain = t >> sh.astype(jnp.uint64)
-        # add case evaluates q = ((n - t)/2 + t) >> (s - 1); the only
-        # s == 0 add case is d == 1, where the quotient is n itself
-        q_add = (((mag - t) >> np.uint64(1)) + t) >> (
-            jnp.maximum(sh, 1).astype(jnp.uint64) - np.uint64(1))
-        q_add = jnp.where(sh == 0, mag, q_add)
-        q = jnp.where(add.astype(bool), q_add, q_plain)
+        q = t >> sh.astype(jnp.uint64)
+        if any_add:
+            # add case evaluates q = ((n - t)/2 + t) >> (s - 1); the
+            # only s == 0 add case is d == 1, where the quotient is n
+            q_add = (((mag - t) >> np.uint64(1)) + t) >> (
+                jnp.maximum(sh, 1).astype(jnp.uint64) - np.uint64(1))
+            q_add = jnp.where(sh == 0, mag, q_add)
+            q = jnp.where(add.astype(bool), q_add, q)
     qi = jax.lax.bitcast_convert_type(q, jnp.int64)
     draws = jnp.where(neg, -qi, qi)
     return jnp.where(w > 0, draws, np.int64(_I64_MIN))
@@ -256,6 +243,8 @@ class BatchMapper:
         self._hash_ids = hash_ids
         self._sizes, self._btype = sizes, btype
         self._nb, self._S, self._P = nb, S, P
+        self._bucket_by_id = {b.id: b for b in cmap.buckets
+                              if b is not None}
         # division-free straw2: per-item magic constants for the static
         # weight table (TPU has no native u64 divide)
         mw = np.zeros((P, nb, S), dtype=np.uint64)
@@ -269,18 +258,47 @@ class BatchMapper:
                         mw[p, row, col], sw[p, row, col], \
                             aw[p, row, col] = _magicu64(d)
         self._wmagic = (mw, sw, aw)
-        # descent depths
-        self.d1 = cmap.max_depth_to_type(take, self.target_type)
+        # descent depths + per-step size bounds: at BFS step t from
+        # `take` only a statically-known set of buckets can be under
+        # the cursor, so each straw2 scans that step's max bucket size
+        # instead of the global max (the canonical root→rack→host map
+        # has a size-1 top level that would otherwise pay a full-S
+        # hash+argmax per element)
+        self.step_sizes1 = self._bfs_step_sizes([take], self.target_type)
+        self.d1 = len(self.step_sizes1)
         if self.recurse:
-            d2 = 0
-            for b in cmap.buckets:
-                if b is not None and b.type == self.target_type:
-                    d2 = max(d2, cmap.max_depth_to_type(b.id, 0))
-            self.d2 = d2
+            starts = [b.id for b in cmap.buckets
+                      if b is not None and b.type == self.target_type]
+            self.step_sizes2 = self._bfs_step_sizes(starts, 0)
+            self.d2 = len(self.step_sizes2)
         else:
+            self.step_sizes2 = []
             self.d2 = 0
 
         self._fn = jax.jit(self._build())
+
+    def _bfs_step_sizes(self, start_items: list[int],
+                        target_type: int) -> list[tuple[int, bool]]:
+        """Per-descent-step (max bucket size, all-uniform?) from
+        `start_items` until everything reachable is at `target_type`
+        (or a device).  Length == the masked-descent trip count (old
+        max_depth); `uniform` lets straw2 skip the per-row size mask."""
+        steps = []
+        frontier = set(start_items)
+        for _ in range(len(self._bucket_by_id) + 1):
+            nxt: set[int] = set()
+            szs: set[int] = set()
+            for it in frontier:
+                if it < 0 and self.cmap.item_type(it) != target_type:
+                    b = self._bucket_by_id.get(it)
+                    if b is not None:
+                        szs.add(b.size)
+                        nxt.update(b.items)
+            if not szs:
+                break
+            steps.append((max(szs), len(szs) == 1))
+            frontier = nxt
+        return steps
 
     # -- jitted pieces ----------------------------------------------------
 
@@ -297,38 +315,64 @@ class BatchMapper:
         wm_s = jnp.asarray(self._wmagic[1])
         wm_a = jnp.asarray(self._wmagic[2])
         nb, S, P = self._nb, self._S, self._P
-        col = jnp.arange(S, dtype=jnp.int32)
 
         def item_type(itm):
             rows = jnp.clip(-1 - itm, 0, nb - 1)
             return jnp.where(itm < 0, btype[rows], 0)
 
-        def straw2(rows, x, r, pos):
+        any_add = bool(self._wmagic[2].any())
+        # the 64Ki ln table rides in as an argument (set per call by
+        # `run`); a box, not a closure constant, so the HLO carries a
+        # parameter instead of a megabyte literal
+        ln16_box = [None]
+
+        def straw2(rows, x, r, pos, step=None):
             """rows/x/r/pos [B] → chosen item [B].  `pos` is the output
-            position selecting the choose_args weight-set column."""
-            its = items[rows]                       # [B, S]
-            hids = hash_ids[rows]
-            p = jnp.clip(pos, 0, P - 1)
-            ws = weights[p, rows]
+            position selecting the choose_args weight-set column;
+            `step` is this descent step's static (max size, uniform?)
+            so the hash+argmax scans only the columns that can matter
+            and skips the per-row size mask on uniform levels."""
+            s_, uniform = (S, False) if step is None else step
+            s_ = min(s_, S)
+            its = items[:, :s_][rows]               # [B, s_]
+            if s_ == 1:
+                # a size-1 straw2 always selects its only item (the
+                # reference's first loop iteration seeds the max)
+                return its[:, 0]
+            hids = hash_ids[:, :s_][rows]
+            if P == 1:
+                # no choose_args positions: index the only weight set
+                # statically instead of a clip+2-axis gather per row
+                ws = weights[0, :, :s_][rows]
+                wm = (wm_m[0, :, :s_][rows], wm_s[0, :, :s_][rows],
+                      wm_a[0, :, :s_][rows])
+            else:
+                p = jnp.clip(pos, 0, P - 1)
+                ws = weights[:, :, :s_][p, rows]
+                wm = (wm_m[:, :, :s_][p, rows],
+                      wm_s[:, :, :s_][p, rows],
+                      wm_a[:, :, :s_][p, rows])
             u = crush_hash32_3(x[:, None], hids.astype(jnp.uint32),
                                r[:, None].astype(jnp.uint32))
             u = (u & np.uint32(0xFFFF))
-            draws = _straw2_draws(u, ws, (wm_m[p, rows], wm_s[p, rows],
-                                          wm_a[p, rows]))
-            draws = jnp.where(col[None, :] < sizes[rows][:, None],
-                              draws, np.int64(_I64_MIN))
+            draws = _straw2_draws(u, ws, wm, any_add=any_add,
+                                  ln16=ln16_box[0])
+            if not uniform:
+                col = jnp.arange(s_, dtype=jnp.int32)
+                draws = jnp.where(col[None, :] < sizes[rows][:, None],
+                                  draws, np.int64(_I64_MIN))
             sel = jnp.argmax(draws, axis=1)
             return its[jnp.arange(its.shape[0]), sel]
 
-        def descend(start, x, r, target, depth, pos):
+        def descend(start, x, r, target, step_specs, pos):
             """Masked hierarchy walk until item type == target."""
             itm = start
-            for _ in range(depth):
+            for spec in (step_specs or [None]):
                 isb = itm < 0
                 rows = jnp.clip(-1 - itm, 0, nb - 1)
                 t = jnp.where(isb, btype[rows], 0)
                 need = isb & (t != target)
-                nxt = straw2(rows, x, r, pos)
+                nxt = straw2(rows, x, r, pos, spec)
                 itm = jnp.where(need, nxt, itm)
             return itm
 
@@ -346,7 +390,7 @@ class BatchMapper:
         # device; C takes the `out2[outpos] = item` direct path, so no
         # inner recursion happens
         leafmode = self.recurse and target != 0
-        d1, d2 = self.d1, self.d2
+        sizes1, sizes2 = self.step_sizes1, self.step_sizes2
         take = self.take
         vary_r = self.cmap.tunables.chooseleaf_vary_r
 
@@ -364,7 +408,7 @@ class BatchMapper:
             leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
             for ft in range(rtries):
                 ri = sub_r + np.int32(ft)
-                cand = descend(host, x, ri, 0, max(d2, 1), pos)
+                cand = descend(host, x, ri, 0, sizes2, pos)
                 valid = (cand >= 0) & (host < 0)
                 collide = jnp.any(prev_leafs == cand[:, None], axis=1)
                 reject = collide | dev_out(wdev, cand, x) | ~valid
@@ -375,53 +419,58 @@ class BatchMapper:
                 dead |= active & ~valid   # C: skip_rep — no more attempts
             return leaf, got
 
+        def rep_while(x, out, leafs, wdev, st0, rep):
+            """The general retry loop for one firstn rep — the
+            original traced body, shape-polymorphic so the straggler
+            fallback can run it on a compacted slice."""
+
+            def body(st):
+                ftotal, placed, dead, item, leaf = st
+                active = ~placed & ~dead
+                r = (rep + ftotal).astype(jnp.int32)
+                root = jnp.full(x.shape, take, dtype=jnp.int32)
+                pos = jnp.sum((out != _NONE).astype(jnp.int32), axis=1)
+                itm = descend(root, x, r, target, sizes1, pos)
+                valid = item_type(itm) == target
+                collide = jnp.any(out == itm[:, None], axis=1)
+                if leafmode:
+                    lf, lgot = leaf_attempts(itm, x, r, leafs,
+                                             wdev, pos)
+                    reject = collide | ~lgot
+                else:
+                    lf = itm
+                    if target == 0:
+                        reject = collide | dev_out(wdev, itm, x)
+                    else:
+                        reject = collide
+                succ = active & valid & ~reject
+                item = jnp.where(succ, itm, item)
+                leaf = jnp.where(succ, lf, leaf)
+                placed = placed | succ
+                dead = dead | (active & ~valid)
+                ftotal = ftotal + (active & valid & reject
+                                   ).astype(jnp.int32)
+                return ftotal, placed, dead, item, leaf
+
+            def cond(st):
+                ftotal, placed, dead, _, _ = st
+                return jnp.any(~placed & ~dead & (ftotal < tries))
+
+            return jax.lax.while_loop(cond, body, st0)
+
         def firstn_fn(x, wdev):
             # one traced rep body under lax.scan (compile cost is one
             # rep, not numrep unrolled copies — the r2 compile-time sink)
             B = x.shape[0]
-            root = jnp.full((B,), take, dtype=jnp.int32)
 
             def rep_body(carry, rep):
                 out, leafs = carry
-
-                def body(st):
-                    ftotal, placed, dead, item, leaf = st
-                    active = ~placed & ~dead
-                    r = (rep + ftotal).astype(jnp.int32)
-                    pos = jnp.sum((out != _NONE).astype(jnp.int32),
-                                  axis=1)
-                    itm = descend(root, x, r, target, max(d1, 1), pos)
-                    valid = item_type(itm) == target
-                    collide = jnp.any(out == itm[:, None], axis=1)
-                    if leafmode:
-                        lf, lgot = leaf_attempts(itm, x, r, leafs,
-                                                 wdev, pos)
-                        reject = collide | ~lgot
-                    else:
-                        lf = itm
-                        if target == 0:
-                            reject = collide | dev_out(wdev, itm, x)
-                        else:
-                            reject = collide
-                    succ = active & valid & ~reject
-                    item = jnp.where(succ, itm, item)
-                    leaf = jnp.where(succ, lf, leaf)
-                    placed = placed | succ
-                    dead = dead | (active & ~valid)
-                    ftotal = ftotal + (active & valid & reject
-                                       ).astype(jnp.int32)
-                    return ftotal, placed, dead, item, leaf
-
-                def cond(st):
-                    ftotal, placed, dead, _, _ = st
-                    return jnp.any(~placed & ~dead & (ftotal < tries))
-
                 st = (jnp.zeros((B,), jnp.int32),
                       jnp.zeros((B,), bool), jnp.zeros((B,), bool),
                       jnp.full((B,), _NONE, jnp.int32),
                       jnp.full((B,), _NONE, jnp.int32))
-                ftotal, placed, dead, item, leaf = jax.lax.while_loop(
-                    cond, body, st)
+                ftotal, placed, dead, item, leaf = rep_while(
+                    x, out, leafs, wdev, st, rep)
                 out = out.at[:, rep].set(
                     jnp.where(placed, item, np.int32(_NONE)))
                 leafs = leafs.at[:, rep].set(
@@ -435,6 +484,144 @@ class BatchMapper:
             res = leafs if leafmode else out
             # compact: stable-move NONE entries to the end (C firstn
             # advances outpos only on success)
+            order = jnp.argsort(res == _NONE, axis=1, stable=True)
+            return jnp.take_along_axis(res, order, axis=1)
+
+        # -- fast firstn: precomputed candidates + compacted stragglers
+        #
+        # The while-loop formulation above recomputes full-batch
+        # descents every retry round: one colliding PG in a 128k batch
+        # makes every PG pay another 2-3 straw2 rounds (the r4 10x
+        # loss vs native scalar C).  With no choose_args (P == 1) a
+        # descent depends only on (x, r), so the first R candidate
+        # r-values are computed ONCE in a single batched launch and
+        # rep selection becomes pure boolean logic; the rare PGs that
+        # exhaust R candidates are compacted (~B/16) and finish in the
+        # general loop at 1/16th the per-round cost.
+        fast_R = numrep
+
+        def firstn_fast_fn(x, wdev):
+            B = x.shape[0]
+            R = fast_R
+            xt = jnp.tile(x, R)
+            rt = jnp.repeat(jnp.arange(R, dtype=jnp.int32), B)
+            zero = jnp.zeros((R * B,), jnp.int32)
+            root = jnp.full((R * B,), take, dtype=jnp.int32)
+            host_c = descend(root, xt, rt, target, sizes1, zero)
+            valid_c = (item_type(host_c) == target).reshape(R, B)
+            if leafmode:
+                sub_r = ((rt >> (vary_r - 1)) if vary_r
+                         else jnp.zeros_like(rt))
+                leaf_fc, lval_fc, lok_fc = [], [], []
+                for ft in range(rtries):
+                    cand = descend(host_c, xt, sub_r + np.int32(ft),
+                                   0, sizes2, zero)
+                    lval = (cand >= 0) & (host_c < 0)
+                    lok = lval & ~dev_out(wdev, cand, xt)
+                    leaf_fc.append(cand.reshape(R, B))
+                    lval_fc.append(lval.reshape(R, B))
+                    lok_fc.append(lok.reshape(R, B))
+            elif target == 0:
+                devok_c = (~dev_out(wdev, host_c, xt)).reshape(R, B)
+            host_c = host_c.reshape(R, B)
+            barange = jnp.arange(B)
+
+            def at_r(arr2d, rc):
+                return arr2d[rc, barange]
+
+            K = max(min(B, 256), B // 16)
+
+            def rep_body(carry, rep):
+                out, leafs = carry
+                ftotal = jnp.zeros((B,), jnp.int32)
+                placed = jnp.zeros((B,), bool)
+                dead = jnp.zeros((B,), bool)
+                item = jnp.full((B,), _NONE, jnp.int32)
+                leaf = jnp.full((B,), _NONE, jnp.int32)
+                # consume up to R precomputed candidates: each step a
+                # PG inspects r = rep + ftotal (consecutive on reject)
+                for _ in range(R):
+                    r = rep + ftotal
+                    in_range = r < R
+                    rc = jnp.clip(r, 0, R - 1)
+                    active = ~placed & ~dead & in_range
+                    hc = at_r(host_c, rc)
+                    valid = at_r(valid_c, rc)
+                    collide = jnp.any(out == hc[:, None], axis=1)
+                    if leafmode:
+                        # inner ft selection against current leafs
+                        lgot = jnp.zeros((B,), bool)
+                        ldead = jnp.zeros((B,), bool)
+                        lf = jnp.full((B,), _NONE, jnp.int32)
+                        for ft in range(rtries):
+                            lc_ = at_r(leaf_fc[ft], rc)
+                            lv = at_r(lval_fc[ft], rc)
+                            lo = at_r(lok_fc[ft], rc)
+                            lcol = jnp.any(leafs == lc_[:, None],
+                                           axis=1)
+                            lact = ~lgot & ~ldead
+                            lsucc = lact & lo & ~lcol
+                            lf = jnp.where(lsucc, lc_, lf)
+                            lgot |= lsucc
+                            ldead |= lact & ~lv
+                        reject = collide | ~lgot
+                    else:
+                        lf = hc
+                        if target == 0:
+                            reject = collide | ~at_r(devok_c, rc)
+                        else:
+                            reject = collide
+                    succ = active & valid & ~reject
+                    item = jnp.where(succ, hc, item)
+                    leaf = jnp.where(succ, lf, leaf)
+                    placed = placed | succ
+                    dead = dead | (active & ~valid)
+                    ftotal = ftotal + (active & valid & reject
+                                       ).astype(jnp.int32)
+                # stragglers: r >= R or still colliding — compact and
+                # run the general loop on a K-slice until none remain
+                def fb_cond(st):
+                    ftotal, placed, dead, _, _ = st
+                    return jnp.any(~placed & ~dead & (ftotal < tries))
+
+                def fb_body(st):
+                    ftotal, placed, dead, item, leaf = st
+                    mask = ~placed & ~dead & (ftotal < tries)
+                    idx = jnp.nonzero(mask, size=K,
+                                      fill_value=B)[0]
+                    ok = idx < B
+                    idxc = jnp.minimum(idx, B - 1).astype(jnp.int32)
+                    stk = (ftotal[idxc],
+                           ~ok,            # pad rows: already "placed"
+                           jnp.zeros((K,), bool),
+                           jnp.full((K,), _NONE, jnp.int32),
+                           jnp.full((K,), _NONE, jnp.int32))
+                    ftk, plk, ddk, itk, lfk = rep_while(
+                        x[idxc], out[idxc], leafs[idxc], wdev, stk,
+                        rep)
+                    # pad rows were marked placed with NONE items;
+                    # mode="drop" discards their B sentinel index
+                    ftotal = ftotal.at[idx].set(ftk, mode="drop")
+                    placed = placed.at[idx].set(plk, mode="drop")
+                    dead = dead.at[idx].set(ddk, mode="drop")
+                    item = item.at[idx].set(itk, mode="drop")
+                    leaf = leaf.at[idx].set(lfk, mode="drop")
+                    return ftotal, placed, dead, item, leaf
+
+                st = (ftotal, placed, dead, item, leaf)
+                ftotal, placed, dead, item, leaf = jax.lax.while_loop(
+                    fb_cond, fb_body, st)
+                out = out.at[:, rep].set(
+                    jnp.where(placed, item, np.int32(_NONE)))
+                leafs = leafs.at[:, rep].set(
+                    jnp.where(placed, leaf, np.int32(_NONE)))
+                return (out, leafs), None
+
+            init = (jnp.full((B, numrep), _NONE, jnp.int32),
+                    jnp.full((B, numrep), _NONE, jnp.int32))
+            (out, leafs), _ = jax.lax.scan(
+                rep_body, init, jnp.arange(numrep, dtype=np.int32))
+            res = leafs if leafmode else out
             order = jnp.argsort(res == _NONE, axis=1, stable=True)
             return jnp.take_along_axis(res, order, axis=1)
 
@@ -453,7 +640,7 @@ class BatchMapper:
                 leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
                 for ft in range(rtries):
                     ri = rep + r + np.int32(numrep * ft)
-                    cand = descend(host, x, ri, 0, max(d2, 1),
+                    cand = descend(host, x, ri, 0, sizes2,
                                    jnp.broadcast_to(rep, ri.shape))
                     valid = (cand >= 0) & (host < 0)
                     reject = dev_out(wdev, cand, x) | ~valid
@@ -474,7 +661,7 @@ class BatchMapper:
                     needs = out[:, rep] == UNDEF
                     r = (rep + np.int32(numrep) * ftotal
                          ).astype(jnp.int32) * jnp.ones((B,), jnp.int32)
-                    itm = descend(root, x, r, target, max(d1, 1),
+                    itm = descend(root, x, r, target, sizes1,
                                   jnp.broadcast_to(rep, r.shape))
                     valid = item_type(itm) == target
                     collide = jnp.any(out == itm[:, None], axis=1)
@@ -512,9 +699,18 @@ class BatchMapper:
             res = out2 if leafmode else out
             return jnp.where(res == UNDEF, np.int32(_NONE), res)
 
-        fn = firstn_fn if self.firstn else indep_fn
+        # fast path preconditions: no choose_args positions (a descent
+        # must depend only on (x, r)) and a small inner-leaf retry
+        # budget (its candidates are precomputed per ft)
+        fast_ok = self.firstn and P == 1 \
+            and (not leafmode or rtries <= 4)
+        if self.firstn:
+            fn = firstn_fast_fn if fast_ok else firstn_fn
+        else:
+            fn = indep_fn
 
-        def run(x, wdev):
+        def run(x, wdev, ln16):
+            ln16_box[0] = ln16
             res = fn(x, wdev)
             if res.shape[1] < self.result_max:
                 pad = jnp.full((x.shape[0], self.result_max - res.shape[1]),
@@ -533,6 +729,7 @@ class BatchMapper:
         else:
             reweight = np.asarray(reweight, dtype=np.uint32)
         wdev = jnp.asarray(reweight)
+        ln16 = jnp.asarray(_ln16_s_tbl())
         outs = []
         for lo in range(0, len(xs), self.chunk):
             hi = min(lo + self.chunk, len(xs))
@@ -540,6 +737,6 @@ class BatchMapper:
             n = len(part)
             if n < self.chunk and len(xs) > self.chunk:
                 part = np.pad(part, (0, self.chunk - n))
-            res = np.asarray(self._fn(jnp.asarray(part), wdev))
+            res = np.asarray(self._fn(jnp.asarray(part), wdev, ln16))
             outs.append(res[:n])
         return np.concatenate(outs, axis=0)

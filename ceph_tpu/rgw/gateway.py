@@ -345,7 +345,14 @@ class RGWStore:
             raw = self.meta.omap_get(BUCKETS_OID, keys=[key]).get(key)
         except ObjectNotFound:
             return None
-        return json.loads(bytes(raw)) if raw else None
+        if not raw:
+            return None
+        try:
+            return json.loads(bytes(raw))
+        except ValueError:
+            # a directly-written non-JSON row must fail closed (deny
+            # in authorize), not 500 the request handler
+            return None
 
     def delete_bucket_policy(self, bucket: str):
         self.meta.omap_rm_keys(BUCKETS_OID, [f"policy.{bucket}"])

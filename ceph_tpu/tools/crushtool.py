@@ -212,7 +212,9 @@ def main(argv=None) -> int:
     honor_jax_platforms_env()
     args = build_parser().parse_args(argv)
     if args.test:
+        from ..utils.platform import enable_compile_cache
         ensure_x64()       # BatchMapper needs 64-bit straw2 draws
+        enable_compile_cache()
     if args.compile:
         with open(args.compile) as f:
             cmap = compile_crushmap(f.read())

@@ -262,9 +262,15 @@ def main(argv=None) -> int:
     from ..utils import honor_jax_platforms_env
     honor_jax_platforms_env()
     args = build_parser().parse_args(argv)
-    if not args.no_jax:
-        from ..utils.platform import ensure_x64
+    maps_pgs = (args.test_map_pgs or args.test_map_object
+                or args.upmap)
+    if maps_pgs and not args.no_jax:
+        # only mapping subcommands touch the batched mapper; pure
+        # map-file operations must never initialize a JAX backend
+        # (which can hang on TPU-tunnel hiccups — see utils.platform)
+        from ..utils.platform import enable_compile_cache, ensure_x64
         ensure_x64()       # BatchMapper needs 64-bit straw2 draws
+        enable_compile_cache()
     if not args.mapfile:
         build_parser().print_usage()
         return 1

@@ -1,3 +1,4 @@
 """Runtime substrate (reference: ``src/common/``; SURVEY.md §3.1)."""
 
-from .platform import honor_jax_platforms_env  # noqa: F401
+from .platform import (enable_compile_cache, ensure_x64,  # noqa: F401
+                       honor_jax_platforms_env)

@@ -27,3 +27,28 @@ def ensure_x64() -> None:
     import jax
     if not jax.config.jax_enable_x64:
         jax.config.update("jax_enable_x64", True)
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Point XLA's persistent compilation cache at a ceph_tpu cache
+    dir so repeated CLI invocations (the reference's osdmaptool /
+    crushtool usage pattern) skip the multi-second mapper compile.
+    Keyed by the traced program, i.e. by (map topology, rule,
+    tunables, batch shape).
+
+    TPU-backend only: measured on the CPU backend, both the cache
+    write (executable serialization) and the hit path (deserialize =
+    LLVM re-jit) cost as much as compiling fresh, so enabling it
+    there is a net loss.  → the cache directory used, or None."""
+    import jax
+    if jax.default_backend() != "tpu":
+        return None
+    path = path or os.environ.get(
+        "CEPH_TPU_XLA_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "ceph_tpu",
+                     "xla"))
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return path

@@ -159,6 +159,10 @@ class TestMalformedPolicy:
             # non-owner request exercises the policy evaluation path
             st, _, _ = s3b._req("GET", "/oldrow/k")
             assert st == 403, garbage
+        # not even JSON: still deny, not 500 (review r5)
+        gw.store.meta.omap_set("buckets", {
+            "policy.oldrow": b"\xff{not json"})
+        assert s3b._req("GET", "/oldrow/k")[0] == 403
         # owner unaffected throughout
         assert s3a.get("oldrow", "k") == (200, b"v")
 
