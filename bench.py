@@ -302,6 +302,21 @@ def _ec_sweep(on_tpu: bool):
             "encode_int8_TOPS": round(e_tops, 3),
             "batch": batch,
         }
+        if on_tpu and size == SIZES[-1]:
+            # old-vs-new kernel on the same bytes: the r5 redesign
+            # claim (bit-sliced i32 v2 vs uint8-layout v1) must be a
+            # measured delta, not a prediction
+            try:
+                enc_v1 = GFLinear(coding, backend="pallas-v1")
+                assert np.array_equal(np.asarray(enc_v1(data[:2]))[0],
+                                      parity0)
+                v1_gbps, _ = _device_leg(enc_v1, data,
+                                         batch * K * chunk, iters)
+                sweep[str(size)]["encode_v1_GBps"] = round(v1_gbps, 3)
+                sweep[str(size)]["v2_over_v1"] = round(
+                    e_gbps / v1_gbps, 2)
+            except Exception as e:      # noqa: BLE001 — comparison
+                sweep[str(size)]["encode_v1_error"] = str(e)[:160]
     return sweep, base_label, enc.backend
 
 

@@ -101,12 +101,19 @@ class GFLinear:
     [batch..., k, n] uint8 returns [batch..., m, n] uint8.
 
     Backends:
-    - ``"pallas"`` — the fused VMEM kernel (`ceph_tpu.ops.gf_pallas`),
-      the TPU production path: one HBM read of the data, one HBM write
-      of the parity, expand/matmul/pack fused per tile;
+    - ``"pallas"`` — the fused VMEM kernel v2
+      (`ceph_tpu.ops.gf_pallas2`), the TPU production path: bytes
+      processed 4-per-lane as i32 words, bit-planes expanded in VMEM,
+      a 256-deep (at k=8) GF(2) matmul on the MXU, parity packed back
+      to words — one HBM read of the data, one write of the parity;
+    - ``"pallas-v1"`` — the original uint8-layout fused kernel
+      (`ceph_tpu.ops.gf_pallas`), kept for the old-vs-new roofline
+      comparison in bench.py;
     - ``"xla"`` — the dot_general bitmatrix composition above (works on
       any backend; what CPU tests run);
-    - ``"auto"`` (default) — pallas on TPU, xla elsewhere.
+    - ``"auto"`` (default) — pallas (v2) on TPU, xla elsewhere.
+    ``*-interpret`` variants run the pallas kernels in interpret mode
+    for CPU byte-exactness tests.
     """
 
     def __init__(self, coding: np.ndarray, use_bits: bool = True,
@@ -125,17 +132,27 @@ class GFLinear:
         # the pallas path jits internally (and interpret mode under an
         # outer jit miscompiles on the CPU backend); jit only the
         # XLA-composed paths here
+        if self.backend.startswith("pallas") and use_bits is False:
+            raise ValueError("pallas backends are bitmatrix-only")
         self._fn = (self._apply if self.backend.startswith("pallas")
                     else jax.jit(self._apply))
 
     def _apply(self, data: jnp.ndarray) -> jnp.ndarray:
         if self.backend in ("pallas", "pallas-interpret"):
+            from .gf_pallas2 import gf_matmul_pallas2
+            if not hasattr(self, "_bdmats"):
+                self._bdmats = {}
+            return gf_matmul_pallas2(
+                self._mat, data, self.m,
+                interpret=self.backend == "pallas-interpret",
+                bdmats=self._bdmats)
+        if self.backend in ("pallas-v1", "pallas-v1-interpret"):
             from .gf_pallas import gf_matmul_pallas
             if not hasattr(self, "_bdmats"):
                 self._bdmats = {}
             return gf_matmul_pallas(
                 self._mat, data, self.m,
-                interpret=self.backend == "pallas-interpret",
+                interpret=self.backend == "pallas-v1-interpret",
                 bdmats=self._bdmats)
         if self.use_bits:
             return gf_matmul_bits(self._mat, data, self.m)

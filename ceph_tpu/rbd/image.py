@@ -105,9 +105,12 @@ class RBD:
     def create(self, ioctx, name: str, size: int, *, order: int = 22,
                stripe_unit: int | None = None, stripe_count: int = 1,
                journaling: bool = False, primary: bool = True,
-               object_map: bool = True):
+               object_map: bool = True, mirror_snapshot: bool = False):
         if size < 0:
             raise ValueError("image size must be >= 0")
+        if journaling and mirror_snapshot:
+            raise ValueError(
+                "mirroring is journal- OR snapshot-based, not both")
         if _header_oid(name) in ioctx.list_objects():
             raise ValueError(f"image {name!r} exists")
         object_size = 1 << order
@@ -128,6 +131,11 @@ class RBD:
             # object-map/fast-diff feature bits, on by default)
             "object_map": object_map,
         }
+        if mirror_snapshot:
+            # snapshot-based mirroring mode (reference `rbd mirror
+            # image enable <img> snapshot`): no journal; the daemon
+            # ships object-map-assisted deltas between mirror snaps
+            hdr["mirror_mode"] = "snapshot"
         ioctx.omap_set(_header_oid(name), {
             "header": json.dumps(hdr).encode()})
 
@@ -577,12 +585,13 @@ class Image:
         self._require_unlocked()
         if self._read_only and not getattr(self, "_replaying", False):
             raise ValueError("image opened read-only")
-        if self._hdr.get("journaling") and \
+        if (self._hdr.get("journaling")
+                or self._hdr.get("mirror_mode") == "snapshot") and \
                 not self._hdr.get("primary", True) and \
                 not getattr(self, "_replaying", False):
             raise ValueError(
                 "image is non-primary (mirrored): writes only arrive "
-                "via journal replay; promote first")
+                "via mirror replay; promote first")
 
     # -- journaling / mirroring ------------------------------------------
     # (reference src/librbd/journal/: every mutation is appended as a
@@ -664,6 +673,70 @@ class Image:
         self._load_header()
         self._hdr["primary"] = False
         self._save_header()
+
+    # -- snapshot-based mirroring ----------------------------------------
+    # (reference src/tools/rbd_mirror/ snapshot mode + the mirror
+    # snapshot schedule: the PRIMARY periodically stamps
+    # ".mirror.primary.<id>" snapshots; the daemon ships the
+    # object-map-assisted delta between consecutive mirror snapshots
+    # and records its sync point back on the primary, which prunes
+    # mirror snapshots older than the peer's sync point.)
+    MIRROR_SNAP_PREFIX = ".mirror.primary."
+
+    def mirror_mode(self) -> str | None:
+        if self._hdr.get("mirror_mode") == "snapshot":
+            return "snapshot"
+        return "journal" if self._hdr.get("journaling") else None
+
+    def mirror_snapshots(self) -> list[tuple[int, str]]:
+        """Mirror snapshots as ordered (id, name)."""
+        out = [(s["id"], nm) for nm, s in self._hdr["snaps"].items()
+               if nm.startswith(self.MIRROR_SNAP_PREFIX)]
+        return sorted(out)
+
+    def mirror_snapshot_create(self) -> str:
+        """Primary-only: stamp a new mirror snapshot (what the
+        reference's snapshot schedule does on its cadence), then prune
+        mirror snapshots the peer has already synced past.
+
+        The name's sequence number continues from the highest existing
+        MIRROR snapshot name — NOT from the local snap_seq, which
+        diverges across the two clusters (user snapshots advance it on
+        the primary only; a promoted secondary would otherwise collide
+        with a name it imported)."""
+        if self.mirror_mode() != "snapshot":
+            raise ValueError("image is not in snapshot mirror mode")
+        self._require_writable()
+        plen = len(self.MIRROR_SNAP_PREFIX)
+        nums = [int(nm[plen:]) for _, nm in self.mirror_snapshots()]
+        name = f"{self.MIRROR_SNAP_PREFIX}{max(nums, default=0) + 1}"
+        self.create_snap(name)
+        self._prune_mirror_snapshots()
+        return name
+
+    def mirror_snap_committed(self) -> int:
+        """Highest mirror-snapshot id the peer reports fully synced."""
+        try:
+            rows = self.ioctx.omap_get(_journal_oid(self.name))
+        except Exception:
+            return 0
+        return int(rows.get("mirror_snap_synced", b"0"))
+
+    def mirror_snap_commit(self, snap_id: int):
+        """Peer-side sync acknowledgement (the daemon writes this on
+        the REMOTE image — the analog of journal_commit)."""
+        self.ioctx.omap_set(_journal_oid(self.name), {
+            "mirror_snap_synced": str(snap_id).encode()})
+
+    def _prune_mirror_snapshots(self):
+        """Drop mirror snapshots STRICTLY older than the peer's sync
+        point: the synced one stays — it is the peer's next diff
+        base — and unsynced ones must survive or the delta chain
+        breaks."""
+        committed = self.mirror_snap_committed()
+        for sid, name in self.mirror_snapshots():
+            if sid < committed:
+                self.remove_snap(name)
 
     # -- object map / fast-diff --------------------------------------------
     # (reference src/librbd/object_map/ + the fast-diff feature: one

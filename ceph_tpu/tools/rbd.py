@@ -37,6 +37,8 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--size", type=int, required=True)
     c.add_argument("--order", type=int, default=22)
     c.add_argument("--journaling", action="store_true")
+    c.add_argument("--mirror-snapshot", action="store_true",
+                   help="enable snapshot-based mirroring mode")
 
     sub.add_parser("ls")
     for name in ("info", "rm"):
@@ -83,7 +85,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--seconds", type=float, default=10.0)
 
     s = sub.add_parser("mirror")
-    s.add_argument("op", choices=["promote", "demote"])
+    s.add_argument("op", choices=["promote", "demote", "snapshot",
+                                  "status"])
     s.add_argument("name")
     return p
 
@@ -135,7 +138,8 @@ def main(argv=None) -> int:
         rbd = RBD()
         if a.cmd == "create":
             rbd.create(io, a.name, a.size, order=a.order,
-                       journaling=a.journaling)
+                       journaling=a.journaling,
+                       mirror_snapshot=a.mirror_snapshot)
             return 0
         if a.cmd == "ls":
             print("\n".join(rbd.list(io)))
@@ -226,8 +230,21 @@ def main(argv=None) -> int:
             print(json.dumps(rep))
             return 0
         if a.cmd == "mirror":
+            if a.op == "snapshot":
+                # reference `rbd mirror image snapshot`: stamp one
+                with Image(io, a.name) as img:
+                    print(img.mirror_snapshot_create())
+                return 0
             with Image(io, a.name, read_only=True) as img:
-                img.promote() if a.op == "promote" else img.demote()
+                if a.op == "status":
+                    print(json.dumps({
+                        "mode": img.mirror_mode(),
+                        "primary": img.is_primary(),
+                        "mirror_snapshots": img.mirror_snapshots(),
+                        "peer_synced": img.mirror_snap_committed()}))
+                else:
+                    (img.promote() if a.op == "promote"
+                     else img.demote())
             return 0
         return 1
     finally:

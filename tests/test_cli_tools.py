@@ -25,6 +25,24 @@ def mon_addr(cluster):
 
 
 class TestRbdCli:
+    def test_mirror_snapshot_verbs(self, mon_addr, capsys):
+        """`rbd mirror snapshot` / `rbd mirror status` over a live
+        cluster (snapshot-based mirroring mode, VERDICT r4 #6)."""
+        m = ["-m", mon_addr, "-p", "vols"]
+        assert rbd_cli.main(m + ["create", "mimg",
+                                 "--size", str(1 << 18),
+                                 "--order", "16",
+                                 "--mirror-snapshot"]) == 0
+        assert rbd_cli.main(m + ["mirror", "snapshot", "mimg"]) == 0
+        assert ".mirror.primary." in capsys.readouterr().out
+        assert rbd_cli.main(m + ["mirror", "status", "mimg"]) == 0
+        st = json.loads(capsys.readouterr().out)
+        assert st["mode"] == "snapshot" and st["primary"]
+        assert len(st["mirror_snapshots"]) == 1
+        assert rbd_cli.main(m + ["mirror", "demote", "mimg"]) == 0
+        assert rbd_cli.main(m + ["mirror", "status", "mimg"]) == 0
+        assert json.loads(capsys.readouterr().out)["primary"] is False
+
     def test_lifecycle(self, mon_addr, capsys, tmp_path):
         m = ["-m", mon_addr, "-p", "vols"]
         assert rbd_cli.main(m + ["create", "disk1",

@@ -17,7 +17,7 @@ def test_pallas_matches_oracle(k, m, batch, chunk):
     data = rng.integers(0, 256, size=(*batch, k, chunk), dtype=np.uint8)
     want = rs.encode_oracle(coding, data.reshape(-1, k, chunk)[0]) \
         if batch else rs.encode_oracle(coding, data)
-    enc = GFLinear(coding, backend="pallas-interpret")
+    enc = GFLinear(coding, backend="pallas-v1-interpret")
     got = np.asarray(enc(data))
     assert got.shape == (*batch, m, chunk)
     ref = GFLinear(coding, backend="xla")
@@ -32,13 +32,13 @@ def test_pallas_decode_roundtrip():
     rng = np.random.default_rng(7)
     data = rng.integers(0, 256, size=(k, 384), dtype=np.uint8)
     parity = np.asarray(GFLinear(coding,
-                                 backend="pallas-interpret")(data))
+                                 backend="pallas-v1-interpret")(data))
     # erase two data chunks, decode from survivors
     erasures = [0, 2]
     dm = rs.decode_matrix(coding, k, erasures)
     survivors = [i for i in range(k + m) if i not in erasures][:k]
     stack = np.stack([data[i] if i < k else parity[i - k]
                       for i in survivors])
-    dec = GFLinear(dm, backend="pallas-interpret")
+    dec = GFLinear(dm, backend="pallas-v1-interpret")
     out = np.asarray(dec(stack))
     assert np.array_equal(out, data)

@@ -1,0 +1,108 @@
+"""Byte-exactness of the v2 bit-sliced Pallas GF kernel (interpret
+mode on CPU; the real-TPU run is bench.py's pre-timing verify)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import rs
+from ceph_tpu.ops.gf_jax import _bit_layout_matrix
+from ceph_tpu.ops.gf_pallas2 import (gf_expand_words, gf_matmul_pallas2,
+                                     gf_matmul_planes)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 3), (8, 4)])
+@pytest.mark.parametrize("batch,chunk", [((), 512), ((3,), 1024),
+                                         ((2,), 700)])
+def test_v2_matches_oracle(k, m, batch, chunk):
+    coding = rs.reed_sol_van_matrix(k, m)
+    bitmat = _bit_layout_matrix(coding)
+    rng = np.random.default_rng(k * 100 + m)
+    data = rng.integers(0, 256, size=(*batch, k, chunk), dtype=np.uint8)
+    got = np.asarray(gf_matmul_pallas2(bitmat, data, m, interpret=True))
+    assert got.shape == (*batch, m, chunk)
+    flat = data.reshape(-1, k, chunk)
+    want = np.stack([rs.encode_oracle(coding, d) for d in flat])
+    assert np.array_equal(got.reshape(-1, m, chunk), want)
+
+
+def test_v2_decode_roundtrip():
+    k, m = 8, 4
+    coding = rs.reed_sol_van_matrix(k, m)
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, size=(k, 2048), dtype=np.uint8)
+    parity = np.asarray(gf_matmul_pallas2(
+        _bit_layout_matrix(coding), data, m, interpret=True))
+    erasures = [1, 6, 9]
+    dm = rs.decode_matrix(coding, k, erasures)
+    survivors = [i for i in range(k + m) if i not in erasures][:k]
+    stack = np.stack([data[i] if i < k else parity[i - k]
+                      for i in survivors])
+    out = np.asarray(gf_matmul_pallas2(
+        _bit_layout_matrix(dm), stack, dm.shape[0], interpret=True))
+    assert np.array_equal(out[:k], data)
+
+
+def test_v2_odd_lane_padding():
+    """n not divisible by 512 → zero-pad path must stay exact."""
+    k, m = 4, 2
+    coding = rs.reed_sol_van_matrix(k, m)
+    bitmat = _bit_layout_matrix(coding)
+    rng = np.random.default_rng(3)
+    for n in (4, 100, 513, 4096 + 36):
+        data = rng.integers(0, 256, size=(k, n), dtype=np.uint8)
+        got = np.asarray(gf_matmul_pallas2(bitmat, data, m,
+                                           interpret=True))
+        want = rs.encode_oracle(coding, data)
+        assert np.array_equal(got, want), n
+
+
+def test_resident_planes_match_fused():
+    """expand-once + multiply-many == the fused kernel: the recovery
+    path can keep survivors expanded across several decode matrices
+    (VERDICT r4 #1 'expand once per buffer lifetime')."""
+    k, m = 8, 3
+    coding = rs.reed_sol_van_matrix(k, m)
+    bitmat = _bit_layout_matrix(coding)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, size=(2, k, 1024), dtype=np.uint8)
+    planes = np.asarray(gf_expand_words(data))
+    assert planes.shape == (2, 32 * k, 1024 // 4)
+    fused = np.asarray(gf_matmul_pallas2(bitmat, data, m,
+                                         interpret=True))
+    from_planes = np.asarray(gf_matmul_planes(bitmat, planes, m,
+                                              interpret=True))
+    assert np.array_equal(fused, from_planes)
+    # a second matrix over the SAME planes (multi-target reconstruct)
+    dm = rs.decode_matrix(coding, k, [0, 2])
+    got2 = np.asarray(gf_matmul_planes(
+        _bit_layout_matrix(dm), planes, dm.shape[0], interpret=True))
+    want2 = np.stack([rs.encode_oracle(dm, d) for d in data])
+    assert np.array_equal(got2, want2)
+
+
+def test_gflinear_pallas_backend_is_v2():
+    """GFLinear's production "pallas" backend routes to the v2 kernel
+    and stays byte-exact through the class interface."""
+    from ceph_tpu.ops.gf_jax import GFLinear
+    k, m = 8, 3
+    coding = rs.reed_sol_van_matrix(k, m)
+    rng = np.random.default_rng(21)
+    data = rng.integers(0, 256, size=(2, k, 640), dtype=np.uint8)
+    enc = GFLinear(coding, backend="pallas-interpret")
+    got = np.asarray(enc(data))
+    want = np.stack([rs.encode_oracle(coding, d) for d in data])
+    assert np.array_equal(got, want)
+
+
+def test_v2_vs_v1_kernel():
+    """Old and new kernels agree bit-for-bit (the bench's roofline
+    comparison depends on both being the same map)."""
+    from ceph_tpu.ops.gf_pallas import gf_matmul_pallas
+    k, m = 8, 3
+    coding = rs.reed_sol_van_matrix(k, m)
+    bitmat = _bit_layout_matrix(coding)
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=(4, k, 512), dtype=np.uint8)
+    v1 = np.asarray(gf_matmul_pallas(bitmat, data, m, interpret=True))
+    v2 = np.asarray(gf_matmul_pallas2(bitmat, data, m, interpret=True))
+    assert np.array_equal(v1, v2)
