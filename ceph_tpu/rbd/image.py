@@ -709,8 +709,13 @@ class Image:
         self._require_writable()
         plen = len(self.MIRROR_SNAP_PREFIX)
         nums = [int(nm[plen:]) for _, nm in self.mirror_snapshots()]
-        name = f"{self.MIRROR_SNAP_PREFIX}{max(nums, default=0) + 1}"
-        self.create_snap(name)
+        # monotonic even when older stamps were removed: reusing a
+        # number would alias a NEW delta under a name the peer already
+        # synced (silent divergence), so the header keeps the floor
+        nxt = max([self._hdr.get("mirror_snap_seq", 0), *nums]) + 1
+        self._hdr["mirror_snap_seq"] = nxt
+        name = f"{self.MIRROR_SNAP_PREFIX}{nxt}"
+        self.create_snap(name)        # persists the header too
         self._prune_mirror_snapshots()
         return name
 

@@ -78,6 +78,17 @@ class MirrorDaemon:
                 applied += self._replay_image(name, rimg)
         return applied
 
+    def _resync_local(self, name: str):
+        """Drop the local non-primary copy so the next pass
+        re-bootstraps it in full (reference `rbd mirror image
+        resync`)."""
+        try:
+            self.rbd.remove(self.local, name)
+        except Exception as e:      # noqa: BLE001 — leave it for the
+            self.errors.append(     # operator if removal also fails
+                f"resync removal of {name!r} failed: {e!r}")
+        self.positions.pop(name, None)
+
     # -- snapshot-mode sync (reference rbd_mirror snapshot replayer) ------
     def _sync_snapshot_image(self, name: str, rimg: Image) -> int:
         """Ship the delta between consecutive primary mirror
@@ -125,11 +136,30 @@ class MirrorDaemon:
                 finally:
                     src.close()
             except ImageNotFound as e:
-                # the primary pruned/changed snapshots under us; stop
-                # this pass and re-resolve the chain on the next one
-                self.errors.append(
-                    f"snapshot chain moved on primary for {name!r}: "
-                    f"{e}")
+                # re-read the primary's snap table: if our diff BASE
+                # is truly gone there the chain cannot re-resolve on
+                # its own — resync from scratch (the reference's
+                # `rbd mirror image resync`: drop the local copy and
+                # re-bootstrap); anything else is a transient race
+                # with a concurrent stamp/prune — retry next pass
+                base_gone = False
+                if base is not None:
+                    try:
+                        with Image(self.remote, name,
+                                   read_only=True) as fresh:
+                            base_gone = base not in \
+                                fresh._hdr["snaps"]
+                    except ImageNotFound:
+                        pass
+                if base_gone:
+                    self.errors.append(
+                        f"mirror chain broken for {name!r} (base "
+                        f"{base!r} removed on primary): resyncing")
+                    self._resync_local(name)
+                else:
+                    self.errors.append(
+                        f"snapshot chain moved on primary for "
+                        f"{name!r}: {e}")
                 return applied
             limg._replaying = True
             try:

@@ -167,8 +167,9 @@ class RGWStore:
                            meta)
 
     def _index_rm(self, bucket: str, key: str):
-        self.meta.omap_rm_keys(self._key_index_oid(bucket, key),
-                               [key])
+        oid = self._key_index_oid(bucket, key)
+        self.meta.omap_rm_keys(oid, [key])
+        self._bilog_append(oid, {"op": "del", "key": key})
 
     def _shard_lock(self, bucket: str, key: str):
         """The write lock for `key`'s index shard: PUT/DELETE on
@@ -198,6 +199,92 @@ class RGWStore:
 
     def _index_set_at(self, oid: str, key: str, meta: dict):
         self.meta.omap_set(oid, {key: json.dumps(meta).encode()})
+        rec = {"op": "put", "key": key,
+               "etag": meta.get("etag", "")}
+        if meta.get("delete_marker"):
+            rec["op"] = "del"          # current version is a marker
+        self._bilog_append(oid, rec)
+
+    # -- bucket index log (reference rgw bilog: cls_rgw bi_log_*) ----------
+    # Every index-row mutation appends an entry to the shard's log so
+    # multisite data sync can consume per-shard deltas instead of
+    # re-listing buckets.  The log is capped (reference: bilog trim);
+    # a consumer that falls behind the cap sees a seq gap and falls
+    # back to full sync for that bucket.
+    _BILOG_KEEP = 512
+    _BILOG_TRIM_EVERY = 64
+
+    @staticmethod
+    def _bilog_oid(index_oid: str) -> str:
+        return f"bilog.{index_oid}"
+
+    def _bilog_append(self, index_oid: str, rec: dict):
+        oid = self._bilog_oid(index_oid)
+        try:
+            rows = self.meta.omap_get(oid, keys=["head", "tail"])
+            head = int(rows.get("head", b"0"))
+            tail = int(rows.get("tail", b"0"))
+        except ObjectNotFound:
+            head = tail = 0
+        head += 1
+        self.meta.omap_set(oid, {
+            f"e{head:016d}": json.dumps(rec).encode(),
+            "head": str(head).encode()})
+        if head % self._BILOG_TRIM_EVERY == 0:
+            # entry keys are deterministic, so the cap-trim computes
+            # the dead window from the persisted tail instead of
+            # re-reading the whole log on the object-write hot path
+            floor = head - self._BILOG_KEEP
+            if floor > tail:
+                self.meta.omap_rm_keys(oid, [
+                    f"e{s:016d}" for s in range(tail + 1, floor + 1)])
+                self.meta.omap_set(oid, {
+                    "tail": str(floor).encode()})
+
+    def bilog_shards(self, bucket: str) -> int:
+        """Number of index shards (1 for legacy unsharded buckets)."""
+        return self._bucket_shards(bucket) or 1
+
+    def _bilog_shard_oid(self, bucket: str, shard: int) -> str:
+        n = self._bucket_shards(bucket)
+        ioid = _shard_oid(bucket, shard) if n else _index_oid(bucket)
+        return self._bilog_oid(ioid)
+
+    def bilog_head(self, bucket: str, shard: int) -> int:
+        try:
+            rows = self.meta.omap_get(
+                self._bilog_shard_oid(bucket, shard), keys=["head"])
+        except ObjectNotFound:
+            return 0
+        return int(rows.get("head", b"0"))
+
+    def bilog_entries(self, bucket: str, shard: int,
+                      after: int = 0) -> list[tuple[int, dict]]:
+        """Shard log entries with seq > after, in order."""
+        try:
+            rows = self.meta.omap_get(
+                self._bilog_shard_oid(bucket, shard))
+        except ObjectNotFound:
+            return []
+        out = []
+        for k, v in rows.items():
+            if k.startswith("e") and int(k[1:]) > after:
+                out.append((int(k[1:]), json.loads(bytes(v))))
+        return sorted(out)
+
+    def bilog_trim(self, bucket: str, shard: int, upto: int):
+        """Drop consumed entries (reference: radosgw-admin bilog trim
+        / the sync-driven trim once every peer passed `upto`)."""
+        oid = self._bilog_shard_oid(bucket, shard)
+        try:
+            rows = self.meta.omap_get(oid, keys=["tail"])
+            tail = int(rows.get("tail", b"0"))
+        except ObjectNotFound:
+            return
+        if upto > tail:
+            self.meta.omap_rm_keys(oid, [
+                f"e{s:016d}" for s in range(tail + 1, upto + 1)])
+            self.meta.omap_set(oid, {"tail": str(upto).encode()})
 
     def _ver_lock(self, bucket: str):
         """Version-sequence lock (one per bucket); always taken INSIDE
@@ -312,7 +399,12 @@ class RGWStore:
             return False
         if self.bucket_exists(bucket):
             return True     # re-create keeps the existing shard count
-        row = {"name": bucket, "num_shards": index_shards}
+        import secrets
+        # a fresh incarnation token: multisite sync markers recorded
+        # against a deleted+recreated bucket of the same name must
+        # not be trusted (its bilog seqs restarted from zero)
+        row = {"name": bucket, "num_shards": index_shards,
+               "gen": secrets.token_hex(8)}
         if owner:
             row["owner"] = owner
         self.meta.omap_set(BUCKETS_OID, {
@@ -333,6 +425,11 @@ class RGWStore:
     def bucket_owner(self, bucket: str) -> str | None:
         row = self._bucket_row(bucket)
         return row.get("owner") if row else None
+
+    def bucket_gen(self, bucket: str) -> str | None:
+        """Incarnation token minted at create (None for legacy rows)."""
+        row = self._bucket_row(bucket)
+        return row.get("gen") if row else None
 
     # -- bucket policies (reference rgw IAM-ish policies) ------------------
     def set_bucket_policy(self, bucket: str, policy: dict):
@@ -499,10 +596,11 @@ class RGWStore:
                                    [bucket, f"lc.{bucket}",
                                     f"policy.{bucket}"])
         for oid in {*oids, _index_oid(bucket)}:
-            try:
-                self.meta.remove(oid)
-            except Exception:
-                pass
+            for o in (oid, self._bilog_oid(oid)):
+                try:
+                    self.meta.remove(o)
+                except Exception:
+                    pass
         return True
 
     def bucket_exists(self, bucket: str) -> bool:

@@ -1,22 +1,29 @@
 """RGW multisite sync — master→secondary zone replication.
 
 Reference behavior re-created (``src/rgw/rgw_data_sync.cc`` +
-``rgw_sync.cc``; SURVEY.md §3.9 "multisite async replication"), at
-slice scale: a sync daemon running near the SECONDARY zone polls the
-master zone's bucket indexes and converges the secondary —
-creating buckets, copying new/changed objects (ETag-diffed, so
-unchanged objects cost one index read and no data movement),
-applying deletions, and removing buckets deleted on the master.
-Like the reference (and rbd-mirror), replication is PULL and
-asynchronous; the secondary is read-only by convention.
+``rgw_sync.cc``; SURVEY.md §3.9 "multisite async replication"): a
+sync daemon running near the SECONDARY zone replicates in two phases
+per bucket, exactly like the reference's data sync state machine:
 
-Versioned buckets replicate their CURRENT objects (the reference
-syncs olh current versions the same way; history stays zone-local
-in this slice).
+- **full sync** (bootstrap): converge on the master's listing
+  (ETag-diffed, so unchanged objects cost one index read and no data
+  movement), then record per-shard markers at the bilog heads;
+- **incremental sync** (steady state): consume each index shard's
+  bucket-index log (`RGWStore.bilog_entries`) after the recorded
+  marker — per-entry apply with per-entry marker advance, retry from
+  the marker on failure, and bilog trim once consumed.  A marker that
+  has fallen behind the capped log (seq gap) falls back to full sync
+  for that bucket, as the reference does on sync errors.
+
+Like the reference (and rbd-mirror), replication is PULL and
+asynchronous; the secondary is read-only by convention.  Versioned
+buckets replicate their CURRENT objects (the reference syncs olh
+current versions the same way; history stays zone-local).
 """
 
 from __future__ import annotations
 
+import json
 import threading
 
 from .gateway import RGWStore
@@ -34,6 +41,11 @@ class RGWSyncDaemon:
         self.errors: list[str] = []
         self.copied = 0
         self.deleted = 0
+        # observability: how the work arrived (the incremental path
+        # must NOT re-list converged buckets — tests pin this)
+        self.full_syncs = 0
+        self.log_applied = 0
+        self.retries = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -75,6 +87,35 @@ class RGWSyncDaemon:
             return {}
         return {k: bytes(v).decode() for k, v in rows.items()}
 
+    # -- per-shard incremental markers ------------------------------------
+    @staticmethod
+    def _shard_marker_oid(bucket: str) -> str:
+        return f"sync-shard-markers.{bucket}"
+
+    def _shard_markers(self, bucket: str) -> dict[int, int] | None:
+        """{shard: last consumed bilog seq}, or None before the
+        bucket's full sync has completed OR when the bucket was
+        deleted+recreated on the master since (its incarnation token
+        changed, so the recorded seqs describe a dead log)."""
+        try:
+            rows = self.secondary.meta.omap_get(
+                self._shard_marker_oid(bucket))
+        except Exception:
+            return None
+        if not rows:
+            return None
+        gen = bytes(rows.pop("gen", b"")).decode() or None
+        if gen != self.master.bucket_gen(bucket):
+            self._restart_full_sync(
+                bucket, "bucket recreated on master (gen changed)")
+            return None
+        return {int(k): int(v) for k, v in rows.items()}
+
+    def _save_shard_marker(self, bucket: str, shard: int, seq: int):
+        self.secondary.meta.omap_set(
+            self._shard_marker_oid(bucket),
+            {str(shard): str(seq).encode()})
+
     # -- one convergence pass ---------------------------------------------
     def sync_once(self) -> int:
         """→ number of objects copied or deleted this pass."""
@@ -86,26 +127,11 @@ class RGWSyncDaemon:
             if self.master.versioning_enabled(bucket) and \
                     not self.secondary.versioning_enabled(bucket):
                 self.secondary.set_versioning(bucket, True)
-            src = self.master.list_objects(bucket)
-            markers = self._markers(bucket)
-            for key, meta in src.items():
-                if markers.get(key) == meta.get("etag"):
-                    continue            # marker-equal: nothing to move
-                body, _ = self.master.get_object(bucket, key)
-                self.secondary.put_object(bucket, key, body)
-                self.secondary.meta.omap_set(
-                    self._marker_oid(bucket),
-                    {key: str(meta.get("etag", "")).encode()})
-                self.copied += 1
-                work += 1
-            stale = [k for k in markers if k not in src]
-            for key in stale:
-                self.secondary.delete_object(bucket, key)
-                self.deleted += 1
-                work += 1
-            if stale:
-                self.secondary.meta.omap_rm_keys(
-                    self._marker_oid(bucket), stale)
+            markers = self._shard_markers(bucket)
+            if markers is None:
+                work += self._full_sync_bucket(bucket)
+            else:
+                work += self._incremental_sync_bucket(bucket, markers)
         # buckets deleted on the master disappear here too
         for bucket in self.secondary.list_buckets():
             if bucket in master_buckets:
@@ -119,9 +145,131 @@ class RGWSyncDaemon:
                 self.secondary.delete_object(bucket, e["key"],
                                              e["version_id"])
             self.secondary.delete_bucket(bucket)
-            try:
-                self.secondary.meta.remove(self._marker_oid(bucket))
-            except Exception:
-                pass
+            for oid in (self._marker_oid(bucket),
+                        self._shard_marker_oid(bucket)):
+                try:
+                    self.secondary.meta.remove(oid)
+                except Exception:
+                    pass
             work += 1
         return work
+
+    def _full_sync_bucket(self, bucket: str) -> int:
+        """Bootstrap convergence on the master's full listing, then
+        arm the per-shard markers at the bilog heads observed BEFORE
+        the listing (entries racing the listing replay harmlessly —
+        the ops are idempotent)."""
+        self.full_syncs += 1
+        heads = {s: self.master.bilog_head(bucket, s)
+                 for s in range(self.master.bilog_shards(bucket))}
+        work = 0
+        src = self.master.list_objects(bucket)
+        markers = self._markers(bucket)
+        for key, meta in src.items():
+            if markers.get(key) == meta.get("etag"):
+                continue            # marker-equal: nothing to move
+            body, _ = self.master.get_object(bucket, key)
+            self.secondary.put_object(bucket, key, body)
+            self.secondary.meta.omap_set(
+                self._marker_oid(bucket),
+                {key: str(meta.get("etag", "")).encode()})
+            self.copied += 1
+            work += 1
+        stale = [k for k in markers if k not in src]
+        for key in stale:
+            self.secondary.delete_object(bucket, key)
+            self.deleted += 1
+            work += 1
+        if stale:
+            self.secondary.meta.omap_rm_keys(
+                self._marker_oid(bucket), stale)
+        for shard, head in heads.items():
+            self._save_shard_marker(bucket, shard, head)
+        gen = self.master.bucket_gen(bucket)
+        if gen:
+            self.secondary.meta.omap_set(
+                self._shard_marker_oid(bucket),
+                {"gen": gen.encode()})
+        return work
+
+    def _restart_full_sync(self, bucket: str, why: str):
+        """Drop the shard markers so the next pass re-bootstraps
+        (reference: sync error → full sync for the bucket)."""
+        self.errors.append(f"{bucket!r}: {why}; scheduling full sync")
+        try:
+            self.secondary.meta.remove(self._shard_marker_oid(bucket))
+        except Exception:
+            pass
+
+    def _incremental_sync_bucket(self, bucket: str,
+                                 markers: dict[int, int]) -> int:
+        """Consume each index shard's bilog past its marker: apply,
+        advance the marker per entry, trim consumed entries.  A
+        failed entry stops THAT shard (retry from the marker next
+        pass); a seq gap (log trimmed past us) falls back to full
+        sync."""
+        work = 0
+        for shard in range(self.master.bilog_shards(bucket)):
+            marker = markers.get(shard, 0)
+            entries = self.master.bilog_entries(bucket, shard,
+                                                after=marker)
+            if entries and entries[0][0] > marker + 1:
+                self._restart_full_sync(
+                    bucket, f"shard {shard} bilog gap "
+                            f"(marker {marker}, oldest "
+                            f"{entries[0][0]})")
+                return work
+            if not entries:
+                head = self.master.bilog_head(bucket, shard)
+                if head != marker:
+                    # appends happened but were trimmed past us (or
+                    # the log was reset under a recreated bucket)
+                    self._restart_full_sync(
+                        bucket, f"shard {shard} bilog empty at head "
+                                f"{head} vs marker {marker}")
+                    return work
+                continue
+            for seq, rec in entries:
+                try:
+                    self._apply_log_entry(bucket, rec)
+                except Exception as e:      # noqa: BLE001 — zone
+                    # hiccup: keep the marker, retry next pass
+                    self.retries += 1
+                    self.errors.append(
+                        f"{bucket!r} shard {shard} seq {seq}: {e!r}")
+                    break
+                marker = seq
+                self._save_shard_marker(bucket, shard, marker)
+                self.log_applied += 1
+                work += 1
+            if marker > markers.get(shard, 0):
+                # sole-peer trim (the reference trims once every zone
+                # has consumed; this slice has one secondary)
+                self.master.bilog_trim(bucket, shard, marker)
+        return work
+
+    def _apply_log_entry(self, bucket: str, rec: dict):
+        """Apply one bilog entry AND keep the full-sync ETag markers
+        coherent: a later gap-triggered full sync diffs against those
+        rows, so an incremental put/delete that skipped them would
+        make that full sync miss deletions (stale-scan can't see the
+        key) or skip re-copies (stale etag happens to match)."""
+        key = rec["key"]
+        if rec["op"] == "del":
+            try:
+                self.secondary.delete_object(bucket, key)
+                self.deleted += 1
+            except KeyError:
+                pass                    # already gone — idempotent
+            self.secondary.meta.omap_rm_keys(
+                self._marker_oid(bucket), [key])
+            return
+        try:
+            body, meta = self.master.get_object(bucket, key)
+        except KeyError:
+            return      # deleted since; the del entry follows
+        self.secondary.put_object(bucket, key, body)
+        self.secondary.meta.omap_set(
+            self._marker_oid(bucket),
+            {key: str(meta.get("etag", "")).encode()})
+        self.copied += 1

@@ -222,6 +222,30 @@ class TestSnapshotMirroring:
             nxt = s.mirror_snapshot_create()    # must not collide
         assert nxt == ".mirror.primary.2"
 
+    def test_broken_chain_triggers_resync(self, sites):
+        """Review r5: if an operator removes the secondary's diff
+        base on the primary, replication must resync (drop + full
+        re-bootstrap, the reference's `rbd mirror image resync`)
+        instead of stalling forever."""
+        pio, sio = sites
+        rbd = RBD()
+        rbd.create(pio, "chainb", 1 << 16, order=16,
+                   mirror_snapshot=True)
+        with Image(pio, "chainb") as img:
+            img.write(0, b"v1-data")
+            b1 = img.mirror_snapshot_create()
+        d = MirrorDaemon(pio, sio, interval=0.05)
+        assert d.sync_once() == 1
+        # operator removes the base on the primary, then stamps anew
+        with Image(pio, "chainb") as img:
+            img.remove_snap(b1)
+            img.write(0, b"v2-data")
+            img.mirror_snapshot_create()
+        d.sync_once()       # detects broken chain, drops local copy
+        assert any("resync" in e for e in d.errors)
+        assert d.sync_once() >= 1           # re-bootstraps in full
+        assert Image(sio, "chainb").read(0, 7) == b"v2-data"
+
     def test_journal_and_snapshot_modes_exclusive(self, sites):
         pio, _sio = sites
         with pytest.raises(ValueError, match="not both"):
