@@ -54,9 +54,15 @@ DECODE_ERASURES = (0, 9)             # one data, one parity shard lost
 def _cpu_env() -> dict:
     """Child env that cannot touch the TPU tunnel: JAX_PLATFORMS=cpu
     AND the axon sitecustomize dropped from PYTHONPATH (it contacts
-    the relay at `import site`, before any user code runs)."""
+    the relay at `import site`, before any user code runs).  An
+    8-device virtual CPU mesh lets the reconstruct leg exercise the
+    real all-gather collectives (BASELINE row 5)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
              if p and "axon" not in p]
     env["PYTHONPATH"] = os.pathsep.join([REPO] + parts)
@@ -320,6 +326,87 @@ def _ec_sweep(on_tpu: bool):
     return sweep, base_label, enc.backend
 
 
+def _reconstruct_leg(on_tpu: bool):
+    """Degraded-read reconstruct over the (dp, shard) mesh (BASELINE
+    row 5): k=8,m=4 survivors all-gathered over ICI (real collectives
+    on the 8-device virtual CPU mesh today; the same program rides a
+    TPU slice's ICI when one is attached).  Denominator: the native
+    single-core k×k inverse-submatrix multiply on the same bytes."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ceph_tpu.ops import rs
+    from ceph_tpu.parallel import ShardedEC, make_mesh
+
+    k, m = 8, 4
+    erasures = (0, 5, 9)            # two data chunks + one parity
+    coding = rs.reed_sol_van_matrix(k, m)
+    mesh = make_mesh(len(jax.devices()))
+    sec = ShardedEC(coding, k, m, mesh)
+
+    C = (1 << 20) // k              # 1 MiB logical stripes
+    per_batch = 16 * mesh.shape["dp"]
+    iters = 10 if on_tpu else 2
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, size=(per_batch, k, C),
+                        dtype=np.uint8)
+    padded = sec.shard_array(sec.pad_data(data),
+                             P("dp", "shard", None))
+    parity = sec.encode(padded)
+    B = per_batch
+    all_chunks = sec.shard_array(
+        np.asarray(sec.assemble_chunks(padded, parity)),
+        P("dp", "shard", None))
+    # byte-exactness BEFORE timing (stripe 0 vs the submitted data)
+    rec = np.asarray(sec.reconstruct(all_chunks, erasures))
+    assert np.array_equal(rec, data), "reconstruct mismatch"
+
+    decode = sec._decode_fn(tuple(sorted(erasures)))
+
+    @jax.jit
+    def loop(ch):
+        def body(_, c):
+            r = decode(c)
+            # xor-fold the recovery back into the data rows: each
+            # iteration depends on the last (relay-cache immunity)
+            return c.at[:, :k].set(
+                jnp.bitwise_xor(c[:, :k], r))
+        out = jax.lax.fori_loop(0, iters, body, ch)
+        return jnp.sum(out.astype(jnp.uint32))
+
+    warm = sec.shard_array(
+        np.asarray(all_chunks) ^ np.uint8(0xFF),
+        P("dp", "shard", None))
+    int(loop(warm))
+    t0 = time.perf_counter()
+    int(loop(all_chunks))
+    dt = time.perf_counter() - t0
+    gbps = iters * B * k * C / dt / 1e9
+
+    out = {"k": k, "m": m, "erasures": list(erasures),
+           "mesh": dict(mesh.shape),
+           "stripes": B, "stripe_bytes": k * C,
+           "reconstruct_GBps": round(gbps, 3)}
+    try:
+        from ceph_tpu import native
+        if native.available():
+            dm = rs.decode_matrix(coding, k, list(erasures))
+            nat = native.NativeEC(k, m)
+            sdata = rng.integers(0, 256, size=(B, k, C),
+                                 dtype=np.uint8)
+            nat.encode_batch(sdata, matrix=dm)      # warm
+            t0 = time.perf_counter()
+            for _ in range(2):
+                nat.encode_batch(sdata, matrix=dm)
+            base = 2 * B * k * C / (time.perf_counter() - t0) / 1e9
+            out["baseline_GBps"] = round(base, 3)
+            out["vs_baseline"] = round(gbps / base, 2)
+    except Exception as e:          # noqa: BLE001 — keep the leg
+        out["baseline_error"] = str(e)[:160]
+    return out
+
+
 def _crush_leg():
     """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
     (BASELINE.md row 4, scaled to fit a bench-run budget)."""
@@ -354,6 +441,10 @@ def child_main():
                "unit": "GB/s", "vs_baseline": 0,
                "platform": jax.default_backend(),
                "error": str(e)[:300]}
+    try:
+        out["reconstruct"] = _reconstruct_leg(on_tpu)
+    except Exception as e:        # keep the EC headline even if broken
+        out["reconstruct"] = {"error": str(e)[:200]}
     if not on_tpu and "CRUSH_BENCH_BUDGET_S" not in os.environ:
         os.environ["CRUSH_BENCH_BUDGET_S"] = "30"
     out["crush"] = _crush_leg()

@@ -149,54 +149,95 @@ class BatchMapper:
         self.chunk = chunk
         t = cmap.tunables
 
-        # --- parse the rule into (take, one choose step, emit) -----------
+        # --- parse the rule: take + a CHAIN of choose steps + emit -------
+        # (the reference rule VM, `crush_do_rule`: each choose step's
+        # outputs become the next step's roots; set_* steps override
+        # tunables for the steps that follow)
         take = None
-        choose = None
+        chain: list[dict] = []
         tries = t.choose_total_tries
         leaf_tries = 0
+        vary_r = t.chooseleaf_vary_r
+        stable = t.chooseleaf_stable
+        local_tries = t.choose_local_tries
+        local_fb = t.choose_local_fallback_tries
+        emitted = False
         for s in rule.steps:
             if s.op == "take":
+                if take is not None or emitted:
+                    raise NotImplementedError(
+                        "multiple take/emit blocks: use the scalar "
+                        "oracle")
                 take = s.arg1
             elif s.op == "set_choose_tries":
                 tries = s.arg1 if s.arg1 > 0 else tries
             elif s.op == "set_chooseleaf_tries":
                 leaf_tries = s.arg1 if s.arg1 > 0 else leaf_tries
+            elif s.op == "set_chooseleaf_vary_r":
+                vary_r = s.arg1 if s.arg1 >= 0 else vary_r
+            elif s.op == "set_chooseleaf_stable":
+                stable = s.arg1 if s.arg1 >= 0 else stable
+            elif s.op == "set_choose_local_tries":
+                local_tries = s.arg1 if s.arg1 >= 0 else local_tries
+            elif s.op == "set_choose_local_fallback_tries":
+                local_fb = s.arg1 if s.arg1 >= 0 else local_fb
             elif s.op in ("choose_firstn", "chooseleaf_firstn",
                           "choose_indep", "chooseleaf_indep"):
-                if choose is not None:
-                    raise NotImplementedError(
-                        "multi-step choose chains: use the scalar oracle")
-                choose = s
+                chain.append({
+                    "op": s.op, "numrep": s.arg1, "target": s.arg2,
+                    "firstn": s.op.endswith("firstn"),
+                    "leaf": s.op.startswith("chooseleaf"),
+                    "tries": tries, "leaf_tries": leaf_tries,
+                    "vary_r": vary_r, "stable": stable,
+                })
             elif s.op == "emit":
-                pass
+                emitted = True
             else:
                 raise NotImplementedError(f"rule step {s.op}: use the oracle")
-        if take is None or choose is None:
+        if take is None or not chain:
             raise ValueError("rule must contain take and a choose step")
-        if t.chooseleaf_vary_r != 1 or t.chooseleaf_stable != 1 \
-                or t.choose_local_tries or t.choose_local_fallback_tries:
+        if local_tries or local_fb:
             raise NotImplementedError(
-                "non-default tunables: use the scalar oracle")
+                "choose_local(_fallback)_tries: use the scalar oracle")
+        if any(st["leaf"] for st in chain[:-1]):
+            raise NotImplementedError(
+                "chooseleaf mid-chain: use the scalar oracle")
+        if len(chain) > 1 and not all(st["firstn"] for st in chain):
+            raise NotImplementedError(
+                "indep in a multi-step chain: use the scalar oracle")
 
-        self.firstn = choose.op.endswith("firstn")
-        self.recurse = choose.op.startswith("chooseleaf")
-        self.target_type = choose.arg2
-        numrep = choose.arg1
+        choose = chain[-1]
+        self.firstn = choose["firstn"]
+        self.recurse = choose["leaf"]
+        self.target_type = choose["target"]
+        numrep = choose["numrep"]
         if result_max is None:
             if numrep <= 0:
                 raise ValueError("numrep<=0 rule needs explicit result_max")
             result_max = numrep
+            for st in chain[:-1]:
+                if st["numrep"] <= 0:
+                    raise ValueError(
+                        "numrep<=0 chain needs explicit result_max")
+                result_max *= st["numrep"]
         if numrep <= 0:
             numrep += result_max
         self.numrep = min(numrep, result_max)
         self.result_max = result_max
-        self.tries = tries
-        if self.firstn:
-            self.recurse_tries = (leaf_tries if leaf_tries
-                                  else (1 if t.chooseleaf_descend_once
-                                        else tries))
-        else:
-            self.recurse_tries = leaf_tries if leaf_tries else 1
+        # resolved per-step reps + retry budgets
+        for st in chain:
+            n = st["numrep"]
+            st["reps"] = n + result_max if n <= 0 else n
+            if st["firstn"]:
+                st["rtries"] = (st["leaf_tries"] if st["leaf_tries"]
+                                else (1 if t.chooseleaf_descend_once
+                                      else st["tries"]))
+            else:
+                st["rtries"] = (st["leaf_tries"] if st["leaf_tries"]
+                                else 1)
+        self.chain = chain
+        self.tries = choose["tries"]
+        self.recurse_tries = choose["rtries"]
         self.take = take
 
         # --- flatten the bucket table ------------------------------------
@@ -259,12 +300,20 @@ class BatchMapper:
                             aw[p, row, col] = _magicu64(d)
         self._wmagic = (mw, sw, aw)
         # descent depths + per-step size bounds: at BFS step t from
-        # `take` only a statically-known set of buckets can be under
-        # the cursor, so each straw2 scans that step's max bucket size
-        # instead of the global max (the canonical root→rack→host map
-        # has a size-1 top level that would otherwise pay a full-S
-        # hash+argmax per element)
-        self.step_sizes1 = self._bfs_step_sizes([take], self.target_type)
+        # the possible roots only a statically-known set of buckets
+        # can be under the cursor, so each straw2 scans that step's
+        # max bucket size instead of the global max (the canonical
+        # root→rack→host map has a size-1 top level that would
+        # otherwise pay a full-S hash+argmax per element).  Chain
+        # step i descends from step i-1's target-type buckets.
+        prev_starts = [take]
+        for st in chain:
+            st["step_sizes"] = self._bfs_step_sizes(prev_starts,
+                                                    st["target"])
+            prev_starts = [b.id for b in cmap.buckets
+                           if b is not None
+                           and b.type == st["target"]]
+        self.step_sizes1 = chain[-1]["step_sizes"]
         self.d1 = len(self.step_sizes1)
         if self.recurse:
             starts = [b.id for b in cmap.buckets
@@ -392,21 +441,29 @@ class BatchMapper:
         leafmode = self.recurse and target != 0
         sizes1, sizes2 = self.step_sizes1, self.step_sizes2
         take = self.take
-        vary_r = self.cmap.tunables.chooseleaf_vary_r
+        chain = self.chain
+        result_max = self.result_max
+        vary_r = chain[-1]["vary_r"]
 
-        def leaf_attempts(host, x, r, prev_leafs, wdev, pos):
+        def leaf_attempts(host, x, r, prev_leafs, wdev, pos, cfg,
+                          rep0_leaf=None):
             """Inner chooseleaf: ≤ rtries attempts inside `host`.
 
-            C: nested crush_choose_firstn(numrep=1, tries=rtries,
-            parent_r=sub_r) with stable=1 — the recursive call keeps
-            the OUTER outpos as the choose_args position.  `prev_leafs`
-            is the [B, numrep] leaf array so far (NONE-padded — NONE
-            never equals a valid device).  Returns (leaf, got)."""
-            sub_r = (r >> (vary_r - 1)) if vary_r else jnp.zeros_like(r)
+            C: nested crush_choose_firstn(numrep=1 if stable else
+            outpos+1, tries=rtries, parent_r=sub_r) — one leaf either
+            way, but stable=0 offsets the inner r by the current
+            outpos.  The recursive call keeps the OUTER outpos as the
+            choose_args position.  `prev_leafs` is the leaf array so
+            far (NONE-padded — NONE never equals a valid device).
+            Returns (leaf, got)."""
+            vr = cfg["vary_r"]
+            sub_r = (r >> (vr - 1)) if vr else jnp.zeros_like(r)
+            if rep0_leaf is not None:
+                sub_r = sub_r + rep0_leaf
             got = jnp.zeros(r.shape, dtype=bool)
             dead = jnp.zeros(r.shape, dtype=bool)
             leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
-            for ft in range(rtries):
+            for ft in range(cfg["rtries"]):
                 ri = sub_r + np.int32(ft)
                 cand = descend(host, x, ri, 0, sizes2, pos)
                 valid = (cand >= 0) & (host < 0)
@@ -419,27 +476,33 @@ class BatchMapper:
                 dead |= active & ~valid   # C: skip_rep — no more attempts
             return leaf, got
 
-        def rep_while(x, out, leafs, wdev, st0, rep):
-            """The general retry loop for one firstn rep — the
-            original traced body, shape-polymorphic so the straggler
-            fallback can run it on a compacted slice."""
+        def rep_while(x, roots, out, leafs, wdev, st0, rep_eff, cfg,
+                      pos_vec=None):
+            """The general retry loop for one firstn rep — shape-
+            polymorphic (the straggler fallback runs it on a compacted
+            slice) and root-vector-parameterized (chain steps descend
+            from the previous step's picks)."""
+            step_leaf = cfg["leaf"] and cfg["target"] != 0
 
             def body(st):
                 ftotal, placed, dead, item, leaf = st
                 active = ~placed & ~dead
-                r = (rep + ftotal).astype(jnp.int32)
-                root = jnp.full(x.shape, take, dtype=jnp.int32)
-                pos = jnp.sum((out != _NONE).astype(jnp.int32), axis=1)
-                itm = descend(root, x, r, target, sizes1, pos)
-                valid = item_type(itm) == target
+                r = (rep_eff + ftotal).astype(jnp.int32)
+                pos = (pos_vec if pos_vec is not None else
+                       jnp.sum((out != _NONE).astype(jnp.int32),
+                               axis=1))
+                itm = descend(roots, x, r, cfg["target"],
+                              cfg["step_sizes"], pos)
+                valid = (item_type(itm) == cfg["target"]) & (roots < 0)
                 collide = jnp.any(out == itm[:, None], axis=1)
-                if leafmode:
-                    lf, lgot = leaf_attempts(itm, x, r, leafs,
-                                             wdev, pos)
+                if step_leaf:
+                    rep0_leaf = (None if cfg["stable"] else pos)
+                    lf, lgot = leaf_attempts(itm, x, r, leafs, wdev,
+                                             pos, cfg, rep0_leaf)
                     reject = collide | ~lgot
                 else:
                     lf = itm
-                    if target == 0:
+                    if cfg["target"] == 0:
                         reject = collide | dev_out(wdev, itm, x)
                     else:
                         reject = collide
@@ -454,38 +517,88 @@ class BatchMapper:
 
             def cond(st):
                 ftotal, placed, dead, _, _ = st
-                return jnp.any(~placed & ~dead & (ftotal < tries))
+                return jnp.any(~placed & ~dead
+                               & (ftotal < cfg["tries"]))
 
             return jax.lax.while_loop(cond, body, st0)
 
-        def firstn_fn(x, wdev):
-            # one traced rep body under lax.scan (compile cost is one
-            # rep, not numrep unrolled copies — the r2 compile-time sink)
+        def firstn_chain_fn(x, wdev):
+            """General firstn executor: any take→choose-chain→emit
+            rule (the reference `crush_do_rule` accumulation), any
+            stable/vary_r.  Each step appends into a fresh result
+            buffer at a per-element outpos; the buffer feeds the next
+            step as its root slots."""
             B = x.shape[0]
+            barange = jnp.arange(B)
+            roots = jnp.full((B, 1), take, dtype=jnp.int32)
+            out = leafs = None
+            for cfg in chain:
+                slots = roots.shape[1]
+                reps = min(cfg["reps"], result_max)
+                cap = min(slots * reps, result_max)
+                out = jnp.full((B, cap), _NONE, jnp.int32)
+                leafs = jnp.full((B, cap), _NONE, jnp.int32)
+                outpos = jnp.zeros((B,), jnp.int32)
 
-            def rep_body(carry, rep):
-                out, leafs = carry
-                st = (jnp.zeros((B,), jnp.int32),
-                      jnp.zeros((B,), bool), jnp.zeros((B,), bool),
-                      jnp.full((B,), _NONE, jnp.int32),
-                      jnp.full((B,), _NONE, jnp.int32))
-                ftotal, placed, dead, item, leaf = rep_while(
-                    x, out, leafs, wdev, st, rep)
-                out = out.at[:, rep].set(
-                    jnp.where(placed, item, np.int32(_NONE)))
-                leafs = leafs.at[:, rep].set(
-                    jnp.where(placed, leaf, np.int32(_NONE)))
-                return (out, leafs), None
+                def root_body(carry, root, cfg=cfg, reps=reps,
+                              cap=cap):
+                    out, leafs, outpos = carry
+                    entry = outpos      # outpos when this root starts
 
-            init = (jnp.full((B, numrep), _NONE, jnp.int32),
-                    jnp.full((B, numrep), _NONE, jnp.int32))
-            (out, leafs), _ = jax.lax.scan(
-                rep_body, init, jnp.arange(numrep, dtype=np.int32))
-            res = leafs if leafmode else out
-            # compact: stable-move NONE entries to the end (C firstn
-            # advances outpos only on success)
-            order = jnp.argsort(res == _NONE, axis=1, stable=True)
-            return jnp.take_along_axis(res, order, axis=1)
+                    def rep_body(c, rep):
+                        out, leafs, outpos = c
+                        if cfg["stable"]:
+                            rep_eff = jnp.full((B,), rep, jnp.int32)
+                            rep_ok = jnp.ones((B,), bool)
+                        else:
+                            # C: rep starts at the entry outpos and
+                            # must stay < numrep — later roots get
+                            # fewer (or zero) reps
+                            rep_eff = entry + rep
+                            rep_ok = rep_eff < np.int32(cfg["reps"])
+                        # C do_rule: `if wi >= 0 or (-1-wi) >= nb:
+                        # continue` — NONE slots from an under-filled
+                        # earlier step are negative but out of bucket
+                        # range and must not descend
+                        root_ok = (root < 0) & ((-1 - root) < nb)
+                        active0 = rep_ok & root_ok \
+                            & (outpos < np.int32(result_max))
+                        st = (jnp.zeros((B,), jnp.int32),
+                              ~active0,       # inactive = "placed"
+                              jnp.zeros((B,), bool),
+                              jnp.full((B,), _NONE, jnp.int32),
+                              jnp.full((B,), _NONE, jnp.int32))
+                        ftotal, placed, dead, item, leaf = rep_while(
+                            x, root, out, leafs, wdev, st, rep_eff,
+                            cfg, pos_vec=outpos)
+                        succ = placed & active0 & (item != _NONE)
+                        slot = jnp.minimum(outpos, np.int32(cap - 1))
+                        out = out.at[barange, slot].set(
+                            jnp.where(succ, item, out[barange, slot]))
+                        leafs = leafs.at[barange, slot].set(
+                            jnp.where(succ, leaf,
+                                      leafs[barange, slot]))
+                        outpos = outpos + succ.astype(jnp.int32)
+                        return (out, leafs, outpos), None
+
+                    (out, leafs, outpos), _ = jax.lax.scan(
+                        rep_body, (out, leafs, outpos),
+                        jnp.arange(reps, dtype=np.int32))
+                    return (out, leafs, outpos), None
+
+                (out, leafs, outpos), _ = jax.lax.scan(
+                    root_body, (out, leafs, outpos),
+                    jnp.moveaxis(roots, 0, 1))
+                roots = out     # next step's root slots
+
+            step_leaf = chain[-1]["leaf"] and chain[-1]["target"] != 0
+            res = leafs if step_leaf else out
+            if res.shape[1] < result_max:
+                res = jnp.concatenate(
+                    [res, jnp.full((B, result_max - res.shape[1]),
+                                   np.int32(_NONE), jnp.int32)],
+                    axis=1)
+            return res[:, :result_max]
 
         # -- fast firstn: precomputed candidates + compacted stragglers
         #
@@ -596,9 +709,11 @@ class BatchMapper:
                            jnp.zeros((K,), bool),
                            jnp.full((K,), _NONE, jnp.int32),
                            jnp.full((K,), _NONE, jnp.int32))
+                    rootk = jnp.full((K,), take, dtype=jnp.int32)
                     ftk, plk, ddk, itk, lfk = rep_while(
-                        x[idxc], out[idxc], leafs[idxc], wdev, stk,
-                        rep)
+                        x[idxc], rootk, out[idxc], leafs[idxc], wdev,
+                        stk, jnp.full((K,), rep, jnp.int32),
+                        chain[-1])
                     # pad rows were marked placed with NONE items;
                     # mode="drop" discards their B sentinel index
                     ftotal = ftotal.at[idx].set(ftk, mode="drop")
@@ -699,13 +814,15 @@ class BatchMapper:
             res = out2 if leafmode else out
             return jnp.where(res == UNDEF, np.int32(_NONE), res)
 
-        # fast path preconditions: no choose_args positions (a descent
-        # must depend only on (x, r)) and a small inner-leaf retry
-        # budget (its candidates are precomputed per ft)
-        fast_ok = self.firstn and P == 1 \
+        # fast path preconditions: single-step rule, no choose_args
+        # positions (a descent must depend only on (x, r)), stable
+        # rep indexing (stable=0 makes r data-dependent), and a small
+        # inner-leaf retry budget (candidates are precomputed per ft)
+        fast_ok = self.firstn and P == 1 and len(chain) == 1 \
+            and chain[-1]["stable"] == 1 \
             and (not leafmode or rtries <= 4)
         if self.firstn:
-            fn = firstn_fast_fn if fast_ok else firstn_fn
+            fn = firstn_fast_fn if fast_ok else firstn_chain_fn
         else:
             fn = indep_fn
 

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..ops import rs
-from ..ops.gf_jax import gf_matmul_gather as _gf_matmul_gather_local
+from ..ops.gf_jax import _bit_layout_matrix, gf_matmul_bits
 
 
 class ShardedEC:
@@ -59,16 +59,25 @@ class ShardedEC:
     # -- encode: data chunks sharded, XOR-combine partials over ICI --------
     def _build_encode(self):
         mesh = self.mesh
-        cpad = jnp.asarray(self._coding_pad)
         shard_n = self.shard_n
         klocal = self.k_pad // shard_n
         m = self.m
+        # bit-layout matrix of the padded coding [8m, 8*k_pad]: the
+        # local multiply runs on the MXU bitmatrix path (the same math
+        # GFLinear's production backend uses), not the table-gather.
+        # Columns interleave as (bit s, chunk i) = s*k_pad + i, so a
+        # device's chunk-column slice is strided — reshape to
+        # [8m, 8, k_pad] and slice the chunk axis.
+        bm_full = _bit_layout_matrix(self._coding_pad)
+        bm3 = jnp.asarray(
+            bm_full.reshape(8 * m, 8, self.k_pad))
 
         def local_fn(data):  # data: [Bl, klocal, C]
             idx = jax.lax.axis_index("shard")
-            cols = jax.lax.dynamic_slice_in_dim(cpad, idx * klocal, klocal,
-                                                axis=1)
-            partial = _gf_matmul_gather_local(cols, data)  # [Bl, m, C]
+            cols3 = jax.lax.dynamic_slice_in_dim(
+                bm3, idx * klocal, klocal, axis=2)
+            cols = cols3.reshape(8 * m, 8 * klocal)
+            partial = gf_matmul_bits(cols, data, m)  # [Bl, m, C]
             # XOR-combine partials across the shard axis via all-gather
             # (ICI); every device ends with the full parity of its stripes.
             gathered = jax.lax.all_gather(partial, "shard", axis=0)
@@ -117,7 +126,7 @@ class ShardedEC:
         k, m = self.k, self.m
         dm = rs.decode_matrix(self.coding, k, list(erasures))
         survivors = tuple(i for i in range(k + m) if i not in erasures)[:k]
-        dmj = jnp.asarray(dm)
+        dmbits = jnp.asarray(_bit_layout_matrix(dm))
         surv_idx = jnp.asarray(np.array(survivors, dtype=np.int32))
 
         def local_fn(chunks):  # [Bl, nlocal, C] — this device's chunk rows
@@ -128,7 +137,8 @@ class ShardedEC:
                 -1, chunks.shape[0], chunks.shape[2])  # [n_pad, Bl, C]
             surv = full[surv_idx]                      # [k, Bl, C]
             surv = jnp.moveaxis(surv, 1, 0)            # [Bl, k, C]
-            data = _gf_matmul_gather_local(dmj, surv)  # [Bl, k, C]
+            # MXU bitmatrix decode (byte-exact vs the oracle)
+            data = gf_matmul_bits(dmbits, surv, dm.shape[0])
             return data
 
         def fn(chunks):  # [B, n_pad, C] sharded P('dp','shard',None)
@@ -148,6 +158,19 @@ class ShardedEC:
         """
         return self._decode_fn(tuple(sorted(erasures)))(chunks_padded)
 
+    def assemble_chunks(self, data_padded, parity) -> jnp.ndarray:
+        """Lay out the [B, n_pad, C] chunk array `_decode_fn` expects:
+        data rows 0..k-1, parity rows k..k+m-1, zero padding to n_pad.
+        The single definition of that implicit layout contract — the
+        bench and the multichip dryrun build their inputs through it
+        too."""
+        B = data_padded.shape[0]
+        C = data_padded.shape[2]
+        return jnp.concatenate(
+            [data_padded[:, :self.k], jnp.asarray(parity),
+             jnp.zeros((B, self.n_pad - self.k - self.m, C),
+                       jnp.uint8)], axis=1)
+
     # -- the full pipeline step (flagship "train step") --------------------
     def pipeline_step(self, data_padded, erasures: tuple[int, ...]):
         """Encode, then reconstruct with ``erasures`` erased, returning
@@ -156,15 +179,6 @@ class ShardedEC:
         `__graft_entry__.dryrun_multichip` compiles over an N-device mesh.
         """
         parity = self._encode(data_padded)
-
-        def build(chunks):
-            return self._decode_fn(tuple(sorted(erasures)))(chunks)
-
-        B = data_padded.shape[0]
-        C = data_padded.shape[2]
-        all_chunks = jnp.concatenate(
-            [data_padded[:, :self.k], parity,
-             jnp.zeros((B, self.n_pad - self.k - self.m, C), jnp.uint8)],
-            axis=1)
-        recovered = build(all_chunks)
+        recovered = self._decode_fn(tuple(sorted(erasures)))(
+            self.assemble_chunks(data_padded, parity))
         return parity, recovered

@@ -23,7 +23,6 @@ current versions the same way; history stays zone-local).
 
 from __future__ import annotations
 
-import json
 import threading
 
 from .gateway import RGWStore

@@ -144,7 +144,9 @@ class TestRbdSupport:
                 return any(s["name"].startswith("scheduled-")
                            for s in im.list_snaps())
 
-        assert _wait(has_snap)
+        # generous budget: the scheduler tick competes with the whole
+        # suite for one CPU core on a loaded runner
+        assert _wait(has_snap, timeout=60)
         rc, _, _ = r.mgr_command({
             "prefix": "rbd snapshot schedule remove",
             "image": "rbd/sched"})
