@@ -776,7 +776,22 @@ class OSDaemon(Dispatcher):
         klass = _SCHED_CLASS.get(type(msg))
         if klass is None:
             return False
-        self.op_queue.enqueue(klass, msg)
+        dmc = getattr(msg, "dmc", None)
+        if klass == CLIENT and isinstance(dmc, dict):
+            # distributed dmclock: per-client tags advanced by the
+            # client's cross-OSD completion feedback.  Wire values
+            # are untrusted JSON — anything non-numeric degrades to
+            # the 1-op default instead of killing the dispatch
+            try:
+                delta = int(dmc.get("delta", 1))
+                rho = int(dmc.get("rho", 1))
+            except (TypeError, ValueError):
+                delta = rho = 1
+            self.op_queue.enqueue(
+                klass, msg, client=getattr(msg, "client", None),
+                delta=delta, rho=rho)
+        else:
+            self.op_queue.enqueue(klass, msg)
         return True
 
     def _route(self, msg) -> bool:

@@ -26,16 +26,23 @@ class MOSDOp(_JsonMessage):
     """Client → primary: one object op batch (reference MOSDOp).
     ``snapc``: the writer's SnapContext {"seq", "snaps"} from the pool
     (reference SnapContext riding every write); read ops may carry a
-    per-op "snapid" for snapshot reads."""
+    per-op "snapid" for snapshot reads.  ``dmc``: distributed-dmclock
+    feedback {"delta", "rho"} — how many of this client's requests
+    completed anywhere (delta) / under reservation (rho) since its
+    last request to THIS osd (reference src/dmclock ReqParams)."""
     TYPE = 40
     FIELDS = ("tid", "client", "pgid", "oid", "epoch", "ops", "flags",
-              "snapc")
+              "snapc", "dmc")
 
 
 @register_message
 class MOSDOpReply(_JsonMessage):
+    """``dmc_phase``: which dmclock phase served the op —
+    "reservation" or "priority" (reference PhaseType riding the
+    reply) — the client's tracker feeds it back as rho."""
     TYPE = 41
-    FIELDS = ("tid", "rc", "outs", "results", "version", "epoch")
+    FIELDS = ("tid", "rc", "outs", "results", "version", "epoch",
+              "dmc_phase")
 
 
 @register_message

@@ -933,7 +933,8 @@ class PG:
         try:
             msg.connection.send_message(M.MOSDOpReply(
                 tid=msg.tid, rc=rc, outs=outs, results=results,
-                version=list(version), epoch=self.daemon.osdmap.epoch))
+                version=list(version), epoch=self.daemon.osdmap.epoch,
+                dmc_phase=getattr(msg, "_dmc_phase", None)))
         except (ConnectionError, AttributeError):
             pass
 

@@ -57,7 +57,7 @@ class WeightedPriorityQueue:
         self._cv = threading.Condition()
         self._closed = False
 
-    def enqueue(self, klass: str, item):
+    def enqueue(self, klass: str, item, **_dmc_ignored):
         with self._cv:
             if klass not in self._queues:
                 self._queues[klass] = collections.deque()
@@ -143,14 +143,35 @@ class MClockScheduler:
         self.profiles = self._normalize(
             profiles or default_mclock_profiles())
         self.clock = clock
-        # per class: deque of (r_tag, p_tag, l_tag, item)
-        self._queues: dict[str, collections.deque] = {}
-        self._prev: dict[str, tuple[float, float, float]] = {}
+        # per (class, client): deque of (r_tag, p_tag, l_tag, item)
+        # — distributed dmclock tracks R/P tags per client within a
+        # class (reference dmclock ClientRec); client None = the
+        # class-wide anonymous stream (sub-ops, recovery, scrub).
+        # The LIMIT stream stays per CLASS: the operator's ceiling is
+        # a class budget and must not multiply with client count.
+        self._queues: dict[tuple, collections.deque] = {}
+        self._prev: dict[tuple, tuple[float, float]] = {}
+        self._lim_prev: dict[str, float] = {}
+        self._last_seen: dict[tuple, float] = {}
         self._peering: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._closed = False
 
-    def enqueue(self, klass: str, item):
+    # idle per-client state is erased after this long (reference
+    # dmclock ClientRec idle/erase ages) — without it, every client
+    # entity ever seen leaves a tag tuple and an empty deque behind
+    IDLE_PURGE_S = 60.0
+
+    def enqueue(self, klass: str, item, client=None, delta: int = 1,
+                rho: int = 1):
+        """`delta`/`rho` are the distributed-dmclock feedback: how
+        many of this client's requests completed ANYWHERE (delta) /
+        under reservation anywhere (rho) since its last request to
+        this server.  The tags advance by rho/res and delta/weight —
+        a client already getting its reservation from other servers
+        progresses its reservation tag here faster, so the aggregate
+        reserved rate across servers stays ≈ res instead of res × N
+        (reference src/dmclock TagCalc)."""
         with self._cv:
             if klass == PEERING:
                 self._peering.append(item)
@@ -158,12 +179,18 @@ class MClockScheduler:
                 return
             now = self.clock()
             res, wgt, lim = self.profiles.get(klass, _MCLOCK_FALLBACK)
-            pr, pp, pl = self._prev.get(klass, (-_INF, -_INF, -_INF))
-            r = max(now, pr + 1.0 / res) if res > 0 else _INF
-            p = max(now, pp + 1.0 / max(wgt, 1e-9))
+            key = (klass, client)
+            pr, pp = self._prev.get(key, (-_INF, -_INF))
+            pl = self._lim_prev.get(klass, -_INF)
+            delta = max(int(delta), 1)
+            rho = max(int(rho), 1)
+            r = max(now, pr + rho / res) if res > 0 else _INF
+            p = max(now, pp + delta / max(wgt, 1e-9))
             lt = max(now, pl + 1.0 / lim) if lim > 0 else 0.0
-            self._prev[klass] = (r if res > 0 else pr, p, lt)
-            self._queues.setdefault(klass,
+            self._prev[key] = (r if res > 0 else pr, p)
+            self._lim_prev[klass] = lt
+            self._last_seen[key] = now
+            self._queues.setdefault(key,
                                     collections.deque()).append(
                 (r, p, lt, item))
             self._cv.notify()
@@ -174,26 +201,47 @@ class MClockScheduler:
             return PEERING, self._peering.popleft()
         best_r = best_p = None
         wake = _INF
-        for c, q in self._queues.items():
+        stale = []
+        for key, q in self._queues.items():
             if not q:
+                if now - self._last_seen.get(key, now) \
+                        > self.IDLE_PURGE_S:
+                    stale.append(key)
                 continue
             r_tag, p_tag, l_tag, _ = q[0]
-            if r_tag <= now:
+            # the class-wide limit gates BOTH phases: per-client
+            # reservations must not aggregate past the operator's
+            # class ceiling (deviation from pure dmclock, where the
+            # reservation bypasses the limit — there the limit is
+            # per-client too)
+            if l_tag <= now and r_tag <= now:
                 if best_r is None or r_tag < best_r[0]:
-                    best_r = (r_tag, c)
+                    best_r = (r_tag, key)
             elif r_tag < _INF:
-                wake = min(wake, r_tag)
+                wake = min(wake, max(r_tag, min(l_tag, _INF)))
             if l_tag <= now:
                 if best_p is None or p_tag < best_p[0]:
-                    best_p = (p_tag, c)
+                    best_p = (p_tag, key)
             else:
                 wake = min(wake, l_tag)
+        for key in stale:       # erase idle per-client state
+            del self._queues[key]
+            self._prev.pop(key, None)
+            self._last_seen.pop(key, None)
         choice = best_r or best_p
         if choice is None:
             return None, wake
-        c = choice[1]
-        _, _, _, item = self._queues[c].popleft()
-        return c, item
+        key = choice[1]
+        _, _, _, item = self._queues[key].popleft()
+        self._last_seen[key] = now
+        # report which phase served the op (reference PhaseType in
+        # the dmclock response): the client tracker turns it into rho
+        try:
+            item._dmc_phase = ("reservation" if choice is best_r
+                               else "priority")
+        except AttributeError:
+            pass        # plain tuples/ints in unit tests
+        return key[0], item
 
     def dequeue(self, timeout: float | None = None):
         """→ (class, item) or None on timeout/close."""
@@ -238,7 +286,10 @@ class MClockScheduler:
 
     def depths(self) -> dict[str, int]:
         with self._cv:
-            d = {c: len(q) for c, q in self._queues.items() if q}
+            d: dict[str, int] = {}
+            for (c, _client), q in self._queues.items():
+                if q:
+                    d[c] = d.get(c, 0) + len(q)
             if self._peering:
                 d[PEERING] = len(self._peering)
             return d

@@ -64,6 +64,13 @@ class Objecter(Dispatcher):
         self.watch_cbs: dict[str, object] = {}
         self.inflight: dict[int, _Op] = {}
         self._osd_cons: dict[int, object] = {}
+        # distributed-dmclock client tracker (reference src/dmclock
+        # ServiceTracker): global completion counters + the snapshot
+        # taken at the last send to each OSD; the difference rides
+        # each MOSDOp as (delta, rho)
+        self._dmc_total = 0
+        self._dmc_res = 0
+        self._dmc_osd_snap: dict[int, tuple[int, int]] = {}
         self._map_waiters: list[threading.Event] = []
         self.monc.on_osdmap = self._on_osdmap
         self.monc.sub_want("osdmap")
@@ -191,11 +198,15 @@ class Objecter(Dispatcher):
         if pool is not None and pool.snap_seq:
             snapc = {"seq": pool.snap_seq,
                      "snaps": sorted(pool.snaps, reverse=True)}
+        st, sr = self._dmc_osd_snap.get(primary, (0, 0))
+        dmc = {"delta": max(1, self._dmc_total - st),
+               "rho": max(1, self._dmc_res - sr)}
+        self._dmc_osd_snap[primary] = (self._dmc_total, self._dmc_res)
         try:
             con.send_message(M.MOSDOp(
                 tid=op.tid, client=self.entity, pgid=str(pgid),
                 oid=op.oid, epoch=self.osdmap.epoch, ops=op.ops,
-                flags=0, snapc=snapc))
+                flags=0, snapc=snapc, dmc=dmc))
         except ConnectionError:
             self._osd_cons.pop(primary, None)
 
@@ -255,6 +266,13 @@ class Objecter(Dispatcher):
                 t.start()
                 return True
             del self.inflight[msg.tid]
+            # dmclock feedback: count exactly one completion per
+            # LOGICAL op (a duplicate reply from a resend race finds
+            # the op already gone above and must not inflate the next
+            # delta/rho)
+            self._dmc_total += 1
+            if getattr(msg, "dmc_phase", None) == "reservation":
+                self._dmc_res += 1
         op.on_reply(msg.rc, msg.outs, msg.results,
                     tuple(msg.version or (0, 0)))
         return True

@@ -172,6 +172,187 @@ class TestMClock:
             "osd_mclock_scheduler_client_res")
 
 
+class _Op:
+    """Attribute-friendly queue item (the scheduler stamps
+    `_dmc_phase` on dequeue)."""
+
+    def __init__(self, tag):
+        self.tag = tag
+
+
+class TestDistributedDmclock:
+    """Distributed dmclock (reference src/dmclock delta/rho): the
+    client reports how much service it got from OTHER servers; each
+    server advances that client's tags accordingly, so the aggregate
+    reserved rate across N servers stays ~res, not res x N."""
+
+    def _mk(self, profiles):
+        from ceph_tpu.osd.scheduler import MClockScheduler
+        clk = FakeClock()
+        return MClockScheduler(profiles, clock=clk), clk
+
+    def test_rho_spaces_reservation_tags(self):
+        from ceph_tpu.osd.scheduler import CLIENT
+        # res=10 -> 0.1s spacing per rho unit.  rho=5 means "I was
+        # served 5 reserved ops elsewhere since my last request
+        # here": the tag advances 0.5s per op -> ~2/s served in
+        # reservation phase on this server.
+        s, clk = self._mk({CLIENT: (10.0, 0.001, 0.0)})
+        for i in range(40):
+            s.enqueue(CLIENT, _Op(i), client="a", rho=5, delta=5)
+        res_served = 0
+        for _ in range(100):
+            clk.advance(0.01)               # 1 simulated second
+            got = s.dequeue(timeout=0)
+            if got is not None and \
+                    got[1]._dmc_phase == "reservation":
+                res_served += 1
+        assert res_served <= 4, res_served   # ~res/rho = 2 (+slack)
+
+    def test_phase_reported(self):
+        from ceph_tpu.osd.scheduler import CLIENT, RECOVERY
+        s, clk = self._mk({CLIENT: (100.0, 1.0, 0.0),
+                           RECOVERY: (0.0, 100.0, 0.0)})
+        a, b = _Op("a"), _Op("b")
+        s.enqueue(CLIENT, a, client="x")
+        s.enqueue(RECOVERY, b)
+        clk.advance(0.001)
+        served = [s.dequeue(timeout=0)[1] for _ in range(2)]
+        assert a in served and b in served
+        assert a._dmc_phase == "reservation"     # res tag was due
+        assert b._dmc_phase == "priority"        # no reservation
+
+    def test_per_client_tag_streams(self):
+        """Two clients in one class get their own proportional tag
+        streams: a backlogged client cannot starve a newcomer (the
+        reference tracks tags per ClientRec, not per class)."""
+        from ceph_tpu.osd.scheduler import CLIENT
+        s, clk = self._mk({CLIENT: (0.0, 10.0, 0.0)})
+        for i in range(100):
+            s.enqueue(CLIENT, _Op(("hog", i)), client="hog")
+        clk.advance(1.0)
+        for i in range(10):
+            s.enqueue(CLIENT, _Op(("late", i)), client="late")
+        first20 = [s.dequeue(timeout=0)[1].tag[0] for _ in range(20)]
+        # the late client's earliest tags interleave rather than
+        # waiting behind 100 hog ops
+        assert "late" in first20[:12], first20
+
+    def test_aggregate_reservation_across_servers(self):
+        """One client spraying two CONTENDED servers (each buried in
+        high-weight recovery, so client service flows only through
+        the reservation): with delta/rho feedback the client's TOTAL
+        service is ~res; without it each server independently grants
+        res — the multiplication the distributed protocol exists to
+        prevent."""
+        from ceph_tpu.osd.scheduler import CLIENT, RECOVERY
+
+        def run(with_feedback: bool) -> int:
+            servers = [self._mk({CLIENT: (10.0, 0.001, 0.0),
+                                 RECOVERY: (0.0, 1000.0, 0.0)})
+                       for _ in range(2)]
+            for srv, _ in servers:
+                for i in range(2000):
+                    srv.enqueue(RECOVERY, _Op(("r", i)))
+            total = res_done = 0
+            snap = {0: (0, 0), 1: (0, 0)}
+            next_sid = [0]
+
+            def send():
+                # closed loop: one replacement op per completion,
+                # alternating servers (a real client's op window)
+                sid = next_sid[0]
+                next_sid[0] = 1 - sid
+                srv, _c = servers[sid]
+                if with_feedback:
+                    st, sr = snap[sid]
+                    delta = max(1, total - st)
+                    rho = max(1, res_done - sr)
+                    snap[sid] = (total, res_done)
+                else:
+                    delta = rho = 1
+                srv.enqueue(CLIENT, _Op(total), client="c",
+                            delta=delta, rho=rho)
+
+            for _ in range(8):              # the op window
+                send()
+            second_half = 0
+            for tick in range(200):         # 2 simulated seconds
+                for s2, c2 in servers:      # each drains 100 deq/s
+                    c2.advance(0.01)
+                    got = s2.dequeue(timeout=0)
+                    if got is not None and got[0] == CLIENT:
+                        total += 1
+                        if got[1]._dmc_phase == "reservation":
+                            res_done += 1
+                        if tick >= 100:     # steady state only
+                            second_half += 1
+                        send()
+            return second_half              # client ops/s, 2nd second
+
+        naive = run(with_feedback=False)
+        fed = run(with_feedback=True)
+        # naive: each server grants ~res=10/s -> ~20 aggregate; with
+        # feedback the aggregate stays ~res
+        assert naive >= 17, naive
+        assert fed <= 14, fed
+
+    def test_limit_stays_class_wide_across_clients(self):
+        """Review r5: the operator's class ceiling must not multiply
+        with client count — 10 clients under lim=10/s still get 10/s
+        TOTAL, and per-client reservations cannot aggregate past it."""
+        from ceph_tpu.osd.scheduler import CLIENT
+        s, clk = self._mk({CLIENT: (10.0, 5.0, 10.0)})
+        for i in range(200):
+            s.enqueue(CLIENT, _Op(i), client=f"c{i % 10}")
+        served = 0
+        for _ in range(400):
+            clk.advance(0.0025)             # 1 simulated second
+            if s.dequeue(timeout=0) is not None:
+                served += 1
+        assert served <= 13, served         # lim=10/s (+slack)
+
+    def test_idle_client_state_purged(self):
+        """Review r5: per-client tag state must be erased after the
+        idle age, not accumulate for every entity ever seen."""
+        from ceph_tpu.osd.scheduler import CLIENT, MClockScheduler
+        s, clk = self._mk({CLIENT: (10.0, 5.0, 0.0)})
+        for i in range(50):
+            s.enqueue(CLIENT, _Op(i), client=f"ephemeral-{i}")
+        while s.dequeue(timeout=0) is not None:
+            clk.advance(0.05)
+        assert len(s._prev) == 50
+        clk.advance(MClockScheduler.IDLE_PURGE_S + 1)
+        s.enqueue(CLIENT, _Op("fresh"), client="fresh")
+        s.dequeue(timeout=0)
+        assert len(s._prev) <= 2            # stale 50 erased
+        assert len(s._queues) <= 2
+
+    def test_e2e_phase_flows_back_to_objecter(self):
+        """Through a live cluster with mclock: replies carry the
+        dmclock phase and the objecter tracker accumulates it."""
+        from ceph_tpu.vstart import MiniCluster
+        c = MiniCluster(n_mons=1, n_osds=2,
+                        osd_config={"osd_op_queue": "mclock"})
+        try:
+            c.start()
+            r = c.rados()
+            r.create_pool("dmc", pg_num=4, size=2)
+            io = r.open_ioctx("dmc")
+            c.wait_for_clean()
+            for i in range(10):
+                io.write_full(f"o{i}", b"x")
+                assert bytes(io.read(f"o{i}")) == b"x"
+            obj = r.objecter
+            assert obj._dmc_total >= 20
+            # client ops with a live reservation: at least some served
+            # in reservation phase
+            assert obj._dmc_res >= 1
+            assert obj._dmc_osd_snap    # per-osd snapshots recorded
+        finally:
+            c.stop()
+
+
 class TestMClockCluster:
     def test_cluster_serves_io_under_mclock(self):
         """End-to-end: a MiniCluster with osd_op_queue=mclock peers,
