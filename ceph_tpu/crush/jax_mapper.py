@@ -740,42 +740,51 @@ class BatchMapper:
             order = jnp.argsort(res == _NONE, axis=1, stable=True)
             return jnp.take_along_axis(res, order, axis=1)
 
-        def indep_fn(x, wdev):
-            B = x.shape[0]
-            root = jnp.full((B,), take, dtype=jnp.int32)
-            UNDEF = np.int32(-0x7FFFFFFE)
+        UNDEF = np.int32(-0x7FFFFFFE)
 
-            def _indep_leaf(host, x, r, rep, wdev):
-                """C: nested crush_choose_indep(left=1, numrep, outpos=rep,
-                parent_r=r, tries=recurse_tries); the inner draw index is
-                rep + parent_r + numrep*ftotal_inner; self-only collision
-                check ⇒ none."""
-                got = jnp.zeros(r.shape, dtype=bool)
-                dead = jnp.zeros(r.shape, dtype=bool)
-                leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
-                for ft in range(rtries):
-                    ri = rep + r + np.int32(numrep * ft)
-                    cand = descend(host, x, ri, 0, sizes2,
-                                   jnp.broadcast_to(rep, ri.shape))
-                    valid = (cand >= 0) & (host < 0)
-                    reject = dev_out(wdev, cand, x) | ~valid
-                    active = ~got & ~dead
-                    succ = active & ~reject
-                    leaf = jnp.where(succ, cand, leaf)
-                    got |= succ
-                    dead |= active & ~valid
-                return leaf, got
+        def _indep_leaf(host, x, r, rep, wdev):
+            """C: nested crush_choose_indep(left=1, numrep, outpos=rep,
+            parent_r=r, tries=recurse_tries); the inner draw index is
+            rep + parent_r + numrep*ftotal_inner; self-only collision
+            check ⇒ none."""
+            got = jnp.zeros(r.shape, dtype=bool)
+            dead = jnp.zeros(r.shape, dtype=bool)
+            leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
+            for ft in range(rtries):
+                ri = rep + r + np.int32(numrep * ft)
+                cand = descend(host, x, ri, 0, sizes2,
+                               jnp.broadcast_to(rep, ri.shape))
+                valid = (cand >= 0) & (host < 0)
+                reject = dev_out(wdev, cand, x) | ~valid
+                active = ~got & ~dead
+                succ = active & ~reject
+                leaf = jnp.where(succ, cand, leaf)
+                got |= succ
+                dead |= active & ~valid
+            return leaf, got
+
+        def indep_rounds(x, wdev, out0, out20, ftotal0):
+            """The general indep round loop.  (A candidate-precompute
+            fast path with a compacted-straggler fallback calling this
+            on a slice was built and measured at PARITY with the plain
+            loop — each rep needs its own draw index, so candidates
+            only relocate the same work — and was dropped; the
+            extraction and state parameters remain from that
+            evaluation and keep the loop independently testable.)"""
+            B_ = x.shape[0]
+            root = jnp.full((B_,), take, dtype=jnp.int32)
 
             def round_body(st):
                 # one traced rep step under fori_loop (was numrep
                 # unrolled copies — the r2 compile-time sink)
-                out0, out20, ftotal = st
+                out0_, out20_, ftotal = st
 
                 def rep_step(rep, c):
                     out, out2 = c
                     needs = out[:, rep] == UNDEF
                     r = (rep + np.int32(numrep) * ftotal
-                         ).astype(jnp.int32) * jnp.ones((B,), jnp.int32)
+                         ).astype(jnp.int32) * jnp.ones((B_,),
+                                                        jnp.int32)
                     itm = descend(root, x, r, target, sizes1,
                                   jnp.broadcast_to(rep, r.shape))
                     valid = item_type(itm) == target
@@ -801,16 +810,22 @@ class BatchMapper:
                     return out, out2
 
                 out, out2 = jax.lax.fori_loop(0, numrep, rep_step,
-                                              (out0, out20))
+                                              (out0_, out20_))
                 return out, out2, ftotal + 1
 
             def round_cond(st):
                 out, _, ftotal = st
                 return (ftotal < tries) & jnp.any(out == UNDEF)
 
+            st = (out0, out20, jnp.int32(ftotal0))
+            out, out2, _ = jax.lax.while_loop(round_cond, round_body,
+                                              st)
+            return out, out2
+
+        def indep_fn(x, wdev):
+            B = x.shape[0]
             out0 = jnp.full((B, numrep), UNDEF, jnp.int32)
-            st = (out0, out0, jnp.int32(0))
-            out, out2, _ = jax.lax.while_loop(round_cond, round_body, st)
+            out, out2 = indep_rounds(x, wdev, out0, out0, 0)
             res = out2 if leafmode else out
             return jnp.where(res == UNDEF, np.int32(_NONE), res)
 
@@ -824,6 +839,10 @@ class BatchMapper:
         if self.firstn:
             fn = firstn_fast_fn if fast_ok else firstn_chain_fn
         else:
+            # indep keeps the general round loop: a candidate-precompute
+            # variant was built and MEASURED at parity (each rep needs
+            # its own draw index, so round-0 candidates just relocate
+            # the same work) — not worth its compile cost
             fn = indep_fn
 
         def run(x, wdev, ln16):
