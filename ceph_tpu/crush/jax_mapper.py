@@ -9,10 +9,14 @@ and the hierarchy walk is a fixed-depth masked descent.  Output is
 bit-identical to the scalar oracle (`ceph_tpu.crush.mapper`), enforced by
 tests/test_crush_jax.py.
 
-Supported (the overwhelmingly common case — everything else falls back
-to the oracle): straw2-only hierarchies, rules of shape
-`take → [set_*] → choose{,leaf}_{firstn,indep} → emit`, default
-chooseleaf tunables (vary_r=1, stable=1), reweights.
+Supported: straw2 + the stateless legacy bucket algs (straw, list,
+tree), single-block rules `take → [set_*] → choose-chain → emit`
+including multi-step choose chains, all chooseleaf vary_r/stable
+tunable combinations, choose_args weight-sets, and reweights.  Falls
+back to the oracle (loudly, via the CLI tools) only for: uniform
+buckets (the perm cache is call-order-stateful),
+choose_local(_fallback)_tries > 0, multiple take/emit blocks,
+chooseleaf mid-chain, and indep inside a multi-step chain.
 
 Requires jax_enable_x64 (straw2 draws are 64-bit fixed point).
 """
@@ -23,7 +27,7 @@ import functools
 
 import numpy as np
 
-from .hash import crush_hash32_2, crush_hash32_3
+from .hash import crush_hash32_2, crush_hash32_3, crush_hash32_4
 from .ln import crush_ln
 from .map import CRUSH_ITEM_NONE, CrushMap, Rule
 
@@ -241,12 +245,19 @@ class BatchMapper:
         self.take = take
 
         # --- flatten the bucket table ------------------------------------
+        # supported algs: straw2 (the modern default), plus the
+        # stateless legacy algs straw/list/tree, all vectorized.
+        # uniform stays on the oracle: bucket_perm_choose's lazily
+        # built permutation is CALL-ORDER-stateful (the r=0 fast path
+        # leaves a different base permutation than a pr>0 first
+        # visit), which a stateless batched recomputation cannot
+        # reproduce bit-exactly.
         nb = len(cmap.buckets)
         S = 1
         for b in cmap.buckets:
             if b is None:
                 continue
-            if b.alg != "straw2":
+            if b.alg not in ("straw2", "straw", "list", "tree"):
                 raise NotImplementedError(
                     f"bucket alg {b.alg}: use the scalar oracle")
             if b.size == 0:
@@ -272,8 +283,13 @@ class BatchMapper:
             sizes[row] = b.size
             btype[row] = b.type
             arg = cmap.choose_args.get(b.id) or {}
-            ws = arg.get("weight_set")
-            if arg.get("ids"):
+            # choose_args act on straw2 buckets only (the oracle's
+            # bucket_straw2_choose is the sole reader) — a weight-set
+            # attached to a legacy bucket must not displace the plain
+            # weights the legacy formulas read
+            ws = (arg.get("weight_set")
+                  if b.alg == "straw2" else None)
+            if arg.get("ids") and b.alg == "straw2":
                 hash_ids[row, :b.size] = arg["ids"]
             for p in range(P):
                 if ws:
@@ -286,6 +302,47 @@ class BatchMapper:
         self._nb, self._S, self._P = nb, S, P
         self._bucket_by_id = {b.id: b for b in cmap.buckets
                               if b is not None}
+        # legacy-alg tables (straw scalers, list prefix sums, tree
+        # node weights) — derived once at build like the reference's
+        # crush_calc_straw / crush_make_tree_bucket
+        self._algs = sorted({b.alg for b in cmap.buckets
+                             if b is not None})
+        alg_num = {"straw2": 0, "straw": 1, "list": 2, "tree": 3}
+        acode = np.zeros(nb, dtype=np.int32)
+        bids = np.zeros(nb, dtype=np.int32)
+        strawsc = np.zeros((nb, S), dtype=np.int64)
+        lsums = np.zeros((nb, S), dtype=np.int64)
+        from .mapper import _tree_node_weights, calc_straw_scalers
+        trees = {row: _tree_node_weights(b)
+                 for row, b in enumerate(cmap.buckets)
+                 if b is not None and b.alg == "tree"}
+        NT = max([num for _, num in trees.values()], default=2)
+        tnodes = np.zeros((nb, NT), dtype=np.int64)
+        troot = np.ones(nb, dtype=np.int32)
+        tdepth = 0
+        for row, b in enumerate(cmap.buckets):
+            if b is None:
+                continue
+            acode[row] = alg_num[b.alg]
+            bids[row] = b.id
+            if b.alg == "straw":
+                strawsc[row, :b.size] = calc_straw_scalers(b.weights)
+            elif b.alg == "list":
+                lsums[row, :b.size] = np.cumsum(b.weights)
+            elif b.alg == "tree":
+                nodes, num = trees[row]
+                tnodes[row, :num] = nodes
+                troot[row] = num >> 1
+                d = 0
+                n = num >> 1
+                while n and (n & 1) == 0:
+                    d += 1
+                    n >>= 1
+                tdepth = max(tdepth, d)
+        self._acode, self._bids = acode, bids
+        self._strawsc, self._lsums = strawsc, lsums
+        self._tnodes, self._troot = tnodes, troot
+        self._tdepth = tdepth
         # division-free straw2: per-item magic constants for the static
         # weight table (TPU has no native u64 divide)
         mw = np.zeros((P, nb, S), dtype=np.uint64)
@@ -370,10 +427,79 @@ class BatchMapper:
             return jnp.where(itm < 0, btype[rows], 0)
 
         any_add = bool(self._wmagic[2].any())
+        legacy_algs = [a for a in self._algs if a != "straw2"]
+        acode = jnp.asarray(self._acode)
+        bids = jnp.asarray(self._bids)
+        strawsc = jnp.asarray(self._strawsc)
+        lsums = jnp.asarray(self._lsums)
+        tnodes = jnp.asarray(self._tnodes)
+        troot = jnp.asarray(self._troot)
+        tdepth = self._tdepth
         # the 64Ki ln table rides in as an argument (set per call by
         # `run`); a box, not a closure constant, so the HLO carries a
         # parameter instead of a megabyte literal
         ln16_box = [None]
+
+        def _legacy_choose(rows, x, r, its, s_, u16):
+            """Batched legacy algs (reference bucket_straw_choose /
+            bucket_list_choose / bucket_tree_choose) — item per row;
+            rows of other algs produce don't-care values that the
+            caller masks out by alg code.  `u16` is straw2's already-
+            computed [B, s_] 16-bit item hash (hash ids differ from
+            items only on straw2 rows with choose_args ids, which are
+            masked out of the legacy output anyway)."""
+            barange = jnp.arange(rows.shape[0])
+            outs = {}
+            if "straw" in legacy_algs:
+                draws = u16.astype(jnp.int64) * strawsc[:, :s_][rows]
+                sel = jnp.argmax(draws, axis=1)
+                outs[1] = its[barange, sel]
+            if "list" in legacy_algs:
+                # newest→oldest walk; item i keeps the draw with
+                # probability weight_i / prefixsum_i → the FIRST hit
+                # from the high end, i.e. the max hit index
+                h4 = crush_hash32_4(
+                    x[:, None], its.astype(jnp.uint32),
+                    r[:, None].astype(jnp.uint32),
+                    bids[rows][:, None].astype(jnp.uint32))
+                sums = lsums[:, :s_][rows]
+                w = ((h4 & np.uint32(0xFFFF)).astype(jnp.int64)
+                     * sums) >> np.int64(16)
+                hit = (sums != 0) & (w < weights[0, :, :s_][rows])
+                rev = hit[:, ::-1]
+                j = jnp.argmax(rev, axis=1)
+                idx = jnp.where(hit.any(axis=1),
+                                np.int32(s_ - 1) - j.astype(jnp.int32),
+                                0)
+                outs[2] = its[barange, idx]
+            if "tree" in legacy_algs:
+                n = troot[rows]
+                nod = tnodes[rows]                       # [B, NT]
+                for _ in range(tdepth):
+                    even = (n & 1) == 0
+                    wn = jnp.take_along_axis(
+                        nod, n[:, None].astype(jnp.int32),
+                        axis=1)[:, 0]
+                    h = crush_hash32_4(
+                        x, n.astype(jnp.uint32),
+                        r.astype(jnp.uint32),
+                        bids[rows].astype(jnp.uint32))
+                    t_ = ((h.astype(jnp.uint64)
+                           * wn.astype(jnp.uint64))
+                          >> np.uint64(32)).astype(jnp.int64)
+                    half = (n & -n) >> 1
+                    left = n - half
+                    wl = jnp.take_along_axis(
+                        nod, left[:, None].astype(jnp.int32),
+                        axis=1)[:, 0]
+                    n2 = jnp.where(t_ < wl, left, n + half)
+                    n = jnp.where(even, n2, n)
+                # an all-zero subtree can land on a padding leaf;
+                # clamp to a real item (rejected later by is_out)
+                idx = jnp.minimum(n >> 1, sizes[rows] - 1)
+                outs[3] = its[barange,
+                              jnp.clip(idx, 0, s_ - 1)]
+            return outs
 
         def straw2(rows, x, r, pos, step=None):
             """rows/x/r/pos [B] → chosen item [B].  `pos` is the output
@@ -411,7 +537,13 @@ class BatchMapper:
                 draws = jnp.where(col[None, :] < sizes[rows][:, None],
                                   draws, np.int64(_I64_MIN))
             sel = jnp.argmax(draws, axis=1)
-            return its[jnp.arange(its.shape[0]), sel]
+            out = its[jnp.arange(its.shape[0]), sel]
+            if legacy_algs:
+                ac = acode[rows]
+                for code, val in _legacy_choose(rows, x, r, its,
+                                                s_, u).items():
+                    out = jnp.where(ac == np.int32(code), val, out)
+            return out
 
         def descend(start, x, r, target, step_specs, pos):
             """Masked hierarchy walk until item type == target."""

@@ -10,8 +10,9 @@ Conventions carried over from the reference:
   of the bucket table (dense).
 - weights are 16.16 fixed point (0x10000 == weight 1.0).
 - bucket algs: straw2 (default since Hammer), uniform, list, tree, straw.
-  straw2 + uniform are implemented; list/tree/straw raise (legacy — add
-  on demand).
+  The scalar oracle (mapper.py) implements all five; the batched JAX
+  mapper covers all but uniform (whose perm cache is call-order-
+  stateful).
 - rule steps form a tiny VM: take / choose(leaf)_firstn / choose(leaf)_indep
   / emit / set_* tunable overrides.
 """

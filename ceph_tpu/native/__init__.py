@@ -317,6 +317,13 @@ class NativeCrush:
         self._lib = _load()
         if self._lib is None:
             raise RuntimeError("native library not built")
+        algs = set(getattr(mapper, "_algs", ["straw2"]))
+        if algs - {"straw2"}:
+            # the native scalar implements straw2 draws only; now
+            # that BatchMapper also batches legacy straw/list/tree
+            # buckets, refusing here beats silently mis-mapping them
+            raise RuntimeError(
+                f"NativeCrush is straw2-only; map uses {sorted(algs)}")
         if not NativeCrush._tables_set:
             from ..crush.ln import LL_TBL, RH_LH_TBL
             rh = np.ascontiguousarray(RH_LH_TBL, dtype=np.uint64)

@@ -242,6 +242,73 @@ class TestLegacyTunables:
         _check(m, 0, 3, XS[:200])
 
 
+class TestLegacyBucketAlgs:
+    """Batched straw / list / tree buckets vs the scalar oracle
+    (reference bucket_{straw,list,tree}_choose); uniform stays on the
+    oracle (its perm cache is call-order-stateful)."""
+
+    @staticmethod
+    def _flat(alg, n=9, weights=None):
+        m = build_flat_map(n, weights=weights)
+        m.bucket(-1).alg = alg
+        return m
+
+    @pytest.mark.parametrize("alg", ["straw", "list", "tree"])
+    def test_flat_uniform_weights(self, alg):
+        _check(self._flat(alg), 0, 3, XS)
+
+    @pytest.mark.parametrize("alg", ["straw", "list", "tree"])
+    def test_flat_skewed_weights(self, alg):
+        rng = np.random.default_rng(hash(alg) % 1000)
+        w = rng.integers(1, 4 * 0x10000, size=11).tolist()
+        _check(self._flat(alg, 11, weights=w), 0, 3, XS)
+
+    @pytest.mark.parametrize("alg", ["straw", "list", "tree"])
+    def test_flat_zero_weights(self, alg):
+        w = [0x10000] * 8
+        w[1] = w[6] = 0
+        _check(self._flat(alg, 8, weights=w), 0, 4, XS[:200])
+
+    @pytest.mark.parametrize("alg", ["straw", "list", "tree"])
+    def test_mixed_hierarchy(self, alg):
+        # straw2 root/racks over legacy-alg host buckets
+        m = build_hierarchy(2, 3, 3)
+        for b in m.buckets:
+            if b is not None and b.type == 1:
+                b.alg = alg
+        _check(m, 0, 3, XS[:250])
+
+    @pytest.mark.parametrize("alg", ["straw", "list", "tree"])
+    def test_reweight_outs(self, alg):
+        m = self._flat(alg, 10)
+        rng = np.random.default_rng(7)
+        rw = rng.integers(0, 0x10001, size=10).astype(np.uint32)
+        _check(m, 0, 3, XS[:250], weight=rw)
+
+    def test_legacy_indep(self):
+        m = build_hierarchy(3, 2, 2, rule="chooseleaf_indep")
+        for b in m.buckets:
+            if b is not None and b.type == 1:
+                b.alg = "tree"
+            if b is not None and b.type == 3:
+                b.alg = "straw"
+        _check(m, 0, 4, XS[:250])
+
+    def test_uniform_still_falls_back(self):
+        m = self._flat("uniform")
+        m.bucket(-1).item_weight = 0x10000
+        with pytest.raises(NotImplementedError, match="uniform"):
+            BatchMapper(m, 0, result_max=3)
+
+    def test_choose_args_ignored_on_legacy_buckets(self):
+        """A weight-set attached to a legacy bucket must not displace
+        the plain weights (the oracle's choose_args reader is
+        straw2-only)."""
+        m = self._flat("straw", 8)
+        m.choose_args[-1] = {"weight_set": [[0x4000] * 8]}
+        _check(m, 0, 3, XS[:200])
+
+
 class TestChunking:
     def test_chunk_boundaries(self):
         m = build_flat_map(10)
