@@ -127,6 +127,18 @@ def _run_child(env: dict, budget_s: float) -> tuple[dict | None, str]:
         print(f"# child: {line}", file=sys.stderr)
     got = _last_json_line(p.stdout or "")
     if got is not None:
+        if p.returncode != 0:
+            # the child crashed after printing a checkpoint; only a
+            # checkpoint with a live headline is worth salvaging —
+            # otherwise fall through so the CPU legs run instead
+            if got.get("value") and not got.get("error"):
+                got["truncated"] = (
+                    f"child died rc={p.returncode}; "
+                    "partial legs salvaged")
+                return got, "ok"
+            tail = ((p.stderr or "").strip().splitlines()
+                    or ["no output"])[-1]
+            return None, f"child rc={p.returncode}: {tail[:160]}"
         return got, "ok"
     tail = ((p.stderr or "").strip().splitlines() or ["no output"])[-1]
     return None, f"child rc={p.returncode}: {tail[:160]}"
@@ -180,6 +192,7 @@ def main():
 
 def _native_ec():
     from ceph_tpu import native
+    native.ensure_built()
     if native.available():
         return native.NativeEC(K, M), "native-c++"
     return None, "numpy"
@@ -336,6 +349,9 @@ def _ec_sweep(on_tpu: bool):
             "encode_int8_TOPS": round(e_tops, 3),
             "batch": batch,
         }
+        if on_tpu and size == SIZES[-1] and _budget_left() <= 0.45:
+            sweep[str(size)]["encode_v1_skipped"] = \
+                "wall budget exhausted"
         if on_tpu and size == SIZES[-1] and _budget_left() > 0.45:
             # old-vs-new kernel on the same bytes: the r5 redesign
             # claim (bit-sliced i32 v2 vs uint8-layout v1) must be a
@@ -418,7 +434,7 @@ def _reconstruct_leg(on_tpu: bool):
            "reconstruct_GBps": round(gbps, 3)}
     try:
         from ceph_tpu import native
-        if native.available():
+        if native.ensure_built():
             dm = rs.decode_matrix(coding, k, list(erasures))
             nat = native.NativeEC(k, m)
             sdata = rng.integers(0, 256, size=(B, k, C),

@@ -94,8 +94,9 @@ def measure() -> dict:
     }
 
     try:
-        from ..native import NativeCrush
-        nc = NativeCrush(bm)
+        from .. import native
+        native.ensure_built()
+        nc = native.NativeCrush(bm)
     except Exception as e:
         result["native_error"] = str(e)[:120]
         return result

@@ -25,7 +25,12 @@ _lib = None
 def _load():
     global _lib
     if _lib is None and _LIB_PATH.exists():
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError:
+            # a build killed mid-link can leave a truncated .so;
+            # treat it as absent (ensure_built may rebuild it)
+            return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         lib.gf256_init.restype = None
         lib.gf256_mul_table.restype = u8p
@@ -111,6 +116,27 @@ def _load():
 
 def available() -> bool:
     return _load() is not None
+
+
+def ensure_built(timeout_s: float = 180.0) -> bool:
+    """Build the native library if it isn't on disk yet.
+
+    The .so is a build artifact (not committed), so a fresh checkout —
+    including the driver's end-of-round bench run — starts without it;
+    without this the bench would silently fall back to the numpy
+    denominator and report inflated speedups.  Bounded `make -C
+    native`; returns `available()` either way.
+    """
+    if available():
+        return True
+    import subprocess
+    try:
+        subprocess.run(
+            ["make", "-C", str(_LIB_PATH.parent)],
+            capture_output=True, timeout=timeout_s, check=False)
+    except Exception:               # noqa: BLE001 — degrade, don't die
+        pass
+    return available()
 
 
 EXECUTOR_CFUNC = ctypes.CFUNCTYPE(
