@@ -417,20 +417,116 @@ extern "C" int pjrt_exec_run(pjrt_exec_t *ex, const uint8_t *in,
     }
     destroy_buf(in_buf);
 
-    /* device -> host */
+    /* device -> host.
+     *
+     * The device buffer's layout need not be row-major: the axon TPU
+     * plugin, for one, materialises the (B, m, C) parity buffer
+     * dim-1-major, and a plain ToHostBuffer copies bytes in DEVICE
+     * layout (found the hard way: 95% parity mismatch that was
+     * exactly an (m, B, C) permutation).  Ask for an explicit dense
+     * row-major host layout; if the plugin rejects that, fall back to
+     * a raw copy and de-permute on the host using the buffer's
+     * declared minor_to_major (untiled layouts only — tiled device
+     * layouts without host_layout support are failed loudly rather
+     * than silently mis-ordered). */
     {
+        size_t nd = ex->out_dims.size();
+        std::vector<int64_t> strides(nd);
+        int64_t acc = 1;     /* uint8 elements: stride == element count */
+        for (size_t i = nd; i-- > 0;) {
+            strides[i] = acc;
+            acc *= ex->out_dims[i];
+        }
+        PJRT_Buffer_MemoryLayout lay;
+        memset(&lay, 0, sizeof(lay));
+        lay.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
+        lay.type = PJRT_Buffer_MemoryLayout_Type_Strides;
+        lay.strides.struct_size =
+            PJRT_Buffer_MemoryLayout_Strides_STRUCT_SIZE;
+        lay.strides.byte_strides = strides.data();
+        lay.strides.num_byte_strides = nd;
+
         PJRT_Buffer_ToHostBuffer_Args a;
         memset(&a, 0, sizeof(a));
         a.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
         a.src = out_buf;
+        a.host_layout = &lay;
         a.dst = out;
         a.dst_size = ex->out_bytes;
-        if (PJRT_Error *e = api->PJRT_Buffer_ToHostBuffer(&a)) {
-            ex->fail("ToHostBuffer: " + error_message(api, e));
-            destroy_buf(out_buf);
-            return -1;
-        }
-        if (!ex->wait(a.event, "d2h transfer")) {
+        PJRT_Error *e = api->PJRT_Buffer_ToHostBuffer(&a);
+        if (e != nullptr) {
+            error_message(api, e);      /* consume + free */
+            /* retry without host_layout, then fix up on the host */
+            std::vector<int64_t> m2m;
+            {
+                PJRT_Buffer_GetMemoryLayout_Args ga;
+                memset(&ga, 0, sizeof(ga));
+                ga.struct_size =
+                    PJRT_Buffer_GetMemoryLayout_Args_STRUCT_SIZE;
+                ga.buffer = out_buf;
+                if (PJRT_Error *ge =
+                        api->PJRT_Buffer_GetMemoryLayout(&ga)) {
+                    ex->fail("GetMemoryLayout: " +
+                             error_message(api, ge));
+                    destroy_buf(out_buf);
+                    return -1;
+                }
+                if (ga.layout.type !=
+                        PJRT_Buffer_MemoryLayout_Type_Tiled) {
+                    ex->fail("plugin rejected host_layout and reports "
+                             "a strided device layout");
+                    destroy_buf(out_buf);
+                    return -1;
+                }
+                /* tile dims are ignored deliberately: ToHostBuffer
+                 * already untiles — the raw copy arrives dense in
+                 * minor_to_major dim order (verified byte-exact
+                 * against the axon plugin's ((8,128),(4,1))-tiled
+                 * parity buffers) */
+                m2m.assign(ga.layout.tiled.minor_to_major,
+                           ga.layout.tiled.minor_to_major +
+                               ga.layout.tiled.minor_to_major_size);
+            }
+            memset(&a, 0, sizeof(a));
+            a.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+            a.src = out_buf;
+            a.dst = out;
+            a.dst_size = ex->out_bytes;
+            if (PJRT_Error *e2 = api->PJRT_Buffer_ToHostBuffer(&a)) {
+                ex->fail("ToHostBuffer: " + error_message(api, e2));
+                destroy_buf(out_buf);
+                return -1;
+            }
+            if (!ex->wait(a.event, "d2h transfer")) {
+                destroy_buf(out_buf);
+                return -1;
+            }
+            /* de-permute: bytes arrived with logical dim m2m[0]
+             * fastest-varying.  Walk the raw buffer once, scattering
+             * each element to its row-major offset. */
+            bool rowmajor = true;
+            for (size_t i = 0; i < m2m.size(); i++)
+                if (m2m[i] != (int64_t)(m2m.size() - 1 - i))
+                    rowmajor = false;
+            if (!rowmajor && m2m.size() == nd) {
+                std::vector<uint8_t> raw(out, out + ex->out_bytes);
+                /* physical-major order = reverse(m2m) */
+                std::vector<int64_t> phys(m2m.rbegin(), m2m.rend());
+                std::vector<int64_t> idx(nd, 0);
+                const uint8_t *src = raw.data();
+                for (size_t off = 0; off < ex->out_bytes; off++) {
+                    int64_t ro = 0;
+                    for (size_t d = 0; d < nd; d++)
+                        ro += idx[d] * strides[d];
+                    out[ro] = src[off];
+                    for (size_t d = nd; d-- > 0;) {
+                        int64_t ld = phys[d];
+                        if (++idx[ld] < ex->out_dims[ld]) break;
+                        idx[ld] = 0;
+                    }
+                }
+            }
+        } else if (!ex->wait(a.event, "d2h transfer")) {
             destroy_buf(out_buf);
             return -1;
         }
