@@ -249,8 +249,66 @@ def _cpu_decode_gbps(dm, chunk, nat):
     return (reps * batch * K * chunk) / dt / 1e9
 
 
+def _dispatch_floor_s(iters: int) -> float:
+    """The relay's fixed per-fetch latency, measured with a trivial
+    chained loop of the same iteration count (~64 ms through axon).
+    Reported alongside the raw numbers so the floor-corrected rate is
+    auditable; the HEADLINE value stays raw/uncorrected."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def floor_loop(x):
+        def body(_, a):
+            return a * jnp.uint32(3) + jnp.uint32(1)
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    int(floor_loop(jnp.uint32(3)))
+    t0 = time.perf_counter()
+    int(floor_loop(jnp.uint32(7)))
+    return time.perf_counter() - t0
+
+
+def _device_leg_words(gfw, words_np, logical_bytes, iters, floor_s):
+    """On-device throughput of a word-native GF map ([B,k,nw] i32 ->
+    [B,m,nw] i32).  Iterations are chained inside ONE jit — each
+    iteration folds a parity checksum back into one input element (a
+    true data dependency, immune to the relay's memoization of
+    identical (executable, input) executions) — and completion is
+    forced by fetching the checksum.  The chain deliberately touches
+    only one element between iterations: the r4 harness xor-folded
+    parity into the full input array, which re-wrote 64 MiB per
+    iteration and measured the harness, not the kernel."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def loop(d):
+        def body(_, carry):
+            dd, acc = carry
+            p = gfw(dd)
+            acc = acc ^ jnp.sum(p)
+            dd = dd.at[0, 0, 0].set(dd[0, 0, 0] ^ (acc & 1))
+            return dd, acc
+        dd, acc = jax.lax.fori_loop(0, iters, body,
+                                    (d, jnp.int32(0)))
+        return acc
+
+    darr = jax.device_put(jnp.asarray(words_np))
+    warm = jax.device_put(jnp.asarray(words_np ^ np.int32(-1)))
+    int(loop(warm))                          # compile + warm
+    t0 = time.perf_counter()
+    int(loop(darr))
+    dt = time.perf_counter() - t0
+    raw = iters * logical_bytes / dt / 1e9
+    corr = iters * logical_bytes / max(dt - floor_s, 1e-6) / 1e9
+    return raw, corr
+
+
 def _device_leg(gflin, data, logical_bytes, iters):
-    """On-device throughput of a GFLinear map.
+    """On-device throughput of a byte-API GFLinear map (kept for the
+    old-vs-new comparison leg).
 
     The iterations are chained inside ONE jit (each iteration
     xor-folds its output back into the input) and completion is forced
@@ -289,23 +347,57 @@ def _device_leg(gflin, data, logical_bytes, iters):
     return gbps, tops
 
 
+def _words_via_xla(mat):
+    """Word-API adapter over the XLA bitmatrix path (CPU fallback —
+    callable like GFLinearWords: [B, k, nw] i32 -> [B, m, nw] i32)."""
+    import jax
+    import jax.numpy as jnp
+    from ceph_tpu.ops.gf_jax import GFLinear
+
+    gl = GFLinear(mat, backend="xla")
+
+    def apply_w(words):
+        b, k, nw = words.shape
+        by = jax.lax.bitcast_convert_type(
+            words, jnp.uint8).reshape(b, k, nw * 4)
+        p = gl._apply(by)
+        return jax.lax.bitcast_convert_type(
+            p.reshape(b, gl.m, nw, 4), jnp.int32)
+    return apply_w
+
+
 def _ec_sweep(on_tpu: bool):
     import numpy as np
     from ceph_tpu.ops import rs
-    from ceph_tpu.ops.gf_jax import GFLinear
+    from ceph_tpu.ops.gf_jax import GFLinear, GFLinearWords
 
     # CPU legs exist to prove the HARNESS end-to-end on a relay-down
     # day, not to set records: shrink the launch so the child finishes
     # well inside its budget
     target_bytes = (64 << 20) if on_tpu else (8 << 20)
-    iters = 10 if on_tpu else 3
+    # 300 chained iterations ≈ 240 ms of kernel per leg vs the ~63 ms
+    # relay dispatch floor, so the RAW number (the headline) carries
+    # ≤ 21% floor tax; the floor-corrected field shows the rest
+    iters = 300 if on_tpu else 3
 
     coding = rs.reed_sol_van_matrix(K, M)
     nat, base_label = _native_ec()
     dm = rs.decode_matrix(coding, K, list(DECODE_ERASURES))
     surv = [i for i in range(K + M) if i not in DECODE_ERASURES][:K]
-    enc = GFLinear(coding)
-    dec = GFLinear(dm)
+    # headline path: word-native kernel (chunk payloads live as i32
+    # words on device — see gf_pallas2.gf_matmul_words).  Off-TPU the
+    # Mosaic kernel only runs in interpret mode, and interpret under
+    # an outer jit miscompiles on the CPU backend (gf_jax.py), so the
+    # CPU harness-proof legs time the XLA bitmatrix path on the same
+    # word-resident data; the word kernel itself is covered eagerly by
+    # tests/test_gf_pallas2.py
+    if on_tpu:
+        enc = GFLinearWords(coding)
+        dec = GFLinearWords(dm)
+    else:
+        enc = _words_via_xla(coding)
+        dec = _words_via_xla(dm)
+    floor_s = _dispatch_floor_s(iters) if on_tpu else 0.0
     rng = np.random.default_rng(2)
     sweep = {}
     for size in SIZES:
@@ -313,12 +405,13 @@ def _ec_sweep(on_tpu: bool):
         batch = max(1, target_bytes // size)
         data = rng.integers(0, 256, size=(batch, K, chunk),
                             dtype=np.uint8)
+        words = GFLinearWords.to_words(data)
         # verify bytes BEFORE timing (stripe 0 vs oracle)
         parity0 = rs.encode_oracle(coding, data[0])
-        got = np.asarray(enc(data[:2]))[0]
+        got = GFLinearWords.to_bytes(np.asarray(enc(words[:2])))[0]
         assert np.array_equal(got, parity0), f"parity mismatch @{size}"
-        e_gbps, e_tops = _device_leg(enc, data, batch * K * chunk,
-                                     iters)
+        e_raw, e_corr = _device_leg_words(
+            enc, words, batch * K * chunk, iters, floor_s)
 
         # decode leg input: each stripe's k surviving shards (ids in
         # `surv`; parity identical across stripes would be unrealistic,
@@ -332,42 +425,50 @@ def _ec_sweep(on_tpu: bool):
             else:
                 sdata[:min(batch, 3), j] = parity[:, s - K]
                 sdata[min(batch, 3):, j] = parity[0, s - K]
-        got0 = np.asarray(dec(sdata[:2]))[0]
+        swords = GFLinearWords.to_words(sdata)
+        got0 = GFLinearWords.to_bytes(np.asarray(dec(swords[:2])))[0]
         assert np.array_equal(got0, data[0]), f"decode mismatch @{size}"
-        d_gbps, d_tops = _device_leg(dec, sdata, batch * K * chunk,
-                                     iters)
+        d_raw, d_corr = _device_leg_words(
+            dec, swords, batch * K * chunk, iters, floor_s)
 
         e_base = _cpu_encode_gbps(coding, chunk, nat)
         d_base = _cpu_decode_gbps(dm, chunk, nat)
         sweep[str(size)] = {
-            "encode_GBps": round(e_gbps, 3),
-            "decode_GBps": round(d_gbps, 3),
+            "encode_GBps": round(e_raw, 3),
+            "decode_GBps": round(d_raw, 3),
+            "encode_floor_corrected_GBps": round(e_corr, 3),
+            "decode_floor_corrected_GBps": round(d_corr, 3),
             "encode_baseline_GBps": round(e_base, 3),
             "decode_baseline_GBps": round(d_base, 3),
-            "encode_vs_baseline": round(e_gbps / e_base, 2),
-            "decode_vs_baseline": round(d_gbps / d_base, 2),
-            "encode_int8_TOPS": round(e_tops, 3),
+            "encode_vs_baseline": round(e_raw / e_base, 2),
+            "decode_vs_baseline": round(d_raw / d_base, 2),
+            "dispatch_floor_ms": round(floor_s * 1e3, 1),
+            "iters": iters,
             "batch": batch,
         }
         if on_tpu and size == SIZES[-1] and _budget_left() <= 0.45:
-            sweep[str(size)]["encode_v1_skipped"] = \
+            sweep[str(size)]["encode_bytesapi_skipped"] = \
                 "wall budget exhausted"
         if on_tpu and size == SIZES[-1] and _budget_left() > 0.45:
-            # old-vs-new kernel on the same bytes: the r5 redesign
-            # claim (bit-sliced i32 v2 vs uint8-layout v1) must be a
-            # measured delta, not a prediction
+            # old-vs-new on the same bytes: the r5 word-native redesign
+            # must be a measured delta, not a prediction.  The byte-API
+            # v2 kernel through the r4 fat harness is what r4 shipped.
             try:
-                enc_v1 = GFLinear(coding, backend="pallas-v1")
-                assert np.array_equal(np.asarray(enc_v1(data[:2]))[0],
+                enc_b = GFLinear(coding, backend="pallas")
+                assert np.array_equal(np.asarray(enc_b(data[:2]))[0],
                                       parity0)
-                v1_gbps, _ = _device_leg(enc_v1, data,
-                                         batch * K * chunk, iters)
-                sweep[str(size)]["encode_v1_GBps"] = round(v1_gbps, 3)
-                sweep[str(size)]["v2_over_v1"] = round(
-                    e_gbps / v1_gbps, 2)
+                # 120 iters keep the dispatch-floor tax on this slower
+                # leg under ~5%, so the ratio measures the kernels,
+                # not floor amortization
+                b_gbps, _ = _device_leg(enc_b, data,
+                                        batch * K * chunk, 120)
+                sweep[str(size)]["encode_bytesapi_GBps"] = round(
+                    b_gbps, 3)
+                sweep[str(size)]["words_over_bytesapi"] = round(
+                    e_raw / b_gbps, 2)
             except Exception as e:      # noqa: BLE001 — comparison
-                sweep[str(size)]["encode_v1_error"] = str(e)[:160]
-    return sweep, base_label, enc.backend
+                sweep[str(size)]["encode_bytesapi_error"] = str(e)[:160]
+    return sweep, base_label, "pallas-words"
 
 
 def _reconstruct_leg(on_tpu: bool):

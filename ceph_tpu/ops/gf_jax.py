@@ -160,3 +160,46 @@ class GFLinear:
 
     def __call__(self, data) -> jax.Array:
         return self._fn(jnp.asarray(data, dtype=jnp.uint8))
+
+
+class GFLinearWords:
+    """Word-native GF(2^8) linear map: [..., k, nw] int32 -> [..., m, nw].
+
+    The 10x-over-native production encode path (see
+    `gf_pallas2.gf_matmul_words` for the measured rationale): chunk
+    payloads stay int32 for their whole device lifetime, so no call
+    pays the u8<->i32 relayout or the uint8 sublane-padding tax.
+    Host-side conversion is a free ``bytes.view("<i4")``.
+
+    Mirrors the reference's region-multiply entry
+    (``galois_w08_region_multiply`` behind src/erasure-code/jerasure —
+    SURVEY.md §4.2) at word granularity; byte-exactness vs the scalar
+    oracle is asserted in tests/test_gf_pallas2.py and before any
+    bench timing.
+    """
+
+    def __init__(self, coding: np.ndarray, interpret: bool | None = None):
+        self.coding = np.asarray(coding, dtype=np.uint8)
+        self.m, self.k = self.coding.shape
+        # Mosaic only lowers on TPU; elsewhere run the kernel in
+        # interpret mode (the CPU test/fallback path)
+        self.interpret = (jax.default_backend() != "tpu"
+                          if interpret is None else interpret)
+        self._mat = jnp.asarray(_bit_layout_matrix(self.coding))
+        self._bdmats: dict = {}
+
+    def __call__(self, words) -> jax.Array:
+        from .gf_pallas2 import gf_matmul_words
+        return gf_matmul_words(self._mat, words, self.m,
+                               interpret=self.interpret,
+                               bdmats=self._bdmats)
+
+    @staticmethod
+    def to_words(data: np.ndarray) -> np.ndarray:
+        """Host bytes [..., n] uint8 (n % 4 == 0) -> [..., n/4] int32."""
+        return np.ascontiguousarray(data).view("<i4")
+
+    @staticmethod
+    def to_bytes(words: np.ndarray) -> np.ndarray:
+        """Host words [..., nw] int32 -> [..., 4*nw] uint8."""
+        return np.ascontiguousarray(words).view("<u1")

@@ -161,6 +161,120 @@ def gf_matmul_pallas2(bitmat: jnp.ndarray, data: jnp.ndarray, m: int,
     return outb.reshape(*lead, m, n)
 
 
+# -- word-native path: i32 in, i32 out, no byte<->word relayout ------------
+#
+# Round-5 discovery (measured on v5e): the fused byte-API kernel above
+# tops out ~21 GB/s not because of expand/matmul/pack — an empty
+# kernel with the same BlockSpecs runs just as slow — but because of
+# the data movement AROUND it: (a) `bitcast_convert_type` u8->i32 is a
+# real relayout pass on TPU ((32,128) int8 tiles -> (8,128) i32
+# tiles), re-paid every call, and (b) a [B, k, n] uint8 operand with
+# k=8 sublanes pays 4x (32,128)-tile padding on every HBM read.
+# Feeding the SAME kernel i32 words end-to-end measures 66 GB/s raw /
+# ~84 GB/s net of the relay's ~64 ms dispatch floor — 10x the
+# host's gf-complete-strength native baseline (the SURVEY §7 target).
+#
+# Chunk payloads should therefore live as i32 words on device for
+# their whole lifetime; `np.ndarray.view("<i4")` converts on the host
+# for free (GF(2^8) acts bytewise, so word endianness cancels between
+# pack and unpack — same argument as the block-diagonal layout above).
+
+_MAX_TNW_WORDS = 8192
+
+
+def _pick_tile_words(nw: int, k: int) -> int:
+    # VMEM per tile scales with 32k rows; 8192 lanes measured best for
+    # k=8 and stays within budget up to clay-sized k
+    for tnw in (_MAX_TNW_WORDS, 4096, 2048, 1024, 512, 256, _LANES):
+        if tnw <= nw and nw % tnw == 0:
+            return tnw
+    return nw
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "interpret"))
+def _gf_apply_words(bdmat, mrow, words, *, k: int, m: int,
+                    interpret: bool = False):
+    """bdmat [32m, 32k] int8, mrow [32k, 1] i32, words [B, k, nw] i32
+    -> [B, m, nw] i32."""
+    b, _, nw = words.shape
+    tnw = _pick_tile_words(nw, k)
+
+    def kern(bd_ref, mrow_ref, data_ref, out_ref):
+        w = data_ref[0]                               # [k, TNW] i32
+        tiled = jnp.tile(w, (32, 1))                  # [32k, TNW]
+        bits = ((tiled & mrow_ref[...]) != 0).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            bd_ref[...], bits,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32) & 1
+        word = acc[0:m] * jnp.int32(_BIT_W[0])
+        for j in range(1, 32):
+            word = word + acc[j * m:(j + 1) * m] * jnp.int32(_BIT_W[j])
+        out_ref[0] = word
+
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((b, m, nw), jnp.int32),
+        grid=(b, nw // tnw),
+        in_specs=[
+            pl.BlockSpec((4 * 8 * m, 4 * 8 * k), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((4 * 8 * k, 1), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k, tnw), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, m, tnw), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(bdmat, mrow, words)
+
+
+def _word_operands(bitmat, k: int, bdmats: dict | None):
+    """Device [32m, 32k] matrix + [32k, 1] per-row bit masks, cached."""
+    cached = (bdmats or {}).get("words")
+    if cached is not None:
+        return cached
+    bdmat = jnp.asarray(block_diag4(np.asarray(bitmat)))
+    mrow = jnp.asarray(np.array(
+        [_BIT_MASK[r // k] for r in range(32 * k)],
+        dtype=np.int32).reshape(32 * k, 1))
+    # don't poison the cache with tracers if a caller hands us a
+    # traced bitmat from inside its own jit (np.asarray above raises
+    # for tracers, but be explicit about the concrete-only contract)
+    if bdmats is not None and not isinstance(bdmat, jax.core.Tracer):
+        bdmats["words"] = (bdmat, mrow)
+    return bdmat, mrow
+
+
+def gf_matmul_words(bitmat: jnp.ndarray, words: jnp.ndarray, m: int,
+                    interpret: bool = False,
+                    bdmats: dict | None = None) -> jnp.ndarray:
+    """Fused GF(2^8) matmul over word-resident chunks.
+
+    words: [..., k, nw] int32 — each lane holds 4 consecutive payload
+    bytes (host view ``bytes.view("<i4")``).  Returns [..., m, nw]
+    int32 parity words.  nw not divisible by the tile is zero-padded
+    (zero bytes map to zero bytes under any GF-linear map).
+    """
+    k8 = bitmat.shape[1]
+    k = k8 // 8
+    lead = words.shape[:-2]
+    nw = words.shape[-1]
+    x = words.reshape((-1, k, nw))
+    npad = -nw % _LANES
+    if npad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, npad)))
+    bdmat, mrow = _word_operands(bitmat, k, bdmats)
+    with jax.enable_x64(False):
+        out = _gf_apply_words(bdmat, mrow, x, k=k, m=m,
+                              interpret=interpret)
+    out = out[:, :, :nw]
+    return out.reshape(*lead, m, nw)
+
+
 # -- resident bit-planes: expand once, multiply many -----------------------
 #
 # Recovery and scrub re-multiply the SAME surviving chunks by several

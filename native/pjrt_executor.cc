@@ -508,6 +508,16 @@ extern "C" int pjrt_exec_run(pjrt_exec_t *ex, const uint8_t *in,
             for (size_t i = 0; i < m2m.size(); i++)
                 if (m2m[i] != (int64_t)(m2m.size() - 1 - i))
                     rowmajor = false;
+            if (!rowmajor && m2m.size() != nd) {
+                /* a rank-mismatched permuted layout can't be fixed up
+                 * here — fail loudly rather than hand back
+                 * device-ordered bytes as success */
+                ex->fail("output layout rank mismatch: minor_to_major "
+                         "rank != output rank and plugin rejected "
+                         "host_layout");
+                destroy_buf(out_buf);
+                return -1;
+            }
             if (!rowmajor && m2m.size() == nd) {
                 std::vector<uint8_t> raw(out, out + ex->out_bytes);
                 /* physical-major order = reverse(m2m) */
