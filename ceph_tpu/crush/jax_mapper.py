@@ -24,6 +24,7 @@ Requires jax_enable_x64 (straw2 draws are 64-bit fixed point).
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -70,6 +71,101 @@ def _magicu64(d: int) -> tuple[int, int, int]:
     raise AssertionError(f"no magic for {d}")
 
 
+@functools.lru_cache(maxsize=1)
+def _ln_limb_tables() -> tuple[np.ndarray, np.ndarray]:
+    """RH/LH and LL tables split into exact 8-bit limbs for the
+    one-hot MXU lookup path: [129, 14] (RH limbs 0-6, LH limbs 7-13 —
+    RH[0] and LH[128] are exactly 2^48, so bit 48 needs a 7th limb)
+    and [256, 6] (LL limbs).  8-bit limbs so BOTH dot operands are
+    bf16 (0..255 and 0/1 are exact in bf16; a one-hot row selects
+    exactly one limb per output, and the f32 accumulation of a single
+    product is exact) — an f32 limb table makes XLA materialize the
+    one-hot upcast to f32, doubling the dominant HBM traffic."""
+    from .ln import RH_LH_TBL, LL_TBL
+    rh = RH_LH_TBL[0::2].astype(np.uint64)       # [129]
+    lh = RH_LH_TBL[1::2].astype(np.uint64)
+    rhlh = np.zeros((129, 14), dtype=np.float32)
+    for i in range(7):
+        # 7 8-bit limbs cover bit 48 (RH[0] and LH[128] are 2^48)
+        rhlh[:, i] = ((rh >> np.uint64(8 * i)) &
+                      np.uint64(0xFF)).astype(np.float32)
+        rhlh[:, 7 + i] = ((lh >> np.uint64(8 * i)) &
+                          np.uint64(0xFF)).astype(np.float32)
+    ll = np.zeros((256, 6), dtype=np.float32)
+    for i in range(6):
+        ll[:, i] = ((LL_TBL.astype(np.uint64) >> np.uint64(8 * i)) &
+                    np.uint64(0xFF)).astype(np.float32)
+    return rhlh, ll
+
+
+def _onehot_rows(idx, n: int):
+    """[..] int32 -> [.., n] bf16 one-hot (0/1 are exact in bf16; the
+    dot promotes to f32)."""
+    import jax.numpy as jnp
+    return (idx[..., None] == jnp.arange(n, dtype=jnp.int32)
+            ).astype(jnp.bfloat16)
+
+
+def _limbs_to_u64(l, base, count):
+    """[N, >=base+count] f32 8-bit limbs -> [N] uint64."""
+    import jax.numpy as jnp
+    v = l[:, base].astype(jnp.uint64)
+    for i in range(1, count):
+        v = v | (l[:, base + i].astype(jnp.uint64)
+                 << np.uint64(8 * i))
+    return v
+
+
+def _straw2_numerator_onehot(u):
+    """Device crush_ln: the straw2 numerator ((crush_ln(u) - 2^48)
+    << 16) computed with small one-hot MXU table lookups instead of a
+    64Ki-entry gather.
+
+    Rationale (measured, v5e via axon): ANY HBM gather on this backend
+    costs ~135 ms per [128Ki, 64] lookup regardless of table size —
+    it was the entire CRUSH device cost — while one-hot matmuls and
+    u64 limb arithmetic are ~10-100x cheaper.  Bit-exact vs
+    `_ln16_s_tbl` over all 65536 inputs (tests/test_crush_jax.py).
+
+    u: [..] any uint/int dtype holding 16-bit hash values.
+    """
+    import jax
+    import jax.numpy as jnp
+    rhlh_np, ll_np = _ln_limb_tables()
+    rhlh = jnp.asarray(rhlh_np)
+    ll3 = jnp.asarray(ll_np)
+
+    x32 = (u.astype(jnp.uint32) & np.uint32(0xFFFF)) + np.uint32(1)
+    # floor_log2 via the f32 exponent field (exact: x <= 2^16 < 2^24)
+    f = x32.astype(jnp.float32)
+    expo = (jax.lax.bitcast_convert_type(f, jnp.int32)
+            >> 23) - np.int32(127)
+    bits = jnp.maximum(np.int32(0), np.int32(15) - expo)
+    xs = (x32 << bits.astype(jnp.uint32))     # normalized [2^15, 2^16]
+    iexpon = (np.int32(15) - bits).astype(jnp.uint64)
+
+    k = (xs >> np.uint32(8)).astype(jnp.int32) - np.int32(128)  # [0,128]
+    lead = u.shape
+    oh1 = _onehot_rows(k.reshape(-1), 129)                 # [N, 129]
+    limbs14 = jax.lax.dot_general(
+        oh1, rhlh.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [N, 14]
+    rh = _limbs_to_u64(limbs14, 0, 7).reshape(lead)
+    lh = _limbs_to_u64(limbs14, 7, 7).reshape(lead)
+
+    xl64 = (xs.astype(jnp.uint64) * rh) >> np.uint64(48)
+    idx2 = (xl64 & np.uint64(0xFF)).astype(jnp.int32)
+    oh2 = _onehot_rows(idx2.reshape(-1), 256)              # [N, 256]
+    limbs6 = jax.lax.dot_general(
+        oh2, ll3.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [N, 6]
+    llv = _limbs_to_u64(limbs6, 0, 6).reshape(lead)
+
+    result = (iexpon << np.uint64(44)) + ((lh + llv) >> np.uint64(4))
+    s = (result - np.uint64(1 << 48)) << np.uint64(16)   # wraps mod 2^64
+    return jax.lax.bitcast_convert_type(s, jnp.int64)
+
+
 def _mulhi_u64(a, b):
     """High 64 bits of a*b via 32-bit limbs (exact in uint64)."""
     import jax.numpy as jnp
@@ -100,10 +196,18 @@ def _straw2_draws(u, w, wmagic=None, any_add=True, ln16=None):
     """
     import jax
     import jax.numpy as jnp
-    # draw = (ln << 16) / w — the numerator comes straight from the
-    # 64Ki-entry table (see _ln16_s_tbl); divide by the 16.16 weight
-    tbl = jnp.asarray(_ln16_s_tbl()) if ln16 is None else ln16
-    s = tbl[u.astype(jnp.int32)]
+    # draw = (ln << 16) / w.  Numerator source:
+    #   - "onehot" (the TPU path): computed on device via small
+    #     one-hot MXU lookups — HBM gathers cost ~135 ms per
+    #     [128Ki, 64] call on this backend regardless of table size
+    #     and were ~95% of the whole mapper's runtime;
+    #   - otherwise one 64Ki-entry i64 gather (fast on CPU), from the
+    #     passed-in table (a program parameter, not an HLO literal).
+    if isinstance(ln16, str) and ln16 == "onehot":
+        s = _straw2_numerator_onehot(u)
+    else:
+        tbl = jnp.asarray(_ln16_s_tbl()) if ln16 is None else ln16
+        s = tbl[u.astype(jnp.int32)]
     neg = s < 0
     mag = jax.lax.bitcast_convert_type(jnp.abs(s), jnp.uint64)
     if wmagic is None:
@@ -151,6 +255,9 @@ class BatchMapper:
         self.cmap = cmap
         self.rule = rule
         self.chunk = chunk
+        self._ln_mode = os.environ.get(
+            "CEPH_TPU_CRUSH_LN",
+            "onehot" if jax.default_backend() == "tpu" else "table")
         t = cmap.tunables
 
         # --- parse the rule: take + a CHAIN of choose steps + emit -------
@@ -978,7 +1085,11 @@ class BatchMapper:
             fn = indep_fn
 
         def run(x, wdev, ln16):
-            ln16_box[0] = ln16
+            # mode chosen at build: "onehot" computes the numerator on
+            # device (TPU: gathers are the pathology); "table" uses
+            # the passed-in 64Ki gather table (CPU: gathers are fine)
+            ln16_box[0] = ("onehot" if self._ln_mode == "onehot"
+                           else ln16)
             res = fn(x, wdev)
             if res.shape[1] < self.result_max:
                 pad = jnp.full((x.shape[0], self.result_max - res.shape[1]),
@@ -1003,7 +1114,12 @@ class BatchMapper:
             hi = min(lo + self.chunk, len(xs))
             part = xs[lo:hi]
             n = len(part)
-            if n < self.chunk and len(xs) > self.chunk:
+            if n < self.chunk:
+                # ALWAYS pad to the chunk shape: one compiled program
+                # per mapper regardless of call sizes (a short call
+                # used to compile a second program — and on the axon
+                # TPU backend small-batch shapes also trip an XLA
+                # scoped-vmem bug in reduce-window lowering)
                 part = np.pad(part, (0, self.chunk - n))
             res = np.asarray(self._fn(jnp.asarray(part), wdev, ln16))
             outs.append(res[:n])
