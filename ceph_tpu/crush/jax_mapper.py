@@ -1109,7 +1109,10 @@ class BatchMapper:
             reweight = np.asarray(reweight, dtype=np.uint32)
         wdev = jnp.asarray(reweight)
         ln16 = jnp.asarray(_ln16_s_tbl())
-        outs = []
+        # dispatch every chunk before fetching any result: jax's async
+        # dispatch overlaps the per-call relay/device latency (~60 ms
+        # through axon) across chunks instead of serializing it
+        pend = []
         for lo in range(0, len(xs), self.chunk):
             hi = min(lo + self.chunk, len(xs))
             part = xs[lo:hi]
@@ -1118,9 +1121,9 @@ class BatchMapper:
                 # ALWAYS pad to the chunk shape: one compiled program
                 # per mapper regardless of call sizes (a short call
                 # used to compile a second program — and on the axon
-                # TPU backend small-batch shapes also trip an XLA
+                # TPU backend some batch shapes also trip an XLA
                 # scoped-vmem bug in reduce-window lowering)
                 part = np.pad(part, (0, self.chunk - n))
-            res = np.asarray(self._fn(jnp.asarray(part), wdev, ln16))
-            outs.append(res[:n])
-        return np.concatenate(outs, axis=0)
+            pend.append((self._fn(jnp.asarray(part), wdev, ln16), n))
+        return np.concatenate(
+            [np.asarray(res)[:n] for res, n in pend], axis=0)
