@@ -375,10 +375,10 @@ def _ec_sweep(on_tpu: bool):
     # day, not to set records: shrink the launch so the child finishes
     # well inside its budget
     target_bytes = (64 << 20) if on_tpu else (8 << 20)
-    # 300 chained iterations ≈ 240 ms of kernel per leg vs the ~63 ms
+    # 600 chained iterations ≈ 480 ms of kernel per leg vs the ~63 ms
     # relay dispatch floor, so the RAW number (the headline) carries
-    # ≤ 21% floor tax; the floor-corrected field shows the rest
-    iters = 300 if on_tpu else 3
+    # ≤ 12% floor tax; the floor-corrected field shows the rest
+    iters = 600 if on_tpu else 3
 
     coding = rs.reed_sol_van_matrix(K, M)
     nat, base_label = _native_ec()
@@ -623,7 +623,15 @@ def child_main():
         try:
             out["reconstruct"] = _reconstruct_leg(on_tpu)
         except Exception as e:    # keep the EC headline even if broken
-            out["reconstruct"] = {"error": str(e)[:200]}
+            # the relay's remote-compile helper occasionally 500s
+            # under load — one retry distinguishes transient from real
+            if _budget_left() > 0.10:
+                try:
+                    out["reconstruct"] = _reconstruct_leg(on_tpu)
+                except Exception as e2:     # noqa: BLE001
+                    out["reconstruct"] = {"error": str(e2)[:200]}
+            else:
+                out["reconstruct"] = {"error": str(e)[:200]}
     else:
         out["reconstruct"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(out))

@@ -73,23 +73,26 @@ def measure() -> dict:
     tpu_s = time.perf_counter() - t0
     got = np.concatenate(parts, axis=0)
 
-    # warm-start compile: a fresh BatchMapper retraces the same
-    # program and hits the persistent XLA cache — the repeated-CLI
-    # cost the harness user actually pays after the first run
-    t0 = time.perf_counter()
-    bm2 = BatchMapper(cmap, 0, result_max=numrep, chunk=bm.chunk)
-    bm2(warm)
-    warm_compile_s = time.perf_counter() - t0
-
     result = {
         "osds": hosts * per_host, "pgs": n_pgs,
         "pgs_mapped": done, "numrep": numrep,
         "rule": "chooseleaf_firstn host",
         "tpu_pgs_per_sec": round(done / tpu_s, 1),
         "tpu_compile_s": round(compile_s, 2),
-        "tpu_compile_warm_s": round(warm_compile_s, 2),
         "tpu_map_s": round(tpu_s, 2),
     }
+
+    if not on_tpu:
+        # warm-start compile: a fresh BatchMapper retraces the same
+        # program and hits the persistent XLA cache — the repeated-CLI
+        # cost the harness user pays after the first run.  Skipped on
+        # TPU: the axon relay recompiles remotely even on a local
+        # cache hit (measured 40-90 s), which would double the leg's
+        # compile cost for a number the r4/r5 history already records.
+        t0 = time.perf_counter()
+        bm2 = BatchMapper(cmap, 0, result_max=numrep, chunk=bm.chunk)
+        bm2(warm)
+        result["warm_compile_s"] = round(time.perf_counter() - t0, 2)
 
     try:
         from .. import native
@@ -118,9 +121,10 @@ def measure() -> dict:
         "vs_native": round((done / tpu_s) / nat_rate, 2),
         "vs_native_amortized": round(
             (done / (tpu_s + compile_s)) / nat_rate, 2),
-        "vs_native_amortized_warm": round(
-            (done / (tpu_s + warm_compile_s)) / nat_rate, 2),
     })
+    if "warm_compile_s" in result:
+        result["vs_native_amortized_warm"] = round(
+            (done / (tpu_s + result["warm_compile_s"])) / nat_rate, 2)
     return result
 
 
