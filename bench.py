@@ -269,7 +269,8 @@ def _dispatch_floor_s(iters: int) -> float:
     return time.perf_counter() - t0
 
 
-def _device_leg_words(gfw, words_np, logical_bytes, iters, floor_s):
+def _device_leg_words(gfw, words_np, logical_bytes, iters, floor_s,
+                      opaque=True):
     """On-device throughput of a word-native GF map ([B,k,nw] i32 ->
     [B,m,nw] i32).  Iterations are chained inside ONE jit — each
     iteration folds a parity checksum back into one input element (a
@@ -288,7 +289,13 @@ def _device_leg_words(gfw, words_np, logical_bytes, iters, floor_s):
         def body(_, carry):
             dd, acc = carry
             p = gfw(dd)
-            acc = acc ^ jnp.sum(p)
+            # strided checksum: a pallas kernel writes its FULL
+            # output regardless (opaque to XLA), so a ~0.4% sample is
+            # dependency enough without re-reading the parity every
+            # iter.  NOT valid for the XLA-path CPU fallback, where
+            # dead parity columns would be eliminated — full sum there.
+            acc = acc ^ (jnp.sum(p[:, :, ::257], dtype=jnp.int32)
+                         if opaque else jnp.sum(p, dtype=jnp.int32))
             dd = dd.at[0, 0, 0].set(dd[0, 0, 0] ^ (acc & 1))
             return dd, acc
         dd, acc = jax.lax.fori_loop(0, iters, body,
@@ -411,7 +418,8 @@ def _ec_sweep(on_tpu: bool):
         got = GFLinearWords.to_bytes(np.asarray(enc(words[:2])))[0]
         assert np.array_equal(got, parity0), f"parity mismatch @{size}"
         e_raw, e_corr = _device_leg_words(
-            enc, words, batch * K * chunk, iters, floor_s)
+            enc, words, batch * K * chunk, iters, floor_s,
+            opaque=on_tpu)
 
         # decode leg input: each stripe's k surviving shards (ids in
         # `surv`; parity identical across stripes would be unrealistic,
@@ -429,7 +437,8 @@ def _ec_sweep(on_tpu: bool):
         got0 = GFLinearWords.to_bytes(np.asarray(dec(swords[:2])))[0]
         assert np.array_equal(got0, data[0]), f"decode mismatch @{size}"
         d_raw, d_corr = _device_leg_words(
-            dec, swords, batch * K * chunk, iters, floor_s)
+            dec, swords, batch * K * chunk, iters, floor_s,
+            opaque=on_tpu)
 
         e_base = _cpu_encode_gbps(coding, chunk, nat)
         d_base = _cpu_decode_gbps(dm, chunk, nat)
@@ -519,8 +528,10 @@ def _reconstruct_leg(on_tpu: bool):
             r = decode(cc)
             # thin dependency chain: fold a recovery checksum into one
             # element (relay-cache immunity without re-writing the
-            # whole chunk array every iteration)
-            acc = acc ^ jnp.sum(r.astype(jnp.uint32))
+            # whole chunk array every iteration).  dtype pinned: the
+            # crush leg flips jax_enable_x64 in this process, which
+            # would otherwise promote the sum to uint64 mid-carry.
+            acc = acc ^ jnp.sum(r, dtype=jnp.uint32)
             cc = cc.at[0, 0, 0].set(
                 cc[0, 0, 0] ^ (acc & 1).astype(cc.dtype))
             return cc, acc

@@ -267,10 +267,24 @@ def gf_matmul_words(bitmat: jnp.ndarray, words: jnp.ndarray, m: int,
     npad = -nw % _LANES
     if npad:
         x = jnp.pad(x, ((0, 0), (0, 0), (0, npad)))
+    nwp = nw + npad
     bdmat, mrow = _word_operands(bitmat, k, bdmats)
     with jax.enable_x64(False):
-        out = _gf_apply_words(bdmat, mrow, x, k=k, m=m,
-                              interpret=interpret)
+        b = x.shape[0]
+        if nwp < 2048 and b * nwp >= 2048:
+            # small-stripe fold: at 4 KiB stripes nw is one 128-lane
+            # tile and the grid degenerates into b tiny steps whose
+            # per-tile overhead dominates (measured ~2x vs ~12x at
+            # 1 MiB).  GF acts per lane-column, so fold the stripe
+            # batch into the lane axis — one transpose each way buys
+            # full-width tiles.
+            xt = jnp.moveaxis(x, 0, 1).reshape(1, k, b * nwp)
+            out = _gf_apply_words(bdmat, mrow, xt, k=k, m=m,
+                                  interpret=interpret)
+            out = jnp.moveaxis(out.reshape(m, b, nwp), 1, 0)
+        else:
+            out = _gf_apply_words(bdmat, mrow, x, k=k, m=m,
+                                  interpret=interpret)
     out = out[:, :, :nw]
     return out.reshape(*lead, m, nw)
 

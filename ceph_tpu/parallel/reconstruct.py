@@ -120,10 +120,15 @@ class ShardedEC:
         def fn(data):  # [B, k_pad, C] sharded P('dp','shard',None)
             # out is replicated over 'shard' by construction (all_gather +
             # full XOR-reduce); the static VMA check can't see that.
-            return shard_map(
-                local_fn, mesh=mesh,
-                in_specs=P("dp", "shard", None),
-                out_specs=P("dp", None, None), check_vma=False)(data)
+            # Traced under x64=False: every dtype here is explicit, and
+            # an embedding process with x64 on (the CRUSH mapper needs
+            # it) otherwise widens internals — which also trips the
+            # axon remote-compile helper on the word-native program.
+            with jax.enable_x64(False):
+                return shard_map(
+                    local_fn, mesh=mesh,
+                    in_specs=P("dp", "shard", None),
+                    out_specs=P("dp", None, None), check_vma=False)(data)
 
         return fn
 
@@ -195,11 +200,13 @@ class ShardedEC:
             return data
 
         def fn(chunks):  # [B, n_pad, C] sharded P('dp','shard',None)
-            # replicated over 'shard' by construction (decode after gather)
-            return shard_map(
-                local_fn, mesh=mesh,
-                in_specs=P("dp", "shard", None),
-                out_specs=P("dp", None, None), check_vma=False)(chunks)
+            # replicated over 'shard' by construction (decode after
+            # gather); x64=False at trace time — see _build_encode
+            with jax.enable_x64(False):
+                return shard_map(
+                    local_fn, mesh=mesh,
+                    in_specs=P("dp", "shard", None),
+                    out_specs=P("dp", None, None), check_vma=False)(chunks)
 
         return jax.jit(fn)
 
