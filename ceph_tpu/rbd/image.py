@@ -689,9 +689,15 @@ class Image:
         return "journal" if self._hdr.get("journaling") else None
 
     def mirror_snapshots(self) -> list[tuple[int, str]]:
-        """Mirror snapshots as ordered (id, name)."""
+        """Mirror snapshots as ordered (id, name).  Only names with a
+        numeric sequence suffix qualify — the namespace is reserved
+        (create_snap rejects user names under the prefix), but images
+        imported from older clusters may carry strays; parsing them
+        here would crash the mirror daemon's whole sync pass."""
+        plen = len(self.MIRROR_SNAP_PREFIX)
         out = [(s["id"], nm) for nm, s in self._hdr["snaps"].items()
-               if nm.startswith(self.MIRROR_SNAP_PREFIX)]
+               if nm.startswith(self.MIRROR_SNAP_PREFIX)
+               and nm[plen:].isdigit()]
         return sorted(out)
 
     def mirror_snapshot_create(self) -> str:
@@ -715,7 +721,7 @@ class Image:
         nxt = max([self._hdr.get("mirror_snap_seq", 0), *nums]) + 1
         self._hdr["mirror_snap_seq"] = nxt
         name = f"{self.MIRROR_SNAP_PREFIX}{nxt}"
-        self.create_snap(name)        # persists the header too
+        self.create_snap(name, _mirror_internal=True)  # persists header
         self._prune_mirror_snapshots()
         return name
 
@@ -842,8 +848,16 @@ class Image:
         return cand
 
     # -- snapshots -----------------------------------------------------------
-    def create_snap(self, snap_name: str):
+    def create_snap(self, snap_name: str, *, _mirror_internal=False):
         self._require_writable()
+        if (snap_name.startswith(self.MIRROR_SNAP_PREFIX)
+                and not _mirror_internal):
+            # reserved namespace: a user snapshot here would either
+            # collide with a future stamp number or (non-numeric
+            # suffix) confuse peers scanning the prefix
+            raise ValueError(
+                f"snapshot names under {self.MIRROR_SNAP_PREFIX!r} "
+                "are reserved for snapshot-mode mirroring")
         if snap_name in self._hdr["snaps"]:
             raise ValueError(f"snapshot {snap_name!r} exists")
         self._journal_append({"op": "snap_create", "name": snap_name})
@@ -1248,8 +1262,10 @@ class Image:
         if to_snap and to_snap not in self._hdr["snaps"]:
             # stamp the chain endpoint so the NEXT incremental's
             # from_snap check passes (reference import-diff creates
-            # the end snap after applying)
-            self.create_snap(to_snap)
+            # the end snap after applying).  _mirror_internal: in
+            # snapshot-mode sync the endpoint IS a reserved
+            # .mirror.primary.N name the secondary must reproduce
+            self.create_snap(to_snap, _mirror_internal=True)
 
     # -- data path ------------------------------------------------------------
     def write(self, offset: int, data: bytes) -> int:

@@ -283,7 +283,10 @@ class MirrorDaemon:
                 limg.resize(rec["size"])
             elif op == "snap_create":
                 if rec["name"] not in limg._hdr["snaps"]:
-                    limg.create_snap(rec["name"])
+                    # faithful replay: reproduce the source snapshot
+                    # even if its name sits in a reserved namespace
+                    limg.create_snap(rec["name"],
+                                     _mirror_internal=True)
             elif op == "snap_remove":
                 if rec["name"] in limg._hdr["snaps"]:
                     limg.remove_snap(rec["name"])

@@ -309,3 +309,23 @@ def test_unjournaled_image_not_mirrored(sites):
     d = MirrorDaemon(pio, sio, interval=0.05)
     d.sync_once()
     assert "plain" not in rbd.list(sio)
+
+
+def test_mirror_snapshot_namespace_reserved(sites):
+    """A user snapshot under .mirror.primary. would crash the stamp
+    sequencer (non-numeric suffix) or alias a future stamp — the
+    namespace is reserved, and strays are ignored by the scanner."""
+    from ceph_tpu.rbd.image import RBD, Image
+    (lio, rio) = sites
+    RBD().create(rio, "resv", 1 << 22, mirror_snapshot=True)
+    with Image(rio, "resv") as img:
+        with pytest.raises(ValueError, match="reserved"):
+            img.create_snap(".mirror.primary.backup")
+        with pytest.raises(ValueError, match="reserved"):
+            img.create_snap(".mirror.primary.7")
+        # a stray imported from an older cluster is skipped, not fatal
+        img._hdr["snaps"][".mirror.primary.stray"] = {
+            "id": 999, "size": 1 << 22}
+        assert img.mirror_snapshots() == []
+        name = img.mirror_snapshot_create()
+        assert name == ".mirror.primary.1"
