@@ -365,3 +365,15 @@ class TestChooseArgs:
                     [0x4000 * (i + 1) for i in range(len(b.items))],
                 ]}
         _check(m, 0, 3, XS)
+
+
+def test_straw2_numerator_onehot_exhaustive():
+    """The one-hot/u32-pair device crush_ln equals the 64Ki gather
+    table on EVERY 16-bit input (the TPU fast path must be bit-exact
+    — a single off-by-one changes argmax winners and placement)."""
+    import jax.numpy as jnp
+    from ceph_tpu.crush.jax_mapper import (_straw2_numerator_onehot,
+                                           _ln16_s_tbl)
+    u = jnp.asarray(np.arange(0x10000, dtype=np.uint32).reshape(256, 256))
+    got = np.asarray(_straw2_numerator_onehot(u)).reshape(-1)
+    assert np.array_equal(got, _ln16_s_tbl())
