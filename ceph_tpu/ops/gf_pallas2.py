@@ -271,13 +271,15 @@ def gf_matmul_words(bitmat: jnp.ndarray, words: jnp.ndarray, m: int,
     bdmat, mrow = _word_operands(bitmat, k, bdmats)
     with jax.enable_x64(False):
         b = x.shape[0]
-        if nwp < 2048 and b * nwp >= 2048:
-            # small-stripe fold: at 4 KiB stripes nw is one 128-lane
-            # tile and the grid degenerates into b tiny steps whose
-            # per-tile overhead dominates (measured ~2x vs ~12x at
-            # 1 MiB).  GF acts per lane-column, so fold the stripe
-            # batch into the lane axis — one transpose each way buys
-            # full-width tiles.
+        if nwp <= 2048 and b * nwp > 2048:
+            # small-stripe fold: at <=64 KiB stripes the grid
+            # degenerates into b narrow steps whose per-tile overhead
+            # dominates (measured: 4 KiB 14.9->63.8, 64 KiB
+            # 46->62 GB/s; at 128 KiB+ the fold's two transposes turn
+            # into a slight net loss, hence the nwp <= 2048 cut).
+            # GF acts per lane-column, so fold the stripe batch into
+            # the lane axis — one transpose each way buys full-width
+            # tiles.
             xt = jnp.moveaxis(x, 0, 1).reshape(1, k, b * nwp)
             out = _gf_apply_words(bdmat, mrow, xt, k=k, m=m,
                                   interpret=interpret)
