@@ -23,37 +23,63 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import rs
-from ..ops.gf_jax import GFLinear
+from ..ops.gf_jax import GFLinear, GFLinearWords
 
 
 class MatrixECEngine:
-    """Executes encode/decode for a fixed [m, k] GF(2^8) coding matrix."""
+    """Executes encode/decode for a fixed [m, k] GF(2^8) coding matrix.
 
-    def __init__(self, coding: np.ndarray, k: int, m: int):
+    ``word_native`` (auto: on for the TPU backend) routes host-side
+    encode/decode through the i32 word kernel
+    (`gf_pallas2.gf_matmul_words`, the 10x-over-native path — uint8
+    payloads on TPU pay a 4x sublane-padding tax and a relayout per
+    call); the host conversion is a free ``view("<i4")``.  Chunks not
+    4-byte aligned fall back to the byte API (Ceph chunk sizes are
+    power-of-two stripe fractions, so this is theoretical)."""
+
+    def __init__(self, coding: np.ndarray, k: int, m: int,
+                 word_native: bool | None = None):
         coding = np.asarray(coding, dtype=np.uint8)
         assert coding.shape == (m, k), (coding.shape, k, m)
         self.coding = coding
         self.k, self.m = k, m
         self._encoder = GFLinear(coding)
-        self._decoders: dict[tuple[int, ...], tuple[GFLinear, list[int]]] = {}
+        self.word_native = (jax.default_backend() == "tpu"
+                            if word_native is None else word_native)
+        self._encoder_w = (GFLinearWords(coding) if self.word_native
+                           else None)
+        self._decoders: dict[tuple[int, ...],
+                             tuple[GFLinear, object, list[int]]] = {}
+
+    def _apply_host(self, gfl, gflw, data: np.ndarray) -> np.ndarray:
+        """Host bytes -> host bytes through the fastest applicable
+        path (word kernel when aligned, byte API otherwise)."""
+        if gflw is not None and data.shape[-1] % 4 == 0:
+            w = GFLinearWords.to_words(np.ascontiguousarray(data))
+            return GFLinearWords.to_bytes(np.asarray(gflw(w)))
+        return np.asarray(gfl(data))
 
     # -- encode ------------------------------------------------------------
     def encode(self, data: np.ndarray) -> np.ndarray:
         """[k, chunk] or [B, k, chunk] uint8 -> parity of matching batch shape."""
-        return np.asarray(self._encoder(data))
+        return self._apply_host(
+            self._encoder, self._encoder_w,
+            np.asarray(data, dtype=np.uint8))
 
     def encode_device(self, data) -> jax.Array:
         """Same, but stays on device (for benchmark/pipeline use)."""
         return self._encoder(data)
 
     # -- decode ------------------------------------------------------------
-    def _decoder_for(self, erasures: tuple[int, ...]) -> tuple[GFLinear, list[int]]:
+    def _decoder_for(self, erasures: tuple[int, ...]
+                     ) -> tuple[GFLinear, object, list[int]]:
         entry = self._decoders.get(erasures)
         if entry is None:
             dm = rs.decode_matrix(self.coding, self.k, list(erasures))
             survivors = [i for i in range(self.k + self.m)
                          if i not in erasures][: self.k]
-            entry = (GFLinear(dm), survivors)
+            dw = GFLinearWords(dm) if self.word_native else None
+            entry = (GFLinear(dm), dw, survivors)
             self._decoders[erasures] = entry
         return entry
 
@@ -61,9 +87,10 @@ class MatrixECEngine:
                chunk_size: int) -> dict[int, np.ndarray]:
         """Recover all k+m chunks of one stripe from any >=k survivors."""
         erasures = tuple(i for i in range(self.k + self.m) if i not in chunks)
-        decoder, survivors = self._decoder_for(erasures)
-        stacked = np.stack([chunks[i] for i in survivors])
-        data = np.asarray(decoder(stacked))
+        decoder, decoder_w, survivors = self._decoder_for(erasures)
+        stacked = np.stack([np.asarray(chunks[i], dtype=np.uint8)
+                            for i in survivors])
+        data = self._apply_host(decoder, decoder_w, stacked)
         out = {i: data[i] for i in range(self.k)}
         missing_parity = [j for j in range(self.m) if self.k + j not in chunks]
         if missing_parity:
@@ -77,5 +104,7 @@ class MatrixECEngine:
     def decode_batch(self, survivors_data: np.ndarray,
                      erasures: tuple[int, ...]) -> np.ndarray:
         """[B, k, chunk] survivor stack (id order) -> [B, k, chunk] data."""
-        decoder, _ = self._decoder_for(erasures)
-        return np.asarray(decoder(survivors_data))
+        decoder, decoder_w, _ = self._decoder_for(erasures)
+        return self._apply_host(
+            decoder, decoder_w,
+            np.asarray(survivors_data, dtype=np.uint8))
