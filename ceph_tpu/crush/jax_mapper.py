@@ -10,12 +10,12 @@ bit-identical to the scalar oracle (`ceph_tpu.crush.mapper`), enforced by
 tests/test_crush_jax.py.
 
 Supported: straw2 + the stateless legacy bucket algs (straw, list,
-tree), single-block rules `take → [set_*] → choose-chain → emit`
+tree), rules of one or more `take → [set_*] → choose-chain → emit` blocks
 including multi-step choose chains, all chooseleaf vary_r/stable
 tunable combinations, choose_args weight-sets, and reweights.  Falls
 back to the oracle (loudly, via the CLI tools) only for: uniform
 buckets (the perm cache is call-order-stateful),
-choose_local(_fallback)_tries > 0, multiple take/emit blocks,
+choose_local(_fallback)_tries > 0,
 chooseleaf mid-chain, and indep inside a multi-step chain.
 
 Requires jax_enable_x64 (straw2 draws are 64-bit fixed point).
@@ -289,6 +289,22 @@ class BatchMapper:
             "CEPH_TPU_CRUSH_LN",
             "onehot" if jax.default_backend() == "tpu" else "table")
         t = cmap.tunables
+
+        # --- multi-block rules: take ... emit, take ... emit -------------
+        # (reference crush_do_rule just keeps appending to `result`
+        # across blocks; the classic use is hybrid placement — e.g.
+        # primary on an SSD root, replicas on an HDD root.)  Each
+        # block compiles as its own single-block mapper and the
+        # outputs concatenate.  The reference's `numrep <= 0` rule is
+        # numrep += result_max - len(result_so_far): statically that
+        # assumes earlier blocks fully place, so any PG where a
+        # non-final block came up short re-maps through the scalar
+        # oracle (exactness over speed on that rare path).
+        self._subs = None
+        blocks = self._split_blocks(rule.steps)
+        if len(blocks) > 1:
+            self._init_multiblock(blocks, result_max)
+            return
 
         # --- parse the rule: take + a CHAIN of choose steps + emit -------
         # (the reference rule VM, `crush_do_rule`: each choose step's
@@ -1129,9 +1145,104 @@ class BatchMapper:
 
         return run
 
+    @staticmethod
+    def _split_blocks(steps) -> list[list]:
+        blocks: list[list] = []
+        cur: list = []
+        for s in steps:
+            cur.append(s)
+            if s.op == "emit":
+                blocks.append(cur)
+                cur = []
+        if cur:
+            blocks.append(cur)
+        return blocks
+
+    def _init_multiblock(self, blocks: list[list],
+                         result_max: int | None) -> None:
+        from .map import Rule as _Rule, Step as _Step
+        for blk in blocks:
+            ops = [s.op for s in blk]
+            if not any(o.startswith("choose") for o in ops):
+                raise NotImplementedError(
+                    "multi-block rule with a chooseless block: use "
+                    "the scalar oracle")
+            if any(o.endswith("indep") for o in ops):
+                raise NotImplementedError(
+                    "indep in a multi-block rule: use the scalar "
+                    "oracle")
+        if result_max is None and any(
+                s.arg1 <= 0 for blk in blocks for s in blk
+                if s.op.startswith("choose")):
+            raise ValueError(
+                "numrep<=0 multi-block rule needs explicit result_max")
+        # set_* steps persist across blocks in the reference VM —
+        # carry the accumulated prefix into each later block
+        carried: list = []
+        sub_steps: list[list] = []
+        for blk in blocks:
+            sub_steps.append(list(carried) + list(blk))
+            carried += [s for s in blk if s.op.startswith("set_")]
+        subs = []
+        prior = 0
+        for i, st in enumerate(sub_steps):
+            st2 = []
+            for s in st:
+                if s.op.startswith("choose") and s.arg1 <= 0:
+                    # reference: numrep += result_max - osize; osize
+                    # here is the static full-placement width of the
+                    # earlier blocks (shorts re-map via the oracle)
+                    s = _Step(op=s.op,
+                              arg1=s.arg1 + result_max - prior,
+                              arg2=s.arg2)
+                    if s.arg1 <= 0:
+                        raise ValueError(
+                            "multi-block numrep resolves to <= 0")
+                st2.append(s)
+            sub = BatchMapper(
+                self.cmap,
+                _Rule(id=self.rule.id,
+                      name=f"{self.rule.name}#block{i}",
+                      steps=st2, type=self.rule.type),
+                result_max=None, chunk=self.chunk)
+            subs.append(sub)
+            prior += sub.result_max
+        self._subs = subs
+        self.firstn = True
+        self.result_max = prior if result_max is None \
+            else result_max
+
+    def _call_multi(self, xs: np.ndarray, reweight) -> np.ndarray:
+        outs = [sub(xs, reweight) for sub in self._subs]
+        cat = np.concatenate(outs, axis=1)
+        R = self.result_max
+        if cat.shape[1] < R:
+            cat = np.pad(cat, ((0, 0), (0, R - cat.shape[1])),
+                         constant_values=_NONE)
+        res = np.ascontiguousarray(cat[:, :R])
+        # a NON-FINAL block that came up short shifts every later
+        # block's position (and, for numrep<=0, its numrep) — those
+        # PGs re-map through the scalar oracle, exactly
+        short = np.zeros(len(xs), dtype=bool)
+        for o in outs[:-1]:
+            short |= (o == _NONE).any(axis=1)
+        if short.any():
+            from .mapper import do_rule
+            w = (None if reweight is None else
+                 [int(v) for v in np.asarray(reweight,
+                                             dtype=np.uint32)])
+            for i in np.nonzero(short)[0]:
+                lst = do_rule(self.cmap, self.rule, int(xs[i]), R, w)
+                row = np.full(R, _NONE, dtype=np.int32)
+                row[:len(lst)] = lst[:R]
+                res[i] = row
+        return res
+
     def __call__(self, xs, reweight=None) -> np.ndarray:
         import jax.numpy as jnp
         xs = np.asarray(xs, dtype=np.uint32)
+        if self._subs is not None:
+            return self._call_multi(xs, reweight)
         if reweight is None:
             reweight = np.full(max(self.cmap.max_devices, 1), 0x10000,
                                dtype=np.uint32)

@@ -377,3 +377,88 @@ def test_straw2_numerator_onehot_exhaustive():
     u = jnp.asarray(np.arange(0x10000, dtype=np.uint32).reshape(256, 256))
     got = np.asarray(_straw2_numerator_onehot(u)).reshape(-1)
     assert np.array_equal(got, _ln16_s_tbl())
+
+
+def _two_root_map(n_hosts=6, osds_per_host=4):
+    """ssd-root and hdd-root hierarchies in one map (the hybrid-rule
+    topology: primary on ssd, replicas on hdd)."""
+    from ceph_tpu.crush.map import Bucket, CrushMap, Rule, Step
+    m = CrushMap(types={0: "osd", 1: "host", 10: "root"})
+    osd, bid = 0, -3                   # -1/-2 reserved for the roots
+    roots = {}
+    for root_id, label in ((-1, "ssd"), (-2, "hdd")):
+        host_ids, host_ws = [], []
+        for h in range(n_hosts // 2):
+            items = list(range(osd, osd + osds_per_host))
+            hb = Bucket(id=bid, type=1, items=items,
+                        weights=[0x10000] * osds_per_host)
+            m.add_bucket(hb)
+            m.names[bid] = f"{label}-host-{h}"
+            host_ids.append(bid)
+            host_ws.append(hb.weight)
+            bid -= 1
+            osd += osds_per_host
+        roots[root_id] = (host_ids, host_ws)
+    for root_id, label in ((-1, "ssd"), (-2, "hdd")):
+        host_ids, host_ws = roots[root_id]
+        m.add_bucket(Bucket(id=root_id, type=10, items=host_ids,
+                            weights=host_ws))
+        m.names[root_id] = label
+    m.max_devices = osd
+    m.rules.append(Rule(id=0, name="hybrid", steps=[
+        Step("take", -1), Step("chooseleaf_firstn", 1, 1),
+        Step("emit"),
+        Step("take", -2), Step("chooseleaf_firstn", 2, 1),
+        Step("emit")]))
+    m.rules.append(Rule(id=1, name="hybrid_rest", steps=[
+        Step("take", -1), Step("chooseleaf_firstn", 1, 1),
+        Step("emit"),
+        Step("take", -2), Step("chooseleaf_firstn", 0, 1),
+        Step("emit")]))
+    return m
+
+
+def test_multiblock_hybrid_rule_matches_oracle():
+    from ceph_tpu.crush.jax_mapper import BatchMapper
+    from ceph_tpu.crush.mapper import do_rule
+    m = _two_root_map()
+    bm = BatchMapper(m, 0, chunk=256)
+    assert bm.result_max == 3
+    xs = np.arange(512, dtype=np.uint32)
+    got = bm(xs)
+    for x in range(512):
+        want = do_rule(m, 0, x, 3)
+        row = list(got[x][: len(want)])
+        assert row == want, (x, row, want)
+        from ceph_tpu.crush.map import CRUSH_ITEM_NONE as _N
+        assert all(v == _N for v in got[x][len(want):])
+
+
+def test_multiblock_numrep_zero_with_result_max():
+    from ceph_tpu.crush.jax_mapper import BatchMapper
+    from ceph_tpu.crush.mapper import do_rule
+    m = _two_root_map()
+    bm = BatchMapper(m, 1, result_max=4, chunk=256)
+    xs = np.arange(256, dtype=np.uint32)
+    got = bm(xs)
+    for x in range(256):
+        want = do_rule(m, 1, x, 4)
+        assert list(got[x][: len(want)]) == want, (x, got[x], want)
+
+
+def test_multiblock_reweight_matches_oracle():
+    from ceph_tpu.crush.jax_mapper import BatchMapper
+    from ceph_tpu.crush.mapper import do_rule
+    m = _two_root_map()
+    rng = np.random.default_rng(7)
+    w = rng.integers(0, 0x10000 + 1, size=m.max_devices,
+                     dtype=np.uint32).tolist()
+    # a few fully-out devices force shorts/retries
+    for d in (0, 13):
+        w[d] = 0
+    bm = BatchMapper(m, 0, chunk=128)
+    xs = np.arange(256, dtype=np.uint32)
+    got = bm(xs, reweight=np.asarray(w, dtype=np.uint32))
+    for x in range(256):
+        want = do_rule(m, 0, x, 3, list(w))
+        assert list(got[x][: len(want)]) == want, (x, got[x], want)
