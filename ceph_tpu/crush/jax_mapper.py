@@ -1189,11 +1189,13 @@ class BatchMapper:
             st2 = []
             for s in st:
                 if s.op.startswith("choose") and s.arg1 <= 0:
-                    # reference: numrep += result_max - osize; osize
-                    # here is the static full-placement width of the
-                    # earlier blocks (shorts re-map via the oracle)
+                    # reference semantics: numrep += result_max (no
+                    # osize term — crush_do_rule caps at EMIT, not at
+                    # choose); the final cat[:, :result_max] trim
+                    # reproduces the emit cap because firstn picks
+                    # are prefix-stable in numrep
                     s = _Step(op=s.op,
-                              arg1=s.arg1 + result_max - prior,
+                              arg1=s.arg1 + result_max,
                               arg2=s.arg2)
                     if s.arg1 <= 0:
                         raise ValueError(

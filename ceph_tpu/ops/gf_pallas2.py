@@ -271,7 +271,7 @@ def gf_matmul_words(bitmat: jnp.ndarray, words: jnp.ndarray, m: int,
     bdmat, mrow = _word_operands(bitmat, k, bdmats)
     with jax.enable_x64(False):
         b = x.shape[0]
-        if nwp <= 2048 and b * nwp > 2048:
+        if nwp <= 2048 and b > 1 and b * nwp >= 2048:
             # small-stripe fold: at <=64 KiB stripes the grid
             # degenerates into b narrow steps whose per-tile overhead
             # dominates (measured: 4 KiB 14.9->63.8, 64 KiB

@@ -446,6 +446,29 @@ def test_multiblock_numrep_zero_with_result_max():
         assert list(got[x][: len(want)]) == want, (x, got[x], want)
 
 
+def test_multiblock_negative_numrep_matches_oracle():
+    """firstn -1 in the second block: the reference resolves numrep
+    += result_max at CHOOSE and caps at EMIT — a formula subtracting
+    the earlier blocks' width under-replicates by one (a silent data
+    safety bug this test pins)."""
+    from ceph_tpu.crush.jax_mapper import BatchMapper
+    from ceph_tpu.crush.map import Rule, Step
+    from ceph_tpu.crush.mapper import do_rule
+    m = _two_root_map()
+    m.rules.append(Rule(id=2, name="hybrid_neg", steps=[
+        Step("take", -1), Step("chooseleaf_firstn", 1, 1),
+        Step("emit"),
+        Step("take", -2), Step("chooseleaf_firstn", -1, 1),
+        Step("emit")]))
+    bm = BatchMapper(m, 2, result_max=4, chunk=128)
+    xs = np.arange(192, dtype=np.uint32)
+    got = bm(xs)
+    for x in range(192):
+        want = do_rule(m, 2, x, 4)
+        assert len(want) == 4, (x, want)   # 1 ssd + 3 hdd
+        assert list(got[x][: len(want)]) == want, (x, got[x], want)
+
+
 def test_multiblock_reweight_matches_oracle():
     from ceph_tpu.crush.jax_mapper import BatchMapper
     from ceph_tpu.crush.mapper import do_rule
