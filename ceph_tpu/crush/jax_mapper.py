@@ -9,13 +9,12 @@ and the hierarchy walk is a fixed-depth masked descent.  Output is
 bit-identical to the scalar oracle (`ceph_tpu.crush.mapper`), enforced by
 tests/test_crush_jax.py.
 
-Supported: straw2 + the stateless legacy bucket algs (straw, list,
-tree), rules of one or more `take → [set_*] → choose-chain → emit` blocks
-including multi-step choose chains, all chooseleaf vary_r/stable
-tunable combinations, choose_args weight-sets, and reweights.  Falls
-back to the oracle (loudly, via the CLI tools) only for: uniform
-buckets (the perm cache is call-order-stateful),
-choose_local(_fallback)_tries > 0,
+Supported: ALL bucket algorithms (straw2, uniform, straw, list,
+tree), rules of one or more `take → [set_*] → choose-chain → emit`
+blocks including multi-step choose chains and hybrid multi-block
+rules, all chooseleaf vary_r/stable tunable combinations, choose_args
+weight-sets, and reweights.  Falls back to the oracle (loudly, via
+the CLI tools) only for: choose_local(_fallback)_tries > 0,
 chooseleaf mid-chain, and indep inside a multi-step chain.
 
 Requires jax_enable_x64 (straw2 draws are 64-bit fixed point).
@@ -398,19 +397,21 @@ class BatchMapper:
         self.take = take
 
         # --- flatten the bucket table ------------------------------------
-        # supported algs: straw2 (the modern default), plus the
-        # stateless legacy algs straw/list/tree, all vectorized.
-        # uniform stays on the oracle: bucket_perm_choose's lazily
-        # built permutation is CALL-ORDER-stateful (the r=0 fast path
-        # leaves a different base permutation than a pr>0 first
-        # visit), which a stateless batched recomputation cannot
-        # reproduce bit-exactly.
+        # supported algs: straw2 (the modern default), plus the legacy
+        # algs uniform/straw/list/tree, all vectorized.  uniform's
+        # permutation cache LOOKS call-order-stateful (the r=0 fast
+        # path), but the first Fisher-Yates step produces exactly the
+        # fast path's transposition, so bucket_perm_choose is a pure
+        # function of (bucket, x, r) — verified against the oracle
+        # over shuffled query orders (tests/test_crush_jax.py) — and
+        # the batched path recomputes the unfold per element.
         nb = len(cmap.buckets)
         S = 1
         for b in cmap.buckets:
             if b is None:
                 continue
-            if b.alg not in ("straw2", "straw", "list", "tree"):
+            if b.alg not in ("straw2", "uniform", "straw", "list",
+                             "tree"):
                 raise NotImplementedError(
                     f"bucket alg {b.alg}: use the scalar oracle")
             if b.size == 0:
@@ -447,8 +448,13 @@ class BatchMapper:
             for p in range(P):
                 if ws:
                     weights[p, row, :b.size] = ws[min(p, len(ws) - 1)]
-                else:
+                elif len(b.weights) == b.size:
                     weights[p, row, :b.size] = b.weights
+                else:
+                    # uniform buckets may carry only item_weight; the
+                    # per-item weights only feed straw2 draws (masked
+                    # out for uniform rows) and the summary APIs
+                    weights[p, row, :b.size] = b.item_weight
         self._items, self._weights = items, weights
         self._hash_ids = hash_ids
         self._sizes, self._btype = sizes, btype
@@ -460,7 +466,8 @@ class BatchMapper:
         # crush_calc_straw / crush_make_tree_bucket
         self._algs = sorted({b.alg for b in cmap.buckets
                              if b is not None})
-        alg_num = {"straw2": 0, "straw": 1, "list": 2, "tree": 3}
+        alg_num = {"straw2": 0, "straw": 1, "list": 2, "tree": 3,
+                   "uniform": 4}
         acode = np.zeros(nb, dtype=np.int32)
         bids = np.zeros(nb, dtype=np.int32)
         strawsc = np.zeros((nb, S), dtype=np.int64)
@@ -607,6 +614,39 @@ class BatchMapper:
                 draws = u16.astype(jnp.int64) * strawsc[:, :s_][rows]
                 sel = jnp.argmax(draws, axis=1)
                 outs[1] = its[barange, sel]
+            if "uniform" in legacy_algs:
+                # bucket_perm_choose: progressive Fisher-Yates keyed
+                # by hash(x, bucket_id, step) — pure in (bucket, x, r)
+                # (the r=0 fast path equals the first unfold step; see
+                # the build-time comment).  Swaps via one-hot masks:
+                # per-element dynamic indexing would hit this
+                # backend's pathological gather path.
+                size_u = sizes[rows].astype(jnp.uint32)   # [B]
+                pr = (r.astype(jnp.uint32) % size_u).astype(jnp.int32)
+                cols = jnp.arange(s_, dtype=jnp.int32)[None, :]
+                perm = jnp.broadcast_to(cols,
+                                        (rows.shape[0], s_))
+                bid_u = bids[rows].astype(jnp.uint32)
+                for p in range(s_):
+                    hp = crush_hash32_3(
+                        x, bid_u, jnp.full_like(bid_u, p))
+                    i = (hp % jnp.maximum(
+                        size_u - np.uint32(p), np.uint32(1))
+                         ).astype(jnp.int32)
+                    swap = ((p <= pr) & (np.int32(p) <
+                                         sizes[rows] - 1) & (i > 0))
+                    j = np.int32(p) + i
+                    ohj = (cols == j[:, None]) & swap[:, None]
+                    colp = perm[:, p]
+                    colj = jnp.sum(jnp.where(ohj, perm, 0), axis=1,
+                                   dtype=jnp.int32)
+                    val_p = jnp.where(swap, colj, colp)
+                    perm = jnp.where(ohj, colp[:, None], perm)
+                    perm = perm.at[:, p].set(val_p)
+                ohpr = cols == pr[:, None]
+                idx = jnp.sum(jnp.where(ohpr, perm, 0), axis=1,
+                              dtype=jnp.int32)
+                outs[4] = its[barange, idx]
             if "list" in legacy_algs:
                 # newest→oldest walk; item i keeps the draw with
                 # probability weight_i / prefixsum_i → the FIRST hit

@@ -294,11 +294,13 @@ class TestLegacyBucketAlgs:
                 b.alg = "straw"
         _check(m, 0, 4, XS[:250])
 
-    def test_uniform_still_falls_back(self):
+    def test_uniform_flat_bucket(self):
+        # r5: uniform is batched too (bucket_perm_choose proved pure
+        # in (bucket, x, r) — see test_uniform_perm_choose_is_order_
+        # independent); a flat all-uniform map maps bit-exactly
         m = self._flat("uniform")
         m.bucket(-1).item_weight = 0x10000
-        with pytest.raises(NotImplementedError, match="uniform"):
-            BatchMapper(m, 0, result_max=3)
+        _check(m, 0, 3, XS[:250])
 
     def test_choose_args_ignored_on_legacy_buckets(self):
         """A weight-set attached to a legacy bucket must not displace
@@ -480,6 +482,88 @@ def test_multiblock_reweight_matches_oracle():
     for d in (0, 13):
         w[d] = 0
     bm = BatchMapper(m, 0, chunk=128)
+    xs = np.arange(256, dtype=np.uint32)
+    got = bm(xs, reweight=np.asarray(w, dtype=np.uint32))
+    for x in range(256):
+        want = do_rule(m, 0, x, 3, list(w))
+        assert list(got[x][: len(want)]) == want, (x, got[x], want)
+
+
+def _uniform_map(n_hosts=8, osds_per_host=4):
+    """root (straw2) -> hosts (UNIFORM buckets) -> osds."""
+    from ceph_tpu.crush.map import Bucket, CrushMap, Rule, Step
+    m = CrushMap(types={0: "osd", 1: "host", 10: "root"})
+    osd, bid = 0, -2
+    host_ids, host_ws = [], []
+    for h in range(n_hosts):
+        items = list(range(osd, osd + osds_per_host))
+        hb = Bucket(id=bid, type=1, alg="uniform", items=items,
+                    weights=[0x10000] * osds_per_host,
+                    item_weight=0x10000)
+        m.add_bucket(hb)
+        host_ids.append(bid)
+        host_ws.append(hb.weight)
+        bid -= 1
+        osd += osds_per_host
+    m.add_bucket(Bucket(id=-1, type=10, items=host_ids,
+                        weights=host_ws))
+    m.max_devices = osd
+    m.rules.append(Rule(id=0, name="repl", steps=[
+        Step("take", -1), Step("chooseleaf_firstn", 0, 1),
+        Step("emit")]))
+    m.rules.append(Rule(id=1, name="ec", type="erasure", steps=[
+        Step("take", -1), Step("set_chooseleaf_tries", 5),
+        Step("chooseleaf_indep", 0, 1), Step("emit")]))
+    return m
+
+
+def test_uniform_perm_choose_is_order_independent():
+    """bucket_perm_choose is a pure function of (bucket, x, r): the
+    r=0 fast path's transposition equals the first Fisher-Yates step,
+    so shuffled/repeated query orders agree — the premise the batched
+    uniform path rests on."""
+    import random
+    from ceph_tpu.crush.map import Bucket
+    from ceph_tpu.crush.mapper import CrushWork, bucket_perm_choose
+    b = Bucket(id=-5, type=1, alg="uniform",
+               items=[10, 11, 12, 13, 14, 15, 16],
+               weights=[0x10000] * 7)
+    rng = random.Random(0)
+    for x in range(64):
+        w = CrushWork()
+        canon = {pr: bucket_perm_choose(b, w, x, pr)
+                 for pr in range(7)}
+        for _ in range(4):
+            order = list(range(7)) * 2
+            rng.shuffle(order)
+            w2 = CrushWork()
+            for pr in order:
+                assert bucket_perm_choose(b, w2, x, pr) == canon[pr]
+
+
+def test_uniform_buckets_match_oracle():
+    from ceph_tpu.crush.jax_mapper import BatchMapper
+    from ceph_tpu.crush.mapper import do_rule
+    m = _uniform_map()
+    for rule, rm in ((0, 3), (1, 4)):
+        bm = BatchMapper(m, rule, result_max=rm, chunk=256)
+        xs = np.arange(512, dtype=np.uint32)
+        got = bm(xs)
+        for x in range(512):
+            want = do_rule(m, rule, x, rm)
+            assert list(got[x][: len(want)]) == want, \
+                (rule, x, list(got[x]), want)
+
+
+def test_uniform_buckets_reweight_matches_oracle():
+    from ceph_tpu.crush.jax_mapper import BatchMapper
+    from ceph_tpu.crush.mapper import do_rule
+    m = _uniform_map()
+    rng = np.random.default_rng(5)
+    w = rng.integers(0, 0x10000 + 1, size=m.max_devices,
+                     dtype=np.uint32).tolist()
+    w[3] = 0
+    bm = BatchMapper(m, 0, result_max=3, chunk=128)
     xs = np.arange(256, dtype=np.uint32)
     got = bm(xs, reweight=np.asarray(w, dtype=np.uint32))
     for x in range(256):
