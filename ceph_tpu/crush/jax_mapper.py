@@ -738,16 +738,37 @@ class BatchMapper:
                     out = jnp.where(ac == np.int32(code), val, out)
             return out
 
-        def descend(start, x, r, target, step_specs, pos):
-            """Masked hierarchy walk until item type == target."""
+        def descend(start, x, r, target, step_specs, pos,
+                    indep_ft=None, indep_numrep=0):
+            """Masked hierarchy walk until item type == target.
+
+            indep paths recompute r PER LEVEL (reference
+            crush_choose_indep: r = rep + parent_r + numrep*ftotal,
+            except (numrep+1)*ftotal while inside a uniform bucket
+            whose size divides numrep) — pass the base r and the
+            ftotal vector via `indep_ft` and the adjustment happens
+            against each level's current bucket."""
             itm = start
+            r_last = r
             for spec in (step_specs or [None]):
                 isb = itm < 0
                 rows = jnp.clip(-1 - itm, 0, nb - 1)
                 t = jnp.where(isb, btype[rows], 0)
                 need = isb & (t != target)
-                nxt = straw2(rows, x, r, pos, spec)
+                if indep_ft is None:
+                    rl = r
+                else:
+                    n_ = np.int32(indep_numrep)
+                    udiv = ((acode[rows] == np.int32(4))
+                            & (sizes[rows] % n_ == 0))
+                    rl = r + jnp.where(udiv, n_ + 1, n_) * indep_ft
+                    # the r in force where each row actually drew last
+                    # — becomes the inner recursion's parent_r
+                    r_last = jnp.where(need, rl, r_last)
+                nxt = straw2(rows, x, rl, pos, spec)
                 itm = jnp.where(need, nxt, itm)
+            if indep_ft is not None:
+                return itm, r_last
             return itm
 
         def dev_out(wdev, itm, x):
@@ -1067,18 +1088,21 @@ class BatchMapper:
 
         UNDEF = np.int32(-0x7FFFFFFE)
 
-        def _indep_leaf(host, x, r, rep, wdev):
+        def _indep_leaf(host, x, parent_r, rep, wdev):
             """C: nested crush_choose_indep(left=1, numrep, outpos=rep,
             parent_r=r, tries=recurse_tries); the inner draw index is
-            rep + parent_r + numrep*ftotal_inner; self-only collision
-            check ⇒ none."""
-            got = jnp.zeros(r.shape, dtype=bool)
-            dead = jnp.zeros(r.shape, dtype=bool)
-            leaf = jnp.full(r.shape, _NONE, dtype=jnp.int32)
+            rep + parent_r + numrep*ftotal_inner — with the uniform-
+            divisible (numrep+1) adjustment applied per level against
+            the inner descent's own buckets."""
+            got = jnp.zeros(parent_r.shape, dtype=bool)
+            dead = jnp.zeros(parent_r.shape, dtype=bool)
+            leaf = jnp.full(parent_r.shape, _NONE, dtype=jnp.int32)
+            base = rep + parent_r
             for ft in range(rtries):
-                ri = rep + r + np.int32(numrep * ft)
-                cand = descend(host, x, ri, 0, sizes2,
-                               jnp.broadcast_to(rep, ri.shape))
+                cand, _ = descend(
+                    host, x, base, 0, sizes2,
+                    jnp.broadcast_to(rep, base.shape),
+                    indep_ft=np.int32(ft), indep_numrep=numrep)
                 valid = (cand >= 0) & (host < 0)
                 reject = dev_out(wdev, cand, x) | ~valid
                 active = ~got & ~dead
@@ -1107,15 +1131,18 @@ class BatchMapper:
                 def rep_step(rep, c):
                     out, out2 = c
                     needs = out[:, rep] == UNDEF
-                    r = (rep + np.int32(numrep) * ftotal
-                         ).astype(jnp.int32) * jnp.ones((B_,),
-                                                        jnp.int32)
-                    itm = descend(root, x, r, target, sizes1,
-                                  jnp.broadcast_to(rep, r.shape))
+                    base = (rep.astype(jnp.int32)
+                            * jnp.ones((B_,), jnp.int32))
+                    itm, r_par = descend(
+                        root, x, base, target, sizes1,
+                        jnp.broadcast_to(rep, base.shape),
+                        indep_ft=ftotal.astype(jnp.int32),
+                        indep_numrep=numrep)
                     valid = item_type(itm) == target
                     collide = jnp.any(out == itm[:, None], axis=1)
                     if leafmode:
-                        lf, lgot = _indep_leaf(itm, x, r, rep, wdev)
+                        lf, lgot = _indep_leaf(itm, x, r_par, rep,
+                                               wdev)
                         reject = collide | ~lgot
                     else:
                         lf = itm

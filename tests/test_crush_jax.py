@@ -569,3 +569,26 @@ def test_uniform_buckets_reweight_matches_oracle():
     for x in range(256):
         want = do_rule(m, 0, x, 3, list(w))
         assert list(got[x][: len(want)]) == want, (x, got[x], want)
+
+
+def test_uniform_indep_divisible_retry_increment():
+    """crush_choose_indep advances r by (numrep+1)*ftotal while
+    descending INSIDE a uniform bucket whose size divides numrep
+    (plain numrep*ftotal elsewhere, recomputed per level).  Dead
+    devices force inner retries so the special increment actually
+    fires — the initial batched-uniform landing diverged on 470/512
+    mappings here."""
+    from ceph_tpu.crush.jax_mapper import BatchMapper
+    from ceph_tpu.crush.mapper import do_rule
+    m = _uniform_map()                       # hosts uniform, size 4
+    rng = np.random.default_rng(9)
+    w = rng.integers(0, 0x10000 + 1, size=m.max_devices,
+                     dtype=np.uint32).tolist()
+    for d in (0, 1, 5, 9):
+        w[d] = 0
+    bm = BatchMapper(m, 1, result_max=4, chunk=128)  # indep numrep 4
+    xs = np.arange(512, dtype=np.uint32)
+    got = bm(xs, reweight=np.asarray(w, dtype=np.uint32))
+    for x in range(512):
+        want = do_rule(m, 1, x, 4, list(w))
+        assert list(got[x][: len(want)]) == want, (x, got[x], want)
