@@ -148,9 +148,9 @@ class TestOrchCommands:
         c.kill_mgr("x")
         assert _wait(
             lambda: r.mon_command({"prefix": "mgr stat"})[2]
-            .get("active_name") == "y", timeout=30)
+            .get("active_name") == "y", timeout=90)
         # the new active answers orch commands with the same specs
-        rc, _, services = r.mgr_command("orch ls", timeout=30)
+        rc, _, services = r.mgr_command("orch ls", timeout=90)
         assert rc == 0
         assert any(s["service_type"] == "mds" and s["count"] == 2
                    for s in services)
