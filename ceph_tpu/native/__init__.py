@@ -343,6 +343,10 @@ class NativeCrush:
         self._lib = _load()
         if self._lib is None:
             raise RuntimeError("native library not built")
+        if getattr(mapper, "_subs", None):
+            # multi-block mappers have no single flat rule to mirror
+            raise RuntimeError(
+                "NativeCrush mirrors single-block rules only")
         algs = set(getattr(mapper, "_algs", ["straw2"]))
         if algs - {"straw2"}:
             # the native scalar implements straw2 draws only; now
