@@ -464,6 +464,9 @@ class BatchMapper:
         # legacy-alg tables (straw scalers, list prefix sums, tree
         # node weights) — derived once at build like the reference's
         # crush_calc_straw / crush_make_tree_bucket
+        self._uniform_smax = max(
+            (b.size for b in cmap.buckets
+             if b is not None and b.alg == "uniform"), default=0)
         self._algs = sorted({b.alg for b in cmap.buckets
                              if b is not None})
         alg_num = {"straw2": 0, "straw": 1, "list": 2, "tree": 3,
@@ -627,7 +630,10 @@ class BatchMapper:
                 perm = jnp.broadcast_to(cols,
                                         (rows.shape[0], s_))
                 bid_u = bids[rows].astype(jnp.uint32)
-                for p in range(s_):
+                # perm[pr] is final after step pr (later steps only
+                # touch positions > pr) and pr < size <= largest
+                # uniform bucket — cap the unroll there
+                for p in range(min(s_, self._uniform_smax)):
                     hp = crush_hash32_3(
                         x, bid_u, jnp.full_like(bid_u, p))
                     i = (hp % jnp.maximum(
