@@ -22,7 +22,9 @@ Requires jax_enable_x64 (straw2 draws are 64-bit fixed point).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import hashlib
 import os
 
 import numpy as np
@@ -33,6 +35,18 @@ from .map import CRUSH_ITEM_NONE, CrushMap, Rule
 
 _NONE = CRUSH_ITEM_NONE
 _I64_MIN = -(1 << 63)
+
+# Incremented whenever a mapper PROGRAM is built (traced) — i.e. NOT on
+# a warm start from the on-disk export cache and NOT on
+# set_weights/remap.  Tests assert on deltas to prove that weight-only
+# changes and cache hits never retrace.
+TRACE_COUNT = 0
+
+# order of the runtime weight-table tuple `run` takes (every table a
+# reweight/balancer round can change — the compiled program's ONLY
+# value-dependence on bucket weights)
+_WTAB_FIELDS = ("w", "wm_m", "wm_s", "wm_a", "hids", "strawsc",
+                "lsums", "tnodes")
 
 
 @functools.lru_cache(maxsize=1)
@@ -55,8 +69,10 @@ def _magicu64(d: int) -> tuple[int, int, int]:
     (((n - t) >> 1) + t) >> (s - 1) with t = mulhi(n, M).
 
     TPUs have no 64-bit integer divide (XLA emulates it with a long
-    shift-subtract loop); bucket weights are compile-time constants,
-    so each item's divisor becomes ~4 32-bit multiplies instead.
+    shift-subtract loop); each weight's magic triple is computed on the
+    host and rides into the program as a runtime argument alongside the
+    weight table, so each item's divisor becomes ~4 32-bit multiplies —
+    and a reweight only re-derives the triples, never the program.
     """
     if d <= 0:
         return 0, 0, 0
@@ -216,9 +232,10 @@ def _straw2_draws(u, w, wmagic=None, any_add=True, ln16=None):
     of an all-zero bucket, matching the reference's `i == 0` seed).
 
     wmagic: optional (M, s, add) uint64/int32 arrays matching w, from
-    `_magicu64` — the division-free path for static weight tables.
-    any_add: False when the static table contains no add-case magics
-    (the common case) so the add branch compiles away entirely.
+    `_magicu64` — the division-free path for host-derived weight tables.
+    any_add: False only when the caller KNOWS the magic table can never
+    contain add-case entries; weight tables passed as runtime arguments
+    must keep the add branch (the values are not visible at trace time).
     ln16: the _ln16_s_tbl array, passed as a traced argument so the
     512 KiB table is a program parameter, not an inline HLO literal
     (inlining it tripled compile time).
@@ -264,6 +281,15 @@ class BatchMapper:
     __call__(xs[B], reweight[max_devices]?) → int32 [B, result_max];
     firstn results are compacted with CRUSH_ITEM_NONE padding at the end,
     indep results keep positional NONE holes (EC shard order).
+
+    Compilation is SHAPE-keyed, not value-keyed: every weight-derived
+    table is a runtime argument of the jitted program, so
+    `set_weights(new_cmap)` / `remap({bucket_id: weights})` rebind a
+    weight-only map change onto the already-compiled executable with
+    zero retraces (asserted by tests/test_compile_cache.py).  The
+    traced program is also `jax.export`ed to an on-disk cache
+    (`native.aot.CompileCache`) so a fresh process with the same
+    topology shape skips tracing too — `cache_hit` reports that.
     """
 
     def __init__(self, cmap: CrushMap, rule: Rule | int,
@@ -295,10 +321,11 @@ class BatchMapper:
         # primary on an SSD root, replicas on an HDD root.)  Each
         # block compiles as its own single-block mapper and the
         # outputs concatenate.  The reference's `numrep <= 0` rule is
-        # numrep += result_max - len(result_so_far): statically that
-        # assumes earlier blocks fully place, so any PG where a
-        # non-final block came up short re-maps through the scalar
-        # oracle (exactness over speed on that rare path).
+        # `numrep += result_max` (crush_do_rule caps at EMIT, not at
+        # choose), so a later block can draw more than the remaining
+        # slots; but a non-final block that comes up SHORT shifts every
+        # later block's positions, so those PGs re-map through the
+        # scalar oracle (exactness over speed on that rare path).
         self._subs = None
         blocks = self._split_blocks(rule.steps)
         if len(blocks) > 1:
@@ -397,128 +424,14 @@ class BatchMapper:
         self.take = take
 
         # --- flatten the bucket table ------------------------------------
-        # supported algs: straw2 (the modern default), plus the legacy
-        # algs uniform/straw/list/tree, all vectorized.  uniform's
-        # permutation cache LOOKS call-order-stateful (the r=0 fast
-        # path), but the first Fisher-Yates step produces exactly the
-        # fast path's transposition, so bucket_perm_choose is a pure
-        # function of (bucket, x, r) — verified against the oracle
-        # over shuffled query orders (tests/test_crush_jax.py) — and
-        # the batched path recomputes the unfold per element.
-        nb = len(cmap.buckets)
-        S = 1
-        for b in cmap.buckets:
-            if b is None:
-                continue
-            if b.alg not in ("straw2", "uniform", "straw", "list",
-                             "tree"):
-                raise NotImplementedError(
-                    f"bucket alg {b.alg}: use the scalar oracle")
-            if b.size == 0:
-                raise ValueError("empty bucket in map")
-            S = max(S, b.size)
-        items = np.zeros((nb, S), dtype=np.int32)
-        hash_ids = np.zeros((nb, S), dtype=np.int32)
-        sizes = np.zeros(nb, dtype=np.int32)
-        btype = np.zeros(nb, dtype=np.int32)
-        # choose_args (balancer weight-set): per-POSITION weight
-        # overrides and id substitution (reference CrushWrapper
-        # choose_args / bucket_straw2_choose's position argument)
-        P = 1
-        for arg in cmap.choose_args.values():
-            if arg.get("weight_set"):
-                P = max(P, len(arg["weight_set"]))
-        weights = np.zeros((P, nb, S), dtype=np.int64)
-        for row, b in enumerate(cmap.buckets):
-            if b is None:
-                continue
-            items[row, :b.size] = b.items
-            hash_ids[row, :b.size] = b.items
-            sizes[row] = b.size
-            btype[row] = b.type
-            arg = cmap.choose_args.get(b.id) or {}
-            # choose_args act on straw2 buckets only (the oracle's
-            # bucket_straw2_choose is the sole reader) — a weight-set
-            # attached to a legacy bucket must not displace the plain
-            # weights the legacy formulas read
-            ws = (arg.get("weight_set")
-                  if b.alg == "straw2" else None)
-            if arg.get("ids") and b.alg == "straw2":
-                hash_ids[row, :b.size] = arg["ids"]
-            for p in range(P):
-                if ws:
-                    weights[p, row, :b.size] = ws[min(p, len(ws) - 1)]
-                elif len(b.weights) == b.size:
-                    weights[p, row, :b.size] = b.weights
-                else:
-                    # uniform buckets may carry only item_weight; the
-                    # per-item weights only feed straw2 draws (masked
-                    # out for uniform rows) and the summary APIs
-                    weights[p, row, :b.size] = b.item_weight
-        self._items, self._weights = items, weights
-        self._hash_ids = hash_ids
-        self._sizes, self._btype = sizes, btype
-        self._nb, self._S, self._P = nb, S, P
-        self._bucket_by_id = {b.id: b for b in cmap.buckets
-                              if b is not None}
-        # legacy-alg tables (straw scalers, list prefix sums, tree
-        # node weights) — derived once at build like the reference's
-        # crush_calc_straw / crush_make_tree_bucket
-        self._uniform_smax = max(
-            (b.size for b in cmap.buckets
-             if b is not None and b.alg == "uniform"), default=0)
-        self._algs = sorted({b.alg for b in cmap.buckets
-                             if b is not None})
-        alg_num = {"straw2": 0, "straw": 1, "list": 2, "tree": 3,
-                   "uniform": 4}
-        acode = np.zeros(nb, dtype=np.int32)
-        bids = np.zeros(nb, dtype=np.int32)
-        strawsc = np.zeros((nb, S), dtype=np.int64)
-        lsums = np.zeros((nb, S), dtype=np.int64)
-        from .mapper import _tree_node_weights, calc_straw_scalers
-        trees = {row: _tree_node_weights(b)
-                 for row, b in enumerate(cmap.buckets)
-                 if b is not None and b.alg == "tree"}
-        NT = max([num for _, num in trees.values()], default=2)
-        tnodes = np.zeros((nb, NT), dtype=np.int64)
-        troot = np.ones(nb, dtype=np.int32)
-        tdepth = 0
-        for row, b in enumerate(cmap.buckets):
-            if b is None:
-                continue
-            acode[row] = alg_num[b.alg]
-            bids[row] = b.id
-            if b.alg == "straw":
-                strawsc[row, :b.size] = calc_straw_scalers(b.weights)
-            elif b.alg == "list":
-                lsums[row, :b.size] = np.cumsum(b.weights)
-            elif b.alg == "tree":
-                nodes, num = trees[row]
-                tnodes[row, :num] = nodes
-                troot[row] = num >> 1
-                d = 0
-                n = num >> 1
-                while n and (n & 1) == 0:
-                    d += 1
-                    n >>= 1
-                tdepth = max(tdepth, d)
-        self._acode, self._bids = acode, bids
-        self._strawsc, self._lsums = strawsc, lsums
-        self._tnodes, self._troot = tnodes, troot
-        self._tdepth = tdepth
-        # division-free straw2: per-item magic constants for the static
-        # weight table (TPU has no native u64 divide)
-        mw = np.zeros((P, nb, S), dtype=np.uint64)
-        sw = np.zeros((P, nb, S), dtype=np.int32)
-        aw = np.zeros((P, nb, S), dtype=np.int32)
-        for p in range(P):
-            for row in range(nb):
-                for col in range(S):
-                    d = int(weights[p, row, col])
-                    if d > 0:
-                        mw[p, row, col], sw[p, row, col], \
-                            aw[p, row, col] = _magicu64(d)
-        self._wmagic = (mw, sw, aw)
+        # Split on the compile-cache contract: `_flatten_static` is
+        # everything the compiled program bakes in (topology shapes,
+        # algs, tree structure); `_set_weight_tables` is everything a
+        # reweight/balancer round can change — those tables are
+        # RUNTIME ARGUMENTS of the jitted function, so two maps with
+        # equal static tables share one executable.
+        self._install_static(self._flatten_static(cmap))
+        self._set_weight_tables(cmap)
         # descent depths + per-step size bounds: at BFS step t from
         # the possible roots only a statically-known set of buckets
         # can be under the cursor, so each straw2 scans that step's
@@ -544,7 +457,338 @@ class BatchMapper:
             self.step_sizes2 = []
             self.d2 = 0
 
-        self._fn = jax.jit(self._build())
+        self._fn, self.cache_hit = self._compile()
+
+    # -- static/dynamic table split ---------------------------------------
+
+    def _flatten_static(self, cmap: CrushMap) -> dict:
+        """Shape/topology tables — the compiled program's constants.
+
+        supported algs: straw2 (the modern default), plus the legacy
+        algs uniform/straw/list/tree, all vectorized.  uniform's
+        permutation cache LOOKS call-order-stateful (the r=0 fast
+        path), but the first Fisher-Yates step produces exactly the
+        fast path's transposition, so bucket_perm_choose is a pure
+        function of (bucket, x, r) — verified against the oracle
+        over shuffled query orders (tests/test_crush_jax.py) — and
+        the batched path recomputes the unfold per element."""
+        nb = len(cmap.buckets)
+        S = 1
+        for b in cmap.buckets:
+            if b is None:
+                continue
+            if b.alg not in ("straw2", "uniform", "straw", "list",
+                             "tree"):
+                raise NotImplementedError(
+                    f"bucket alg {b.alg}: use the scalar oracle")
+            if b.size == 0:
+                raise ValueError("empty bucket in map")
+            S = max(S, b.size)
+        # choose_args (balancer weight-set): per-POSITION weight
+        # overrides and id substitution (reference CrushWrapper
+        # choose_args / bucket_straw2_choose's position argument).
+        # The position COUNT is a table shape, hence static.
+        P = 1
+        for arg in cmap.choose_args.values():
+            if arg.get("weight_set"):
+                P = max(P, len(arg["weight_set"]))
+        items = np.zeros((nb, S), dtype=np.int32)
+        sizes = np.zeros(nb, dtype=np.int32)
+        btype = np.zeros(nb, dtype=np.int32)
+        alg_num = {"straw2": 0, "straw": 1, "list": 2, "tree": 3,
+                   "uniform": 4}
+        acode = np.zeros(nb, dtype=np.int32)
+        bids = np.zeros(nb, dtype=np.int32)
+        from .mapper import _tree_node_weights
+        trees = {row: _tree_node_weights(b)[1]
+                 for row, b in enumerate(cmap.buckets)
+                 if b is not None and b.alg == "tree"}
+        # tree node COUNT is a function of bucket size alone — the
+        # node VALUES (weights) live in the runtime tables
+        NT = max(trees.values(), default=2)
+        troot = np.ones(nb, dtype=np.int32)
+        tdepth = 0
+        for row, b in enumerate(cmap.buckets):
+            if b is None:
+                continue
+            items[row, :b.size] = b.items
+            sizes[row] = b.size
+            btype[row] = b.type
+            acode[row] = alg_num[b.alg]
+            bids[row] = b.id
+            if b.alg == "tree":
+                num = trees[row]
+                troot[row] = num >> 1
+                d = 0
+                n = num >> 1
+                while n and (n & 1) == 0:
+                    d += 1
+                    n >>= 1
+                tdepth = max(tdepth, d)
+        return {
+            "nb": nb, "S": S, "P": P, "NT": NT,
+            "items": items, "sizes": sizes, "btype": btype,
+            "acode": acode, "bids": bids, "troot": troot,
+            "tdepth": tdepth,
+            "uniform_smax": max(
+                (b.size for b in cmap.buckets
+                 if b is not None and b.alg == "uniform"), default=0),
+            "algs": sorted({b.alg for b in cmap.buckets
+                            if b is not None}),
+            "bucket_by_id": {b.id: b for b in cmap.buckets
+                             if b is not None},
+        }
+
+    def _install_static(self, st: dict) -> None:
+        self._items = st["items"]
+        self._sizes, self._btype = st["sizes"], st["btype"]
+        self._nb, self._S, self._P = st["nb"], st["S"], st["P"]
+        self._NT = st["NT"]
+        self._bucket_by_id = st["bucket_by_id"]
+        self._uniform_smax = st["uniform_smax"]
+        self._algs = st["algs"]
+        self._acode, self._bids = st["acode"], st["bids"]
+        self._troot, self._tdepth = st["troot"], st["tdepth"]
+
+    def _set_weight_tables(self, cmap: CrushMap) -> None:
+        """Weight-derived tables — runtime ARGUMENTS of the compiled
+        program: the [P, nb, S] weight sets with their straw2 magic
+        triples, choose_args hash-id substitutions, and the legacy-alg
+        derivations (straw scalers, list prefix sums, tree node
+        weights — the reference's crush_calc_straw /
+        crush_make_tree_bucket).  Rebuilding these is the WHOLE cost
+        of `set_weights`: no retrace, no XLA compile."""
+        nb, S, P = self._nb, self._S, self._P
+        hash_ids = np.zeros((nb, S), dtype=np.int32)
+        weights = np.zeros((P, nb, S), dtype=np.int64)
+        strawsc = np.zeros((nb, S), dtype=np.int64)
+        lsums = np.zeros((nb, S), dtype=np.int64)
+        tnodes = np.zeros((nb, self._NT), dtype=np.int64)
+        from .mapper import _tree_node_weights, calc_straw_scalers
+        for row, b in enumerate(cmap.buckets):
+            if b is None:
+                continue
+            hash_ids[row, :b.size] = b.items
+            arg = cmap.choose_args.get(b.id) or {}
+            # choose_args act on straw2 buckets only (the oracle's
+            # bucket_straw2_choose is the sole reader) — a weight-set
+            # attached to a legacy bucket must not displace the plain
+            # weights the legacy formulas read
+            ws = (arg.get("weight_set")
+                  if b.alg == "straw2" else None)
+            if arg.get("ids") and b.alg == "straw2":
+                hash_ids[row, :b.size] = arg["ids"]
+            for p in range(P):
+                if ws:
+                    weights[p, row, :b.size] = ws[min(p, len(ws) - 1)]
+                elif len(b.weights) == b.size:
+                    weights[p, row, :b.size] = b.weights
+                else:
+                    # uniform buckets may carry only item_weight; the
+                    # per-item weights only feed straw2 draws (masked
+                    # out for uniform rows) and the summary APIs
+                    weights[p, row, :b.size] = b.item_weight
+            if b.alg == "straw":
+                strawsc[row, :b.size] = calc_straw_scalers(b.weights)
+            elif b.alg == "list":
+                lsums[row, :b.size] = np.cumsum(b.weights)
+            elif b.alg == "tree":
+                nodes, num = _tree_node_weights(b)
+                tnodes[row, :num] = nodes
+        # division-free straw2: magic constants per DISTINCT weight
+        # (TPU has no native u64 divide)
+        mw = np.zeros((P, nb, S), dtype=np.uint64)
+        sw = np.zeros((P, nb, S), dtype=np.int32)
+        aw = np.zeros((P, nb, S), dtype=np.int32)
+        for d in np.unique(weights):
+            if d <= 0:
+                continue
+            msk = weights == d
+            mw[msk], sw[msk], aw[msk] = _magicu64(int(d))
+        self._weights, self._hash_ids = weights, hash_ids
+        self._wmagic = (mw, sw, aw)
+        self._strawsc, self._lsums = strawsc, lsums
+        self._tnodes = tnodes
+        self._wtab_dev = None   # device copies re-upload lazily
+
+    def set_weights(self, cmap: CrushMap,
+                    _check_rule: bool = True) -> "BatchMapper":
+        """Rebind to `cmap`'s weights WITHOUT recompiling.
+
+        Everything shape-like must be unchanged: topology (bucket ids,
+        items, sizes, types, algs), rule steps, tunables, max_devices.
+        Raises ValueError when the change is not weight-only — callers
+        (e.g. ``OSDMap.batch_mapper``) catch that and build a fresh
+        mapper.  On success: zero retraces, zero XLA compiles — only
+        the host-side weight tables are rebuilt."""
+        if _check_rule:
+            try:
+                rule = cmap.rule_by_id(self.rule.id)
+            except Exception as e:
+                raise ValueError(
+                    f"rule {self.rule.id} missing from new map") from e
+            if ([(s.op, s.arg1, s.arg2) for s in rule.steps]
+                    != [(s.op, s.arg1, s.arg2)
+                        for s in self.rule.steps]):
+                raise ValueError("rule changed: rebuild the mapper")
+        if cmap.tunables != self.cmap.tunables:
+            raise ValueError("tunables changed: rebuild the mapper")
+        if max(cmap.max_devices, 1) != max(self.cmap.max_devices, 1):
+            raise ValueError("max_devices changed: rebuild the mapper")
+        if self._subs is not None:
+            # sub-mappers carry synthetic per-block rules derived from
+            # the (just verified) original — skip their rule lookup
+            for sub in self._subs:
+                sub.set_weights(cmap, _check_rule=False)
+            self.cmap = cmap
+            return self
+        st = self._flatten_static(cmap)
+        same = (st["nb"] == self._nb and st["S"] == self._S
+                and st["P"] == self._P and st["NT"] == self._NT
+                and st["tdepth"] == self._tdepth
+                and st["uniform_smax"] == self._uniform_smax
+                and st["algs"] == self._algs
+                and np.array_equal(st["items"], self._items)
+                and np.array_equal(st["sizes"], self._sizes)
+                and np.array_equal(st["btype"], self._btype)
+                and np.array_equal(st["acode"], self._acode)
+                and np.array_equal(st["troot"], self._troot))
+        if not same:
+            raise ValueError("topology changed: rebuild the mapper")
+        self.cmap = cmap
+        self._bucket_by_id = st["bucket_by_id"]
+        self._set_weight_tables(cmap)
+        return self
+
+    def remap(self, new_weights) -> "BatchMapper":
+        """Weight-only rebind reusing the compiled executable.
+
+        `new_weights` is either a full CrushMap (must match this
+        mapper's topology shape — see `set_weights`) or a
+        ``{bucket_id: [per-item 16.16 weights]}`` dict patched onto
+        the current map.  A dict patch changes ONLY the named buckets:
+        CRUSH surfaces a child's total weight as the parent's item
+        weight, so callers mirroring ``ceph osd crush reweight``
+        should patch the ancestor buckets too (or pass the full
+        recomputed CrushMap)."""
+        if isinstance(new_weights, CrushMap):
+            return self.set_weights(new_weights)
+        by_id = dict(new_weights)
+        buckets = []
+        for b in self.cmap.buckets:
+            if b is not None and b.id in by_id:
+                ws = [int(w) for w in by_id.pop(b.id)]
+                if len(ws) != b.size:
+                    raise ValueError(
+                        f"bucket {b.id}: {len(ws)} weights != "
+                        f"size {b.size}")
+                b = dataclasses.replace(
+                    b, weights=ws,
+                    item_weight=(ws[0] if b.alg == "uniform"
+                                 else b.item_weight))
+            buckets.append(b)
+        if by_id:
+            raise ValueError(f"unknown bucket ids {sorted(by_id)}")
+        return self.set_weights(
+            dataclasses.replace(self.cmap, buckets=buckets))
+
+    # -- compile / warm start ---------------------------------------------
+
+    def _wtab(self):
+        """Device copies of the runtime weight tables (lazy: a
+        set_weights drops them, the next call re-uploads once)."""
+        if self._wtab_dev is None:
+            import jax.numpy as jnp
+            mw, sw, aw = self._wmagic
+            self._wtab_dev = tuple(
+                jnp.asarray(a) for a in (
+                    self._weights, mw, sw, aw, self._hash_ids,
+                    self._strawsc, self._lsums, self._tnodes))
+        return self._wtab_dev
+
+    def _arg_specs(self):
+        import jax
+        import jax.numpy as jnp
+        sds = jax.ShapeDtypeStruct
+        W = max(self.cmap.max_devices, 1)
+        nb, S, P, NT = self._nb, self._S, self._P, self._NT
+        wtab = (sds((P, nb, S), jnp.int64),
+                sds((P, nb, S), jnp.uint64),
+                sds((P, nb, S), jnp.int32),
+                sds((P, nb, S), jnp.int32),
+                sds((nb, S), jnp.int32),
+                sds((nb, S), jnp.int64),
+                sds((nb, S), jnp.int64),
+                sds((nb, NT), jnp.int64))
+        return (sds((self.chunk,), jnp.uint32),
+                sds((W,), jnp.uint32),
+                sds((0x10000,), jnp.int64),
+                wtab)
+
+    def _cache_key(self) -> dict:
+        """The persistent-cache key: everything the compiled program
+        depends on EXCEPT weight values — jax version, backend,
+        shapes, topology arrays, rule steps, tunables.  Weight-only
+        map changes therefore hash to the same entry."""
+        import jax
+
+        def h(a):
+            return hashlib.sha256(
+                np.ascontiguousarray(a).tobytes()).hexdigest()[:16]
+
+        return {
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "ln_mode": self._ln_mode,
+            "chunk": self.chunk,
+            "numrep": self.numrep,
+            "result_max": self.result_max,
+            "max_devices": int(max(self.cmap.max_devices, 1)),
+            "rule": [(s.op, s.arg1, s.arg2) for s in self.rule.steps],
+            "tunables": dataclasses.asdict(self.cmap.tunables),
+            "shape": {"nb": self._nb, "S": self._S, "P": self._P,
+                      "NT": self._NT, "tdepth": self._tdepth,
+                      "uniform_smax": self._uniform_smax,
+                      "algs": self._algs},
+            "topo": {n: h(getattr(self, "_" + n))
+                     for n in ("items", "sizes", "btype", "acode",
+                               "bids", "troot")},
+            "steps1": self.step_sizes1,
+            "steps2": self.step_sizes2,
+        }
+
+    def _compile(self):
+        """Build or warm-start the jitted mapper → (fn, cache_hit).
+
+        Warm start: the serialized `jax.export` module is deserialized
+        from the on-disk cache — no tracing at all; XLA still compiles
+        the module once per process (free on TPU when
+        `utils.enable_compile_cache` has the persistent XLA cache on).
+        Cold: trace once, export, persist; fall back to plain `jit`
+        if this program can't export on this jax."""
+        import jax
+        global TRACE_COUNT
+        from ..native.aot import CompileCache
+        cache = CompileCache.default()
+        if cache is not None:
+            exported = cache.load_exported("crush", self._cache_key())
+            if exported is not None:
+                return jax.jit(exported.call), True
+        run = self._build()
+        TRACE_COUNT += 1
+        if cache is not None:
+            try:
+                from jax import export as jexport
+                exported = jexport.export(jax.jit(run))(
+                    *self._arg_specs())
+                cache.store_exported("crush", self._cache_key(),
+                                     exported)
+                # execute through the exported module so cold and warm
+                # processes feed XLA the identical program
+                return jax.jit(exported.call), False
+            except Exception:
+                pass  # non-exportable on this jax — plain jit works
+        return jax.jit(run), False
 
     def _bfs_step_sizes(self, start_items: list[int],
                         target_type: int) -> list[tuple[int, bool]]:
@@ -576,32 +820,28 @@ class BatchMapper:
         import jax.numpy as jnp
 
         items = jnp.asarray(self._items)
-        hash_ids = jnp.asarray(self._hash_ids)
-        weights = jnp.asarray(self._weights)        # [P, nb, S]
         sizes = jnp.asarray(self._sizes)
         btype = jnp.asarray(self._btype)
-        wm_m = jnp.asarray(self._wmagic[0])
-        wm_s = jnp.asarray(self._wmagic[1])
-        wm_a = jnp.asarray(self._wmagic[2])
         nb, S, P = self._nb, self._S, self._P
 
         def item_type(itm):
             rows = jnp.clip(-1 - itm, 0, nb - 1)
             return jnp.where(itm < 0, btype[rows], 0)
 
-        any_add = bool(self._wmagic[2].any())
         legacy_algs = [a for a in self._algs if a != "straw2"]
         acode = jnp.asarray(self._acode)
         bids = jnp.asarray(self._bids)
-        strawsc = jnp.asarray(self._strawsc)
-        lsums = jnp.asarray(self._lsums)
-        tnodes = jnp.asarray(self._tnodes)
         troot = jnp.asarray(self._troot)
         tdepth = self._tdepth
         # the 64Ki ln table rides in as an argument (set per call by
         # `run`); a box, not a closure constant, so the HLO carries a
         # parameter instead of a megabyte literal
         ln16_box = [None]
+        # the weight tables (weights, straw2 magics, hash ids, straw
+        # scalers, list sums, tree nodes) ride in the same way — they
+        # are the ONLY value-dependence on bucket weights, which is
+        # what lets set_weights/remap reuse the executable
+        wt: dict = {}
 
         def _legacy_choose(rows, x, r, its, s_, u16):
             """Batched legacy algs (reference bucket_straw_choose /
@@ -614,7 +854,7 @@ class BatchMapper:
             barange = jnp.arange(rows.shape[0])
             outs = {}
             if "straw" in legacy_algs:
-                draws = u16.astype(jnp.int64) * strawsc[:, :s_][rows]
+                draws = u16.astype(jnp.int64) * wt["strawsc"][:, :s_][rows]
                 sel = jnp.argmax(draws, axis=1)
                 outs[1] = its[barange, sel]
             if "uniform" in legacy_algs:
@@ -661,10 +901,10 @@ class BatchMapper:
                     x[:, None], its.astype(jnp.uint32),
                     r[:, None].astype(jnp.uint32),
                     bids[rows][:, None].astype(jnp.uint32))
-                sums = lsums[:, :s_][rows]
+                sums = wt["lsums"][:, :s_][rows]
                 w = ((h4 & np.uint32(0xFFFF)).astype(jnp.int64)
                      * sums) >> np.int64(16)
-                hit = (sums != 0) & (w < weights[0, :, :s_][rows])
+                hit = (sums != 0) & (w < wt["w"][0, :, :s_][rows])
                 rev = hit[:, ::-1]
                 j = jnp.argmax(rev, axis=1)
                 idx = jnp.where(hit.any(axis=1),
@@ -673,7 +913,7 @@ class BatchMapper:
                 outs[2] = its[barange, idx]
             if "tree" in legacy_algs:
                 n = troot[rows]
-                nod = tnodes[rows]                       # [B, NT]
+                nod = wt["tnodes"][rows]                 # [B, NT]
                 for _ in range(tdepth):
                     even = (n & 1) == 0
                     wn = jnp.take_along_axis(
@@ -713,23 +953,26 @@ class BatchMapper:
                 # a size-1 straw2 always selects its only item (the
                 # reference's first loop iteration seeds the max)
                 return its[:, 0]
-            hids = hash_ids[:, :s_][rows]
+            hids = wt["hids"][:, :s_][rows]
             if P == 1:
                 # no choose_args positions: index the only weight set
                 # statically instead of a clip+2-axis gather per row
-                ws = weights[0, :, :s_][rows]
-                wm = (wm_m[0, :, :s_][rows], wm_s[0, :, :s_][rows],
-                      wm_a[0, :, :s_][rows])
+                ws = wt["w"][0, :, :s_][rows]
+                wm = (wt["wm_m"][0, :, :s_][rows],
+                      wt["wm_s"][0, :, :s_][rows],
+                      wt["wm_a"][0, :, :s_][rows])
             else:
                 p = jnp.clip(pos, 0, P - 1)
-                ws = weights[:, :, :s_][p, rows]
-                wm = (wm_m[:, :, :s_][p, rows],
-                      wm_s[:, :, :s_][p, rows],
-                      wm_a[:, :, :s_][p, rows])
+                ws = wt["w"][:, :, :s_][p, rows]
+                wm = (wt["wm_m"][:, :, :s_][p, rows],
+                      wt["wm_s"][:, :, :s_][p, rows],
+                      wt["wm_a"][:, :, :s_][p, rows])
             u = crush_hash32_3(x[:, None], hids.astype(jnp.uint32),
                                r[:, None].astype(jnp.uint32))
             u = (u & np.uint32(0xFFFF))
-            draws = _straw2_draws(u, ws, wm, any_add=any_add,
+            # any_add stays on: the weight table is a runtime argument,
+            # so trace time can't prove the add-case magics away
+            draws = _straw2_draws(u, ws, wm, any_add=True,
                                   ln16=ln16_box[0])
             if not uniform:
                 col = jnp.arange(s_, dtype=jnp.int32)
@@ -751,7 +994,7 @@ class BatchMapper:
             indep paths recompute r PER LEVEL (reference
             crush_choose_indep: r = rep + parent_r + numrep*ftotal,
             except (numrep+1)*ftotal while inside a uniform bucket
-            whose size divides numrep) — pass the base r and the
+            whose size is divisible by numrep) — pass the base r and the
             ftotal vector via `indep_ft` and the adjustment happens
             against each level's current bucket."""
             itm = start
@@ -1203,12 +1446,13 @@ class BatchMapper:
             # the same work) — not worth its compile cost
             fn = indep_fn
 
-        def run(x, wdev, ln16):
+        def run(x, wdev, ln16, wtab):
             # mode chosen at build: "onehot" computes the numerator on
             # device (TPU: gathers are the pathology); "table" uses
             # the passed-in 64Ki gather table (CPU: gathers are fine)
             ln16_box[0] = ("onehot" if self._ln_mode == "onehot"
                            else ln16)
+            wt.update(zip(_WTAB_FIELDS, wtab))
             res = fn(x, wdev)
             if res.shape[1] < self.result_max:
                 pad = jnp.full((x.shape[0], self.result_max - res.shape[1]),
@@ -1284,6 +1528,7 @@ class BatchMapper:
             prior += sub.result_max
         self._subs = subs
         self.firstn = True
+        self.cache_hit = all(sub.cache_hit for sub in subs)
         self.result_max = prior if result_max is None \
             else result_max
 
@@ -1318,12 +1563,21 @@ class BatchMapper:
         xs = np.asarray(xs, dtype=np.uint32)
         if self._subs is not None:
             return self._call_multi(xs, reweight)
+        W = max(self.cmap.max_devices, 1)
         if reweight is None:
-            reweight = np.full(max(self.cmap.max_devices, 1), 0x10000,
-                               dtype=np.uint32)
+            reweight = np.full(W, 0x10000, dtype=np.uint32)
         else:
+            # normalize to the compiled [W] spec: the oracle's is_out
+            # treats a device past the end of the vector as weight 0
+            # (out), so shorter vectors zero-pad; entries past
+            # max_devices can never be drawn, so longer vectors trim
             reweight = np.asarray(reweight, dtype=np.uint32)
+            if len(reweight) < W:
+                reweight = np.pad(reweight, (0, W - len(reweight)))
+            elif len(reweight) > W:
+                reweight = reweight[:W]
         wdev = jnp.asarray(reweight)
+        wtab = self._wtab()
         ln16 = jnp.asarray(_ln16_s_tbl())
         # dispatch every chunk before fetching any result: jax's async
         # dispatch overlaps the per-call relay/device latency (~60 ms
@@ -1340,6 +1594,7 @@ class BatchMapper:
                 # TPU backend some batch shapes also trip an XLA
                 # scoped-vmem bug in reduce-window lowering)
                 part = np.pad(part, (0, self.chunk - n))
-            pend.append((self._fn(jnp.asarray(part), wdev, ln16), n))
+            pend.append((self._fn(jnp.asarray(part), wdev, ln16,
+                                  wtab), n))
         return np.concatenate(
             [np.asarray(res)[:n] for res, n in pend], axis=0)

@@ -45,6 +45,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.jaxcompat import enable_x64
+
 # lane width is 128 on all TPU generations; tiles are multiples of it
 _LANES = 128
 _MAX_TN = 4096          # per-tile lane extent (VMEM budget ~1 MB/tile)
@@ -146,7 +148,7 @@ def gf_matmul_pallas(bitmat: jnp.ndarray, data: jnp.ndarray, m: int,
             bdmats[g] = bdmat
     # trace in 32-bit mode: under jax_enable_x64 (required by CRUSH)
     # the grid/index arithmetic becomes i64, which Mosaic rejects
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = _gf_apply_pallas(bdmat, x, k=k, m=m, g=g,
                                interpret=interpret)
     out = out[:b, :, :n]

@@ -52,6 +52,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.jaxcompat import enable_x64, tpu_compiler_params
+
 _LANES = 128
 _WORD = 4                      # bytes per i32 lane
 # lanes per tile (i32 words); 2048 words = 8 KiB rows; VMEM per tile at
@@ -151,7 +153,7 @@ def gf_matmul_pallas2(bitmat: jnp.ndarray, data: jnp.ndarray, m: int,
         bdmat = jnp.asarray(block_diag4(np.asarray(bitmat)))
         if bdmats is not None:
             bdmats["v2"] = bdmat
-    with jax.enable_x64(False):
+    with enable_x64(False):
         words = jax.lax.bitcast_convert_type(
             x.reshape(bsz, k, nw, _WORD), jnp.int32)
         out = _gf_apply_pallas2(bdmat, words, k=k, m=m,
@@ -226,7 +228,7 @@ def _gf_apply_words(bdmat, mrow, words, *, k: int, m: int,
         ],
         out_specs=pl.BlockSpec((1, m, tnw), lambda i, j: (i, 0, j),
                                memory_space=pltpu.VMEM),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(bdmat, mrow, words)
@@ -269,7 +271,7 @@ def gf_matmul_words(bitmat: jnp.ndarray, words: jnp.ndarray, m: int,
         x = jnp.pad(x, ((0, 0), (0, 0), (0, npad)))
     nwp = nw + npad
     bdmat, mrow = _word_operands(bitmat, k, bdmats)
-    with jax.enable_x64(False):
+    with enable_x64(False):
         b = x.shape[0]
         if nwp <= 2048 and b > 1 and b * nwp >= 2048:
             # small-stripe fold: at <=64 KiB stripes the grid
@@ -305,7 +307,7 @@ def gf_expand_words(data: jnp.ndarray) -> jnp.ndarray:
     in the v2 word-sliced layout."""
     *lead, k, n = data.shape
     nw = n // _WORD
-    with jax.enable_x64(False):
+    with enable_x64(False):
         words = jax.lax.bitcast_convert_type(
             data.reshape(*lead, k, nw, _WORD), jnp.int32)
         planes = []
@@ -370,7 +372,7 @@ def gf_matmul_planes(bitmat: jnp.ndarray, planes: jnp.ndarray, m: int,
         bdmat = jnp.asarray(block_diag4(np.asarray(bitmat)))
         if bdmats is not None:
             bdmats["v2"] = bdmat
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = _gf_apply_planes(bdmat, x, m=m, interpret=interpret)
         outb = jax.lax.bitcast_convert_type(out, jnp.uint8)
     return outb.reshape(*lead, m, nw * _WORD)

@@ -197,6 +197,37 @@ class OSDMap:
         # daemon addresses, "host:port" — the Objecter's routing table
         # (reference OSDMap::get_addrs)
         self.osd_addrs: dict[int, str] = {}
+        # (rule_id, result_max) → BatchMapper, reused across epochs:
+        # a weight-only CRUSH change rebinds via set_weights (zero
+        # recompiles), everything else falls back to a fresh build
+        self._mappers: dict = {}
+
+    def batch_mapper(self, rule_id: int, result_max: int,
+                     **kwargs):
+        """Cached `crush.jax_mapper.BatchMapper` for (rule, size).
+
+        The reweight fast path of the mapping spine: balancer rounds
+        and repeated osdmaptool sweeps hit the same compiled
+        executable; after `apply_incremental` swaps in a weight-only
+        `new_crush`, the mapper rebinds through
+        `BatchMapper.set_weights` instead of recompiling.  Topology /
+        rule / tunables changes rebuild (and the compiled program may
+        still warm-start from the on-disk export cache)."""
+        from ..crush.jax_mapper import BatchMapper
+        key = (rule_id, result_max, tuple(sorted(kwargs.items())))
+        bm = self._mappers.get(key)
+        if bm is not None:
+            if bm.cmap is not self.crush:
+                try:
+                    bm.set_weights(self.crush)
+                except (ValueError, NotImplementedError):
+                    bm = None
+            if bm is not None:
+                return bm
+        bm = BatchMapper(self.crush, rule_id, result_max=result_max,
+                         **kwargs)
+        self._mappers[key] = bm
+        return bm
 
     # -- construction ------------------------------------------------------
     @classmethod

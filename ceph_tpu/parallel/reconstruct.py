@@ -28,9 +28,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..ops import rs
+from ..utils.jaxcompat import enable_x64, shard_map
 from ..ops.gf_jax import _bit_layout_matrix, gf_matmul_bits
 from ..ops.gf_pallas2 import (_BIT_MASK, _gf_apply_words, block_diag4,
                               _word_operands)
@@ -96,6 +96,11 @@ class ShardedEC:
                 [_BIT_MASK[r // klocal] for r in range(32 * klocal)],
                 dtype=np.int32).reshape(32 * klocal, 1))
 
+        # Mosaic lowering of the fused word kernel requires a real TPU;
+        # off-TPU (CPU equivalence tests, dev boxes) run it in Pallas
+        # interpret mode instead of failing at lowering.
+        interpret = jax.default_backend() != "tpu"
+
         def local_fn(data):  # data: [Bl, klocal, C] (or Cw words)
             idx = jax.lax.axis_index("shard")
             if self.word_native:
@@ -103,7 +108,8 @@ class ShardedEC:
                     bd4, idx * klocal, klocal, axis=2).reshape(
                         32 * m, 32 * klocal)
                 partial = _gf_apply_words(cols, mrow_l, data,
-                                          k=klocal, m=m)
+                                          k=klocal, m=m,
+                                          interpret=interpret)
             else:
                 cols3 = jax.lax.dynamic_slice_in_dim(
                     bm3, idx * klocal, klocal, axis=2)
@@ -124,7 +130,7 @@ class ShardedEC:
             # an embedding process with x64 on (the CRUSH mapper needs
             # it) otherwise widens internals — which also trips the
             # axon remote-compile helper on the word-native program.
-            with jax.enable_x64(False):
+            with enable_x64(False):
                 return shard_map(
                     local_fn, mesh=mesh,
                     in_specs=P("dp", "shard", None),
@@ -181,6 +187,7 @@ class ShardedEC:
         if self.word_native:
             wcache: dict = {}
             wbd, wmrow = _word_operands(dmbits_np, k, wcache)
+        interpret = jax.default_backend() != "tpu"  # see _build_encode
 
         def local_fn(chunks):  # [Bl, nlocal, C] — this device's chunk rows
             # gather every device's chunk rows over ICI (the sub-read fan-in)
@@ -193,7 +200,8 @@ class ShardedEC:
             if self.word_native:
                 # fused Pallas word kernel (the production decode path)
                 data = _gf_apply_words(wbd, wmrow, surv,
-                                       k=k, m=dm.shape[0])
+                                       k=k, m=dm.shape[0],
+                                       interpret=interpret)
             else:
                 # MXU bitmatrix decode (byte-exact vs the oracle)
                 data = gf_matmul_bits(dmbits, surv, dm.shape[0])
@@ -202,7 +210,7 @@ class ShardedEC:
         def fn(chunks):  # [B, n_pad, C] sharded P('dp','shard',None)
             # replicated over 'shard' by construction (decode after
             # gather); x64=False at trace time — see _build_encode
-            with jax.enable_x64(False):
+            with enable_x64(False):
                 return shard_map(
                     local_fn, mesh=mesh,
                     in_specs=P("dp", "shard", None),

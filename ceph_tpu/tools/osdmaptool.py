@@ -125,8 +125,10 @@ def map_pool_pgs(m: OSDMap, pool: PGPool,
     rule = m.crush.rule_by_id(pool.crush_rule)
     if use_jax:
         try:
-            from ..crush.jax_mapper import BatchMapper
-            bm = BatchMapper(m.crush, rule, result_max=pool.size)
+            # the OSDMap-level cache: repeated sweeps (balancer
+            # rounds, --test-map-pgs after a reweight) reuse the
+            # compiled executable via BatchMapper.set_weights
+            bm = m.batch_mapper(rule.id, pool.size)
             out = bm(pps, np.asarray(m.osd_weight, dtype=np.uint32))
             if engines is not None:
                 engines.append("tpu-batched")

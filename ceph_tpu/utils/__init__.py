@@ -1,4 +1,4 @@
 """Runtime substrate (reference: ``src/common/``; SURVEY.md §3.1)."""
 
-from .platform import (enable_compile_cache, ensure_x64,  # noqa: F401
-                       honor_jax_platforms_env)
+from .platform import (cache_root, enable_compile_cache,  # noqa: F401
+                       ensure_x64, honor_jax_platforms_env)

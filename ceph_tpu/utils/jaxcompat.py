@@ -1,0 +1,49 @@
+"""Version-tolerant aliases for JAX APIs that moved between releases.
+
+The repo targets the jax that ships in the image (0.4.x line) but is
+written against the current public names where possible.  Three APIs
+moved in ways that break one direction or the other:
+
+- ``jax.shard_map`` (new) vs ``jax.experimental.shard_map.shard_map``
+  (old), with the replication-check kwarg renamed ``check_vma`` ←
+  ``check_rep``;
+- ``jax.enable_x64`` context manager (new) vs
+  ``jax.experimental.enable_x64`` (old);
+- ``pltpu.CompilerParams`` (new) vs ``pltpu.TPUCompilerParams`` (old).
+
+Import the names from here instead of guessing; each alias presents the
+*new* signature and translates as needed.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "enable_x64", "tpu_compiler_params"]
+
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+
+else:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
+
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:
+    from jax.experimental import enable_x64  # noqa: F401
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` across the rename."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -29,6 +29,15 @@ def ensure_x64() -> None:
         jax.config.update("jax_enable_x64", True)
 
 
+def cache_root() -> str:
+    """Base directory for every on-disk ceph_tpu cache — the
+    `jax.export` program cache (`native.aot.CompileCache`, subdir
+    ``export/``) and XLA's persistent compilation cache (subdir
+    ``xla/``): ``$CEPH_TPU_CACHE_DIR``, default ``~/.cache/ceph_tpu``."""
+    return os.environ.get("CEPH_TPU_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "ceph_tpu")
+
+
 def enable_compile_cache(path: str | None = None) -> str | None:
     """Point XLA's persistent compilation cache at a ceph_tpu cache
     dir so repeated CLI invocations (the reference's osdmaptool /
@@ -44,9 +53,7 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     if jax.default_backend() != "tpu":
         return None
     path = path or os.environ.get(
-        "CEPH_TPU_XLA_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "ceph_tpu",
-                     "xla"))
+        "CEPH_TPU_XLA_CACHE", os.path.join(cache_root(), "xla"))
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)

@@ -13,10 +13,17 @@ whole suite).
 """
 
 import os
+import tempfile
 
 # x64 is required by the CRUSH straw2 draw math (64-bit fixed point);
 # the EC paths use explicit uint8/int32 dtypes and are unaffected.
 os.environ["JAX_ENABLE_X64"] = "1"
+# hermetic compile cache: keep the suite's jax.export programs out of
+# ~/.cache/ceph_tpu (tests still exercise the cache machinery — and
+# repeated same-topology mappers warm-start within the run)
+os.environ.setdefault(
+    "CEPH_TPU_CACHE_DIR",
+    tempfile.mkdtemp(prefix="ceph_tpu_test_cache_"))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,170 @@
+"""Persistent compile cache + runtime-weight reweight fast path.
+
+The contract under test (jax_mapper + native.aot.CompileCache):
+
+- weights are runtime arguments, so a reweight/`remap()` reuses the
+  already-compiled executable — zero new traces, zero new XLA
+  compilations;
+- a fresh mapper on the same topology *shape* warm-starts from the
+  serialized ``jax.export`` program on disk (no tracing at all);
+- a topology change is a cache miss and `set_weights` refuses it;
+- a corrupt cache entry degrades to a fresh compile, never an error.
+
+Tiny 2-host topology so the whole file runs on CPU in seconds.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import BatchMapper, build_hierarchy, do_rule
+from ceph_tpu.crush import jax_mapper as jm
+from ceph_tpu.crush.map import CRUSH_ITEM_NONE
+from ceph_tpu.native.aot import CompileCache
+
+XS = np.arange(257, dtype=np.uint32)
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    """Hermetic per-test cache so hits/misses are this test's own."""
+    monkeypatch.setenv("CEPH_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("CEPH_TPU_EXPORT_CACHE", raising=False)
+    return tmp_path
+
+
+def _oracle(m, xs, result_max=2):
+    out = np.full((len(xs), result_max), CRUSH_ITEM_NONE, dtype=np.int32)
+    for j, x in enumerate(xs):
+        r = do_rule(m, 0, int(x), result_max)
+        out[j, :len(r)] = r
+    return out
+
+
+def _tiny():
+    return build_hierarchy(1, 2, 2)   # root -> 2 hosts x 2 osds
+
+
+def _skew(host):
+    """A NON-uniform reweight of one host's items: straw2 is scale
+    invariant, so a uniform scaling would not move any placement."""
+    return [w >> (2 * (i & 1)) for i, w in enumerate(host.weights)]
+
+
+def test_cold_build_then_warm_start(cache_dir):
+    t0 = jm.TRACE_COUNT
+    bm = BatchMapper(_tiny(), 0, result_max=2, chunk=256)
+    assert bm.cache_hit is False
+    assert jm.TRACE_COUNT == t0 + 1
+    got = bm(XS)
+    np.testing.assert_array_equal(got, _oracle(_tiny(), XS))
+
+    # the serialized program landed on disk with its key sidecar
+    entries = list((cache_dir / "export" / "crush").glob("*.jaxpb"))
+    assert len(entries) == 1
+    assert entries[0].with_suffix(".json").exists()
+
+    # fresh mapper, same topology shape: deserialized, never traced
+    t1 = jm.TRACE_COUNT
+    bm2 = BatchMapper(_tiny(), 0, result_max=2, chunk=256)
+    assert bm2.cache_hit is True
+    assert jm.TRACE_COUNT == t1
+    np.testing.assert_array_equal(bm2(XS), got)
+
+
+def test_reweight_reuses_executable(cache_dir):
+    cmap = _tiny()
+    bm = BatchMapper(cmap, 0, result_max=2, chunk=256)
+    before = bm(XS)
+    host0 = next(b for b in cmap.buckets if b is not None and b.type == 1)
+    skew = _skew(host0)
+
+    t0 = jm.TRACE_COUNT
+    n0 = bm._fn._cache_size()
+    bm.remap({host0.id: skew})
+    after = bm(XS)
+    # the whole point: a weight-only change compiles NOTHING new
+    assert jm.TRACE_COUNT == t0
+    assert bm._fn._cache_size() == n0 == 1
+    assert not np.array_equal(after, before), \
+        "skewed reweight moved no PGs — weights are not reaching the kernel"
+
+    # byte-exact vs the scalar oracle on the reweighted map...
+    m2 = _tiny()
+    h2 = next(b for b in m2.buckets if b is not None and b.id == host0.id)
+    h2.weights[:] = skew
+    np.testing.assert_array_equal(after, _oracle(m2, XS))
+    # ...and vs a freshly built mapper on that map
+    fresh = BatchMapper(m2, 0, result_max=2, chunk=256)
+    np.testing.assert_array_equal(after, fresh(XS))
+
+
+def test_set_weights_roundtrip(cache_dir):
+    cmap = _tiny()
+    bm = BatchMapper(cmap, 0, result_max=2, chunk=256)
+    before = bm(XS)
+    host0 = next(b for b in cmap.buckets if b is not None and b.type == 1)
+    bm.remap({host0.id: _skew(host0)})
+    bm.set_weights(_tiny())          # restore original weights
+    np.testing.assert_array_equal(bm(XS), before)
+
+
+def test_topology_change_misses_and_refuses(cache_dir):
+    bm = BatchMapper(_tiny(), 0, result_max=2, chunk=256)
+    assert bm.cache_hit is False
+    bigger = build_hierarchy(1, 2, 3)     # 3 osds/host: new shape
+    bm2 = BatchMapper(bigger, 0, result_max=2, chunk=256)
+    assert bm2.cache_hit is False         # distinct key, no false hit
+    np.testing.assert_array_equal(bm2(XS), _oracle(bigger, XS))
+    with pytest.raises(ValueError, match="rebuild the mapper"):
+        bm.set_weights(bigger)
+
+
+def test_corrupt_cache_entry_falls_back(cache_dir):
+    BatchMapper(_tiny(), 0, result_max=2, chunk=256)
+    [entry] = (cache_dir / "export" / "crush").glob("*.jaxpb")
+    entry.write_bytes(b"not a serialized jax.export program")
+
+    t0 = jm.TRACE_COUNT
+    bm = BatchMapper(_tiny(), 0, result_max=2, chunk=256)
+    assert bm.cache_hit is False          # garbage reported as a miss
+    assert jm.TRACE_COUNT == t0 + 1       # recompiled from scratch
+    np.testing.assert_array_equal(bm(XS), _oracle(_tiny(), XS))
+    # the poisoned entry was evicted and rewritten by the fresh build
+    [entry2] = (cache_dir / "export" / "crush").glob("*.jaxpb")
+    assert entry2.read_bytes() != b"not a serialized jax.export program"
+
+
+def test_cache_disabled_env(cache_dir, monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_EXPORT_CACHE", "0")
+    assert CompileCache.default() is None
+    bm = BatchMapper(_tiny(), 0, result_max=2, chunk=256)
+    assert bm.cache_hit is False
+    assert not (cache_dir / "export").exists()
+    np.testing.assert_array_equal(bm(XS), _oracle(_tiny(), XS))
+
+
+def test_osdmap_reweight_fast_path(cache_dir):
+    from ceph_tpu.osd.osdmap import OSDMap
+
+    om = OSDMap(crush=_tiny())
+    bm = om.batch_mapper(0, 2)
+    before = bm(XS)
+
+    # weight-only change: a new CrushMap object with the same shape
+    # retargets the SAME mapper through set_weights, no rebuild
+    om.crush = build_hierarchy(1, 2, 2)
+    host0 = next(b for b in om.crush.buckets
+                 if b is not None and b.type == 1)
+    host0.weights[:] = _skew(host0)
+    t0 = jm.TRACE_COUNT
+    bm2 = om.batch_mapper(0, 2)
+    assert bm2 is bm                      # reused, not rebuilt
+    assert jm.TRACE_COUNT == t0
+    assert not np.array_equal(bm2(XS), before)
+    np.testing.assert_array_equal(bm2(XS), _oracle(om.crush, XS))
+
+    # shape change: the cached mapper is dropped and rebuilt
+    om.crush = build_hierarchy(1, 2, 3)
+    bm3 = om.batch_mapper(0, 2)
+    assert bm3 is not bm
+    np.testing.assert_array_equal(bm3(XS), _oracle(om.crush, XS))
