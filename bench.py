@@ -574,6 +574,69 @@ def _reconstruct_leg(on_tpu: bool):
     return out
 
 
+def _scrub_leg(on_tpu: bool):
+    """Deep-scrub device kernels: batched CRC-32C digest throughput
+    and the EC parity recheck (re-encode stored stripes, compare
+    recomputed parity) — the two on-device stages of
+    ``ceph_tpu/scrub``.  Both verify byte-exactness before timing."""
+    import numpy as np
+    from ceph_tpu.ec.interface import ECProfile
+    from ceph_tpu.ec.jerasure import ErasureCodeJerasure
+    from ceph_tpu.scrub.crc32c_jax import crc32c, crc32c_batch
+    from ceph_tpu.scrub.engine import ScrubEngine
+
+    rng = np.random.default_rng(11)
+    out = {}
+
+    # -- digest: n same-length objects through the bit-matrix kernel
+    chunk = (1 << 18) if on_tpu else (1 << 14)
+    nobj = 128 if on_tpu else 16
+    reps = 8 if on_tpu else 2
+    data = rng.integers(0, 256, size=(nobj, chunk), dtype=np.uint8)
+    got = np.asarray(crc32c_batch(data))            # warm + verify
+    for i in (0, nobj // 2, nobj - 1):
+        assert int(got[i]) == crc32c(data[i].tobytes()), \
+            "digest kernel mismatch"
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(crc32c_batch(data))
+    dt = time.perf_counter() - t0
+    out["scrub_digest_mb_per_sec"] = round(
+        reps * nobj * chunk / dt / 1e6, 1)
+    out["digest_objects"] = nobj
+    out["digest_chunk_bytes"] = chunk
+
+    # -- parity recheck: re-encode B stripes, compare stored parity
+    k, m = 8, 3
+    ec = ErasureCodeJerasure(ECProfile(k=k, m=m))
+    B = 64 if on_tpu else 8
+    C = (1 << 17) if on_tpu else (1 << 12)
+    sdata = rng.integers(0, 256, size=(B, k, C), dtype=np.uint8)
+    parity = np.asarray(ec._encode_chunks(sdata))
+    stripes = {}
+    for b in range(B):
+        shards = {i: sdata[b, i].tobytes() for i in range(k)}
+        shards.update({k + j: parity[b, j].tobytes()
+                       for j in range(m)})
+        stripes[f"s{b}"] = shards
+    eng = ScrubEngine()
+    verdicts = eng.recheck_parity(ec, stripes)      # warm + verify
+    assert not any(verdicts.values()), "clean stripes flagged"
+    flip = {i: bytes(s) for i, s in stripes["s0"].items()}
+    flip[k] = bytes([flip[k][0] ^ 1]) + flip[k][1:]
+    assert ScrubEngine().recheck_parity(
+        ec, {"s0": flip})["s0"], "corrupt parity missed"
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        eng.recheck_parity(ec, stripes)
+    dt = time.perf_counter() - t0
+    out["scrub_parity_recheck_mb_per_sec"] = round(
+        reps * B * k * C / dt / 1e6, 1)
+    out["parity_stripes"] = B
+    out["parity_stripe_bytes"] = k * C
+    return out
+
+
 def _crush_leg():
     """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
     (BASELINE.md row 4, scaled to fit a bench-run budget)."""
@@ -656,6 +719,15 @@ def child_main():
                 out["reconstruct"] = {"error": str(e)[:200]}
     else:
         out["reconstruct"] = {"skipped": "wall budget exhausted"}
+    print(json.dumps(dict(out, scrub={"skipped": "timeout"})),
+          flush=True)
+    if _budget_left() > 0.06:
+        try:
+            out["scrub"] = _scrub_leg(on_tpu)
+        except Exception as e:    # noqa: BLE001 — keep the headline
+            out["scrub"] = {"error": str(e)[:200]}
+    else:
+        out["scrub"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(out))
     try:
         dev = jax.devices()[0].device_kind

@@ -27,29 +27,71 @@ import os
 import time
 from dataclasses import dataclass, field
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:         # environment without pyca/cryptography
+    AESGCM = None
 
 
 class AuthError(Exception):
     pass
 
 
+def _hmac_stream(secret: bytes, nonce: bytes, n: int) -> bytes:
+    out = bytearray()
+    ctr = 0
+    while len(out) < n:
+        out += hmac.new(secret, nonce + ctr.to_bytes(8, "big") + b"ks",
+                        hashlib.sha256).digest()
+        ctr += 1
+    return bytes(out[:n])
+
+
 class CryptoKey:
-    """A 16-byte AES key (reference CryptoKey, type CEPH_CRYPTO_AES)."""
+    """An AES key, 16/24/32 bytes (reference CryptoKey, type
+    CEPH_CRYPTO_AES; RBD at-rest encryption wraps 32-byte DEKs).
+
+    When pyca/cryptography is unavailable the AEAD degrades to an
+    HMAC-SHA256 CTR stream + 16-byte HMAC tag: same nonce/tag framing
+    and tamper detection, interoperable only with itself — a
+    dependency gate, not a second supported cipher suite.
+    """
 
     def __init__(self, secret: bytes | None = None, created: float = 0.0):
         self.secret = secret if secret is not None else os.urandom(16)
-        if len(self.secret) != 16:
-            raise AuthError("key must be 16 bytes")
+        if len(self.secret) not in (16, 24, 32):
+            raise AuthError("key must be 16/24/32 bytes")
         self.created = created or time.time()
+
+    def _seal(self, nonce: bytes, plaintext: bytes, aad: bytes) -> bytes:
+        ct = bytes(a ^ b for a, b in zip(
+            plaintext, _hmac_stream(self.secret, nonce, len(plaintext))))
+        tag = hmac.new(self.secret, nonce + aad + ct,
+                       hashlib.sha256).digest()[:16]
+        return ct + tag
+
+    def _unseal(self, nonce: bytes, blob: bytes, aad: bytes) -> bytes:
+        if len(blob) < 16:
+            raise AuthError("ciphertext too short")
+        ct, tag = blob[:-16], blob[-16:]
+        want = hmac.new(self.secret, nonce + aad + ct,
+                        hashlib.sha256).digest()[:16]
+        if not hmac.compare_digest(tag, want):
+            raise AuthError("decrypt failed: bad tag")
+        return bytes(a ^ b for a, b in zip(
+            ct, _hmac_stream(self.secret, nonce, len(ct))))
 
     def encrypt(self, plaintext: bytes, aad: bytes = b"") -> bytes:
         nonce = os.urandom(12)
+        if AESGCM is None:
+            return nonce + self._seal(nonce, plaintext, aad)
         return nonce + AESGCM(self.secret).encrypt(nonce, plaintext, aad)
 
     def decrypt(self, blob: bytes, aad: bytes = b"") -> bytes:
         if len(blob) < 13:
             raise AuthError("ciphertext too short")
+        if AESGCM is None:
+            return self._unseal(blob[:12], blob[12:], aad)
         try:
             return AESGCM(self.secret).decrypt(blob[:12], blob[12:], aad)
         except Exception as e:

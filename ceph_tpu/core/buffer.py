@@ -14,8 +14,6 @@ hands a chain to the device path with at most one flatten.
 
 from __future__ import annotations
 
-import zlib
-
 import numpy as np
 
 
@@ -140,12 +138,13 @@ class BufferList:
             self._ptrs = [flat]
 
     def crc32c(self, seed: int = 0) -> int:
-        """Chain checksum.  The reference uses CRC32-C (Castagnoli,
-        SSE4.2); zlib's CRC32 (IEEE) is the polynomial available
-        in-process — same role, stated openly for cross-checking."""
+        """Chain checksum: true CRC-32C (Castagnoli), matching the
+        reference's ``ceph_crc32c`` — RFC 3720 polynomial, chained
+        across segments like a buffer::list crc."""
+        from ..scrub.crc32c_jax import crc32c
         crc = seed
         for ptr in self._ptrs:
-            crc = zlib.crc32(ptr.view(), crc)
+            crc = crc32c(ptr.view(), crc)
         return crc & 0xFFFFFFFF
 
     def hexdump(self, limit: int = 256) -> str:

@@ -84,7 +84,9 @@ def build_options() -> list[Option]:
         Option("osd_recovery_max_active", int, 3,
                "concurrent recovery ops per OSD"),
         Option("osd_scrub_interval", float, 86400.0,
-               "periodic scrub target (s)"),
+               "periodic (shallow) scrub target (s; 0 disables)"),
+        Option("osd_deep_scrub_interval", float, 604800.0,
+               "periodic deep scrub target (s; 0 disables)"),
         Option("osd_client_message_cap", int, 256,
                "max in-flight client messages"),
         # -- erasure coding ----------------------------------------------

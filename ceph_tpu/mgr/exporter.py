@@ -70,6 +70,22 @@ class Exporter:
                      help_="PGs by state" if first else None)
                 first = False
 
+        # cluster-wide scrub totals from the PGMap (per-OSD rates come
+        # from the perf-dump scrape below: scrub_digest_bytes etc.)
+        try:
+            rc, _, dump = self.monc.command({"prefix": "pg dump"})
+        except Exception:
+            rc, dump = -1, None
+        if rc == 0 and dump:
+            pg_stats = (dump.get("pg_stats") or {}).values()
+            emit("ceph_pg_scrub_errors",
+                 sum(st.get("scrub_errors", 0) for st in pg_stats),
+                 help_="scrub inconsistencies outstanding")
+            emit("ceph_pg_inconsistent_objects",
+                 sum(len(st.get("inconsistent_objects") or [])
+                     for st in pg_stats),
+                 help_="objects flagged by list-inconsistent-obj")
+
         for daemon, path in sorted(self.asok_paths.items()):
             try:
                 dump = admin_command(path, "perf dump")

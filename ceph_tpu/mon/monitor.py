@@ -699,7 +699,7 @@ class OSDMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, f"set-quota {field}={val} on pool {name}", None
-        if prefix in ("pg scrub", "pg repair"):
+        if prefix in ("pg scrub", "pg deep-scrub", "pg repair"):
             pgid = _parse_pgid(cmd.get("pgid"))
             if pgid is None:
                 return -22, f"invalid pgid {cmd.get('pgid')!r}", None
@@ -716,9 +716,12 @@ class OSDMonitor(PaxosService):
             if not addr_s:
                 return -16, f"osd.{primary} has no address", None
             from ..osd import messages as OM
+            # "pg scrub" is the shallow pass (reference semantics);
+            # deep-scrub reads + digests; repair implies deep
             self._osd_send(primary, OM.MOSDScrubCommand(
                 pgid=str(pgid), epoch=m.epoch,
-                repair=(prefix == "pg repair")))
+                repair=(prefix == "pg repair"),
+                deep=(prefix != "pg scrub")))
             return 0, f"instructing pg {pgid} on osd.{primary} to " \
                 f"{prefix.split()[1]}", None
         if prefix == "osd pool ls":
@@ -1357,6 +1360,16 @@ class HealthMonitor(PaxosService):
                            "osd_stats": {
                                str(o): s for o, s in
                                self.mon.pgmap.osd_stats.items()}}
+        if prefix == "pg list-inconsistent-obj":
+            # the `rados list-inconsistent-obj` backend: the primary's
+            # last scrub report as carried by MPGStats into the PGMap
+            pgid = str(cmd.get("pgid", ""))
+            st = self.mon.pgmap.pg_stats.get(pgid)
+            if st is None:
+                return -2, f"no stats for pg {pgid!r}", None
+            return 0, "", {
+                "epoch": self.mon.services["osdmap"].osdmap.epoch,
+                "inconsistents": st.get("inconsistent_objects", [])}
         if prefix == "df":
             # per-pool usage from PGMap (reference `ceph df`:
             # PGMap::dump_cluster_stats + per-pool sums)

@@ -53,10 +53,31 @@ class CompileCache:
     deletes the entry and reports a miss — the cache can only ever
     cause a fresh compile, never an error.  Writes are atomic
     (tmp + rename) so concurrent processes at worst both compile.
+
+    Bounded: every store prunes age-expired entries and, LRU-style
+    (loads touch mtime), trims past ``max_entries`` — so the cache
+    dir stops growing unboundedly.  Knobs (0 disables a limit):
+    ``CEPH_TPU_EXPORT_CACHE_MAX_ENTRIES`` (default 512) and
+    ``CEPH_TPU_EXPORT_CACHE_MAX_AGE_DAYS`` (default 30).
     """
 
-    def __init__(self, root: str | Path):
+    def __init__(self, root: str | Path,
+                 max_entries: int | None = None,
+                 max_age_s: float | None = None):
         self.root = Path(root)
+        self.max_entries = (self._env_num(
+            "CEPH_TPU_EXPORT_CACHE_MAX_ENTRIES", 512)
+            if max_entries is None else max_entries)
+        self.max_age_s = (self._env_num(
+            "CEPH_TPU_EXPORT_CACHE_MAX_AGE_DAYS", 30.0) * 86400.0
+            if max_age_s is None else max_age_s)
+
+    @staticmethod
+    def _env_num(name: str, default: float) -> float:
+        try:
+            return float(os.environ.get(name, default))
+        except (TypeError, ValueError):
+            return default
 
     @classmethod
     def default(cls) -> "CompileCache | None":
@@ -83,6 +104,10 @@ class CompileCache:
         except OSError:
             return None
         try:
+            os.utime(p)         # recency for LRU trimming
+        except OSError:
+            pass
+        try:
             from jax import export as jexport
             return jexport.deserialize(bytearray(blob))
         except Exception:
@@ -101,7 +126,39 @@ class CompileCache:
         os.replace(tmp, p)
         p.with_suffix(".json").write_text(
             json.dumps(key, sort_keys=True, default=str, indent=1))
+        try:
+            self.prune()
+        except Exception:
+            pass                # pruning is best-effort housekeeping
         return p
+
+    def prune(self, now: float | None = None) -> int:
+        """Expire entries older than `max_age_s`, then trim the
+        oldest-by-mtime past `max_entries` (across all namespaces).
+        → number of entries removed."""
+        import time
+        now = time.time() if now is None else now
+        try:
+            entries = sorted(self.root.rglob("*.jaxpb"),
+                             key=lambda p: p.stat().st_mtime)
+        except OSError:
+            return 0
+        doomed = []
+        if self.max_age_s and self.max_age_s > 0:
+            cutoff = now - self.max_age_s
+            doomed += [p for p in entries if p.stat().st_mtime < cutoff]
+        keep = [p for p in entries if p not in doomed]
+        if self.max_entries and self.max_entries > 0:
+            excess = len(keep) - int(self.max_entries)
+            if excess > 0:
+                doomed += keep[:excess]
+        for p in doomed:
+            for victim in (p, p.with_suffix(".json")):
+                try:
+                    victim.unlink()
+                except OSError:
+                    pass
+        return len(doomed)
 
 
 def cached_export(namespace: str, key: dict, make_fn, specs):

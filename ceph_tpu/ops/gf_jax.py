@@ -136,6 +136,40 @@ class GFLinear:
             raise ValueError("pallas backends are bitmatrix-only")
         self._fn = (self._apply if self.backend.startswith("pallas")
                     else jax.jit(self._apply))
+        # persistent warm start (XLA path only): per input shape, the
+        # lowered program round-trips through the export cache exactly
+        # like the CRUSH mapper's — a fresh process deserializes
+        # instead of re-tracing the encode/decode programs
+        self._shape_fns: dict[tuple, object] = {}
+        self.export_hits: dict[tuple, bool] = {}
+
+    def _fn_for_shape(self, shape: tuple):
+        fn = self._shape_fns.get(shape)
+        if fn is not None:
+            return fn
+        fn, hit = self._warm_start(shape)
+        self._shape_fns[shape] = fn
+        self.export_hits[shape] = hit
+        return fn
+
+    def _warm_start(self, shape: tuple):
+        from ..native.aot import CompileCache, cached_export
+        if CompileCache.default() is None:
+            return self._fn, False
+        import hashlib
+        key = {"kind": "gf_linear", "jax": jax.__version__,
+               "x64": bool(jax.config.jax_enable_x64),
+               "backend": jax.default_backend(),
+               "use_bits": self.use_bits, "m": self.m, "k": self.k,
+               "mat": hashlib.sha256(self.coding.tobytes()).hexdigest(),
+               "shape": list(shape)}
+        try:
+            exported, hit = cached_export(
+                "ec", key, lambda: jax.jit(self._apply),
+                (jax.ShapeDtypeStruct(shape, jnp.uint8),))
+            return jax.jit(exported.call), hit
+        except Exception:
+            return self._fn, False
 
     def _apply(self, data: jnp.ndarray) -> jnp.ndarray:
         if self.backend in ("pallas", "pallas-interpret"):
@@ -159,7 +193,10 @@ class GFLinear:
         return gf_matmul_gather(self._mat, data)
 
     def __call__(self, data) -> jax.Array:
-        return self._fn(jnp.asarray(data, dtype=jnp.uint8))
+        arr = jnp.asarray(data, dtype=jnp.uint8)
+        if self.backend == "xla":
+            return self._fn_for_shape(arr.shape)(arr)
+        return self._fn(arr)
 
 
 class GFLinearWords:

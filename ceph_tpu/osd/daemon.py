@@ -81,6 +81,16 @@ def _build_osd_perf(name: str):
     b.add_u64_counter("subop", "replica/shard sub-operations")
     b.add_u64_counter("recovery_ops", "objects recovered/pushed")
     b.add_u64_counter("scrub_errors_found", "scrub inconsistencies")
+    b.add_u64_counter("scrub_errors_repaired",
+                      "scrub inconsistencies confirmed repaired")
+    b.add_u64_counter("scrub_objects_scanned",
+                      "objects digested by deep scrub")
+    b.add_u64_counter("scrub_digest_bytes",
+                      "payload bytes CRC-32C'd by deep scrub")
+    b.add_u64_counter("scrub_parity_recheck_bytes",
+                      "EC data bytes re-encoded by parity recheck")
+    b.add_u64_counter("scrubs_scheduled",
+                      "periodic scrubs started by the tick")
     b.add_u64("numpg", "placement groups hosted")
     return b.create_perf_counters()
 
@@ -358,8 +368,11 @@ class OSDaemon(Dispatcher):
     def _start_scrub_or_retry(self, pg, msg, *, max_tries: int = 20):
         """An operator scrub refused (writes in flight, already
         scrubbing, mid-peering) requeues itself instead of silently
-        dropping — the mon already acked the command."""
-        if pg.start_scrub():
+        dropping — the mon already acked the command.  ``repair``
+        implies deep (a shallow pass can't see what to repair)."""
+        deep = bool(getattr(msg, "repair", False)) or \
+            getattr(msg, "deep", True) is not False
+        if pg.start_scrub(deep=deep):
             return
         tries = getattr(msg, "_scrub_tries", 0)
         if tries >= max_tries:
@@ -368,11 +381,11 @@ class OSDaemon(Dispatcher):
         self.timer.add_event_after(
             0.5, lambda: self.op_queue.enqueue("scrub", msg))
 
-    def scrub_pg(self, pgid: PGid) -> bool:
+    def scrub_pg(self, pgid: PGid, deep: bool = True) -> bool:
         """Kick a scrub on a PG this OSD is primary for."""
         with self.lock:
             pg = self.pgs.get(pgid)
-            return bool(pg is not None and pg.start_scrub())
+            return bool(pg is not None and pg.start_scrub(deep=deep))
 
     # -- map handling ------------------------------------------------------
     def _on_osdmap(self, epoch: int, map_dict: dict, newest: int = 0):
@@ -678,6 +691,7 @@ class OSDaemon(Dispatcher):
             # stale address); a stuck primary simply re-asks
             for pg in self.pgs.values():
                 pg.check_scrub_timeout()
+                self._maybe_schedule_scrub(pg)
                 if pg.is_primary and pg.state in ("peering",
                                                   "incomplete"):
                     pg._start_peering()
@@ -717,6 +731,28 @@ class OSDaemon(Dispatcher):
             self._tick_token = self.timer.add_event_after(
                 self._hb_interval, self._tick)
 
+    def _maybe_schedule_scrub(self, pg):
+        """Periodic scrub scheduling (reference OSD::sched_scrub):
+        when a primary active PG's last (deep-)scrub is older than
+        ``osd_scrub_interval`` / ``osd_deep_scrub_interval``, kick one
+        from the tick.  0 disables an interval; a refusal (writes in
+        flight etc.) just waits for the next tick.  Never-scrubbed PGs
+        age from their creation stamp, so a restart doesn't stampede
+        every PG at once."""
+        if not pg.is_primary or pg.state != "active" or pg.scrubbing:
+            return
+        now = time.time()
+        floor = pg._scrub_stamp_floor
+        deep_iv = float(self.config.get("osd_deep_scrub_interval"))
+        if deep_iv > 0 and now - max(pg.last_deep_scrub, floor) >= deep_iv:
+            if pg.start_scrub(deep=True):
+                self.perf.inc("scrubs_scheduled")
+            return
+        iv = float(self.config.get("osd_scrub_interval"))
+        if iv > 0 and now - max(pg.last_scrub, floor) >= iv:
+            if pg.start_scrub(deep=False):
+                self.perf.inc("scrubs_scheduled")
+
     def _report_pg_stats(self):
         """Primary PGs report state/object counts to the mon (reference
         MPGStats → PGMap; caller holds the lock)."""
@@ -753,7 +789,9 @@ class OSDaemon(Dispatcher):
                 "missing": len(pg.missing) + sum(
                     len(pm) for pm in pg.peer_missing.values()),
                 "last_scrub": pg.last_scrub,
+                "last_deep_scrub": pg.last_deep_scrub,
                 "scrub_errors": pg.scrub_errors,
+                "inconsistent_objects": pg.inconsistent_objects,
             }
         if stats or self.pgs:
             self.monc.send(MM.MPGStats(

@@ -150,26 +150,32 @@ class MOSDPGPull(_JsonMessage):
 @register_message
 class MOSDScrubCommand(_JsonMessage):
     """Mon → primary OSD: operator-requested scrub/repair of one PG
-    (reference MOSDScrub, the `ceph pg scrub|repair` path; our scrub
-    repairs inconsistencies it finds, so repair == scrub here)."""
+    (reference MOSDScrub, the `ceph pg scrub|deep-scrub|repair` path;
+    our scrub repairs inconsistencies it finds, so repair implies
+    deep).  ``deep``: read data and verify digests/parity; a shallow
+    scrub (deep falsy) compares metadata only."""
     TYPE = 70
-    FIELDS = ("pgid", "epoch", "repair")
+    FIELDS = ("pgid", "epoch", "repair", "deep")
 
 
 @register_message
 class MOSDRepScrub(_JsonMessage):
     """Primary → acting member: build and return your scrub map for
-    this PG (reference MOSDRepScrub → replica ScrubMap build)."""
+    this PG (reference MOSDRepScrub → replica ScrubMap build).
+    ``deep``: read payloads and digest them (deep scrub); shallow
+    maps carry sizes/versions only."""
     TYPE = 55
-    FIELDS = ("pgid", "epoch", "scrub_tid", "from_osd")
+    FIELDS = ("pgid", "epoch", "scrub_tid", "from_osd", "deep")
 
 
 @register_message
 class MOSDRepScrubMap(_JsonMessage):
     """Acting member → primary: my scrub map (reference
     MOSDRepScrubMap).  objects: {oid: {"size", "crc", "version",
-    "valid"}} — for EC shards "crc" is the chunk crc and "valid" is
-    the self-check against the stored hinfo."""
+    "valid"}} — for EC shards "crc" is the chunk CRC-32C and "valid"
+    is the self-check against the stored hinfo; deep EC maps also
+    carry "data" (hex chunk payload) so the primary can re-run the
+    erasure code across shards (parity recheck)."""
     TYPE = 56
     FIELDS = ("pgid", "epoch", "scrub_tid", "shard", "objects",
               "from_osd")

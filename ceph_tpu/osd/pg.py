@@ -37,7 +37,6 @@ from __future__ import annotations
 
 import json
 import time
-import zlib
 
 import numpy as np
 
@@ -46,6 +45,8 @@ from ..ec.interface import ECProfile
 from ..ec.registry import create_erasure_code
 from ..os_store import Transaction
 from ..osd.osdmap import PGid
+from ..scrub import engine as scrub_engine
+from ..scrub.crc32c_jax import crc32c
 from . import messages as M
 from .types import (DELETE, LogEntry, MODIFY, PGInfo, PGLog, ZERO)
 
@@ -113,10 +114,18 @@ class PG:
         # scrub state (primary-driven; reference src/osd/scrubber/)
         self.scrubbing = False
         self.last_scrub = 0.0
+        self.last_deep_scrub = 0.0
         self.scrub_errors = 0
         self._scrub_tid = 0
+        self._scrub_deep = True
         self._scrub_maps: dict[int, dict] = {}
         self._scrub_waiting: set[int] = set()
+        # list-inconsistent-obj report from the last scrub that found
+        # errors (primary; cleared by a clean scrub)
+        self.inconsistent_objects: list[dict] = []
+        # periodic scrub scheduling baseline: a never-scrubbed PG
+        # waits a full interval from creation (no startup storm)
+        self._scrub_stamp_floor = time.time()
         self._pulls: dict[int, str] = {}       # pull_tid → oid
         self._pull_tid = 0
         self._held_cache: list[int] | None = None   # see _held_shards
@@ -1120,10 +1129,15 @@ class PG:
     # scrub (reference src/osd/scrubber/: primary gathers a ScrubMap
     # from every acting member, compares, repairs from survivors)
     # =======================================================================
-    def start_scrub(self) -> bool:
+    def start_scrub(self, deep: bool = True) -> bool:
         """Primary: kick a scrub round.  False if the PG can't scrub
         now (not primary / not active / already scrubbing / writes in
-        flight — scrub maps must not race uncommitted writes)."""
+        flight — scrub maps must not race uncommitted writes).
+
+        deep=True (the default) reads every payload and verifies
+        CRC-32C digests — plus the EC parity recheck on the primary;
+        deep=False is the shallow pass: sizes/versions/presence only,
+        no data reads."""
         from .osdmap import CLUSTER_FLAGS
         busy = (self.backend._inflight
                 or getattr(self.backend, "_rmw", None)
@@ -1134,16 +1148,17 @@ class PG:
                 or self.scrubbing or busy:
             return False
         self.scrubbing = True
+        self._scrub_deep = bool(deep)
         self._scrub_started = time.monotonic()
         self._scrub_tid += 1
         self._scrub_maps = {
-            self.daemon.whoami: self.backend.build_scrub_map()}
+            self.daemon.whoami: self.backend.build_scrub_map(deep=deep)}
         self._scrub_waiting = set(self._peer_osds())
         for o in self._scrub_waiting:
             self.daemon.send_to_osd(o, M.MOSDRepScrub(
                 pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
                 scrub_tid=self._scrub_tid,
-                from_osd=self.daemon.whoami))
+                from_osd=self.daemon.whoami, deep=bool(deep)))
         self._maybe_finish_scrub()
         return True
 
@@ -1152,7 +1167,8 @@ class PG:
         self.daemon.send_to_osd(msg.from_osd, M.MOSDRepScrubMap(
             pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
             scrub_tid=msg.scrub_tid, shard=self.shard,
-            objects=self.backend.build_scrub_map(),
+            objects=self.backend.build_scrub_map(
+                deep=msg.deep is not False),
             from_osd=self.daemon.whoami))
 
     def handle_scrub_map(self, msg: M.MOSDRepScrubMap):
@@ -1165,11 +1181,19 @@ class PG:
     def _maybe_finish_scrub(self):
         if self._scrub_waiting:
             return
-        errors = self.backend.scrub_compare(self._scrub_maps)
+        prev_errors = self.scrub_errors
+        errors = self.backend.scrub_compare(self._scrub_maps,
+                                            deep=self._scrub_deep)
         if errors:
             self.daemon.perf.inc("scrub_errors_found", errors)
+        elif prev_errors:
+            # a clean scrub after a dirty one: the repairs took
+            self.daemon.perf.inc("scrub_errors_repaired", prev_errors)
+            self.inconsistent_objects = []
         self.scrub_errors = errors
         self.last_scrub = time.time()
+        if self._scrub_deep:
+            self.last_deep_scrub = self.last_scrub
         self.scrubbing = False
         self._scrub_maps = {}
         if errors:
@@ -1502,51 +1526,85 @@ class ReplicatedBackend:
         return results
 
     # -- scrub -------------------------------------------------------------
-    def build_scrub_map(self) -> dict:
+    def build_scrub_map(self, deep: bool = True) -> dict:
         """oid → {size, crc, version} over my copy of the collection
-        (reference ScrubMap build: whole-object crc per replica)."""
+        (reference ScrubMap build: whole-object crc per replica).
+        Deep maps carry a true CRC-32C data digest — payloads are
+        bucketed and digested through the batched scrub engine; a
+        shallow map reads no data (size from the object meta)."""
         pg = self.pg
         store, cid = pg.daemon.store, pg.cid
         out = {}
+        payloads: dict[str, bytes] = {}
         for oid in pg._list_objects(include_snaps=True):
             try:
-                data = store.read(cid, oid)
                 meta = json.loads(bytes(store.getattr(cid, oid, "_")))
+                if deep:
+                    payloads[oid] = bytes(store.read(cid, oid))
+                    size = len(payloads[oid])
+                else:
+                    size = int(meta.get("size", 0))
             except KeyError:
                 continue
-            out[oid] = {"size": len(data), "crc": zlib.crc32(data),
+            out[oid] = {"size": size,
                         "version": meta.get("version", list(ZERO)),
                         "valid": True}
+        if deep:
+            eng = scrub_engine.default_engine()
+            for oid, digest in eng.compute_digests(payloads).items():
+                out[oid]["crc"] = digest
+            perf = pg.daemon.perf
+            perf.inc("scrub_objects_scanned", len(payloads))
+            perf.inc("scrub_digest_bytes",
+                     sum(len(b) for b in payloads.values()))
         return out
 
-    def scrub_compare(self, maps: dict[int, dict]) -> int:
-        """Majority-vote across replica crcs; divergent or absent
-        copies become recovery state (pushed from the authoritative
-        copy).  Ties prefer the primary's copy — the reference prefers
-        the copy matching the object_info digest and falls back to the
-        primary.  Returns the inconsistency count."""
+    def scrub_compare(self, maps: dict[int, dict],
+                      deep: bool = True) -> int:
+        """Majority-vote across replica digests (sizes only, for a
+        shallow scrub); divergent or absent copies become recovery
+        state (pushed from the authoritative copy).  Ties prefer the
+        primary's copy — the reference prefers the copy matching the
+        object_info digest and falls back to the primary.  Returns the
+        inconsistency count and leaves a ``list-inconsistent-obj``
+        report on the PG."""
         pg = self.pg
         me = pg.daemon.whoami
         oids = set()
         for m in maps.values():
             oids.update(m)
         errors = 0
+        report = []
         for oid in sorted(oids):
             votes: dict[tuple, list[int]] = {}
             for osd, m in maps.items():
                 e = m.get(oid)
                 if e is not None:
-                    votes.setdefault((e["crc"], e["size"]),
+                    votes.setdefault((e.get("crc"), e["size"]),
                                      []).append(osd)
             best = max(votes, key=lambda k: (len(votes[k]),
                                              me in votes[k]))
             good = votes[best]
             ver = tuple(next(m[oid] for m in maps.values()
                              if oid in m)["version"])
-            for osd in maps:
+            shard_report: dict[tuple, dict] = {}
+            obj_errors: set[str] = set()
+            for osd, m in maps.items():
                 if osd in good:
                     continue
                 errors += 1
+                e = m.get(oid)
+                if e is None:
+                    shard_report[osd, 0] = {"errors": ["missing"]}
+                    obj_errors.add("missing")
+                else:
+                    kind = ("size_mismatch"
+                            if e["size"] != best[1]
+                            else "data_digest_mismatch")
+                    shard_report[osd, 0] = {
+                        "size": e["size"], "digest": e.get("crc"),
+                        "errors": [kind]}
+                    obj_errors.add(kind)
                 if osd == me:
                     pg.missing[oid] = ver
                     # pull specifically from an authoritative copy
@@ -1563,6 +1621,16 @@ class ReplicatedBackend:
                             from_osd=me, pull_tid=pg._pull_tid))
                 else:
                     pg.peer_missing.setdefault(osd, {})[oid] = ver
+            if shard_report:
+                for osd in good:
+                    e = maps[osd][oid]
+                    shard_report[osd, 0] = {
+                        "size": e["size"], "digest": e.get("crc"),
+                        "errors": []}
+                report.append(scrub_engine.inconsistent_entry(
+                    oid, sorted(obj_errors), shard_report))
+        if report:
+            pg.inconsistent_objects = report
         return errors
 
     def snap_trim(self, removed: set[int] | None):
@@ -1989,7 +2057,7 @@ class ECBackend:
             t.truncate(cid, oid, 0)
             t.write(cid, oid, 0, chunk)
             t.setattrs(cid, oid, {"_": _obj_meta(
-                version, logical_size, hinfo=zlib.crc32(chunk))})
+                version, logical_size, hinfo=crc32c(chunk))})
         # attr-only mutations leave "_" untouched: it carries the
         # shard's data hinfo, which an attr update must not clobber
         # (the log entry alone records the new version)
@@ -2317,7 +2385,7 @@ class ECBackend:
         # HashInfo crc verification on sub-read)
         meta = json.loads(bytes.fromhex(msg.attrs["_"]))
         hinfo = meta.get("hinfo")
-        if hinfo is not None and zlib.crc32(chunk) != hinfo:
+        if hinfo is not None and crc32c(chunk) != hinfo:
             del self._reads[msg.tid]
             if st.get("on_fail") is not None:
                 st["on_fail"]()
@@ -2512,7 +2580,7 @@ class ECBackend:
                 attrs={"_": _obj_meta(
                     tuple(meta.get("version", version)),
                     int(meta.get("size", 0)),
-                    hinfo=zlib.crc32(chunk)).hex()},
+                    hinfo=crc32c(chunk)).hex()},
                 omap={}, version=list(version),
                 from_osd=pg.daemon.whoami, pull_tid=None))
 
@@ -2542,7 +2610,7 @@ class ECBackend:
             t.write(cid, oid, 0, chunk)
             t.setattrs(cid, oid, {"_": _obj_meta(
                 tuple(meta.get("version", version)),
-                int(meta.get("size", 0)), hinfo=zlib.crc32(chunk))})
+                int(meta.get("size", 0)), hinfo=crc32c(chunk))})
             pg.daemon.store.queue_transaction(t)
             pg._pulls.pop(pull_tid, None)
             pg.missing.pop(oid, None)
@@ -2555,49 +2623,155 @@ class ECBackend:
                                                             None))
 
     # -- scrub -------------------------------------------------------------
-    def build_scrub_map(self) -> dict:
+    def build_scrub_map(self, deep: bool = True) -> dict:
         """oid → {size, crc, version, valid}: each EC shard verifies
         its own chunk against the stored hinfo crc (reference deep
-        scrub on EC shards), so corruption is self-evident without
-        cross-shard comparison."""
+        scrub on EC shards).  Deep maps digest chunks through the
+        batched CRC-32C kernel and carry the chunk payload ("data",
+        hex) so the primary can re-run the erasure code across shards
+        — the parity recheck that catches bit-rot whose hinfo was
+        rewritten consistently.  Shallow maps are presence/size only
+        (no data read, no self-check)."""
         pg = self.pg
         store, cid = pg.daemon.store, pg.cid
         out = {}
+        chunks: dict[str, bytes] = {}
+        metas: dict[str, dict] = {}
         for oid in pg._list_objects():
             try:
-                chunk = store.read(cid, oid)
                 meta = json.loads(bytes(store.getattr(cid, oid, "_")))
+                if deep:
+                    chunks[oid] = bytes(store.read(cid, oid))
+                metas[oid] = meta
             except KeyError:
                 continue
-            crc = zlib.crc32(chunk)
-            hinfo = meta.get("hinfo")
-            out[oid] = {"size": int(meta.get("size", 0)), "crc": crc,
+            out[oid] = {"size": int(meta.get("size", 0)),
                         "version": meta.get("version", list(ZERO)),
-                        "valid": hinfo is None or crc == hinfo}
+                        "valid": True}
+        if deep:
+            eng = scrub_engine.default_engine()
+            for oid, digest in eng.compute_digests(chunks).items():
+                hinfo = metas[oid].get("hinfo")
+                out[oid].update(
+                    crc=digest, data=chunks[oid].hex(),
+                    valid=hinfo is None or digest == hinfo)
+            perf = pg.daemon.perf
+            perf.inc("scrub_objects_scanned", len(chunks))
+            perf.inc("scrub_digest_bytes",
+                     sum(len(b) for b in chunks.values()))
         return out
 
-    def scrub_compare(self, maps: dict[int, dict]) -> int:
+    def scrub_compare(self, maps: dict[int, dict],
+                      deep: bool = True) -> int:
         """A shard whose self-check failed (or that is missing an
         object other members have) gets its chunk reconstructed from
-        the k survivors — the §4.3 path as repair."""
+        the k survivors — the §4.3 path as repair.
+
+        Deep scrubs additionally re-encode each fully-present stripe
+        through the GF(2^8) matmul engine and compare recomputed
+        parity against the stored parity shards; an inconsistent
+        stripe whose shards all pass their own hinfo self-check is
+        attributed by single-erasure hypothesis testing
+        (``scrub.engine.isolate_culprit``) and repaired through the
+        same reconstruct path."""
         pg = self.pg
         me = pg.daemon.whoami
         oids = set()
         for m in maps.values():
             oids.update(m)
         errors = 0
+        report = []
+        shard_of = {osd: i for i, osd in enumerate(pg.acting)
+                    if osd != CRUSH_ITEM_NONE}
+        versions: dict[str, tuple] = {}
+        suspect: set[str] = set()
         for oid in sorted(oids):
             ver = tuple(next(m[oid] for m in maps.values()
                              if oid in m)["version"])
+            versions[oid] = ver
+            shard_report: dict[tuple, dict] = {}
+            obj_errors: set[str] = set()
             for osd, m in maps.items():
                 e = m.get(oid)
                 if e is not None and e["valid"]:
                     continue
                 errors += 1
+                suspect.add(oid)
+                kind = "missing" if e is None else "data_digest_mismatch"
+                obj_errors.add(kind)
+                shard_report[osd, shard_of.get(osd, -1)] = {
+                    "errors": [kind],
+                    **({} if e is None else
+                       {"size": e["size"], "digest": e.get("crc")})}
                 if osd == me:
                     pg.missing[oid] = ver
                 else:
                     pg.peer_missing.setdefault(osd, {})[oid] = ver
+            if shard_report:
+                report.append(scrub_engine.inconsistent_entry(
+                    oid, sorted(obj_errors), shard_report))
+        if deep:
+            errors += self._parity_recheck(
+                maps, oids - suspect, shard_of, versions, report)
+        if report:
+            pg.inconsistent_objects = report
+        return errors
+
+    def _parity_recheck(self, maps: dict[int, dict], oids: set,
+                        shard_of: dict[int, int],
+                        versions: dict[str, tuple],
+                        report: list) -> int:
+        """Re-encode fully-present self-consistent stripes; attribute
+        and queue repair for any whose stored parity diverges."""
+        pg = self.pg
+        me = pg.daemon.whoami
+        ec = self.engine
+        n = ec.k + ec.m
+        stripes: dict[str, dict[int, bytes]] = {}
+        for oid in oids:
+            chunks: dict[int, bytes] = {}
+            for osd, m in maps.items():
+                e = m.get(oid)
+                if e is None or "data" not in e or osd not in shard_of:
+                    continue
+                chunks[shard_of[osd]] = bytes.fromhex(e["data"])
+            if (len(chunks) == n
+                    and len({len(c) for c in chunks.values()}) == 1):
+                stripes[oid] = chunks
+        if not stripes:
+            return 0
+        eng = scrub_engine.default_engine()
+        before = eng.parity_bytes
+        verdicts = eng.recheck_parity(ec, stripes)
+        pg.daemon.perf.inc("scrub_parity_recheck_bytes",
+                           eng.parity_bytes - before)
+        errors = 0
+        for oid, inconsistent in sorted(verdicts.items()):
+            if not inconsistent:
+                continue
+            errors += 1
+            culprit = scrub_engine.isolate_culprit(ec, stripes[oid])
+            osd_by_shard = {s: o for o, s in shard_of.items()}
+            shard_report: dict[tuple, dict] = {}
+            if culprit is None:
+                # detected but unattributable (m=1 has no
+                # discriminating redundancy): report only
+                for osd, s in shard_of.items():
+                    shard_report[osd, s] = {
+                        "errors": ["parity_mismatch"]}
+                kinds = ["parity_mismatch"]
+            else:
+                osd = osd_by_shard[culprit]
+                shard_report[osd, culprit] = {
+                    "errors": ["parity_mismatch"]}
+                kinds = ["parity_mismatch"]
+                ver = versions[oid]
+                if osd == me:
+                    pg.missing[oid] = ver
+                else:
+                    pg.peer_missing.setdefault(osd, {})[oid] = ver
+            report.append(scrub_engine.inconsistent_entry(
+                oid, kinds, shard_report))
         return errors
 
     def answer_pull(self, msg: M.MOSDPGPull):

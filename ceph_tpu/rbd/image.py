@@ -62,16 +62,15 @@ def _derive_kek(passphrase: str, salt: bytes) -> bytes:
 
 
 def _seal(key: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
-    import os as _os
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-    nonce = _os.urandom(12)
-    return nonce + AESGCM(key).encrypt(nonce, plaintext, aad)
+    # CryptoKey carries the AES-GCM-or-HMAC-stream dependency gate
+    # (core/auth.py): same nonce+ct framing either way
+    from ..core.auth import CryptoKey
+    return CryptoKey(key).encrypt(plaintext, aad)
 
 
 def _unseal(key: bytes, blob: bytes, aad: bytes = b"") -> bytes:
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-    return AESGCM(key).decrypt(bytes(blob[:12]), bytes(blob[12:]),
-                               aad)
+    from ..core.auth import CryptoKey
+    return CryptoKey(key).decrypt(bytes(blob), aad)
 
 
 def _is_data_suffix(rest: str) -> bool:

@@ -1,0 +1,19 @@
+"""TPU-native integrity engine — batched CRC32C + EC parity deep-scrub.
+
+The reference OSD's deep scrub (``src/osd/scrubber/``, backed by
+``ceph_crc32c``) recomputes per-object digests, cross-checks
+replicas/shards, and drives repair.  Here the digest math itself is
+GF(2) linear algebra batched on the accelerator:
+
+- :mod:`.crc32c_jax` — true CRC32C (Castagnoli, poly ``0x1EDC6F41``
+  reflected) as a bit-matrix kernel over ``[n_objects, chunk]`` uint8
+  batches, plus ``crc32c_combine`` via matrix exponentiation so
+  chunked CRCs merge exactly like the reference's buffer-chain CRC;
+- :mod:`.engine` — the batched deep-scrub planner: groups shard
+  payloads, digests them on-device, and for EC pools recomputes
+  parity through the existing ``ops/gf_jax`` matmul path to catch
+  bit-rot that per-shard digest self-checks cannot see.
+"""
+
+from .crc32c_jax import crc32c, crc32c_combine, crc32c_batch  # noqa: F401
+from .engine import ScrubEngine, default_engine  # noqa: F401

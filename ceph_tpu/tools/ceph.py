@@ -134,8 +134,12 @@ def _dispatch(args, rest) -> int:
                 rest[2:3] == ["set-quota"]:
             cmd = {"prefix": "osd pool set-quota", "pool": rest[3],
                    "field": rest[4], "val": rest[5]}
-        elif rest[0] == "pg" and rest[1:2] in (["scrub"], ["repair"]):
+        elif rest[0] == "pg" and rest[1:2] in (["scrub"], ["deep-scrub"],
+                                               ["repair"]):
             cmd = {"prefix": f"pg {rest[1]}", "pgid": rest[2]}
+        elif rest[0] == "pg" and rest[1:2] == ["list-inconsistent-obj"]:
+            cmd = {"prefix": "pg list-inconsistent-obj",
+                   "pgid": rest[2]}
         elif rest[0] == "fs" and rest[1:2] == ["set"]:
             cmd = {"prefix": "fs set", "fs_name": rest[2],
                    "var": rest[3], "val": rest[4]}

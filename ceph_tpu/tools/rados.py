@@ -7,6 +7,7 @@ bench engine ``src/common/obj_bencher.cc``; SURVEY.md §3.10):
     rados -m ... mkpool POOL [--size N] [--pg-num N]
     rados -m ... -p POOL put OBJ FILE | get OBJ FILE | rm OBJ
     rados -m ... -p POOL ls | stat OBJ
+    rados -m ... list-inconsistent-obj PGID
     rados -m ... -p POOL bench SECONDS write|seq|rand \\
           [-b BLOCKSIZE] [-t CONCURRENCY] [--no-cleanup] [--json]
 
@@ -180,6 +181,8 @@ def build_parser() -> argparse.ArgumentParser:
         x.add_argument("value")
     cf = sub.add_parser("cache-flush-evict-all")
     cf.add_argument("base_pool")
+    li = sub.add_parser("list-inconsistent-obj")
+    li.add_argument("pgid")
     be = sub.add_parser("bench")
     be.add_argument("seconds", type=int)
     be.add_argument("mode", choices=["write", "seq", "rand"])
@@ -222,6 +225,15 @@ def main(argv=None) -> int:
             n = r.cache_flush_evict_all(args.base_pool)
             print(f"flushed and evicted {n} objects")
             return 0
+        if args.cmd == "list-inconsistent-obj":
+            rc, outs, outb = r.mon_command(
+                {"prefix": "pg list-inconsistent-obj",
+                 "pgid": args.pgid})
+            if outb is not None:
+                print(json.dumps(outb, indent=2, default=str))
+            if outs:
+                print(outs, file=sys.stderr)
+            return 0 if rc == 0 else 1
         if not args.pool:
             raise SystemExit("rados: -p POOL required")
         io = r.open_ioctx(args.pool)

@@ -256,10 +256,12 @@ class MiniCluster:
             time.sleep(0.05)
         raise TimeoutError(f"cluster never went clean: {states}")
 
-    def scrub_pg(self, pgid, timeout: float = 20.0) -> int:
+    def scrub_pg(self, pgid, timeout: float = 20.0, *,
+                 deep: bool = True) -> int:
         """Scrub one PG on its primary; wait for completion and
         subsequent repair to settle.  Returns the error count the
-        scrub found (0 = clean)."""
+        scrub found (0 = clean).  deep=False runs a shallow scrub
+        (metadata only — no payload digests, no parity recheck)."""
         primary = None
         for osd in self.osds.values():
             with osd.lock:
@@ -270,7 +272,7 @@ class MiniCluster:
         if primary is None:
             raise KeyError(f"no primary for {pgid}")
         deadline = time.monotonic() + timeout
-        while not primary.scrub_pg(pgid):
+        while not primary.scrub_pg(pgid, deep=deep):
             # refused while writes are in flight — retry
             if time.monotonic() > deadline:
                 raise TimeoutError(f"scrub of {pgid} never started")

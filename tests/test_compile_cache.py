@@ -168,3 +168,110 @@ def test_osdmap_reweight_fast_path(cache_dir):
     bm3 = om.batch_mapper(0, 2)
     assert bm3 is not bm
     np.testing.assert_array_equal(bm3(XS), _oracle(om.crush, XS))
+
+
+# -- cache pruning (LRU trim + age expiry) --------------------------------
+
+def _fill(root, n, t0=1_000_000.0):
+    """n fake entries with increasing mtimes; → list oldest-first."""
+    import os
+    d = root / "export" / "fake"
+    d.mkdir(parents=True)
+    out = []
+    for i in range(n):
+        p = d / f"e{i:03d}.jaxpb"
+        p.write_bytes(b"x")
+        p.with_suffix(".json").write_text("{}")
+        os.utime(p, (t0 + i, t0 + i))
+        out.append(p)
+    return out
+
+
+def test_prune_trims_past_max_entries(tmp_path):
+    entries = _fill(tmp_path, 8)
+    cc = CompileCache(tmp_path / "export", max_entries=3,
+                      max_age_s=0)
+    assert cc.prune(now=1_000_100.0) == 5
+    survivors = sorted(p.name for p in
+                       (tmp_path / "export").rglob("*.jaxpb"))
+    # oldest-by-mtime evicted, newest 3 kept, sidecars went with them
+    assert survivors == ["e005.jaxpb", "e006.jaxpb", "e007.jaxpb"]
+    assert not (entries[0].with_suffix(".json")).exists()
+    assert entries[-1].with_suffix(".json").exists()
+
+
+def test_prune_expires_by_age(tmp_path):
+    _fill(tmp_path, 4, t0=1_000_000.0)
+    cc = CompileCache(tmp_path / "export", max_entries=0,
+                      max_age_s=10.0)
+    # now = t0 + 12 → entries at t0+0, t0+1 are older than 10s
+    assert cc.prune(now=1_000_012.0) == 2
+    assert len(list((tmp_path / "export").rglob("*.jaxpb"))) == 2
+
+
+def test_prune_disabled_by_zero_limits(tmp_path):
+    _fill(tmp_path, 6)
+    cc = CompileCache(tmp_path / "export", max_entries=0,
+                      max_age_s=0)
+    assert cc.prune(now=2_000_000.0) == 0
+    assert len(list((tmp_path / "export").rglob("*.jaxpb"))) == 6
+
+
+def test_prune_env_knobs(tmp_path, monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_EXPORT_CACHE_MAX_ENTRIES", "2")
+    monkeypatch.setenv("CEPH_TPU_EXPORT_CACHE_MAX_AGE_DAYS", "0")
+    _fill(tmp_path, 5)
+    cc = CompileCache(tmp_path / "export")
+    assert cc.max_entries == 2
+    assert cc.prune(now=1_000_100.0) == 3
+
+
+def test_store_triggers_prune(cache_dir, monkeypatch):
+    """Every store_exported call prunes, so the dir is self-bounding:
+    two differently-shaped CRUSH programs under max_entries=1 leave
+    exactly one entry behind."""
+    monkeypatch.setenv("CEPH_TPU_EXPORT_CACHE_MAX_ENTRIES", "1")
+    BatchMapper(_tiny(), 0, result_max=2, chunk=256)
+    BatchMapper(build_hierarchy(1, 2, 3), 0, result_max=2, chunk=256)
+    assert len(list((cache_dir / "export").rglob("*.jaxpb"))) == 1
+
+
+# -- EC encode/decode programs warm-start from the same cache -------------
+
+def test_gf_linear_warm_start(cache_dir):
+    from ceph_tpu.ops.gf_jax import GFLinear
+
+    coding = np.array([[1, 1], [1, 2]], dtype=np.uint8)
+    data = np.arange(2 * 64, dtype=np.uint8).reshape(2, 64)
+
+    gf = GFLinear(coding, backend="xla")
+    out = np.asarray(gf(data))
+    assert gf.export_hits[(2, 64)] is False          # cold: exported
+    entries = list((cache_dir / "export" / "ec").glob("*.jaxpb"))
+    assert len(entries) == 1
+
+    # a fresh instance (fresh process stand-in) deserializes
+    gf2 = GFLinear(coding, backend="xla")
+    out2 = np.asarray(gf2(data))
+    assert gf2.export_hits[(2, 64)] is True          # warm
+    np.testing.assert_array_equal(out2, out)
+
+    # different coefficients must NOT collide with the cached program
+    gf3 = GFLinear(np.array([[1, 1], [1, 3]], dtype=np.uint8),
+                   backend="xla")
+    np.asarray(gf3(data))
+    assert gf3.export_hits[(2, 64)] is False
+    assert len(list(
+        (cache_dir / "export" / "ec").glob("*.jaxpb"))) == 2
+
+
+def test_gf_linear_cache_disabled(cache_dir, monkeypatch):
+    from ceph_tpu.ops.gf_jax import GFLinear
+
+    monkeypatch.setenv("CEPH_TPU_EXPORT_CACHE", "0")
+    coding = np.array([[1, 1]], dtype=np.uint8)
+    gf = GFLinear(coding, backend="xla")
+    out = np.asarray(gf(np.ones((2, 32), np.uint8)))
+    assert gf.export_hits[(2, 32)] is False
+    assert out.shape == (1, 32)
+    assert not (cache_dir / "export" / "ec").exists()
