@@ -637,6 +637,63 @@ def _scrub_leg(on_tpu: bool):
     return out
 
 
+def _robustness_leg():
+    """Kill an OSD under write load on a live MiniCluster: throughput
+    through the degraded window (the backoff/resend fabric keeps the
+    client from storming) and the convergence time back to
+    active+clean after the revive — the fault-fabric recovery
+    headline."""
+    import threading
+
+    from ceph_tpu.vstart import MiniCluster
+
+    res = {}
+    payload = os.urandom(4096)
+    with MiniCluster(n_mons=1, n_osds=3) as c:
+        r = c.rados()
+        r.create_pool("bench_rob", pg_num=8, size=3, min_size=2)
+        io = r.open_ioctx("bench_rob")
+        io.write_full("o0", payload)
+        c.wait_for_clean()
+        stop = threading.Event()
+        stamps: list[float] = []
+
+        def load():
+            n = 0
+            while not stop.is_set():
+                try:
+                    io.write_full(f"o{n % 64}", payload)
+                    stamps.append(time.monotonic())
+                    n += 1
+                except Exception:       # noqa: BLE001 — op timeout
+                    time.sleep(0.05)    # during the kill window
+
+        def ops_per_sec(window: float) -> float:
+            t0 = time.monotonic()
+            time.sleep(window)
+            return round(sum(1 for t in stamps if t >= t0) / window, 1)
+
+        th = threading.Thread(target=load, daemon=True)
+        th.start()
+        res["baseline_ops_per_sec"] = ops_per_sec(2.0)
+        victim = sorted(c.osds)[-1]
+        t_kill = time.monotonic()
+        c.kill_osd(victim)
+        c.wait_for_osd_down(victim)
+        res["detect_down_s"] = round(time.monotonic() - t_kill, 2)
+        res["degraded_ops_per_sec"] = ops_per_sec(2.0)
+        t_revive = time.monotonic()
+        c.revive_osd(victim)
+        c.wait_for_clean(timeout=60.0)
+        res["recovery_convergence_s"] = round(
+            time.monotonic() - t_revive, 2)
+        stop.set()
+        th.join(timeout=15.0)
+        res["total_ops"] = len(stamps)
+        r.shutdown()
+    return res
+
+
 def _crush_leg():
     """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
     (BASELINE.md row 4, scaled to fit a bench-run budget)."""
@@ -728,6 +785,16 @@ def child_main():
             out["scrub"] = {"error": str(e)[:200]}
     else:
         out["scrub"] = {"skipped": "wall budget exhausted"}
+    print(json.dumps(dict(out, robustness={"skipped": "timeout"})),
+          flush=True)
+    # ~20s of live-cluster churn: needs a real slice of wall budget
+    if _budget_left() > 0.08:
+        try:
+            out["robustness"] = _robustness_leg()
+        except Exception as e:    # noqa: BLE001 — keep the headline
+            out["robustness"] = {"error": str(e)[:200]}
+    else:
+        out["robustness"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(out))
     try:
         dev = jax.devices()[0].device_kind

@@ -20,6 +20,30 @@ def build_options() -> list[Option]:
         Option("ms_inject_socket_failures", int, 0,
                "fault injection: drop 1-in-N sends (0=off)",
                Level.DEV),
+        # fault-fabric knobs (msg/fault.py FaultInjector): the seed
+        # makes every probabilistic verdict a pure function of
+        # (seed, src, dst, n) so a thrash failure replays exactly
+        Option("ms_inject_seed", int, 0,
+               "fault injection RNG seed (0 = random, logged)",
+               Level.DEV),
+        Option("ms_inject_drop_prob", float, 0.0,
+               "fault injection: P(drop) per message", Level.DEV,
+               min=0.0, max=1.0),
+        Option("ms_inject_delay_prob", float, 0.0,
+               "fault injection: P(delay) per message", Level.DEV,
+               min=0.0, max=1.0),
+        Option("ms_inject_delay_ms", float, 20.0,
+               "fault injection: delay length (ms)", Level.DEV,
+               min=0.0),
+        Option("ms_inject_dup_prob", float, 0.0,
+               "fault injection: P(duplicate) per message", Level.DEV,
+               min=0.0, max=1.0),
+        Option("ms_inject_reorder_prob", float, 0.0,
+               "fault injection: P(reorder) per message", Level.DEV,
+               min=0.0, max=1.0),
+        Option("ms_inject_reorder_ms", float, 40.0,
+               "fault injection: reorder hold-back window (ms)",
+               Level.DEV, min=0.0),
         Option("ms_crc_data", bool, True, "checksum frame payloads"),
         # -- mon ----------------------------------------------------------
         Option("mon_lease", float, 5.0, "paxos lease duration (s)"),
@@ -112,6 +136,20 @@ def build_options() -> list[Option]:
                "initial mon hunt timeout (s)"),
         Option("objecter_inflight_ops", int, 1024,
                "client op throttle"),
+        # RADOS backoff / resend schedule (osdc/objecter.py): the
+        # periodic resend ramps exponentially from the base interval
+        # to the max, jittered so a wounded cluster sees decorrelated
+        # retries; server MOSDBackoff blocks park ops entirely, with
+        # the expire guard in case the unblock is lost on the wire
+        Option("objecter_resend_interval", float, 2.0,
+               "base op resend interval (s)", min=0.1),
+        Option("objecter_resend_max", float, 16.0,
+               "resend backoff ceiling (s)", min=0.1),
+        Option("objecter_resend_jitter", float, 0.25,
+               "resend jitter fraction (+/-)", min=0.0, max=1.0),
+        Option("objecter_backoff_expire", float, 10.0,
+               "drop a server backoff not unblocked within (s)",
+               min=0.1),
         # -- tpu ----------------------------------------------------------
         Option("tpu_mesh_shape", str, "auto",
                "device mesh, e.g. '2x4' or 'auto'"),

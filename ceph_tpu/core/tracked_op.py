@@ -84,3 +84,15 @@ class OpTracker:
         with self._lock:
             return [op for op in self._inflight.values()
                     if op.age > self.complaint_time]
+
+    def slow_summary(self) -> dict:
+        """Compact slow-op report for the mon/mgr stat pipeline:
+        count + worst age (+ its description, for operators chasing
+        the stuck op from `ceph health detail`)."""
+        slow = self.get_slow_ops()
+        if not slow:
+            return {"count": 0, "oldest_age": 0.0, "oldest_desc": ""}
+        worst = max(slow, key=lambda op: op.age)
+        return {"count": len(slow),
+                "oldest_age": round(worst.age, 3),
+                "oldest_desc": worst.description}

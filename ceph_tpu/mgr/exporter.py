@@ -85,6 +85,27 @@ class Exporter:
                  sum(len(st.get("inconsistent_objects") or [])
                      for st in pg_stats),
                  help_="objects flagged by list-inconsistent-obj")
+            # slow-op gauges (reference ceph_healthcheck_slow_ops +
+            # per-daemon slow op counts): fed from the osd_stats each
+            # OSD reports out of its op tracker
+            osd_stats = dump.get("osd_stats") or {}
+            total_slow, worst_age = 0, 0.0
+            first = True
+            for name, st in sorted(osd_stats.items()):
+                s = st.get("slow_ops") or {}
+                count = int(s.get("count", 0))
+                age = float(s.get("oldest_age", 0.0))
+                total_slow += count
+                worst_age = max(worst_age, age)
+                emit("ceph_osd_slow_ops", count,
+                     labels={"ceph_daemon": f"osd.{name}"},
+                     help_="slow ops in flight (per OSD)"
+                     if first else None)
+                first = False
+            emit("ceph_cluster_slow_ops", total_slow,
+                 help_="slow ops in flight (cluster total)")
+            emit("ceph_cluster_slow_ops_oldest_age_seconds", worst_age,
+                 help_="age of the oldest slow op")
 
         for daemon, path in sorted(self.asok_paths.items()):
             try:

@@ -1428,6 +1428,34 @@ class HealthMonitor(PaxosService):
                 checks.append({"code": "OSD_DOWN",
                                "summary": f"{len(down)} osds down",
                                "detail": [f"osd.{o} down" for o in down]})
+            # SLOW_OPS: OSDs report op_tracker slow-op counts in their
+            # osd_stats (reference health check of the same name) —
+            # per-OSD attribution + the worst blocked age cluster-wide
+            slow_osds = []
+            now = time.time()
+            for o, st in sorted(self.mon.pgmap.osd_stats.items()):
+                if now - st.get("stamp", 0.0) > PG_STALE_GRACE and \
+                        not (o < m.max_osd and m.is_up(o)):
+                    continue    # dead OSD's last report: not "slow"
+                s = st.get("slow_ops") or {}
+                if int(s.get("count", 0)) > 0:
+                    slow_osds.append((o, int(s["count"]),
+                                      float(s.get("oldest_age", 0.0)),
+                                      s.get("oldest_desc", "")))
+            if slow_osds:
+                n_slow = sum(c for _o, c, _a, _d in slow_osds)
+                worst = max(a for _o, _c, a, _d in slow_osds)
+                checks.append({
+                    "code": "SLOW_OPS",
+                    "summary": f"{n_slow} slow ops, oldest one "
+                               f"blocked for {worst:.0f} sec, "
+                               f"daemons [" + ",".join(
+                                   f"osd.{o}" for o, _c, _a, _d
+                                   in slow_osds) + "] have slow ops",
+                    "detail": [
+                        f"osd.{o}: {c} slow ops, oldest {a:.1f}s"
+                        + (f" ({d})" if d else "")
+                        for o, c, a, d in slow_osds]})
             flags_set = sorted(n for n, bit in CLUSTER_FLAGS.items()
                                if m.flags & bit)
             if flags_set:

@@ -14,6 +14,7 @@ equivalent".  This messenger is the control plane (maps, peering,
 heartbeats, client ops).
 """
 
+from .fault import FaultInjector, FaultRule  # noqa: F401
 from .message import (MSG_REGISTRY, Message, MGenericPing,  # noqa: F401
                       MGenericReply, register_message)
 from .messenger import (Connection, Dispatcher, EntityAddr,  # noqa: F401

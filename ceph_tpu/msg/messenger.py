@@ -35,15 +35,14 @@ from __future__ import annotations
 import asyncio
 import json
 import os
-import random
 import struct
 import threading
-import time
 import zlib
 from dataclasses import dataclass
 
 from ..core.auth import AuthError, CryptoKey, ServiceVerifier
 from ..core.encoding import DecodeError
+from .fault import DELAY, DROP, DUP, PARTITION, REORDER, FaultInjector
 from .message import Message
 
 BANNER = b"ceph-tpu msgr2\n"
@@ -127,6 +126,27 @@ class Connection:
             # a dead peer must not grow an unbounded backlog; senders
             # (heartbeats, elections) retry at the protocol level
             raise ConnectionError("send queue full (peer unreachable?)")
+        faults = self.msgr.faults
+        if faults.active:
+            dst = self.peer_name or (
+                f"{self.peer_addr.host}:{self.peer_addr.port}"
+                if self.peer_addr else "?")
+            d = faults.decide(self.msgr.entity_name, dst)
+            if d.verdict in (DROP, PARTITION):
+                return           # lost on the wire; protocols retry
+            if d.verdict in (DELAY, REORDER):
+                # late enqueue: anything sent inside the hold window
+                # overtakes this message (seq is assigned at dequeue,
+                # so the scramble is a real logical-order inversion)
+                self.msgr._call_soon(
+                    self.msgr._loop.call_later, d.hold_s,
+                    self._send_q.put_nowait, msg)
+                return
+            if d.verdict == DUP:
+                # enqueue twice: the second pass gets a fresh seq, so
+                # the session-layer dedup does NOT absorb it and the
+                # application sees a true duplicate delivery
+                self.msgr._call_soon(self._send_q.put_nowait, msg)
         self.msgr._call_soon(self._send_q.put_nowait, msg)
 
     def mark_down(self):
@@ -151,7 +171,8 @@ class Connection:
         if w is None:
             raise ConnectionError("not connected")
         if self.msgr.inject_socket_failures:
-            if random.randrange(self.msgr.inject_socket_failures) == 0:
+            if self.msgr.faults.socket_cut(
+                    self.msgr.inject_socket_failures):
                 # simulate a cut link: kill the transport only; session
                 # state stays for resume
                 w.transport.abort()
@@ -339,6 +360,8 @@ class Messenger:
                  session_ticket=None,
                  mode: str = "crc",
                  inject_socket_failures: int = 0,
+                 fault_injector: FaultInjector | None = None,
+                 inject_seed: int | None = None,
                  reconnect: bool = True,
                  reconnect_backoff_max: float = 2.0,
                  max_queued: int = 4096):
@@ -367,6 +390,10 @@ class Messenger:
         self.session_ticket = session_ticket
         self.keyring_key = keyring_key
         self.inject_socket_failures = inject_socket_failures
+        # every injection decision (socket cuts included) routes
+        # through this seeded policy table — the deterministic-replay
+        # contract lives in msg/fault.py
+        self.faults = fault_injector or FaultInjector(seed=inject_seed)
         self.reconnect = reconnect
         self.reconnect_backoff_max = reconnect_backoff_max
         self.max_queued = max_queued

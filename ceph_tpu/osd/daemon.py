@@ -116,7 +116,14 @@ class OSDaemon(Dispatcher):
             if self.config.source_of(key) == "default":
                 self.config.set(key, val)
         self.perf = _build_osd_perf(f"osd.{whoami}")
-        self.op_tracker = OpTracker()
+        self.op_tracker = OpTracker(
+            history_size=int(self.config.get("op_history_size") or 20),
+            complaint_time=float(
+                self.config.get("op_complaint_time") or 30.0))
+        self.config.add_observer(
+            "op_complaint_time",
+            lambda _n, v: setattr(self.op_tracker, "complaint_time",
+                                  float(v)))
         self.admin_socket = AdminSocket(
             admin_socket_path
             or f"/tmp/ceph_tpu-osd.{whoami}.{os.getpid()}.asok")
@@ -124,9 +131,30 @@ class OSDaemon(Dispatcher):
         self.store = store if store is not None else MemStore(
             name=f"osd.{whoami}")
         self.auth = auth
+        # fault fabric: the messenger's injector is built from the
+        # ms_inject_* options and stays retunable while the daemon
+        # runs — `config set`/injectargs feed the observers below,
+        # `fault *` admin commands poke the policy table directly
+        from ..msg.fault import injector_from_config
         self.msgr = Messenger(
             f"osd.{whoami}",
+            inject_socket_failures=int(
+                self.config.get("ms_inject_socket_failures") or 0),
+            fault_injector=injector_from_config(self.config),
             **(auth.msgr_kwargs(f"osd.{whoami}") if auth else {}))
+        self.config.add_observer(
+            "ms_inject_socket_failures",
+            lambda _n, v: setattr(self.msgr, "inject_socket_failures",
+                                  int(v)))
+        for _opt, _knob in (("ms_inject_drop_prob", "drop"),
+                            ("ms_inject_delay_prob", "delay"),
+                            ("ms_inject_delay_ms", "delay_ms"),
+                            ("ms_inject_dup_prob", "dup"),
+                            ("ms_inject_reorder_prob", "reorder"),
+                            ("ms_inject_reorder_ms", "reorder_ms")):
+            self.config.add_observer(
+                _opt, lambda _n, v, _k=_knob: self.msgr.faults.set_rule(
+                    "*", "*", **{_k: float(v)}))
         self.msgr.add_dispatcher(self)
         self.monc = MonClient(monmap, entity=f"osd.{whoami}",
                               auth=auth)
@@ -191,6 +219,10 @@ class OSDaemon(Dispatcher):
         )[1], "set a config override")
         a.register("config help", lambda c: self.config.help(c["key"]),
                    "option metadata")
+        a.register("injectargs", lambda c: (
+            self.config.injectargs(c.get("args", "")),
+            {"success": self.config.diff()})[1],
+            "apply '--key value ...' runtime overrides")
         from ..core.mempool import dump_mempools
         a.register("dump_mempools", lambda c: dump_mempools(),
                    "per-pool live allocation accounting")
@@ -199,6 +231,26 @@ class OSDaemon(Dispatcher):
             "num_pgs": len(self.pgs),
             "state": "active" if self.running else "stopped"},
             "daemon status")
+        # fault fabric controls (handlers bind self.msgr lazily — the
+        # messenger is constructed after this registration)
+        _FAULT_KNOBS = ("drop", "delay", "delay_ms", "dup", "reorder",
+                        "reorder_ms")
+        a.register("fault show",
+                   lambda c: self.msgr.faults.describe(),
+                   "dump fault-injection policy table + seed")
+        a.register("fault set", lambda c: self.msgr.faults.set_rule(
+            c.get("src", "*"), c.get("dst", "*"),
+            **{k: float(v) for k, v in c.items()
+               if k in _FAULT_KNOBS}).to_dict(),
+            "set per-peer-pair fault probabilities")
+        a.register("fault partition", lambda c: (
+            self.msgr.faults.partition(c["dst"], c.get("src", "*")),
+            {"partitioned": f"{c.get('src', '*')}>{c['dst']}"})[1],
+            "directed partition: blackhole sends to dst")
+        a.register("fault heal", lambda c: (
+            self.msgr.faults.heal(c.get("src"), c.get("dst")),
+            {"healed": True})[1],
+            "remove fault rules (all, or filtered by src/dst)")
         # SMART-style device health (reference: the OSD shells out to
         # smartctl; here synthetic counters steered by a DEV option so
         # devicehealth's scrape→predict→warn pipeline is testable).
@@ -739,17 +791,29 @@ class OSDaemon(Dispatcher):
         flight etc.) just waits for the next tick.  Never-scrubbed PGs
         age from their creation stamp, so a restart doesn't stampede
         every PG at once."""
-        if not pg.is_primary or pg.state != "active" or pg.scrubbing:
+        # active+clean is the steady state a periodic scrub targets
+        if not pg.is_primary or not pg.state.startswith("active") \
+                or pg.scrubbing:
             return
+        # operator flags gate PERIODIC scrubs only (reference
+        # OSD::sched_scrub): noscrub stops shallow, nodeep-scrub stops
+        # deep; an explicit `ceph pg scrub` still rides
+        # MOSDScrubCommand → _start_scrub_or_retry and overrides both
+        from .osdmap import CLUSTER_FLAGS
+        flags = self.osdmap.flags
+        noscrub = bool(flags & CLUSTER_FLAGS["noscrub"])
+        nodeep = bool(flags & CLUSTER_FLAGS["nodeep-scrub"])
         now = time.time()
         floor = pg._scrub_stamp_floor
         deep_iv = float(self.config.get("osd_deep_scrub_interval"))
-        if deep_iv > 0 and now - max(pg.last_deep_scrub, floor) >= deep_iv:
+        if deep_iv > 0 and not nodeep and \
+                now - max(pg.last_deep_scrub, floor) >= deep_iv:
             if pg.start_scrub(deep=True):
                 self.perf.inc("scrubs_scheduled")
             return
         iv = float(self.config.get("osd_scrub_interval"))
-        if iv > 0 and now - max(pg.last_scrub, floor) >= iv:
+        if iv > 0 and not noscrub and \
+                now - max(pg.last_scrub, floor) >= iv:
             if pg.start_scrub(deep=False):
                 self.perf.inc("scrubs_scheduled")
 
@@ -803,7 +867,12 @@ class OSDaemon(Dispatcher):
                            # IOPS (reference osd_stat_t op counters)
                            "op": self.perf.get("op"),
                            "op_w": self.perf.get("op_w"),
-                           "op_r": self.perf.get("op_r")}))
+                           "op_r": self.perf.get("op_r"),
+                           # slow-op attribution: the mon's SLOW_OPS
+                           # health check and the exporter gauges are
+                           # fed from here (reference osd_stat_t
+                           # num_slow_ops via the mgr report)
+                           "slow_ops": self.op_tracker.slow_summary()}))
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:

@@ -197,6 +197,19 @@ class MWatchNotifyAck(_JsonMessage):
 
 
 @register_message
+class MOSDBackoff(_JsonMessage):
+    """Primary → client: RADOS backoff (reference
+    ``src/messages/MOSDBackoff.h``).  ``op`` is "block" or "unblock";
+    a blocked client parks every op targeting (this OSD, this PG) and
+    neither resends nor submits new ones until the matching unblock
+    (or a map advance re-targets the PG).  Sent instead of silently
+    queueing when the PG cannot serve (not active / below min_size) —
+    the server-directed alternative to a client resend storm."""
+    TYPE = 71
+    FIELDS = ("pgid", "id", "op", "epoch")
+
+
+@register_message
 class MOSDPGBackfillPrune(_JsonMessage):
     """Primary → backfill target: the authoritative object list; the
     target removes anything extraneous (reference backfill's

@@ -40,7 +40,8 @@ CLUSTER_FLAGS = {
     "nodown": 1 << 1,    # suppress marking OSDs down
     "noout": 1 << 2,     # suppress auto-out (stored; nothing
                          # auto-outs at this scale yet)
-    "noscrub": 1 << 3,   # suppress scheduled scrubs
+    "noscrub": 1 << 3,   # suppress scheduled (shallow) scrubs
+    "nodeep-scrub": 1 << 4,  # suppress scheduled deep scrubs
 }
 
 # osd state bits (reference CEPH_OSD_EXISTS/UP)

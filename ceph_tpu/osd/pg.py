@@ -102,6 +102,12 @@ class PG:
         # notify back on activate); _resend_activation skips them
         self.peer_activated: set[int] = set()
         self.waiting_for_active: list = []
+        # RADOS backoff sessions (reference PG::Backoff): client
+        # connections we told to block for this PG — released (unblock
+        # sent) on activation; keyed by connection identity so one
+        # block per session no matter how many ops raced in
+        self.backoffs: dict[int, tuple[object, int]] = {}
+        self._backoff_id = 0
         self._promote_waiters: dict[str, list] = {}
         self.waiting_for_object: dict[str, list] = {}
         self._queried: set[int] = set()
@@ -597,6 +603,7 @@ class PG:
                         self.peer_missing[o] = dict(inv)
         self.state = "active"
         self.daemon.store.queue_transaction(self._persist_meta())
+        self.release_backoffs()
         waiters, self.waiting_for_active = self.waiting_for_active, []
         for fn in waiters:
             fn()
@@ -855,7 +862,11 @@ class PG:
             return
         if self.state in ("peering", "down", "reset", "stray",
                           "incomplete"):
-            self.waiting_for_active.append(lambda: self.do_op(msg))
+            # RADOS backoff: tell the client to park the op instead of
+            # queueing server-side / letting it resend blindly — the
+            # unblock on activation releases it (reference
+            # PrimaryLogPG::do_request backoff path)
+            self._send_backoff(msg)
             return
         reqid = f"{msg.client}:{msg.tid}"
         dup = self.log.find_reqid(reqid)
@@ -900,6 +911,14 @@ class PG:
             self.waiting_for_active.append(lambda: self.do_op(msg))
             return
         is_write = any(op.get("op") in _WRITE_OPS for op in msg.ops)
+        if is_write and \
+                len(self.acting_live()) < max(1, self.pool.min_size):
+            # too few live members to make the write durable: block
+            # the client until peering resolves it (the map advance
+            # that shrank acting_live will re-peer us into down/
+            # incomplete, or recovery restores min_size and unblocks)
+            self._send_backoff(msg)
+            return
         if is_write and self.pool.full and \
                 not all(op.get("op") == "delete" for op in msg.ops):
             # quota exceeded (reference: FULL_QUOTA pools reply
@@ -925,6 +944,60 @@ class PG:
         except ValueError as e:
             self._reply(msg, -22, str(e))            # EINVAL
 
+    def _send_backoff(self, msg: M.MOSDOp):
+        """Block the client session for this PG instead of queueing
+        the op: it parks client-side and comes back on unblock (or a
+        map advance).  Re-sends the block for an already-blocked
+        session — the injector can drop the first copy, and a silent
+        drop here would strand the client's periodic resends forever."""
+        con = getattr(msg, "connection", None)
+        if con is None:
+            # internal re-entry with no session: server-side queueing
+            # is the only option left
+            self.waiting_for_active.append(lambda: self.do_op(msg))
+            return
+        # the client holds the op now; keeping it in the tracker
+        # would count a parked (not stuck) op as slow forever
+        self.finish_tracked(msg, "backoff")
+        key = id(con)
+        if key in self.backoffs:
+            _, bid = self.backoffs[key]
+        else:
+            self._backoff_id += 1
+            bid = self._backoff_id
+            self.backoffs[key] = (con, bid)
+        try:
+            con.send_message(M.MOSDBackoff(
+                pgid=str(self.pgid), id=bid, op="block",
+                epoch=self.daemon.osdmap.epoch))
+        except ConnectionError:
+            self.backoffs.pop(key, None)
+            self.waiting_for_active.append(lambda: self.do_op(msg))
+
+    def release_backoffs(self):
+        """Unblock every backed-off session (on activation)."""
+        backoffs, self.backoffs = self.backoffs, {}
+        for con, bid in backoffs.values():
+            try:
+                con.send_message(M.MOSDBackoff(
+                    pgid=str(self.pgid), id=bid, op="unblock",
+                    epoch=self.daemon.osdmap.epoch))
+            except ConnectionError:
+                pass    # client re-targets on its next map instead
+
+    @staticmethod
+    def finish_tracked(msg, event: str):
+        """Finish a message's TrackedOp (idempotent).  Every path
+        that stops working on an op — reply, backoff handoff,
+        interval-change drop — must come through here, or the tracker
+        counts the op as slow forever."""
+        tracked = getattr(msg, "tracked", None)
+        if tracked is not None:
+            msg.tracked = None
+            tracked.mark_event(event)
+            tracked.finish()
+        return tracked
+
     def _reply(self, msg: M.MOSDOp, rc: int, outs: str = "",
                results=None, version=ZERO):
         call_results = getattr(msg, "_call_results", None)
@@ -933,11 +1006,8 @@ class PG:
             for idx, res in call_results.items():
                 if idx < len(results):
                     results[idx] = res
-        tracked = getattr(msg, "tracked", None)
+        tracked = self.finish_tracked(msg, "replied")
         if tracked is not None:
-            msg.tracked = None
-            tracked.mark_event("replied")
-            tracked.finish()
             self.daemon.perf.tinc("op_latency", tracked.age)
         try:
             msg.connection.send_message(M.MOSDOpReply(
@@ -1137,13 +1207,15 @@ class PG:
         deep=True (the default) reads every payload and verifies
         CRC-32C digests — plus the EC parity recheck on the primary;
         deep=False is the shallow pass: sizes/versions/presence only,
-        no data reads."""
-        from .osdmap import CLUSTER_FLAGS
+        no data reads.
+
+        noscrub/nodeep-scrub do NOT gate here: the flags suppress the
+        periodic scheduler (OSD._maybe_schedule_scrub) only, while an
+        operator `ceph pg scrub` overrides them — reference
+        OSD::sched_scrub vs the forced-scrub path."""
         busy = (self.backend._inflight
                 or getattr(self.backend, "_rmw", None)
                 or getattr(self.backend, "_reads", None))
-        if self.daemon.osdmap.flags & CLUSTER_FLAGS["noscrub"]:
-            return False    # operator suppressed scrubbing
         if not self.is_primary or not self.state.startswith("active") \
                 or self.scrubbing or busy:
             return False
@@ -1265,6 +1337,11 @@ class ReplicatedBackend:
         self._inflight: dict[str, dict] = {}   # reqid → waiting state
 
     def on_change(self):
+        # cross-interval repops die here and their clients resend
+        # against the new interval — finish the tracked ops, or the
+        # dropped originals count as slow ops forever
+        for st in self._inflight.values():
+            self.pg.finish_tracked(st.get("msg"), "reset")
         self._inflight.clear()
 
     # -- writes ------------------------------------------------------------
@@ -1826,6 +1903,12 @@ class ECBackend:
         return self._engine
 
     def on_change(self):
+        # see ReplicatedBackend.on_change: dropped repops must not
+        # linger in the op tracker
+        for st in self._inflight.values():
+            self.pg.finish_tracked(st.get("msg"), "reset")
+        for st in self._reads.values():
+            self.pg.finish_tracked(st.get("msg"), "reset")
         self._inflight.clear()
         self._reads.clear()
         self._rmw.clear()

@@ -55,16 +55,30 @@ class Completion:
 class Rados:
     """Cluster handle (reference ``librados::Rados``)."""
 
-    def __init__(self, monmap, name: str = "client.admin", auth=None):
+    def __init__(self, monmap, name: str = "client.admin", auth=None,
+                 config=None):
         self.monmap = monmap
         self.name = name
         self.auth = auth
+        # optional ConfigProxy: carries the objecter resend/backoff
+        # knobs (objecter_resend_*, objecter_backoff_expire)
+        self.config = config
         self.monc = MonClient(monmap, entity=name, auth=auth)
         self.objecter: Objecter | None = None
 
     def connect(self, timeout: float = 15.0):
+        kw = {}
+        if self.config is not None:
+            kw = {"resend_interval": float(
+                      self.config.get("objecter_resend_interval")),
+                  "resend_max": float(
+                      self.config.get("objecter_resend_max")),
+                  "resend_jitter": float(
+                      self.config.get("objecter_resend_jitter")),
+                  "backoff_expire": float(
+                      self.config.get("objecter_backoff_expire"))}
         self.objecter = Objecter(self.monmap, entity=self.name,
-                                 auth=self.auth)
+                                 auth=self.auth, **kw)
         self.objecter.wait_for_osdmap(1, timeout)
         return self
 

@@ -17,6 +17,7 @@ Reference behavior re-created (``src/osdc/Objecter.{h,cc}``; SURVEY.md
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 
@@ -29,7 +30,8 @@ from ..tools.osdmaptool import osdmap_from_dict
 
 class _Op:
     __slots__ = ("tid", "pool", "oid", "ops", "on_reply", "pgid",
-                 "target_osd", "attempts", "submitted", "direct")
+                 "target_osd", "attempts", "submitted", "direct",
+                 "next_resend", "resend_delay")
 
     def __init__(self, tid, pool, oid, ops, on_reply, direct=False):
         self.tid = tid
@@ -42,11 +44,80 @@ class _Op:
         self.attempts = 0
         self.submitted = time.monotonic()
         self.direct = direct        # skip cache-tier overlay redirect
+        # exponential-backoff resend schedule (reset on map advance)
+        self.next_resend = 0.0
+        self.resend_delay = 0.0
+
+
+class BackoffRegistry:
+    """Client-side mirror of the OSDs' per-PG backoffs (reference
+    ``Objecter::OSDSession`` backoff map).
+
+    Keyed ``(osd, pgid_str)``.  An entry parks every op targeting that
+    (OSD, PG): ``_send_op`` and the resend ticker skip parked ops, so
+    a wounded PG sees zero traffic instead of a resend storm.  Entries
+    die three ways: the OSD's unblock, a map advance past the entry's
+    epoch (the PG re-targets), or the safety expiry — the block/
+    unblock ride the same faulty network as everything else, so a
+    lost unblock must not strand ops forever.
+    """
+
+    def __init__(self, expire_s: float = 10.0):
+        self.expire_s = expire_s
+        self._entries: dict[tuple[int, str], dict] = {}
+
+    def add(self, osd: int, pgid: str, bid, epoch: int) -> bool:
+        """→ True if this (osd, pg) was not already blocked."""
+        fresh = (osd, pgid) not in self._entries
+        self._entries[(osd, pgid)] = {
+            "id": bid, "epoch": epoch or 0,
+            "since": time.monotonic()}
+        return fresh
+
+    def remove(self, osd: int, pgid: str, bid=None) -> bool:
+        e = self._entries.get((osd, pgid))
+        if e is None:
+            return False
+        if bid is not None and e["id"] != bid:
+            return False    # stale unblock from an older block cycle
+        del self._entries[(osd, pgid)]
+        return True
+
+    def blocked(self, osd: int, pgid) -> bool:
+        e = self._entries.get((osd, str(pgid)))
+        if e is None:
+            return False
+        if time.monotonic() - e["since"] > self.expire_s:
+            # safety expiry: the unblock may have been lost on the
+            # wire — resume (slow) resends rather than hang forever
+            del self._entries[(osd, str(pgid))]
+            return False
+        return True
+
+    def prune(self, epoch: int) -> list[tuple[int, str]]:
+        """Map advance: drop backoffs registered under older epochs —
+        the op re-targets against the new map (reference: backoffs are
+        per past-interval)."""
+        dead = [k for k, e in self._entries.items()
+                if e["epoch"] < epoch]
+        for k in dead:
+            del self._entries[k]
+        return dead
+
+    def clear_osd(self, osd: int):
+        for k in [k for k in self._entries if k[0] == osd]:
+            del self._entries[k]
+
+    def count(self) -> int:
+        return len(self._entries)
 
 
 class Objecter(Dispatcher):
     def __init__(self, monmap, entity: str = "client.objecter", *,
-                 resend_interval: float = 2.0, auth=None):
+                 resend_interval: float = 2.0,
+                 resend_max: float = 16.0,
+                 resend_jitter: float = 0.25,
+                 backoff_expire: float = 10.0, auth=None):
         # a per-session nonce joins the entity name in every reqid:
         # two sessions of the same client name must never collide in
         # the OSDs' dup-op log (the reference's osd_reqid_t carries
@@ -81,6 +152,12 @@ class Objecter(Dispatcher):
         # reqid dup detection (reference: Objecter op resend +
         # osd_op_complaint/backoff machinery)
         self._resend_interval = resend_interval
+        self._resend_max = resend_max
+        self._resend_jitter = resend_jitter
+        self._rng = random.Random()
+        # server-directed backoffs (MOSDBackoff): ops targeting a
+        # blocked (osd, pg) park here instead of resending
+        self.backoffs = BackoffRegistry(expire_s=backoff_expire)
         self._stop = threading.Event()
         self._ticker = threading.Thread(
             target=self._resend_loop, name=f"{entity}-resend",
@@ -95,13 +172,37 @@ class Objecter(Dispatcher):
         primary can no longer complete it)."""
         return not any(o.get("op") == "notify" for o in op.ops)
 
+    def _next_resend(self, op: _Op, now: float):
+        """Advance the op's exponential-backoff resend schedule:
+        delay doubles per periodic resend up to resend_max, with
+        ±jitter so a wounded cluster's retries decorrelate instead of
+        arriving in fixed-period volleys."""
+        op.resend_delay = min(
+            max(op.resend_delay, self._resend_interval) * 2,
+            self._resend_max)
+        spread = 1.0 + self._resend_jitter * (
+            2.0 * self._rng.random() - 1.0)
+        op.next_resend = now + op.resend_delay * spread
+
+    def _reset_resend(self, op: _Op, now: float | None = None):
+        """New information arrived (map advance, unblock, reset):
+        resend promptly again and restart the backoff ramp."""
+        now = time.monotonic() if now is None else now
+        op.resend_delay = self._resend_interval
+        op.next_resend = now + self._resend_interval
+
     def _resend_loop(self):
-        while not self._stop.wait(self._resend_interval):
+        # tick finer than the base interval: backoff deadlines and
+        # expiring server backoffs land between interval multiples
+        tick = min(0.25, self._resend_interval / 2)
+        while not self._stop.wait(tick):
             now = time.monotonic()
             with self.lock:
                 for op in list(self.inflight.values()):
-                    if now - op.submitted <= self._resend_interval:
+                    if now < op.next_resend:
                         continue
+                    if self.backoffs.blocked(op.target_osd, op.pgid):
+                        continue    # parked: the server said stop
                     pgid, primary = self._calc_target(
                         self._effective_pool(op.pool, op.direct),
                         op.oid)
@@ -109,6 +210,7 @@ class Objecter(Dispatcher):
                              or primary != op.target_osd)
                     if moved or self._idempotent(op):
                         op.submitted = now
+                        self._next_resend(op, now)
                         self._send_op(op)
 
     def wait_for_osdmap(self, min_epoch: int = 1, timeout: float = 10.0):
@@ -131,6 +233,10 @@ class Objecter(Dispatcher):
             if epoch <= self.osdmap.epoch:
                 return
             self.osdmap = osdmap_from_dict(map_dict)
+            # a map advance releases backoffs from older epochs: the
+            # blocked PG re-targets under the new map (and the OSD
+            # re-blocks us if it still can't serve)
+            self.backoffs.prune(epoch)
             # epoch-driven resend (reference Objecter::handle_osd_map
             # → _scan_requests): every in-flight op re-targets and
             # resends on a map advance — OSDs silently drop ops from
@@ -138,6 +244,7 @@ class Objecter(Dispatcher):
             # idempotent, so eager resend beats waiting for the
             # periodic ticker
             for op in list(self.inflight.values()):
+                self._reset_resend(op)      # fresh info: restart ramp
                 if self._idempotent(op):
                     self._send_op(op)       # re-targets internally
                 else:
@@ -165,6 +272,7 @@ class Objecter(Dispatcher):
             self._tid += 1
             op = _Op(self._tid, pool, oid, list(ops), on_reply,
                      direct=direct)
+            self._reset_resend(op, op.submitted)
             self.inflight[op.tid] = op
             self._send_op(op)
             return op.tid
@@ -187,6 +295,8 @@ class Objecter(Dispatcher):
         pgid, primary = self._calc_target(
             self._effective_pool(op.pool, op.direct), op.oid)
         op.pgid, op.target_osd = pgid, primary
+        if primary >= 0 and self.backoffs.blocked(primary, pgid):
+            return   # parked: released by unblock / map advance
         op.attempts += 1
         if primary < 0:
             return   # no primary this epoch: wait for the next map
@@ -227,6 +337,32 @@ class Objecter(Dispatcher):
 
     # -- replies -----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, M.MOSDBackoff):
+            peer = getattr(msg.connection, "peer_name", None) or ""
+            try:
+                osd = int(peer.rsplit(".", 1)[1])
+            except (IndexError, ValueError):
+                return True     # not an osd session; stale/garbled
+            with self.lock:
+                if msg.op == "block":
+                    self.backoffs.add(osd, msg.pgid, msg.id,
+                                      msg.epoch or 0)
+                else:
+                    if self.backoffs.remove(osd, msg.pgid, msg.id):
+                        # released: resend everything parked on this
+                        # (osd, pg) right away — non-idempotent ops
+                        # included, since a backoff means the server
+                        # dropped the op without executing it (same
+                        # unconditional-resend precedent as
+                        # ms_handle_reset)
+                        now = time.monotonic()
+                        for op in list(self.inflight.values()):
+                            if op.target_osd == osd and \
+                                    str(op.pgid) == msg.pgid:
+                                op.submitted = now
+                                self._reset_resend(op, now)
+                                self._send_op(op)
+            return True
         if isinstance(msg, M.MWatchNotify):
             # a notify fired on an object this client watches: run the
             # registered callback, ack back up the same connection
@@ -287,10 +423,15 @@ class Objecter(Dispatcher):
         with self.lock:
             victims = [o for o, (_a, c) in self._osd_cons.items()
                        if c is con]
+            now = time.monotonic()
             for o in victims:
                 del self._osd_cons[o]
+                # backoffs are per-session state on the OSD; a reset
+                # session's blocks are gone with it
+                self.backoffs.clear_osd(o)
             for op in self.inflight.values():
                 if op.target_osd in victims:
+                    self._reset_resend(op, now)
                     self._send_op(op)
 
     # -- sync convenience --------------------------------------------------

@@ -9,6 +9,10 @@
     ceph -m ... osd pool mksnap POOL SNAP | rmsnap POOL SNAP
     ceph -m ... osd pg-upmap-items PGID FROM TO [FROM TO ...]
     ceph -m ... daemon SOCK_PATH COMMAND [k=v ...]
+        (e.g. daemon <asok> injectargs args="op_complaint_time=5",
+         daemon <asok> fault show | fault set dst=osd.1 drop=0.3 |
+         fault partition dst=osd.2 | fault heal — the seeded
+         network-chaos injector, see msg/fault.py)
 
 Free-form: any unrecognized argument list is sent as
 {"prefix": "<joined words>"} — the same pass-through the reference CLI

@@ -234,10 +234,43 @@ class MiniCluster:
         self.stop()
 
     # -- clients -----------------------------------------------------------
-    def rados(self, name: str = "client.admin") -> Rados:
-        r = Rados(self.monmap, name=name, auth=self.auth).connect()
+    def rados(self, name: str = "client.admin",
+              config=None) -> Rados:
+        """config: optional ConfigProxy carrying client knobs
+        (objecter_resend_*, objecter_backoff_expire)."""
+        r = Rados(self.monmap, name=name, auth=self.auth,
+                  config=config).connect()
         self._clients.append(r)
         return r
+
+    # -- fault fabric ------------------------------------------------------
+    def partition_osds(self, a: int, b: int, *,
+                       bidirectional: bool = True):
+        """Netsplit osd.a ⇸ osd.b via their messengers' fault
+        injectors.  Directed by default semantics of the injector: a's
+        sends to b are blackholed; bidirectional=True (the usual
+        split) also installs b ⇸ a.  Heartbeats, sub-ops and peering
+        traffic all die on the partitioned edges while both daemons
+        keep talking to the mons — the classic netsplit."""
+        self.osds[a].msgr.faults.partition(f"osd.{b}")
+        if bidirectional:
+            self.osds[b].msgr.faults.partition(f"osd.{a}")
+
+    def isolate_osd(self, i: int):
+        """Partition osd.i from every OTHER osd (mon links stay up)."""
+        for j, osd in self.osds.items():
+            if j == i:
+                continue
+            self.osds[i].msgr.faults.partition(f"osd.{j}")
+            osd.msgr.faults.partition(f"osd.{i}")
+
+    def heal_netsplit(self):
+        """Remove every osd→osd partition rule installed above
+        (blanket probabilistic rules from ms_inject_* are kept)."""
+        for i, osd in self.osds.items():
+            for j in self.osds:
+                if j != i:
+                    osd.msgr.faults.heal(dst=f"osd.{j}")
 
     # -- cluster helpers ---------------------------------------------------
     def wait_for_clean(self, timeout: float = 30.0):

@@ -74,6 +74,38 @@ class TestCephCLI:
         assert rc == 0
         assert f"osd.{osd.whoami}" in json.loads(buf.getvalue())
 
+    def test_daemon_fault_and_injectargs(self, cluster):
+        """The chaos surface: `ceph daemon <asok> fault set|show|
+        partition|heal` and live `injectargs` retuning."""
+        def daemon(osd, *argv):
+            old = sys.stdout
+            sys.stdout = buf = _io.StringIO()
+            try:
+                rc = ceph_main(["daemon", osd.admin_socket.path,
+                                *argv])
+            finally:
+                sys.stdout = old
+            return rc, json.loads(buf.getvalue())
+
+        osd = next(iter(cluster.osds.values()))
+        rc, out = daemon(osd, "fault", "set", "dst=osd.1",
+                         "drop=0.25")
+        assert rc == 0 and out["drop"] == 0.25
+        rc, out = daemon(osd, "fault", "partition", "dst=osd.2")
+        assert rc == 0 and out["partitioned"] == "*>osd.2"
+        rc, out = daemon(osd, "fault", "show")
+        assert rc == 0 and out["seed"] == osd.msgr.faults.seed
+        assert out["rules"]["*>osd.1"]["drop"] == 0.25
+        assert out["rules"]["*>osd.2"]["partition"]
+        rc, out = daemon(osd, "fault", "heal")
+        assert rc == 0 and out["healed"]
+        assert not osd.msgr.faults.active
+        rc, out = daemon(osd, "injectargs",
+                         "args=--op_complaint_time=5")
+        assert rc == 0 and "op_complaint_time" in out["success"]
+        assert osd.op_tracker.complaint_time == 5.0
+        daemon(osd, "injectargs", "args=--op_complaint_time=30")
+
     def test_osd_reweight(self, cluster):
         rc, _ = _run(cluster, "osd", "reweight", "1", "0.5")
         assert rc == 0

@@ -480,3 +480,131 @@ class TestReportTimeout:
                 r.shutdown()
         finally:
             OSDMonitor.REPORT_TIMEOUT = old
+
+
+class TestDownOutMachinery:
+    """nodown/noout interplay with OSDMonitor.tick: grace-window
+    refresh under nodown (so lifting the flag never mass-expires),
+    noout auto-out suppression, and _down_since cleanup on revive.
+    Drives the tick machinery on a single leader mon with fake-booted
+    OSDs — no OSD daemons, so the report windows are entirely under
+    test control."""
+
+    def _leader_with_osds(self, n=3):
+        monmap, mons = make_cluster(1)
+        mon = mons[0]
+        assert wait_for(lambda: mon.is_leader and
+                        mon.paxos.last_committed > 0, timeout=30)
+        svc = mon.services["osdmap"]
+        with mon.lock:
+            for o in range(n):
+                svc.handle_boot(o, f"127.0.0.1:{7800 + o}")
+        assert wait_for(lambda: all(svc.osdmap.is_up(o)
+                                    for o in range(n)), timeout=30)
+        return monmap, mon, svc
+
+    def _set_flag(self, monmap, flag, on=True):
+        mc = MonClient(monmap)
+        try:
+            rc, outs, _ = mc.command(
+                {"prefix": "osd set" if on else "osd unset",
+                 "key": flag}, timeout=30)
+            assert rc == 0, outs
+        finally:
+            mc.shutdown()
+
+    def test_nodown_refreshes_windows_no_mass_expire_on_lift(self):
+        monmap, mon, svc = self._leader_with_osds(3)
+        try:
+            self._set_flag(monmap, "nodown")
+            # backdate every report window far past the timeout: with
+            # nodown set the tick must refresh them instead of marking
+            # anyone down
+            stale = time.monotonic() - svc.REPORT_TIMEOUT * 3
+            with mon.lock:
+                for o in range(3):
+                    svc._last_report[o] = stale
+            assert wait_for(lambda: all(
+                time.monotonic() - svc._last_report.get(o, 0) <
+                svc.REPORT_TIMEOUT / 2 for o in range(3)), timeout=10)
+            assert all(svc.osdmap.is_up(o) for o in range(3))
+            # lifting the flag must not mass-expire: the windows were
+            # refreshed while nodown was set, so nobody is past the
+            # timeout when normal expiry resumes
+            self._set_flag(monmap, "nodown", on=False)
+            time.sleep(1.0)     # several tick periods of normal expiry
+            assert all(svc.osdmap.is_up(o) for o in range(3))
+        finally:
+            mon.shutdown()
+
+    def test_report_timeout_still_fires_without_nodown(self):
+        """Control for the test above: the same backdating WITHOUT
+        nodown expires the window and marks the OSD down."""
+        monmap, mon, svc = self._leader_with_osds(2)
+        try:
+            with mon.lock:
+                svc._last_report[1] = \
+                    time.monotonic() - svc.REPORT_TIMEOUT - 5.0
+            assert wait_for(lambda: not svc.osdmap.is_up(1),
+                            timeout=10)
+            assert svc.osdmap.is_up(0)
+        finally:
+            mon.shutdown()
+
+    def test_noout_suppresses_auto_out_until_lifted(self):
+        from ceph_tpu.mon.monitor import OSDMonitor
+        old = OSDMonitor.DOWN_OUT_INTERVAL
+        OSDMonitor.DOWN_OUT_INTERVAL = 1.0
+        try:
+            monmap, mon, svc = self._leader_with_osds(2)
+            try:
+                self._set_flag(monmap, "noout")
+                # expire osd.1's report window so the mon marks it down
+                with mon.lock:
+                    svc._last_report[1] = \
+                        time.monotonic() - svc.REPORT_TIMEOUT - 5.0
+                assert wait_for(lambda: not svc.osdmap.is_up(1),
+                                timeout=10)
+                # well past DOWN_OUT_INTERVAL: noout must hold the
+                # OSD in (and not even start its down clock)
+                time.sleep(2.5)
+                assert not svc.osdmap.is_out(1)
+                assert 1 not in getattr(svc, "_down_since", {})
+                # lifting noout starts the clock AT the lift — no
+                # instant mass-out for time served under the flag
+                self._set_flag(monmap, "noout", on=False)
+                assert wait_for(
+                    lambda: 1 in getattr(svc, "_down_since", {}),
+                    timeout=10)
+                assert not svc.osdmap.is_out(1)
+                assert wait_for(lambda: svc.osdmap.is_out(1),
+                                timeout=10)
+            finally:
+                mon.shutdown()
+        finally:
+            OSDMonitor.DOWN_OUT_INTERVAL = old
+
+    def test_down_since_cleared_on_revive(self):
+        monmap, mon, svc = self._leader_with_osds(2)
+        try:
+            with mon.lock:
+                svc._last_report[1] = \
+                    time.monotonic() - svc.REPORT_TIMEOUT - 5.0
+            assert wait_for(lambda: not svc.osdmap.is_up(1),
+                            timeout=10)
+            # tick tracks when the down OSD's auto-out clock started
+            assert wait_for(
+                lambda: 1 in getattr(svc, "_down_since", {}),
+                timeout=10)
+            # revive: re-boot at a (new) address — tick must drop the
+            # _down_since entry so a LATER down restarts the interval
+            # from zero instead of inheriting this outage's age
+            with mon.lock:
+                svc.handle_boot(1, "127.0.0.1:7899")
+            assert wait_for(lambda: svc.osdmap.is_up(1), timeout=10)
+            assert wait_for(
+                lambda: 1 not in getattr(svc, "_down_since", {}),
+                timeout=10)
+            assert not svc.osdmap.is_out(1)
+        finally:
+            mon.shutdown()

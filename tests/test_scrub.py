@@ -182,3 +182,51 @@ class TestScrubCommand:
             assert ceph_cli.main(
                 ["-m", addr, "pg", "repair", "9.99"]) == 1
             r.shutdown()
+
+
+class TestScrubScheduler:
+    def test_flags_gate_periodic_but_not_operator(self):
+        """noscrub gates scheduled shallow scrubs, nodeep-scrub gates
+        scheduled deep scrubs; an explicit operator scrub overrides
+        both (reference OSD::sched_scrub vs the forced-scrub path)."""
+        from ceph_tpu.osd.osdmap import CLUSTER_FLAGS
+        with MiniCluster(n_mons=1, n_osds=1) as c:
+            r = c.rados()
+            r.create_pool("ss", pg_num=1, size=1)
+            io = r.open_ioctx("ss")
+            io.write_full("o", b"x")
+            c.wait_for_clean()
+            osd = c.osds[0]
+            with osd.lock:
+                pg = next(iter(osd.pgs.values()))
+            # shallow path: interval overdue, deep disabled (a
+            # single-member scrub completes inline, so the scrub
+            # STAMP is the probe, not the scrubbing flag)
+            osd.config.set("osd_scrub_interval", 1e-6)
+            osd.config.set("osd_deep_scrub_interval", 0)
+            with osd.lock:
+                osd.osdmap.flags |= CLUSTER_FLAGS["noscrub"]
+                osd._maybe_schedule_scrub(pg)
+                assert pg.last_scrub == 0.0, "noscrub ignored"
+                osd.osdmap.flags &= ~CLUSTER_FLAGS["noscrub"]
+                osd._maybe_schedule_scrub(pg)
+            deadline = time.monotonic() + 20
+            while pg.last_scrub == 0.0 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert pg.last_scrub > 0.0, \
+                "overdue shallow scrub not scheduled"
+            # deep path
+            osd.config.set("osd_scrub_interval", 0)
+            osd.config.set("osd_deep_scrub_interval", 1e-6)
+            with osd.lock:
+                osd.osdmap.flags |= CLUSTER_FLAGS["nodeep-scrub"]
+                osd._maybe_schedule_scrub(pg)
+                assert pg.last_deep_scrub == 0.0, \
+                    "nodeep-scrub ignored"
+                # operator override: both flags set, explicit scrub
+                # still starts
+                osd.osdmap.flags |= CLUSTER_FLAGS["noscrub"]
+            assert c.scrub_pg(pg.pgid, deep=True) == 0
+            assert pg.last_deep_scrub > 0.0
+            r.shutdown()
