@@ -694,6 +694,59 @@ def _robustness_leg():
     return res
 
 
+def _observability_leg():
+    """Tracing tax: ops/sec through one live cluster, span collection
+    toggled live via the tracer enable flags.  Cluster throughput
+    drifts downward as PG logs/history grow, so a sequential A-then-B
+    run conflates drift with tracing cost — instead interleave small
+    traced/untraced batches and accumulate per-mode wall time.  The
+    acceptance bar: enabled within 10%; disabled is the
+    zero-allocation path so the untraced windows ARE the ~0%
+    baseline."""
+    from ceph_tpu.vstart import MiniCluster
+
+    res = {}
+    payload = os.urandom(4096)
+    batch, rounds = 25, 12
+
+    with MiniCluster(n_mons=1, n_osds=3) as c:
+        r = c.rados()
+        r.create_pool("bench_obs", pg_num=8, size=3)
+        io = r.open_ioctx("bench_obs")
+        c.wait_for_clean()
+
+        def set_tracing(on: bool):
+            r.objecter.tracer.enabled = on
+            for osd in c.osds.values():
+                osd.tracer.enabled = on
+
+        for i in range(2 * batch):                  # JIT + conn warmup
+            io.write_full(f"w{i % 64}", payload)
+        elapsed = {False: 0.0, True: 0.0}
+        ops = {False: 0, True: 0}
+        for rnd in range(rounds):
+            # flip order each round so within-round drift cancels too
+            order = (False, True) if rnd % 2 == 0 else (True, False)
+            for traced in order:
+                set_tracing(traced)
+                t0 = time.monotonic()
+                for i in range(batch):
+                    io.write_full(f"o{i % 64}", payload)
+                elapsed[traced] += time.monotonic() - t0
+                ops[traced] += batch
+        res["untraced_ops_per_sec"] = round(
+            ops[False] / elapsed[False], 1)
+        res["traced_ops_per_sec"] = round(ops[True] / elapsed[True], 1)
+        res["spans_collected"] = sum(
+            len(o.tracer) for o in c.osds.values()) + len(
+            r.objecter.tracer)
+        res["trace_overhead_pct"] = round(
+            100.0 * (elapsed[True] - elapsed[False]) / elapsed[False],
+            1)
+        r.shutdown()
+    return res
+
+
 def _crush_leg():
     """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
     (BASELINE.md row 4, scaled to fit a bench-run budget)."""
@@ -795,6 +848,16 @@ def child_main():
             out["robustness"] = {"error": str(e)[:200]}
     else:
         out["robustness"] = {"skipped": "wall budget exhausted"}
+    print(json.dumps(dict(out, observability={"skipped": "timeout"})),
+          flush=True)
+    # tracing tax on a live cluster: two short timed windows (~10s)
+    if _budget_left() > 0.04:
+        try:
+            out["observability"] = _observability_leg()
+        except Exception as e:    # noqa: BLE001 — keep the headline
+            out["observability"] = {"error": str(e)[:200]}
+    else:
+        out["observability"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(out))
     try:
         dev = jax.devices()[0].device_kind

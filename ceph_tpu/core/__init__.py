@@ -5,8 +5,11 @@ Reference counterparts: ``src/include/buffer.h`` (bufferlist),
 ``src/common/config*`` (typed options), ``src/log/`` (subsystem log),
 ``src/common/perf_counters.*``, ``src/common/Formatter.*``,
 ``src/common/Throttle/Timer/Finisher``, ``src/common/admin_socket.*``,
-``src/common/TrackedOp.*``.
+``src/common/TrackedOp.*``, ``src/common/tracer.cc`` (op tracing),
+``src/common/LogClient.cc`` (cluster log).
 """
 
 from .buffer import BufferList, BufferPtr  # noqa: F401
 from .encoding import Decoder, Encoder  # noqa: F401
+from .log_client import LogClient  # noqa: F401
+from .tracer import Span, Tracer, chrome_trace  # noqa: F401

@@ -10,6 +10,7 @@ built-ins (`help`, `version`, `perf dump`, `config show/set`,
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import socket
@@ -18,6 +19,15 @@ import threading
 from typing import Callable
 
 Handler = Callable[[dict], object]   # cmd dict -> JSON-serializable
+
+# pid alone is not enough to keep paths distinct: two MiniClusters in
+# one process would bind the same <name>.<pid>.asok and the second
+# unlinks the first's socket out from under it
+_seq = itertools.count()
+
+
+def default_path(name: str) -> str:
+    return f"/tmp/ceph_tpu-{name}.{os.getpid()}.{next(_seq)}.asok"
 
 
 class AdminSocket:

@@ -160,4 +160,11 @@ def build_options() -> list[Option]:
         Option("op_complaint_time", float, 30.0,
                "slow-op warning age (s)"),
         Option("op_history_size", int, 20, "completed ops kept"),
+        Option("osd_op_history_duration", float, 600.0,
+               "drop historic ops older than this (s)", min=0.0),
+        # -- tracing ------------------------------------------------------
+        Option("jaeger_tracing_enable", bool, False,
+               "collect per-op spans across daemons"),
+        Option("tracer_ring_size", int, 4096,
+               "finished spans kept per daemon", min=1),
     ]

@@ -104,6 +104,12 @@ class PerfCounters:
                 out[c.name] = c.value
         return {self.name: out}
 
+    def dump_histograms(self) -> dict:
+        """HISTOGRAM counters only (reference `perf histogram dump`)."""
+        return {self.name: {c.name: c.hist.dump()
+                            for c in self._counters.values()
+                            if c.kind == HISTOGRAM}}
+
     def schema(self) -> dict:
         return {self.name: {c.name: {"type": c.kind, "desc": c.desc}
                             for c in self._counters.values()}}

@@ -1,0 +1,183 @@
+"""Per-daemon span collector (reference ``src/common/tracer.cc``).
+
+The reference links Jaeger/OpenTelemetry and attaches blkin-style op
+traces to every layer of the op path.  This reproduction keeps the
+Dapper model — a span is ``(trace_id, span_id, parent_id, name,
+start, duration, tags, events, daemon)`` — but collects spans into an
+in-process ring per daemon instead of shipping them to an agent.
+
+Cost model: when tracing is disabled ``Tracer.start_span`` returns
+``None`` without allocating anything, so every call site guards with
+``if span is not None`` and the disabled op path stays span-free.
+Context rides the message JSON as a two-key dict
+(``{"t": trace_id, "s": span_id}``) — the compact analogue of the
+trace/span id pair the reference packs into the message header.
+
+Spans use ``time.monotonic()`` for start/duration; all daemons of a
+``MiniCluster`` share one process, so starts are directly comparable
+and ``chrome_trace`` can emit absolute microsecond timestamps for
+chrome://tracing without clock alignment.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import uuid
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One timed operation; finish() files it into the tracer ring."""
+
+    __slots__ = ("_tracer", "trace_id", "span_id", "parent_id", "name",
+                 "daemon", "start", "duration", "tags", "events")
+
+    def __init__(self, tracer: "Tracer", trace_id: str, span_id: str,
+                 parent_id: str | None, name: str,
+                 tags: dict | None = None):
+        self._tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.daemon = tracer.daemon
+        self.start = time.monotonic()
+        self.duration: float | None = None
+        self.tags = dict(tags) if tags else {}
+        self.events: list = []          # [offset_s, name] pairs
+
+    def set_tag(self, key: str, value) -> None:
+        self.tags[key] = value
+
+    def event(self, name: str) -> None:
+        """Point-in-time annotation (mark_event / resend / backoff)."""
+        self.events.append([time.monotonic() - self.start, name])
+
+    def ctx(self) -> dict:
+        """Wire form carried in message fields."""
+        return {"t": self.trace_id, "s": self.span_id}
+
+    def finish(self) -> None:
+        if self.duration is not None:       # idempotent
+            return
+        self.duration = time.monotonic() - self.start
+        self._tracer._finish(self)
+
+    def dump(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "daemon": self.daemon,
+            "start": self.start,
+            "duration": self.duration,
+            "tags": dict(self.tags),
+            "events": [list(e) for e in self.events],
+        }
+
+
+class Tracer:
+    """Per-daemon collector: bounded ring of finished spans.
+
+    ``perf``, when attached, receives a
+    ``tinc("<layer>_span_duration", dur)`` per finished span keyed by
+    the span's ``layer`` tag — the per-layer time-avg counters the
+    exporter scrapes.  Unknown counter names are ignored so callers
+    can tag freely.
+    """
+
+    def __init__(self, daemon: str = "", ring_size: int = 4096,
+                 enabled: bool = False, perf=None):
+        self.daemon = daemon
+        self.enabled = bool(enabled)
+        self.perf = perf
+        self._spans: collections.deque = collections.deque(
+            maxlen=max(1, int(ring_size)))
+        self._lock = threading.Lock()
+
+    # -- span lifecycle -------------------------------------------------
+
+    def start_span(self, name: str, parent=None,
+                   tags: dict | None = None) -> Span | None:
+        """New span, or None (no allocation) when tracing is off.
+
+        ``parent`` may be a live ``Span``, a wire ctx dict
+        (``{"t":..,"s":..}``), or None to root a fresh trace.
+        """
+        if not self.enabled:
+            return None
+        if isinstance(parent, Span):
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif isinstance(parent, dict) and parent.get("t"):
+            trace_id, parent_id = parent["t"], parent.get("s")
+        else:
+            trace_id, parent_id = _new_id(), None
+        return Span(self, trace_id, _new_id(), parent_id, name, tags)
+
+    def _finish(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+        perf = self.perf
+        if perf is not None:
+            layer = span.tags.get("layer", "op")
+            try:
+                perf.tinc(f"{layer}_span_duration", span.duration)
+            except KeyError:
+                pass                    # layer without a counter
+
+    # -- inspection -----------------------------------------------------
+
+    def dump(self) -> list[dict]:
+        with self._lock:
+            spans = list(self._spans)
+        return [s.dump() for s in spans]
+
+    def spans_for(self, trace_id: str) -> list[dict]:
+        return [d for d in self.dump() if d["trace_id"] == trace_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def __len__(self) -> int:
+        return len(self._spans)
+
+
+def chrome_trace(spans: list[dict]) -> dict:
+    """Chrome trace_event JSON for chrome://tracing / Perfetto.
+
+    ``spans`` are ``Span.dump()`` dicts (typically from
+    ``MiniCluster.collect_trace``).  Each daemon becomes a pid with a
+    process_name metadata record; spans become complete ("X") events
+    with microsecond ts/dur on the shared monotonic clock.
+    """
+    daemons = sorted({s.get("daemon") or "?" for s in spans})
+    pids = {d: i + 1 for i, d in enumerate(daemons)}
+    events: list[dict] = [
+        {"ph": "M", "name": "process_name", "pid": pids[d], "tid": 0,
+         "args": {"name": d}}
+        for d in daemons
+    ]
+    for s in spans:
+        args = {"trace_id": s["trace_id"], "span_id": s["span_id"],
+                "parent_id": s["parent_id"], **s.get("tags", {})}
+        if s.get("events"):
+            args["events"] = [f"+{off * 1e3:.3f}ms {name}"
+                              for off, name in s["events"]]
+        events.append({
+            "ph": "X",
+            "name": s["name"],
+            "cat": s.get("tags", {}).get("layer", "op"),
+            "pid": pids[s.get("daemon") or "?"],
+            "tid": 1,
+            "ts": round(s["start"] * 1e6, 3),
+            "dur": round((s["duration"] or 0.0) * 1e6, 3),
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

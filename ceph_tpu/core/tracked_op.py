@@ -22,13 +22,18 @@ class TrackedOp:
         self.initiated_at = time.monotonic()
         self.events: list[tuple[float, str]] = [(0.0, "initiated")]
         self.completed_at: float | None = None
+        self.span = None        # tracer.Span when tracing is on
 
     def mark_event(self, name: str):
         self.events.append((time.monotonic() - self.initiated_at, name))
+        if self.span is not None:
+            self.span.event(name)
 
     def finish(self):
         self.mark_event("done")
         self.completed_at = time.monotonic()
+        if self.span is not None:
+            self.span.finish()
         self._tracker._complete(self)
 
     @property
@@ -48,13 +53,15 @@ class TrackedOp:
 
 class OpTracker:
     def __init__(self, history_size: int = 20,
-                 complaint_time: float = 30.0):
+                 complaint_time: float = 30.0,
+                 history_duration: float = 600.0):
         self._inflight: dict[int, TrackedOp] = {}
         self._history: collections.deque[TrackedOp] = collections.deque(
             maxlen=history_size)
         self._seq = 0
         self._lock = threading.Lock()
         self.complaint_time = complaint_time
+        self.history_duration = history_duration
 
     def create_request(self, desc: str) -> TrackedOp:
         op = TrackedOp(self, desc)
@@ -68,6 +75,17 @@ class OpTracker:
         with self._lock:
             self._inflight.pop(op._id, None)
             self._history.append(op)
+            self._prune_locked()
+
+    def _prune_locked(self):
+        """Drop history entries completed longer ago than
+        ``history_duration`` (reference osd_op_history_duration)."""
+        if self.history_duration <= 0:
+            return
+        horizon = time.monotonic() - self.history_duration
+        while self._history and \
+                (self._history[0].completed_at or 0.0) < horizon:
+            self._history.popleft()
 
     # -- introspection (admin socket commands) -----------------------------
     def dump_ops_in_flight(self) -> dict:
@@ -77,7 +95,18 @@ class OpTracker:
 
     def dump_historic_ops(self) -> dict:
         with self._lock:
+            self._prune_locked()
             ops = [op.dump() for op in self._history]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops_by_duration(self) -> dict:
+        """History sorted longest-duration first (reference
+        ``dump_historic_ops_by_duration``)."""
+        with self._lock:
+            self._prune_locked()
+            ops = sorted(self._history, key=lambda op: op.age,
+                         reverse=True)
+            ops = [op.dump() for op in ops]
         return {"num_ops": len(ops), "ops": ops}
 
     def get_slow_ops(self) -> list[TrackedOp]:

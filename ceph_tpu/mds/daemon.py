@@ -133,8 +133,7 @@ class MDSDaemon(Dispatcher):
         self.sessions: dict[str, int] = {}
         # observability (reference: every daemon has PerfCounters +
         # an AdminSocket — `ceph daemon mds.X perf dump / session ls`)
-        import os as _os
-        from ..core.admin_socket import AdminSocket
+        from ..core.admin_socket import AdminSocket, default_path
         from ..core.perf_counters import PerfCountersBuilder
         pb = PerfCountersBuilder(f"mds.{name}")
         pb.add_u64_counter("request", "client requests served")
@@ -142,8 +141,7 @@ class MDSDaemon(Dispatcher):
         pb.add_u64_counter("journal_events", "journal events appended")
         pb.add_u64_counter("replays", "journal replays performed")
         self.perf = pb.create_perf_counters()
-        self.admin_socket = AdminSocket(
-            f"/tmp/ceph_tpu-mds.{name}.{_os.getpid()}.asok")
+        self.admin_socket = AdminSocket(default_path(f"mds.{name}"))
         self.admin_socket.register(
             "perf dump", lambda c: self.perf.dump(),
             "dump perf counters")

@@ -215,10 +215,8 @@ class MgrDaemon:
         self.msgr.add_dispatcher(_MgrCommandServer(self))
         self.addr = None
         # observability (reference: the mgr serves its own asok)
-        import os as _os
-        from ..core.admin_socket import AdminSocket
-        self.admin_socket = AdminSocket(
-            f"/tmp/ceph_tpu-mgr.{name}.{_os.getpid()}.asok")
+        from ..core.admin_socket import AdminSocket, default_path
+        self.admin_socket = AdminSocket(default_path(f"mgr.{name}"))
         self.admin_socket.register(
             "status", lambda c: {
                 "name": self.name, "state": self.state,

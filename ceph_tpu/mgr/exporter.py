@@ -107,28 +107,75 @@ class Exporter:
             emit("ceph_cluster_slow_ops_oldest_age_seconds", worst_age,
                  help_="age of the oldest slow op")
 
+        # per-family TYPE lines, once each (families repeat across
+        # daemon instances)
+        typed: set[str] = set()
+
+        def emit_type(name, typ):
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {name} {typ}")
+
         for daemon, path in sorted(self.asok_paths.items()):
             try:
                 dump = admin_command(path, "perf dump")
             except Exception:
                 continue        # daemon down: skip its series
+            try:
+                schema = admin_command(path, "perf schema")
+            except Exception:
+                schema = {}     # older daemon: untyped series only
             # one metric FAMILY per counter, instance in the
             # ceph_daemon label (reference prometheus module's
             # shape) — sum(ceph_osd_op) must aggregate across OSDs
             dtype = _san(daemon.split(".", 1)[0])
-            for counters in dump.values():
+            for pcname, counters in dump.items():
+                kinds = schema.get(pcname) or {}
                 for cname, val in counters.items():
                     base = f"ceph_{dtype}_{_san(cname)}"
                     lab = {"ceph_daemon": daemon}
+                    kind = (kinds.get(cname) or {}).get("type")
                     if isinstance(val, dict):
                         if "avgcount" in val:
                             emit(base + "_sum", val.get("sum", 0),
                                  labels=lab)
                             emit(base + "_count",
                                  val.get("avgcount", 0), labels=lab)
+                        elif "values" in val:
+                            self._emit_histogram(
+                                emit, emit_type, base, lab, val)
                     else:
+                        if kind == "u64":
+                            # monotonic counters get the proper
+                            # prometheus type (rate() needs it)
+                            emit_type(base, "counter")
                         emit(base, val, labels=lab)
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _emit_histogram(emit, emit_type, base, lab, val):
+        """LogHistogram dump → prometheus histogram series.
+
+        The 2-D log2 histogram collapses its y axis; x-bucket i holds
+        observations v with int(log2(v+1)) == i, so its upper bound
+        is 2^(i+1)-1 (the last bucket is +Inf).  `_sum` is
+        approximated from bucket lower bounds — the source histogram
+        stores counts only."""
+        rows = val.get("values") or []
+        if not rows:
+            return
+        nx = len(rows[0])
+        per_x = [sum(r[i] for r in rows) for i in range(nx)]
+        emit_type(base, "histogram")
+        cum = 0
+        approx_sum = 0.0
+        for i, n in enumerate(per_x):
+            cum += n
+            approx_sum += n * float(2 ** i - 1)
+            le = "+Inf" if i == nx - 1 else f"{float(2 ** (i + 1) - 1):g}"
+            emit(base + "_bucket", cum, labels={**lab, "le": le})
+        emit(base + "_sum", approx_sum, labels=lab)
+        emit(base + "_count", cum, labels=lab)
 
 
 class ExporterService:

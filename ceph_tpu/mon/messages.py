@@ -126,6 +126,16 @@ class MMgrMapMsg(_JsonMessage):
 
 
 @register_message
+class MLog(_JsonMessage):
+    """Daemon → mon: batched cluster-log entries (reference
+    ``src/messages/MLog.h``).  entries: [{"stamp", "name", "channel",
+    "prio", "text"}] — LogClient ships the unsent tail, LogMonitor
+    commits through paxos and serves ``ceph log last``."""
+    TYPE = 31
+    FIELDS = ("entries", "fwd")
+
+
+@register_message
 class MPGStats(_JsonMessage):
     """Primary OSD → mon: per-PG state/object counts (reference
     MPGStats → PGMap aggregation, ``src/mon/PGMap.cc``).  pg_stats:

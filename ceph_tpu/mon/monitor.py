@@ -1250,28 +1250,54 @@ class LogMonitor(PaxosService):
         if committed >= self._staged_seq:
             self._staged_seq = 0
 
+    def _stage_entries(self, entries: list[dict]):
+        """Append a batch at monotonic seqs and propose once."""
+        seq = max(self.mon.store.get_int(self.prefix, "seq"),
+                  self._staged_seq)
+        for entry in entries:
+            seq += 1
+            self.stage("put", seq, json.dumps(entry))
+        self._staged_seq = seq
+        self.stage("put", "seq", str(seq))
+        self.mon.propose()
+
+    def handle_log(self, entries) -> int:
+        """Leader-side MLog ingest: daemon clog batches land in the
+        paxos-backed ring (reference LogMonitor::preprocess_log)."""
+        clean = []
+        for e in entries or []:
+            if not isinstance(e, dict):
+                continue
+            clean.append({"stamp": float(e.get("stamp") or time.time()),
+                          "name": str(e.get("name") or "?"),
+                          "channel": str(e.get("channel") or "cluster"),
+                          "prio": str(e.get("prio") or "info"),
+                          "text": str(e.get("text") or "")})
+        if clean:
+            self._stage_entries(clean)
+        return len(clean)
+
     def dispatch_command(self, cmd):
         prefix = cmd.get("prefix", "")
         if prefix == "log":
-            seq = max(self.mon.store.get_int(self.prefix, "seq"),
-                      self._staged_seq) + 1
-            self._staged_seq = seq
-            entry = json.dumps({"stamp": time.time(),
-                                "text": cmd.get("logtext", "")})
-            self.stage("put", seq, entry)
-            self.stage("put", "seq", str(seq))
-            self.mon.propose()
+            self._stage_entries([{
+                "stamp": time.time(), "name": "mon",
+                "channel": "cluster", "prio": "info",
+                "text": cmd.get("logtext", "")}])
             return 0, "logged", None
         if prefix == "log last":
-            n = int(cmd.get("num", 20))
-            seq = self.mon.store.get_int(self.prefix, "seq")
-            out = []
-            for s in range(max(1, seq - n + 1), seq + 1):
-                blob = self.mon.store.get_str(self.prefix, s)
-                if blob:
-                    out.append(json.loads(blob))
-            return 0, "", out
+            return 0, "", self.last(int(cmd.get("num", 20)))
         return None
+
+    def last(self, n: int = 20) -> list[dict]:
+        """Tail of the committed ring, oldest first."""
+        seq = self.mon.store.get_int(self.prefix, "seq")
+        out = []
+        for s in range(max(1, seq - n + 1), seq + 1):
+            blob = self.mon.store.get_str(self.prefix, s)
+            if blob:
+                out.append(json.loads(blob))
+        return out
 
 
 PG_STALE_GRACE = 6.0     # seconds without a primary report → stale
@@ -1545,19 +1571,20 @@ class Monitor(Dispatcher):
         self._initial_created = False
         # observability (reference: every daemon has PerfCounters and
         # an AdminSocket — `ceph daemon mon.X perf dump`)
-        import os as _os
-        from ..core.admin_socket import AdminSocket
+        from ..core.admin_socket import AdminSocket, default_path
         from ..core.perf_counters import PerfCountersBuilder
         pb = PerfCountersBuilder(self.name)
         pb.add_u64_counter("paxos_commits", "committed paxos values")
         pb.add_u64_counter("elections", "election rounds entered")
         pb.add_u64_counter("commands", "client commands dispatched")
         self.perf = pb.create_perf_counters()
-        self.admin_socket = AdminSocket(
-            f"/tmp/ceph_tpu-{self.name}.{_os.getpid()}.asok")
+        self.admin_socket = AdminSocket(default_path(self.name))
         self.admin_socket.register(
             "perf dump", lambda c: self.perf.dump(),
             "dump perf counters")
+        self.admin_socket.register(
+            "perf schema", lambda c: self.perf.schema(),
+            "perf counter schema")
         self.admin_socket.register(
             "quorum_status", lambda c: {
                 "quorum": self.quorum, "leader": self.elector.leader,
@@ -1866,6 +1893,15 @@ class Monitor(Dispatcher):
                 self._peer_send(self.elector.leader,
                                 M.MOSDAlive(osd=msg.osd, want=msg.want,
                                             fwd=1))
+            return True
+        if isinstance(msg, M.MLog):
+            # batched daemon clog entries; same one-hop leader
+            # forwarding as the daemon reports above
+            if self.is_leader:
+                self.services["log"].handle_log(msg.entries)
+            elif self.elector.leader is not None and not msg.fwd:
+                self._peer_send(self.elector.leader,
+                                M.MLog(entries=msg.entries, fwd=1))
             return True
         if isinstance(msg, M.MPGStats):
             # every mon keeps a PGMap copy (reports fan out through

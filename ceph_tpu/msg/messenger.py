@@ -126,18 +126,37 @@ class Connection:
             # a dead peer must not grow an unbounded backlog; senders
             # (heartbeats, elections) retry at the protocol level
             raise ConnectionError("send queue full (peer unreachable?)")
+        tracer = self.msgr.tracer
+        span = None
+        if tracer is not None and tracer.enabled:
+            ctx = getattr(msg, "trace", None)
+            if ctx:
+                span = tracer.start_span(
+                    f"wire_send:{type(msg).__name__}", parent=ctx,
+                    tags={"layer": "wire",
+                          "peer": self.peer_name or (
+                              f"{self.peer_addr.host}:"
+                              f"{self.peer_addr.port}"
+                              if self.peer_addr else "?")})
         faults = self.msgr.faults
         if faults.active:
             dst = self.peer_name or (
                 f"{self.peer_addr.host}:{self.peer_addr.port}"
                 if self.peer_addr else "?")
             d = faults.decide(self.msgr.entity_name, dst)
+            if span is not None and d.verdict is not None:
+                span.set_tag("fault", d.verdict)
             if d.verdict in (DROP, PARTITION):
+                if span is not None:
+                    span.finish()
                 return           # lost on the wire; protocols retry
             if d.verdict in (DELAY, REORDER):
                 # late enqueue: anything sent inside the hold window
                 # overtakes this message (seq is assigned at dequeue,
                 # so the scramble is a real logical-order inversion)
+                if span is not None:
+                    span.set_tag("hold_s", round(d.hold_s, 6))
+                    span.finish()
                 self.msgr._call_soon(
                     self.msgr._loop.call_later, d.hold_s,
                     self._send_q.put_nowait, msg)
@@ -147,6 +166,8 @@ class Connection:
                 # the session-layer dedup does NOT absorb it and the
                 # application sees a true duplicate delivery
                 self.msgr._call_soon(self._send_q.put_nowait, msg)
+        if span is not None:
+            span.finish()
         self.msgr._call_soon(self._send_q.put_nowait, msg)
 
     def mark_down(self):
@@ -397,6 +418,10 @@ class Messenger:
         self.reconnect = reconnect
         self.reconnect_backoff_max = reconnect_backoff_max
         self.max_queued = max_queued
+        # core.tracer.Tracer attached by the owning daemon; wire
+        # spans are only cut for messages already carrying a trace
+        # ctx, so heartbeats/elections stay span-free
+        self.tracer = None
         self.dispatchers: list[Dispatcher] = []
         self.connections: list[Connection] = []
         self._down = False
@@ -663,15 +688,27 @@ class Messenger:
 
     # -- dispatch ----------------------------------------------------------
     def _dispatch(self, msg: Message):
-        for d in self.dispatchers:
-            try:
-                if d.ms_dispatch(msg):
+        tracer = self.tracer
+        span = None
+        if tracer is not None and tracer.enabled:
+            ctx = getattr(msg, "trace", None)
+            if ctx:
+                span = tracer.start_span(
+                    f"wire_recv:{type(msg).__name__}", parent=ctx,
+                    tags={"layer": "wire"})
+        try:
+            for d in self.dispatchers:
+                try:
+                    if d.ms_dispatch(msg):
+                        return
+                except Exception:  # noqa: BLE001 — a dispatcher must
+                    import traceback  # not kill the messenger thread
+                    traceback.print_exc()
                     return
-            except Exception:  # noqa: BLE001 — a dispatcher must not
-                import traceback  # kill the messenger thread
-                traceback.print_exc()
-                return
-        # undispatched messages are dropped, as the reference does
+            # undispatched messages are dropped, as the reference does
+        finally:
+            if span is not None:
+                span.finish()
 
     def _notify_reset(self, con: Connection):
         for d in self.dispatchers:

@@ -25,7 +25,7 @@ import os
 import threading
 import time
 
-from ..core.admin_socket import AdminSocket
+from ..core.admin_socket import AdminSocket, default_path
 from ..core.config import ConfigProxy
 from ..core.options import build_options
 from ..core.perf_counters import PerfCountersBuilder
@@ -92,6 +92,16 @@ def _build_osd_perf(name: str):
     b.add_u64_counter("scrubs_scheduled",
                       "periodic scrubs started by the tick")
     b.add_u64("numpg", "placement groups hosted")
+    # per-layer span durations (tracer perf sink; ceph_*_span_duration
+    # in the exporter) — zero until jaeger_tracing_enable is on
+    b.add_time_avg("osd_span_duration", "OSD op span duration")
+    b.add_time_avg("wire_span_duration", "messenger wire span duration")
+    b.add_time_avg("device_span_duration",
+                   "TPU device kernel span duration")
+    # log2 op-latency distribution in microseconds (reference
+    # osd_op_latency histograms; `perf histogram dump`)
+    b.add_histogram("op_latency_histogram",
+                    "client op latency distribution (us, log2 buckets)")
     return b.create_perf_counters()
 
 
@@ -119,14 +129,30 @@ class OSDaemon(Dispatcher):
         self.op_tracker = OpTracker(
             history_size=int(self.config.get("op_history_size") or 20),
             complaint_time=float(
-                self.config.get("op_complaint_time") or 30.0))
+                self.config.get("op_complaint_time") or 30.0),
+            history_duration=float(
+                self.config.get("osd_op_history_duration") or 600.0))
         self.config.add_observer(
             "op_complaint_time",
             lambda _n, v: setattr(self.op_tracker, "complaint_time",
                                   float(v)))
+        self.config.add_observer(
+            "osd_op_history_duration",
+            lambda _n, v: setattr(self.op_tracker, "history_duration",
+                                  float(v)))
+        # op tracing: spans adopted from the client ctx riding MOSDOp;
+        # the perf sink feeds the *_span_duration counters above
+        from ..core.tracer import Tracer
+        self.tracer = Tracer(
+            daemon=f"osd.{whoami}",
+            ring_size=int(self.config.get("tracer_ring_size") or 4096),
+            enabled=bool(self.config.get("jaeger_tracing_enable")),
+            perf=self.perf)
+        self.config.add_observer(
+            "jaeger_tracing_enable",
+            lambda _n, v: setattr(self.tracer, "enabled", bool(v)))
         self.admin_socket = AdminSocket(
-            admin_socket_path
-            or f"/tmp/ceph_tpu-osd.{whoami}.{os.getpid()}.asok")
+            admin_socket_path or default_path(f"osd.{whoami}"))
         self._register_admin_commands()
         self.store = store if store is not None else MemStore(
             name=f"osd.{whoami}")
@@ -156,8 +182,14 @@ class OSDaemon(Dispatcher):
                 _opt, lambda _n, v, _k=_knob: self.msgr.faults.set_rule(
                     "*", "*", **{_k: float(v)}))
         self.msgr.add_dispatcher(self)
+        self.msgr.tracer = self.tracer
         self.monc = MonClient(monmap, entity=f"osd.{whoami}",
                               auth=auth)
+        # cluster log: ring + batched MLog uplink, flushed on the tick
+        from ..core.log_client import LogClient
+        self.clog = LogClient(f"osd.{whoami}", send_fn=self.monc.send)
+        self._slow_ops_logged = 0      # clog on 0→N transitions
+        self._scrub_errors_logged = 0
         self.osdmap = OSDMap()
         self.pgs: dict[PGid, PG] = {}
         # interval history per PG, built by walking EVERY map epoch in
@@ -210,6 +242,35 @@ class OSDaemon(Dispatcher):
         a.register("dump_historic_ops",
                    lambda c: self.op_tracker.dump_historic_ops(),
                    "recently completed ops")
+        a.register(
+            "dump_historic_ops_by_duration",
+            lambda c: self.op_tracker.dump_historic_ops_by_duration(),
+            "recently completed ops, slowest first")
+        a.register("perf histogram dump",
+                   lambda c: self.perf.dump_histograms(),
+                   "2-D log-bucket histogram counters")
+        # op tracing surface (reference `dump_tracing` / blkin):
+        # `trace start|stop` rides one registration — the dispatcher
+        # hands the full prefix through, so parse the verb here
+        a.register("dump_tracing", lambda c: {
+            "enabled": self.tracer.enabled,
+            "num_spans": len(self.tracer),
+            "spans": self.tracer.dump()},
+            "collected spans")
+
+        def _trace_ctl(c):
+            verb = c.get("prefix", "").split()[-1]
+            if verb == "start":
+                self.tracer.enabled = True
+            elif verb == "stop":
+                self.tracer.enabled = False
+            elif verb == "clear":
+                self.tracer.clear()
+            else:
+                return {"error": "usage: trace start|stop|clear"}
+            return {"enabled": self.tracer.enabled}
+        a.register("trace", _trace_ctl,
+                   "trace start|stop|clear — toggle span collection")
         a.register("config show", lambda c: {
             k: self.config.get(k) for k in self.config.keys()},
             "effective configuration")
@@ -779,6 +840,8 @@ class OSDaemon(Dispatcher):
             if now - self._stats_last >= self._stats_interval:
                 self._stats_last = now
                 self._report_pg_stats()
+                self._maybe_clog_health()
+                self.clog.flush()
         if self.running:
             self._tick_token = self.timer.add_event_after(
                 self._hb_interval, self._tick)
@@ -816,6 +879,23 @@ class OSDaemon(Dispatcher):
                 now - max(pg.last_scrub, floor) >= iv:
             if pg.start_scrub(deep=False):
                 self.perf.inc("scrubs_scheduled")
+
+    def _maybe_clog_health(self):
+        """Cluster-log the SLOW_OPS / scrub-error transitions
+        (reference: OSD clog warnings feeding `ceph -w`); only edges
+        are logged so a stuck op does not spam an entry per tick."""
+        slow = self.op_tracker.slow_summary()
+        if slow["count"] > self._slow_ops_logged:
+            self.clog.warn(
+                f"{slow['count']} slow requests, oldest "
+                f"{slow['oldest_age']:.1f}s: {slow['oldest_desc']}")
+        self._slow_ops_logged = slow["count"]
+        errors = sum(pg.scrub_errors for pg in self.pgs.values()
+                     if pg.is_primary)
+        if errors > self._scrub_errors_logged:
+            self.clog.error(
+                f"scrub found {errors} inconsistencies")
+        self._scrub_errors_logged = errors
 
     def _report_pg_stats(self):
         """Primary PGs report state/object counts to the mon (reference
@@ -1029,6 +1109,12 @@ class OSDaemon(Dispatcher):
         msg.tracked = self.op_tracker.create_request(
             f"osd_op({msg.client}.{msg.tid} {msg.pgid} {msg.oid} "
             f"{'+'.join(sorted(k for k in kinds if k))})")
+        # adopt the client's trace ctx: every mark_event on the
+        # tracked op becomes a span event, finish() closes the span
+        msg.tracked.span = self.tracer.start_span(
+            f"osd_op:{msg.oid}", parent=getattr(msg, "trace", None),
+            tags={"layer": "osd", "pgid": msg.pgid,
+                  "write": is_write})
         pg = self.pgs.get(PGid.parse(msg.pgid))
         if pg is None:
             msg.tracked.finish()

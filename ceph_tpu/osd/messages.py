@@ -32,7 +32,7 @@ class MOSDOp(_JsonMessage):
     last request to THIS osd (reference src/dmclock ReqParams)."""
     TYPE = 40
     FIELDS = ("tid", "client", "pgid", "oid", "epoch", "ops", "flags",
-              "snapc", "dmc")
+              "snapc", "dmc", "trace")
 
 
 @register_message
@@ -50,7 +50,7 @@ class MOSDRepOp(_JsonMessage):
     """Primary → replica: apply this transaction (ReplicatedBackend)."""
     TYPE = 42
     FIELDS = ("reqid", "pgid", "epoch", "txn", "version", "log_entries",
-              "pg_info")
+              "pg_info", "trace")
 
 
 @register_message
@@ -90,7 +90,7 @@ class MOSDECSubOpWrite(_JsonMessage):
     """Primary → shard k: write your chunk (reference MOSDECSubOpWrite)."""
     TYPE = 47
     FIELDS = ("reqid", "pgid", "shard", "epoch", "txn", "version",
-              "log_entries", "pg_info")
+              "log_entries", "pg_info", "trace")
 
 
 @register_message

@@ -204,7 +204,7 @@ class OSDMap:
         self._mappers: dict = {}
 
     def batch_mapper(self, rule_id: int, result_max: int,
-                     **kwargs):
+                     tracer=None, **kwargs):
         """Cached `crush.jax_mapper.BatchMapper` for (rule, size).
 
         The reweight fast path of the mapping spine: balancer rounds
@@ -213,21 +213,42 @@ class OSDMap:
         `new_crush`, the mapper rebinds through
         `BatchMapper.set_weights` instead of recompiling.  Topology /
         rule / tunables changes rebuild (and the compiled program may
-        still warm-start from the on-disk export cache)."""
+        still warm-start from the on-disk export cache).
+
+        ``tracer``: optional ``core.tracer.Tracer`` — the acquisition
+        is recorded as a device span tagged with how it was satisfied
+        (mapper reuse / weight rebind / fresh build, and whether a
+        fresh build warm-started from the AOT compile cache)."""
         from ..crush.jax_mapper import BatchMapper
+        span = None if tracer is None else tracer.start_span(
+            "crush_batch_mapper", tags={
+                "layer": "device", "kernel": "crush",
+                "rule": rule_id, "result_max": result_max})
         key = (rule_id, result_max, tuple(sorted(kwargs.items())))
         bm = self._mappers.get(key)
         if bm is not None:
-            if bm.cmap is not self.crush:
+            rebound = bm.cmap is not self.crush
+            if rebound:
                 try:
                     bm.set_weights(self.crush)
                 except (ValueError, NotImplementedError):
                     bm = None
             if bm is not None:
+                if span is not None:
+                    span.set_tag("cache_hit", True)
+                    span.set_tag("how",
+                                 "rebind" if rebound else "reuse")
+                    span.finish()
                 return bm
         bm = BatchMapper(self.crush, rule_id, result_max=result_max,
                          **kwargs)
         self._mappers[key] = bm
+        if span is not None:
+            # bm.cache_hit: the fresh build warm-started from the
+            # persistent AOT executable cache (no XLA recompile)
+            span.set_tag("cache_hit", bool(bm.cache_hit))
+            span.set_tag("how", "build")
+            span.finish()
         return bm
 
     # -- construction ------------------------------------------------------

@@ -1009,6 +1009,12 @@ class PG:
         tracked = self.finish_tracked(msg, "replied")
         if tracked is not None:
             self.daemon.perf.tinc("op_latency", tracked.age)
+            # log2 distribution in µs (perf histogram dump / exporter)
+            try:
+                self.daemon.perf.hinc("op_latency_histogram",
+                                      tracked.age * 1e6)
+            except KeyError:
+                pass
         try:
             msg.connection.send_message(M.MOSDOpReply(
                 tid=msg.tid, rc=rc, outs=outs, results=results,
@@ -1366,13 +1372,18 @@ class ReplicatedBackend:
                  "results": results}
         self._inflight[reqid] = state
         wire_txn = txn.to_dict()
+        # sub-ops join the trace as children of the OSD op span (fall
+        # back to the client ctx when tracking was skipped)
+        span = getattr(getattr(msg, "tracked", None), "span", None)
+        trace = span.ctx() if span is not None \
+            else getattr(msg, "trace", None)
         for o in peers:
             daemon.send_to_osd(o, M.MOSDRepOp(
                 reqid=reqid, pgid=str(pg.pgid),
                 epoch=daemon.osdmap.epoch, txn=wire_txn,
                 version=list(version),
                 log_entries=[entry.to_dict()],
-                pg_info=pg.info.to_dict()))
+                pg_info=pg.info.to_dict(), trace=trace))
         daemon.store.queue_transaction(txn)
         if not peers:
             self._maybe_ack(reqid)
@@ -1628,8 +1639,15 @@ class ReplicatedBackend:
                         "valid": True}
         if deep:
             eng = scrub_engine.default_engine()
+            span = pg.daemon.tracer.start_span(
+                "crc_digest", tags={
+                    "layer": "device", "kernel": "crc32c",
+                    "pgid": str(pg.pgid), "objects": len(payloads),
+                    "bytes": sum(len(b) for b in payloads.values())})
             for oid, digest in eng.compute_digests(payloads).items():
                 out[oid]["crc"] = digest
+            if span is not None:
+                span.finish()
             perf = pg.daemon.perf
             perf.inc("scrub_objects_scanned", len(payloads))
             perf.inc("scrub_digest_bytes",
@@ -2065,13 +2083,23 @@ class ECBackend:
                          version=version, prior_version=prior,
                          reqid=reqid, mtime=time.time())
         daemon = pg.daemon
-        # encode once; per-shard transactions
+        # encode once; per-shard transactions.  The jitted GF encode
+        # is the device kernel of the write path — traced as a child
+        # of the OSD op span with bytes + wall time
         shard_chunks = None
         if data is not None:
             k, m = self.engine.k, self.engine.m
+            _ospan = getattr(getattr(msg, "tracked", None), "span",
+                             None)
+            span = daemon.tracer.start_span(
+                "gf_encode", parent=_ospan, tags={
+                    "layer": "device", "kernel": "gf_encode",
+                    "bytes": len(data), "k": k, "m": m})
             out = self.engine.encode(set(range(k + m)), data)
             shard_chunks = {i: bytes(out[i].tobytes())
                             for i in range(k + m)}
+            if span is not None:
+                span.finish()
         live = []
         for s, o in enumerate(pg.acting):
             if o == CRUSH_ITEM_NONE or not daemon.osdmap.is_up(o):
@@ -2115,6 +2143,9 @@ class ECBackend:
                  "local_txns": local_txns, "entry": entry,
                  "oid": oid}
         self._inflight[reqid] = state
+        span = getattr(getattr(msg, "tracked", None), "span", None)
+        trace = span.ctx() if span is not None \
+            else getattr(msg, "trace", None)
         for s, o in remote:
             txn = self._shard_txn(s, oid, shard_chunks, delete,
                                   attr_ops, version,
@@ -2124,7 +2155,7 @@ class ECBackend:
                 epoch=daemon.osdmap.epoch, txn=txn.to_dict(),
                 version=list(version),
                 log_entries=[entry.to_dict()],
-                pg_info=pg.info.to_dict()))
+                pg_info=pg.info.to_dict(), trace=trace))
         self._maybe_ack(reqid)
 
     def _shard_txn(self, shard: int, oid: str, chunks, delete: bool,
@@ -2733,11 +2764,18 @@ class ECBackend:
                         "valid": True}
         if deep:
             eng = scrub_engine.default_engine()
+            span = pg.daemon.tracer.start_span(
+                "crc_digest", tags={
+                    "layer": "device", "kernel": "crc32c",
+                    "pgid": str(pg.pgid), "objects": len(chunks),
+                    "bytes": sum(len(b) for b in chunks.values())})
             for oid, digest in eng.compute_digests(chunks).items():
                 hinfo = metas[oid].get("hinfo")
                 out[oid].update(
                     crc=digest, data=chunks[oid].hex(),
                     valid=hinfo is None or digest == hinfo)
+            if span is not None:
+                span.finish()
             perf = pg.daemon.perf
             perf.inc("scrub_objects_scanned", len(chunks))
             perf.inc("scrub_digest_bytes",
@@ -2825,7 +2863,14 @@ class ECBackend:
             return 0
         eng = scrub_engine.default_engine()
         before = eng.parity_bytes
+        span = pg.daemon.tracer.start_span(
+            "parity_recheck", tags={
+                "layer": "device", "kernel": "gf_encode",
+                "pgid": str(pg.pgid), "stripes": len(stripes)})
         verdicts = eng.recheck_parity(ec, stripes)
+        if span is not None:
+            span.set_tag("bytes", eng.parity_bytes - before)
+            span.finish()
         pg.daemon.perf.inc("scrub_parity_recheck_bytes",
                            eng.parity_bytes - before)
         errors = 0

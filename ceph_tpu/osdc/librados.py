@@ -76,7 +76,11 @@ class Rados:
                   "resend_jitter": float(
                       self.config.get("objecter_resend_jitter")),
                   "backoff_expire": float(
-                      self.config.get("objecter_backoff_expire"))}
+                      self.config.get("objecter_backoff_expire")),
+                  "tracing": bool(
+                      self.config.get("jaeger_tracing_enable")),
+                  "tracer_ring": int(
+                      self.config.get("tracer_ring_size"))}
         self.objecter = Objecter(self.monmap, entity=self.name,
                                  auth=self.auth, **kw)
         self.objecter.wait_for_osdmap(1, timeout)

@@ -21,6 +21,7 @@ import random
 import threading
 import time
 
+from ..core.tracer import Tracer
 from ..mon.client import MonClient
 from ..msg import Dispatcher, EntityAddr, Messenger
 from ..osd import messages as M
@@ -31,7 +32,7 @@ from ..tools.osdmaptool import osdmap_from_dict
 class _Op:
     __slots__ = ("tid", "pool", "oid", "ops", "on_reply", "pgid",
                  "target_osd", "attempts", "submitted", "direct",
-                 "next_resend", "resend_delay")
+                 "next_resend", "resend_delay", "span")
 
     def __init__(self, tid, pool, oid, ops, on_reply, direct=False):
         self.tid = tid
@@ -47,6 +48,7 @@ class _Op:
         # exponential-backoff resend schedule (reset on map advance)
         self.next_resend = 0.0
         self.resend_delay = 0.0
+        self.span = None            # objecter op span when tracing
 
 
 class BackoffRegistry:
@@ -117,7 +119,8 @@ class Objecter(Dispatcher):
                  resend_interval: float = 2.0,
                  resend_max: float = 16.0,
                  resend_jitter: float = 0.25,
-                 backoff_expire: float = 10.0, auth=None):
+                 backoff_expire: float = 10.0, auth=None,
+                 tracing: bool = False, tracer_ring: int = 4096):
         # a per-session nonce joins the entity name in every reqid:
         # two sessions of the same client name must never collide in
         # the OSDs' dup-op log (the reference's osd_reqid_t carries
@@ -128,6 +131,11 @@ class Objecter(Dispatcher):
         self.msgr = Messenger(
             entity, **(auth.msgr_kwargs(entity) if auth else {}))
         self.msgr.add_dispatcher(self)
+        # op tracing: the root span of every client op starts here;
+        # its ctx rides the MOSDOp so the OSD's spans join the trace
+        self.tracer = Tracer(daemon=entity, ring_size=tracer_ring,
+                             enabled=tracing)
+        self.msgr.tracer = self.tracer
         self.osdmap = OSDMap()
         self.lock = threading.RLock()
         self._tid = 0
@@ -272,6 +280,10 @@ class Objecter(Dispatcher):
             self._tid += 1
             op = _Op(self._tid, pool, oid, list(ops), on_reply,
                      direct=direct)
+            op.span = self.tracer.start_span(
+                f"objecter_op:{oid}",
+                tags={"layer": "objecter", "pool": pool,
+                      "ops": ",".join(str(o.get("op")) for o in op.ops)})
             self._reset_resend(op, op.submitted)
             self.inflight[op.tid] = op
             self._send_op(op)
@@ -292,12 +304,24 @@ class Objecter(Dispatcher):
         return pool
 
     def _send_op(self, op: _Op):
+        # the CRUSH mapping itself is a traced child: per-send so
+        # resends show their (possibly new) target computation
+        cspan = None if op.span is None else self.tracer.start_span(
+            "crush_map", parent=op.span, tags={"layer": "crush"})
         pgid, primary = self._calc_target(
             self._effective_pool(op.pool, op.direct), op.oid)
+        if cspan is not None:
+            cspan.set_tag("pgid", str(pgid))
+            cspan.set_tag("primary", primary)
+            cspan.finish()
         op.pgid, op.target_osd = pgid, primary
         if primary >= 0 and self.backoffs.blocked(primary, pgid):
+            if op.span is not None:
+                op.span.event("backoff_parked")
             return   # parked: released by unblock / map advance
         op.attempts += 1
+        if op.span is not None and op.attempts > 1:
+            op.span.event(f"resend:{op.attempts - 1}")
         if primary < 0:
             return   # no primary this epoch: wait for the next map
         con = self._osd_con(primary)
@@ -316,7 +340,8 @@ class Objecter(Dispatcher):
             con.send_message(M.MOSDOp(
                 tid=op.tid, client=self.entity, pgid=str(pgid),
                 oid=op.oid, epoch=self.osdmap.epoch, ops=op.ops,
-                flags=0, snapc=snapc, dmc=dmc))
+                flags=0, snapc=snapc, dmc=dmc,
+                trace=None if op.span is None else op.span.ctx()))
         except ConnectionError:
             self._osd_cons.pop(primary, None)
 
@@ -347,6 +372,11 @@ class Objecter(Dispatcher):
                 if msg.op == "block":
                     self.backoffs.add(osd, msg.pgid, msg.id,
                                       msg.epoch or 0)
+                    for op in self.inflight.values():
+                        if op.span is not None and \
+                                op.target_osd == osd and \
+                                str(op.pgid) == msg.pgid:
+                            op.span.event("backoff_block")
                 else:
                     if self.backoffs.remove(osd, msg.pgid, msg.id):
                         # released: resend everything parked on this
@@ -409,6 +439,10 @@ class Objecter(Dispatcher):
             self._dmc_total += 1
             if getattr(msg, "dmc_phase", None) == "reservation":
                 self._dmc_res += 1
+        if op.span is not None:
+            op.span.set_tag("rc", msg.rc)
+            op.span.set_tag("attempts", op.attempts)
+            op.span.finish()
         op.on_reply(msg.rc, msg.outs, msg.results,
                     tuple(msg.version or (0, 0)))
         return True
@@ -448,7 +482,10 @@ class Objecter(Dispatcher):
         tid = self.op_submit(pool, oid, ops, on_reply, direct=direct)
         if not ev.wait(timeout):
             with self.lock:
-                self.inflight.pop(tid, None)
+                op = self.inflight.pop(tid, None)
+                if op is not None and op.span is not None:
+                    op.span.set_tag("timeout", True)
+                    op.span.finish()
             raise TimeoutError(
                 f"osd op on {oid!r} (pool {pool}) timed out")
         return box[0]

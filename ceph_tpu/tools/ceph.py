@@ -8,7 +8,10 @@
     ceph -m ... osd reweight ID WEIGHT
     ceph -m ... osd pool mksnap POOL SNAP | rmsnap POOL SNAP
     ceph -m ... osd pg-upmap-items PGID FROM TO [FROM TO ...]
+    ceph -m ... log last [N] | log MESSAGE...
     ceph -m ... daemon SOCK_PATH COMMAND [k=v ...]
+        (e.g. daemon <asok> dump_tracing | trace start|stop|clear |
+         dump_historic_ops_by_duration | perf histogram dump)
         (e.g. daemon <asok> injectargs args="op_complaint_time=5",
          daemon <asok> fault show | fault set dst=osd.1 drop=0.3 |
          fault partition dst=osd.2 | fault heal — the seeded
@@ -169,6 +172,14 @@ def _dispatch(args, rest) -> int:
         elif rest[0] == "osd" and rest[1:2] == ["reweight"]:
             cmd = {"prefix": "osd reweight", "id": int(rest[2]),
                    "weight": float(rest[3])}
+        elif rest[0] == "log" and rest[1:2] == ["last"]:
+            # `ceph log last [n]` — tail of the cluster log
+            cmd = {"prefix": "log last"}
+            if len(rest) > 2:
+                cmd["num"] = int(rest[2])
+        elif rest[0] == "log" and len(rest) > 1:
+            # `ceph log <msg...>` — operator entry into the clog
+            cmd = {"prefix": "log", "logtext": " ".join(rest[1:])}
         else:
             words = ["status" if w == "-s" else w for w in rest]
             fmt = None

@@ -318,6 +318,26 @@ class MiniCluster:
             time.sleep(0.05)
         raise TimeoutError(f"scrub of {pgid} never finished")
 
+    # -- tracing -----------------------------------------------------------
+    def collect_trace(self, trace_id: str) -> list[dict]:
+        """Merge one trace's spans from every daemon and client ring,
+        ordered by start time (all daemons share this process, so the
+        monotonic starts are directly comparable).  Feed the result to
+        ``core.tracer.chrome_trace`` for a chrome://tracing export."""
+        spans: list[dict] = []
+        for osd in self.osds.values():
+            spans.extend(osd.tracer.spans_for(trace_id))
+        for r in self._clients:
+            if r.objecter is not None:
+                spans.extend(r.objecter.tracer.spans_for(trace_id))
+        spans.sort(key=lambda s: s["start"])
+        return spans
+
+    def export_chrome_trace(self, trace_id: str) -> dict:
+        """chrome://tracing JSON for one trace."""
+        from .core.tracer import chrome_trace
+        return chrome_trace(self.collect_trace(trace_id))
+
     def wait_for_osd_down(self, i: int, timeout: float = 20.0):
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:

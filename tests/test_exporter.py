@@ -49,6 +49,33 @@ class TestExporter:
                 assert 'ceph_osd_op{ceph_daemon="osd.1"}' in text
                 assert 'ceph_mon_paxos_commits{ceph_daemon="mon.0"}' \
                     in text
+                # U64 counters carry the prometheus counter type
+                # (rate() needs it), exactly once per family
+                assert text.count("# TYPE ceph_osd_op counter") == 1
+                # LogHistogram counters export as native histograms
+                assert text.count(
+                    "# TYPE ceph_osd_op_latency_histogram histogram") \
+                    == 1
+                assert 'ceph_osd_op_latency_histogram_bucket{' \
+                    'ceph_daemon="osd.0",le="+Inf"}' in text
+                assert 'ceph_osd_op_latency_histogram_count{' \
+                    'ceph_daemon="osd.0"}' in text
+                assert 'ceph_osd_op_latency_histogram_sum{' \
+                    'ceph_daemon="osd.0"}' in text
+                # cumulative bucket counts: +Inf equals _count
+                import re
+                buckets = {
+                    m.group(1): float(m.group(2))
+                    for m in re.finditer(
+                        r'ceph_osd_op_latency_histogram_bucket\{'
+                        r'ceph_daemon="osd\.0",le="([^"]+)"\} (\S+)',
+                        text)}
+                count = float(re.search(
+                    r'ceph_osd_op_latency_histogram_count\{'
+                    r'ceph_daemon="osd\.0"\} (\S+)', text).group(1))
+                assert buckets["+Inf"] == count
+                finite = [v for k, v in buckets.items() if k != "+Inf"]
+                assert finite == sorted(finite)   # monotone cumulative
             finally:
                 svc.shutdown()
         finally:

@@ -338,6 +338,42 @@ class TestCommands:
         finally:
             mc.shutdown()
 
+    def test_clog_round_trip(self, cluster):
+        # daemon-side LogClient → batched MLog → LogMonitor ring
+        # (MonClient may land on a peon: exercises leader forwarding)
+        from ceph_tpu.core.log_client import LogClient
+        monmap, mons = cluster
+        assert wait_for(lambda: any(m.is_leader for m in mons))
+        mc = MonClient(monmap)
+        try:
+            clog = LogClient("osd.7", send_fn=mc.send)
+            clog.info("pg 1.0 scrub starts")
+            clog.warn("2 slow requests")
+            assert clog.last(2)[-1]["prio"] == "warn"   # local ring
+            assert clog.flush() == 2
+
+            def _landed():
+                rc, _, entries = mc.command(
+                    {"prefix": "log last", "num": 10})
+                texts = [e["text"] for e in entries] if rc == 0 else []
+                return "2 slow requests" in texts
+            assert wait_for(_landed, timeout=10)
+            rc, _, entries = mc.command({"prefix": "log last",
+                                         "num": 10})
+            ent = next(e for e in entries
+                       if e["text"] == "2 slow requests")
+            assert ent["name"] == "osd.7"
+            assert ent["prio"] == "warn"
+            assert ent["channel"] == "cluster"
+            assert ent["stamp"] > 0
+            # ring is shared paxos state: every mon serves the entry
+            assert wait_for(lambda: all(
+                any(e["text"] == "2 slow requests"
+                    for e in m.services["log"].last(10))
+                for m in mons), timeout=10)
+        finally:
+            mc.shutdown()
+
     def test_status_and_auth(self, cluster):
         monmap, mons = cluster
         assert wait_for(lambda: any(m.is_leader for m in mons))

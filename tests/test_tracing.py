@@ -1,0 +1,276 @@
+"""End-to-end op tracing (reference src/common/tracer.cc + blkin):
+one client op yields one connected trace across objecter → wire →
+OSD → device kernels, surfaced via admin socket and Chrome export."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.core.admin_socket import admin_command
+from ceph_tpu.core.config import ConfigProxy
+from ceph_tpu.core.options import build_options
+from ceph_tpu.core.tracer import Tracer, chrome_trace
+from ceph_tpu.core.tracked_op import OpTracker
+from ceph_tpu.vstart import MiniCluster
+
+
+def _client_config(**overrides):
+    cfg = ConfigProxy(build_options())
+    cfg.set("jaeger_tracing_enable", True)
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return cfg
+
+
+def _last_trace_id(r, oid):
+    spans = r.objecter.tracer.dump()
+    roots = [s for s in spans if s["name"] == f"objecter_op:{oid}"]
+    assert roots, f"no objecter span for {oid}"
+    return roots[-1]["trace_id"]
+
+
+def _settle_trace(c, tid, minimum, timeout=5.0):
+    """Spans finish asynchronously on replica OSDs — poll the merge."""
+    deadline = time.monotonic() + timeout
+    spans = []
+    while time.monotonic() < deadline:
+        spans = c.collect_trace(tid)
+        if len(spans) >= minimum:
+            return spans
+        time.sleep(0.05)
+    return spans
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3,
+                    osd_config={"jaeger_tracing_enable": True})
+    c.start()
+    r = c.rados(config=_client_config())
+    r.create_pool("tr", pg_num=4, size=3)
+    rc, outs, _ = r.mon_command({
+        "prefix": "osd pool create", "pool": "tre", "pg_num": 4,
+        "size": 3, "pool_type": "erasure"})
+    assert rc == 0, outs
+    c.wait_for_clean()
+    yield c, r
+    c.stop()
+
+
+class TestTraceLinkage:
+    def test_replicated_write_connected_trace(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("tr")
+        io.write_full("rep-obj", b"replicated payload" * 32)
+        tid = _last_trace_id(r, "rep-obj")
+        spans = _settle_trace(c, tid, minimum=6)
+        layers = {s["tags"].get("layer") for s in spans}
+        assert {"objecter", "wire", "osd"} <= layers
+        # single connected tree: exactly one root, every other span's
+        # parent is present in the trace
+        ids = {s["span_id"] for s in spans}
+        roots = [s for s in spans if s["parent_id"] is None]
+        assert len(roots) == 1
+        assert roots[0]["name"] == "objecter_op:rep-obj"
+        for s in spans:
+            if s["parent_id"] is not None:
+                assert s["parent_id"] in ids
+        # the 3-OSD write shows up on more than one daemon
+        daemons = {s["daemon"] for s in spans}
+        assert len([d for d in daemons if d.startswith("osd.")]) >= 2
+        # TrackedOp mark_events became span events on the OSD op span
+        osd_op = [s for s in spans if s["tags"].get("layer") == "osd"]
+        assert osd_op and any(
+            name == "done" for _off, name in osd_op[0]["events"])
+
+    def test_ec_write_covers_four_layers(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("tre")
+        io.write_full("ec-obj", b"erasure coded payload" * 64)
+        tid = _last_trace_id(r, "ec-obj")
+        spans = _settle_trace(c, tid, minimum=8)
+        layers = {s["tags"].get("layer") for s in spans}
+        # acceptance: objecter, messenger, OSD op, device kernel
+        assert {"objecter", "wire", "osd", "device"} <= layers
+        dev = [s for s in spans if s["tags"].get("layer") == "device"]
+        assert any(s["tags"].get("kernel") == "gf_encode"
+                   and s["tags"].get("bytes", 0) > 0 for s in dev)
+        # one connected trace
+        ids = {s["span_id"] for s in spans}
+        assert sum(1 for s in spans if s["parent_id"] is None) == 1
+        assert all(s["parent_id"] in ids for s in spans
+                   if s["parent_id"] is not None)
+
+    def test_chrome_export_valid_json_monotonic(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("tre")
+        io.write_full("chrome-obj", b"x" * 512)
+        tid = _last_trace_id(r, "chrome-obj")
+        spans = _settle_trace(c, tid, minimum=6)
+        # the cluster-level export is the same function over a live
+        # re-collect; assert shape on the settled snapshot
+        assert c.export_chrome_trace(tid)["traceEvents"]
+        out = chrome_trace(spans)
+        text = json.dumps(out)          # must be JSON-serializable
+        parsed = json.loads(text)
+        events = parsed["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert len(xs) == len(spans)
+        assert all(e["dur"] >= 0 for e in xs)
+        # merge order is by span start: ts monotonic non-decreasing
+        ts = [e["ts"] for e in xs]
+        assert ts == sorted(ts)
+        # per-daemon pid metadata present
+        metas = [e for e in events if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in metas} == \
+            {s["daemon"] for s in spans}
+
+    def test_trace_survives_drop_and_resend(self, cluster):
+        c, r2 = cluster
+        r = c.rados(config=_client_config(
+            objecter_resend_interval=0.3, objecter_resend_jitter=0.0))
+        try:
+            io = r.open_ioctx("tr")
+            io.write_full("pre", b"warm the connections")
+            r.objecter.msgr.faults.set_rule("*", "*", drop=1.0)
+
+            def _heal():
+                time.sleep(0.7)
+                r.objecter.msgr.faults.heal()
+            t = threading.Thread(target=_heal)
+            t.start()
+            io.write_full("dropped-obj", b"survives the drop")
+            t.join()
+            tid = _last_trace_id(r, "dropped-obj")
+            spans = r.objecter.tracer.spans_for(tid)
+            root = [s for s in spans
+                    if s["name"] == "objecter_op:dropped-obj"][0]
+            assert any(name.startswith("resend")
+                       for _off, name in root["events"])
+            wire = [s for s in spans
+                    if s["tags"].get("layer") == "wire"]
+            assert any(s["tags"].get("fault") == "drop" for s in wire)
+        finally:
+            r.shutdown()
+
+
+class TestDisabledMode:
+    def test_disabled_allocates_no_spans(self):
+        with MiniCluster(n_mons=1, n_osds=2) as c:
+            r = c.rados()
+            r.create_pool("off", pg_num=2, size=2)
+            io = r.open_ioctx("off")
+            c.wait_for_clean()
+            for i in range(5):
+                io.write_full(f"o{i}", b"untraced")
+            assert len(r.objecter.tracer) == 0
+            assert all(len(o.tracer) == 0 for o in c.osds.values())
+            dump = admin_command(c.osds[0].admin_socket.path,
+                                 "dump_tracing")
+            assert dump["enabled"] is False
+            assert dump["num_spans"] == 0
+
+
+class TestAdminSurface:
+    def test_dump_tracing_and_toggle(self, cluster):
+        c, r = cluster
+        osd = c.osds[0]
+        dump = admin_command(osd.admin_socket.path, "dump_tracing")
+        assert dump["enabled"] is True
+        out = admin_command(osd.admin_socket.path, "trace stop")
+        assert out["enabled"] is False
+        assert osd.tracer.enabled is False
+        out = admin_command(osd.admin_socket.path, "trace start")
+        assert out["enabled"] is True
+
+    def test_historic_ops_by_duration_sorted(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("tr")
+        for i in range(4):
+            io.write_full(f"dur{i}", b"y" * 64)
+        found = False
+        for o in c.osds.values():
+            h = admin_command(o.admin_socket.path,
+                              "dump_historic_ops_by_duration")
+            ages = [op["age"] for op in h["ops"]]
+            assert ages == sorted(ages, reverse=True)
+            found = found or bool(ages)
+        assert found
+
+    def test_perf_histogram_dump(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("tr")
+        io.write_full("histo", b"z" * 128)
+        time.sleep(0.2)
+        total = 0
+        for i, o in c.osds.items():
+            h = admin_command(o.admin_socket.path,
+                              "perf histogram dump")
+            hist = h[f"osd.{i}"]["op_latency_histogram"]
+            assert hist["x_buckets"] == len(hist["values"][0])
+            total += sum(sum(row) for row in hist["values"])
+        assert total > 0    # some OSD served a client op
+
+    def test_span_duration_perf_counters(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("tre")
+        io.write_full("perf-obj", b"w" * 256)
+        time.sleep(0.2)
+        dumps = [admin_command(o.admin_socket.path, "perf dump")
+                 [f"osd.{i}"] for i, o in c.osds.items()]
+        assert any(d["osd_span_duration"]["avgcount"] > 0
+                   for d in dumps)
+        assert any(d["device_span_duration"]["avgcount"] > 0
+                   for d in dumps)
+        assert any(d["wire_span_duration"]["avgcount"] > 0
+                   for d in dumps)
+
+
+class TestTracerUnit:
+    def test_disabled_start_span_returns_none(self):
+        t = Tracer(daemon="x", enabled=False)
+        assert t.start_span("anything") is None
+        assert len(t) == 0
+
+    def test_parent_child_and_ctx(self):
+        t = Tracer(daemon="x", enabled=True)
+        root = t.start_span("root")
+        child = t.start_span("child", parent=root)
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        # wire-ctx round trip
+        remote = t.start_span("remote", parent=root.ctx())
+        assert remote.trace_id == root.trace_id
+        assert remote.parent_id == root.span_id
+        for s in (child, remote, root):
+            s.finish()
+        assert len(t.spans_for(root.trace_id)) == 3
+
+    def test_ring_bounded(self):
+        t = Tracer(daemon="x", ring_size=4, enabled=True)
+        for i in range(10):
+            t.start_span(f"s{i}").finish()
+        assert len(t) == 4
+
+    def test_chrome_trace_shape(self):
+        t = Tracer(daemon="osd.9", enabled=True)
+        s = t.start_span("op", tags={"layer": "osd"})
+        s.event("queued")
+        s.finish()
+        out = chrome_trace(t.dump())
+        assert json.loads(json.dumps(out)) == out
+        xs = [e for e in out["traceEvents"] if e["ph"] == "X"]
+        assert xs[0]["name"] == "op" and xs[0]["cat"] == "osd"
+
+    def test_history_duration_pruning(self):
+        tr = OpTracker(history_size=50, history_duration=0.05)
+        for i in range(3):
+            tr.create_request(f"op{i}").finish()
+        assert tr.dump_historic_ops()["num_ops"] == 3
+        time.sleep(0.12)
+        tr.create_request("fresh").finish()
+        out = tr.dump_historic_ops()
+        assert out["num_ops"] == 1
+        assert "fresh" in out["ops"][0]["description"]
