@@ -744,7 +744,51 @@ def _observability_leg():
             100.0 * (elapsed[True] - elapsed[False]) / elapsed[False],
             1)
         r.shutdown()
+
+    res["health_eval_ms"] = _health_eval_ms()
     return res
+
+
+def _health_eval_ms():
+    """Health-check evaluation cost at scale: one full
+    evaluate_checks pass over a synthetic 4096-OSD map with a ~16k-PG
+    PGMap.  This runs inside every mon tick (0.25 s), so the
+    acceptance bar is a small fraction of the tick."""
+    from ceph_tpu.mon.health import (HealthContext, PGMap,
+                                     evaluate_checks)
+    from ceph_tpu.osd.osdmap import EXISTS, UP, OSDMap
+
+    n_osds, n_pgs = 4096, 16384
+    m = OSDMap(max_osd=n_osds)
+    m.epoch = 10
+    for o in range(n_osds):
+        # sprinkle some down osds so OSD_DOWN does real work
+        m.osd_state[o] = EXISTS | (0 if o % 97 == 0 else UP)
+    pgmap = PGMap()
+    now = time.time()
+    states = ("active+clean", "active+recovering",
+              "active+undersized+degraded", "peering")
+    for i in range(n_pgs):
+        pgmap.pg_stats[f"1.{i:x}"] = {
+            "state": states[i % len(states)], "stamp": now,
+            "num_objects": 8, "missing": i % 3,
+            "scrub_errors": 0}
+    for o in range(0, n_osds, 8):
+        pgmap.osd_stats[str(o)] = {
+            "slow_ops": {"count": o % 5, "oldest_age": 1.0},
+            "stamp": now}
+    rounds = 5
+    t0 = time.monotonic()
+    for _ in range(rounds):
+        checks = evaluate_checks(HealthContext(
+            osdmap=m, pgmap=pgmap, monmap_ranks=(0, 1, 2),
+            quorum=(0, 1, 2), now=now))
+    per_eval_ms = (time.monotonic() - t0) * 1000.0 / rounds
+    # must fit comfortably inside the 250 ms mon tick
+    assert per_eval_ms < 200.0, f"health eval {per_eval_ms:.1f}ms"
+    return {"osds": n_osds, "pgs": n_pgs,
+            "checks_raised": len(checks),
+            "per_eval_ms": round(per_eval_ms, 2)}
 
 
 def _crush_leg():

@@ -36,9 +36,10 @@ class LogClient:
 
     # -- producers ------------------------------------------------------
 
-    def do_log(self, prio: str, text: str) -> dict:
+    def do_log(self, prio: str, text: str,
+               channel: str | None = None) -> dict:
         entry = {"stamp": time.time(), "name": self.name,
-                 "channel": self.channel,
+                 "channel": channel or self.channel,
                  "prio": prio if prio in PRIO else "info",
                  "text": str(text)}
         with self._lock:
@@ -57,6 +58,12 @@ class LogClient:
 
     def error(self, text: str) -> dict:
         return self.do_log("error", text)
+
+    def audit(self, text: str, prio: str = "info") -> dict:
+        """Entry on the ``audit`` channel (reference LogChannel
+        ``audit`` — administrative actions, kept in the mon's
+        separate audit ring)."""
+        return self.do_log(prio, text, channel="audit")
 
     # -- uplink ---------------------------------------------------------
 

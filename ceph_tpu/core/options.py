@@ -167,4 +167,10 @@ def build_options() -> list[Option]:
                "collect per-op spans across daemons"),
         Option("tracer_ring_size", int, 4096,
                "finished spans kept per daemon", min=1),
+        Option("tracer_sampling_rate", float, 1.0,
+               "fraction of trace roots kept (head sampling)",
+               min=0.0, max=1.0),
+        Option("tracer_span_budget", int, 0,
+               "max trace roots started per second (0 = unlimited)",
+               min=0),
     ]

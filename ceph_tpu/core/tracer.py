@@ -22,6 +22,7 @@ chrome://tracing without clock alignment.
 from __future__ import annotations
 
 import collections
+import random
 import threading
 import time
 import uuid
@@ -35,7 +36,8 @@ class Span:
     """One timed operation; finish() files it into the tracer ring."""
 
     __slots__ = ("_tracer", "trace_id", "span_id", "parent_id", "name",
-                 "daemon", "start", "duration", "tags", "events")
+                 "daemon", "start", "duration", "tags", "events",
+                 "links")
 
     def __init__(self, tracer: "Tracer", trace_id: str, span_id: str,
                  parent_id: str | None, name: str,
@@ -50,6 +52,7 @@ class Span:
         self.duration: float | None = None
         self.tags = dict(tags) if tags else {}
         self.events: list = []          # [offset_s, name] pairs
+        self.links: list = []           # [{"t","s"}] causal, non-parent
 
     def set_tag(self, key: str, value) -> None:
         self.tags[key] = value
@@ -61,6 +64,16 @@ class Span:
     def ctx(self) -> dict:
         """Wire form carried in message fields."""
         return {"t": self.trace_id, "s": self.span_id}
+
+    def add_link(self, other) -> None:
+        """Causal cross-trace reference (OTel span link): background
+        work (scrub, recovery) points at the op or event that
+        triggered it without joining its trace.  ``other`` is a Span,
+        a wire ctx dict, or None (ignored)."""
+        if isinstance(other, Span):
+            self.links.append(other.ctx())
+        elif isinstance(other, dict) and other.get("t"):
+            self.links.append({"t": other["t"], "s": other.get("s")})
 
     def finish(self) -> None:
         if self.duration is not None:       # idempotent
@@ -79,6 +92,7 @@ class Span:
             "duration": self.duration,
             "tags": dict(self.tags),
             "events": [list(e) for e in self.events],
+            "links": [dict(l) for l in self.links],
         }
 
 
@@ -90,22 +104,53 @@ class Tracer:
     the span's ``layer`` tag — the per-layer time-avg counters the
     exporter scrapes.  Unknown counter names are ignored so callers
     can tag freely.
+
+    Two throttles keep tracing affordable under load (reference
+    head-sampling; ``tracer_sampling_rate`` / ``tracer_span_budget``
+    options).  Both apply at trace ROOTS only: a sampled-out root
+    returns None and — since children pass the parent span/ctx — the
+    whole op allocates no spans anywhere, while accepted traces stay
+    complete.  The budget is a per-second token count refilled on the
+    wall-clock second boundary; the counters are unsynchronized on
+    purpose (a race overshoots by at most a few spans, and the hot
+    path takes no lock).
     """
 
     def __init__(self, daemon: str = "", ring_size: int = 4096,
-                 enabled: bool = False, perf=None):
+                 enabled: bool = False, perf=None,
+                 sampling_rate: float = 1.0, span_budget: int = 0):
         self.daemon = daemon
         self.enabled = bool(enabled)
         self.perf = perf
+        self.sampling_rate = float(sampling_rate)
+        self.span_budget = int(span_budget)     # roots/sec; 0 = off
+        self._budget_sec = 0
+        self._budget_used = 0
         self._spans: collections.deque = collections.deque(
             maxlen=max(1, int(ring_size)))
         self._lock = threading.Lock()
 
     # -- span lifecycle -------------------------------------------------
 
+    def _admit_root(self) -> bool:
+        if self.sampling_rate < 1.0 and \
+                random.random() >= self.sampling_rate:
+            return False
+        budget = self.span_budget
+        if budget > 0:
+            sec = int(time.monotonic())
+            if sec != self._budget_sec:
+                self._budget_sec = sec
+                self._budget_used = 0
+            if self._budget_used >= budget:
+                return False
+            self._budget_used += 1
+        return True
+
     def start_span(self, name: str, parent=None,
                    tags: dict | None = None) -> Span | None:
-        """New span, or None (no allocation) when tracing is off.
+        """New span, or None (no allocation) when tracing is off or
+        the root is sampled out / over budget.
 
         ``parent`` may be a live ``Span``, a wire ctx dict
         (``{"t":..,"s":..}``), or None to root a fresh trace.
@@ -117,6 +162,8 @@ class Tracer:
         elif isinstance(parent, dict) and parent.get("t"):
             trace_id, parent_id = parent["t"], parent.get("s")
         else:
+            if not self._admit_root():
+                return None
             trace_id, parent_id = _new_id(), None
         return Span(self, trace_id, _new_id(), parent_id, name, tags)
 
@@ -170,6 +217,9 @@ def chrome_trace(spans: list[dict]) -> dict:
         if s.get("events"):
             args["events"] = [f"+{off * 1e3:.3f}ms {name}"
                               for off, name in s["events"]]
+        if s.get("links"):
+            args["links"] = [f"{l.get('t')}/{l.get('s')}"
+                             for l in s["links"]]
         events.append({
             "ph": "X",
             "name": s["name"],

@@ -129,8 +129,15 @@ class PrometheusModule(MgrModule):
     def __init__(self, ctx):
         super().__init__(ctx)
         self.service = ExporterService(
-            Exporter(ctx._d.monc, ctx._d.asok_paths)).start()
+            Exporter(ctx._d.monc, ctx._d.asok_paths,
+                     progress_events=self._progress_events)).start()
         self.port = self.service.port
+
+    def _progress_events(self):
+        # lazy lookup: module construction order is undefined, so the
+        # progress module may not exist yet at our __init__
+        mod = self.ctx._d.modules.get("progress")
+        return mod.snapshot() if mod is not None else []
 
     def shutdown(self):
         self.service.shutdown()
@@ -143,12 +150,13 @@ def _default_modules():
                           TelemetryModule)
     from .devicehealth import DeviceHealthModule
     from .orchestrator import OrchestratorModule
+    from .progress import ProgressModule
     from .rbd_support import RbdSupportModule
     from .volumes import VolumesModule
     return (BalancerModule, PgAutoscalerModule, PrometheusModule,
-            StatusModule, IostatModule, CrashModule, TelemetryModule,
-            DashboardModule, VolumesModule, OrchestratorModule,
-            DeviceHealthModule, RbdSupportModule)
+            ProgressModule, StatusModule, IostatModule, CrashModule,
+            TelemetryModule, DashboardModule, VolumesModule,
+            OrchestratorModule, DeviceHealthModule, RbdSupportModule)
 
 
 class _MgrCommandServer(Dispatcher):

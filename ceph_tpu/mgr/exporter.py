@@ -24,11 +24,14 @@ def _san(name: str) -> str:
 
 
 class Exporter:
-    def __init__(self, monc, asok_paths: dict[str, str] | None = None):
+    def __init__(self, monc, asok_paths: dict[str, str] | None = None,
+                 progress_events=None):
         """monc: a MonClient; asok_paths: daemon name → admin socket
-        (scraped for perf counters)."""
+        (scraped for perf counters); progress_events: nullary callable
+        → open mgr progress events (ceph_progress_event gauge)."""
         self.monc = monc
         self.asok_paths = dict(asok_paths or {})
+        self.progress_events = progress_events
 
     def collect(self) -> str:
         lines: list[str] = []
@@ -68,6 +71,45 @@ class Exporter:
                 emit("ceph_pg_state", n,
                      labels={"state": state},
                      help_="PGs by state" if first else None)
+                first = False
+
+        # per-check health + mute gauges (reference
+        # ceph_health_detail): one series per active check code,
+        # valued by severity, plus one per muted code
+        try:
+            rc, _, rep = self.monc.command({"prefix": "health"})
+        except Exception:
+            rc, rep = -1, None
+        if rc == 0 and rep:
+            first = True
+            for chk in rep.get("checks") or []:
+                sev = 2 if chk.get("severity") == "ERR" else 1
+                emit("ceph_health_check", sev,
+                     labels={"code": chk.get("code", "")},
+                     help_="active health checks (1=WARN 2=ERR)"
+                     if first else None)
+                first = False
+            first = True
+            for chk in rep.get("muted") or []:
+                emit("ceph_health_mute", 1,
+                     labels={"code": chk.get("code", "")},
+                     help_="muted health checks" if first else None)
+                first = False
+
+        # open mgr progress events (reference ceph_progress_event)
+        if self.progress_events is not None:
+            try:
+                events = self.progress_events() or []
+            except Exception:
+                events = []
+            first = True
+            for ev in events:
+                emit("ceph_progress_event",
+                     round(float(ev.get("progress", 0.0)), 4),
+                     labels={"id": ev.get("id", ""),
+                             "message": ev.get("message", "")},
+                     help_="progress event completion fraction"
+                     if first else None)
                 first = False
 
         # cluster-wide scrub totals from the PGMap (per-OSD rates come

@@ -1,0 +1,151 @@
+"""mgr ``progress`` module — recovery/backfill/scrub progress events.
+
+Reference behavior re-created (``src/pybind/mgr/progress/module.py``;
+SURVEY.md §3.10): watch PGMap deltas and the OSDMap out-set to open,
+advance and close **progress events** — "Rebalancing after osd.3
+marked out — 42%" — with the fraction derived from outstanding
+recovery work (missing objects + backfill remainder) against the
+worst backlog seen since the event opened, so it advances
+monotonically.  Open events serve ``ceph progress`` /
+``ceph progress json`` and the ``ceph_progress_event`` exporter
+gauge; every open/advance/close is also published to the mon event
+stream (``progress publish``) so ``ceph -w`` narrates it live.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .daemon import MgrModule
+
+
+class ProgressModule(MgrModule):
+    NAME = "progress"
+    TICK = 1.0
+    MAX_COMPLETED = 20
+    # an event that never saw work (stats lag, or nothing actually
+    # moved) closes quietly after this long
+    CLEAN_GRACE = 10.0
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self.events: dict[str, dict] = {}       # open, by id
+        self.completed: list[dict] = []          # bounded, oldest first
+        self._baselines: dict[str, int] = {}     # id → worst backlog
+        self._prev_out: set[int] | None = None
+        self._dirty: list[dict] = []             # pending publishes
+
+    # -- event bookkeeping -----------------------------------------------
+
+    def _open(self, eid: str, message: str, now: float) -> dict:
+        ev = {"id": eid, "message": message, "progress": 0.0,
+              "started_at": now, "updated_at": now}
+        self.events[eid] = ev
+        self._dirty.append(dict(ev, state="open"))
+        return ev
+
+    def _close(self, eid: str, now: float):
+        ev = self.events.pop(eid, None)
+        if ev is None:
+            return
+        self._baselines.pop(eid, None)
+        ev["progress"] = 1.0
+        ev["updated_at"] = now
+        self.completed.append(ev)
+        del self.completed[:-self.MAX_COMPLETED]
+        self._dirty.append(dict(ev, state="complete"))
+
+    def _advance(self, ev: dict, frac: float, now: float):
+        if frac > ev["progress"] + 1e-9:         # monotonic only
+            ev["progress"] = min(1.0, frac)
+            ev["updated_at"] = now
+            self._dirty.append(dict(ev, state="update"))
+
+    # -- the tick ----------------------------------------------------------
+
+    def serve_tick(self):
+        m = self.ctx.get_osdmap()
+        if m is None:
+            return
+        now = time.time()
+        out = {o for o in range(m.max_osd)
+               if m.exists(o) and m.is_out(o)}
+        prev, self._prev_out = self._prev_out, out
+        try:
+            rc, _, dump = self.ctx.mon_command({"prefix": "pg dump"})
+        except Exception:       # noqa: BLE001 — mon churn: next tick
+            return
+        if rc != 0 or not dump:
+            return
+        pg_stats = dump.get("pg_stats") or {}
+        work = sum(int(st.get("missing", 0))
+                   + int(st.get("backfill_remaining", 0))
+                   for st in pg_stats.values())
+        scrubbing = sum(1 for st in pg_stats.values()
+                        if "scrubbing" in str(st.get("state", "")))
+
+        if prev is not None:
+            for o in sorted(out - prev):
+                self._open(f"osd.{o}-out",
+                           f"Rebalancing after osd.{o} marked out",
+                           now)
+            for o in sorted(prev - out):
+                self._open(f"osd.{o}-in",
+                           f"Rebalancing after osd.{o} marked in",
+                           now)
+
+        recovery = [e for e in self.events.values()
+                    if e["id"] != "scrub-sweep"]
+        if work > 0 and not recovery:
+            # degradation with no attributable map change (osd crash,
+            # lost objects): one generic recovery event
+            recovery = [self._open("recovery",
+                                   "Recovering degraded objects", now)]
+        for ev in list(recovery):
+            eid = ev["id"]
+            base = max(self._baselines.get(eid, 0), work)
+            self._baselines[eid] = base
+            if base <= 0:
+                if work == 0 and \
+                        now - ev["started_at"] > self.CLEAN_GRACE:
+                    self._close(eid, now)
+                continue
+            self._advance(ev, 1.0 - work / base, now)
+            if work == 0:
+                self._close(eid, now)
+
+        sweep = self.events.get("scrub-sweep")
+        if sweep is None and scrubbing > 0:
+            sweep = self._open("scrub-sweep",
+                               "Deep scrub sweep in progress", now)
+        if sweep is not None:
+            base = max(self._baselines.get("scrub-sweep", 0),
+                       scrubbing)
+            self._baselines["scrub-sweep"] = base
+            if base > 0:
+                self._advance(sweep, 1.0 - scrubbing / base, now)
+            if scrubbing == 0:
+                self._close("scrub-sweep", now)
+
+        if self._dirty:
+            batch, self._dirty = self._dirty, []
+            try:
+                self.ctx.mon_command({"prefix": "progress publish",
+                                      "events": batch})
+            except Exception:   # noqa: BLE001 — re-publish next time
+                self._dirty = batch + self._dirty
+
+    # -- surfaces ----------------------------------------------------------
+
+    def snapshot(self) -> list[dict]:
+        """Open events, oldest first (exporter + CLI share this)."""
+        return sorted((dict(e) for e in self.events.values()),
+                      key=lambda e: e["started_at"])
+
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix in ("progress", "progress json"):
+            return 0, "", {"events": self.snapshot(),
+                           "completed": [dict(e)
+                                         for e in self.completed]}
+        return None

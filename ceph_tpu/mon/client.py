@@ -45,6 +45,7 @@ class MonClient(Dispatcher):
         self.mgrmap_epoch = 0
         self.mgrmap_dict: dict | None = None
         self.on_mgrmap = None       # cb(epoch, mgrmap_dict)
+        self.on_event = None        # cb(kind, data, stamp) — "events"
         self._lock = threading.Lock()
 
     # -- session -----------------------------------------------------------
@@ -265,6 +266,11 @@ class MonClient(Dispatcher):
                 self.mgrmap_dict = msg.mgrmap
                 if self.on_mgrmap:
                     self.on_mgrmap(msg.epoch, msg.mgrmap)
+            return True
+        if isinstance(msg, M.MMonEvent):
+            cb = self.on_event
+            if cb is not None:
+                cb(msg.kind, msg.data, msg.stamp)
             return True
         if isinstance(msg, M.MOSDMapMsg):
             if msg.epoch >= self.osdmap_epoch:

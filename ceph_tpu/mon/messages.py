@@ -136,6 +136,16 @@ class MLog(_JsonMessage):
 
 
 @register_message
+class MMonEvent(_JsonMessage):
+    """Mon → "events" subscriber: one live event-stream record (the
+    `ceph -w` feed — reference MLog/MMonHealth pushes folded into one
+    frame).  kind: "health" | "clog" | "progress"; data: the record;
+    fwd set on leader→peer fan-out of non-paxos events (progress)."""
+    TYPE = 32
+    FIELDS = ("kind", "data", "stamp", "fwd")
+
+
+@register_message
 class MPGStats(_JsonMessage):
     """Primary OSD → mon: per-PG state/object counts (reference
     MPGStats → PGMap aggregation, ``src/mon/PGMap.cc``).  pg_stats:

@@ -36,7 +36,9 @@ from ..osd.osdmap import (CLUSTER_FLAGS, EXISTS, OSDMap, PGid,
                           TYPE_ERASURE, TYPE_REPLICATED, UP)
 from ..tools.osdmaptool import osdmap_from_dict, osdmap_to_dict
 from . import messages as M
+from .health import PG_STALE_GRACE, HealthMonitor, PGMap  # noqa: F401
 from .paxos import Elector, Paxos, VICTORY
+from .service import PaxosService
 from .store import MonitorDBStore, StoreTransaction
 
 
@@ -72,49 +74,6 @@ class MonMap:
         return cls(epoch=d["epoch"],
                    mons={int(r): EntityAddr(a[0], a[1])
                          for r, a in d["mons"].items()})
-
-
-class PaxosService:
-    NAME = "base"
-
-    def __init__(self, mon: "Monitor"):
-        self.mon = mon
-        self.pending_ops: list = []
-
-    @property
-    def prefix(self) -> str:
-        return f"svc_{self.NAME}"
-
-    def stage(self, kind: str, key, value=None):
-        self.pending_ops.append([kind, self.prefix, str(key), value])
-
-    def have_pending(self) -> bool:
-        return bool(self.pending_ops)
-
-    def take_pending(self) -> list:
-        ops, self.pending_ops = self.pending_ops, []
-        return ops
-
-    # hooks
-    def create_initial(self):
-        pass
-
-    def update_from_store(self):
-        """Reload in-memory state after a commit (all quorum members)."""
-
-    def dispatch_command(self, cmd: dict) -> tuple[int, str, object] | None:
-        """→ (rc, status, output) or None if not mine.  Mutating
-        handlers stage ops and the monitor proposes after."""
-        return None
-
-    def on_election_start(self):
-        """Leadership lost or in doubt: staged-but-unproposed ops and
-        any pending (uncommitted) working state are dead.  Subclasses
-        with extra pending fields clear them here too."""
-        self.pending_ops = []
-
-    def tick(self):
-        """Periodic leader-side work (liveness checks etc.)."""
 
 
 class OSDMonitor(PaxosService):
@@ -1233,32 +1192,71 @@ class ConfigMonitor(PaxosService):
 
 
 class LogMonitor(PaxosService):
+    """Paxos-backed cluster log, one ring per channel (reference
+    ``LogMonitor.cc`` log channels): ``cluster`` keeps the legacy
+    bare-``seq`` store keys, every other channel (``audit``) gets its
+    own ``<channel>_seq`` / ``<channel>_<n>`` keyspace.  Committed
+    entries are also fanned to event-stream subscribers (``ceph -w``)
+    from every quorum member."""
+
     NAME = "log"
+    CHANNELS = ("cluster", "audit")
 
     def __init__(self, mon):
         super().__init__(mon)
-        self._staged_seq = 0   # beyond the committed 'seq'
+        self._staged_seq: dict[str, int] = {}  # beyond committed seq
+        self._pushed_seq: dict[str, int] = {}  # last seq fanned out
+
+    def _seq_key(self, channel: str) -> str:
+        return "seq" if channel == "cluster" else f"{channel}_seq"
+
+    def _entry_key(self, channel: str, seq: int) -> str:
+        return str(seq) if channel == "cluster" else f"{channel}_{seq}"
 
     def on_election_start(self):
         # staged entries died with the queue; keeping their seqs would
         # commit the next entry past a permanent hole in the log
         super().on_election_start()
-        self._staged_seq = 0
+        self._staged_seq = {}
 
     def update_from_store(self):
-        committed = self.mon.store.get_int(self.prefix, "seq")
-        if committed >= self._staged_seq:
-            self._staged_seq = 0
+        for channel in self.CHANNELS:
+            committed = self.mon.store.get_int(
+                self.prefix, self._seq_key(channel))
+            if committed >= self._staged_seq.get(channel, 0):
+                self._staged_seq.pop(channel, None)
+            last = self._pushed_seq.get(channel)
+            if last is None:
+                # boot-time replay: start the live feed here, don't
+                # spray the whole committed history at subscribers
+                self._pushed_seq[channel] = committed
+                continue
+            if committed > last:
+                for s in range(last + 1, committed + 1):
+                    blob = self.mon.store.get_str(
+                        self.prefix, self._entry_key(channel, s))
+                    if blob:
+                        self.mon.push_event("clog", json.loads(blob))
+                self._pushed_seq[channel] = committed
 
     def _stage_entries(self, entries: list[dict]):
-        """Append a batch at monotonic seqs and propose once."""
-        seq = max(self.mon.store.get_int(self.prefix, "seq"),
-                  self._staged_seq)
-        for entry in entries:
-            seq += 1
-            self.stage("put", seq, json.dumps(entry))
-        self._staged_seq = seq
-        self.stage("put", "seq", str(seq))
+        """Append a batch at per-channel monotonic seqs, propose once."""
+        by_chan: dict[str, list] = {}
+        for e in entries:
+            chan = e.get("channel") or "cluster"
+            if chan not in self.CHANNELS:
+                chan = "cluster"
+            by_chan.setdefault(chan, []).append(e)
+        for channel, batch in by_chan.items():
+            seq = max(self.mon.store.get_int(self.prefix,
+                                             self._seq_key(channel)),
+                      self._staged_seq.get(channel, 0))
+            for entry in batch:
+                seq += 1
+                self.stage("put", self._entry_key(channel, seq),
+                           json.dumps(entry))
+            self._staged_seq[channel] = seq
+            self.stage("put", self._seq_key(channel), str(seq))
         self.mon.propose()
 
     def handle_log(self, entries) -> int:
@@ -1286,255 +1284,25 @@ class LogMonitor(PaxosService):
                 "text": cmd.get("logtext", "")}])
             return 0, "logged", None
         if prefix == "log last":
-            return 0, "", self.last(int(cmd.get("num", 20)))
+            channel = str(cmd.get("channel") or "cluster")
+            if channel not in self.CHANNELS:
+                return -22, f"unknown log channel {channel!r}", None
+            return 0, "", self.last(int(cmd.get("num", 20)),
+                                    channel=channel)
         return None
 
-    def last(self, n: int = 20) -> list[dict]:
-        """Tail of the committed ring, oldest first."""
-        seq = self.mon.store.get_int(self.prefix, "seq")
+    def last(self, n: int = 20,
+             channel: str = "cluster") -> list[dict]:
+        """Tail of one channel's committed ring, oldest first."""
+        seq = self.mon.store.get_int(self.prefix,
+                                     self._seq_key(channel))
         out = []
         for s in range(max(1, seq - n + 1), seq + 1):
-            blob = self.mon.store.get_str(self.prefix, s)
+            blob = self.mon.store.get_str(self.prefix,
+                                          self._entry_key(channel, s))
             if blob:
                 out.append(json.loads(blob))
         return out
-
-
-PG_STALE_GRACE = 6.0     # seconds without a primary report → stale
-
-
-class PGMap:
-    """Cluster-wide PG state aggregation (reference ``src/mon/
-    PGMap.cc``; held in memory on the leader like the modern mgr's
-    copy — stats are telemetry, not paxos state)."""
-
-    def __init__(self):
-        # pgid str → {"state", "num_objects", ..., "osd", "stamp"}
-        self.pg_stats: dict[str, dict] = {}
-        self.osd_stats: dict[int, dict] = {}
-
-    def apply_report(self, osd: int, pg_stats: dict, osd_stats: dict):
-        now = time.time()
-        for pgid, st in (pg_stats or {}).items():
-            st = dict(st)
-            st["osd"] = osd
-            st["stamp"] = now
-            self.pg_stats[pgid] = st
-        if osd_stats:
-            self.osd_stats[osd] = dict(osd_stats, stamp=now)
-
-    def prune(self, live_pools: set[int]):
-        """Drop stats for PGs of deleted pools — their primaries stop
-        reporting, and without pruning they'd read as stale forever
-        (reference: PGMap consumes pool deletions from the OSDMap)."""
-        for pgid in list(self.pg_stats):
-            try:
-                pool = int(pgid.split(".", 1)[0])
-            except ValueError:
-                pool = -1
-            if pool not in live_pools:
-                del self.pg_stats[pgid]
-
-    def states(self, total_expected: int | None = None) -> dict:
-        """state string → count; primaries silent past the grace are
-        'stale+<last state>', PGs never reported at all are
-        'unknown' (reference pg states of the same names)."""
-        now = time.time()
-        out: dict[str, int] = {}
-        for st in self.pg_stats.values():
-            s = st.get("state", "unknown")
-            if now - st["stamp"] > PG_STALE_GRACE:
-                s = f"stale+{s}"
-            out[s] = out.get(s, 0) + 1
-        if total_expected is not None:
-            known = len(self.pg_stats)
-            if total_expected > known:
-                out["unknown"] = out.get("unknown", 0) + \
-                    (total_expected - known)
-        return out
-
-    def num_objects(self) -> int:
-        return sum(int(st.get("num_objects", 0))
-                   for st in self.pg_stats.values())
-
-    def pool_usage(self, live_pools: set[int]) -> dict[int, list]:
-        """pool id → [objects, bytes], pruned to live pools first so
-        a deleted pool's stale stats can't count against a reused
-        id."""
-        self.prune(live_pools)
-        usage: dict[int, list] = {}
-        for pgid_s, st in self.pg_stats.items():
-            try:
-                pid = int(pgid_s.split(".", 1)[0])
-            except ValueError:
-                continue
-            row = usage.setdefault(pid, [0, 0])
-            row[0] += int(st.get("num_objects", 0))
-            row[1] += int(st.get("num_bytes", 0))
-        return usage
-
-
-class HealthMonitor(PaxosService):
-    NAME = "health"
-
-    def dispatch_command(self, cmd):
-        prefix = cmd.get("prefix", "")
-        if prefix == "pg dump":
-            self.mon.pgmap.prune(
-                set(self.mon.services["osdmap"].osdmap.pools))
-            return 0, "", {"pg_stats": self.mon.pgmap.pg_stats,
-                           "osd_stats": {
-                               str(o): s for o, s in
-                               self.mon.pgmap.osd_stats.items()}}
-        if prefix == "pg list-inconsistent-obj":
-            # the `rados list-inconsistent-obj` backend: the primary's
-            # last scrub report as carried by MPGStats into the PGMap
-            pgid = str(cmd.get("pgid", ""))
-            st = self.mon.pgmap.pg_stats.get(pgid)
-            if st is None:
-                return -2, f"no stats for pg {pgid!r}", None
-            return 0, "", {
-                "epoch": self.mon.services["osdmap"].osdmap.epoch,
-                "inconsistents": st.get("inconsistent_objects", [])}
-        if prefix == "df":
-            # per-pool usage from PGMap (reference `ceph df`:
-            # PGMap::dump_cluster_stats + per-pool sums)
-            osdsvc = self.mon.services["osdmap"]
-            m = osdsvc.osdmap
-            usage = self.mon.pgmap.pool_usage(set(m.pools))
-            out = {"pools": []}
-            for name, pid in sorted(m.pool_name.items()):
-                pool = m.pools.get(pid)
-                row = usage.get(pid, [0, 0])
-                out["pools"].append({
-                    "name": name, "id": pid,
-                    "pg_num": pool.pg_num if pool else 0,
-                    "objects": row[0],
-                    "bytes_used": row[1]})
-            out["total_objects"] = sum(p["objects"]
-                                       for p in out["pools"])
-            out["total_bytes_used"] = sum(p["bytes_used"]
-                                          for p in out["pools"])
-            return 0, "", out
-        if prefix == "osd df":
-            # per-osd utilization (reference `ceph osd df`)
-            osdsvc = self.mon.services["osdmap"]
-            m = osdsvc.osdmap
-            rows = []
-            for o, st in sorted(self.mon.pgmap.osd_stats.items()):
-                rows.append({
-                    "osd": o,
-                    "up": m.is_up(o) if o < m.max_osd else False,
-                    "num_pgs": int(st.get("num_pgs", 0)),
-                    "ops": int(st.get("op", 0))})
-            return 0, "", {"nodes": rows}
-        if prefix in ("health", "status", "pg stat"):
-            osdsvc: OSDMonitor = self.mon.services["osdmap"]
-            m = osdsvc.osdmap
-            self.mon.pgmap.prune(set(m.pools))
-            total_pgs = sum(p.pg_num for p in m.pools.values())
-            states = self.mon.pgmap.states(total_expected=total_pgs)
-            if prefix == "pg stat":
-                return 0, "", {"num_pgs": total_pgs, "states": states}
-            checks = []
-            quorum = set(self.mon.elector.quorum or [])
-            absent = [r for r in self.mon.monmap.ranks()
-                      if r not in quorum]
-            if absent and quorum:
-                checks.append({
-                    "code": "MON_DOWN",
-                    "summary": f"{len(absent)}/"
-                               f"{len(self.mon.monmap.ranks())} mons "
-                               f"out of quorum",
-                    "detail": [f"mon.{r} not in quorum"
-                               for r in absent]})
-            down = [o for o in range(m.max_osd)
-                    if m.exists(o) and not m.is_up(o)]
-            if down:
-                checks.append({"code": "OSD_DOWN",
-                               "summary": f"{len(down)} osds down",
-                               "detail": [f"osd.{o} down" for o in down]})
-            # SLOW_OPS: OSDs report op_tracker slow-op counts in their
-            # osd_stats (reference health check of the same name) —
-            # per-OSD attribution + the worst blocked age cluster-wide
-            slow_osds = []
-            now = time.time()
-            for o, st in sorted(self.mon.pgmap.osd_stats.items()):
-                if now - st.get("stamp", 0.0) > PG_STALE_GRACE and \
-                        not (o < m.max_osd and m.is_up(o)):
-                    continue    # dead OSD's last report: not "slow"
-                s = st.get("slow_ops") or {}
-                if int(s.get("count", 0)) > 0:
-                    slow_osds.append((o, int(s["count"]),
-                                      float(s.get("oldest_age", 0.0)),
-                                      s.get("oldest_desc", "")))
-            if slow_osds:
-                n_slow = sum(c for _o, c, _a, _d in slow_osds)
-                worst = max(a for _o, _c, a, _d in slow_osds)
-                checks.append({
-                    "code": "SLOW_OPS",
-                    "summary": f"{n_slow} slow ops, oldest one "
-                               f"blocked for {worst:.0f} sec, "
-                               f"daemons [" + ",".join(
-                                   f"osd.{o}" for o, _c, _a, _d
-                                   in slow_osds) + "] have slow ops",
-                    "detail": [
-                        f"osd.{o}: {c} slow ops, oldest {a:.1f}s"
-                        + (f" ({d})" if d else "")
-                        for o, c, a, d in slow_osds]})
-            flags_set = sorted(n for n, bit in CLUSTER_FLAGS.items()
-                               if m.flags & bit)
-            if flags_set:
-                checks.append({
-                    "code": "OSDMAP_FLAGS",
-                    "summary": f"{','.join(flags_set)} flag(s) set",
-                    "detail": [f"{f} is set" for f in flags_set]})
-            full_pools = [n for n, pid in m.pool_name.items()
-                          if m.pools[pid].full]
-            if full_pools:
-                checks.append({
-                    "code": "POOL_FULL",
-                    "summary": f"{len(full_pools)} pool(s) over "
-                               "quota",
-                    "detail": [f"pool '{n}' is full (quota)"
-                               for n in sorted(full_pools)]})
-            unhealthy = {s: n for s, n in states.items()
-                         if s not in ("active", "active+clean")}
-            degraded = {s: n for s, n in states.items()
-                        if "active" in s and "clean" not in s}
-            if degraded:
-                checks.append({
-                    "code": "PG_DEGRADED",
-                    "summary": f"{sum(degraded.values())} pgs not clean",
-                    "detail": [f"{n} pgs {s}"
-                               for s, n in sorted(degraded.items())]})
-            stuck = {s: n for s, n in unhealthy.items()
-                     if s.split("+")[0] in ("peering", "incomplete",
-                                            "down", "stale", "unknown")}
-            if stuck:
-                checks.append({
-                    "code": "PG_AVAILABILITY",
-                    "summary": f"{sum(stuck.values())} pgs stuck "
-                               f"({'/'.join(sorted(stuck))})",
-                    "detail": [f"{n} pgs {s}"
-                               for s, n in sorted(stuck.items())]})
-            status = ("HEALTH_OK" if not checks else "HEALTH_WARN")
-            out = {"health": status, "checks": checks}
-            if prefix == "status":
-                out.update({
-                    "quorum": self.mon.elector.quorum,
-                    "leader": self.mon.elector.leader,
-                    "monmap_epoch": self.mon.monmap.epoch,
-                    "osdmap_epoch": m.epoch,
-                    "num_osds": m.max_osd,
-                    "num_up_osds": m.num_up_osds(),
-                    "pools": sorted(m.pool_name),
-                    "num_pgs": total_pgs,
-                    "pg_states": states,
-                    "num_objects": self.mon.pgmap.num_objects(),
-                })
-            return 0, status, out
-        return None
 
 
 class Monitor(Dispatcher):
@@ -1760,6 +1528,33 @@ class Monitor(Dispatcher):
         for con in dead:
             self._subs.pop(con, None)
 
+    def push_event(self, kind: str, data: dict):
+        """Fan one event-stream record (health transition, clog entry,
+        progress update) to THIS mon's "events" subscribers — the
+        `ceph -w` feed.  Paxos-backed events reach every mon through
+        update_from_store; non-paxos ones ride broadcast_event."""
+        dead = []
+        for con, subs in self._subs.items():
+            if "events" in subs:
+                try:
+                    con.send_message(M.MMonEvent(
+                        kind=kind, data=data, stamp=time.time()))
+                except ConnectionError:
+                    dead.append(con)
+        for con in dead:
+            self._subs.pop(con, None)
+
+    def broadcast_event(self, kind: str, data: dict):
+        """Leader-side: push locally AND forward one hop to every
+        quorum peer so their subscribers see it too (progress events
+        don't ride paxos — same fan-out idiom as MPGStats)."""
+        self.push_event(kind, data)
+        for r in (self.elector.quorum or []):
+            if r != self.rank:
+                self._peer_send(r, M.MMonEvent(kind=kind, data=data,
+                                               stamp=time.time(),
+                                               fwd=1))
+
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:
         with self.lock:
@@ -1846,6 +1641,43 @@ class Monitor(Dispatcher):
                         mgrmap=dict(mgrsvc.mgrmap)))
                 except ConnectionError:
                     self._subs.pop(msg.connection, None)
+            if "events" in subs:
+                # catch-up snapshot so a watcher joining a quiet
+                # cluster knows the current rollup immediately
+                # (wait_for_health_ok must not hang on HEALTH_OK).
+                # Evaluated live on the leader (only it holds the
+                # PGMap), not from the committed report: the commit
+                # path trails the tick, and a stale HEALTH_OK here
+                # would release waiters on a cluster that just went
+                # unhealthy.  A live/committed mismatch also stages a
+                # catch-up evaluation so the transition events the
+                # watcher will block on are actually emitted.
+                hsvc = self.services["health"]
+                report = hsvc.report or {}
+                if self.is_leader:
+                    try:
+                        report = hsvc._live_report()
+                        if report != (hsvc.report or {}):
+                            hsvc._evaluate_and_stage(time.time())
+                    except Exception:   # noqa: BLE001 — mid-election
+                        report = hsvc.report or {}
+                data = {"state": "snapshot",
+                        "status": report.get("status"),
+                        "checks": [c["code"] for c in
+                                   report.get("checks") or []],
+                        "muted": [c["code"] for c in
+                                  report.get("muted") or []]}
+                try:
+                    msg.connection.send_message(M.MMonEvent(
+                        kind="health", data=data, stamp=time.time()))
+                except ConnectionError:
+                    self._subs.pop(msg.connection, None)
+            return True
+        if isinstance(msg, M.MMonEvent):
+            # leader → peer fan-out of non-paxos events (progress):
+            # re-push to OUR subscribers, never forward again
+            if msg.fwd:
+                self.push_event(msg.kind, msg.data)
             return True
         if isinstance(msg, M.MMgrBeacon):
             if self.is_leader:
@@ -1947,11 +1779,23 @@ class Monitor(Dispatcher):
             rc, outs, outb = 0, "", {
                 "quorum": self.quorum, "leader": self.elector.leader,
                 "rank": self.rank, "state": self.elector.state}
+        elif cmd.get("prefix") == "progress publish":
+            # active mgr's progress module → every mon's `ceph -w`
+            # subscribers (mutating-routed here, so we ARE the leader)
+            n = 0
+            for ev in (cmd.get("events") or []):
+                if isinstance(ev, dict):
+                    self.broadcast_event("progress", ev)
+                    n += 1
+            rc, outs, outb = 0, f"published {n} events", None
         else:
             # a malformed command (missing key, bad type) must produce
             # a -22 reply, not an unhandled exception: the messenger
             # swallows dispatcher exceptions, so raising here would
             # leave the client waiting out its full timeout
+            qlen_before = len(self._proposal_queue)
+            was_updating = self.paxos.state == "updating"
+            committed_before = self.paxos.last_committed
             try:
                 for svc in self.services.values():
                     res = svc.dispatch_command(cmd)
@@ -1966,6 +1810,27 @@ class Monitor(Dispatcher):
                 # EAGAIN so the client retries instead of waiting out
                 # its timeout on silence or failing fast on a blip
                 rc, outs, outb = -11, f"internal: {e!r}", None
+            if rc == 0 and (len(self._proposal_queue) > qlen_before
+                            or (not was_updating
+                                and self.paxos.state == "updating")
+                            or self.paxos.last_committed > committed_before
+                            or any(svc.have_pending()
+                                   for svc in self.services.values())):
+                # the dispatch queued a paxos round ⇒ the command
+                # actually mutated state (read-only commands that are
+                # merely leader-routed never trip this) → audit trail.
+                # On a single mon propose() commits synchronously under
+                # the mon lock — the queue is drained and paxos is back
+                # to "active" by the time dispatch returns — so a
+                # last_committed advance (or ops still staged for the
+                # next round) is equally valid mutation evidence.
+                # (reference: mon audit log channel)
+                self.services["log"]._stage_entries([{
+                    "stamp": time.time(), "name": self.name,
+                    "channel": "audit", "prio": "info",
+                    "text": "from='client' cmd="
+                            + json.dumps(cmd, default=str)
+                            + ": dispatch"}])
 
         def reply(rc=rc, outs=outs, outb=outb):
             try:

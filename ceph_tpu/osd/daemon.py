@@ -147,10 +147,21 @@ class OSDaemon(Dispatcher):
             daemon=f"osd.{whoami}",
             ring_size=int(self.config.get("tracer_ring_size") or 4096),
             enabled=bool(self.config.get("jaeger_tracing_enable")),
-            perf=self.perf)
+            perf=self.perf,
+            sampling_rate=float(
+                self.config.get("tracer_sampling_rate") or 1.0),
+            span_budget=int(
+                self.config.get("tracer_span_budget") or 0))
         self.config.add_observer(
             "jaeger_tracing_enable",
             lambda _n, v: setattr(self.tracer, "enabled", bool(v)))
+        self.config.add_observer(
+            "tracer_sampling_rate",
+            lambda _n, v: setattr(self.tracer, "sampling_rate",
+                                  float(v)))
+        self.config.add_observer(
+            "tracer_span_budget",
+            lambda _n, v: setattr(self.tracer, "span_budget", int(v)))
         self.admin_socket = AdminSocket(
             admin_socket_path or default_path(f"osd.{whoami}"))
         self._register_admin_commands()
@@ -449,7 +460,8 @@ class OSDaemon(Dispatcher):
                         con.send_message(M.MOSDOpReply(
                             tid=msg.tid, rc=-5, outs="op faulted",
                             results=None, version=[0, 0],
-                            epoch=self.osdmap.epoch))
+                            epoch=self.osdmap.epoch,
+                            trace=getattr(msg, "trace", None)))
                     except ConnectionError:
                         pass
 
@@ -485,7 +497,8 @@ class OSDaemon(Dispatcher):
         implies deep (a shallow pass can't see what to repair)."""
         deep = bool(getattr(msg, "repair", False)) or \
             getattr(msg, "deep", True) is not False
-        if pg.start_scrub(deep=deep):
+        if pg.start_scrub(deep=deep,
+                          trigger=getattr(msg, "trace", None)):
             return
         tries = getattr(msg, "_scrub_tries", 0)
         if tries >= max_tries:
@@ -932,6 +945,10 @@ class OSDaemon(Dispatcher):
                 "log_size": len(pg.log.entries),
                 "missing": len(pg.missing) + sum(
                     len(pm) for pm in pg.peer_missing.values()),
+                # misplaced-work analogue: what backfill still owes —
+                # the mgr progress module derives its fraction from
+                # missing + backfill_remaining deltas
+                "backfill_remaining": pg.backfill_remaining(),
                 "last_scrub": pg.last_scrub,
                 "last_deep_scrub": pg.last_deep_scrub,
                 "scrub_errors": pg.scrub_errors,
@@ -1123,7 +1140,8 @@ class OSDaemon(Dispatcher):
                 msg.connection.send_message(M.MOSDOpReply(
                     tid=msg.tid, rc=-11, outs="pg not here",
                     results=None, version=[0, 0],
-                    epoch=self.osdmap.epoch))
+                    epoch=self.osdmap.epoch,
+                    trace=getattr(msg, "trace", None)))
             except (ConnectionError, AttributeError):
                 pass
             return

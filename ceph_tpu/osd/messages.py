@@ -39,10 +39,12 @@ class MOSDOp(_JsonMessage):
 class MOSDOpReply(_JsonMessage):
     """``dmc_phase``: which dmclock phase served the op —
     "reservation" or "priority" (reference PhaseType riding the
-    reply) — the client's tracker feeds it back as rho."""
+    reply) — the client's tracker feeds it back as rho.
+    ``trace``: the OSD-side span ctx (``{"t","s"}``) echoed back so
+    the client's wire_recv span nests under the server's trace."""
     TYPE = 41
     FIELDS = ("tid", "rc", "outs", "results", "version", "epoch",
-              "dmc_phase")
+              "dmc_phase", "trace")
 
 
 @register_message
@@ -163,9 +165,11 @@ class MOSDRepScrub(_JsonMessage):
     """Primary → acting member: build and return your scrub map for
     this PG (reference MOSDRepScrub → replica ScrubMap build).
     ``deep``: read payloads and digest them (deep scrub); shallow
-    maps carry sizes/versions only."""
+    maps carry sizes/versions only.  ``trace``: the primary's scrub
+    span ctx so replica map-build spans link to the sweep."""
     TYPE = 55
-    FIELDS = ("pgid", "epoch", "scrub_tid", "from_osd", "deep")
+    FIELDS = ("pgid", "epoch", "scrub_tid", "from_osd", "deep",
+              "trace")
 
 
 @register_message

@@ -669,9 +669,23 @@ class PG:
         for fn in waiters:
             fn()
 
-    def _kick_recovery(self):
+    def _kick_recovery(self, trigger=None):
         if not self.is_primary:
             return
+        # marker span for the background work burst; `trigger` (the
+        # blocked op's span, or the scrub that queued repairs via
+        # _scrub_trace) becomes a span LINK — causal, not parental:
+        # recovery outlives and out-fans any single op's trace
+        span = self.daemon.tracer.start_span(
+            "recovery_kick", tags={
+                "layer": "recovery", "pgid": str(self.pgid),
+                "missing": len(self.missing),
+                "peer_missing": sum(len(pm) for pm in
+                                    self.peer_missing.values())})
+        if span is not None:
+            span.add_link(trigger if trigger is not None
+                          else getattr(self, "_scrub_trace", None))
+            span.finish()
         # pull what WE miss first (clients read from us)
         for oid, ver in list(self.missing.items()):
             if ver is None:
@@ -769,6 +783,23 @@ class PG:
                 st["pending"].add(oid)
                 self.backend.push_object(
                     o, oid, self._object_version_onstore(oid))
+
+    def backfill_remaining(self) -> int:
+        """Objects still to push across all backfill targets —
+        progress telemetry for MPGStats (reference pg_stat_t
+        misplaced counts).  A target whose scan hasn't started counts
+        its full listing (min 1 so pending work never reads as 0)."""
+        import bisect
+        rem = 0
+        for st in self.backfill_targets.values():
+            objs = st["objs"]
+            if objs is None:
+                rem += max(1, len(self._list_objects()))
+            else:
+                rem += len(st["pending"]) + max(
+                    0, len(objs) - bisect.bisect_right(
+                        objs, st["cursor"]))
+        return rem
 
     def _maybe_clean(self):
         if self.state == "active" and not self.missing and \
@@ -885,7 +916,8 @@ class PG:
         if self.is_degraded_object(oid) and \
                 not self._supersedes_object(msg):
             self.wait_for_object(oid, lambda: self.do_op(msg))
-            self._kick_recovery()
+            self._kick_recovery(trigger=getattr(
+                getattr(msg, "tracked", None), "span", None))
             return
         if self._maybe_promote(msg):
             return      # parked; requeued when the promote lands
@@ -1006,6 +1038,12 @@ class PG:
             for idx, res in call_results.items():
                 if idx < len(results):
                     results[idx] = res
+        # capture the server-side span ctx BEFORE finish_tracked nulls
+        # msg.tracked: the reply echoes it so the client's wire_recv
+        # span nests under the OSD's op span, not the client root
+        span = getattr(getattr(msg, "tracked", None), "span", None)
+        trace = span.ctx() if span is not None \
+            else getattr(msg, "trace", None)
         tracked = self.finish_tracked(msg, "replied")
         if tracked is not None:
             self.daemon.perf.tinc("op_latency", tracked.age)
@@ -1019,7 +1057,8 @@ class PG:
             msg.connection.send_message(M.MOSDOpReply(
                 tid=msg.tid, rc=rc, outs=outs, results=results,
                 version=list(version), epoch=self.daemon.osdmap.epoch,
-                dmc_phase=getattr(msg, "_dmc_phase", None)))
+                dmc_phase=getattr(msg, "_dmc_phase", None),
+                trace=trace))
         except (ConnectionError, AttributeError):
             pass
 
@@ -1205,7 +1244,7 @@ class PG:
     # scrub (reference src/osd/scrubber/: primary gathers a ScrubMap
     # from every acting member, compares, repairs from survivors)
     # =======================================================================
-    def start_scrub(self, deep: bool = True) -> bool:
+    def start_scrub(self, deep: bool = True, trigger=None) -> bool:
         """Primary: kick a scrub round.  False if the PG can't scrub
         now (not primary / not active / already scrubbing / writes in
         flight — scrub maps must not race uncommitted writes).
@@ -1229,6 +1268,19 @@ class PG:
         self._scrub_deep = bool(deep)
         self._scrub_started = time.monotonic()
         self._scrub_tid += 1
+        # the sweep span covers the whole round (local map build →
+        # replica maps → compare); `trigger` — the operator command or
+        # scheduler event that kicked it — rides as a span link, and
+        # the ctx travels in MOSDRepScrub so replica digest spans link
+        # back to this sweep
+        span = self.daemon.tracer.start_span(
+            "pg_scrub", tags={"layer": "scrub",
+                              "pgid": str(self.pgid),
+                              "deep": bool(deep)})
+        if span is not None:
+            span.add_link(trigger)
+        self._scrub_span = span
+        self._scrub_trace = span.ctx() if span is not None else None
         self._scrub_maps = {
             self.daemon.whoami: self.backend.build_scrub_map(deep=deep)}
         self._scrub_waiting = set(self._peer_osds())
@@ -1236,18 +1288,25 @@ class PG:
             self.daemon.send_to_osd(o, M.MOSDRepScrub(
                 pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
                 scrub_tid=self._scrub_tid,
-                from_osd=self.daemon.whoami, deep=bool(deep)))
+                from_osd=self.daemon.whoami, deep=bool(deep),
+                trace=self._scrub_trace))
         self._maybe_finish_scrub()
         return True
 
     def handle_rep_scrub(self, msg: M.MOSDRepScrub):
         """Acting member: walk my collection, return the scrub map."""
+        # expose the primary's sweep ctx so the backend's crc_digest
+        # span links to it, then drop it (we are not the sweep owner)
+        self._scrub_trace = getattr(msg, "trace", None)
+        try:
+            objects = self.backend.build_scrub_map(
+                deep=msg.deep is not False)
+        finally:
+            self._scrub_trace = None
         self.daemon.send_to_osd(msg.from_osd, M.MOSDRepScrubMap(
             pgid=str(self.pgid), epoch=self.daemon.osdmap.epoch,
             scrub_tid=msg.scrub_tid, shard=self.shard,
-            objects=self.backend.build_scrub_map(
-                deep=msg.deep is not False),
-            from_osd=self.daemon.whoami))
+            objects=objects, from_osd=self.daemon.whoami))
 
     def handle_scrub_map(self, msg: M.MOSDRepScrubMap):
         if not self.scrubbing or msg.scrub_tid != self._scrub_tid:
@@ -1274,10 +1333,17 @@ class PG:
             self.last_deep_scrub = self.last_scrub
         self.scrubbing = False
         self._scrub_maps = {}
+        span = getattr(self, "_scrub_span", None)
+        if span is not None:
+            span.set_tag("errors", errors)
+            span.finish()
+            self._scrub_span = None
         if errors:
-            # repair queued as recovery state by scrub_compare
+            # repair queued as recovery state by scrub_compare;
+            # _scrub_trace still set → recovery_kick links to the sweep
             self.state = "active"
             self._kick_recovery()
+        self._scrub_trace = None
         # release writes that queued behind the scrub
         waiters, self.waiting_for_active = self.waiting_for_active, []
         for fn in waiters:
@@ -1293,6 +1359,12 @@ class PG:
             self.scrubbing = False
             self._scrub_maps = {}
             self._scrub_waiting = set()
+            span = getattr(self, "_scrub_span", None)
+            if span is not None:
+                span.set_tag("timeout", True)
+                span.finish()
+                self._scrub_span = None
+            self._scrub_trace = None
             waiters, self.waiting_for_active = \
                 self.waiting_for_active, []
             for fn in waiters:
@@ -1644,6 +1716,8 @@ class ReplicatedBackend:
                     "layer": "device", "kernel": "crc32c",
                     "pgid": str(pg.pgid), "objects": len(payloads),
                     "bytes": sum(len(b) for b in payloads.values())})
+            if span is not None:
+                span.add_link(getattr(pg, "_scrub_trace", None))
             for oid, digest in eng.compute_digests(payloads).items():
                 out[oid]["crc"] = digest
             if span is not None:
@@ -2769,6 +2843,8 @@ class ECBackend:
                     "layer": "device", "kernel": "crc32c",
                     "pgid": str(pg.pgid), "objects": len(chunks),
                     "bytes": sum(len(b) for b in chunks.values())})
+            if span is not None:
+                span.add_link(getattr(pg, "_scrub_trace", None))
             for oid, digest in eng.compute_digests(chunks).items():
                 hinfo = metas[oid].get("hinfo")
                 out[oid].update(
@@ -2867,6 +2943,8 @@ class ECBackend:
             "parity_recheck", tags={
                 "layer": "device", "kernel": "gf_encode",
                 "pgid": str(pg.pgid), "stripes": len(stripes)})
+        if span is not None:
+            span.add_link(getattr(pg, "_scrub_trace", None))
         verdicts = eng.recheck_parity(ec, stripes)
         if span is not None:
             span.set_tag("bytes", eng.parity_bytes - before)

@@ -80,7 +80,11 @@ class Rados:
                   "tracing": bool(
                       self.config.get("jaeger_tracing_enable")),
                   "tracer_ring": int(
-                      self.config.get("tracer_ring_size"))}
+                      self.config.get("tracer_ring_size")),
+                  "tracer_sampling_rate": float(
+                      self.config.get("tracer_sampling_rate")),
+                  "tracer_span_budget": int(
+                      self.config.get("tracer_span_budget"))}
         self.objecter = Objecter(self.monmap, entity=self.name,
                                  auth=self.auth, **kw)
         self.objecter.wait_for_osdmap(1, timeout)

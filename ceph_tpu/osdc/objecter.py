@@ -120,7 +120,9 @@ class Objecter(Dispatcher):
                  resend_max: float = 16.0,
                  resend_jitter: float = 0.25,
                  backoff_expire: float = 10.0, auth=None,
-                 tracing: bool = False, tracer_ring: int = 4096):
+                 tracing: bool = False, tracer_ring: int = 4096,
+                 tracer_sampling_rate: float = 1.0,
+                 tracer_span_budget: int = 0):
         # a per-session nonce joins the entity name in every reqid:
         # two sessions of the same client name must never collide in
         # the OSDs' dup-op log (the reference's osd_reqid_t carries
@@ -134,7 +136,9 @@ class Objecter(Dispatcher):
         # op tracing: the root span of every client op starts here;
         # its ctx rides the MOSDOp so the OSD's spans join the trace
         self.tracer = Tracer(daemon=entity, ring_size=tracer_ring,
-                             enabled=tracing)
+                             enabled=tracing,
+                             sampling_rate=tracer_sampling_rate,
+                             span_budget=tracer_span_budget)
         self.msgr.tracer = self.tracer
         self.osdmap = OSDMap()
         self.lock = threading.RLock()
@@ -440,6 +444,15 @@ class Objecter(Dispatcher):
             if getattr(msg, "dmc_phase", None) == "reservation":
                 self._dmc_res += 1
         if op.span is not None:
+            # the reply echoes the OSD-side span ctx: nest the
+            # client's receive under the server's op span when
+            # present so the cross-daemon trace reads send→serve→recv
+            rspan = self.tracer.start_span(
+                "wire_recv",
+                parent=getattr(msg, "trace", None) or op.span,
+                tags={"layer": "wire", "rc": msg.rc})
+            if rspan is not None:
+                rspan.finish()
             op.span.set_tag("rc", msg.rc)
             op.span.set_tag("attempts", op.attempts)
             op.span.finish()

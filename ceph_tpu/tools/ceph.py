@@ -1,6 +1,11 @@
 """ceph CLI — mon command dispatch (reference ``src/ceph.in``).
 
     ceph -m HOST:PORT[,...] status|-s | health | df | osd df
+    ceph -m ... -w [--count N] [--timeout S]   (live event stream)
+    ceph -m ... health detail | health history
+    ceph -m ... health mute CODE [TTL_SECONDS] [--sticky]
+    ceph -m ... health unmute CODE
+    ceph -m ... progress [json]   (mgr progress events)
     ceph -m ... pg stat | pg dump
     ceph -m ... osd tree | osd dump | osd stat | osd pool ls
     ceph -m ... osd pool create NAME [--pg-num N] [--size N] [--type T]
@@ -8,7 +13,7 @@
     ceph -m ... osd reweight ID WEIGHT
     ceph -m ... osd pool mksnap POOL SNAP | rmsnap POOL SNAP
     ceph -m ... osd pg-upmap-items PGID FROM TO [FROM TO ...]
-    ceph -m ... log last [N] | log MESSAGE...
+    ceph -m ... log last [N] [cluster|audit] | log MESSAGE...
     ceph -m ... daemon SOCK_PATH COMMAND [k=v ...]
         (e.g. daemon <asok> dump_tracing | trace start|stop|clear |
          dump_historic_ops_by_duration | perf histogram dump)
@@ -72,6 +77,21 @@ def _dispatch(args, rest) -> int:
         out = admin_command(sock, " ".join(words), **kvs)
         print(json.dumps(out, indent=2, default=str))
         return 0
+
+    if rest[0] in ("-w", "--watch", "watch"):
+        # `ceph -w` — live event stream (health transitions, clog,
+        # progress); --count/--timeout bound it for scripting
+        sub = argparse.ArgumentParser(prog="ceph -w")
+        sub.add_argument("--count", type=int, default=0)
+        sub.add_argument("--timeout", type=float, default=0.0)
+        a = sub.parse_args(rest[1:])
+        if not args.mon:
+            raise SystemExit("ceph: -m HOST:PORT required")
+        mc = MonClient(_monmap_from_addrs(args.mon))
+        try:
+            return _watch(mc, count=a.count, timeout=a.timeout)
+        finally:
+            mc.shutdown()
 
     if not args.mon:
         raise SystemExit("ceph: -m HOST:PORT required")
@@ -172,11 +192,27 @@ def _dispatch(args, rest) -> int:
         elif rest[0] == "osd" and rest[1:2] == ["reweight"]:
             cmd = {"prefix": "osd reweight", "id": int(rest[2]),
                    "weight": float(rest[3])}
+        elif rest[0] == "health" and rest[1:2] == ["mute"]:
+            # `ceph health mute CODE [TTL] [--sticky]`
+            cmd = {"prefix": "health mute", "code": rest[2]}
+            for tok in rest[3:]:
+                if tok == "--sticky":
+                    cmd["sticky"] = True
+                else:
+                    cmd["ttl"] = float(tok)
+        elif rest[0] == "health" and rest[1:2] == ["unmute"]:
+            cmd = {"prefix": "health unmute", "code": rest[2]}
+        elif rest[0] == "progress":
+            # mgr-hosted progress events
+            return _run_mgr_command(mc, {"prefix": "progress"})
         elif rest[0] == "log" and rest[1:2] == ["last"]:
-            # `ceph log last [n]` — tail of the cluster log
+            # `ceph log last [n] [cluster|audit]` — ring tails
             cmd = {"prefix": "log last"}
-            if len(rest) > 2:
-                cmd["num"] = int(rest[2])
+            for tok in rest[2:]:
+                if tok.isdigit():
+                    cmd["num"] = int(tok)
+                else:
+                    cmd["channel"] = tok
         elif rest[0] == "log" and len(rest) > 1:
             # `ceph log <msg...>` — operator entry into the clog
             cmd = {"prefix": "log", "logtext": " ".join(rest[1:])}
@@ -211,6 +247,60 @@ def _dispatch(args, rest) -> int:
         return 0 if rc == 0 else 1
     finally:
         mc.shutdown()
+
+
+def _fmt_event(kind: str, data: dict, stamp: float) -> str | None:
+    """One `ceph -w` line per event; None ⇒ suppressed (snapshots)."""
+    import datetime
+    ts = datetime.datetime.fromtimestamp(
+        data.get("stamp", stamp) or stamp).strftime("%H:%M:%S")
+    if kind == "clog":
+        return (f"{ts} {data.get('channel', 'cluster')} "
+                f"[{data.get('prio', 'info').upper()[:3]}] "
+                f"{data.get('name', '?')}: {data.get('text', '')}")
+    if kind == "health":
+        state = data.get("state")
+        if state == "snapshot":
+            return None     # catch-up frame, not a transition
+        if state == "rollup":
+            return f"{ts} health: cluster is {data.get('status')}"
+        return (f"{ts} health: {data.get('code')} {state} "
+                f"({data.get('summary', '')}) → {data.get('status')}")
+    if kind == "progress":
+        pct = round(float(data.get("progress", 0.0)) * 100)
+        return (f"{ts} progress: {data.get('message', '?')} — "
+                f"{pct}% ({data.get('state', 'update')})")
+    return f"{ts} {kind}: {json.dumps(data, default=str)}"
+
+
+def _watch(mc: MonClient, count: int = 0, timeout: float = 0.0) -> int:
+    import queue
+    import time as _time
+    q: queue.Queue = queue.Queue()
+    mc.on_event = lambda kind, data, stamp: q.put((kind, data, stamp))
+    mc.sub_want("events", 0)
+    printed = 0
+    deadline = _time.monotonic() + timeout if timeout > 0 else None
+    try:
+        while True:
+            wait = 1.0 if deadline is None else \
+                min(1.0, deadline - _time.monotonic())
+            if wait <= 0:
+                return 0
+            try:
+                kind, data, stamp = q.get(timeout=wait)
+            except queue.Empty:
+                continue
+            line = _fmt_event(kind, data if isinstance(data, dict)
+                              else {}, stamp)
+            if line is None:
+                continue
+            print(line, flush=True)
+            printed += 1
+            if count and printed >= count:
+                return 0
+    except KeyboardInterrupt:
+        return 0
 
 
 def _render(prefix: str, out) -> str | None:

@@ -10,6 +10,7 @@ fixture every end-to-end test runs on, and the substrate for the
 
 from __future__ import annotations
 
+import queue
 import socket
 import time
 
@@ -30,6 +31,41 @@ def _free_ports(n: int) -> list[int]:
     for s in socks:
         s.close()
     return ports
+
+
+class ClusterWatcher:
+    """Live cluster event feed (the `ceph -w` transport): health
+    transitions, clog entries and progress updates arrive in order on
+    an internal queue via a mon "events" subscription."""
+
+    def __init__(self, monmap, auth=None):
+        from .mon.client import MonClient
+        self._q: queue.Queue = queue.Queue()
+        self.monc = MonClient(monmap, entity="client.watch", auth=auth)
+        self.monc.on_event = self._on_event
+        self.monc.sub_want("events", 0)
+        self.seen: list[dict] = []
+
+    def _on_event(self, kind, data, stamp):
+        self._q.put({"kind": kind, "data": data or {}, "stamp": stamp})
+
+    def next(self, timeout: float = 10.0) -> dict:
+        """Block for the next event → {"kind", "data", "stamp"}."""
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError("no cluster event within timeout")
+        self.seen.append(ev)
+        return ev
+
+    def close(self):
+        self.monc.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class MiniCluster:
@@ -273,6 +309,27 @@ class MiniCluster:
                     osd.msgr.faults.heal(dst=f"osd.{j}")
 
     # -- cluster helpers ---------------------------------------------------
+    def watch(self) -> ClusterWatcher:
+        """Subscribe to the mon event stream (health / clog /
+        progress) — the `ceph -w` feed.  Caller closes."""
+        return ClusterWatcher(self.monmap, auth=self.auth)
+
+    def wait_for_health_ok(self, timeout: float = 30.0):
+        """Block until the cluster reports HEALTH_OK, driven entirely
+        by the event stream — no status polling.  The subscription
+        snapshot answers immediately when already healthy."""
+        with self.watch() as w:
+            deadline = time.monotonic() + timeout
+            while True:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError("cluster never reached "
+                                       "HEALTH_OK")
+                ev = w.next(timeout=left)
+                if ev["kind"] == "health" and \
+                        ev["data"].get("status") == "HEALTH_OK":
+                    return
+
     def wait_for_clean(self, timeout: float = 30.0):
         """Wait until every PG on every live OSD is active (+clean when
         it owns recovery state)."""

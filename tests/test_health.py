@@ -1,0 +1,371 @@
+"""Health observatory end-to-end: structured checks with transitions
+into the clog + history ring, mute with TTL/sticky semantics, the
+mgr progress module over a real osd-out recovery, and the `ceph -w`
+event stream (reference ``mon/HealthMonitor.cc``,
+``pybind/mgr/progress``, ``ceph -w``)."""
+
+import collections
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.mon.health import (HealthContext, PGMap, diff_reports,
+                                 evaluate_checks, rollup)
+from ceph_tpu.osd.osdmap import EXISTS, UP, OSDMap
+from ceph_tpu.tools import ceph as ceph_cli
+from ceph_tpu.vstart import MiniCluster
+
+
+def wait_for(pred, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# =====================================================================
+# pure evaluators (no cluster)
+# =====================================================================
+
+def _synth_ctx(n_osds=6, down=(), pg_states=("active+clean",),
+               scrub_errors=0):
+    m = OSDMap(max_osd=n_osds)
+    m.epoch = 5
+    for o in range(n_osds):
+        m.osd_state[o] = EXISTS | (0 if o in down else UP)
+    pgmap = PGMap()
+    now = time.time()
+    for i, st in enumerate(pg_states):
+        pgmap.pg_stats[f"1.{i:x}"] = {
+            "state": st, "stamp": now, "num_objects": 4,
+            "scrub_errors": scrub_errors}
+    return HealthContext(osdmap=m, pgmap=pgmap, monmap_ranks=(0,),
+                         quorum=(0,), now=now)
+
+
+class TestEvaluators:
+    def test_clean_cluster_raises_nothing(self):
+        assert evaluate_checks(_synth_ctx()) == []
+
+    def test_osd_down_warn(self):
+        checks = evaluate_checks(_synth_ctx(down=(1, 4)))
+        by_code = {c["code"]: c for c in checks}
+        assert by_code["OSD_DOWN"]["severity"] == "WARN"
+        assert "2 osds down" in by_code["OSD_DOWN"]["summary"]
+        assert rollup(checks) == "HEALTH_WARN"
+
+    def test_pg_damaged_is_err(self):
+        checks = evaluate_checks(_synth_ctx(scrub_errors=2))
+        by_code = {c["code"]: c for c in checks}
+        assert by_code["PG_DAMAGED"]["severity"] == "ERR"
+        assert rollup(checks) == "HEALTH_ERR"
+
+    def test_diff_reports_transitions(self):
+        old = {"status": "HEALTH_OK", "checks": [], "muted": []}
+        chk = {"code": "OSD_DOWN", "severity": "WARN",
+               "summary": "1 osds down", "detail": [], "count": 1}
+        new = {"status": "HEALTH_WARN", "checks": [chk], "muted": []}
+        evs = diff_reports(old, new)
+        assert [(e["code"], e["state"]) for e in evs] == \
+            [("OSD_DOWN", "failed")]
+        assert diff_reports(new, old)[0]["state"] == "cleared"
+        muted = {"status": "HEALTH_OK", "checks": [],
+                 "muted": [dict(chk, muted=True)]}
+        assert diff_reports(new, muted)[0]["state"] == "muted"
+        assert diff_reports(muted, new)[0]["state"] == "unmuted"
+
+
+# =====================================================================
+# live cluster
+# =====================================================================
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniCluster(n_mons=1, n_osds=3) as c:
+        r = c.rados()
+        r.create_pool("health_pool", pg_num=4, size=2)
+        io = r.open_ioctx("health_pool")
+        for i in range(8):
+            io.write_full(f"obj{i}", b"h" * 256)
+        c.wait_for_clean()
+        yield c
+        r.shutdown()
+
+
+@pytest.fixture(scope="module")
+def mon_addr(cluster):
+    return f"127.0.0.1:{cluster.monmap.mons[0].port}"
+
+
+def mon_cmd(c, cmd):
+    return c._clients[0].mon_command(cmd)
+
+
+class TestTransitions:
+    def test_failed_and_cleared_reach_clog_history_and_stream(
+            self, cluster):
+        c = cluster
+        c.wait_for_health_ok(timeout=30)
+        with c.watch() as w:
+            # first frame on the subscription is the catch-up snapshot
+            first = w.next(timeout=10)
+            assert first["kind"] == "health"
+            assert first["data"]["state"] == "snapshot"
+            assert first["data"]["status"] == "HEALTH_OK"
+
+            c.kill_osd(2)
+            c.wait_for_osd_down(2)
+
+            # the OSD_DOWN failed transition arrives on the stream
+            def until(pred, timeout=30.0):
+                deadline = time.monotonic() + timeout
+                while True:
+                    ev = w.next(timeout=max(
+                        0.1, deadline - time.monotonic()))
+                    if pred(ev):
+                        return ev
+            ev = until(lambda e: e["kind"] == "health"
+                       and e["data"].get("code") == "OSD_DOWN")
+            assert ev["data"]["state"] == "failed"
+            assert ev["data"]["status"] == "HEALTH_WARN"
+
+            # ... and into the cluster log
+            assert wait_for(lambda: any(
+                "Health check failed: OSD_DOWN" in e["text"]
+                for e in mon_cmd(c, {"prefix": "log last",
+                                     "num": 50})[2]),
+                timeout=10)
+
+            c.revive_osd(2)
+            ev = until(lambda e: e["kind"] == "health"
+                       and e["data"].get("code") == "OSD_DOWN"
+                       and e["data"].get("state") == "cleared",
+                       timeout=60)
+
+        # both edges recorded in the bounded history ring
+        rc, _, hist = mon_cmd(c, {"prefix": "health history"})
+        assert rc == 0
+        osd_down = [(e["code"], e["state"]) for e in hist["events"]
+                    if e["code"] == "OSD_DOWN"]
+        assert ("OSD_DOWN", "failed") in osd_down
+        assert ("OSD_DOWN", "cleared") in osd_down
+
+        # event-driven wait returns once the cluster is healthy again
+        c.wait_for_clean(timeout=60)
+        c.wait_for_health_ok(timeout=60)
+
+    def test_history_ring_is_bounded(self, cluster):
+        c = cluster
+        svc = c.mons[0].services["health"]
+        svc.history = collections.deque(svc.history, maxlen=5)
+        for _ in range(4):      # 8 transitions through paxos
+            assert mon_cmd(c, {"prefix": "osd set",
+                               "key": "noout"})[0] == 0
+            assert wait_for(lambda: any(
+                e["code"] == "OSDMAP_FLAGS" and e["state"] == "failed"
+                for e in svc.history), timeout=10)
+            assert mon_cmd(c, {"prefix": "osd unset",
+                               "key": "noout"})[0] == 0
+            assert wait_for(lambda: any(
+                e["code"] == "OSDMAP_FLAGS" and e["state"] == "cleared"
+                for e in svc.history), timeout=10)
+            svc_events = list(svc.history)
+            assert len(svc_events) <= 5
+        assert len(svc.history) == 5
+        c.wait_for_health_ok(timeout=30)
+
+
+class TestMute:
+    def test_mute_drops_rollup_and_ttl_expires(self, cluster):
+        c = cluster
+        assert mon_cmd(c, {"prefix": "osd set", "key": "noout"})[0] \
+            == 0
+        assert wait_for(lambda: mon_cmd(c, {"prefix": "health"})[2]
+                        ["health"] == "HEALTH_WARN", timeout=10)
+
+        rc, outs, _ = mon_cmd(c, {"prefix": "health mute",
+                                  "code": "OSDMAP_FLAGS", "ttl": 2.0})
+        assert rc == 0 and "muted" in outs
+        rc, _, rep = mon_cmd(c, {"prefix": "health detail"})
+        assert rep["health"] == "HEALTH_OK"          # out of rollup
+        assert [m["code"] for m in rep["muted"]] == ["OSDMAP_FLAGS"]
+        assert rep["muted"][0]["muted"] is True      # still in detail
+        assert "OSDMAP_FLAGS" in rep["mutes"]
+
+        # TTL expiry un-mutes: the check comes back into the rollup
+        assert wait_for(lambda: mon_cmd(c, {"prefix": "health"})[2]
+                        ["health"] == "HEALTH_WARN", timeout=15), \
+            "mute never expired"
+        rc, _, rep = mon_cmd(c, {"prefix": "health"})
+        assert [ch["code"] for ch in rep["checks"]] == ["OSDMAP_FLAGS"]
+        assert rep["muted"] == []
+
+        assert mon_cmd(c, {"prefix": "osd unset", "key": "noout"})[0] \
+            == 0
+        c.wait_for_health_ok(timeout=30)
+
+    def test_non_sticky_dies_on_clear_sticky_survives(self, cluster):
+        c = cluster
+        # muting an absent check requires sticky
+        rc, outs, _ = mon_cmd(c, {"prefix": "health mute",
+                                  "code": "OSDMAP_FLAGS"})
+        assert rc == -2 and "sticky" in outs
+
+        # non-sticky mute: raised check, mute, clear → mute reaped
+        mon_cmd(c, {"prefix": "osd set", "key": "noout"})
+        assert wait_for(lambda: mon_cmd(c, {"prefix": "health"})[2]
+                        ["health"] == "HEALTH_WARN", timeout=10)
+        assert mon_cmd(c, {"prefix": "health mute",
+                           "code": "OSDMAP_FLAGS"})[0] == 0
+        mon_cmd(c, {"prefix": "osd unset", "key": "noout"})
+        assert wait_for(
+            lambda: "OSDMAP_FLAGS" not in
+            mon_cmd(c, {"prefix": "health detail"})[2]["mutes"],
+            timeout=10), "non-sticky mute survived the clear"
+
+        # sticky mute in advance: check raised later arrives muted
+        assert mon_cmd(c, {"prefix": "health mute",
+                           "code": "OSDMAP_FLAGS",
+                           "sticky": True})[0] == 0
+        mon_cmd(c, {"prefix": "osd set", "key": "noout"})
+        time.sleep(1.0)         # give ticks a chance to (not) raise it
+        rc, _, rep = mon_cmd(c, {"prefix": "health detail"})
+        assert rep["health"] == "HEALTH_OK"
+        assert [m["code"] for m in rep["muted"]] == ["OSDMAP_FLAGS"]
+        # explicit unmute surfaces it again
+        assert mon_cmd(c, {"prefix": "health unmute",
+                           "code": "OSDMAP_FLAGS"})[0] == 0
+        assert wait_for(lambda: mon_cmd(c, {"prefix": "health"})[2]
+                        ["health"] == "HEALTH_WARN", timeout=10)
+        mon_cmd(c, {"prefix": "osd unset", "key": "noout"})
+        c.wait_for_health_ok(timeout=30)
+
+
+class TestAuditChannel:
+    def test_mutating_commands_land_in_audit_ring(self, cluster):
+        c = cluster
+        mon_cmd(c, {"prefix": "osd set", "key": "nodeep-scrub"})
+        mon_cmd(c, {"prefix": "osd unset", "key": "nodeep-scrub"})
+        def audited():
+            rc, _, out = mon_cmd(c, {"prefix": "log last", "num": 50,
+                                     "channel": "audit"})
+            return rc == 0 and any(
+                "osd set" in e["text"] and "dispatch" in e["text"]
+                for e in out)
+        assert wait_for(audited, timeout=10), \
+            "osd set never audited"
+        # reads don't audit
+        mon_cmd(c, {"prefix": "status"})
+        rc, _, out2 = mon_cmd(c, {"prefix": "log last", "num": 50,
+                                  "channel": "audit"})
+        assert not any('"status"' in e["text"] for e in out2)
+        # the cluster channel stays separate
+        rc, _, clu = mon_cmd(c, {"prefix": "log last", "num": 50})
+        assert not any("dispatch" in e["text"] for e in clu)
+        # unknown channel refused
+        assert mon_cmd(c, {"prefix": "log last",
+                           "channel": "bogus"})[0] == -22
+        c.wait_for_health_ok(timeout=30)
+
+
+class TestExporterGauges:
+    def test_health_check_and_mute_series(self, cluster):
+        from ceph_tpu.mgr.exporter import Exporter
+        c = cluster
+        monc = c._clients[0].monc
+        mon_cmd(c, {"prefix": "osd set", "key": "noout"})
+        assert wait_for(lambda: mon_cmd(c, {"prefix": "health"})[2]
+                        ["health"] == "HEALTH_WARN", timeout=10)
+        text = Exporter(monc).collect()
+        assert 'ceph_health_check{code="OSDMAP_FLAGS"} 1' in text
+        assert "ceph_health_status 1" in text
+
+        mon_cmd(c, {"prefix": "health mute", "code": "OSDMAP_FLAGS"})
+        text = Exporter(monc).collect()
+        assert 'ceph_health_mute{code="OSDMAP_FLAGS"} 1' in text
+        assert "ceph_health_status 0" in text
+
+        events = [{"id": "osd.1-out", "message": "Rebalancing",
+                   "progress": 0.42, "started_at": 1.0}]
+        text = Exporter(monc,
+                        progress_events=lambda: events).collect()
+        assert ('ceph_progress_event{id="osd.1-out",'
+                'message="Rebalancing"} 0.42') in text
+
+        mon_cmd(c, {"prefix": "osd unset", "key": "noout"})
+        c.wait_for_health_ok(timeout=30)
+
+
+class TestCephW:
+    def test_cli_watch_prints_transitions(self, cluster, mon_addr,
+                                          capsys):
+        c = cluster
+        rcbox = []
+
+        def run():
+            rcbox.append(ceph_cli.main(
+                ["-m", mon_addr, "-w", "--count", "1",
+                 "--timeout", "30"]))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(1.0)         # let the subscription land
+        mon_cmd(c, {"prefix": "osd set", "key": "noout"})
+        t.join(timeout=40)
+        assert not t.is_alive() and rcbox == [0]
+        out = capsys.readouterr().out
+        # one frame is enough to prove the stream: either the
+        # OSDMAP_FLAGS health transition, or the audit entry for the
+        # very `osd set` we issued (whichever the mon pushed first)
+        assert "OSDMAP_FLAGS" in out or "health:" in out \
+            or "cluster" in out or "audit" in out
+        mon_cmd(c, {"prefix": "osd unset", "key": "noout"})
+        c.wait_for_health_ok(timeout=30)
+
+
+class TestProgress:
+    def test_osd_out_recovery_lifecycle(self):
+        with MiniCluster(n_mons=1, n_osds=3) as c:
+            c.start_mgr("pmgr")
+            c.wait_for_active_mgr()
+            r = c.rados()
+            r.create_pool("prog", pg_num=8, size=2)
+            io = r.open_ioctx("prog")
+            for i in range(24):
+                io.write_full(f"obj{i}", b"p" * 512)
+            c.wait_for_clean()
+
+            with c.watch() as w:
+                assert r.mon_command({"prefix": "osd out",
+                                      "ids": [2]})[0] == 0
+                seen = []
+                deadline = time.monotonic() + 90
+                while time.monotonic() < deadline:
+                    ev = w.next(timeout=max(
+                        0.1, deadline - time.monotonic()))
+                    if ev["kind"] != "progress":
+                        continue
+                    d = ev["data"]
+                    if d.get("id") != "osd.2-out":
+                        continue
+                    seen.append((d["state"], float(d["progress"])))
+                    if d["state"] == "complete":
+                        break
+                assert seen, "no progress events for the osd-out"
+                assert seen[0][0] == "open" and seen[0][1] == 0.0
+                assert seen[-1][0] == "complete" and seen[-1][1] == 1.0
+                fracs = [p for _s, p in seen]
+                assert fracs == sorted(fracs), \
+                    f"progress went backwards: {fracs}"
+                assert "marked out" in ev["data"]["message"]
+
+            # completed event visible via `ceph progress`
+            rc, _, out = r.mgr_command({"prefix": "progress"})
+            assert rc == 0
+            done = {e["id"]: e for e in out["completed"]}
+            assert done["osd.2-out"]["progress"] == 1.0
+            assert out["events"] == []      # nothing left open
+            r.shutdown()

@@ -149,14 +149,27 @@ def test_netsplit_backoff_slow_ops_end_to_end():
         assert peak_attempts[0] <= 32, \
             f"resend storm: an op was sent {peak_attempts[0]} times"
 
-        # SLOW_OPS clears once nothing is blocked
-        def slow_ops_cleared():
-            rc, _, health = r.mon_command({"prefix": "health"})
-            return rc == 0 and health and not any(
-                chk["code"] == "SLOW_OPS"
-                for chk in health["checks"])
-        assert wait_for(slow_ops_cleared, timeout=30), \
-            "SLOW_OPS never cleared after heal"
+        # SLOW_OPS clears once nothing is blocked — consumed from the
+        # live event stream (`ceph -w` transport) instead of polling
+        # `health`: the subscription snapshot answers when the check
+        # is already gone, otherwise we block on the cleared
+        # transition itself
+        with c.watch() as w:
+            deadline = time.monotonic() + 30.0
+            cleared = False
+            while not cleared:
+                left = deadline - time.monotonic()
+                assert left > 0, "SLOW_OPS never cleared after heal"
+                ev = w.next(timeout=left)
+                if ev["kind"] != "health":
+                    continue
+                d = ev["data"]
+                cleared = (
+                    (d.get("state") == "snapshot"
+                     and "SLOW_OPS" not in (d.get("checks") or []))
+                    or (d.get("code") == "SLOW_OPS"
+                        and d.get("state") == "cleared")
+                    or d.get("status") == "HEALTH_OK")
 
         # -- phase 3: deterministic backoff park/release -------------
         # drop the probe object's PG below min_size: the primary must
