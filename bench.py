@@ -694,6 +694,50 @@ def _robustness_leg():
     return res
 
 
+def _stretch_leg():
+    """Scripted site disaster drill on a 2-site stretch cluster
+    (game_day): how fast a whole-site blackout surfaces as
+    DEGRADED_STRETCH_MODE, and how fast the cluster converges back to
+    full replication after the site heals — the two wall-clock
+    numbers an operator plans an RTO around."""
+    from ceph_tpu.vstart import MiniCluster, health_event
+
+    res = {}
+    payload = os.urandom(2048)
+    with MiniCluster(n_mons=5, n_osds=4,
+                     stretch_sites={"east": [0, 1], "west": [2, 3]},
+                     fault_seed=0xD15A57E) as c:
+        r = c.rados()
+        c.enable_stretch_mode(r)
+        r.create_pool("bench_stretch", pg_num=8)
+        io = r.open_ioctx("bench_stretch")
+        for n in range(32):
+            io.write_full(f"o{n}", payload)
+        c.wait_for_clean(timeout=60.0)
+        report = c.game_day([
+            {"name": "blackout",
+             "action": lambda cl: cl.blackout_site("west"),
+             "until": health_event("DEGRADED_STRETCH_MODE", "failed"),
+             "timeout": 90.0},
+            {"name": "degraded_write",
+             "action": lambda cl: io.write_full("drill", payload)},
+            {"name": "heal",
+             "action": lambda cl: cl.heal_sites(),
+             "until": health_event("DEGRADED_STRETCH_MODE",
+                                   "cleared"),
+             "timeout": 120.0},
+        ])
+        timings = {p["phase"]: p["elapsed_s"] for p in report}
+        res["site_failover_detect_s"] = round(timings["blackout"], 2)
+        res["site_heal_convergence_s"] = round(timings["heal"], 2)
+        c.wait_for_clean(timeout=60.0)
+        ok = all(io.read(f"o{n}") == payload for n in range(32))
+        res["byte_verified"] = bool(ok and
+                                    io.read("drill") == payload)
+        r.shutdown()
+    return res
+
+
 def _observability_leg():
     """Tracing tax: ops/sec through one live cluster, span collection
     toggled live via the tracer enable flags.  Cluster throughput
@@ -892,6 +936,17 @@ def child_main():
             out["robustness"] = {"error": str(e)[:200]}
     else:
         out["robustness"] = {"skipped": "wall budget exhausted"}
+    print(json.dumps(dict(out, stretch={"skipped": "timeout"},
+                          observability={"skipped": "timeout"})),
+          flush=True)
+    # ~30s: 5-mon/4-osd stretch cluster through a full site drill
+    if _budget_left() > 0.07:
+        try:
+            out["stretch"] = _stretch_leg()
+        except Exception as e:    # noqa: BLE001 — keep the headline
+            out["stretch"] = {"error": str(e)[:200]}
+    else:
+        out["stretch"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(dict(out, observability={"skipped": "timeout"})),
           flush=True)
     # tracing tax on a live cluster: two short timed windows (~10s)

@@ -113,6 +113,9 @@ def build_options() -> list[Option]:
                "periodic deep scrub target (s; 0 disables)"),
         Option("osd_client_message_cap", int, 256,
                "max in-flight client messages"),
+        Option("osd_stub_capacity_bytes", int, 1 << 30,
+               "synthetic device capacity reported in osd_stats "
+               "(drives OSD_NEARFULL)", min=1),
         # -- erasure coding ----------------------------------------------
         Option("osd_pool_default_erasure_code_profile", str,
                "plugin=jerasure technique=reed_sol_van k=2 m=2",

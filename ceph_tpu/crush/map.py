@@ -214,6 +214,60 @@ def build_flat_map(n_osds: int, osd_weight: int = 0x10000,
     return m
 
 
+DATACENTER_TYPE = 8     # reference type id for "datacenter"
+
+
+def build_stretch_map(sites: dict[str, list[int]],
+                      osd_weight: int = 0x10000) -> CrushMap:
+    """Two-"datacenter" stretch topology plus the stretch rule.
+
+    ``sites`` maps site name → osd ids (each OSD gets its own host
+    bucket so ``chooseleaf firstn 2 type host`` can spread within the
+    site).  Rule 0 is the reference stretch-mode placement::
+
+        take default
+        choose firstn 2 type datacenter
+        chooseleaf firstn 2 type host
+        emit
+
+    — both sites first, then two hosts in each, giving size=4 replica
+    sets that always span the sites.
+    """
+    m = CrushMap(types={0: "osd", 1: "host",
+                        DATACENTER_TYPE: "datacenter", 10: "root"})
+    bid = -2  # -1 reserved for root
+    dc_ids, dc_ws = [], []
+    max_osd = 0
+    for site, osds in sites.items():
+        host_ids, host_ws = [], []
+        for i, o in enumerate(osds):
+            m.names[o] = f"osd.{o}"
+            max_osd = max(max_osd, o + 1)
+            hb = Bucket(id=bid, type=1, items=[o], weights=[osd_weight])
+            m.add_bucket(hb)
+            m.names[bid] = f"host-{site}-{i}"
+            host_ids.append(bid)
+            host_ws.append(hb.weight)
+            bid -= 1
+        db = Bucket(id=bid, type=DATACENTER_TYPE, items=host_ids,
+                    weights=host_ws)
+        m.add_bucket(db)
+        m.names[bid] = site
+        dc_ids.append(bid)
+        dc_ws.append(db.weight)
+        bid -= 1
+    root = Bucket(id=-1, type=10, items=dc_ids, weights=dc_ws)
+    m.add_bucket(root)
+    m.names[-1] = "default"
+    m.max_devices = max_osd
+    m.rules.append(Rule(id=0, name="stretch_rule", steps=[
+        Step("take", -1),
+        Step("choose_firstn", len(sites), DATACENTER_TYPE),
+        Step("chooseleaf_firstn", 2, 1),
+        Step("emit")]))
+    return m
+
+
 def build_hierarchy(n_racks: int, hosts_per_rack: int, osds_per_host: int,
                     osd_weight: int = 0x10000,
                     rule: str = "chooseleaf_firstn") -> CrushMap:

@@ -14,6 +14,7 @@ stream (``progress publish``) so ``ceph -w`` narrates it live.
 
 from __future__ import annotations
 
+import json
 import time
 
 from .daemon import MgrModule
@@ -26,6 +27,10 @@ class ProgressModule(MgrModule):
     # an event that never saw work (stats lag, or nothing actually
     # moved) closes quietly after this long
     CLEAN_GRACE = 10.0
+    # config-key slot the open events + baselines persist under, so a
+    # promoted standby resumes half-done events instead of restarting
+    # every fraction at 0% (reference: the module's kv-store state)
+    STORE_KEY = "mgr/progress/state"
 
     def __init__(self, ctx):
         super().__init__(ctx)
@@ -34,6 +39,42 @@ class ProgressModule(MgrModule):
         self._baselines: dict[str, int] = {}     # id → worst backlog
         self._prev_out: set[int] | None = None
         self._dirty: list[dict] = []             # pending publishes
+        self._loaded = False
+
+    # -- failover persistence (mon config-key store) ----------------------
+
+    def _load_state(self):
+        """One-shot restore on the first tick after promotion: mgr
+        module instances are rebuilt from scratch on failover, so the
+        open events and their worst-seen backlogs must come back from
+        the mon or every in-flight rebalance restarts at 0%."""
+        self._loaded = True
+        try:
+            rc, _, out = self.ctx.mon_command(
+                {"prefix": "config-key get", "key": self.STORE_KEY})
+        except Exception:       # noqa: BLE001 — mon churn: stay empty
+            return
+        if rc != 0 or not out:
+            return
+        try:
+            state = json.loads(out if isinstance(out, str)
+                               else out.get("value", ""))
+        except (ValueError, AttributeError):
+            return
+        self.events = dict(state.get("events") or {})
+        self._baselines = {k: int(v) for k, v in
+                           (state.get("baselines") or {}).items()}
+        self.completed = list(state.get("completed") or [])
+
+    def _save_state(self):
+        blob = json.dumps({"events": self.events,
+                           "baselines": self._baselines,
+                           "completed": self.completed})
+        try:
+            self.ctx.mon_command({"prefix": "config-key put",
+                                  "key": self.STORE_KEY, "val": blob})
+        except Exception:       # noqa: BLE001 — retried next change
+            pass
 
     # -- event bookkeeping -----------------------------------------------
 
@@ -67,6 +108,8 @@ class ProgressModule(MgrModule):
         m = self.ctx.get_osdmap()
         if m is None:
             return
+        if not self._loaded:
+            self._load_state()
         now = time.time()
         out = {o for o in range(m.max_osd)
                if m.exists(o) and m.is_out(o)}
@@ -134,6 +177,10 @@ class ProgressModule(MgrModule):
                                       "events": batch})
             except Exception:   # noqa: BLE001 — re-publish next time
                 self._dirty = batch + self._dirty
+            # state changed (open/advance/close) — checkpoint it for
+            # the next mgr; piggybacked here so an idle cluster never
+            # writes the key
+            self._save_state()
 
     # -- surfaces ----------------------------------------------------------
 

@@ -21,6 +21,14 @@ from .monitor import MonMap
 
 
 class MonClient(Dispatcher):
+    # session keepalive (reference MonClient::tick): ping the session
+    # mon; silence past the grace — or an "out of quorum" ack — makes
+    # us hunt a different mon.  Without this a fault-injected blackout
+    # (TCP up, frames blackholed) pins subscribers to a dead mon
+    # forever: nothing ever resets the connection.
+    PING_INTERVAL = 1.0
+    PING_GRACE = 3.5
+
     def __init__(self, monmap: MonMap, entity: str = "client.admin",
                  timeout: float = 10.0, auth=None):
         self.monmap = monmap
@@ -47,6 +55,10 @@ class MonClient(Dispatcher):
         self.on_mgrmap = None       # cb(epoch, mgrmap_dict)
         self.on_event = None        # cb(kind, data, stamp) — "events"
         self._lock = threading.Lock()
+        self._last_ack = time.monotonic()
+        self._stop = threading.Event()
+        threading.Thread(target=self._keepalive_loop, daemon=True,
+                         name=f"monc-ping-{entity}").start()
 
     # -- session -----------------------------------------------------------
     def _connect(self, rank: int | None = None):
@@ -58,6 +70,7 @@ class MonClient(Dispatcher):
             try:
                 self._con = self.msgr.connect_to(self.monmap.mons[r])
                 self._cur_rank = r
+                self._last_ack = time.monotonic()  # fresh grace
                 if self._subs:
                     self._con.send_message(
                         M.MMonSubscribe(what=dict(self._subs)))
@@ -70,7 +83,34 @@ class MonClient(Dispatcher):
         if self._con is None or not self._con.is_connected:
             self._connect()
 
+    def _keepalive_loop(self):
+        while not self._stop.wait(self.PING_INTERVAL):
+            con = self._con
+            if con is None or not con.is_connected:
+                # nothing to watch over unless a subscription exists
+                # (command clients reconnect lazily on their own)
+                if self._subs:
+                    try:
+                        self._connect()
+                        self._last_ack = time.monotonic()
+                    except (ConnectionError, OSError):
+                        pass
+                continue
+            if time.monotonic() - self._last_ack > self.PING_GRACE:
+                # silent session (blackholed, wedged, or dead): hunt
+                self._con = None
+                try:
+                    con.mark_down()
+                except Exception:   # noqa: BLE001 — already dead
+                    pass
+                continue
+            try:
+                con.send_message(M.MMonPing(tid=0))
+            except (ConnectionError, OSError):
+                self._con = None
+
     def shutdown(self):
+        self._stop.set()
         self.msgr.shutdown()
 
     # -- commands ----------------------------------------------------------
@@ -246,6 +286,19 @@ class MonClient(Dispatcher):
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, M.MMonPing):
+            self._last_ack = time.monotonic()
+            if msg.quorum is not None and not msg.quorum:
+                # mon is alive but outside quorum: it serves no events
+                # or fresh maps — hunt one that does.  Subscriptions
+                # re-send (and the mon re-snapshots) on reconnect.
+                con, self._con = self._con, None
+                if con is not None:
+                    try:
+                        con.mark_down()
+                    except Exception:   # noqa: BLE001
+                        pass
+            return True
         if isinstance(msg, M.MMonCommandReply):
             with self._lock:
                 waiter = self._waiters.get(msg.tid)

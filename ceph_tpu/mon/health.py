@@ -31,6 +31,12 @@ from ..osd.osdmap import CLUSTER_FLAGS
 from .service import PaxosService
 
 PG_STALE_GRACE = 6.0     # seconds without a primary report → stale
+# PG_NOT_SCRUBBED: warn when a PG's effective scrub stamp is older
+# than this (reference: osd_scrub_interval × mon_warn ratio).  Module
+# constants so tests can shrink them without threading config through
+# the pure evaluators.
+SCRUB_WARN_INTERVAL = 1.5 * 86400.0
+NEARFULL_RATIO = 0.85    # OSD_NEARFULL: bytes_used / bytes_total
 
 
 class PGMap:
@@ -259,6 +265,72 @@ def _pg_damaged(ctx):
                   [f"pg {pgid} has {n} scrub errors"
                    for pgid, n in sorted(bad.items())],
                   count=sum(bad.values()))
+
+
+@health_check
+def _degraded_stretch_mode(ctx):
+    # stretch cluster lost (or is recovering from losing) a site:
+    # min_size was dropped so writes continue on the survivors
+    # (reference DEGRADED_STRETCH_MODE / RECOVERING_STRETCH_MODE)
+    m = ctx.osdmap
+    if not getattr(m, "degraded_stretch_mode", False):
+        return None
+    recovering = bool(getattr(m, "recovering_stretch_mode", False))
+    site = getattr(m, "stretch_degraded_site", "") or "?"
+    if recovering:
+        summary = (f"stretch mode recovering: site '{site}' is back, "
+                   "waiting for PGs to go clean")
+        detail = [f"site '{site}' rejoined; full replication will be "
+                  "restored once recovery completes"]
+    else:
+        summary = (f"stretch cluster degraded: site '{site}' is down, "
+                   "writes continue at reduced min_size")
+        detail = [f"no OSD of site '{site}' is up"]
+    return _check("DEGRADED_STRETCH_MODE", "WARN", summary, detail,
+                  count=1)
+
+
+@health_check
+def _pg_not_scrubbed(ctx):
+    # effective stamp (max of last scrub and PG creation) reported by
+    # the primary; never-reported PGs are PG_AVAILABILITY's problem
+    late = {}
+    for pgid, st in ctx.pgmap.pg_stats.items():
+        stamp = st.get("last_scrub_stamp")
+        if stamp is None:
+            continue
+        age = ctx.now - float(stamp)
+        if age > SCRUB_WARN_INTERVAL:
+            late[pgid] = age
+    if not late:
+        return None
+    return _check(
+        "PG_NOT_SCRUBBED", "WARN",
+        f"{len(late)} pgs not scrubbed in time",
+        [f"pg {pgid} not scrubbed for {age:.0f}s"
+         for pgid, age in sorted(late.items())])
+
+
+@health_check
+def _osd_nearfull(ctx):
+    m = ctx.osdmap
+    near = []
+    for o, st in sorted(ctx.pgmap.osd_stats.items()):
+        if ctx.now - st.get("stamp", 0.0) > PG_STALE_GRACE and \
+                not (o < m.max_osd and m.is_up(o)):
+            continue    # dead OSD's last report: capacity is moot
+        total = int(st.get("bytes_total", 0))
+        if total <= 0:
+            continue
+        ratio = int(st.get("bytes_used", 0)) / total
+        if ratio >= NEARFULL_RATIO:
+            near.append((o, ratio))
+    if not near:
+        return None
+    return _check(
+        "OSD_NEARFULL", "WARN",
+        f"{len(near)} nearfull osd(s)",
+        [f"osd.{o} is near full ({r:.0%} used)" for o, r in near])
 
 
 def evaluate_checks(ctx: HealthContext) -> list[dict]:

@@ -146,6 +146,18 @@ class MMonEvent(_JsonMessage):
 
 
 @register_message
+class MMonPing(_JsonMessage):
+    """MonClient ↔ mon session keepalive (reference MonClient::tick
+    keepalive + hunt).  Client sends ``tid``; the mon echoes it with
+    ``ack=1`` and whether it currently sits in quorum — a silent or
+    out-of-quorum session makes the client hunt a different mon, which
+    is what lets subscribers survive a blacked-out site whose TCP
+    links never reset."""
+    TYPE = 33
+    FIELDS = ("tid", "ack", "quorum")
+
+
+@register_message
 class MPGStats(_JsonMessage):
     """Primary OSD → mon: per-PG state/object counts (reference
     MPGStats → PGMap aggregation, ``src/mon/PGMap.cc``).  pg_stats:

@@ -57,9 +57,16 @@ def _parse_pgid(s) -> PGid | None:
 
 @dataclass
 class MonMap:
-    """monmap: rank → address (reference ``src/mon/MonMap.h``)."""
+    """monmap: rank → address (reference ``src/mon/MonMap.h``).
+
+    Stretch clusters add site placement: ``sites`` maps rank → site
+    name (reference CRUSH location of the mon) and ``tiebreaker`` names
+    the rank that arbitrates between sites — it votes but never leads
+    (reference MonMap::tiebreaker_mon / disallowed_leaders)."""
     epoch: int = 1
     mons: dict[int, EntityAddr] = field(default_factory=dict)
+    sites: dict[int, str] = field(default_factory=dict)
+    tiebreaker: int = -1       # rank; -1 = no stretch tiebreaker
 
     def ranks(self) -> list[int]:
         return sorted(self.mons)
@@ -67,13 +74,18 @@ class MonMap:
     def to_dict(self) -> dict:
         return {"epoch": self.epoch,
                 "mons": {str(r): [a.host, a.port]
-                         for r, a in self.mons.items()}}
+                         for r, a in self.mons.items()},
+                "sites": {str(r): s for r, s in self.sites.items()},
+                "tiebreaker": self.tiebreaker}
 
     @classmethod
     def from_dict(cls, d: dict) -> "MonMap":
         return cls(epoch=d["epoch"],
                    mons={int(r): EntityAddr(a[0], a[1])
-                         for r, a in d["mons"].items()})
+                         for r, a in d["mons"].items()},
+                   sites={int(r): s
+                          for r, s in (d.get("sites") or {}).items()},
+                   tiebreaker=int(d.get("tiebreaker", -1)))
 
 
 class OSDMonitor(PaxosService):
@@ -212,7 +224,8 @@ class OSDMonitor(PaxosService):
                         outs.append(o)
                 else:
                     down_t.pop(o, None)
-        if not dead and not quota_flips and not outs:
+        if not dead and not quota_flips and not outs \
+                and not cur.stretch_mode_enabled:
             return
         m = self._working()
         for o in dead:
@@ -228,8 +241,79 @@ class OSDMonitor(PaxosService):
                 m.pools[pid].last_change = m.epoch + 1
         for o in outs:
             m.mark_out(o)
+        changed = bool(dead or quota_flips or outs)
+        # stretch transitions are evaluated on the mutated map so a
+        # site whose last OSD we just marked down degrades in the SAME
+        # epoch the down-marking commits
+        if self._apply_stretch(m):
+            changed = True
+        if not changed:
+            return
         self._stage_map(m)
         self.mon.propose()
+
+    def _apply_stretch(self, m: OSDMap) -> bool:
+        """Stretch-mode state machine (reference OSDMonitor
+        trigger_degraded_stretch_mode / trigger_healthy_stretch_mode):
+        site loss drops stretch pools to min_size 1 and raises
+        DEGRADED_STRETCH_MODE; once every site has OSDs up again the
+        healthy min_size is restored (recovering), and the degraded
+        state only clears after recovery completes."""
+        if not m.stretch_mode_enabled:
+            return False
+        down = m.stretch_down_sites()
+        if not m.degraded_stretch_mode:
+            if down and len(down) < len(m.stretch_sites):
+                m.degraded_stretch_mode = True
+                m.recovering_stretch_mode = False
+                m.stretch_degraded_site = down[0]
+                for pool in m.pools.values():
+                    if pool.is_stretch:
+                        if not pool.stretch_min_size:
+                            pool.stretch_min_size = pool.min_size
+                        pool.min_size = 1
+                        pool.last_change = m.epoch + 1
+                return True
+            return False
+        if down:
+            if m.recovering_stretch_mode:
+                # relapse mid-recovery: back to degraded operation
+                m.recovering_stretch_mode = False
+                m.stretch_degraded_site = down[0]
+                for pool in m.pools.values():
+                    if pool.is_stretch:
+                        pool.min_size = 1
+                        pool.last_change = m.epoch + 1
+                return True
+            return False
+        if not m.recovering_stretch_mode:
+            # every site is back: restore full replication and wait
+            # for recovery before clearing the health state
+            m.recovering_stretch_mode = True
+            for pool in m.pools.values():
+                if pool.is_stretch:
+                    pool.min_size = pool.stretch_min_size or \
+                        (pool.size - pool.size // 2)
+                    pool.last_change = m.epoch + 1
+            return True
+        if self._stretch_recovery_done(m):
+            m.degraded_stretch_mode = False
+            m.recovering_stretch_mode = False
+            m.stretch_degraded_site = ""
+            return True
+        return False
+
+    def _stretch_recovery_done(self, m: OSDMap) -> bool:
+        """Every PG of every stretch pool reports active+clean."""
+        stats = self.mon.pgmap.pg_stats
+        for pool in m.pools.values():
+            if not pool.is_stretch:
+                continue
+            for seed in range(pool.pg_num):
+                st = stats.get(f"{pool.id}.{seed:x}")
+                if st is None or st.get("state") != "active+clean":
+                    return False
+        return True
 
     def _check_quotas(self, cur) -> list:
         """Pools whose FULL flag must flip, from PGMap usage vs quota
@@ -301,6 +385,11 @@ class OSDMonitor(PaxosService):
         # (new device into the root bucket), never replaced.
         if len(m.crush.buckets) == 0:
             m.crush = self._seed_crush(m.max_osd)
+        elif m.stretch_mode_enabled:
+            # a stretch hierarchy is site-placed by the operator; auto-
+            # appending an unplaced device to the root would let the
+            # stretch rule pick it as a "datacenter"
+            pass
         elif m.crush.max_devices < m.max_osd:
             # resolve the actual root: prefer rule 0's take target,
             # fall back to bucket id -1 (maps without either get no
@@ -415,10 +504,17 @@ class OSDMonitor(PaxosService):
                 m.crush.rule_by_id(rule_id)
             except KeyError:
                 return -22, f"crush rule {rule_id} does not exist", None
-            m.create_pool(name, pg_num=int(cmd.get("pg_num", 32)),
-                          size=size, min_size=min_size, type=ptype,
-                          crush_rule=rule_id,
-                          erasure_code_profile=profile_name)
+            pool = m.create_pool(name, pg_num=int(cmd.get("pg_num", 32)),
+                                 size=size, min_size=min_size,
+                                 type=ptype, crush_rule=rule_id,
+                                 erasure_code_profile=profile_name)
+            if m.stretch_mode_enabled and ptype == TYPE_REPLICATED \
+                    and rule_id == 0:
+                # pools born into a stretch cluster span the sites
+                pool.is_stretch = True
+                pool.size = 4
+                pool.min_size = 1 if m.degraded_stretch_mode else 2
+                pool.stretch_min_size = 2
             self._stage_map(m)
             self.mon.propose()
             return 0, f"pool '{name}' created", None
@@ -765,6 +861,60 @@ class OSDMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, "set crush map", None
+        if prefix == "osd enable-stretch-mode":
+            # reference `ceph mon enable_stretch_mode` + the crush/pool
+            # surgery deploy tooling does around it, in one command:
+            # build the two-datacenter hierarchy + stretch rule, flag
+            # every replicated pool is_stretch at size 4 / min_size 2
+            from ..crush.map import (DATACENTER_TYPE, Rule, Step,
+                                     build_stretch_map)
+            sites = {s: [int(o) for o in osds]
+                     for s, osds in (cmd.get("sites") or {}).items()}
+            if len(sites) != 2:
+                return -22, "stretch mode wants exactly 2 sites", None
+            if any(len(osds) < 2 for osds in sites.values()):
+                return -22, "each site needs >= 2 OSDs", None
+            known = sorted(o for osds in sites.values() for o in osds)
+            if len(set(known)) != len(known):
+                return -22, "an OSD appears in both sites", None
+            m = self._working()
+            if known and known[-1] >= m.max_osd:
+                return -2, f"osd.{known[-1]} does not exist", None
+            m.crush = build_stretch_map(sites)
+            m.crush.max_devices = m.max_osd
+            # EC pools keep a usable rule id 1 (hosts within the tree)
+            m.crush.rules.append(Rule(
+                id=1, name="erasure_rule", type="erasure",
+                steps=[Step("take", -1),
+                       Step("set_chooseleaf_tries", 5),
+                       Step("chooseleaf_indep", 0, 1), Step("emit")]))
+            m.stretch_mode_enabled = True
+            m.stretch_bucket_type = DATACENTER_TYPE
+            m.stretch_sites = sites
+            m.stretch_tiebreaker = str(cmd.get("tiebreaker", ""))
+            for pool in m.pools.values():
+                if pool.type == TYPE_REPLICATED:
+                    pool.is_stretch = True
+                    pool.size = 4
+                    pool.min_size = 2
+                    pool.stretch_min_size = 2
+                    pool.crush_rule = 0
+                    pool.last_change = m.epoch + 1
+            self._stage_map(m)
+            self.mon.propose()
+            return 0, "stretch mode enabled across " \
+                + "/".join(sorted(sites)), None
+        if prefix == "osd stretch status":
+            m = self.osdmap
+            return 0, "", {
+                "enabled": m.stretch_mode_enabled,
+                "sites": {s: {"osds": list(o),
+                              "up": m.stretch_site_up(s)}
+                          for s, o in m.stretch_sites.items()},
+                "tiebreaker": m.stretch_tiebreaker,
+                "degraded": m.degraded_stretch_mode,
+                "recovering": m.recovering_stretch_mode,
+                "degraded_site": m.stretch_degraded_site}
         return None
 
     def _tree(self) -> dict:
@@ -1318,7 +1468,10 @@ class Monitor(Dispatcher):
             self.name,
             **(auth.msgr_kwargs(self.name) if auth else {}))
         self.msgr.add_dispatcher(self)
-        self.elector = Elector(rank, monmap.ranks())
+        self.elector = Elector(
+            rank, monmap.ranks(),
+            tiebreaker=(monmap.tiebreaker
+                        if monmap.tiebreaker >= 0 else None))
         self.paxos = Paxos(self.store, rank)
         self.paxos.on_commit = self._on_paxos_commit
         self.paxos.on_active = self._on_paxos_active
@@ -1599,6 +1752,17 @@ class Monitor(Dispatcher):
         if isinstance(msg, M.MMonCommand):
             self._handle_command(msg)
             return True
+        if isinstance(msg, M.MMonPing):
+            # session keepalive: echo the tid and report quorum
+            # membership so pinned clients abandon an isolated mon
+            in_q = self.elector.state in ("leader", "peon") and \
+                self.rank in (self.elector.quorum or [])
+            try:
+                msg.connection.send_message(M.MMonPing(
+                    tid=msg.tid, ack=1, quorum=in_q))
+            except ConnectionError:
+                pass
+            return True
         if isinstance(msg, M.MMonSubscribe):
             subs = (json.loads(msg.what) if isinstance(msg.what, str)
                     else msg.what)
@@ -1641,7 +1805,9 @@ class Monitor(Dispatcher):
                         mgrmap=dict(mgrsvc.mgrmap)))
                 except ConnectionError:
                     self._subs.pop(msg.connection, None)
-            if "events" in subs:
+            in_q = self.elector.state in ("leader", "peon") and \
+                self.rank in (self.elector.quorum or [])
+            if "events" in subs and in_q:
                 # catch-up snapshot so a watcher joining a quiet
                 # cluster knows the current rollup immediately
                 # (wait_for_health_ok must not hang on HEALTH_OK).
@@ -1652,6 +1818,10 @@ class Monitor(Dispatcher):
                 # unhealthy.  A live/committed mismatch also stages a
                 # catch-up evaluation so the transition events the
                 # watcher will block on are actually emitted.
+                # Out-of-quorum mons send NO snapshot: their committed
+                # report may predate the very transition the watcher
+                # wants, and the keepalive will re-home the client to
+                # a quorum mon that snapshots fresh.
                 hsvc = self.services["health"]
                 report = hsvc.report or {}
                 if self.is_leader:
@@ -1912,5 +2082,6 @@ def _is_mutating(cmd: dict) -> bool:
                  "osd erasure-code-profile ls", "auth get", "auth ls",
                  "config-key get", "config-key ls", "log last",
                  "mon dump", "quorum_status", "fs ls", "fs dump",
-                 "mds stat", "mgr dump", "mgr stat")
+                 "mds stat", "mgr dump", "mgr stat",
+                 "osd stretch status")
     return prefix not in read_only

@@ -43,9 +43,16 @@ class Elector:
     """Rank-based election logic (transport-agnostic: the Monitor feeds
     messages in and sends what `outbox` accumulates)."""
 
-    def __init__(self, rank: int, ranks: list[int]):
+    def __init__(self, rank: int, ranks: list[int],
+                 tiebreaker: int | None = None):
         self.rank = rank
         self.ranks = ranks           # all monmap ranks
+        # stretch-mode tiebreaker rank (reference
+        # MonMap::tiebreaker_mon / disallowed_leaders): its ACK counts
+        # toward a majority — that's how a surviving site keeps quorum
+        # after losing half the mons — but it never campaigns and no
+        # one defers to it, so it can never become leader.
+        self.tiebreaker = tiebreaker
         self.epoch = 1               # odd ⇒ electing
         self.state = "startup"       # no round begun yet
         self.leader: int | None = None
@@ -61,6 +68,23 @@ class Elector:
 
     def start(self):
         """Begin (or restart) an election round."""
+        if self.rank == self.tiebreaker:
+            # a tiebreaker never campaigns: its PROPOSE below is only
+            # a nudge (peers treat candidacy from the tiebreaker rank
+            # as "please start an election", never as a candidate)
+            if self.epoch % 2 == 0:
+                self.epoch += 1
+            self.state = "electing"
+            self.leader = None
+            self.electing_me = False
+            self.acked = set()
+            self.deferred_to = None
+            for r in self.ranks:
+                if r != self.rank:
+                    self.outbox.append(
+                        (r, {"op": PROPOSE, "epoch": self.epoch,
+                             "from": self.rank}))
+            return
         if self.epoch % 2 == 0:
             self.epoch += 1
         elif self.deferred_to is not None:
@@ -116,6 +140,20 @@ class Elector:
             return
         if op == PROPOSE:
             self._bump_epoch(epoch)
+            if self.tiebreaker is not None and frm == self.tiebreaker:
+                # the tiebreaker's PROPOSE is a nudge, not a candidacy
+                # — deferring to it could elect a leader outside both
+                # sites.  Campaign ourselves instead.
+                if not self.electing_me and self.deferred_to is None \
+                        and self.rank != self.tiebreaker:
+                    self.start()
+                return
+            if self.rank == self.tiebreaker:
+                # tiebreaker: ack the best (lowest-ranked) candidate
+                # seen this round, never campaign
+                if self.deferred_to is None or frm <= self.deferred_to:
+                    self._defer(frm)
+                return
             if frm < self.rank:
                 # they would win over me — defer unless we already
                 # deferred to a still-better (lower) candidate this

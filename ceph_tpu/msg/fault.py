@@ -192,11 +192,30 @@ class FaultInjector:
         return [self._verdict_for(rule, self._draw(src, dst, n))
                 for n in range(count)]
 
+    def preview_pairs(self, pairs, count: int) -> dict[str, list]:
+        """Site-level twin of `preview`: the fault schedule for each
+        directed ``(src, dst)`` pair over its first `count` messages,
+        keyed ``"src>dst"``.  Pure — this is how a whole-site event
+        (blackout, WAN degradation over every inter-site pair) proves
+        it replays from the logged seed."""
+        return {f"{s}>{d}": self.preview(s, d, count) for s, d in pairs}
+
     def socket_cut(self, every: int) -> bool:
         """Legacy ms_inject_socket_failures draw, through the seeded
         per-messenger RNG (was: module-global ``random``)."""
         with self._lock:
             return self.rng.randrange(every) == 0
+
+
+def site_pairs(a: list[str], b: list[str],
+               bidirectional: bool = True) -> list[tuple[str, str]]:
+    """All directed inter-site (src, dst) entity-name pairs — the unit
+    the site-level primitives (partition_sites, blackout, slow-WAN)
+    operate on.  Deterministic order: sorted within each site."""
+    pairs = [(s, d) for s in sorted(a) for d in sorted(b)]
+    if bidirectional:
+        pairs += [(s, d) for s in sorted(b) for d in sorted(a)]
+    return pairs
 
 
 def injector_from_config(cfg) -> FaultInjector:

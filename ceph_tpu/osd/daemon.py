@@ -951,14 +951,25 @@ class OSDaemon(Dispatcher):
                 "backfill_remaining": pg.backfill_remaining(),
                 "last_scrub": pg.last_scrub,
                 "last_deep_scrub": pg.last_deep_scrub,
+                # effective stamp for PG_NOT_SCRUBBED: a never-scrubbed
+                # PG counts from creation, not from the epoch
+                "last_scrub_stamp": max(pg.last_scrub,
+                                        pg._scrub_stamp_floor),
                 "scrub_errors": pg.scrub_errors,
                 "inconsistent_objects": pg.inconsistent_objects,
             }
         if stats or self.pgs:
+            bytes_used = sum(st["num_bytes"] for st in stats.values())
             self.monc.send(MM.MPGStats(
                 osd=self.whoami, epoch=self.osdmap.epoch,
                 pg_stats=stats,
                 osd_stats={"num_pgs": len(self.pgs),
+                           # stub capacity accounting for the
+                           # OSD_NEARFULL check: primary-PG bytes vs a
+                           # configured synthetic device size
+                           "bytes_used": bytes_used,
+                           "bytes_total": int(self.config.get(
+                               "osd_stub_capacity_bytes")),
                            # cumulative client-op counters: the mgr
                            # iostat module differentiates these into
                            # IOPS (reference osd_stat_t op counters)

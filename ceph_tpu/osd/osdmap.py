@@ -110,6 +110,12 @@ class PGPool:
     write_tier: int = -1
     cache_mode: str = "none"         # none | writeback
     tiers: list = field(default_factory=list)
+    # stretch pools (reference pg_pool_t peering-crush stretch set):
+    # replicas span the datacenter buckets; on site loss the mon drops
+    # min_size to 1 (degraded stretch mode) and restores
+    # `stretch_min_size` once both sites are back.
+    is_stretch: bool = False
+    stretch_min_size: int = 0        # healthy min_size to restore
 
     def __post_init__(self):
         if self.pgp_num == 0:
@@ -171,6 +177,9 @@ class Incremental:
         field(default_factory=dict)
     old_pg_upmap_items: list[PGid] = field(default_factory=list)
     new_crush: CrushMap | None = None
+    # stretch-mode state delta: {field: value} over the OSDMap stretch
+    # attributes (stretch_mode_enabled, stretch_sites, ...)
+    new_stretch: dict | None = None
 
 
 class OSDMap:
@@ -202,6 +211,15 @@ class OSDMap:
         # a weight-only CRUSH change rebinds via set_weights (zero
         # recompiles), everything else falls back to a fresh build
         self._mappers: dict = {}
+        # stretch mode (reference OSDMap::stretch_mode_enabled et al.):
+        # site-aware placement + surviving-site degraded operation
+        self.stretch_mode_enabled = False
+        self.stretch_bucket_type = 0             # crush type id (datacenter)
+        self.stretch_sites: dict[str, list[int]] = {}   # site → osd ids
+        self.stretch_tiebreaker = ""             # tiebreaker mon name
+        self.degraded_stretch_mode = False       # a site is down
+        self.recovering_stretch_mode = False     # healed, recovery pending
+        self.stretch_degraded_site = ""          # which site died
 
     def batch_mapper(self, rule_id: int, result_max: int,
                      tracer=None, **kwargs):
@@ -392,6 +410,21 @@ class OSDMap:
         self.epoch = inc.epoch
         if inc.new_crush is not None:
             self.crush = inc.new_crush
+            # weight-only fast path: rebind every cached batch mapper
+            # onto the new map now (`remap()` under the hood — zero
+            # recompiles); a mapper that rejects the rebind saw a
+            # topology/tunables change and is evicted so the next
+            # `batch_mapper` call rebuilds it.
+            for key, bm in list(self._mappers.items()):
+                try:
+                    bm.set_weights(self.crush)
+                except (ValueError, NotImplementedError):
+                    del self._mappers[key]
+        if inc.new_stretch is not None:
+            for k, v in inc.new_stretch.items():
+                if not hasattr(self, k):
+                    raise ValueError(f"unknown stretch field {k!r}")
+                setattr(self, k, v)
         if inc.new_max_osd is not None:
             old = self.max_osd
             self.max_osd = inc.new_max_osd
@@ -431,6 +464,15 @@ class OSDMap:
         self.pg_upmap_items.update(inc.new_pg_upmap_items)
         for pgid in inc.old_pg_upmap_items:
             self.pg_upmap_items.pop(pgid, None)
+
+    # -- stretch mode ------------------------------------------------------
+    def stretch_site_up(self, site: str) -> bool:
+        """A site counts as up while any of its OSDs is up."""
+        return any(self.is_up(o) for o in self.stretch_sites.get(site, []))
+
+    def stretch_down_sites(self) -> list[str]:
+        return [s for s in sorted(self.stretch_sites)
+                if not self.stretch_site_up(s)]
 
     # -- stats -------------------------------------------------------------
     def num_up_osds(self) -> int:

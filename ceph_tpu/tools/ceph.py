@@ -1,7 +1,8 @@
 """ceph CLI — mon command dispatch (reference ``src/ceph.in``).
 
     ceph -m HOST:PORT[,...] status|-s | health | df | osd df
-    ceph -m ... -w [--count N] [--timeout S]   (live event stream)
+    ceph -m ... -w [--count N] [--timeout S] [--filter CODE]
+        (live event stream; --filter narrows to one health code)
     ceph -m ... health detail | health history
     ceph -m ... health mute CODE [TTL_SECONDS] [--sticky]
     ceph -m ... health unmute CODE
@@ -80,16 +81,20 @@ def _dispatch(args, rest) -> int:
 
     if rest[0] in ("-w", "--watch", "watch"):
         # `ceph -w` — live event stream (health transitions, clog,
-        # progress); --count/--timeout bound it for scripting
+        # progress); --count/--timeout bound it for scripting;
+        # --filter CODE prints only events about that health check
+        # (repeatable — any match passes)
         sub = argparse.ArgumentParser(prog="ceph -w")
         sub.add_argument("--count", type=int, default=0)
         sub.add_argument("--timeout", type=float, default=0.0)
+        sub.add_argument("--filter", action="append", default=[])
         a = sub.parse_args(rest[1:])
         if not args.mon:
             raise SystemExit("ceph: -m HOST:PORT required")
         mc = MonClient(_monmap_from_addrs(args.mon))
         try:
-            return _watch(mc, count=a.count, timeout=a.timeout)
+            return _watch(mc, count=a.count, timeout=a.timeout,
+                          codes=[c.upper() for c in a.filter])
         finally:
             mc.shutdown()
 
@@ -273,7 +278,23 @@ def _fmt_event(kind: str, data: dict, stamp: float) -> str | None:
     return f"{ts} {kind}: {json.dumps(data, default=str)}"
 
 
-def _watch(mc: MonClient, count: int = 0, timeout: float = 0.0) -> int:
+def _event_matches(kind: str, data: dict, codes: list[str]) -> bool:
+    """--filter CODE predicate: health events match on their code,
+    clog lines on a mention of the code in their text (the mon logs
+    'Health check failed: CODE (...)' transitions), everything else is
+    suppressed when a filter is active."""
+    if not codes:
+        return True
+    if kind == "health":
+        return data.get("code") in codes
+    if kind == "clog":
+        text = data.get("text", "")
+        return any(c in text for c in codes)
+    return False
+
+
+def _watch(mc: MonClient, count: int = 0, timeout: float = 0.0,
+           codes: list[str] | None = None) -> int:
     import queue
     import time as _time
     q: queue.Queue = queue.Queue()
@@ -291,8 +312,10 @@ def _watch(mc: MonClient, count: int = 0, timeout: float = 0.0) -> int:
                 kind, data, stamp = q.get(timeout=wait)
             except queue.Empty:
                 continue
-            line = _fmt_event(kind, data if isinstance(data, dict)
-                              else {}, stamp)
+            data = data if isinstance(data, dict) else {}
+            if not _event_matches(kind, data, codes or []):
+                continue
+            line = _fmt_event(kind, data, stamp)
             if line is None:
                 continue
             print(line, flush=True)

@@ -57,7 +57,18 @@ def osdmap_to_dict(m: OSDMap) -> dict:
             "tier_of": p.tier_of, "read_tier": p.read_tier,
             "write_tier": p.write_tier, "cache_mode": p.cache_mode,
             "tiers": list(p.tiers),
+            "is_stretch": p.is_stretch,
+            "stretch_min_size": p.stretch_min_size,
         } for p in m.pools.values()],
+        "stretch": {
+            "enabled": m.stretch_mode_enabled,
+            "bucket_type": m.stretch_bucket_type,
+            "sites": {s: list(o) for s, o in m.stretch_sites.items()},
+            "tiebreaker": m.stretch_tiebreaker,
+            "degraded": m.degraded_stretch_mode,
+            "recovering": m.recovering_stretch_mode,
+            "degraded_site": m.stretch_degraded_site,
+        },
         "pg_temp": {str(pg): osds for pg, osds in m.pg_temp.items()},
         "primary_temp": {str(pg): o for pg, o in m.primary_temp.items()},
         "pg_upmap": {str(pg): osds for pg, osds in m.pg_upmap.items()},
@@ -93,6 +104,16 @@ def osdmap_from_dict(d: dict) -> OSDMap:
         for s, v in d.get("pg_upmap_items", {}).items()}
     m.erasure_code_profiles = d.get("erasure_code_profiles", {})
     m.osd_addrs = {int(o): a for o, a in d.get("osd_addrs", {}).items()}
+    st = d.get("stretch")
+    if st:
+        m.stretch_mode_enabled = bool(st.get("enabled", False))
+        m.stretch_bucket_type = int(st.get("bucket_type", 0))
+        m.stretch_sites = {s: [int(o) for o in osds]
+                           for s, osds in (st.get("sites") or {}).items()}
+        m.stretch_tiebreaker = st.get("tiebreaker", "")
+        m.degraded_stretch_mode = bool(st.get("degraded", False))
+        m.recovering_stretch_mode = bool(st.get("recovering", False))
+        m.stretch_degraded_site = st.get("degraded_site", "")
     return m
 
 

@@ -11,14 +11,48 @@ fixture every end-to-end test runs on, and the substrate for the
 from __future__ import annotations
 
 import queue
+import random
 import socket
 import time
 
 from .mds.daemon import MDSDaemon
 from .mon.monitor import MonMap, Monitor
 from .msg import EntityAddr
+from .msg.fault import site_pairs
 from .osd.daemon import OSDaemon
 from .osdc.librados import Rados
+
+
+def health_event(code: str, state: str):
+    """Predicate factory for ``game_day`` phases / watcher loops:
+    matches the health event where `code` transitions to `state`
+    ("failed" / "cleared"), or — for state "rollup:HEALTH_OK" style —
+    a rollup event reaching that status.
+
+    Catch-up snapshots also satisfy the predicate when they already
+    show the target state: a watcher whose session mon died mid-drill
+    re-hunts and re-subscribes, and the transition it was blocking on
+    may only be visible as the fresh snapshot's contents."""
+    if state.startswith("rollup:"):
+        want = state.split(":", 1)[1]
+
+        def _rollup(ev):
+            d = ev["data"]
+            return (ev["kind"] == "health"
+                    and d.get("state") in ("rollup", "snapshot")
+                    and d.get("status") == want)
+        return _rollup
+
+    def _pred(ev):
+        if ev["kind"] != "health":
+            return False
+        d = ev["data"]
+        if d.get("state") == "snapshot":
+            present = code in (d.get("checks") or [])
+            return present if state == "failed" else \
+                (not present if state == "cleared" else False)
+        return d.get("code") == code and d.get("state") == state
+    return _pred
 
 
 def _free_ports(n: int) -> list[int]:
@@ -72,7 +106,11 @@ class MiniCluster:
     def __init__(self, n_mons: int = 3, n_osds: int = 3, *,
                  osd_stores=None, mon_stores=None,
                  osd_config: dict | None = None,
-                 secure: bool = False):
+                 secure: bool = False,
+                 stretch_sites: dict[str, list[int]] | None = None,
+                 mon_sites: dict[int, str] | None = None,
+                 tiebreaker_mon: int = -1,
+                 fault_seed: int | None = None):
         # option overrides applied to every OSD BEFORE construction
         # (some, e.g. osd_op_queue, are consumed in the ctor)
         self._osd_config = dict(osd_config or {})
@@ -84,9 +122,30 @@ class MiniCluster:
         if secure:
             from .core.auth import ClusterAuth
             self.auth = ClusterAuth()
+        # stretch topology: OSD site membership drives the CRUSH
+        # hierarchy (enable_stretch_mode) and the site fault fabric;
+        # mons are spread round-robin across the sites with the last
+        # rank as tiebreaker unless the caller places them explicitly
+        self.stretch_sites = {s: sorted(o) for s, o
+                              in (stretch_sites or {}).items()}
+        if self.stretch_sites and mon_sites is None:
+            names = sorted(self.stretch_sites)
+            if tiebreaker_mon < 0:
+                tiebreaker_mon = n_mons - 1
+            mon_sites = {}
+            k = 0
+            for r in range(n_mons):
+                if r == tiebreaker_mon:
+                    mon_sites[r] = "tiebreaker"
+                else:
+                    mon_sites[r] = names[k % len(names)]
+                    k += 1
+        self.fault_seed = fault_seed
         ports = _free_ports(n_mons)
         self.monmap = MonMap(mons={r: EntityAddr("127.0.0.1", ports[r])
-                                   for r in range(n_mons)})
+                                   for r in range(n_mons)},
+                             sites=dict(mon_sites or {}),
+                             tiebreaker=tiebreaker_mon)
         self.mons = [Monitor(r, self.monmap,
                              store=mon_stores[r] if mon_stores else None,
                              auth=self.auth)
@@ -98,9 +157,19 @@ class MiniCluster:
         self.mdss: dict[str, MDSDaemon] = {}
         self.mgrs: dict[str, object] = {}
         self._fs_clients: list = []
+        # (injector, src, dst) triples the site primitives installed,
+        # so heal_sites removes exactly what it added
+        self._site_rules: list[tuple] = []
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, timeout: float = 30.0) -> "MiniCluster":
+        if self.fault_seed is not None:
+            # one logged seed reseeds every daemon injector: verdicts
+            # are pure functions of (seed, src, dst, n), so a whole
+            # site event replays from this number alone
+            for m in self.mons:
+                m.msgr.faults.seed = int(self.fault_seed)
+                m.msgr.faults.rng = random.Random(int(self.fault_seed))
         for m in self.mons:
             m.start()
         deadline = time.monotonic() + timeout
@@ -125,6 +194,9 @@ class MiniCluster:
                 cfg.set(k, v)
         osd = OSDaemon(i, self.monmap, store=store, config=cfg,
                        auth=self.auth)
+        if self.fault_seed is not None:
+            osd.msgr.faults.seed = int(self.fault_seed)
+            osd.msgr.faults.rng = random.Random(int(self.fault_seed))
         osd.start(wait_for_up=True, timeout=timeout)
         self.osds[i] = osd
         return osd
@@ -307,6 +379,148 @@ class MiniCluster:
             for j in self.osds:
                 if j != i:
                     osd.msgr.faults.heal(dst=f"osd.{j}")
+
+    # -- stretch / site fault fabric ---------------------------------------
+    def site_daemons(self, site: str) -> list[str]:
+        """Entity names of every daemon placed in `site`: its mons
+        (monmap placement) and its OSDs (stretch_sites)."""
+        ents = [f"mon.{r}" for r, s in sorted(self.monmap.sites.items())
+                if s == site]
+        ents += [f"osd.{o}"
+                 for o in self.stretch_sites.get(site, [])]
+        return ents
+
+    def _entity_injectors(self) -> dict:
+        """entity name → that live daemon's FaultInjector."""
+        inj = {m.name: m.msgr.faults for m in self.mons}
+        inj.update({f"osd.{i}": osd.msgr.faults
+                    for i, osd in self.osds.items()})
+        return inj
+
+    def enable_stretch_mode(self, rados=None) -> dict:
+        """Switch the cluster to stretch mode: two-datacenter CRUSH
+        map, stretch rule, every replicated pool size=4/min_size=2.
+        Requires the cluster to have been built with
+        ``stretch_sites`` (and, for tiebreaker quorum semantics, an
+        odd mon count with the tiebreaker rank)."""
+        if len(self.stretch_sites) != 2:
+            raise ValueError("stretch mode needs exactly 2 sites")
+        r = rados or self.rados()
+        tb = (f"mon.{self.monmap.tiebreaker}"
+              if self.monmap.tiebreaker >= 0 else "")
+        rc, outs, out = r.mon_command({
+            "prefix": "osd enable-stretch-mode",
+            "sites": {s: list(o)
+                      for s, o in self.stretch_sites.items()},
+            "tiebreaker": tb})
+        if rc != 0:
+            raise RuntimeError(f"enable-stretch-mode failed: {outs}")
+        return out or {}
+
+    def _install(self, inj_map, src: str, dst: str, **kw):
+        inj = inj_map.get(src)
+        if inj is None:
+            return      # daemon currently dead: nothing to install on
+        if kw:
+            inj.set_rule(src, dst, **kw)
+        else:
+            inj.partition(dst, src=src)
+        self._site_rules.append((inj, src, dst))
+
+    def partition_sites(self, a: str, b: str):
+        """Cut every inter-site daemon link between sites `a` and `b`
+        (both directions) — the WAN-cut drill.  Intra-site traffic and
+        links to daemons outside either site (e.g. the tiebreaker mon)
+        keep flowing, which is exactly what lets the surviving side
+        keep quorum."""
+        inj = self._entity_injectors()
+        for s, d in site_pairs(self.site_daemons(a),
+                               self.site_daemons(b)):
+            self._install(inj, s, d)
+
+    def blackout_site(self, site: str):
+        """Whole-site power loss without killing the processes: the
+        site's daemons stop talking to ANYONE (clients included) and
+        everyone stops reaching them.  Survivors' failure reports mark
+        the site's OSDs down; its mons drop out of quorum."""
+        inj = self._entity_injectors()
+        dead = self.site_daemons(site)
+        for d_ent in dead:
+            # outbound blanket cut — replies to clients die too
+            self._install(inj, d_ent, "*")
+        for s_ent in inj:
+            if s_ent in dead:
+                continue
+            for d_ent in dead:
+                self._install(inj, s_ent, d_ent)
+
+    def slow_wan(self, a: str, b: str, *, delay: float = 0.5,
+                 delay_ms: float = 80.0, reorder: float = 0.0,
+                 reorder_ms: float = 120.0, drop: float = 0.0):
+        """Degrade (not cut) the inter-site link: delay/reorder/drop
+        probabilities applied ONLY to inter-site pairs, in both
+        directions.  Intra-site latency is untouched."""
+        inj = self._entity_injectors()
+        for s, d in site_pairs(self.site_daemons(a),
+                               self.site_daemons(b)):
+            self._install(inj, s, d, delay=delay, delay_ms=delay_ms,
+                          reorder=reorder, reorder_ms=reorder_ms,
+                          drop=drop)
+
+    def heal_sites(self):
+        """Remove exactly the rules the site primitives installed."""
+        for inj, src, dst in self._site_rules:
+            inj.heal(src=src, dst=dst)
+        self._site_rules.clear()
+
+    def preview_site_schedule(self, a: str, b: str,
+                              count: int = 32) -> dict[str, list]:
+        """The deterministic fault schedule every inter-site pair
+        would see for its next `count` messages — pure (no counter
+        advance).  Equal seeds + equal rules ⇒ equal schedules: the
+        acceptance hook for site-event replay."""
+        inj = self._entity_injectors()
+        out = {}
+        for s, d in site_pairs(self.site_daemons(a),
+                               self.site_daemons(b)):
+            if s in inj:
+                out[f"{s}>{d}"] = inj[s].preview(s, d, count)
+        return out
+
+    def game_day(self, phases, *, timeout: float = 60.0) -> list[dict]:
+        """Run a scripted site-disaster drill.
+
+        Each phase is ``{"name", "action": fn(cluster)|None,
+        "until": fn(event)->bool|None, "timeout": s}``: fire the
+        action, then (if `until` is given) consume the live `ceph -w`
+        event stream until the predicate matches.  Returns per-phase
+        wall-clock timings — the bench stretch leg reads
+        ``site_failover_detect_s`` and ``site_heal_convergence_s``
+        straight out of this report."""
+        report = []
+        with self.watch() as w:
+            for ph in phases:
+                name = ph.get("name", "?")
+                t0 = time.monotonic()
+                action = ph.get("action")
+                if action is not None:
+                    action(self)
+                until = ph.get("until")
+                if until is not None:
+                    deadline = time.monotonic() + \
+                        float(ph.get("timeout", timeout))
+                    while True:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            raise TimeoutError(
+                                f"game day phase {name!r} never "
+                                "reached its target event")
+                        ev = w.next(timeout=left)
+                        if until(ev):
+                            break
+                report.append({"phase": name,
+                               "elapsed_s": time.monotonic() - t0})
+        return report
 
     # -- cluster helpers ---------------------------------------------------
     def watch(self) -> ClusterWatcher:

@@ -113,3 +113,37 @@ class TestCephCLI:
         assert json.loads(out)["osd_weight"][1] == 0x8000
         rc, _ = _run(cluster, "osd", "reweight", "1", "1.0")
         assert rc == 0
+
+    def test_watch_filter_prints_only_matching_code(self, cluster,
+                                                    capsys):
+        """`ceph -w --filter CODE`: only events about CODE reach the
+        terminal — the audit clog line for the very command that
+        raised it is suppressed."""
+        import threading
+        import time
+
+        addrs = ",".join(f"{a.host}:{a.port}"
+                         for a in cluster.monmap.mons.values())
+        rcbox = []
+
+        def run():
+            rcbox.append(ceph_main(
+                ["-m", addrs, "-w", "--count", "1", "--timeout",
+                 "30", "--filter", "osdmap_flags"]))   # case-folded
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(1.0)         # let the subscription land
+        r = cluster.rados()
+        try:
+            assert r.mon_command({"prefix": "osd set",
+                                  "key": "noout"})[0] == 0
+            t.join(timeout=40)
+            assert not t.is_alive() and rcbox == [0]
+            out = capsys.readouterr().out
+            lines = [ln for ln in out.splitlines() if ln.strip()]
+            assert len(lines) == 1, lines
+            assert "OSDMAP_FLAGS" in lines[0]
+            assert "audit" not in out
+        finally:
+            r.mon_command({"prefix": "osd unset", "key": "noout"})

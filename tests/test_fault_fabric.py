@@ -17,7 +17,8 @@ import pytest
 
 from ceph_tpu.msg import Dispatcher, MGenericReply, Messenger
 from ceph_tpu.msg.fault import (DROP, DUP, PARTITION, REORDER,
-                                FaultInjector, injector_from_config)
+                                FaultInjector, injector_from_config,
+                                site_pairs)
 from ceph_tpu.osdc.objecter import BackoffRegistry
 
 
@@ -118,6 +119,40 @@ class TestFaultInjector:
         fi.set_rule("a", "b", dup=0.0, delay=1.0)
         d = fi.decide("a", "b")
         assert d.verdict == "delay" and d.hold_s == pytest.approx(0.02)
+
+    def test_site_pairs_enumeration(self):
+        """The site-level unit: every directed inter-site pair, in a
+        deterministic (sorted) order, both directions by default."""
+        east = ["osd.1", "mon.0", "osd.0"]
+        west = ["osd.2", "mon.1"]
+        pairs = site_pairs(east, west)
+        assert len(pairs) == 12
+        assert pairs[:2] == [("mon.0", "mon.1"), ("mon.0", "osd.2")]
+        assert ("osd.2", "mon.0") in pairs       # reverse direction
+        oneway = site_pairs(east, west, bidirectional=False)
+        assert len(oneway) == 6
+        assert all(s in sorted(east) for s, _ in oneway)
+        # pure: same inputs, same order, every time
+        assert pairs == site_pairs(east, west)
+
+    def test_preview_pairs_site_schedule_replays(self):
+        """preview() lifted to a whole site event: two injectors with
+        equal seeds and rules agree on the schedule of EVERY
+        inter-site pair, and previewing advances no counters."""
+        pairs = site_pairs(["osd.0", "mon.0"], ["osd.1", "mon.1"])
+        a, b = FaultInjector(seed=21), FaultInjector(seed=21)
+        for fi in (a, b):
+            for s, d in pairs:
+                fi.set_rule(s, d, drop=0.3, delay=0.2)
+        sa = a.preview_pairs(pairs, 48)
+        assert set(sa) == {f"{s}>{d}" for s, d in pairs}
+        assert sa == b.preview_pairs(pairs, 48)
+        assert a.describe()["counters"] == {}    # pure
+        # pairs are independent: distinct directions, distinct fates
+        assert sa["osd.0>osd.1"] != sa["osd.1>osd.0"]
+        # and the lived schedule walks exactly the preview
+        lived = [a.decide("osd.0", "osd.1").verdict for _ in range(48)]
+        assert lived == sa["osd.0>osd.1"]
 
     def test_seeded_socket_cut_replays(self):
         a, b = FaultInjector(seed=11), FaultInjector(seed=11)
@@ -303,6 +338,49 @@ class TestClusterBackoff:
             assert attempts and max(attempts) <= 3, attempts
             c.revive_osd(victims[0])
             # re-peer at min_size ⇒ unblock releases the parked op
+            assert comp.wait_for_complete(timeout=30.0)
+            assert comp.rc == 0
+            assert wait_for(lambda: obj.backoffs.count() == 0,
+                            timeout=10)
+            assert io.read("o") == b"v2"
+            r.shutdown()
+
+    def test_netsplit_roundtrip_preserves_parked_backoff(self):
+        """Regression: installing and healing an osd↔osd netsplit
+        while a client op sits parked on a backoff must not disturb
+        the parked state — the backoff belongs to the client↔primary
+        session, not to the osd↔osd edges the netsplit touches."""
+        from ceph_tpu.vstart import MiniCluster
+        with MiniCluster(n_mons=1, n_osds=3) as c:
+            r = c.rados()
+            # min_size == size: one death parks every write
+            r.create_pool("bk2", pg_num=1, size=3, min_size=3)
+            io = r.open_ioctx("bk2")
+            io.write_full("o", b"v1")
+            c.wait_for_clean()
+            primary = next(i for i, osd in c.osds.items()
+                           if any(pg.is_primary
+                                  for pg in osd.pgs.values()))
+            victim = next(i for i in c.osds if i != primary)
+            c.kill_osd(victim)
+            c.wait_for_osd_down(victim)
+            obj = r.objecter
+            comp = io.aio_write_full("o", b"v2")
+            assert wait_for(lambda: obj.backoffs.count() > 0,
+                            timeout=10), "write never parked"
+            # round-trip a partition between the two survivors while
+            # the op is parked (short: under the heartbeat grace, so
+            # no mark-down noise)
+            a, b = sorted(c.osds)
+            c.partition_osds(a, b)
+            time.sleep(0.5)
+            c.heal_netsplit()
+            assert not c.osds[a].msgr.faults.active
+            assert not c.osds[b].msgr.faults.active
+            # the parked backoff survived the round-trip untouched
+            assert obj.backoffs.count() > 0
+            assert not comp.wait_for_complete(timeout=1.0)
+            c.revive_osd(victim)
             assert comp.wait_for_complete(timeout=30.0)
             assert comp.rc == 0
             assert wait_for(lambda: obj.backoffs.count() == 0,

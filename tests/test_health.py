@@ -63,6 +63,52 @@ class TestEvaluators:
         assert by_code["PG_DAMAGED"]["severity"] == "ERR"
         assert rollup(checks) == "HEALTH_ERR"
 
+    def test_stretch_degraded_and_recovering(self):
+        ctx = _synth_ctx()
+        m = ctx.osdmap
+        m.stretch_mode_enabled = True
+        m.degraded_stretch_mode = True
+        m.stretch_degraded_site = "west"
+        by_code = {c["code"]: c for c in evaluate_checks(ctx)}
+        chk = by_code["DEGRADED_STRETCH_MODE"]
+        assert chk["severity"] == "WARN"
+        assert "site 'west' is down" in chk["summary"]
+        m.recovering_stretch_mode = True
+        by_code = {c["code"]: c for c in evaluate_checks(ctx)}
+        assert "recovering" in by_code["DEGRADED_STRETCH_MODE"][
+            "summary"]
+        m.degraded_stretch_mode = False
+        m.recovering_stretch_mode = False
+        assert evaluate_checks(ctx) == []
+
+    def test_pg_not_scrubbed_warns_on_age(self):
+        ctx = _synth_ctx(pg_states=("active+clean", "active+clean"))
+        stats = list(ctx.pgmap.pg_stats.values())
+        stats[0]["last_scrub_stamp"] = ctx.now - 2.0 * 86400.0  # late
+        stats[1]["last_scrub_stamp"] = ctx.now - 3600.0         # fresh
+        by_code = {c["code"]: c for c in evaluate_checks(ctx)}
+        chk = by_code["PG_NOT_SCRUBBED"]
+        assert chk["severity"] == "WARN" and chk["count"] == 1
+        assert "1 pgs not scrubbed in time" == chk["summary"]
+        assert "pg 1.0 not scrubbed for" in chk["detail"][0]
+
+    def test_osd_nearfull_ignores_stale_reports(self):
+        ctx = _synth_ctx()
+        ctx.pgmap.osd_stats[0] = {"stamp": ctx.now,
+                                  "bytes_used": 900,
+                                  "bytes_total": 1000}
+        ctx.pgmap.osd_stats[1] = {"stamp": ctx.now,
+                                  "bytes_used": 100,
+                                  "bytes_total": 1000}
+        # a long-dead OSD's final report must not pin the warning
+        ctx.pgmap.osd_stats[7] = {"stamp": ctx.now - 3600.0,
+                                  "bytes_used": 999,
+                                  "bytes_total": 1000}
+        by_code = {c["code"]: c for c in evaluate_checks(ctx)}
+        chk = by_code["OSD_NEARFULL"]
+        assert chk["severity"] == "WARN" and chk["count"] == 1
+        assert chk["detail"] == ["osd.0 is near full (90% used)"]
+
     def test_diff_reports_transitions(self):
         old = {"status": "HEALTH_OK", "checks": [], "muted": []}
         chk = {"code": "OSD_DOWN", "severity": "WARN",
@@ -368,4 +414,59 @@ class TestProgress:
             done = {e["id"]: e for e in out["completed"]}
             assert done["osd.2-out"]["progress"] == 1.0
             assert out["events"] == []      # nothing left open
+            r.shutdown()
+
+    def test_progress_state_survives_mgr_failover(self):
+        """The module checkpoints events + baselines to the mon
+        config-key store on every change; a promoted standby (whose
+        module instance is built from scratch and never saw the
+        osd-out) restores them instead of restarting at 0%."""
+        import json
+
+        with MiniCluster(n_mons=1, n_osds=3) as c:
+            c.start_mgr("pa")
+            c.start_mgr("pb")
+            first = c.wait_for_active_mgr()
+            r = c.rados()
+            r.create_pool("prog2", pg_num=8, size=2)
+            io = r.open_ioctx("prog2")
+            for i in range(24):
+                io.write_full(f"obj{i}", b"q" * 1024)
+            c.wait_for_clean()
+            assert r.mon_command({"prefix": "osd out",
+                                  "ids": [2]})[0] == 0
+
+            def _persisted():
+                rc, _, out = r.mon_command(
+                    {"prefix": "config-key get",
+                     "key": "mgr/progress/state"})
+                if rc != 0 or not out:
+                    return False
+                state = json.loads(out if isinstance(out, str)
+                                   else out.get("value", ""))
+                return any(e["id"] == "osd.2-out"
+                           for e in state.get("completed", []))
+
+            assert wait_for(_persisted, timeout=90), \
+                "progress state never reached the config-key store"
+            c.kill_mgr(first)
+            assert wait_for(lambda: any(m.state == "active"
+                                        for m in c.mgrs.values()),
+                            timeout=30), "standby never promoted"
+            promoted = next(m for m in c.mgrs.values()
+                            if m.state == "active")
+            assert promoted.name != first
+
+            def _restored():
+                mod = promoted.modules.get("progress")
+                return mod is not None and any(
+                    e["id"] == "osd.2-out" for e in mod.completed)
+
+            assert wait_for(_restored, timeout=30), \
+                "promoted mgr never restored persisted progress"
+            # the restored history serves `ceph progress` on the NEW mgr
+            out = promoted.modules["progress"].handle_command(
+                {"prefix": "progress"})[2]
+            done = {e["id"]: e for e in out["completed"]}
+            assert done["osd.2-out"]["progress"] == 1.0
             r.shutdown()

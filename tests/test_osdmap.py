@@ -13,8 +13,9 @@ import pytest
 
 from ceph_tpu.crush.compiler import (compile_crushmap, crushmap_from_dict,
                                      crushmap_to_dict, decompile_crushmap)
-from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, build_flat_map,
-                                build_hierarchy)
+from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, DATACENTER_TYPE,
+                                build_flat_map, build_hierarchy,
+                                build_stretch_map)
 from ceph_tpu.crush.mapper import do_rule
 from ceph_tpu.osd.osdmap import (Incremental, OSDMap, PGid, TYPE_ERASURE,
                                  UP, ceph_stable_mod)
@@ -300,3 +301,95 @@ class TestMapPGsBatch:
         batch = pool.raw_pg_to_pps_batch(np.arange(pool.pg_num))
         for s in range(pool.pg_num):
             assert int(batch[s]) == pool.raw_pg_to_pps(s)
+
+
+class TestStretch:
+    """Stretch topology + the weight-only incremental fast path."""
+
+    SITES = {"east": [0, 1], "west": [2, 3]}
+
+    def make(self, pg_num=32):
+        m = OSDMap(crush=build_stretch_map(self.SITES), max_osd=4)
+        m.epoch = 1
+        m.crush.max_devices = 4
+        for o in range(4):
+            m.osd_state[o] = 3          # EXISTS | UP
+        m.create_pool("stretch", pg_num=pg_num, size=4, min_size=2,
+                      crush_rule=0)
+        m.pools[0].is_stretch = True
+        m.pools[0].stretch_min_size = 2
+        m.stretch_mode_enabled = True
+        m.stretch_bucket_type = DATACENTER_TYPE
+        m.stretch_sites = {s: list(o) for s, o in self.SITES.items()}
+        m.stretch_tiebreaker = "mon.4"
+        return m
+
+    def test_every_pg_spans_both_sites(self):
+        m = self.make()
+        east, west = set(self.SITES["east"]), set(self.SITES["west"])
+        for s in range(m.pools[0].pg_num):
+            up, up_p, acting, _ = m.pg_to_up_acting_osds(PGid(0, s))
+            assert len(up) == 4 and len(set(up)) == 4
+            assert len(set(up) & east) == 2, up
+            assert len(set(up) & west) == 2, up
+            assert acting == up and up_p == up[0]
+
+    def test_site_loss_leaves_surviving_replicas(self):
+        m = self.make()
+        for o in self.SITES["west"]:
+            m.mark_down(o)
+        assert not m.stretch_site_up("west")
+        assert m.stretch_site_up("east")
+        east = set(self.SITES["east"])
+        for s in range(m.pools[0].pg_num):
+            up, *_ = m.pg_to_up_acting_osds(PGid(0, s))
+            assert up and set(up) <= east, up
+
+    def test_stretch_fields_json_roundtrip(self):
+        m = self.make()
+        m.degraded_stretch_mode = True
+        m.stretch_degraded_site = "west"
+        m2 = osdmap_from_dict(
+            json.loads(json.dumps(osdmap_to_dict(m))))
+        assert m2.stretch_mode_enabled
+        assert m2.stretch_bucket_type == DATACENTER_TYPE
+        assert m2.stretch_sites == {"east": [0, 1], "west": [2, 3]}
+        assert m2.stretch_tiebreaker == "mon.4"
+        assert m2.degraded_stretch_mode
+        assert m2.stretch_degraded_site == "west"
+        p = m2.pools[0]
+        assert p.is_stretch and p.stretch_min_size == 2
+        for s in range(8):
+            assert m.pg_to_up_acting_osds(PGid(0, s)) == \
+                m2.pg_to_up_acting_osds(PGid(0, s))
+
+    def test_incremental_carries_stretch_transitions(self):
+        m = self.make()
+        inc = Incremental(epoch=2, new_stretch={
+            "degraded_stretch_mode": True,
+            "stretch_degraded_site": "east"})
+        m.apply_incremental(inc)
+        assert m.degraded_stretch_mode
+        assert m.stretch_degraded_site == "east"
+        with pytest.raises(ValueError):
+            m.apply_incremental(Incremental(
+                epoch=3, new_stretch={"bogus_field": 1}))
+
+    def test_weight_only_incremental_rebinds_cached_mapper(self):
+        import copy
+        m = OSDMap.build_simple(8, pg_bits=2)
+        bm = m.batch_mapper(0, 3)
+        assert m.batch_mapper(0, 3) is bm          # plain reuse
+        # weight-only change: same topology, one item reweighted
+        crush2 = copy.deepcopy(m.crush)
+        b = next(bk for bk in crush2.buckets
+                 if bk is not None and 0 in bk.items)
+        b.weights[b.items.index(0)] //= 2
+        m.apply_incremental(Incremental(epoch=2, new_crush=crush2))
+        assert m.batch_mapper(0, 3) is bm          # rebound, not rebuilt
+        assert bm.cmap is crush2
+        # topology change: the cached mapper must be evicted
+        crush3 = build_hierarchy(2, 2, 2)
+        crush3.max_devices = m.crush.max_devices
+        m.apply_incremental(Incremental(epoch=3, new_crush=crush3))
+        assert m.batch_mapper(0, 3) is not bm

@@ -1,0 +1,307 @@
+"""Stretch-cluster site disaster drills (slow tier).
+
+The scripted game day the reference runs by hand in
+``doc/rados/operations/stretch-mode.rst`` terms: a two-datacenter
+stretch cluster loses its entire west site mid-workload.  The
+surviving site plus the tiebreaker mon keep quorum, the lead mon
+commits a degraded map (pool ``min_size`` dropped to 1) and raises
+``DEGRADED_STRETCH_MODE``, writes keep landing on the surviving
+replicas, RGW multisite sync and rbd-mirror fail clients over to a DR
+cluster, then the site heals: full replication is restored, the mon
+waits for every stretch PG to go clean before clearing the flags, and
+every byte converges.
+
+Determinism contract: all network chaos is a pure function of the one
+logged ``FAULT_SEED`` — the replay test rebuilds the whole inter-site
+fault schedule from that number alone and a fresh injector.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.msg.fault import FaultInjector, site_pairs
+from ceph_tpu.rbd.image import RBD, Image
+from ceph_tpu.rbd.mirror import MirrorDaemon, promote
+from ceph_tpu.rgw import RGWService, S3Client
+from ceph_tpu.rgw.sync import RGWSyncDaemon
+from ceph_tpu.vstart import MiniCluster, health_event
+
+from test_thrash import RadosModel, SiteThrasher
+
+pytestmark = pytest.mark.slow
+
+SITES = {"east": [0, 1], "west": [2, 3]}
+# the logged seed: the whole drill's fault schedule derives from it
+FAULT_SEED = 0x5717E5CB
+
+
+@pytest.fixture(scope="module")
+def drill():
+    """Primary stretch cluster (2 sites + tiebreaker mon) and a small
+    independent DR cluster acting as the remote RGW zone / rbd-mirror
+    peer."""
+    with MiniCluster(n_mons=5, n_osds=4, stretch_sites=SITES,
+                     fault_seed=FAULT_SEED) as c, \
+            MiniCluster(n_mons=1, n_osds=2) as dr:
+        r, rdr = c.rados(), dr.rados()
+        c.enable_stretch_mode(r)
+        yield c, dr, r, rdr
+
+
+def _stretch_status(r):
+    rc, outs, out = r.mon_command({"prefix": "osd stretch status"})
+    assert rc == 0, outs
+    return out
+
+
+def test_game_day_site_loss_and_recovery(drill):
+    c, dr, r, rdr = drill
+
+    st = _stretch_status(r)
+    assert st["enabled"] and not st["degraded"]
+    assert st["sites"]["east"]["up"] and st["sites"]["west"]["up"]
+
+    # -- stretch pool + seeded model workload --------------------------
+    r.create_pool("drill", pg_num=8)
+    io = r.open_ioctx("drill")
+    pid = r.objecter.osdmap.pool_name["drill"]
+    pool = r.objecter.osdmap.pools[pid]
+    assert pool.is_stretch and pool.size == 4 and pool.min_size == 2
+    model = RadosModel(io, seed=FAULT_SEED)
+    for _ in range(40):
+        model.step()
+    c.wait_for_clean(timeout=60.0)
+
+    # -- RGW multisite + rbd-mirror primed before the disaster ---------
+    gw = RGWService(r).start()
+    s3 = S3Client("127.0.0.1", gw.port)
+    s3.make_bucket("docs")
+    s3.put("docs", "runbook.txt", b"evacuate west")
+    s3.put("docs", "blob.bin", b"Z" * 40000)
+    sync = RGWSyncDaemon(r, rdr, interval=0.1)
+    assert sync.sync_once() >= 2          # DR zone converged
+
+    rdr.create_pool("rbd", pg_num=4)
+    # the primary's "rbd" pool is born stretch (size 4) — that's the
+    # point: the image's journal survives the site loss
+    r.create_pool("rbd", pg_num=4)
+    pio, sio = r.open_ioctx("rbd"), rdr.open_ioctx("rbd")
+    rbd = RBD()
+    rbd.create(pio, "vm-disk", 1 << 20, order=16, journaling=True)
+    with Image(pio, "vm-disk") as img:
+        img.write(0, b"bootsector" * 10)
+    mirror = MirrorDaemon(pio, sio, interval=0.05).start()
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        try:
+            if Image(sio, "vm-disk").read(0, 10) == b"bootsector":
+                break
+        except Exception:
+            pass
+        time.sleep(0.05)
+    else:
+        raise TimeoutError("rbd mirror never bootstrapped")
+    mirror.stop()       # its primary-side reads would park mid-drill
+
+    # -- background workload that keeps mutating through the drill -----
+    wl_stop = threading.Event()
+    wl_errors: list[BaseException] = []
+
+    def _workload():
+        while not wl_stop.is_set():
+            try:
+                model.step()
+            except BaseException as e:      # noqa: BLE001 — audit later
+                wl_errors.append(e)
+                return
+
+    wl = threading.Thread(target=_workload, name="drill-wl",
+                          daemon=True)
+
+    # -- the scripted drill --------------------------------------------
+    drill_log: dict = {}
+
+    def _degraded_writes(cl):
+        st = _stretch_status(r)
+        assert st["degraded"] and st["degraded_site"] == "west"
+        # min_size dropped: a 2-replica east-only write must land
+        io._sync("drill-sentinel",
+                 [{"op": "write_full", "data": (b"degraded" * 64).hex()}],
+                 timeout=30.0)
+        drill_log["degraded_pool_min_size"] = \
+            r.objecter.osdmap.pools[pid].min_size
+
+    def _client_failover(cl):
+        # RGW: reads fail over to a gateway fronting the DR zone
+        gw_dr = RGWService(rdr).start()
+        try:
+            s3_dr = S3Client("127.0.0.1", gw_dr.port)
+            assert s3_dr.get("docs", "runbook.txt")[1] == \
+                b"evacuate west"
+            assert s3_dr.get("docs", "blob.bin")[1] == b"Z" * 40000
+        finally:
+            gw_dr.shutdown()
+        # RBD: promote the mirrored image at the DR site and write
+        promote(sio, "vm-disk")
+        with Image(sio, "vm-disk") as dimg:
+            assert dimg.is_primary()
+            dimg.write(4096, b"dr-takeover")
+        # the site event schedule, captured while the rules are live
+        drill_log["blackout_sched"] = \
+            cl.preview_site_schedule("east", "west", count=16)
+
+    wl.start()
+    try:
+        report = c.game_day([
+            {"name": "blackout",
+             "action": lambda cl: cl.blackout_site("west"),
+             "until": health_event("DEGRADED_STRETCH_MODE", "failed"),
+             "timeout": 90.0},
+            {"name": "degraded-writes", "action": _degraded_writes},
+            {"name": "client-failover", "action": _client_failover},
+            {"name": "heal",
+             "action": lambda cl: cl.heal_sites(),
+             "until": health_event("DEGRADED_STRETCH_MODE", "cleared"),
+             "timeout": 150.0},
+        ])
+    finally:
+        wl_stop.set()
+        wl.join(timeout=60.0)
+        gw.shutdown()
+
+    assert not wl_errors, f"workload died mid-drill: {wl_errors!r}"
+    assert [p["phase"] for p in report] == \
+        ["blackout", "degraded-writes", "client-failover", "heal"]
+    assert report[0]["elapsed_s"] > 0
+    assert drill_log["degraded_pool_min_size"] == 1
+
+    # blackout partitions every inter-site pair deterministically
+    assert drill_log["blackout_sched"] and all(
+        v == "partition" for sched in
+        drill_log["blackout_sched"].values() for v in sched)
+
+    # -- convergence audit ---------------------------------------------
+    st = _stretch_status(r)
+    assert not st["degraded"] and not st["recovering"]
+    assert st["sites"]["west"]["up"]
+    c.wait_for_clean(timeout=60.0)
+    assert r.objecter.osdmap.pools[pid].min_size == 2
+
+    # every byte the model wrote — before, during and after the
+    # blackout — reads back identically from the healed cluster
+    model.verify_all()
+    assert model.ops > 40
+    got, _ = io._sync("drill-sentinel", [{"op": "read", "off": 0}],
+                      timeout=30.0)
+    assert bytes.fromhex(got[0]["data"]) == b"degraded" * 64
+
+    # DR site kept the promoted image's writes
+    with Image(sio, "vm-disk") as dimg:
+        assert dimg.read(4096, 11) == b"dr-takeover"
+        assert dimg.read(0, 10) == b"bootsector"
+
+
+def test_site_schedule_replays_from_logged_seed(drill):
+    """Acceptance hook: a second run from the logged seed produces
+    the same event schedule.  The live injectors' WAN-degradation
+    verdicts are reproduced exactly by a FRESH injector built from
+    FAULT_SEED and the same rules — nothing else (threading, wall
+    clock, traffic on other pairs) leaks in."""
+    c, dr, r, rdr = drill
+    kw = dict(delay=0.3, delay_ms=50.0, reorder=0.1,
+              reorder_ms=80.0, drop=0.1)
+    c.slow_wan("east", "west", **kw)
+    try:
+        live = c.preview_site_schedule("east", "west", count=64)
+    finally:
+        c.heal_sites()
+
+    pairs = site_pairs(c.site_daemons("east"), c.site_daemons("west"))
+    assert {f"{s}>{d}" for s, d in pairs} == set(live)
+    fresh = FaultInjector(seed=FAULT_SEED)
+    for s, d in pairs:
+        fresh.set_rule(s, d, **kw)
+    assert fresh.preview_pairs(pairs, 64) == live
+    # the schedule is non-trivial: faults actually fire, and the two
+    # directions of one pair see different (but reproducible) fates
+    verdicts = {v for sched in live.values() for v in sched}
+    assert verdicts & {"drop", "delay", "reorder"}
+    a, b = sorted(live)[:2]
+    assert live[a] != live[b]
+
+
+def test_partitioned_site_cannot_win_quorum(drill):
+    """The losing side of the split: with the WAN cut, the minority
+    site's mons (2 of 5, no tiebreaker) must NOT form a quorum — the
+    tiebreaker always sides with exactly one site."""
+    c, dr, r, rdr = drill
+    c.partition_sites("east", "west")
+    try:
+        deadline = time.monotonic() + 30.0
+        west_ranks = {rk for rk, s in c.monmap.sites.items()
+                      if s == "west"}
+        while time.monotonic() < deadline:
+            lead = [m for m in c.mons if m.is_leader
+                    and m.rank not in west_ranks]
+            q = set(lead[0].elector.quorum or []) if lead else set()
+            # post-re-election quorum: majority, all on the east side
+            # of the split (east + tiebreaker) — never a west rank
+            if len(q) >= 3 and not q & west_ranks:
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("no surviving-site quorum emerged")
+        # a west mon that led BEFORE the cut keeps a stale is_leader
+        # flag until its lease expires; it must then stay stuck
+        # electing — 2 of 5 mons can never assemble a majority
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if not any(m.is_leader and m.rank in west_ranks
+                       for m in c.mons):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                "partitioned west mon still claims leadership")
+    finally:
+        c.heal_sites()
+    # quorum reassembles all five mons after the heal
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        lead = [m for m in c.mons if m.is_leader]
+        if lead and len(lead[0].elector.quorum or []) == 5:
+            break
+        time.sleep(0.1)
+    else:
+        raise TimeoutError("quorum never reassembled after heal")
+    c.wait_for_clean(timeout=60.0)
+
+
+def test_site_thrasher_live_events_match_preview(drill):
+    """A short live site-thrash: the events actually injected are
+    exactly the ones the pre-run preview promised (seeded replay at
+    the site level), and the cluster survives them with bytes
+    intact."""
+    c, dr, r, rdr = drill
+    io = r.open_ioctx("drill")
+    io.write_full("thrash-canary", b"pre-thrash" * 50)
+    th = SiteThrasher(c, seed=FAULT_SEED, events=2,
+                      min_interval=0.5)
+    promised = th.preview_schedule(2)
+    th.start()
+    th._thread.join(timeout=60.0)
+    th.stop()
+    assert th.applied == promised and len(th.applied) == 2
+    assert not c._site_rules, "thrasher left fault rules installed"
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        lead = [m for m in c.mons if m.is_leader]
+        if lead and len(lead[0].elector.quorum or []) == 5:
+            break
+        time.sleep(0.1)
+    c.wait_for_clean(timeout=90.0)
+    got, _ = io._sync("thrash-canary", [{"op": "read", "off": 0}],
+                      timeout=30.0)
+    assert bytes.fromhex(got[0]["data"]) == b"pre-thrash" * 50
