@@ -789,7 +789,68 @@ def _observability_leg():
             1)
         r.shutdown()
 
+    res.update(_profiler_leg())
     res["health_eval_ms"] = _health_eval_ms()
+    return res
+
+
+def _profiler_leg():
+    """Device-profiler tax + the dispatch-floor baseline BENCH_r06
+    carries forward: EC encodes through GFLinear with the launch
+    profiler toggled, same interleaved A/B scheme as the tracing leg.
+    The profiler adds two clock reads and a dict append per launch, so
+    the acceptance bar is <2%; the dispatch-overhead and occupancy
+    percentages are the numbers the coalescing engine (ROADMAP item 1)
+    must destroy and preserve respectively."""
+    import numpy as np
+    from ceph_tpu.core.device_profiler import default_profiler
+    from ceph_tpu.ops import rs
+    from ceph_tpu.ops.gf_jax import GFLinear
+
+    k, m = 4, 2
+    coding = rs.reed_sol_van_matrix(k, m)
+    gl = GFLinear(coding, backend="xla")
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (k, 1 << 14), dtype=np.uint8)
+    prof = default_profiler()
+    was = prof.enabled
+    prof.set_enabled(False)
+    prof.reset()
+    baseline = np.asarray(gl(data))          # JIT warmup
+    batch, rounds = 50, 10
+    elapsed = {False: 0.0, True: 0.0}
+    for rnd in range(rounds):
+        order = (False, True) if rnd % 2 == 0 else (True, False)
+        for profiled in order:
+            prof.set_enabled(profiled)
+            t0 = time.monotonic()
+            for _ in range(batch):
+                # materialize per call, as every OSD write does — the
+                # profiler's post-launch fence is then a no-op and the
+                # A/B delta isolates its bookkeeping cost instead of
+                # penalizing it for breaking async pipelining the real
+                # path never had
+                np.asarray(gl(data))
+            elapsed[profiled] += time.monotonic() - t0
+    prof.set_enabled(False)
+    assert np.array_equal(np.asarray(gl(data)), baseline), \
+        "profiling changed encode results"
+    agg = prof.aggregate()
+    tot = agg["totals"]
+    overhead = 100.0 * (elapsed[True] - elapsed[False]) \
+        / elapsed[False]
+    assert overhead < 2.0, f"profiler overhead {overhead:.2f}%"
+    res = {
+        "profiler_overhead_pct": round(overhead, 2),
+        "profiled_launches": tot["launches"],
+        "dispatch_overhead_pct": round(
+            100.0 * agg["dispatch_overhead_ratio"], 1),
+        "device_occupancy_pct": round(
+            100.0 * agg["occupancy_ratio"], 1),
+        "idle_gap_avg_us": round(1e6 * agg["idle_gap_avg_s"], 1),
+    }
+    prof.reset()
+    prof.set_enabled(was)
     return res
 
 

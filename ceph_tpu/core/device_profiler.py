@@ -1,0 +1,291 @@
+"""Per-launch device profiler — the measurement half of the dispatch floor.
+
+Every device entry point (GF(256) encode, CRC-32C digest, parity
+recheck, CRUSH batch map, sharded reconstruct) brackets its kernel
+launch with :meth:`DeviceProfiler.start` / :meth:`_Launch.finish`.
+The two timestamps taken by ``finish`` split the wall time of a launch
+into
+
+* **dispatch** — host time until the (async) jitted call returned,
+  i.e. trace/lowering/executable lookup plus enqueue; this is the
+  64 ms floor ROADMAP item 1 wants dead, and
+* **compute** — the extra wait of ``jax.block_until_ready`` on the
+  result, i.e. actual device occupancy.
+
+Each sample also records bytes in/out, batch occupancy (useful rows
+vs. padded rows — padding is pure waste the coalescing engine can
+reclaim), cache-hit tags from the compile caches, and the **idle gap**
+since the previous launch ended (the cluster-level "device idle"
+series: a device that is mostly gap is starved by dispatch, not by
+work).
+
+Samples land in a bounded per-daemon ring (``deque(maxlen=...)``) and
+fold into per-kernel aggregates plus a log2 histogram of launch wall
+time, cheap enough to ship on every osd_stats beacon.  Attribution is
+thread-local: a daemon ``bind()``\\ s its profiler around the code that
+calls into the device libraries, the libraries ask
+:func:`DeviceProfiler.active` — exactly the pattern the tracer uses,
+and mirroring how upstream perf counters are owned per-daemon
+(``src/common/perf_counters.cc``).
+
+Nested instrumented calls (``ScrubEngine.recheck_parity`` re-encodes
+through ``GFLinear.__call__``) record only the **outermost** launch:
+an inner ``start`` while a launch is already open on this thread
+returns ``None``, so bytes/time are never double counted.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any
+
+from .perf_counters import LogHistogram
+
+# launch wall-time histogram: log2 buckets of microseconds, 2^31 us
+# (~35 min) ceiling — same shape the op-latency histogram uses so the
+# mgr/exporter quantile code is shared
+LAUNCH_HIST_BUCKETS = 32
+
+_tls = threading.local()
+
+
+class _Launch:
+    """One open launch; ``finish`` closes it and records the sample."""
+
+    __slots__ = ("_prof", "kernel", "t0", "t_dispatch",
+                 "bytes_in", "rows", "rows_used", "tags")
+
+    def __init__(self, prof: "DeviceProfiler", kernel: str,
+                 bytes_in: int, rows: int, rows_used: int,
+                 tags: dict[str, Any]):
+        self._prof = prof
+        self.kernel = kernel
+        self.t0 = time.monotonic()
+        self.t_dispatch = 0.0
+        self.bytes_in = int(bytes_in)
+        self.rows = int(rows)
+        self.rows_used = int(rows_used)
+        self.tags = tags
+
+    def finish(self, out: Any = None, bytes_out: int = 0,
+               **tags) -> None:
+        """Close the launch.
+
+        Called right after the (possibly async) device call returned;
+        the time to here is *dispatch*.  If ``out`` is a device value
+        it is fenced with ``block_until_ready`` and the extra wait is
+        *compute*.  Call sites that already materialise the result
+        (``np.asarray``) pass ``out=None`` with the fence implicit in
+        their own conversion — then compute is folded into dispatch,
+        which is the honest reading: the host blocked for it.
+        """
+        now = time.monotonic()
+        self.t_dispatch = now - self.t0
+        compute = 0.0
+        if out is not None:
+            try:
+                import jax
+                jax.block_until_ready(out)
+                t2 = time.monotonic()
+                compute = t2 - now
+                now = t2
+            except Exception:   # noqa: BLE001 — non-jax value: no fence
+                pass
+        if tags:
+            self.tags.update(tags)
+        self._prof._record(self, compute, now, int(bytes_out))
+
+    def abort(self) -> None:
+        """Discard an open launch (device call raised) so the
+        thread-local nesting flag doesn't stick."""
+        _tls.in_launch = False
+
+
+class DeviceProfiler:
+    """Bounded ring of per-launch samples + per-kernel aggregates."""
+
+    def __init__(self, name: str = "", ring_size: int = 1024,
+                 enabled: bool = False, perf=None):
+        self.name = name
+        self.enabled = bool(enabled)
+        self.perf = perf
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(1, int(ring_size)))
+        self._last_end: float | None = None   # for the idle-gap series
+        self._agg: dict[str, dict] = {}
+        self._hist = LogHistogram(LAUNCH_HIST_BUCKETS)
+        self._totals = self._zero_agg()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @staticmethod
+    def _zero_agg() -> dict:
+        return {"launches": 0, "dispatch_s": 0.0, "compute_s": 0.0,
+                "bytes_in": 0, "bytes_out": 0, "rows": 0,
+                "rows_used": 0, "cache_hits": 0,
+                "gap_s": 0.0, "gaps": 0}
+
+    def set_enabled(self, v: bool) -> None:
+        self.enabled = bool(v)
+
+    def set_ring_size(self, n: int) -> None:
+        with self._lock:
+            self._ring = collections.deque(self._ring,
+                                           maxlen=max(1, int(n)))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._agg.clear()
+            self._totals = self._zero_agg()
+            self._hist = LogHistogram(LAUNCH_HIST_BUCKETS)
+            self._last_end = None
+
+    # -- thread-local attribution (same pattern as the tracer) -------------
+
+    def bind(self) -> "_Bind":
+        """Context manager: device calls on this thread attribute here."""
+        return _Bind(self)
+
+    @classmethod
+    def active(cls) -> "DeviceProfiler":
+        """The profiler bound to this thread, else the process default."""
+        p = getattr(_tls, "profiler", None)
+        return p if p is not None else default_profiler()
+
+    # -- recording ---------------------------------------------------------
+
+    def start(self, kernel: str, bytes_in: int = 0, rows: int = 0,
+              rows_used: int = 0, **tags) -> _Launch | None:
+        """Open a launch; returns ``None`` when disabled or nested so
+        call sites stay zero-alloc on the fast path."""
+        if not self.enabled:
+            return None
+        if getattr(_tls, "in_launch", False):
+            return None             # outermost wins: no double counting
+        _tls.in_launch = True
+        return _Launch(self, kernel, bytes_in, rows,
+                       max(rows_used, 0) or rows, tags)
+
+    def _record(self, lnch: _Launch, compute: float, t_end: float,
+                bytes_out: int) -> None:
+        _tls.in_launch = False
+        dispatch = lnch.t_dispatch
+        total = (t_end - lnch.t0)
+        cache_hit = bool(lnch.tags.get("cache_hit"))
+        sample = {
+            "kernel": lnch.kernel,
+            "start": lnch.t0,
+            "dispatch_s": dispatch,
+            "compute_s": compute,
+            "total_s": total,
+            "bytes_in": lnch.bytes_in,
+            "bytes_out": bytes_out,
+            "rows": lnch.rows,
+            "rows_used": lnch.rows_used,
+            "tags": lnch.tags,
+        }
+        with self._lock:
+            gap = None
+            if self._last_end is not None and lnch.t0 > self._last_end:
+                gap = lnch.t0 - self._last_end
+            self._last_end = t_end
+            sample["gap_s"] = gap
+            self._ring.append(sample)
+            for agg in (self._agg.setdefault(lnch.kernel,
+                                             self._zero_agg()),
+                        self._totals):
+                agg["launches"] += 1
+                agg["dispatch_s"] += dispatch
+                agg["compute_s"] += compute
+                agg["bytes_in"] += lnch.bytes_in
+                agg["bytes_out"] += bytes_out
+                agg["rows"] += lnch.rows
+                agg["rows_used"] += lnch.rows_used
+                if cache_hit:
+                    agg["cache_hits"] += 1
+                if gap is not None:
+                    agg["gap_s"] += gap
+                    agg["gaps"] += 1
+            self._hist.add(int(total * 1e6))
+        if self.perf is not None:
+            try:
+                self.perf.inc("device_launches")
+                self.perf.tinc("device_dispatch", dispatch)
+                self.perf.tinc("device_compute", compute)
+                self.perf.inc("device_bytes_in", lnch.bytes_in)
+                self.perf.inc("device_bytes_out", bytes_out)
+                self.perf.hinc("device_launch_hist", int(total * 1e6))
+            except KeyError:
+                pass            # daemon built without device counters
+
+    # -- surfaces ----------------------------------------------------------
+
+    def samples(self) -> list[dict]:
+        with self._lock:
+            return [dict(s) for s in self._ring]
+
+    def aggregate(self) -> dict:
+        """Cheap summary for the osd_stats beacon / asok dump."""
+        with self._lock:
+            kernels = {k: dict(v) for k, v in self._agg.items()}
+            tot = dict(self._totals)
+            hist = list(self._hist.data[0])
+        t = tot["dispatch_s"] + tot["compute_s"]
+        return {
+            "name": self.name,
+            "enabled": self.enabled,
+            "kernels": kernels,
+            "totals": tot,
+            "launch_hist_us": hist,
+            "dispatch_overhead_ratio":
+                (tot["dispatch_s"] / t) if t > 0 else 0.0,
+            "occupancy_ratio":
+                (tot["rows_used"] / tot["rows"]) if tot["rows"] else 1.0,
+            "idle_gap_avg_s":
+                (tot["gap_s"] / tot["gaps"]) if tot["gaps"] else 0.0,
+        }
+
+    def dump(self) -> dict:
+        d = self.aggregate()
+        d["ring"] = self.samples()
+        return d
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+class _Bind:
+    __slots__ = ("_prof", "_prev")
+
+    def __init__(self, prof: DeviceProfiler):
+        self._prof = prof
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "profiler", None)
+        _tls.profiler = self._prof
+        return self._prof
+
+    def __exit__(self, *exc):
+        _tls.profiler = self._prev
+        return False
+
+
+_default: DeviceProfiler | None = None
+_default_lock = threading.Lock()
+
+
+def default_profiler() -> DeviceProfiler:
+    """Process-wide fallback profiler (disabled until someone enables
+    it) — used by direct library calls outside any daemon."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = DeviceProfiler(name="process")
+    return _default

@@ -176,4 +176,14 @@ def build_options() -> list[Option]:
         Option("tracer_span_budget", int, 0,
                "max trace roots started per second (0 = unlimited)",
                min=0),
+        Option("tracer_tail_slow_ms", float, 0.0,
+               "pin whole traces whose root closes slower than this "
+               "or with an error tag (0 = tail sampling off)",
+               min=0.0),
+        # -- device profiling ---------------------------------------------
+        Option("device_profiling_enable", bool, False,
+               "record per-launch device profiles (dispatch/compute "
+               "split, bytes, occupancy)"),
+        Option("device_profiler_ring_size", int, 1024,
+               "launch samples kept per daemon", min=1),
     ]

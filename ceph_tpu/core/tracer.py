@@ -116,18 +116,28 @@ class Tracer:
     path takes no lock).
     """
 
+    MAX_PINNED_TRACES = 32
+
     def __init__(self, daemon: str = "", ring_size: int = 4096,
                  enabled: bool = False, perf=None,
-                 sampling_rate: float = 1.0, span_budget: int = 0):
+                 sampling_rate: float = 1.0, span_budget: int = 0,
+                 tail_slow_s: float = 0.0):
         self.daemon = daemon
         self.enabled = bool(enabled)
         self.perf = perf
         self.sampling_rate = float(sampling_rate)
         self.span_budget = int(span_budget)     # roots/sec; 0 = off
+        # tail sampling: a root closing slower than this (or with an
+        # error tag) retroactively pins its whole trace against ring
+        # eviction — head sampling decides cheaply at admission, the
+        # tail pass rescues the traces worth keeping (0 = off)
+        self.tail_slow_s = float(tail_slow_s)
         self._budget_sec = 0
         self._budget_used = 0
         self._spans: collections.deque = collections.deque(
             maxlen=max(1, int(ring_size)))
+        # trace_id → [Span]; insertion-ordered, oldest trace evicted
+        self._pinned: dict[str, list] = {}
         self._lock = threading.Lock()
 
     # -- span lifecycle -------------------------------------------------
@@ -169,7 +179,13 @@ class Tracer:
 
     def _finish(self, span: Span) -> None:
         with self._lock:
-            self._spans.append(span)
+            if span.trace_id in self._pinned:
+                # trace already rescued: late children join it directly
+                self._pinned[span.trace_id].append(span)
+            else:
+                self._spans.append(span)
+                if span.parent_id is None and self._should_pin(span):
+                    self._pin_locked(span.trace_id)
         perf = self.perf
         if perf is not None:
             layer = span.tags.get("layer", "op")
@@ -178,11 +194,32 @@ class Tracer:
             except KeyError:
                 pass                    # layer without a counter
 
+    # -- tail sampling ---------------------------------------------------
+
+    def _should_pin(self, root: Span) -> bool:
+        if root.tags.get("error"):
+            return True
+        return (self.tail_slow_s > 0
+                and (root.duration or 0.0) > self.tail_slow_s)
+
+    def _pin_locked(self, trace_id: str) -> None:
+        """Move every span of ``trace_id`` out of the eviction ring
+        into the pinned store (caller holds the lock)."""
+        keep, mine = collections.deque(maxlen=self._spans.maxlen), []
+        for s in self._spans:
+            (mine if s.trace_id == trace_id else keep).append(s)
+        self._spans = keep
+        self._pinned[trace_id] = mine
+        while len(self._pinned) > self.MAX_PINNED_TRACES:
+            self._pinned.pop(next(iter(self._pinned)))
+
     # -- inspection -----------------------------------------------------
 
     def dump(self) -> list[dict]:
         with self._lock:
             spans = list(self._spans)
+            for group in self._pinned.values():
+                spans.extend(group)
         return [s.dump() for s in spans]
 
     def spans_for(self, trace_id: str) -> list[dict]:
@@ -191,9 +228,83 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._pinned.clear()
 
     def __len__(self) -> int:
-        return len(self._spans)
+        with self._lock:
+            return len(self._spans) + sum(
+                len(g) for g in self._pinned.values())
+
+
+def _otlp_value(v) -> dict:
+    """One OTLP AnyValue."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _otlp_attrs(tags: dict) -> list[dict]:
+    return [{"key": str(k), "value": _otlp_value(v)}
+            for k, v in tags.items()]
+
+
+def otlp_trace(spans: list[dict]) -> dict:
+    """OTLP/JSON-shaped export (OpenTelemetry ExportTraceServiceRequest):
+    one resourceSpans entry per daemon (``service.name``), spans with
+    padded 128-bit traceId / 64-bit spanId hex, nanosecond Unix
+    timestamps, attributes, events and links.
+
+    ``spans`` are ``Span.dump()`` dicts on the shared monotonic
+    clock; one wall-clock offset computed here converts them all, so
+    relative timing is preserved exactly.
+    """
+    offset = time.time() - time.monotonic()
+    by_daemon: dict[str, list[dict]] = {}
+    for s in spans:
+        by_daemon.setdefault(s.get("daemon") or "?", []).append(s)
+    resource_spans = []
+    for daemon in sorted(by_daemon):
+        otlp_spans = []
+        for s in by_daemon[daemon]:
+            start_ns = int((offset + s["start"]) * 1e9)
+            end_ns = int((offset + s["start"]
+                          + (s["duration"] or 0.0)) * 1e9)
+            rec = {
+                "traceId": s["trace_id"].ljust(32, "0"),
+                "spanId": s["span_id"].ljust(16, "0"),
+                "name": s["name"],
+                "kind": 1,              # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(start_ns),
+                "endTimeUnixNano": str(end_ns),
+                "attributes": _otlp_attrs(s.get("tags") or {}),
+            }
+            if s.get("parent_id"):
+                rec["parentSpanId"] = s["parent_id"].ljust(16, "0")
+            if s.get("events"):
+                rec["events"] = [
+                    {"timeUnixNano":
+                     str(int((offset + s["start"] + off) * 1e9)),
+                     "name": name}
+                    for off, name in s["events"]]
+            if s.get("links"):
+                rec["links"] = [
+                    {"traceId": (l.get("t") or "").ljust(32, "0"),
+                     "spanId": (l.get("s") or "").ljust(16, "0")}
+                    for l in s["links"]]
+            otlp_spans.append(rec)
+        resource_spans.append({
+            "resource": {"attributes": _otlp_attrs(
+                {"service.name": daemon,
+                 "service.namespace": "ceph-tpu"})},
+            "scopeSpans": [{
+                "scope": {"name": "ceph_tpu.tracer", "version": "1"},
+                "spans": otlp_spans}],
+        })
+    return {"resourceSpans": resource_spans}
 
 
 def chrome_trace(spans: list[dict]) -> dict:

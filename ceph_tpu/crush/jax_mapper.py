@@ -1582,19 +1582,33 @@ class BatchMapper:
         # dispatch every chunk before fetching any result: jax's async
         # dispatch overlaps the per-call relay/device latency (~60 ms
         # through axon) across chunks instead of serializing it
+        from ..core.device_profiler import DeviceProfiler
+        ln = DeviceProfiler.active().start(
+            "crush_map", bytes_in=xs.nbytes + reweight.nbytes,
+            rows=-(-len(xs) // self.chunk) * self.chunk
+            if len(xs) else 0,
+            rows_used=len(xs), cache_hit=self.cache_hit)
         pend = []
-        for lo in range(0, len(xs), self.chunk):
-            hi = min(lo + self.chunk, len(xs))
-            part = xs[lo:hi]
-            n = len(part)
-            if n < self.chunk:
-                # ALWAYS pad to the chunk shape: one compiled program
-                # per mapper regardless of call sizes (a short call
-                # used to compile a second program — and on the axon
-                # TPU backend some batch shapes also trip an XLA
-                # scoped-vmem bug in reduce-window lowering)
-                part = np.pad(part, (0, self.chunk - n))
-            pend.append((self._fn(jnp.asarray(part), wdev, ln16,
-                                  wtab), n))
-        return np.concatenate(
-            [np.asarray(res)[:n] for res, n in pend], axis=0)
+        try:
+            for lo in range(0, len(xs), self.chunk):
+                hi = min(lo + self.chunk, len(xs))
+                part = xs[lo:hi]
+                n = len(part)
+                if n < self.chunk:
+                    # ALWAYS pad to the chunk shape: one compiled program
+                    # per mapper regardless of call sizes (a short call
+                    # used to compile a second program — and on the axon
+                    # TPU backend some batch shapes also trip an XLA
+                    # scoped-vmem bug in reduce-window lowering)
+                    part = np.pad(part, (0, self.chunk - n))
+                pend.append((self._fn(jnp.asarray(part), wdev, ln16,
+                                      wtab), n))
+            out = np.concatenate(
+                [np.asarray(res)[:n] for res, n in pend], axis=0)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.finish(bytes_out=out.nbytes)
+        return out

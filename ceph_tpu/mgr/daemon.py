@@ -130,7 +130,8 @@ class PrometheusModule(MgrModule):
         super().__init__(ctx)
         self.service = ExporterService(
             Exporter(ctx._d.monc, ctx._d.asok_paths,
-                     progress_events=self._progress_events)).start()
+                     progress_events=self._progress_events,
+                     telemetry=self._telemetry)).start()
         self.port = self.service.port
 
     def _progress_events(self):
@@ -138,6 +139,10 @@ class PrometheusModule(MgrModule):
         # progress module may not exist yet at our __init__
         mod = self.ctx._d.modules.get("progress")
         return mod.snapshot() if mod is not None else []
+
+    def _telemetry(self):
+        mod = self.ctx._d.modules.get("telemetry_spine")
+        return mod.export_view() if mod is not None else {}
 
     def shutdown(self):
         self.service.shutdown()
@@ -152,11 +157,13 @@ def _default_modules():
     from .orchestrator import OrchestratorModule
     from .progress import ProgressModule
     from .rbd_support import RbdSupportModule
+    from .telemetry import TelemetrySpine
     from .volumes import VolumesModule
     return (BalancerModule, PgAutoscalerModule, PrometheusModule,
             ProgressModule, StatusModule, IostatModule, CrashModule,
-            TelemetryModule, DashboardModule, VolumesModule,
-            OrchestratorModule, DeviceHealthModule, RbdSupportModule)
+            TelemetryModule, TelemetrySpine, DashboardModule,
+            VolumesModule, OrchestratorModule, DeviceHealthModule,
+            RbdSupportModule)
 
 
 class _MgrCommandServer(Dispatcher):

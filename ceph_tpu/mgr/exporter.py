@@ -23,27 +23,48 @@ def _san(name: str) -> str:
                    for ch in name)
 
 
+def _esc_label(v) -> str:
+    """Prometheus label-value escaping: backslash, double quote and
+    newline (exposition format spec)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 class Exporter:
     def __init__(self, monc, asok_paths: dict[str, str] | None = None,
-                 progress_events=None):
+                 progress_events=None, telemetry=None):
         """monc: a MonClient; asok_paths: daemon name → admin socket
         (scraped for perf counters); progress_events: nullary callable
-        → open mgr progress events (ceph_progress_event gauge)."""
+        → open mgr progress events (ceph_progress_event gauge);
+        telemetry: nullary callable → the telemetry spine's export
+        view (device-plane series + derived byte rates)."""
         self.monc = monc
         self.asok_paths = dict(asok_paths or {})
         self.progress_events = progress_events
+        self.telemetry = telemetry
 
     def collect(self) -> str:
         lines: list[str] = []
+        # one `# TYPE`/`# HELP` per metric family, no matter how many
+        # instances emit into it (scrapers reject duplicates)
+        typed: set[str] = set()
+        helped: set[str] = set()
+
+        def emit_type(name, typ):
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {name} {typ}")
 
         def emit(name, value, labels=None, help_=None, typ="gauge"):
-            if help_:
+            if help_ and name not in helped:
+                helped.add(name)
                 lines.append(f"# HELP {name} {help_}")
-                lines.append(f"# TYPE {name} {typ}")
+                emit_type(name, typ)
             lab = ""
             if labels:
                 lab = "{" + ",".join(
-                    f'{k}="{v}"' for k, v in labels.items()) + "}"
+                    f'{k}="{_esc_label(v)}"'
+                    for k, v in labels.items()) + "}"
             lines.append(f"{name}{lab} {value}")
 
         try:
@@ -149,14 +170,14 @@ class Exporter:
             emit("ceph_cluster_slow_ops_oldest_age_seconds", worst_age,
                  help_="age of the oldest slow op")
 
-        # per-family TYPE lines, once each (families repeat across
-        # daemon instances)
-        typed: set[str] = set()
-
-        def emit_type(name, typ):
-            if name not in typed:
-                typed.add(name)
-                lines.append(f"# TYPE {name} {typ}")
+        # device-plane series from the mgr telemetry spine (profiler
+        # aggregates + derived rates the OSDs beacon via osd_stats)
+        if self.telemetry is not None:
+            try:
+                view = self.telemetry() or {}
+            except Exception:
+                view = {}
+            self._emit_device_series(emit, emit_type, view)
 
         for daemon, path in sorted(self.asok_paths.items()):
             try:
@@ -193,6 +214,56 @@ class Exporter:
                             emit_type(base, "counter")
                         emit(base, val, labels=lab)
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _emit_device_series(emit, emit_type, view):
+        """Telemetry-spine export view → the device observability
+        families: a per-daemon launch wall-time histogram (buckets in
+        seconds, converted from the profiler's log2-µs histogram) and
+        the dispatch-overhead / occupancy / byte-rate gauges."""
+        profs = view.get("profiler") or {}
+        rates = view.get("rates") or {}
+        first = True
+        for daemon in sorted(profs):
+            prof = profs[daemon] or {}
+            lab = {"ceph_daemon": daemon}
+            hist = prof.get("launch_hist_us") or []
+            if hist:
+                emit_type("ceph_device_launch_seconds", "histogram")
+                cum = 0
+                approx_sum = 0.0
+                for i, n in enumerate(hist):
+                    cum += n
+                    approx_sum += n * (2 ** i - 1) * 1e-6
+                    le = "+Inf" if i == len(hist) - 1 \
+                        else f"{(2 ** (i + 1) - 1) * 1e-6:g}"
+                    emit("ceph_device_launch_seconds_bucket", cum,
+                         labels={**lab, "le": le})
+                emit("ceph_device_launch_seconds_sum",
+                     f"{approx_sum:g}", labels=lab)
+                emit("ceph_device_launch_seconds_count", cum,
+                     labels=lab)
+            emit("ceph_device_dispatch_overhead_ratio",
+                 round(float(prof.get("dispatch_overhead_ratio",
+                                      0.0)), 6),
+                 labels=lab,
+                 help_="host dispatch time / total device wall time"
+                 if first else None)
+            emit("ceph_device_occupancy_ratio",
+                 round(float(prof.get("occupancy_ratio", 1.0)), 6),
+                 labels=lab,
+                 help_="useful rows / padded rows per launch"
+                 if first else None)
+            first = False
+        first = True
+        for daemon in sorted(rates):
+            r = rates[daemon] or {}
+            emit("ceph_osd_bytes_rate",
+                 round(float(r.get("bytes_per_sec", 0.0)), 3),
+                 labels={"ceph_daemon": daemon},
+                 help_="client write bytes per second (windowed)"
+                 if first else None)
+            first = False
 
     @staticmethod
     def _emit_histogram(emit, emit_type, base, lab, val):

@@ -138,7 +138,8 @@ class ProgressModule(MgrModule):
                            now)
 
         recovery = [e for e in self.events.values()
-                    if e["id"] != "scrub-sweep"]
+                    if e["id"] != "scrub-sweep"
+                    and not e["id"].startswith("pg_scrub/")]
         if work > 0 and not recovery:
             # degradation with no attributable map change (osd crash,
             # lost objects): one generic recovery event
@@ -156,6 +157,28 @@ class ProgressModule(MgrModule):
             self._advance(ev, 1.0 - work / base, now)
             if work == 0:
                 self._close(eid, now)
+
+        # per-PG scrub sweeps: the primary reports its chunk position
+        # (scrub maps gathered vs. the acting set) in pg_stats while a
+        # scrub is mid-flight — one `pg_scrub/<pgid>` event each, so
+        # `ceph progress` narrates individual sweeps, not just the
+        # cluster-wide scrub-sweep aggregate below
+        seen: set[str] = set()
+        for pgid, st in pg_stats.items():
+            total = int(st.get("scrub_chunks_total") or 0)
+            if "scrubbing" not in str(st.get("state", "")) \
+                    or total <= 0:
+                continue
+            eid = f"pg_scrub/{pgid}"
+            seen.add(eid)
+            ev = self.events.get(eid)
+            if ev is None:
+                ev = self._open(eid, f"Scrubbing pg {pgid}", now)
+            done = int(st.get("scrub_chunks_done") or 0)
+            self._advance(ev, done / total, now)
+        for eid in [e for e in self.events
+                    if e.startswith("pg_scrub/") and e not in seen]:
+            self._close(eid, now)
 
         sweep = self.events.get("scrub-sweep")
         if sweep is None and scrubbing > 0:

@@ -1,0 +1,274 @@
+"""mgr telemetry spine — counter time-series with derived rates.
+
+Everything before this module was point-in-time: perf counters are
+cumulative totals, ``pg dump`` a snapshot.  The spine turns the
+osd_stats beacon into **history**: every tick it ingests the selected
+counters + device-profiler aggregates each OSD ships, keeps a
+fixed-size downsampling ring per (daemon, counter) — when a ring
+fills it decimates by two and doubles its sampling stride, so memory
+stays bounded while the window keeps growing (the classic RRD
+trade) — and derives
+
+* **rates** from consecutive cumulative samples (ops/s, B/s,
+  launches/s), clamped at zero across daemon restarts,
+* **rolling p50/p99** launch times from the *delta* of the log2
+  launch histograms over the retained window (not lifetime), and
+* **device-plane ratios** straight off the profiler aggregates:
+  dispatch overhead (host dispatch time / total device wall time —
+  ROADMAP item 1's target), batch occupancy (useful rows / padded
+  rows) and the average device idle gap.
+
+``ceph iostat`` and ``ceph osd perf`` are served from here
+(reference: the mgr's ``iostat`` module and ``osd perf`` reading
+osd_stat_t fields the OSDs beacon via MPGStats).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+
+from .daemon import MgrModule
+
+# counters lifted verbatim off each osd_stats beacon into rings
+_COUNTERS = ("op", "op_w", "op_r", "op_in_bytes")
+
+
+class SeriesRing:
+    """Fixed-capacity (t, value) ring: when full, decimate by two and
+    double the sampling stride — old history thins, recent stays."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(4, int(capacity))
+        self.samples: list[tuple[float, float]] = []
+        self._stride = 1
+        self._pending = 0
+
+    def append(self, t: float, v: float):
+        self._pending += 1
+        if self._pending < self._stride:
+            return
+        self._pending = 0
+        self.samples.append((t, float(v)))
+        if len(self.samples) > self.capacity:
+            self.samples = self.samples[::2]
+            self._stride *= 2
+
+    def last(self) -> tuple[float, float] | None:
+        return self.samples[-1] if self.samples else None
+
+    def rate(self) -> float:
+        """Per-second rate from the two most recent samples of a
+        cumulative counter (>= 0: restarts step counters backwards)."""
+        if len(self.samples) < 2:
+            return 0.0
+        (t0, v0), (t1, v1) = self.samples[-2], self.samples[-1]
+        dt = t1 - t0
+        if dt <= 0:
+            return 0.0
+        return max(0.0, (v1 - v0) / dt)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def hist_quantile(counts: list[int], q: float) -> float:
+    """Approximate quantile of a log2-bucketed histogram (bucket i
+    holds values in [2^i - 1, 2^(i+1) - 1)): returns the upper bound
+    of the bucket where the cumulative count crosses q."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            return float((1 << (i + 1)) - 1)
+    return float((1 << len(counts)) - 1)
+
+
+def _hist_delta(new: list[int], old: list[int]) -> list[int]:
+    if not old or len(old) != len(new):
+        return list(new)
+    d = [n - o for n, o in zip(new, old)]
+    # a reset profiler steps buckets backwards: fall back to lifetime
+    return list(new) if any(v < 0 for v in d) else d
+
+
+class TelemetrySpine(MgrModule):
+    """Per-(daemon, counter) rings + derived rates/percentiles."""
+
+    NAME = "telemetry_spine"
+    TICK = 1.0
+    RING_CAPACITY = 256
+    HIST_WINDOW = 64           # (t, hist) snapshots kept per daemon
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self.series: dict[str, dict[str, SeriesRing]] = {}
+        self.profiler: dict[str, dict] = {}      # latest aggregate
+        self._hists: dict[str, collections.deque] = {}
+        self._latency: dict[str, SeriesRing] = {}  # op_latency sum ring
+        self._lat_count: dict[str, SeriesRing] = {}
+
+    # -- ingest ------------------------------------------------------------
+
+    def _ring(self, daemon: str, counter: str) -> SeriesRing:
+        return self.series.setdefault(daemon, {}).setdefault(
+            counter, SeriesRing(self.RING_CAPACITY))
+
+    def serve_tick(self):
+        try:
+            rc, _, dump = self.ctx.mon_command({"prefix": "pg dump"})
+        except Exception:       # noqa: BLE001 — mon churn: next tick
+            return
+        if rc != 0 or not dump:
+            return
+        now = time.monotonic()
+        for osd, st in (dump.get("osd_stats") or {}).items():
+            daemon = f"osd.{osd}"
+            for c in _COUNTERS:
+                if c in st:
+                    self._ring(daemon, c).append(now, float(st[c]))
+            lat = st.get("op_latency")
+            if isinstance(lat, dict):
+                self._latency.setdefault(
+                    daemon, SeriesRing(self.RING_CAPACITY)).append(
+                        now, float(lat.get("sum", 0.0)))
+                self._lat_count.setdefault(
+                    daemon, SeriesRing(self.RING_CAPACITY)).append(
+                        now, float(lat.get("count", 0)))
+            prof = st.get("profiler")
+            if isinstance(prof, dict):
+                self.profiler[daemon] = prof
+                tot = prof.get("totals") or {}
+                self._ring(daemon, "device_launches").append(
+                    now, float(tot.get("launches", 0)))
+                self._ring(daemon, "device_bytes").append(
+                    now, float(tot.get("bytes_in", 0)
+                               + tot.get("bytes_out", 0)))
+                hist = prof.get("launch_hist_us")
+                if hist:
+                    dq = self._hists.setdefault(
+                        daemon,
+                        collections.deque(maxlen=self.HIST_WINDOW))
+                    dq.append((now, list(hist)))
+
+    # -- derived views -----------------------------------------------------
+
+    def daemon_rates(self, daemon: str) -> dict:
+        rings = self.series.get(daemon, {})
+
+        def r(c):
+            ring = rings.get(c)
+            return ring.rate() if ring is not None else 0.0
+        return {
+            "ops_per_sec": r("op"),
+            "write_ops_per_sec": r("op_w"),
+            "read_ops_per_sec": r("op_r"),
+            "bytes_per_sec": r("op_in_bytes"),
+            "launches_per_sec": r("device_launches"),
+            "device_bytes_per_sec": r("device_bytes"),
+        }
+
+    def commit_latency_ms(self, daemon: str) -> float:
+        """Windowed client-op commit latency: delta(sum)/delta(count)
+        of the op_latency pair over the last two beacons."""
+        s, c = self._latency.get(daemon), self._lat_count.get(daemon)
+        if s is None or c is None or len(s) < 2 or len(c) < 2:
+            return 0.0
+        ds = s.samples[-1][1] - s.samples[-2][1]
+        dc = c.samples[-1][1] - c.samples[-2][1]
+        if dc <= 0:
+            # nothing completed this window: lifetime average instead
+            tot_s, tot_c = s.samples[-1][1], c.samples[-1][1]
+            return 1000.0 * tot_s / tot_c if tot_c > 0 else 0.0
+        return 1000.0 * max(ds, 0.0) / dc
+
+    def launch_percentiles(self, daemon: str) -> dict:
+        """Rolling p50/p99 launch wall time (us) over the retained
+        histogram window."""
+        dq = self._hists.get(daemon)
+        if not dq:
+            return {"p50_us": 0.0, "p99_us": 0.0}
+        newest = dq[-1][1]
+        oldest = dq[0][1] if len(dq) > 1 else [0] * len(newest)
+        delta = _hist_delta(newest, oldest)
+        if sum(delta) <= 0:
+            delta = newest      # idle window: lifetime distribution
+        return {"p50_us": hist_quantile(delta, 0.50),
+                "p99_us": hist_quantile(delta, 0.99)}
+
+    def device_summary(self, daemon: str) -> dict:
+        prof = self.profiler.get(daemon) or {}
+        tot = prof.get("totals") or {}
+        launches = int(tot.get("launches", 0))
+        disp, comp = tot.get("dispatch_s", 0.0), tot.get("compute_s", 0.0)
+        out = {
+            "launches": launches,
+            "dispatch_ms_avg":
+                1000.0 * disp / launches if launches else 0.0,
+            "compute_ms_avg":
+                1000.0 * comp / launches if launches else 0.0,
+            "dispatch_overhead_ratio":
+                float(prof.get("dispatch_overhead_ratio", 0.0)),
+            "occupancy_ratio": float(prof.get("occupancy_ratio", 1.0)),
+            "idle_gap_avg_s": float(prof.get("idle_gap_avg_s", 0.0)),
+        }
+        out.update(self.launch_percentiles(daemon))
+        return out
+
+    def iostat(self) -> dict:
+        """`ceph iostat` payload: cluster totals + per-OSD rates."""
+        osds = sorted((d for d in self.series if d.startswith("osd.")),
+                      key=lambda d: int(d.split(".", 1)[1]))
+        per = {d: self.daemon_rates(d) for d in osds}
+        cluster = {k: sum(v[k] for v in per.values())
+                   for k in ("ops_per_sec", "write_ops_per_sec",
+                             "read_ops_per_sec", "bytes_per_sec",
+                             "launches_per_sec",
+                             "device_bytes_per_sec")} if per else {
+            "ops_per_sec": 0.0, "write_ops_per_sec": 0.0,
+            "read_ops_per_sec": 0.0, "bytes_per_sec": 0.0,
+            "launches_per_sec": 0.0, "device_bytes_per_sec": 0.0}
+        return {"cluster": cluster, "osds": per}
+
+    def osd_perf(self) -> dict:
+        """`ceph osd perf` payload: commit latency + device-launch
+        breakdown per OSD."""
+        osds = sorted(set(self.series) | set(self.profiler))
+        out = {}
+        for d in osds:
+            if not d.startswith("osd."):
+                continue
+            out[d] = {
+                "commit_latency_ms": self.commit_latency_ms(d),
+                "apply_latency_ms": self.commit_latency_ms(d),
+                "device": self.device_summary(d),
+            }
+        return {"osd_perf": out}
+
+    def series_dump(self, daemon: str | None = None) -> dict:
+        """Raw rings (history surface for tests/tools)."""
+        src = (self.series if daemon is None
+               else {daemon: self.series.get(daemon, {})})
+        return {d: {c: list(r.samples) for c, r in rings.items()}
+                for d, rings in src.items()}
+
+    def export_view(self) -> dict:
+        """What the prometheus exporter consumes: latest profiler
+        aggregate + derived rates per daemon."""
+        return {"profiler": dict(self.profiler),
+                "rates": {d: self.daemon_rates(d)
+                          for d in self.series}}
+
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix in ("iostat", "iostat json"):
+            return 0, "", self.iostat()
+        if prefix == "osd perf":
+            return 0, "", self.osd_perf()
+        if prefix == "telemetry series":
+            return 0, "", self.series_dump(cmd.get("daemon"))
+        return None

@@ -193,10 +193,26 @@ class GFLinear:
         return gf_matmul_gather(self._mat, data)
 
     def __call__(self, data) -> jax.Array:
+        from ..core.device_profiler import DeviceProfiler
         arr = jnp.asarray(data, dtype=jnp.uint8)
-        if self.backend == "xla":
-            return self._fn_for_shape(arr.shape)(arr)
-        return self._fn(arr)
+        rows = int(arr.shape[0]) if arr.ndim else 0
+        ln = DeviceProfiler.active().start(
+            "gf_encode", bytes_in=arr.nbytes, rows=rows,
+            cache_hit=self.export_hits.get(arr.shape, False),
+            backend=self.backend)
+        try:
+            if self.backend == "xla":
+                out = self._fn_for_shape(arr.shape)(arr)
+            else:
+                out = self._fn(arr)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.finish(out=out, bytes_out=out.nbytes,
+                      cache_hit=self.export_hits.get(arr.shape, False))
+        return out
 
 
 class GFLinearWords:
@@ -227,9 +243,23 @@ class GFLinearWords:
 
     def __call__(self, words) -> jax.Array:
         from .gf_pallas2 import gf_matmul_words
-        return gf_matmul_words(self._mat, words, self.m,
-                               interpret=self.interpret,
-                               bdmats=self._bdmats)
+        from ..core.device_profiler import DeviceProfiler
+        warr = jnp.asarray(words)
+        ln = DeviceProfiler.active().start(
+            "gf_encode", bytes_in=warr.nbytes,
+            rows=int(warr.shape[0]) if warr.ndim else 0,
+            backend="words")
+        try:
+            out = gf_matmul_words(self._mat, warr, self.m,
+                                  interpret=self.interpret,
+                                  bdmats=self._bdmats)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.finish(out=out, bytes_out=out.nbytes)
+        return out
 
     @staticmethod
     def to_words(data: np.ndarray) -> np.ndarray:

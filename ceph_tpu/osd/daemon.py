@@ -102,6 +102,18 @@ def _build_osd_perf(name: str):
     # osd_op_latency histograms; `perf histogram dump`)
     b.add_histogram("op_latency_histogram",
                     "client op latency distribution (us, log2 buckets)")
+    # device-plane launch accounting (device_profiler sink) — zero
+    # until device_profiling_enable is on
+    b.add_u64_counter("op_in_bytes", "client write payload bytes")
+    b.add_u64_counter("device_launches", "device kernel launches")
+    b.add_time_avg("device_dispatch",
+                   "host-side dispatch time per launch")
+    b.add_time_avg("device_compute",
+                   "device compute time per launch")
+    b.add_u64_counter("device_bytes_in", "bytes shipped to device")
+    b.add_u64_counter("device_bytes_out", "bytes fetched from device")
+    b.add_histogram("device_launch_hist",
+                    "launch wall time distribution (us, log2 buckets)")
     return b.create_perf_counters()
 
 
@@ -162,6 +174,28 @@ class OSDaemon(Dispatcher):
         self.config.add_observer(
             "tracer_span_budget",
             lambda _n, v: setattr(self.tracer, "span_budget", int(v)))
+        self.config.add_observer(
+            "tracer_tail_slow_ms",
+            lambda _n, v: setattr(self.tracer, "tail_slow_s",
+                                  float(v) / 1000.0))
+        self.tracer.tail_slow_s = float(
+            self.config.get("tracer_tail_slow_ms") or 0.0) / 1000.0
+        # device-plane launch profiler: PG device call sites bind() it
+        # so launches attribute to this daemon; aggregates ride the
+        # osd_stats beacon into the mgr telemetry spine
+        from ..core.device_profiler import DeviceProfiler
+        self.profiler = DeviceProfiler(
+            name=f"osd.{whoami}",
+            ring_size=int(
+                self.config.get("device_profiler_ring_size") or 1024),
+            enabled=bool(self.config.get("device_profiling_enable")),
+            perf=self.perf)
+        self.config.add_observer(
+            "device_profiling_enable",
+            lambda _n, v: self.profiler.set_enabled(bool(v)))
+        self.config.add_observer(
+            "device_profiler_ring_size",
+            lambda _n, v: self.profiler.set_ring_size(int(v)))
         self.admin_socket = AdminSocket(
             admin_socket_path or default_path(f"osd.{whoami}"))
         self._register_admin_commands()
@@ -263,11 +297,16 @@ class OSDaemon(Dispatcher):
         # op tracing surface (reference `dump_tracing` / blkin):
         # `trace start|stop` rides one registration — the dispatcher
         # hands the full prefix through, so parse the verb here
-        a.register("dump_tracing", lambda c: {
-            "enabled": self.tracer.enabled,
-            "num_spans": len(self.tracer),
-            "spans": self.tracer.dump()},
-            "collected spans")
+        def _dump_tracing(c):
+            spans = self.tracer.dump()
+            if c.get("format") == "otlp":
+                from ..core.tracer import otlp_trace
+                return otlp_trace(spans)
+            return {"enabled": self.tracer.enabled,
+                    "num_spans": len(self.tracer),
+                    "spans": spans}
+        a.register("dump_tracing", _dump_tracing,
+                   "collected spans (format=otlp for OTLP JSON)")
 
         def _trace_ctl(c):
             verb = c.get("prefix", "").split()[-1]
@@ -282,6 +321,17 @@ class OSDaemon(Dispatcher):
             return {"enabled": self.tracer.enabled}
         a.register("trace", _trace_ctl,
                    "trace start|stop|clear — toggle span collection")
+
+        def _profiler_ctl(c):
+            verb = c.get("prefix", "").split()[-1]
+            if verb == "dump":
+                return self.profiler.dump()
+            if verb == "reset":
+                self.profiler.reset()
+                return {"success": "profiler reset"}
+            return {"error": "usage: profiler dump|reset"}
+        a.register("profiler", _profiler_ctl,
+                   "profiler dump|reset — per-launch device profiles")
         a.register("config show", lambda c: {
             k: self.config.get(k) for k in self.config.keys()},
             "effective configuration")
@@ -958,6 +1008,14 @@ class OSDaemon(Dispatcher):
                 "scrub_errors": pg.scrub_errors,
                 "inconsistent_objects": pg.inconsistent_objects,
             }
+            if pg.scrubbing:
+                # chunk position of an in-flight scrub (maps gathered
+                # vs. acting-set size) — the mgr progress module turns
+                # this into a per-PG `pg_scrub/<pgid>` event
+                stats[str(pgid)]["scrub_chunks_done"] = \
+                    pg.scrub_chunks_done()
+                stats[str(pgid)]["scrub_chunks_total"] = \
+                    pg.scrub_chunks_total()
         if stats or self.pgs:
             bytes_used = sum(st["num_bytes"] for st in stats.values())
             self.monc.send(MM.MPGStats(
@@ -976,6 +1034,18 @@ class OSDaemon(Dispatcher):
                            "op": self.perf.get("op"),
                            "op_w": self.perf.get("op_w"),
                            "op_r": self.perf.get("op_r"),
+                           "op_in_bytes": self.perf.get("op_in_bytes"),
+                           # (sum, count) so the spine can derive a
+                           # windowed commit latency, not lifetime avg
+                           "op_latency": {
+                               "sum": self.perf._counters[
+                                   "op_latency"].sum,
+                               "count": self.perf._counters[
+                                   "op_latency"].count},
+                           # device-plane launch aggregates for the
+                           # telemetry spine (dispatch/compute split,
+                           # occupancy, idle gap, launch histogram)
+                           "profiler": self.profiler.aggregate(),
                            # slow-op attribution: the mon's SLOW_OPS
                            # health check and the exporter gauges are
                            # fed from here (reference osd_stat_t
@@ -1134,6 +1204,11 @@ class OSDaemon(Dispatcher):
         is_write = bool(kinds & _WRITE_OPS)
         self.perf.inc("op")
         self.perf.inc("op_w" if is_write else "op_r")
+        if is_write:
+            # payload rides as hex text: 2 chars per byte
+            self.perf.inc("op_in_bytes", sum(
+                len(op.get("data", "")) // 2 for op in (msg.ops or [])
+                if op.get("op") in _WRITE_OPS))
         msg.tracked = self.op_tracker.create_request(
             f"osd_op({msg.client}.{msg.tid} {msg.pgid} {msg.oid} "
             f"{'+'.join(sorted(k for k in kinds if k))})")

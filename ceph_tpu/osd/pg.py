@@ -1315,6 +1315,15 @@ class PG:
         self._scrub_waiting.discard(msg.from_osd)
         self._maybe_finish_scrub()
 
+    # chunk position of the in-flight sweep (scrub maps gathered vs.
+    # the acting set) — rides pg_stats so the mgr progress module can
+    # show per-PG scrub sweeps mid-flight
+    def scrub_chunks_done(self) -> int:
+        return len(self._scrub_maps)
+
+    def scrub_chunks_total(self) -> int:
+        return len(self._scrub_maps) + len(self._scrub_waiting)
+
     def _maybe_finish_scrub(self):
         if self._scrub_waiting:
             return
@@ -1989,8 +1998,13 @@ class ECBackend:
     @property
     def engine(self):
         if self._engine is None:
+            # resolve exactly like the mon's `osd pool create` (same
+            # "default" alias and k=2/m=2 fallback): a different
+            # fallback here desyncs the chunk count from pool.size —
+            # CRUSH then maps a shard the encoder never produces
             prof_d = self.pg.daemon.osdmap.erasure_code_profiles.get(
-                self.pg.pool.erasure_code_profile, {"k": "2", "m": "1"})
+                self.pg.pool.erasure_code_profile or "default",
+                {"k": "2", "m": "2"})
             self._engine = create_erasure_code(ECProfile.parse(prof_d))
         return self._engine
 
@@ -2169,7 +2183,8 @@ class ECBackend:
                 "gf_encode", parent=_ospan, tags={
                     "layer": "device", "kernel": "gf_encode",
                     "bytes": len(data), "k": k, "m": m})
-            out = self.engine.encode(set(range(k + m)), data)
+            with daemon.profiler.bind():
+                out = self.engine.encode(set(range(k + m)), data)
             shard_chunks = {i: bytes(out[i].tobytes())
                             for i in range(k + m)}
             if span is not None:
@@ -2845,7 +2860,9 @@ class ECBackend:
                     "bytes": sum(len(b) for b in chunks.values())})
             if span is not None:
                 span.add_link(getattr(pg, "_scrub_trace", None))
-            for oid, digest in eng.compute_digests(chunks).items():
+            with pg.daemon.profiler.bind():
+                digests = eng.compute_digests(chunks)
+            for oid, digest in digests.items():
                 hinfo = metas[oid].get("hinfo")
                 out[oid].update(
                     crc=digest, data=chunks[oid].hex(),
@@ -2945,7 +2962,8 @@ class ECBackend:
                 "pgid": str(pg.pgid), "stripes": len(stripes)})
         if span is not None:
             span.add_link(getattr(pg, "_scrub_trace", None))
-        verdicts = eng.recheck_parity(ec, stripes)
+        with pg.daemon.profiler.bind():
+            verdicts = eng.recheck_parity(ec, stripes)
         if span is not None:
             span.set_tag("bytes", eng.parity_bytes - before)
             span.finish()

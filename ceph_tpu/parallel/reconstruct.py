@@ -163,7 +163,21 @@ class ShardedEC:
 
     def encode(self, data_padded) -> jax.Array:
         """[B, k_pad, C] (sharded or host) -> parity [B, m, C]."""
-        return self._encode(data_padded)
+        from ..core.device_profiler import DeviceProfiler
+        nbytes = getattr(data_padded, "nbytes", 0)
+        B = int(data_padded.shape[0])
+        ln = DeviceProfiler.active().start(
+            "sharded_encode", bytes_in=nbytes,
+            rows=B * self.k_pad, rows_used=B * self.k)
+        try:
+            out = self._encode(data_padded)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.finish(out=out, bytes_out=getattr(out, "nbytes", 0))
+        return out
 
     # -- degraded read: all-gather survivors, decode locally ---------------
     def _decode_fn(self, erasures: tuple[int, ...]):
@@ -224,7 +238,23 @@ class ShardedEC:
         ``erasures`` lists erased chunk ids; their rows in the input are
         ignored (may be garbage/zeros).
         """
-        return self._decode_fn(tuple(sorted(erasures)))(chunks_padded)
+        from ..core.device_profiler import DeviceProfiler
+        key = tuple(sorted(erasures))
+        B = int(chunks_padded.shape[0])
+        ln = DeviceProfiler.active().start(
+            "sharded_reconstruct",
+            bytes_in=getattr(chunks_padded, "nbytes", 0),
+            rows=B * self.n_pad, rows_used=B * (self.k + self.m),
+            cache_hit=key in self._decode_cache)
+        try:
+            out = self._decode_fn(key)(chunks_padded)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.finish(out=out, bytes_out=getattr(out, "nbytes", 0))
+        return out
 
     def assemble_chunks(self, data_padded, parity) -> jnp.ndarray:
         """Lay out the [B, n_pad, C] chunk array `_decode_fn` expects:

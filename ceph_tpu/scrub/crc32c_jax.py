@@ -239,4 +239,18 @@ def crc32c_batch(data, seeds=None) -> np.ndarray:
         s = jnp.zeros(n, dtype=jnp.uint32)
     else:
         s = jnp.asarray(seeds, dtype=jnp.uint32)
-    return np.asarray(_batch_kernel(length)(arr, s), dtype=np.uint32)
+    from ..core.device_profiler import DeviceProfiler
+    misses = _batch_kernel.cache_info().misses
+    ln = DeviceProfiler.active().start(
+        "crc32c", bytes_in=arr.nbytes, rows=n)
+    try:
+        out = _batch_kernel(length)(arr, s)
+    except Exception:
+        if ln is not None:
+            ln.abort()
+        raise
+    res = np.asarray(out, dtype=np.uint32)
+    if ln is not None:
+        ln.finish(bytes_out=res.nbytes,
+                  cache_hit=_batch_kernel.cache_info().misses == misses)
+    return res

@@ -69,10 +69,21 @@ class ScrubEngine:
             self.objects_scanned += len(group)
             self.digest_bytes += length * len(group)
             if self._use_device(len(group), length):
+                from ..core.device_profiler import DeviceProfiler
                 batch = np.frombuffer(
                     b"".join(b for _, b in group), dtype=np.uint8
                 ).reshape(len(group), length)
-                crcs = crc32c_batch(batch)
+                ln = DeviceProfiler.active().start(
+                    "crc_digest", bytes_in=batch.nbytes,
+                    rows=len(group))
+                try:
+                    crcs = crc32c_batch(batch)
+                except Exception:
+                    if ln is not None:
+                        ln.abort()
+                    raise
+                if ln is not None:
+                    ln.finish(bytes_out=crcs.nbytes)
                 self.device_digest_bytes += length * len(group)
                 for (key, _), c in zip(group, crcs):
                     out[key] = int(c)
@@ -104,12 +115,23 @@ class ScrubEngine:
                           for i in range(k)])
                 for _, shards in group])                 # [B, k, chunk]
             self.parity_bytes += data.size
+            from ..core.device_profiler import DeviceProfiler
+            ln = DeviceProfiler.active().start(
+                "parity_recheck", bytes_in=data.nbytes,
+                rows=len(group))
             try:
                 parity = np.asarray(ec._encode_chunks(data))  # [B, m, chunk]
             except Exception:
                 # engine without batch support: stripe at a time
-                parity = np.stack([np.asarray(ec._encode_chunks(d))
-                                   for d in data])
+                try:
+                    parity = np.stack([np.asarray(ec._encode_chunks(d))
+                                       for d in data])
+                except Exception:
+                    if ln is not None:
+                        ln.abort()
+                    raise
+            if ln is not None:
+                ln.finish(bytes_out=parity.nbytes)
             for (oid, shards), par in zip(group, parity):
                 stored = np.stack([
                     np.frombuffer(memoryview(shards[k + j]), np.uint8)

@@ -7,6 +7,8 @@
     ceph -m ... health mute CODE [TTL_SECONDS] [--sticky]
     ceph -m ... health unmute CODE
     ceph -m ... progress [json]   (mgr progress events)
+    ceph -m ... iostat [json]     (live rates from the telemetry spine)
+    ceph -m ... osd perf [json]   (commit latency + device launches)
     ceph -m ... pg stat | pg dump
     ceph -m ... osd tree | osd dump | osd stat | osd pool ls
     ceph -m ... osd pool create NAME [--pg-num N] [--size N] [--type T]
@@ -16,7 +18,8 @@
     ceph -m ... osd pg-upmap-items PGID FROM TO [FROM TO ...]
     ceph -m ... log last [N] [cluster|audit] | log MESSAGE...
     ceph -m ... daemon SOCK_PATH COMMAND [k=v ...]
-        (e.g. daemon <asok> dump_tracing | trace start|stop|clear |
+        (e.g. daemon <asok> dump_tracing [format=otlp] |
+         trace start|stop|clear | profiler dump|reset |
          dump_historic_ops_by_duration | perf histogram dump)
         (e.g. daemon <asok> injectargs args="op_complaint_time=5",
          daemon <asok> fault show | fault set dst=osd.1 drop=0.3 |
@@ -69,12 +72,22 @@ def _dispatch(args, rest) -> int:
     if rest[0] == "daemon":
         # `ceph daemon <asok> <cmd> [k=v ...]` — local admin socket
         sock, words, kvs = rest[1], [], {}
-        for tok in rest[2:]:
-            if "=" in tok:
+        toks = rest[2:]
+        i = 0
+        while i < len(toks):
+            tok = toks[i]
+            if tok.startswith("--") and "=" in tok:
+                k, v = tok[2:].split("=", 1)
+                kvs[k] = v
+            elif tok.startswith("--") and i + 1 < len(toks):
+                kvs[tok[2:]] = toks[i + 1]
+                i += 1
+            elif "=" in tok:
                 k, v = tok.split("=", 1)
                 kvs[k] = v
             else:
                 words.append(tok)
+            i += 1
         out = admin_command(sock, " ".join(words), **kvs)
         print(json.dumps(out, indent=2, default=str))
         return 0
@@ -210,6 +223,28 @@ def _dispatch(args, rest) -> int:
         elif rest[0] == "progress":
             # mgr-hosted progress events
             return _run_mgr_command(mc, {"prefix": "progress"})
+        elif rest[0] == "iostat":
+            # mgr telemetry spine: live rates from osd_stats deltas
+            rc, outs, outb = mc.mgr_command({"prefix": "iostat"})
+            if rc == 0 and outb is not None and "json" not in rest[1:]:
+                print(_render_iostat(outb))
+                return 0
+            if outb is not None:
+                print(json.dumps(outb, indent=2, default=str))
+            if outs:
+                print(outs, file=sys.stderr)
+            return 0 if rc == 0 else 1
+        elif rest[0] == "osd" and rest[1:2] == ["perf"]:
+            # commit latency + device-launch breakdown per OSD
+            rc, outs, outb = mc.mgr_command({"prefix": "osd perf"})
+            if rc == 0 and outb is not None and "json" not in rest[2:]:
+                print(_render_osd_perf(outb))
+                return 0
+            if outb is not None:
+                print(json.dumps(outb, indent=2, default=str))
+            if outs:
+                print(outs, file=sys.stderr)
+            return 0 if rc == 0 else 1
         elif rest[0] == "log" and rest[1:2] == ["last"]:
             # `ceph log last [n] [cluster|audit]` — ring tails
             cmd = {"prefix": "log last"}
@@ -324,6 +359,47 @@ def _watch(mc: MonClient, count: int = 0, timeout: float = 0.0,
                 return 0
     except KeyboardInterrupt:
         return 0
+
+
+def _render_iostat(out: dict) -> str:
+    """`ceph iostat` panel: one cluster line + one row per OSD."""
+    c = out.get("cluster") or {}
+    lines = [
+        f"cluster: {c.get('ops_per_sec', 0.0):.1f} op/s "
+        f"(rd {c.get('read_ops_per_sec', 0.0):.1f}, "
+        f"wr {c.get('write_ops_per_sec', 0.0):.1f}), "
+        f"{c.get('bytes_per_sec', 0.0):.0f} B/s, "
+        f"{c.get('launches_per_sec', 0.0):.1f} launches/s",
+        f"{'OSD':<8}{'OP/S':>10}{'RD/S':>10}{'WR/S':>10}"
+        f"{'B/S':>12}{'LAUNCH/S':>10}",
+    ]
+    for d, r in sorted((out.get("osds") or {}).items()):
+        lines.append(
+            f"{d:<8}{r.get('ops_per_sec', 0.0):>10.1f}"
+            f"{r.get('read_ops_per_sec', 0.0):>10.1f}"
+            f"{r.get('write_ops_per_sec', 0.0):>10.1f}"
+            f"{r.get('bytes_per_sec', 0.0):>12.0f}"
+            f"{r.get('launches_per_sec', 0.0):>10.1f}")
+    return "\n".join(lines)
+
+
+def _render_osd_perf(out: dict) -> str:
+    """`ceph osd perf` panel: commit latency plus the device-launch
+    breakdown the telemetry spine derives from profiler aggregates."""
+    lines = [f"{'OSD':<8}{'COMMIT(MS)':>12}{'LAUNCHES':>10}"
+             f"{'DISP(MS)':>10}{'COMP(MS)':>10}{'DISP%':>8}"
+             f"{'OCC%':>8}{'P99(US)':>10}"]
+    for d, r in sorted((out.get("osd_perf") or {}).items()):
+        dev = r.get("device") or {}
+        lines.append(
+            f"{d:<8}{r.get('commit_latency_ms', 0.0):>12.2f}"
+            f"{dev.get('launches', 0):>10}"
+            f"{dev.get('dispatch_ms_avg', 0.0):>10.2f}"
+            f"{dev.get('compute_ms_avg', 0.0):>10.2f}"
+            f"{100 * dev.get('dispatch_overhead_ratio', 0.0):>8.1f}"
+            f"{100 * dev.get('occupancy_ratio', 1.0):>8.1f}"
+            f"{dev.get('p99_us', 0.0):>10.0f}")
+    return "\n".join(lines)
 
 
 def _render(prefix: str, out) -> str | None:

@@ -590,11 +590,16 @@ class MiniCluster:
         raise TimeoutError(f"scrub of {pgid} never finished")
 
     # -- tracing -----------------------------------------------------------
-    def collect_trace(self, trace_id: str) -> list[dict]:
+    def collect_trace(self, trace_id: str,
+                      format: str = "spans"):
         """Merge one trace's spans from every daemon and client ring,
         ordered by start time (all daemons share this process, so the
-        monotonic starts are directly comparable).  Feed the result to
-        ``core.tracer.chrome_trace`` for a chrome://tracing export."""
+        monotonic starts are directly comparable).
+
+        ``format="spans"`` (default) returns the raw span dicts —
+        feed them to ``core.tracer.chrome_trace`` for chrome://tracing;
+        ``format="otlp"`` returns the OTLP/JSON resource/scope/span
+        shape; ``format="chrome"`` the Chrome trace_event JSON."""
         spans: list[dict] = []
         for osd in self.osds.values():
             spans.extend(osd.tracer.spans_for(trace_id))
@@ -602,6 +607,12 @@ class MiniCluster:
             if r.objecter is not None:
                 spans.extend(r.objecter.tracer.spans_for(trace_id))
         spans.sort(key=lambda s: s["start"])
+        if format == "otlp":
+            from .core.tracer import otlp_trace
+            return otlp_trace(spans)
+        if format == "chrome":
+            from .core.tracer import chrome_trace
+            return chrome_trace(spans)
         return spans
 
     def export_chrome_trace(self, trace_id: str) -> dict:

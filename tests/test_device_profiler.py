@@ -1,0 +1,402 @@
+"""Launch profiler: dispatch/compute split, occupancy, idle gap and
+the bounded sample ring across every device entry point, plus the
+daemon/mgr surfaces (`profiler dump`, `ceph iostat`, `ceph osd perf`).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.core.admin_socket import admin_command
+from ceph_tpu.core.device_profiler import DeviceProfiler, default_profiler
+from ceph_tpu.ops import rs
+from ceph_tpu.ops.gf_jax import GFLinear
+from ceph_tpu.scrub.engine import ScrubEngine
+from ceph_tpu.vstart import MiniCluster
+
+
+def wait_for(pred, timeout=30.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _prof(**kw):
+    kw.setdefault("enabled", True)
+    return DeviceProfiler(name="test", **kw)
+
+
+# =====================================================================
+# core recording semantics (no device libraries involved)
+# =====================================================================
+
+class TestRecording:
+    def test_disabled_start_returns_none(self):
+        p = DeviceProfiler(enabled=False)
+        assert p.start("k") is None
+        assert len(p) == 0
+
+    def test_sample_fields_and_aggregate(self):
+        p = _prof()
+        ln = p.start("k", bytes_in=100, rows=8, rows_used=6, tag="v")
+        ln.finish(bytes_out=40)
+        (s,) = p.samples()
+        assert s["kernel"] == "k"
+        assert s["bytes_in"] == 100 and s["bytes_out"] == 40
+        assert s["rows"] == 8 and s["rows_used"] == 6
+        assert s["dispatch_s"] >= 0 and s["total_s"] >= s["dispatch_s"]
+        assert s["tags"]["tag"] == "v"
+        agg = p.aggregate()
+        assert agg["totals"]["launches"] == 1
+        assert agg["occupancy_ratio"] == pytest.approx(6 / 8)
+        assert 0.0 <= agg["dispatch_overhead_ratio"] <= 1.0
+        assert sum(agg["launch_hist_us"]) == 1
+
+    def test_rows_used_defaults_to_rows(self):
+        p = _prof()
+        p.start("k", rows=4).finish()
+        assert p.samples()[0]["rows_used"] == 4
+        assert p.aggregate()["occupancy_ratio"] == 1.0
+
+    def test_ring_bounded_and_reset(self):
+        p = _prof(ring_size=4)
+        for i in range(10):
+            p.start(f"k{i}").finish()
+        assert len(p) == 4
+        agg = p.aggregate()
+        assert agg["totals"]["launches"] == 10     # aggregates keep all
+        p.reset()
+        assert len(p) == 0
+        assert p.aggregate()["totals"]["launches"] == 0
+        assert sum(p.aggregate()["launch_hist_us"]) == 0
+
+    def test_idle_gap_series(self):
+        p = _prof()
+        p.start("a").finish()
+        time.sleep(0.02)
+        p.start("b").finish()
+        s = p.samples()
+        assert s[0]["gap_s"] is None               # nothing before it
+        assert s[1]["gap_s"] >= 0.015
+        assert p.aggregate()["idle_gap_avg_s"] >= 0.015
+
+    def test_nested_start_suppressed(self):
+        p = _prof()
+        outer = p.start("outer")
+        assert p.start("inner") is None            # outermost wins
+        outer.finish()
+        assert [s["kernel"] for s in p.samples()] == ["outer"]
+        inner = p.start("after")                   # flag released
+        assert inner is not None
+        inner.finish()
+
+    def test_abort_releases_nesting_flag(self):
+        p = _prof()
+        p.start("doomed").abort()
+        assert len(p) == 0
+        ln = p.start("next")
+        assert ln is not None
+        ln.finish()
+        assert len(p) == 1
+
+    def test_bind_restores_previous(self):
+        a, b = _prof(), _prof()
+        with a.bind():
+            assert DeviceProfiler.active() is a
+            with b.bind():
+                assert DeviceProfiler.active() is b
+            assert DeviceProfiler.active() is a
+        assert DeviceProfiler.active() is default_profiler()
+
+    def test_cache_hit_counting(self):
+        p = _prof()
+        p.start("k", cache_hit=True).finish()
+        p.start("k").finish(cache_hit=True)        # late tag via finish
+        p.start("k").finish()
+        assert p.aggregate()["kernels"]["k"]["cache_hits"] == 2
+
+
+# =====================================================================
+# the five device entry points
+# =====================================================================
+
+class TestEntryPoints:
+    def test_gf_encode_sample(self):
+        k, m = 4, 2
+        gl = GFLinear(rs.reed_sol_van_matrix(k, m), backend="xla")
+        data = np.arange(k * 64, dtype=np.uint8).reshape(k, 64)
+        p = _prof()
+        with p.bind():
+            out = np.asarray(gl(data))
+        (s,) = [x for x in p.samples() if x["kernel"] == "gf_encode"]
+        assert s["bytes_in"] == data.nbytes
+        assert s["bytes_out"] == out.nbytes
+        assert s["rows"] == k
+        assert s["dispatch_s"] > 0
+        assert s["tags"]["backend"] == "xla"
+
+    def test_crc32c_batch_sample_and_cache_hit(self):
+        from ceph_tpu.scrub.crc32c_jax import crc32c_batch
+        batch = np.arange(4 * 32, dtype=np.uint8).reshape(4, 32)
+        p = _prof()
+        with p.bind():
+            crc32c_batch(batch)
+            crc32c_batch(batch)                    # same length: hit
+        ss = [s for s in p.samples() if s["kernel"] == "crc32c"]
+        assert len(ss) == 2
+        assert ss[0]["bytes_in"] == batch.nbytes
+        assert ss[1]["tags"]["cache_hit"] is True
+
+    def test_crc_digest_suppresses_inner_crc32c(self):
+        eng = ScrubEngine(device_min_rows=1, device_min_bytes=1)
+        payloads = {f"o{i}": b"\x5a" * 64 for i in range(6)}
+        p = _prof()
+        with p.bind():
+            digests = eng.compute_digests(payloads)
+        kernels = [s["kernel"] for s in p.samples()]
+        assert kernels == ["crc_digest"]           # no double counting
+        (s,) = p.samples()
+        assert s["rows"] == 6 and s["bytes_in"] == 6 * 64
+        from ceph_tpu.scrub.crc32c_jax import crc32c
+        assert digests["o0"] == crc32c(b"\x5a" * 64)
+
+    def test_parity_recheck_suppresses_inner_gf_encode(self):
+        from ceph_tpu.ec import create_erasure_code
+        ec = create_erasure_code({"plugin": "jerasure", "k": 3, "m": 2})
+        rng = np.random.default_rng(5)
+        stripes = {}
+        for oid in ("good", "bad"):
+            data = rng.integers(0, 256, (3, 32), dtype=np.uint8)
+            enc = ec.encode(set(range(5)), data.reshape(-1))
+            shards = {i: bytes(enc[i]) for i in range(5)}
+            if oid == "bad":
+                shards[4] = bytes(32)              # rot a parity shard
+            stripes[oid] = shards
+        eng = ScrubEngine()
+        p = _prof()
+        with p.bind():
+            verdicts = eng.recheck_parity(ec, stripes)
+        assert verdicts == {"good": False, "bad": True}
+        kernels = [s["kernel"] for s in p.samples()]
+        assert kernels == ["parity_recheck"]
+        assert p.samples()[0]["rows"] == 2
+
+    def test_crush_map_occupancy_counts_chunk_padding(self):
+        from ceph_tpu.crush import BatchMapper, build_flat_map
+        m = build_flat_map(6)
+        bm = BatchMapper(m, 0, result_max=3, chunk=8)
+        xs = np.arange(5, dtype=np.uint32)         # 5 of an 8-row chunk
+        p = _prof()
+        with p.bind():
+            bm(xs)
+        (s,) = [x for x in p.samples() if x["kernel"] == "crush_map"]
+        assert s["rows"] == 8 and s["rows_used"] == 5
+        assert p.aggregate()["occupancy_ratio"] == pytest.approx(5 / 8)
+
+    def test_sharded_encode_and_reconstruct_samples(self):
+        from ceph_tpu.parallel import ShardedEC, make_mesh
+        from jax.sharding import PartitionSpec as P
+        mesh = make_mesh(8, shard=4)
+        k, m, B, C = 6, 2, 2, 64
+        coding = rs.reed_sol_van_matrix(k, m)
+        sec = ShardedEC(coding, k, m, mesh)
+        rng = np.random.default_rng(9)
+        data = rng.integers(0, 256, (B, k, C), dtype=np.uint8)
+        parity = np.stack([rs.encode_oracle(coding, data[b])
+                           for b in range(B)])
+        p = _prof()
+        with p.bind():
+            arr = sec.shard_array(sec.pad_data(data), P("dp", "shard", None))
+            np.asarray(sec.encode(arr))
+            chunks = np.zeros((B, sec.n_pad, C), dtype=np.uint8)
+            chunks[:, :k] = data
+            chunks[:, k:k + m] = parity
+            chunks[:, 1] = 0xDE
+            carr = sec.shard_array(chunks, P("dp", "shard", None))
+            np.asarray(sec.reconstruct(carr, (1,)))
+        by_kernel = {s["kernel"]: s for s in p.samples()}
+        enc = by_kernel["sharded_encode"]
+        assert enc["rows"] == B * sec.k_pad
+        assert enc["rows_used"] == B * k
+        rec = by_kernel["sharded_reconstruct"]
+        assert rec["rows"] == B * sec.n_pad
+        assert rec["rows_used"] == B * (k + m)
+        # second reconstruct with the same erasures hits the plan cache
+        with p.bind():
+            np.asarray(sec.reconstruct(carr, (1,)))
+        last = p.samples()[-1]
+        assert last["tags"]["cache_hit"] is True
+
+    def test_profiling_leaves_encode_bit_identical(self):
+        k, m = 4, 2
+        gl = GFLinear(rs.reed_sol_van_matrix(k, m), backend="xla")
+        rng = np.random.default_rng(11)
+        data = rng.integers(0, 256, (k, 256), dtype=np.uint8)
+        p = _prof(enabled=False)
+        with p.bind():
+            baseline = np.asarray(gl(data))
+        assert len(p) == 0
+        p.set_enabled(True)
+        with p.bind():
+            profiled = np.asarray(gl(data))
+        assert len(p) == 1
+        assert np.array_equal(profiled, baseline)
+        assert profiled.tobytes() == baseline.tobytes()
+
+
+# =====================================================================
+# CLI renderers (synthetic payloads)
+# =====================================================================
+
+class TestRenderers:
+    def test_render_iostat(self):
+        from ceph_tpu.tools.ceph import _render_iostat
+        out = {"cluster": {"ops_per_sec": 3.0, "write_ops_per_sec": 2.0,
+                           "read_ops_per_sec": 1.0,
+                           "bytes_per_sec": 4096.0,
+                           "launches_per_sec": 0.5,
+                           "device_bytes_per_sec": 0.0},
+               "osds": {"osd.0": {"ops_per_sec": 3.0,
+                                  "write_ops_per_sec": 2.0,
+                                  "read_ops_per_sec": 1.0,
+                                  "bytes_per_sec": 4096.0,
+                                  "launches_per_sec": 0.5,
+                                  "device_bytes_per_sec": 0.0}}}
+        text = _render_iostat(out)
+        assert "osd.0" in text and "4096 B/s" in text
+        assert "LAUNCH/S" in text
+
+    def test_render_osd_perf(self):
+        from ceph_tpu.tools.ceph import _render_osd_perf
+        out = {"osd_perf": {"osd.1": {
+            "commit_latency_ms": 1.25, "apply_latency_ms": 1.25,
+            "device": {"launches": 7, "dispatch_ms_avg": 0.2,
+                       "compute_ms_avg": 0.1,
+                       "dispatch_overhead_ratio": 0.66,
+                       "occupancy_ratio": 0.9,
+                       "idle_gap_avg_s": 0.0,
+                       "p50_us": 100.0, "p99_us": 900.0}}}}
+        text = _render_osd_perf(out)
+        assert "osd.1" in text and "66" in text and "900" in text
+
+
+# =====================================================================
+# live cluster: asok + telemetry spine + mgr command surfaces
+# =====================================================================
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=3,
+                    osd_config={"device_profiling_enable": True})
+    c.start()
+    c.start_mgr("obsv")
+    c.wait_for_active_mgr()
+    r = c.rados()
+    r.create_pool("prf", pg_num=4, size=3)
+    rc, outs, _ = r.mon_command({
+        "prefix": "osd pool create", "pool": "prfe", "pg_num": 4,
+        "size": 3, "pool_type": "erasure"})
+    assert rc == 0, outs
+    c.wait_for_clean()
+    yield c, r
+    c.stop()
+
+
+class TestClusterSurfaces:
+    def test_ec_writes_reach_profiler_dump(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("prfe")
+        for i in range(4):
+            io.write_full(f"ec{i}", b"device payload " * 64)
+        def launched():
+            return any(
+                admin_command(o.admin_socket.path, "profiler dump")
+                ["totals"]["launches"] > 0 for o in c.osds.values())
+        assert wait_for(launched, timeout=20)
+        dumps = [admin_command(o.admin_socket.path, "profiler dump")
+                 for o in c.osds.values()]
+        hot = [d for d in dumps if d["totals"]["launches"] > 0]
+        assert any("gf_encode" in d["kernels"] for d in hot)
+        for d in hot:
+            assert d["totals"]["bytes_in"] > 0
+            assert d["ring"], "aggregates without ring samples"
+            s = d["ring"][0]
+            assert s["dispatch_s"] >= 0 and s["total_s"] >= 0
+            assert 0.0 <= d["dispatch_overhead_ratio"] <= 1.0
+            assert 0.0 < d["occupancy_ratio"] <= 1.0
+        # launch accounting also lands in the perf counters
+        perfs = [admin_command(o.admin_socket.path, "perf dump")
+                 [f"osd.{i}"] for i, o in c.osds.items()]
+        assert any(p["device_launches"] > 0 for p in perfs)
+        assert any(p["device_dispatch"]["avgcount"] > 0 for p in perfs)
+
+    def test_profiler_reset_clears_ring(self, cluster):
+        c, r = cluster
+        osd = c.osds[0]
+        out = admin_command(osd.admin_socket.path, "profiler reset")
+        assert out == {"success": "profiler reset"}
+        dump = admin_command(osd.admin_socket.path, "profiler dump")
+        assert dump["totals"]["launches"] == 0 and dump["ring"] == []
+        assert dump["enabled"] is True             # reset ≠ disable
+
+    def test_mgr_iostat_and_osd_perf(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("prf")
+
+        def spine_sees_osds():
+            for i in range(6):
+                io.write_full(f"io{i}", b"rate fodder " * 32)
+            rc, _, out = r.mgr_command({"prefix": "iostat"})
+            return rc == 0 and len(out.get("osds") or {}) >= 3
+        assert wait_for(spine_sees_osds, timeout=40, interval=0.5)
+
+        rc, _, out = r.mgr_command({"prefix": "iostat"})
+        assert rc == 0
+        for d, rates in out["osds"].items():
+            assert d.startswith("osd.")
+            for k in ("ops_per_sec", "bytes_per_sec",
+                      "launches_per_sec"):
+                assert rates[k] >= 0.0
+        assert out["cluster"]["ops_per_sec"] == pytest.approx(
+            sum(v["ops_per_sec"] for v in out["osds"].values()))
+
+        rc, _, perf = r.mgr_command({"prefix": "osd perf"})
+        assert rc == 0
+        assert len(perf["osd_perf"]) >= 3
+        ecio = r.open_ioctx("prfe")
+        for i in range(4):
+            ecio.write_full(f"dev{i}", b"launches " * 128)
+
+        def device_seen():
+            rc, _, p = r.mgr_command({"prefix": "osd perf"})
+            return rc == 0 and any(
+                d["device"]["launches"] > 0
+                for d in p["osd_perf"].values())
+        assert wait_for(device_seen, timeout=30, interval=0.5)
+        rc, _, p = r.mgr_command({"prefix": "osd perf"})
+        hot = [d for d in p["osd_perf"].values()
+               if d["device"]["launches"] > 0]
+        for d in hot:
+            assert d["commit_latency_ms"] >= 0.0
+            assert d["device"]["p99_us"] >= d["device"]["p50_us"] >= 0
+
+    def test_telemetry_series_ring_history(self, cluster):
+        c, r = cluster
+        def has_history():
+            rc, _, out = r.mgr_command({"prefix": "telemetry series",
+                                        "daemon": "osd.0"})
+            return (rc == 0
+                    and len((out.get("osd.0") or {}).get("op") or [])
+                    >= 2)
+        assert wait_for(has_history, timeout=30, interval=0.5)
+        rc, _, out = r.mgr_command({"prefix": "telemetry series",
+                                    "daemon": "osd.0"})
+        samples = out["osd.0"]["op"]
+        ts = [t for t, _v in samples]
+        vs = [v for _t, v in samples]
+        assert ts == sorted(ts)
+        assert vs == sorted(vs)                    # cumulative counter

@@ -1,12 +1,116 @@
 """Prometheus exporter mgr module (reference pybind/mgr/prometheus)."""
 
 import http.client
+import re
 import time
 
 import pytest
 
 from ceph_tpu.mgr import Exporter, ExporterService
+from ceph_tpu.mgr.exporter import _esc_label
 from ceph_tpu.vstart import MiniCluster
+
+
+class _FakeMonc:
+    """Just enough MonClient for Exporter.collect(): canned replies."""
+
+    def __init__(self, health_checks=()):
+        self._checks = list(health_checks)
+
+    def command(self, cmd):
+        p = cmd.get("prefix")
+        if p == "status":
+            return 0, "", {"health": "HEALTH_OK", "num_up_osds": 2,
+                           "num_osds": 2, "quorum": [0], "num_pgs": 4,
+                           "num_objects": 3,
+                           "pg_states": {"active+clean": 4}}
+        if p == "health":
+            return 0, "", {"health": "HEALTH_OK",
+                           "checks": self._checks, "muted": []}
+        if p == "pg dump":
+            return 0, "", {"pg_stats": {}, "osd_stats": {}}
+        return -22, "unknown", None
+
+
+def _telemetry_view(daemon="osd.0", hist=(3, 2, 0, 1)):
+    return {
+        "profiler": {daemon: {
+            "launch_hist_us": list(hist),
+            "dispatch_overhead_ratio": 0.25,
+            "occupancy_ratio": 0.75,
+            "totals": {"launches": sum(hist)},
+        }},
+        "rates": {daemon: {"bytes_per_sec": 1234.5}},
+    }
+
+
+class TestExposition:
+    """Format correctness on a deterministic collect() (no cluster)."""
+
+    def test_type_and_help_exactly_once_per_family(self):
+        view = _telemetry_view()
+        view["profiler"]["osd.1"] = dict(view["profiler"]["osd.0"])
+        view["rates"]["osd.1"] = {"bytes_per_sec": 99.0}
+        text = Exporter(_FakeMonc(),
+                        telemetry=lambda: view).collect()
+        families = re.findall(r"^# TYPE (\S+)", text, re.M)
+        assert len(families) == len(set(families)), families
+        helps = re.findall(r"^# HELP (\S+)", text, re.M)
+        assert len(helps) == len(set(helps)), helps
+        # both daemons emit into the shared families
+        assert text.count(
+            "# TYPE ceph_device_launch_seconds histogram") == 1
+        for d in ("osd.0", "osd.1"):
+            assert (f'ceph_device_dispatch_overhead_ratio'
+                    f'{{ceph_daemon="{d}"}} 0.25') in text
+            assert (f'ceph_device_occupancy_ratio'
+                    f'{{ceph_daemon="{d}"}} 0.75') in text
+        assert ('ceph_osd_bytes_rate{ceph_daemon="osd.0"} 1234.5'
+                in text)
+
+    def test_device_histogram_monotone_and_consistent(self):
+        text = Exporter(_FakeMonc(),
+                        telemetry=_telemetry_view).collect()
+        buckets = [
+            (m.group(1), float(m.group(2)))
+            for m in re.finditer(
+                r'ceph_device_launch_seconds_bucket\{'
+                r'ceph_daemon="osd\.0",le="([^"]+)"\} (\S+)', text)]
+        assert buckets, text
+        les = [le for le, _ in buckets]
+        assert les[-1] == "+Inf"
+        finite = [float(le) for le in les[:-1]]
+        assert finite == sorted(finite)            # le ascending
+        counts = [v for _le, v in buckets]
+        assert counts == sorted(counts)            # cumulative
+        count = float(re.search(
+            r'ceph_device_launch_seconds_count\{'
+            r'ceph_daemon="osd\.0"\} (\S+)', text).group(1))
+        ssum = float(re.search(
+            r'ceph_device_launch_seconds_sum\{'
+            r'ceph_daemon="osd\.0"\} (\S+)', text).group(1))
+        assert counts[-1] == count == 6            # +Inf == _count
+        assert 0.0 <= ssum <= count * float(les[-2]) \
+            + counts[-1] * 1.0                     # sane approx _sum
+
+    def test_label_escaping(self):
+        assert _esc_label('plain') == 'plain'
+        assert _esc_label('sl\\ash') == 'sl\\\\ash'
+        assert _esc_label('qu"ote') == 'qu\\"ote'
+        assert _esc_label('new\nline') == 'new\\nline'
+        nasty = 'OSD_D"OWN\\\n'
+        text = Exporter(_FakeMonc(health_checks=[
+            {"code": nasty, "severity": "WARN"}])).collect()
+        line = next(l for l in text.splitlines()
+                    if l.startswith("ceph_health_check"))
+        assert line == \
+            'ceph_health_check{code="OSD_D\\"OWN\\\\\\n"} 1'
+        # escaped payload round-trips through the exposition parser
+        m = re.match(r'ceph_health_check\{code="((?:[^"\\]|\\.)*)"\} 1',
+                     line)
+        unescaped = (m.group(1).replace("\\n", "\n")
+                     .replace('\\"', '"').replace("\\\\", "\\"))
+        assert unescaped == nasty
 
 
 class TestExporter:

@@ -416,6 +416,125 @@ class TestProgress:
             assert out["events"] == []      # nothing left open
             r.shutdown()
 
+    def test_pg_scrub_chunk_position_events(self):
+        """A scrubbing PG's chunk position (scrub maps gathered vs.
+        acting set) opens/advances/closes one `pg_scrub/<pgid>` event,
+        without disturbing the cluster-wide scrub-sweep event."""
+        from ceph_tpu.mgr.progress import ProgressModule
+
+        class Ctx:
+            def __init__(self):
+                self.pg_stats = {}
+                self.published = []
+
+            def get_osdmap(self):
+                m = OSDMap(max_osd=3)
+                m.epoch = 3
+                for o in range(3):
+                    m.osd_state[o] = EXISTS | UP
+                return m
+
+            def mon_command(self, cmd):
+                p = cmd.get("prefix")
+                if p == "pg dump":
+                    return 0, "", {"pg_stats": self.pg_stats}
+                if p == "progress publish":
+                    self.published.extend(cmd["events"])
+                    return 0, "", None
+                if p == "config-key get":
+                    return -2, "", None
+                return 0, "", None
+
+        ctx = Ctx()
+        mod = ProgressModule(ctx)
+
+        def scrub_pg(done, total):
+            return {"state": "active+clean+scrubbing+deep",
+                    "scrub_chunks_done": done,
+                    "scrub_chunks_total": total}
+
+        ctx.pg_stats = {"1.a": scrub_pg(0, 4),
+                        "1.b": {"state": "active+clean"}}
+        mod.serve_tick()
+        assert "pg_scrub/1.a" in mod.events
+        assert mod.events["pg_scrub/1.a"]["message"] == \
+            "Scrubbing pg 1.a"
+        assert mod.events["pg_scrub/1.a"]["progress"] == 0.0
+        assert "pg_scrub/1.b" not in mod.events
+
+        ctx.pg_stats["1.a"] = scrub_pg(3, 4)
+        mod.serve_tick()
+        assert mod.events["pg_scrub/1.a"]["progress"] == \
+            pytest.approx(0.75)
+
+        # a lagging beacon must not walk the fraction backwards
+        ctx.pg_stats["1.a"] = scrub_pg(2, 4)
+        mod.serve_tick()
+        assert mod.events["pg_scrub/1.a"]["progress"] == \
+            pytest.approx(0.75)
+
+        # scrub finished: the per-PG event closes at 100%
+        ctx.pg_stats["1.a"] = {"state": "active+clean"}
+        mod.serve_tick()
+        assert "pg_scrub/1.a" not in mod.events
+        done = {e["id"]: e for e in mod.completed}
+        assert done["pg_scrub/1.a"]["progress"] == 1.0
+        states = [(e["id"], e["state"]) for e in ctx.published
+                  if e["id"] == "pg_scrub/1.a"]
+        assert states[0] == ("pg_scrub/1.a", "open")
+        assert ("pg_scrub/1.a", "update") in states
+        assert states[-1] == ("pg_scrub/1.a", "complete")
+        # the per-PG events never spawned a generic recovery event
+        assert "recovery" not in done and "recovery" not in mod.events
+
+    def test_pg_scrub_progress_live(self):
+        """Deep scrub on a live cluster: the primary beacons its chunk
+        position and the mgr narrates per-PG sweeps.  Replica scrub
+        maps normally return in microseconds, so inter-OSD traffic is
+        delayed to hold the PG mid-sweep long enough for the beacon +
+        mgr tick to observe the chunk position."""
+        with MiniCluster(n_mons=1, n_osds=3) as c:
+            c.start_mgr("sm")
+            c.wait_for_active_mgr()
+            r = c.rados()
+            r.create_pool("sc", pg_num=2, size=2)
+            io = r.open_ioctx("sc")
+            for i in range(16):
+                io.write_full(f"o{i}", b"s" * 1024)
+            c.wait_for_clean()
+            for i, osd in c.osds.items():
+                for j in c.osds:
+                    if j != i:
+                        osd.msgr.faults.set_rule(
+                            "*", f"osd.{j}", delay=1.0, delay_ms=4000)
+            seen = []
+
+            def saw_pg_scrub():
+                rc, _, out = r.mgr_command({"prefix": "progress"})
+                assert rc == 0
+                seen.extend(
+                    e["id"] for e in out["events"] + out["completed"]
+                    if e["id"].startswith("pg_scrub/"))
+                return bool(seen)
+            try:
+                rc, _, dump = r.mon_command({"prefix": "pg dump"})
+                assert rc == 0
+                for pgid in dump["pg_stats"]:
+                    assert r.mon_command({"prefix": "pg deep-scrub",
+                                          "pgid": pgid})[0] == 0
+                assert wait_for(saw_pg_scrub, timeout=60), \
+                    "no per-PG scrub progress event appeared"
+            finally:
+                for osd in c.osds.values():
+                    osd.msgr.faults.heal()
+            # events eventually close once the sweep completes
+            assert wait_for(
+                lambda: not any(
+                    e["id"].startswith("pg_scrub/")
+                    for e in r.mgr_command({"prefix": "progress"})
+                    [2]["events"]), timeout=60)
+            r.shutdown()
+
     def test_progress_state_survives_mgr_failover(self):
         """The module checkpoints events + baselines to the mon
         config-key store on every change; a promoted standby (whose

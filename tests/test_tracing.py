@@ -11,7 +11,7 @@ import pytest
 from ceph_tpu.core.admin_socket import admin_command
 from ceph_tpu.core.config import ConfigProxy
 from ceph_tpu.core.options import build_options
-from ceph_tpu.core.tracer import Tracer, chrome_trace
+from ceph_tpu.core.tracer import Tracer, chrome_trace, otlp_trace
 from ceph_tpu.core.tracked_op import OpTracker
 from ceph_tpu.vstart import MiniCluster
 
@@ -274,3 +274,143 @@ class TestTracerUnit:
         out = tr.dump_historic_ops()
         assert out["num_ops"] == 1
         assert "fresh" in out["ops"][0]["description"]
+
+
+class TestTailSampling:
+    def test_slow_trace_retained_fast_evicted_same_budget(self):
+        t = Tracer(daemon="x", ring_size=4, enabled=True,
+                   tail_slow_s=0.01)
+        # a fast trace admitted first under the same ring budget
+        fast_root = t.start_span("fast_root")
+        fast_root.finish()
+        fast_tid = fast_root.trace_id
+        # a slow trace: child finishes, then the root closes slow
+        slow_root = t.start_span("slow_root")
+        t.start_span("slow_child", parent=slow_root).finish()
+        time.sleep(0.02)
+        slow_root.finish()              # > tail_slow_s → trace pinned
+        slow_tid = slow_root.trace_id
+        # flood: many more fast traces than the ring holds
+        for i in range(20):
+            t.start_span(f"noise{i}").finish()
+        # the slow trace survived in full ...
+        assert len(t.spans_for(slow_tid)) == 2
+        # ... while the fast one was evicted with the rest of the ring
+        assert t.spans_for(fast_tid) == []
+        others = [s for s in t.dump() if s["trace_id"] != slow_tid]
+        assert len(others) == 4         # ring stays bounded
+
+    def test_fast_trace_not_pinned(self):
+        t = Tracer(daemon="x", ring_size=4, enabled=True,
+                   tail_slow_s=0.5)
+        r = t.start_span("quick")
+        r.finish()
+        assert t._pinned == {}
+
+    def test_error_tag_pins_without_slow_threshold(self):
+        t = Tracer(daemon="x", ring_size=4, enabled=True)
+        r = t.start_span("boom", tags={"error": "EIO"})
+        r.finish()
+        for i in range(20):
+            t.start_span(f"noise{i}").finish()
+        assert len(t.spans_for(r.trace_id)) == 1
+
+    def test_late_children_join_pinned_trace(self):
+        t = Tracer(daemon="x", ring_size=4, enabled=True,
+                   tail_slow_s=0.01)
+        root = t.start_span("root")
+        straggler = t.start_span("replica_ack", parent=root)
+        time.sleep(0.02)
+        root.finish()                   # pinned before the child closed
+        for i in range(10):
+            t.start_span(f"noise{i}").finish()
+        straggler.finish()              # lands in the pinned store
+        assert len(t.spans_for(root.trace_id)) == 2
+
+    def test_pinned_store_bounded(self):
+        t = Tracer(daemon="x", ring_size=64, enabled=True)
+        first = t.start_span("err0", tags={"error": True})
+        first.finish()
+        for i in range(1, t.MAX_PINNED_TRACES + 1):
+            t.start_span(f"err{i}", tags={"error": True}).finish()
+        assert len(t._pinned) == t.MAX_PINNED_TRACES
+        assert t.spans_for(first.trace_id) == []   # oldest evicted
+
+    def test_clear_drops_pinned(self):
+        t = Tracer(daemon="x", enabled=True)
+        t.start_span("e", tags={"error": True}).finish()
+        assert len(t) == 1
+        t.clear()
+        assert len(t) == 0
+
+
+class TestOTLPExport:
+    def _sample_spans(self):
+        t = Tracer(daemon="osd.7", enabled=True)
+        root = t.start_span("op", tags={"layer": "osd", "retries": 2,
+                                        "ratio": 0.5, "ok": True})
+        child = t.start_span("kernel", parent=root,
+                             tags={"layer": "device"})
+        child.event("enqueued")
+        child.finish()
+        root.finish()
+        return t.dump(), root, child
+
+    def test_otlp_shape(self):
+        spans, root, child = self._sample_spans()
+        out = otlp_trace(spans)
+        assert json.loads(json.dumps(out)) == out
+        (rs,) = out["resourceSpans"]
+        attrs = {a["key"]: a["value"]
+                 for a in rs["resource"]["attributes"]}
+        assert attrs["service.name"] == {"stringValue": "osd.7"}
+        (scope,) = rs["scopeSpans"]
+        assert scope["scope"]["name"] == "ceph_tpu.tracer"
+        recs = {r["name"]: r for r in scope["spans"]}
+        assert set(recs) == {"op", "kernel"}
+        for r in recs.values():
+            assert len(r["traceId"]) == 32
+            assert len(r["spanId"]) == 16
+            assert int(r["endTimeUnixNano"]) >= \
+                int(r["startTimeUnixNano"])
+            assert r["kind"] == 1
+        assert recs["kernel"]["parentSpanId"] == \
+            recs["op"]["spanId"]
+        assert "parentSpanId" not in recs["op"]
+        # typed attribute values (ints are decimal strings per OTLP)
+        op_attrs = {a["key"]: a["value"]
+                    for a in recs["op"]["attributes"]}
+        assert op_attrs["retries"] == {"intValue": "2"}
+        assert op_attrs["ratio"] == {"doubleValue": 0.5}
+        assert op_attrs["ok"] == {"boolValue": True}
+        (ev,) = recs["kernel"]["events"]
+        assert ev["name"] == "enqueued"
+        assert int(ev["timeUnixNano"]) >= \
+            int(recs["kernel"]["startTimeUnixNano"])
+
+    def test_cluster_collect_trace_otlp(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("tr")
+        io.write_full("otlp-obj", b"o" * 256)
+        tid = _last_trace_id(r, "otlp-obj")
+        spans = _settle_trace(c, tid, minimum=6)
+        out = c.collect_trace(tid, format="otlp")
+        per_daemon = {s["daemon"] for s in spans}
+        assert len(out["resourceSpans"]) == len(per_daemon)
+        n = sum(len(sc["spans"]) for rsp in out["resourceSpans"]
+                for sc in rsp["scopeSpans"])
+        assert n == len(spans)
+
+    def test_asok_dump_tracing_otlp(self, cluster):
+        c, r = cluster
+        io = r.open_ioctx("tr")
+        io.write_full("asok-otlp", b"a" * 128)
+        osd = c.osds[0]
+        out = admin_command(osd.admin_socket.path, "dump_tracing",
+                            format="otlp")
+        assert set(out) == {"resourceSpans"}
+        names = {a["value"]["stringValue"]
+                 for rsp in out["resourceSpans"]
+                 for a in rsp["resource"]["attributes"]
+                 if a["key"] == "service.name"}
+        assert names == {"osd.0"}
