@@ -35,6 +35,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -896,6 +897,124 @@ def _health_eval_ms():
             "per_eval_ms": round(per_eval_ms, 2)}
 
 
+def _dataplane_leg(on_tpu: bool):
+    """Coalescing device data plane (ROADMAP item 1 / BENCH_r06's
+    dispatch floor): a RadosModel-ish write mix pushed by concurrent
+    submitter threads through one OSD's BatchEngine, vs the raw fused
+    encode+digest kernel on the same stripes.  The headline numbers:
+
+    - cluster_sustained_GBps — logical bytes acked / wall time with
+      deadline batching on (the number the 64 ms floor used to cap);
+    - launches_per_1k_ops — coalescing ratio (1000 means no
+      coalescing at all; the engine should sit far below);
+    - idle_gap_avg_us — device idle between launches, from the same
+      profiler series BENCH_r06 introduced;
+    - vs_raw_kernel — sustained / raw-kernel throughput (acceptance:
+      within ~20% on device).
+
+    Bit-identity is asserted in-leg: a sample of the mix is replayed
+    through a disabled engine and must match byte-for-byte."""
+    import numpy as np
+    from ceph_tpu.core.device_profiler import DeviceProfiler
+    from ceph_tpu.ec import create_erasure_code
+    from ceph_tpu.ops.gf_jax import GFEncodeDigest
+    from ceph_tpu.osd.batch_engine import BatchEngine
+
+    k, m = 8, 3
+    ec = create_erasure_code({"plugin": "jerasure", "k": k, "m": m,
+                              "technique": "reed_sol_van"})
+    rng = np.random.default_rng(11)
+    stripe = (1 << 20) if on_tpu else (256 << 10)
+    # mostly full-stripe writes, a tail of small writes and digests —
+    # the mix RadosModel throws at an OSD
+    sizes = ([stripe] * 6 + [stripe // 4] * 3 + [stripe // 16] * 2
+             + [4 << 10])
+    payloads = [rng.integers(0, 256, s, np.uint8).tobytes()
+                for s in sizes]
+
+    prof = DeviceProfiler(name="dataplane", enabled=True)
+    eng = BatchEngine("bench", flush_ms=2.0, max_ops=64,
+                      max_bytes=64 << 20, profiler=prof)
+    # warmup compiles one fused program per size bucket
+    for p in payloads:
+        eng.submit_encode(ec, p)
+    eng.submit_digest(payloads[0])
+    eng.drain()
+    prof.reset()
+    for key in list(eng.stats):
+        eng.stats[key] = 0
+
+    threads, per_thread = 8, 16 if on_tpu else 8
+    comps = [None] * (threads * per_thread)
+    logical = 0
+    for i in range(threads * per_thread):
+        logical += len(payloads[i % len(payloads)])
+
+    def submitter(t):
+        for i in range(per_thread):
+            j = t * per_thread + i
+            p = payloads[j % len(payloads)]
+            if j % 5 == 4:          # every 5th op is a scrub digest
+                comps[j] = eng.submit_digest(p)
+            else:
+                comps[j] = eng.submit_encode(ec, p)
+
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=submitter, args=(t,))
+          for t in range(threads)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    eng.drain()
+    wall = time.monotonic() - t0
+    assert all(c is not None and c.done() and c.error is None
+               for c in comps), "dataplane op failed"
+    ops = len(comps)
+    launches = eng.stats["launches"]
+    agg = prof.aggregate()
+
+    # raw fused kernel on the same code + full stripes: the physics
+    # ceiling the engine is trying to reach
+    fused = GFEncodeDigest(ec.engine.coding)
+    chunks = np.ascontiguousarray(
+        ec.encode_prepare(payloads[0]), dtype=np.uint8)
+    raw_batch = np.stack([chunks] * 8)
+    np.asarray(fused(raw_batch)[1])             # compile + warm
+    iters = 12 if on_tpu else 4
+    t0 = time.monotonic()
+    for _ in range(iters):
+        np.asarray(fused(raw_batch)[1])
+    raw_gbps = (raw_batch.shape[0] * stripe * iters
+                / (time.monotonic() - t0)) / 1e9
+    sustained = logical / wall / 1e9
+
+    # bit-identity gate: replay a sample with the engine disabled
+    off = BatchEngine("bench-off", enabled=False)
+    for j in (0, 7, len(comps) - 1):
+        p = payloads[j % len(payloads)]
+        want = (off.submit_digest(p) if j % 5 == 4
+                else off.submit_encode(ec, p)).result()
+        assert comps[j].result() == want, "batched result diverged"
+
+    eng.stop()
+    return {
+        "cluster_sustained_GBps": round(sustained, 3),
+        "raw_kernel_GBps": round(raw_gbps, 3),
+        "vs_raw_kernel": round(sustained / raw_gbps, 3)
+        if raw_gbps else 0.0,
+        "ops": ops,
+        "launches": launches,
+        "launches_per_1k_ops": round(1000.0 * launches / ops, 1),
+        "megabatch_byte_occupancy_pct": round(
+            100.0 * agg["byte_occupancy_ratio"], 1),
+        "idle_gap_avg_us": round(1e6 * agg["idle_gap_avg_s"], 1),
+        "flushes": {r: eng.stats[r] for r in
+                    ("flush_deadline", "flush_max_ops",
+                     "flush_max_bytes") if eng.stats.get(r)},
+    }
+
+
 def _crush_leg():
     """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
     (BASELINE.md row 4, scaled to fit a bench-run budget)."""
@@ -1008,7 +1127,8 @@ def child_main():
             out["stretch"] = {"error": str(e)[:200]}
     else:
         out["stretch"] = {"skipped": "wall budget exhausted"}
-    print(json.dumps(dict(out, observability={"skipped": "timeout"})),
+    print(json.dumps(dict(out, observability={"skipped": "timeout"},
+                          dataplane={"skipped": "timeout"})),
           flush=True)
     # tracing tax on a live cluster: two short timed windows (~10s)
     if _budget_left() > 0.04:
@@ -1018,6 +1138,16 @@ def child_main():
             out["observability"] = {"error": str(e)[:200]}
     else:
         out["observability"] = {"skipped": "wall budget exhausted"}
+    print(json.dumps(dict(out, dataplane={"skipped": "timeout"})),
+          flush=True)
+    # coalescing data plane: concurrent write mix through BatchEngine
+    if _budget_left() > 0.03:
+        try:
+            out["dataplane"] = _dataplane_leg(on_tpu)
+        except Exception as e:    # noqa: BLE001 — keep the headline
+            out["dataplane"] = {"error": str(e)[:200]}
+    else:
+        out["dataplane"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(out))
     try:
         dev = jax.devices()[0].device_kind

@@ -55,44 +55,56 @@ class _Launch:
     """One open launch; ``finish`` closes it and records the sample."""
 
     __slots__ = ("_prof", "kernel", "t0", "t_dispatch",
-                 "bytes_in", "rows", "rows_used", "tags")
+                 "bytes_in", "bytes_used", "rows", "rows_used",
+                 "tags", "_overlap")
 
     def __init__(self, prof: "DeviceProfiler", kernel: str,
                  bytes_in: int, rows: int, rows_used: int,
-                 tags: dict[str, Any]):
+                 tags: dict[str, Any], bytes_used: int | None = None,
+                 overlap: bool = False):
         self._prof = prof
         self.kernel = kernel
         self.t0 = time.monotonic()
         self.t_dispatch = 0.0
         self.bytes_in = int(bytes_in)
+        self.bytes_used = int(bytes_in if bytes_used is None
+                              else bytes_used)
         self.rows = int(rows)
         self.rows_used = int(rows_used)
         self.tags = tags
+        self._overlap = overlap
+
+    def dispatched(self) -> None:
+        """Mark the end of the (async) dispatch phase *now*.  A later
+        ``finish`` then attributes everything past this point to
+        compute — the double-buffered engine dispatches a flight,
+        keeps working, and fences it launches later."""
+        self.t_dispatch = time.monotonic() - self.t0
 
     def finish(self, out: Any = None, bytes_out: int = 0,
                **tags) -> None:
         """Close the launch.
 
         Called right after the (possibly async) device call returned;
-        the time to here is *dispatch*.  If ``out`` is a device value
-        it is fenced with ``block_until_ready`` and the extra wait is
-        *compute*.  Call sites that already materialise the result
+        the time to here is *dispatch* (unless :meth:`dispatched`
+        already marked it).  If ``out`` is a device value it is fenced
+        with ``block_until_ready`` and the extra wait is *compute*.
+        Call sites that already materialise the result
         (``np.asarray``) pass ``out=None`` with the fence implicit in
         their own conversion — then compute is folded into dispatch,
         which is the honest reading: the host blocked for it.
         """
         now = time.monotonic()
-        self.t_dispatch = now - self.t0
-        compute = 0.0
+        if self.t_dispatch <= 0.0:
+            self.t_dispatch = now - self.t0
         if out is not None:
             try:
                 import jax
                 jax.block_until_ready(out)
-                t2 = time.monotonic()
-                compute = t2 - now
-                now = t2
+                now = time.monotonic()
             except Exception:   # noqa: BLE001 — non-jax value: no fence
                 pass
+        compute = max(0.0, (now - self.t0) - self.t_dispatch)
         if tags:
             self.tags.update(tags)
         self._prof._record(self, compute, now, int(bytes_out))
@@ -100,7 +112,8 @@ class _Launch:
     def abort(self) -> None:
         """Discard an open launch (device call raised) so the
         thread-local nesting flag doesn't stick."""
-        _tls.in_launch = False
+        if not self._overlap:
+            _tls.in_launch = False
 
 
 class DeviceProfiler:
@@ -124,8 +137,8 @@ class DeviceProfiler:
     @staticmethod
     def _zero_agg() -> dict:
         return {"launches": 0, "dispatch_s": 0.0, "compute_s": 0.0,
-                "bytes_in": 0, "bytes_out": 0, "rows": 0,
-                "rows_used": 0, "cache_hits": 0,
+                "bytes_in": 0, "bytes_used": 0, "bytes_out": 0,
+                "rows": 0, "rows_used": 0, "cache_hits": 0,
                 "gap_s": 0.0, "gaps": 0}
 
     def set_enabled(self, v: bool) -> None:
@@ -159,20 +172,34 @@ class DeviceProfiler:
     # -- recording ---------------------------------------------------------
 
     def start(self, kernel: str, bytes_in: int = 0, rows: int = 0,
-              rows_used: int = 0, **tags) -> _Launch | None:
+              rows_used: int = 0, bytes_used: int | None = None,
+              overlap: bool = False, **tags) -> _Launch | None:
         """Open a launch; returns ``None`` when disabled or nested so
-        call sites stay zero-alloc on the fast path."""
+        call sites stay zero-alloc on the fast path.
+
+        ``bytes_used`` — the member-payload bytes inside ``bytes_in``
+        (size-bucket padding is the difference); defaults to
+        ``bytes_in`` so ordinary launches read as fully occupied.
+
+        ``overlap=True`` — the call site keeps several launches open
+        at once (the batch engine's double-buffered flights) and
+        guarantees no nested instrumented calls of its own; such a
+        launch neither consults nor sets the thread-local nesting
+        flag."""
         if not self.enabled:
             return None
-        if getattr(_tls, "in_launch", False):
-            return None             # outermost wins: no double counting
-        _tls.in_launch = True
+        if not overlap:
+            if getattr(_tls, "in_launch", False):
+                return None         # outermost wins: no double counting
+            _tls.in_launch = True
         return _Launch(self, kernel, bytes_in, rows,
-                       max(rows_used, 0) or rows, tags)
+                       max(rows_used, 0) or rows, tags,
+                       bytes_used=bytes_used, overlap=overlap)
 
     def _record(self, lnch: _Launch, compute: float, t_end: float,
                 bytes_out: int) -> None:
-        _tls.in_launch = False
+        if not lnch._overlap:
+            _tls.in_launch = False
         dispatch = lnch.t_dispatch
         total = (t_end - lnch.t0)
         cache_hit = bool(lnch.tags.get("cache_hit"))
@@ -183,6 +210,7 @@ class DeviceProfiler:
             "compute_s": compute,
             "total_s": total,
             "bytes_in": lnch.bytes_in,
+            "bytes_used": lnch.bytes_used,
             "bytes_out": bytes_out,
             "rows": lnch.rows,
             "rows_used": lnch.rows_used,
@@ -202,6 +230,7 @@ class DeviceProfiler:
                 agg["dispatch_s"] += dispatch
                 agg["compute_s"] += compute
                 agg["bytes_in"] += lnch.bytes_in
+                agg["bytes_used"] += lnch.bytes_used
                 agg["bytes_out"] += bytes_out
                 agg["rows"] += lnch.rows
                 agg["rows_used"] += lnch.rows_used
@@ -245,6 +274,9 @@ class DeviceProfiler:
                 (tot["dispatch_s"] / t) if t > 0 else 0.0,
             "occupancy_ratio":
                 (tot["rows_used"] / tot["rows"]) if tot["rows"] else 1.0,
+            "byte_occupancy_ratio":
+                (tot["bytes_used"] / tot["bytes_in"])
+                if tot["bytes_in"] else 1.0,
             "idle_gap_avg_s":
                 (tot["gap_s"] / tot["gaps"]) if tot["gaps"] else 0.0,
         }

@@ -116,6 +116,20 @@ def build_options() -> list[Option]:
         Option("osd_stub_capacity_bytes", int, 1 << 30,
                "synthetic device capacity reported in osd_stats "
                "(drives OSD_NEARFULL)", min=1),
+        # -- device data plane (osd/batch_engine.py) ----------------------
+        Option("osd_batch_enable", bool, True,
+               "coalesce device ops (EC encode + CRC digest) into "
+               "megabatch launches"),
+        Option("osd_batch_max_bytes", int, 8 << 20,
+               "flush the batch engine at this many pending payload "
+               "bytes", min=1),
+        Option("osd_batch_max_ops", int, 64,
+               "flush the batch engine at this many pending ops",
+               min=1),
+        Option("osd_batch_flush_ms", float, 0.0,
+               "batch accumulation window (ms); 0 = flush each submit "
+               "immediately (the CPU-safe synchronous default)",
+               min=0.0),
         # -- erasure coding ----------------------------------------------
         Option("osd_pool_default_erasure_code_profile", str,
                "plugin=jerasure technique=reed_sol_van k=2 m=2",

@@ -729,7 +729,13 @@ class BatchMapper:
         """The persistent-cache key: everything the compiled program
         depends on EXCEPT weight values — jax version, backend,
         shapes, topology arrays, rule steps, tunables.  Weight-only
-        map changes therefore hash to the same entry."""
+        map changes therefore hash to the same entry.
+
+        The CHUNK (xs batch length) is deliberately absent: results
+        are chunk-invariant, so one exported program serves every
+        requested chunk size — a warm start adopts the cached
+        program's batch shape (see `_compile`) instead of re-tracing
+        per chunk."""
         import jax
 
         def h(a):
@@ -740,7 +746,6 @@ class BatchMapper:
             "jax": jax.__version__,
             "backend": jax.default_backend(),
             "ln_mode": self._ln_mode,
-            "chunk": self.chunk,
             "numrep": self.numrep,
             "result_max": self.result_max,
             "max_devices": int(max(self.cmap.max_devices, 1)),
@@ -773,7 +778,17 @@ class BatchMapper:
         if cache is not None:
             exported = cache.load_exported("crush", self._cache_key())
             if exported is not None:
-                return jax.jit(exported.call), True
+                # the cache key is chunk-free: adopt the cached
+                # program's batch shape as this mapper's chunk so any
+                # requested chunk warm-starts from the one export
+                # (callers chunk the xs stream at whatever granularity
+                # the program bakes in — results are identical)
+                try:
+                    self.chunk = int(exported.in_avals[0].shape[0])
+                except Exception:   # noqa: BLE001 — malformed export:
+                    exported = None  # fall through to a cold build
+                if exported is not None:
+                    return jax.jit(exported.call), True
         run = self._build()
         TRACE_COUNT += 1
         if cache is not None:

@@ -8,12 +8,14 @@ plugins register by name in-process; the native C ABI seam lives in
 
 from __future__ import annotations
 
+import threading
 from typing import Callable
 
 from .interface import ECError, ECProfile, ErasureCodeInterface
 
 _PLUGINS: dict[str, Callable[[ECProfile], ErasureCodeInterface]] = {}
 _BUILTINS_LOADED = False
+_LOAD_LOCK = threading.Lock()
 
 
 def register_plugin(name: str,
@@ -30,21 +32,27 @@ def _load_builtin():
     global _BUILTINS_LOADED
     if _BUILTINS_LOADED:
         return
-    _BUILTINS_LOADED = True
-    from .jerasure import ErasureCodeJerasure
-    from .isa import ErasureCodeIsa
-    from .lrc import ErasureCodeLrc
-    from .shec import ErasureCodeShec
-    from .clay import ErasureCodeClay
-    register_plugin("jerasure", ErasureCodeJerasure)
-    register_plugin("clay", ErasureCodeClay)
-    register_plugin("isa", ErasureCodeIsa)
-    register_plugin("lrc", ErasureCodeLrc)
-    register_plugin("shec", ErasureCodeShec)
-    # the reference ships jerasure as the default plugin; `jax_tpu` is this
-    # framework's name for the same RS math on the TPU engine (they share
-    # MatrixECEngine, so the alias is exact)
-    register_plugin("jax_tpu", ErasureCodeJerasure)
+    # many OSD threads hit their first encode at once: the flag must
+    # only flip after every builtin is registered, or a racing caller
+    # sees a half-empty registry
+    with _LOAD_LOCK:
+        if _BUILTINS_LOADED:
+            return
+        from .jerasure import ErasureCodeJerasure
+        from .isa import ErasureCodeIsa
+        from .lrc import ErasureCodeLrc
+        from .shec import ErasureCodeShec
+        from .clay import ErasureCodeClay
+        register_plugin("jerasure", ErasureCodeJerasure)
+        register_plugin("clay", ErasureCodeClay)
+        register_plugin("isa", ErasureCodeIsa)
+        register_plugin("lrc", ErasureCodeLrc)
+        register_plugin("shec", ErasureCodeShec)
+        # the reference ships jerasure as the default plugin; `jax_tpu` is
+        # this framework's name for the same RS math on the TPU engine (they
+        # share MatrixECEngine, so the alias is exact)
+        register_plugin("jax_tpu", ErasureCodeJerasure)
+        _BUILTINS_LOADED = True
 
 
 def create_erasure_code(profile) -> ErasureCodeInterface:

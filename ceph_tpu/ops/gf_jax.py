@@ -270,3 +270,102 @@ class GFLinearWords:
     def to_bytes(words: np.ndarray) -> np.ndarray:
         """Host words [..., nw] int32 -> [..., 4*nw] uint8."""
         return np.ascontiguousarray(words).view("<u1")
+
+
+class GFEncodeDigest:
+    """Fused EC encode + CRC-32C digest — one launch per megabatch.
+
+    The batch engine's device program: ``[B, k, L]`` uint8 stripes in,
+    ``([B, m, L]`` uint8 parity, ``[B, k+m]`` uint32 shard digests)
+    out.  Parity is the GF(2) bitmatrix matmul above; the digest
+    reuses ``scrub.crc32c_jax``'s contribution-matrix construction so
+    every data *and* parity shard leaves the device already CRC'd —
+    the write path's per-shard hinfo costs no second pass.
+
+    One jitted program per ``(B, L)`` — callers bucket both to powers
+    of two so the live set stays O(log B · log L).  On TPU the staged
+    batch is donated (``donate_argnums``): the input buffer's HBM is
+    reusable the moment the launch consumes it, which is what lets
+    the engine double-buffer host↔device staging without 2x peak
+    memory.  CPU (CI) skips donation — XLA:CPU can't alias them and
+    would warn on every launch.
+    """
+
+    def __init__(self, coding: np.ndarray, donate: bool | None = None):
+        self.coding = np.asarray(coding, dtype=np.uint8)
+        self.m, self.k = self.coding.shape
+        self._mat = jnp.asarray(_bit_layout_matrix(self.coding))
+        self.donate = (jax.default_backend() == "tpu"
+                       if donate is None else bool(donate))
+        self._shape_fns: dict[tuple, object] = {}
+        self.export_hits: dict[tuple, bool] = {}
+
+    def _make(self, batch: int, length: int):
+        from ..scrub.crc32c_jax import _contrib
+        k, m = self.k, self.m
+        k_dense, a_dense = _contrib(length)
+        kt = jnp.asarray(k_dense.T.astype(np.int8))       # [8L, 32]
+        ones = np.ones(32, dtype=np.uint8)
+        const_row = jnp.asarray((((a_dense @ ones) % 2) ^ ones)
+                                .astype(np.int32))
+        mat = self._mat
+
+        def run(data):                                    # [B, k, L] u8
+            parity = gf_matmul_bits(mat, data, m)         # [B, m, L]
+            shards = jnp.concatenate([data, parity], axis=1)
+            flat = shards.reshape(batch * (k + m), length)
+            shifts = jnp.arange(8, dtype=jnp.uint8)
+            bits = ((flat[:, :, None] >> shifts) & jnp.uint8(1))
+            bits = bits.reshape(batch * (k + m),
+                                8 * length).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                bits, kt, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out_bits = ((acc + const_row) & 1).astype(jnp.uint32)
+            crcs = jnp.sum(out_bits << jnp.arange(32, dtype=jnp.uint32),
+                           axis=-1, dtype=jnp.uint32)
+            return parity, crcs.reshape(batch, k + m)
+
+        return run
+
+    def _fn_for_shape(self, shape: tuple):
+        fn = self._shape_fns.get(shape)
+        if fn is not None:
+            return fn
+        batch, _k, length = shape
+        run = self._make(batch, length)
+        donate = (0,) if self.donate else ()
+        fn, hit = jax.jit(run, donate_argnums=donate), False
+        from ..native.aot import CompileCache, cached_export
+        if CompileCache.default() is not None:
+            import hashlib
+            key = {"kind": "gf_encode_digest", "jax": jax.__version__,
+                   "x64": bool(jax.config.jax_enable_x64),
+                   "backend": jax.default_backend(),
+                   "m": self.m, "k": self.k,
+                   "mat": hashlib.sha256(
+                       self.coding.tobytes()).hexdigest(),
+                   "shape": list(shape)}
+            try:
+                exported, hit = cached_export(
+                    "ec", key, lambda: jax.jit(run),
+                    (jax.ShapeDtypeStruct(shape, jnp.uint8),))
+                fn = jax.jit(exported.call, donate_argnums=donate)
+            except Exception:
+                pass            # non-exportable on this jax: plain jit
+        self._shape_fns[shape] = fn
+        self.export_hits[shape] = hit
+        return fn
+
+    def __call__(self, data) -> tuple[jax.Array, jax.Array]:
+        """[B, k, L] uint8 → (parity [B, m, L], crcs [B, k+m]).
+
+        Returns *device* values un-fenced — the caller (the engine's
+        flight queue) decides when to materialise, which is the whole
+        double-buffering point.  Not profiler-instrumented: the engine
+        brackets each flight itself with rows/bytes occupancy."""
+        arr = jnp.asarray(data, dtype=jnp.uint8)
+        if arr.ndim != 3 or arr.shape[1] != self.k:
+            raise ValueError(
+                f"GFEncodeDigest wants [B, {self.k}, L], got {arr.shape}")
+        return self._fn_for_shape(arr.shape)(arr)

@@ -1,0 +1,504 @@
+"""Per-OSD coalescing device data plane — kill the per-op dispatch floor.
+
+BENCH_r05's ``dispatch_floor_ms`` is the tax every OSD op pays to
+cross Python→device once: EC encode, CRC digest, parity recheck each
+launch alone, so an op-mix workload runs at launch rate, not at MXU
+rate.  This engine is the Python mirror of the native coalescing ring
+(``native/pjrt_executor.cc``): the write stream for a tick — across
+PGs and across op types — accumulates into one **megabatch** that a
+single fused launch (`ops.gf_jax.GFEncodeDigest`) encodes *and*
+digests, so per-shard hinfo CRCs ride the same program.
+
+Shape discipline keeps the jit cache bounded: members are grouped by
+EC code identity and bucketed by chunk length, rows and lengths both
+pad to powers of two.  Zero padding is free for the GF encode
+(linearity: zero columns encode to zero parity) and reversible for
+the digest (`scrub.crc32c_jax.crc32c_zero_unpad` strips the pad with
+two 32-bit GF(2) matrix applications) — so batched results are
+**bit-identical** to the unbatched path, asserted in
+tests/test_batch_engine.py and before any bench timing.
+
+Flush policy (reference: the OSD op queue's batching heuristics):
+
+- ``max_bytes`` / ``max_ops`` — size triggers, checked at submit;
+- ``flush_ms`` — the accumulation deadline.  ``0`` (the default)
+  means *immediate*: every submit flushes synchronously and
+  completions fire before ``submit_*`` returns — CPU-only CI runs
+  exactly the old one-op-at-a-time semantics, just through one code
+  path.  ``> 0`` arms a timer (``schedule``) and enables the
+  double-buffered flight pipeline: a flush dispatches its launches
+  asynchronously and hands the flights to a completion worker that
+  fences them in FIFO order while the next tick keeps staging — the
+  device never idles between launches, and FIFO completion preserves
+  per-PG version ordering.
+
+Lock order (lockdep-clean by construction): submitters may hold the
+daemon lock when calling ``submit_*`` (engine locks are leaves);
+completion callbacks re-acquire the daemon lock but run either on
+the submitter's own thread (immediate mode — RLock re-entry) or on
+the completion worker with **no** engine lock held, so there is no
+path that holds an engine lock while waiting on the daemon lock.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+
+import numpy as np
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+class Completion:
+    """One submitted op's pending result.
+
+    ``value`` for an encode op is ``(shard_chunks, hinfos)`` —
+    ``{shard: bytes}`` for all k+m shards and ``{shard: crc32c}`` to
+    match; for a digest op it is the ``int`` crc.  ``info`` carries
+    flush attribution (rows, members, reason) for the member's span.
+    """
+
+    __slots__ = ("_ev", "value", "error", "info", "_cb")
+
+    def __init__(self, callback=None):
+        self._ev = threading.Event()
+        self.value = None
+        self.error: BaseException | None = None
+        self.info: dict = {}
+        self._cb = callback
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._ev.wait(timeout)
+
+    def result(self, timeout: float | None = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("batch op still pending")
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+    def _fire(self, value=None, error: BaseException | None = None):
+        if self._ev.is_set():
+            return              # first outcome wins
+        self.value = value
+        self.error = error
+        self._ev.set()
+        if self._cb is not None:
+            self._cb(self)
+
+
+class _Op:
+    __slots__ = ("kind", "key", "chunks", "payload", "length",
+                 "nbytes", "comp", "span")
+
+    def __init__(self, kind, key, comp, span, length, nbytes,
+                 chunks=None, payload=None):
+        self.kind = kind            # "encode" | "digest"
+        self.key = key              # executable-identity group key
+        self.comp = comp
+        self.span = span
+        self.length = length        # true (unpadded) per-row length
+        self.nbytes = nbytes
+        self.chunks = chunks        # encode: [k, length] uint8
+        self.payload = payload      # digest: bytes
+
+
+class _Flight:
+    """One dispatched launch awaiting its fence."""
+
+    __slots__ = ("kind", "ops", "out", "length", "bucket", "ln",
+                 "span", "reason")
+
+    def __init__(self, kind, ops, out, length, bucket, ln, span,
+                 reason):
+        self.kind = kind
+        self.ops = ops
+        self.out = out              # device value(s), un-fenced
+        self.length = length        # bucket row length
+        self.bucket = bucket        # padded row count
+        self.ln = ln                # profiler launch (overlap) or None
+        self.span = span
+        self.reason = reason
+
+
+class BatchEngine:
+    """Tick-accumulating megabatch launcher for one OSD's device ops."""
+
+    def __init__(self, name: str = "", *, enabled: bool = True,
+                 max_bytes: int = 8 << 20, max_ops: int = 64,
+                 flush_ms: float = 0.0, schedule=None,
+                 profiler=None, tracer=None):
+        self.name = name
+        self.enabled = bool(enabled)
+        self.max_bytes = int(max_bytes)
+        self.max_ops = int(max_ops)
+        self.flush_ms = float(flush_ms)
+        self._schedule = schedule   # schedule(delay_s, fn) -> token
+        self.profiler = profiler
+        self.tracer = tracer
+        self._lock = threading.Lock()        # pending accumulator
+        self._flush_lock = threading.Lock()  # serializes dispatch
+        self._pending: list[_Op] = []
+        self._pending_bytes = 0
+        self._pending_since: float | None = None
+        self._deadline_armed = False
+        self._fused: dict = {}               # code key → GFEncodeDigest
+        self._flights: queue.Queue = queue.Queue()
+        self._worker: threading.Thread | None = None
+        self._stopped = False
+        self.stats = collections.Counter()
+
+    # -- submission --------------------------------------------------------
+
+    @staticmethod
+    def _matrix_engine(ec):
+        """The batchable core of an EC plugin, or None (LRC/SHEC/
+        bitmatrix layers fall back to the unbatched path)."""
+        from ..ec.jax_backend import MatrixECEngine
+        eng = getattr(ec, "engine", None)
+        return eng if isinstance(eng, MatrixECEngine) else None
+
+    def submit_encode(self, ec, data, *, span=None,
+                      callback=None) -> Completion:
+        """Queue a full-stripe encode+digest; the completion's value is
+        ``({shard: bytes}, {shard: crc32c})`` over all k+m shards —
+        byte- and digest-identical to ``ec.encode`` + host
+        ``crc32c`` per shard."""
+        comp = Completion(callback)
+        self.stats["ops_submitted"] += 1
+        value = None
+        try:
+            eng = self._matrix_engine(ec)
+            if eng is None or not self.enabled or self._stopped:
+                value = self._encode_unbatched(ec, data)
+            else:
+                chunks = np.ascontiguousarray(
+                    ec.encode_prepare(data), dtype=np.uint8)
+                key = ("encode", eng.k, eng.m, eng.coding.tobytes())
+                op = _Op("encode", key, comp, span,
+                         length=int(chunks.shape[1]),
+                         nbytes=int(chunks.nbytes), chunks=chunks)
+                self._enqueue(op)
+                return comp
+        except Exception as e:      # noqa: BLE001 — poisoned payloads
+            self.stats["ops_failed"] += 1   # fail their own op only
+            comp._fire(error=e)
+            return comp
+        # fire outside the try: a callback raising must surface to the
+        # submitter, not masquerade as an encode failure
+        comp._fire(value=value)
+        return comp
+
+    def submit_digest(self, payload, *, span=None,
+                      callback=None) -> Completion:
+        """Queue a CRC-32C digest; completion value is the int crc."""
+        comp = Completion(callback)
+        self.stats["ops_submitted"] += 1
+        try:
+            buf = bytes(payload)
+            if self.enabled and not self._stopped and buf:
+                op = _Op("digest", ("digest",), comp, span,
+                         length=len(buf), nbytes=len(buf),
+                         payload=buf)
+                self._enqueue(op)
+                return comp
+            from ..scrub.crc32c_jax import crc32c
+            value = crc32c(buf)
+        except Exception as e:      # noqa: BLE001
+            self.stats["ops_failed"] += 1
+            comp._fire(error=e)
+            return comp
+        comp._fire(value=value)
+        return comp
+
+    @staticmethod
+    def _encode_unbatched(ec, data):
+        """The exact pre-engine semantics: whole-stripe encode, then
+        host CRC per shard — the bit-identity reference."""
+        from ..scrub.crc32c_jax import crc32c
+        n = ec.k + ec.m
+        out = ec.encode(set(range(n)), data)
+        shard_chunks = {i: bytes(np.asarray(out[i]).tobytes())
+                        for i in range(n)}
+        hinfos = {i: crc32c(shard_chunks[i]) for i in range(n)}
+        return shard_chunks, hinfos
+
+    def _enqueue(self, op: _Op):
+        arm = False
+        fire = None
+        with self._lock:
+            self._pending.append(op)
+            self._pending_bytes += op.nbytes
+            if self._pending_since is None:
+                self._pending_since = time.monotonic()
+            if len(self._pending) >= self.max_ops:
+                fire = "max_ops"
+            elif self._pending_bytes >= self.max_bytes:
+                fire = "max_bytes"
+            elif self.flush_ms <= 0:
+                fire = "immediate"
+            elif not self._deadline_armed and self._schedule is not None:
+                self._deadline_armed = True
+                arm = True
+        if fire is not None:
+            self.flush(reason=fire)
+        elif arm:
+            self._schedule(self.flush_ms / 1000.0, self._on_deadline)
+
+    def _on_deadline(self):
+        self.flush(reason="deadline")
+
+    def maybe_flush(self) -> bool:
+        """Tick backstop: flush if the oldest pending op has waited
+        past the deadline window (covers a lost/absent timer)."""
+        with self._lock:
+            since = self._pending_since
+            if not self._pending or since is None:
+                return False
+            if (time.monotonic() - since) * 1000.0 < self.flush_ms:
+                return False
+        self.flush(reason="deadline")
+        return True
+
+    # -- flush / dispatch --------------------------------------------------
+
+    def flush(self, reason: str = "manual") -> int:
+        """Dispatch everything pending as megabatch launches.  In
+        immediate mode the flights complete inline (after all engine
+        locks drop); in batched mode they go to the FIFO completion
+        worker so the next tick stages while these fence."""
+        inline: list[_Flight] = []
+        n = 0
+        with self._flush_lock:
+            with self._lock:
+                pending, self._pending = self._pending, []
+                self._pending_bytes = 0
+                self._pending_since = None
+                self._deadline_armed = False
+                use_worker = self.flush_ms > 0 and not self._stopped
+            if not pending:
+                return 0
+            self.stats[f"flush_{reason}"] += 1
+            flights = self._dispatch(pending, reason)
+            n = len(flights)
+            for fl in flights:
+                if use_worker:
+                    self._ensure_worker()
+                    self._flights.put(fl)
+                else:
+                    inline.append(fl)
+        for fl in inline:
+            self._complete(fl)
+        return n
+
+    def drain(self):
+        """Flush and wait until every in-flight completion has fired
+        (shutdown / test barrier)."""
+        self.flush(reason="drain")
+        self._flights.join()
+
+    def stop(self):
+        """Drain, then retire the completion worker.  Later submits
+        degrade to the synchronous unbatched path."""
+        self._stopped = True
+        self.drain()
+        w = self._worker
+        if w is not None:
+            self._flights.put(None)
+            w.join(timeout=5.0)
+            self._worker = None
+
+    def _ensure_worker(self):
+        w = self._worker
+        if w is not None and w.is_alive():
+            return
+        w = threading.Thread(target=self._worker_loop,
+                             name=f"batch-{self.name}", daemon=True)
+        self._worker = w
+        w.start()
+
+    def _worker_loop(self):
+        while True:
+            fl = self._flights.get()
+            try:
+                if fl is None:
+                    return
+                self._complete(fl)
+            finally:
+                self._flights.task_done()
+
+    def _groups(self, pending):
+        groups: dict = {}
+        for op in pending:
+            bucket_len = _next_pow2(max(op.length, 32))
+            groups.setdefault((op.key, bucket_len), []).append(op)
+        return groups
+
+    def _dispatch(self, pending, reason) -> list[_Flight]:
+        flights = []
+        for (key, bucket_len), ops in self._groups(pending).items():
+            rows = _next_pow2(len(ops))
+            span = None
+            if self.tracer is not None:
+                span = self.tracer.start_span(
+                    "megabatch_flush", tags={
+                        "layer": "device", "kernel": "megabatch",
+                        "op": key[0], "members": len(ops),
+                        "rows": rows, "row_len": bucket_len,
+                        "reason": reason})
+                if span is not None:
+                    for op in ops:
+                        if op.span is not None:
+                            span.add_link(op.span)
+            try:
+                if key[0] == "encode":
+                    fl = self._launch_encode(key, ops, rows,
+                                             bucket_len, span, reason)
+                else:
+                    fl = self._launch_digest(ops, rows, bucket_len,
+                                             span, reason)
+            except Exception as e:  # noqa: BLE001 — one group's
+                # launch failure must not kill sibling groups
+                self._fail_group(ops, e, span)
+                continue
+            flights.append(fl)
+            self.stats["launches"] += 1
+        return flights
+
+    def _prof_start(self, ops, rows, staged_bytes, reason, op_kind,
+                    cache_hit):
+        if self.profiler is None:
+            return None
+        return self.profiler.start(
+            "megabatch", bytes_in=staged_bytes,
+            bytes_used=sum(o.nbytes for o in ops),
+            rows=rows, rows_used=len(ops), overlap=True,
+            members=len(ops), reason=reason, op=op_kind,
+            cache_hit=cache_hit)
+
+    def _launch_encode(self, key, ops, rows, bucket_len, span,
+                       reason) -> _Flight:
+        from ..ops.gf_jax import GFEncodeDigest
+        _kind, k, m, mat = key
+        fused = self._fused.get(key)
+        if fused is None:
+            fused = self._fused[key] = GFEncodeDigest(
+                np.frombuffer(mat, dtype=np.uint8).reshape(m, k))
+        batch = np.zeros((rows, k, bucket_len), dtype=np.uint8)
+        for i, op in enumerate(ops):
+            batch[i, :, :op.length] = op.chunks
+        shape = (rows, k, bucket_len)
+        ln = self._prof_start(ops, rows, batch.nbytes, reason,
+                              "encode", fused.export_hits.get(shape,
+                                                              False))
+        try:
+            out = fused(batch)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.dispatched()
+        return _Flight("encode", ops, out, bucket_len, rows, ln, span,
+                       reason)
+
+    def _launch_digest(self, ops, rows, bucket_len, span,
+                       reason) -> _Flight:
+        import jax.numpy as jnp
+        from ..scrub.crc32c_jax import _batch_kernel
+        batch = np.zeros((rows, bucket_len), dtype=np.uint8)
+        for i, op in enumerate(ops):
+            batch[i, :op.length] = np.frombuffer(op.payload, np.uint8)
+        ln = self._prof_start(ops, rows, batch.nbytes, reason,
+                              "digest", True)
+        try:
+            out = _batch_kernel(bucket_len)(
+                jnp.asarray(batch), jnp.zeros(rows, jnp.uint32))
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.dispatched()
+        return _Flight("digest", ops, out, bucket_len, rows, ln, span,
+                       reason)
+
+    # -- completion --------------------------------------------------------
+
+    def _complete(self, fl: _Flight):
+        from ..scrub.crc32c_jax import crc32c_zero_unpad
+        try:
+            if fl.kind == "encode":
+                parity = np.asarray(fl.out[0])
+                crcs = np.asarray(fl.out[1])
+            else:
+                crcs = np.asarray(fl.out)
+                parity = None
+        except Exception as e:      # noqa: BLE001 — launch died at the
+            if fl.ln is not None:   # fence: fail every member
+                fl.ln.abort()
+            self._fail_group(fl.ops, e, fl.span)
+            return
+        if fl.ln is not None:
+            fl.ln.finish(bytes_out=int(crcs.nbytes) +
+                         (int(parity.nbytes) if parity is not None
+                          else 0))
+        if fl.span is not None:
+            fl.span.finish()
+        info = {"rows": fl.bucket, "members": len(fl.ops),
+                "row_len": fl.length, "reason": fl.reason}
+        for i, op in enumerate(fl.ops):
+            pad = fl.length - op.length
+            try:
+                if fl.kind == "encode":
+                    k = op.chunks.shape[0]
+                    m = parity.shape[1]
+                    shard_chunks = {j: op.chunks[j].tobytes()
+                                    for j in range(k)}
+                    for j in range(m):
+                        shard_chunks[k + j] = \
+                            parity[i, j, :op.length].tobytes()
+                    hinfos = {s: crc32c_zero_unpad(int(crcs[i, s]),
+                                                   pad)
+                              for s in range(k + m)}
+                    value = (shard_chunks, hinfos)
+                else:
+                    value = crc32c_zero_unpad(int(crcs[i]), pad)
+                op.comp.info = info
+                op.comp._fire(value=value)
+                self.stats["ops_completed"] += 1
+            except Exception:       # noqa: BLE001 — a member's
+                # callback blowing up must not starve its siblings
+                self.stats["callback_errors"] += 1
+
+    def _fail_group(self, ops, err, span):
+        if span is not None:
+            span.set_tag("error", repr(err))
+            span.finish()
+        for op in ops:
+            self.stats["ops_failed"] += 1
+            try:
+                op.comp._fire(error=err)
+            except Exception:       # noqa: BLE001
+                self.stats["callback_errors"] += 1
+
+    # -- introspection -----------------------------------------------------
+
+    def dump(self) -> dict:
+        with self._lock:
+            pending = len(self._pending)
+            pending_bytes = self._pending_bytes
+        d = dict(self.stats)
+        d.update(enabled=self.enabled, flush_ms=self.flush_ms,
+                 max_bytes=self.max_bytes, max_ops=self.max_ops,
+                 pending_ops=pending, pending_bytes=pending_bytes,
+                 inflight=self._flights.unfinished_tasks)
+        return d

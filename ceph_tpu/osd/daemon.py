@@ -196,6 +196,38 @@ class OSDaemon(Dispatcher):
         self.config.add_observer(
             "device_profiler_ring_size",
             lambda _n, v: self.profiler.set_ring_size(int(v)))
+        # coalescing device data plane: PG write paths submit encode/
+        # digest work here instead of launching per-op; the deadline
+        # timer rides SafeTimer (resolved lazily — the timer is
+        # constructed below), megabatch launches attribute to the
+        # device profiler, flush spans link member op spans
+        from .batch_engine import BatchEngine
+        self.batch_engine = BatchEngine(
+            name=f"osd.{whoami}",
+            enabled=bool(self.config.get("osd_batch_enable")),
+            max_bytes=int(
+                self.config.get("osd_batch_max_bytes") or (8 << 20)),
+            max_ops=int(self.config.get("osd_batch_max_ops") or 64),
+            flush_ms=float(
+                self.config.get("osd_batch_flush_ms") or 0.0),
+            schedule=lambda d, fn: self.timer.add_event_after(d, fn),
+            profiler=self.profiler, tracer=self.tracer)
+        self.config.add_observer(
+            "osd_batch_enable",
+            lambda _n, v: setattr(self.batch_engine, "enabled",
+                                  bool(v)))
+        self.config.add_observer(
+            "osd_batch_max_bytes",
+            lambda _n, v: setattr(self.batch_engine, "max_bytes",
+                                  int(v)))
+        self.config.add_observer(
+            "osd_batch_max_ops",
+            lambda _n, v: setattr(self.batch_engine, "max_ops",
+                                  int(v)))
+        self.config.add_observer(
+            "osd_batch_flush_ms",
+            lambda _n, v: setattr(self.batch_engine, "flush_ms",
+                                  float(v)))
         self.admin_socket = AdminSocket(
             admin_socket_path or default_path(f"osd.{whoami}"))
         self._register_admin_commands()
@@ -332,6 +364,9 @@ class OSDaemon(Dispatcher):
             return {"error": "usage: profiler dump|reset"}
         a.register("profiler", _profiler_ctl,
                    "profiler dump|reset — per-launch device profiles")
+        a.register("dump_batch_engine",
+                   lambda c: self.batch_engine.dump(),
+                   "coalescing data-plane counters + flush config")
         a.register("config show", lambda c: {
             k: self.config.get(k) for k in self.config.keys()},
             "effective configuration")
@@ -518,6 +553,12 @@ class OSDaemon(Dispatcher):
     def shutdown(self):
         self.running = False
         self.op_queue.close()
+        # drain the data plane while the messenger is still up: the
+        # flights' completions fan out their sub-writes
+        try:
+            self.batch_engine.stop()
+        except Exception:   # noqa: BLE001 — shutdown is best-effort
+            pass
         self.timer.shutdown()
         self.admin_socket.shutdown()
         tier = getattr(self, "_tier_client", None)
@@ -860,6 +901,10 @@ class OSDaemon(Dispatcher):
     def _tick(self):
         if not self.running:
             return
+        # deadline backstop for the data plane: a flush whose timer
+        # event was lost (or an engine configured without a schedule)
+        # still drains within one tick
+        self.batch_engine.maybe_flush()
         with self.lock:
             now = time.monotonic()
             # peering retransmit: queries/notifies are fire-and-forget

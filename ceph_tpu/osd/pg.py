@@ -2171,10 +2171,17 @@ class ECBackend:
                          version=version, prior_version=prior,
                          reqid=reqid, mtime=time.time())
         daemon = pg.daemon
-        # encode once; per-shard transactions.  The jitted GF encode
-        # is the device kernel of the write path — traced as a child
-        # of the OSD op span with bytes + wall time
-        shard_chunks = None
+        # encode once; per-shard transactions.  The fused GF encode +
+        # CRC digest is the device kernel of the write path — it goes
+        # through the per-OSD batch engine, which coalesces concurrent
+        # writes (across PGs and op types) into megabatch launches and
+        # completes each member with its shard bytes AND per-shard
+        # hinfo digests.  The fan-out continues in the completion
+        # callback; with the default immediate flush this runs
+        # synchronously before submit_encode returns (the old
+        # semantics, bit-identically), while a deadline window makes
+        # it a true async data plane.  Traced as a child of the OSD op
+        # span; the engine links it to its megabatch flush span.
         if data is not None:
             k, m = self.engine.k, self.engine.m
             _ospan = getattr(getattr(msg, "tracked", None), "span",
@@ -2183,12 +2190,54 @@ class ECBackend:
                 "gf_encode", parent=_ospan, tags={
                     "layer": "device", "kernel": "gf_encode",
                     "bytes": len(data), "k": k, "m": m})
+
+            def _encoded(comp, _dlen=len(data)):
+                with daemon.lock:
+                    if span is not None:
+                        if comp.info:
+                            span.set_tag("batch_rows",
+                                         comp.info.get("rows"))
+                            span.set_tag("batch_members",
+                                         comp.info.get("members"))
+                        span.finish()
+                    if reqid not in self._active_reqids:
+                        return      # op reset (on_change) mid-encode
+                    if comp.error is not None:
+                        self._inflight.pop(reqid, None)
+                        self._active_reqids.discard(reqid)
+                        self._release_rmw(oid)
+                        pg._reply(msg, -22,
+                                  f"write failed: {comp.error!r}")
+                        return
+                    shard_chunks, hinfos = comp.value
+                    try:
+                        self._finish_apply(
+                            msg, reqid, oid, entry, version, results,
+                            shard_chunks, hinfos, delete, attr_ops,
+                            _dlen)
+                    except Exception as e:   # noqa: BLE001 — poisoned
+                        # op past encode: same cleanup as submit_write
+                        self._inflight.pop(reqid, None)
+                        self._active_reqids.discard(reqid)
+                        self._release_rmw(oid)
+                        pg._reply(msg, -22, f"write failed: {e!r}")
+
             with daemon.profiler.bind():
-                out = self.engine.encode(set(range(k + m)), data)
-            shard_chunks = {i: bytes(out[i].tobytes())
-                            for i in range(k + m)}
-            if span is not None:
-                span.finish()
+                daemon.batch_engine.submit_encode(
+                    self.engine, data, span=span, callback=_encoded)
+            return
+        self._finish_apply(msg, reqid, oid, entry, version, results,
+                           None, None, delete, attr_ops, None)
+
+    def _finish_apply(self, msg: M.MOSDOp, reqid: str, oid: str,
+                      entry, version, results, shard_chunks, hinfos,
+                      delete: bool, attr_ops, logical_size):
+        """The post-encode half of a write: min_size gate, per-shard
+        transactions, primary-applies-last fan-out.  Runs inline for
+        data-less ops and as the batch engine's completion for
+        encoded ones (under the daemon lock either way)."""
+        pg = self.pg
+        daemon = pg.daemon
         live = []
         for s, o in enumerate(pg.acting):
             if o == CRUSH_ITEM_NONE or not daemon.osdmap.is_up(o):
@@ -2224,8 +2273,7 @@ class ECBackend:
         remote = [(s, o) for s, o in live if o != daemon.whoami]
         local_txns = [self._shard_txn(s, oid, shard_chunks, delete,
                                       attr_ops, version,
-                                      len(data) if data is not None
-                                      else None)
+                                      logical_size, hinfos=hinfos)
                       for s, _ in local]
         state = {"waiting": {s for s, _ in remote}, "msg": msg,
                  "version": version, "results": results,
@@ -2237,8 +2285,8 @@ class ECBackend:
             else getattr(msg, "trace", None)
         for s, o in remote:
             txn = self._shard_txn(s, oid, shard_chunks, delete,
-                                  attr_ops, version,
-                                  len(data) if data is not None else None)
+                                  attr_ops, version, logical_size,
+                                  hinfos=hinfos)
             daemon.send_to_osd(o, M.MOSDECSubOpWrite(
                 reqid=reqid, pgid=str(pg.pgid), shard=s,
                 epoch=daemon.osdmap.epoch, txn=txn.to_dict(),
@@ -2248,7 +2296,8 @@ class ECBackend:
         self._maybe_ack(reqid)
 
     def _shard_txn(self, shard: int, oid: str, chunks, delete: bool,
-                   attr_ops, version, logical_size) -> Transaction:
+                   attr_ops, version, logical_size,
+                   hinfos=None) -> Transaction:
         pg = self.pg
         cid = pg.cid_for_shard(shard)
         t = Transaction()
@@ -2257,10 +2306,16 @@ class ECBackend:
             return t
         if chunks is not None:
             chunk = chunks[shard]
+            # hinfo normally arrives precomputed from the batch
+            # engine's fused digest (identical by construction to the
+            # host crc — asserted in tests); the host path is the
+            # fallback for callers without one
+            hinfo = (hinfos[shard] if hinfos is not None
+                     else crc32c(chunk))
             t.truncate(cid, oid, 0)
             t.write(cid, oid, 0, chunk)
             t.setattrs(cid, oid, {"_": _obj_meta(
-                version, logical_size, hinfo=crc32c(chunk))})
+                version, logical_size, hinfo=hinfo)})
         # attr-only mutations leave "_" untouched: it carries the
         # shard's data hinfo, which an attr update must not clobber
         # (the log entry alone records the new version)

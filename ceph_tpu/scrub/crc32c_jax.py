@@ -154,6 +154,74 @@ def crc32c_combine(crc_a: int, crc_b: int, len_b: int) -> int:
     return crc32c_shift(crc_a, len_b) ^ (int(crc_b) & _MASK)
 
 
+def _matrix_inverse(cols: list[int]) -> list[int]:
+    """Invert a 32x32 GF(2) matrix (column-of-uint32 form) by
+    Gauss-Jordan elimination.  A is invertible because the CRC
+    polynomial has a nonzero constant term (x^0), so the byte-shift
+    operator is a bijection on register states."""
+    n = len(cols)
+    dense = _dense(cols, n).astype(np.uint8)
+    aug = np.concatenate([dense, np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        piv = col + int(np.argmax(aug[col:, col]))
+        if aug[piv, col] == 0:
+            raise ValueError("singular GF(2) matrix")
+        if piv != col:
+            aug[[col, piv]] = aug[[piv, col]]
+        for row in np.nonzero(aug[:, col])[0]:
+            if row != col:
+                aug[row] ^= aug[col]
+    inv = aug[:, n:]
+    return [int(sum(int(inv[r, i]) << r for r in range(n)))
+            for i in range(n)]
+
+
+@functools.lru_cache(maxsize=1)
+def _a_inv_cols() -> list[int]:
+    return _matrix_inverse(_A_COLS)
+
+
+def crc32c_unshift(crc: int, nbytes: int) -> int:
+    """Inverse of :func:`crc32c_shift`: apply ``A^-nbytes`` (remove
+    `nbytes` trailing zero bytes from the *raw* register), by
+    square-and-multiply over the inverted shift matrix."""
+    c = int(crc) & _MASK
+    n = int(nbytes)
+    if n < 0:
+        raise ValueError("negative length")
+    mat = _a_inv_cols()
+    while n:
+        if n & 1:
+            c = _matrix_times(mat, c)
+        n >>= 1
+        if n:
+            mat = _matrix_square(mat)
+    return c
+
+
+@functools.lru_cache(maxsize=None)
+def crc32c_zeros(nbytes: int) -> int:
+    """``crc32c(b"\\x00" * nbytes)`` without touching the bytes:
+    conditioning in, ``A^n``, conditioning out."""
+    if nbytes == 0:
+        return 0
+    return (crc32c_shift(_MASK, nbytes) ^ _MASK) & _MASK
+
+
+def crc32c_zero_unpad(crc: int, pad: int) -> int:
+    """``crc32c(A)`` from ``crc32c(A || 0^pad)`` — strip `pad`
+    trailing zero bytes from a digest.
+
+    The batch engine right-pads every member payload to its size
+    bucket with zeros before the fused device digest; by
+    ``crc(A||0^n) = A^n·crc(A) ⊕ crc(0^n)`` the true digest is
+    recovered host-side with two 32-bit GF(2) matrix applications —
+    no second pass over the data."""
+    if pad == 0:
+        return int(crc) & _MASK
+    return crc32c_unshift((int(crc) ^ crc32c_zeros(pad)) & _MASK, pad)
+
+
 # ------------------------------------------------------- batch kernel
 
 def _dense(cols: list[int], rows: int = 32) -> np.ndarray:

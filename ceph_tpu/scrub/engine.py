@@ -29,7 +29,7 @@ import os
 
 import numpy as np
 
-from .crc32c_jax import crc32c, crc32c_batch
+from .crc32c_jax import crc32c, crc32c_batch, crc32c_combine
 
 
 class ScrubEngine:
@@ -37,12 +37,22 @@ class ScrubEngine:
     OSD perf counters and bench can report scanned bytes."""
 
     def __init__(self, device_min_rows: int = 4,
-                 device_min_bytes: int = 1 << 16):
+                 device_min_bytes: int = 1 << 16,
+                 segment_bytes: int | None = None):
         mode = os.environ.get("CEPH_TPU_SCRUB_DEVICE", "auto").lower()
         self.mode = mode if mode in ("auto", "always", "never") else "auto"
         self.device_min_rows = device_min_rows
         self.device_min_bytes = device_min_bytes
+        # streaming-digest granularity: objects larger than one
+        # device buffer are digested as equal segments and folded
+        # with crc32c_combine (GF(2) matrix exponentiation) — the
+        # device batch shape stays bounded no matter the object size
+        if segment_bytes is None:
+            segment_bytes = int(os.environ.get(
+                "CEPH_TPU_SCRUB_SEGMENT_BYTES", 4 << 20))
+        self.segment_bytes = max(1, int(segment_bytes))
         self.objects_scanned = 0
+        self.segmented_objects = 0
         self.digest_bytes = 0
         self.device_digest_bytes = 0
         self.parity_bytes = 0
@@ -59,14 +69,51 @@ class ScrubEngine:
 
     def compute_digests(self, payloads: dict) -> dict:
         """{key: bytes-like} → {key: crc32c int}, batching same-length
-        payloads through the device kernel."""
-        by_len: dict[int, list] = {}
+        payloads through the device kernel.
+
+        Payloads larger than ``segment_bytes`` are digested as a
+        stream of equal-size segments (which land in one shared
+        length bucket, so they batch with *each other* across
+        objects) and folded back into one per-object digest with
+        :func:`crc32c_combine` — bit-identical to digesting the
+        whole buffer at once, but the device batch never exceeds
+        ``segment_bytes`` per row.
+        """
+        seg = self.segment_bytes
+        parts: dict = {}        # key -> [(part_key, part_len), ...]
+        expanded: dict = {}     # part_key/key -> bytes
         for key, buf in payloads.items():
             b = bytes(buf)
+            if len(b) > seg:
+                self.segmented_objects += 1
+                pieces = parts[key] = []
+                for i, off in enumerate(range(0, len(b), seg)):
+                    pk = ("_seg", key, i)
+                    expanded[pk] = b[off:off + seg]
+                    pieces.append((pk, len(expanded[pk])))
+            else:
+                expanded[key] = b
+        digests = self._digest_exact(expanded)
+        out: dict = {}
+        for key in payloads:
+            if key in parts:
+                crc = 0
+                for pk, plen in parts[key]:
+                    crc = crc32c_combine(crc, digests[pk], plen)
+                out[key] = crc
+            else:
+                out[key] = digests[key]
+        self.objects_scanned += len(payloads)
+        return out
+
+    def _digest_exact(self, payloads: dict) -> dict:
+        """Digest already-materialised byte payloads, bucketed by
+        exact length (no segmentation — compute_digests handles it)."""
+        by_len: dict[int, list] = {}
+        for key, b in payloads.items():
             by_len.setdefault(len(b), []).append((key, b))
         out: dict = {}
         for length, group in by_len.items():
-            self.objects_scanned += len(group)
             self.digest_bytes += length * len(group)
             if self._use_device(len(group), length):
                 from ..core.device_profiler import DeviceProfiler

@@ -1,0 +1,363 @@
+"""Coalescing device data plane — the per-OSD BatchEngine.
+
+The engine aggregates the write-path device work for a tick (EC
+encode+digest, scrub digests) into one megabatch launch per
+(code, size-bucket) group.  These tests pin the contract that makes
+that safe to enable by default:
+
+1. **Bit-identity** — batched results are byte- and digest-identical
+   to the synchronous unbatched path (``ec.encode`` + host crc32c).
+2. **Flush policy** — max_ops / max_bytes / deadline / immediate all
+   fire, and the tick backstop (`maybe_flush`) covers a lost timer.
+3. **Coalescing** — a concurrent burst across submitters collapses
+   into far fewer launches than ops.
+4. **Failure isolation** — a poisoned group (or poisoned member)
+   fails its own completions; sibling groups/members still complete.
+5. **End to end** — an EC pool on a MiniCluster with batching forced
+   on serves writes correctly and reports engine stats over the
+   admin socket.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.core.admin_socket import admin_command
+from ceph_tpu.core.device_profiler import DeviceProfiler
+from ceph_tpu.ec import create_erasure_code
+from ceph_tpu.osd.batch_engine import BatchEngine, _next_pow2
+from ceph_tpu.scrub.crc32c_jax import crc32c
+from ceph_tpu.vstart import MiniCluster
+
+
+def _payload(n, seed=0):
+    return bytes((i * 131 + seed * 17 + 7) & 0xFF for i in range(n))
+
+
+@pytest.fixture
+def ec():
+    return create_erasure_code(
+        {"plugin": "jerasure", "k": 4, "m": 2,
+         "technique": "reed_sol_van"})
+
+
+# ---------------------------------------------------------------- identity
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("size", [1, 100, 4096, 5000])
+    def test_encode_matches_unbatched(self, ec, size):
+        eng = BatchEngine("t")          # flush_ms=0 → immediate mode
+        data = _payload(size)
+        got = eng.submit_encode(ec, data).result()
+        want = BatchEngine._encode_unbatched(ec, data)
+        assert got[0] == want[0]
+        assert got[1] == want[1]
+        # and the reference itself agrees with host crc32c
+        assert all(want[1][s] == crc32c(want[0][s]) for s in want[0])
+
+    def test_encode_batched_mixed_sizes(self, ec):
+        """Many stripes across several size buckets, flushed as one
+        call — every member identical to its unbatched twin."""
+        eng = BatchEngine("t", flush_ms=1000.0, max_ops=1000,
+                          max_bytes=1 << 30)
+        sizes = [64, 100, 128, 3000, 257, 64, 100, 5000, 1]
+        comps = [eng.submit_encode(ec, _payload(s, i))
+                 for i, s in enumerate(sizes)]
+        assert not any(c.done() for c in comps)
+        eng.drain()
+        for i, (s, c) in enumerate(zip(sizes, comps)):
+            want = BatchEngine._encode_unbatched(ec, _payload(s, i))
+            assert c.result(timeout=10) == want
+        # same bucket ops shared a launch: 9 ops, fewer launches
+        assert 0 < eng.stats["launches"] < len(sizes)
+        eng.stop()
+
+    def test_digest_matches_host(self):
+        eng = BatchEngine("t", flush_ms=1000.0)
+        payloads = [_payload(n, n) for n in (0, 1, 31, 32, 33, 4096)]
+        comps = [eng.submit_digest(p) for p in payloads]
+        eng.drain()
+        for p, c in zip(payloads, comps):
+            assert c.result(timeout=10) == crc32c(p)
+        eng.stop()
+
+    def test_disabled_engine_is_synchronous_and_identical(self, ec):
+        eng = BatchEngine("t", enabled=False)
+        data = _payload(777)
+        comp = eng.submit_encode(ec, data)
+        assert comp.done()          # no deferral at all
+        assert comp.result() == BatchEngine._encode_unbatched(ec, data)
+        assert eng.stats["launches"] == 0
+        d = eng.submit_digest(b"hello")
+        assert d.done() and d.result() == crc32c(b"hello")
+
+
+# ------------------------------------------------------------ flush policy
+
+class TestFlushTriggers:
+    def test_immediate_mode_flushes_each_submit(self, ec):
+        eng = BatchEngine("t", flush_ms=0.0)
+        for i in range(3):
+            assert eng.submit_encode(ec, _payload(100, i)).done()
+        assert eng.stats["flush_immediate"] == 3
+        assert eng.stats["launches"] == 3
+
+    def test_max_ops_trigger(self, ec):
+        eng = BatchEngine("t", flush_ms=1000.0, max_ops=4,
+                          max_bytes=1 << 30)
+        comps = [eng.submit_encode(ec, _payload(64, i))
+                 for i in range(4)]
+        eng._flights.join()
+        assert eng.stats["flush_max_ops"] == 1
+        assert all(c.wait(timeout=10) for c in comps)
+        eng.stop()
+
+    def test_max_bytes_trigger(self):
+        eng = BatchEngine("t", flush_ms=1000.0, max_ops=1000,
+                          max_bytes=1024)
+        comps = [eng.submit_digest(_payload(512, i)) for i in range(2)]
+        eng._flights.join()
+        assert eng.stats["flush_max_bytes"] == 1
+        assert all(c.wait(timeout=10) for c in comps)
+        eng.stop()
+
+    def test_deadline_via_schedule(self, ec):
+        """The armed timer (schedule callback) fires the flush."""
+        armed = []
+        eng = BatchEngine("t", flush_ms=5.0, max_ops=1000,
+                          max_bytes=1 << 30,
+                          schedule=lambda d, fn: armed.append((d, fn)))
+        comp = eng.submit_encode(ec, _payload(200))
+        assert len(armed) == 1 and armed[0][0] == pytest.approx(0.005)
+        assert not comp.done()
+        armed[0][1]()               # timer fires
+        assert comp.wait(timeout=10)
+        assert eng.stats["flush_deadline"] == 1
+        eng.stop()
+
+    def test_maybe_flush_backstop(self, ec):
+        """No timer at all: the tick backstop flushes once the oldest
+        op has aged past the window."""
+        eng = BatchEngine("t", flush_ms=1.0, max_ops=1000,
+                          max_bytes=1 << 30, schedule=None)
+        comp = eng.submit_encode(ec, _payload(200))
+        time.sleep(0.01)
+        assert eng.maybe_flush()
+        assert comp.wait(timeout=10)
+        assert eng.maybe_flush() is False      # nothing pending
+        eng.stop()
+
+
+# -------------------------------------------------------------- coalescing
+
+class TestCoalescing:
+    def test_concurrent_burst_coalesces(self, ec):
+        """16 submitter threads × 8 ops each (think: many PGs on one
+        OSD in the same tick) collapse into a handful of launches."""
+        eng = BatchEngine("t", flush_ms=50.0, max_ops=1000,
+                          max_bytes=1 << 30)
+        comps, lock = [], threading.Lock()
+
+        def burst(t):
+            mine = [eng.submit_encode(ec, _payload(500, t * 8 + i))
+                    for i in range(8)]
+            with lock:
+                comps.extend(mine)
+
+        threads = [threading.Thread(target=burst, args=(t,))
+                   for t in range(16)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        eng.drain()
+        assert len(comps) == 128
+        # all payloads share one (code, bucket) group → launches ≪ ops
+        assert eng.stats["launches"] <= 4
+        assert eng.stats["ops_completed"] == 128
+        # spot-check identity on a few members
+        want = BatchEngine._encode_unbatched(ec, _payload(500, 0))
+        got = [c.result(timeout=10) for c in comps]
+        assert want in got
+        eng.stop()
+
+    def test_profiler_sees_occupancy(self, ec):
+        """Megabatch launches record staged vs useful bytes; the
+        aggregate exposes byte_occupancy_ratio."""
+        prof = DeviceProfiler(enabled=True)
+        eng = BatchEngine("t", flush_ms=1000.0, profiler=prof)
+        for i in range(5):
+            eng.submit_encode(ec, _payload(100, i))
+        eng.drain()
+        mega = [s for s in prof.samples()
+                if s["kernel"] == "megabatch"]
+        assert mega
+        s = mega[-1]
+        assert s["rows"] == _next_pow2(5) and s["rows_used"] == 5
+        assert 0 < s["bytes_used"] <= s["bytes_in"]
+        agg = prof.aggregate()
+        assert agg["kernels"]["megabatch"]["bytes_used"] > 0
+        assert 0 < agg["byte_occupancy_ratio"] <= 1.0
+        eng.stop()
+
+
+# ------------------------------------------------------- failure isolation
+
+class TestFailureRouting:
+    def test_poisoned_group_spares_siblings(self, ec, monkeypatch):
+        """One size-bucket group's launch raises; its members get the
+        error, members of the other bucket complete normally."""
+        eng = BatchEngine("t", flush_ms=1000.0, max_ops=1000,
+                          max_bytes=1 << 30)
+        import ceph_tpu.ops.gf_jax as gf_jax
+        real = gf_jax.GFEncodeDigest.__call__
+
+        def poisoned(self, data):
+            if data.shape[2] == 32:         # only the 32-byte bucket
+                raise RuntimeError("injected launch failure")
+            return real(self, data)
+
+        monkeypatch.setattr(gf_jax.GFEncodeDigest, "__call__", poisoned)
+        bad = [eng.submit_encode(ec, _payload(100, i))     # chunk 32
+               for i in range(3)]
+        good = [eng.submit_encode(ec, _payload(1000, i))   # chunk 256
+                for i in range(3)]
+        eng.drain()
+        for c in bad:
+            assert c.wait(timeout=10)
+            with pytest.raises(RuntimeError, match="injected"):
+                c.result()
+        for i, c in enumerate(good):
+            assert c.result(timeout=10) == \
+                BatchEngine._encode_unbatched(ec, _payload(1000, i))
+        assert eng.stats["ops_failed"] == 3
+        assert eng.stats["ops_completed"] == 3
+        eng.stop()
+
+    def test_bad_submit_fails_only_its_op(self, ec):
+        """A poisoned payload dies at submit; the queue keeps going."""
+        eng = BatchEngine("t", flush_ms=1000.0, max_ops=1000,
+                          max_bytes=1 << 30)
+        ok1 = eng.submit_encode(ec, _payload(100))
+        bad = eng.submit_encode(ec, object())      # not bytes-like
+        ok2 = eng.submit_encode(ec, _payload(100, 1))
+        assert bad.done() and bad.error is not None
+        with pytest.raises(Exception):
+            bad.result()
+        eng.drain()
+        assert ok1.result(timeout=10) == \
+            BatchEngine._encode_unbatched(ec, _payload(100))
+        assert ok2.result(timeout=10) == \
+            BatchEngine._encode_unbatched(ec, _payload(100, 1))
+        eng.stop()
+
+    def test_member_callback_error_spares_siblings(self, ec):
+        eng = BatchEngine("t", flush_ms=1000.0, max_ops=1000,
+                          max_bytes=1 << 30)
+        boom = eng.submit_encode(ec, _payload(64),
+                                 callback=lambda c: 1 / 0)
+        ok = eng.submit_encode(ec, _payload(64, 1))
+        eng.drain()
+        assert boom.wait(timeout=10)     # value still delivered
+        assert ok.result(timeout=10) == \
+            BatchEngine._encode_unbatched(ec, _payload(64, 1))
+        assert eng.stats["callback_errors"] == 1
+        eng.stop()
+
+    def test_submit_after_stop_degrades_synchronously(self, ec):
+        eng = BatchEngine("t", flush_ms=1000.0)
+        eng.stop()
+        data = _payload(96)
+        comp = eng.submit_encode(ec, data)
+        assert comp.done()
+        assert comp.result() == BatchEngine._encode_unbatched(ec, data)
+
+
+# --------------------------------------------------------------- end to end
+
+class TestClusterIntegration:
+    @pytest.mark.slow
+    def test_ec_writes_through_batched_engine(self):
+        """EC pool with deadline batching forced on: concurrent
+        writes land correctly, and the engine coalesced them."""
+        c = MiniCluster(n_mons=1, n_osds=4, osd_config={
+            "osd_batch_flush_ms": 25.0,
+            "osd_batch_max_ops": 64})
+        c.start()
+        try:
+            r = c.rados()
+            r.monc.command({"prefix": "osd erasure-code-profile set",
+                            "name": "beprof",
+                            "profile": ["k=2", "m=1",
+                                        "technique=reed_sol_van"]})
+            r.create_pool("bep", pg_num=4, pool_type="erasure",
+                          erasure_code_profile="beprof")
+            io = r.open_ioctx("bep")
+            c.wait_for_clean()
+            payloads = {f"obj-{i}": _payload(800 + i, i)
+                        for i in range(24)}
+
+            def write(oid):
+                io.write_full(oid, payloads[oid])
+
+            threads = [threading.Thread(target=write, args=(oid,))
+                       for oid in payloads]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for oid, data in payloads.items():
+                assert io.read(oid) == data
+            dumps = [admin_command(o.admin_socket.path,
+                                   "dump_batch_engine")
+                     for o in c.osds.values()]
+            submitted = sum(d.get("ops_submitted", 0) for d in dumps)
+            launches = sum(d.get("launches", 0) for d in dumps)
+            assert submitted >= 24
+            assert 0 < launches < submitted
+            assert sum(d.get("ops_failed", 0) for d in dumps) == 0
+            r.shutdown()
+        finally:
+            c.stop()
+
+    def test_ec_writes_engine_disabled_bit_identical(self):
+        """Engine off vs on: the stored shards and hinfos for the
+        same payload are byte-identical (the bit-identity acceptance
+        gate, cluster-level)."""
+        stored = {}
+        for enabled, flush in ((False, 0.0), (True, 25.0)):
+            c = MiniCluster(n_mons=1, n_osds=3, osd_config={
+                "osd_batch_enable": enabled,
+                "osd_batch_flush_ms": flush})
+            c.start()
+            try:
+                r = c.rados()
+                r.monc.command(
+                    {"prefix": "osd erasure-code-profile set",
+                     "name": "idprof",
+                     "profile": ["k=2", "m=1",
+                                 "technique=reed_sol_van"]})
+                r.create_pool("idp", pg_num=1, pool_type="erasure",
+                              erasure_code_profile="idprof")
+                io = r.open_ioctx("idp")
+                c.wait_for_clean()
+                io.write_full("victim", _payload(1500))
+                time.sleep(0.3)
+                shards = {}
+                for i, osd in c.osds.items():
+                    with osd.lock:
+                        for cid in osd.store.list_collections():
+                            if osd.store.exists(cid, "victim"):
+                                shards[i] = (
+                                    bytes(osd.store.read(cid,
+                                                         "victim")),
+                                    bytes(osd.store.getattr(
+                                        cid, "victim", "_")))
+                stored[enabled] = shards
+                assert io.read("victim") == _payload(1500)
+                r.shutdown()
+            finally:
+                c.stop()
+        assert stored[False] == stored[True]

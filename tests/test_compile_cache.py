@@ -71,6 +71,29 @@ def test_cold_build_then_warm_start(cache_dir):
     np.testing.assert_array_equal(bm2(XS), got)
 
 
+def test_warm_start_across_chunk_sizes(cache_dir):
+    """chunk is a harness knob, not part of the compiled program's
+    identity: a mapper built with a different chunk warm-starts from
+    the same cache entry and ADOPTS the cached program's batch shape
+    — no second trace, no second entry, identical placements."""
+    t0 = jm.TRACE_COUNT
+    bm = BatchMapper(_tiny(), 0, result_max=2, chunk=256)
+    assert bm.cache_hit is False and jm.TRACE_COUNT == t0 + 1
+    got = bm(XS)
+
+    t1 = jm.TRACE_COUNT
+    bm2 = BatchMapper(_tiny(), 0, result_max=2, chunk=8)
+    assert bm2.cache_hit is True
+    assert jm.TRACE_COUNT == t1           # never traced
+    assert bm2.chunk == 256               # adopted the cached shape
+    np.testing.assert_array_equal(bm2(XS), got)
+    np.testing.assert_array_equal(bm2(XS), _oracle(_tiny(), XS))
+
+    # still exactly one entry on disk — the key is chunk-free
+    entries = list((cache_dir / "export" / "crush").glob("*.jaxpb"))
+    assert len(entries) == 1
+
+
 def test_reweight_reuses_executable(cache_dir):
     cmap = _tiny()
     bm = BatchMapper(cmap, 0, result_max=2, chunk=256)

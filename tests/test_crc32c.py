@@ -9,7 +9,9 @@ import numpy as np
 import pytest
 
 from ceph_tpu.scrub.crc32c_jax import (crc32c, crc32c_batch,
-                                       crc32c_combine, crc32c_shift)
+                                       crc32c_combine, crc32c_shift,
+                                       crc32c_unshift, crc32c_zeros,
+                                       crc32c_zero_unpad)
 
 # (payload, expected) — RFC 3720 §B.4 plus the classic check value
 GOLDEN = [
@@ -65,6 +67,24 @@ class TestCombine:
                 assert crc32c(base + b"\x00" * n) == \
                     crc32c_shift(crc32c(base), n) ^ \
                     crc32c(b"\x00" * n)
+
+    def test_unshift_inverts_shift(self):
+        for base in (b"", b"xyz", bytes(range(64))):
+            c = crc32c(base)
+            for n in (0, 1, 5, 32, 300):
+                assert crc32c_unshift(crc32c_shift(c, n), n) == c
+
+    def test_zeros_matches_host(self):
+        for n in (0, 1, 31, 32, 4096):
+            assert crc32c_zeros(n) == crc32c(b"\x00" * n)
+
+    def test_zero_unpad_recovers_unpadded_crc(self):
+        # crc(A || 0^pad) → crc(A): the batch engine's bucket-padding
+        # correction, exact for any pad width
+        for base in (b"", b"q", bytes(range(100))):
+            for pad in (0, 1, 5, 63, 300):
+                padded = crc32c(base + b"\x00" * pad)
+                assert crc32c_zero_unpad(padded, pad) == crc32c(base)
 
 
 class TestBatchKernel:
