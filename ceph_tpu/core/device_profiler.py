@@ -129,6 +129,7 @@ class DeviceProfiler:
             maxlen=max(1, int(ring_size)))
         self._last_end: float | None = None   # for the idle-gap series
         self._agg: dict[str, dict] = {}
+        self._lanes: dict[str, dict] = {}
         self._hist = LogHistogram(LAUNCH_HIST_BUCKETS)
         self._totals = self._zero_agg()
 
@@ -153,6 +154,7 @@ class DeviceProfiler:
         with self._lock:
             self._ring.clear()
             self._agg.clear()
+            self._lanes.clear()
             self._totals = self._zero_agg()
             self._hist = LogHistogram(LAUNCH_HIST_BUCKETS)
             self._last_end = None
@@ -223,9 +225,13 @@ class DeviceProfiler:
             self._last_end = t_end
             sample["gap_s"] = gap
             self._ring.append(sample)
-            for agg in (self._agg.setdefault(lnch.kernel,
-                                             self._zero_agg()),
-                        self._totals):
+            aggs = [self._agg.setdefault(lnch.kernel, self._zero_agg()),
+                    self._totals]
+            lane = lnch.tags.get("lane")
+            if lane is not None:
+                aggs.append(self._lanes.setdefault(str(lane),
+                                                   self._zero_agg()))
+            for agg in aggs:
                 agg["launches"] += 1
                 agg["dispatch_s"] += dispatch
                 agg["compute_s"] += compute
@@ -261,6 +267,7 @@ class DeviceProfiler:
         """Cheap summary for the osd_stats beacon / asok dump."""
         with self._lock:
             kernels = {k: dict(v) for k, v in self._agg.items()}
+            lanes = {k: dict(v) for k, v in self._lanes.items()}
             tot = dict(self._totals)
             hist = list(self._hist.data[0])
         t = tot["dispatch_s"] + tot["compute_s"]
@@ -268,6 +275,7 @@ class DeviceProfiler:
             "name": self.name,
             "enabled": self.enabled,
             "kernels": kernels,
+            "lanes": lanes,
             "totals": tot,
             "launch_hist_us": hist,
             "dispatch_overhead_ratio":

@@ -130,6 +130,22 @@ def build_options() -> list[Option]:
                "batch accumulation window (ms); 0 = flush each submit "
                "immediately (the CPU-safe synchronous default)",
                min=0.0),
+        Option("osd_recovery_batch_enable", bool, True,
+               "coalesce degraded reads / recovery / backfill decodes "
+               "into the batch engine's reconstruct lane"),
+        Option("osd_recovery_batch_max_bytes", int, 8 << 20,
+               "flush the reconstruct lane at this many pending "
+               "survivor bytes", min=1),
+        Option("osd_recovery_batch_max_ops", int, 64,
+               "flush the reconstruct lane at this many pending "
+               "decodes", min=1),
+        Option("osd_recovery_batch_flush_ms", float, 0.0,
+               "reconstruct-lane accumulation window (ms); 0 = flush "
+               "each submit immediately (the CPU-safe synchronous "
+               "default)", min=0.0),
+        Option("osd_recovery_batch_mesh", bool, False,
+               "shard reconstruct megabatches over a (dp, shard) "
+               "device mesh when more than one device is visible"),
         # -- erasure coding ----------------------------------------------
         Option("osd_pool_default_erasure_code_profile", str,
                "plugin=jerasure technique=reed_sol_van k=2 m=2",

@@ -376,3 +376,54 @@ def gf_matmul_planes(bitmat: jnp.ndarray, planes: jnp.ndarray, m: int,
         out = _gf_apply_planes(bdmat, x, m=m, interpret=interpret)
         outb = jax.lax.bitcast_convert_type(out, jnp.uint8)
     return outb.reshape(*lead, m, nw * _WORD)
+
+
+class ResidentPlanes:
+    """Expand-once/multiply-many survivor planes, resident on device.
+
+    ``gf_matmul_words`` re-expands its byte input into bit-planes on
+    every call, but a recovery sweep multiplies the SAME survivor
+    batch by several GF(2^8) matrices: the decode matrix for erased
+    data rows, the composed coding∘decode matrix for erased parity
+    rows, one matrix per hypothesis in scrub culprit attribution.
+    This holder runs :func:`gf_expand_words` once and serves any
+    number of :meth:`multiply` calls against the resident planes.
+
+    ``mats`` is an optional shared per-matrix operand cache
+    ({matrix bytes: bdmats dict}); hand the same dict to every
+    ``ResidentPlanes`` of a sweep and the block-diagonal device
+    matrices upload once for the whole sweep instead of once per
+    batch (the "held across a whole recovery sweep" half of the
+    contract — planes live per batch, matrices per sweep).
+    """
+
+    __slots__ = ("planes", "n", "interpret", "_mats")
+
+    # gf_expand_words tile contract: byte length % 512 == 0 so the
+    # word planes split into whole 128-lane tiles
+    _ALIGN = 512
+
+    def __init__(self, data, interpret: bool = False,
+                 mats: dict | None = None):
+        data = jnp.asarray(data, dtype=jnp.uint8)
+        n = int(data.shape[-1])
+        pad = -n % self._ALIGN
+        if pad:
+            width = [(0, 0)] * (data.ndim - 1) + [(0, pad)]
+            data = jnp.pad(data, width)
+        self.n = n
+        self.interpret = interpret
+        self._mats = mats if mats is not None else {}
+        self.planes = gf_expand_words(data)
+
+    def multiply(self, matrix: np.ndarray) -> jnp.ndarray:
+        """GF(2^8) matrix [m, k] × resident planes → [..., m, n]
+        uint8 (device value, pad stripped; zero padding is exact:
+        zero bytes map to zero bytes under any GF-linear map)."""
+        from .gf_jax import _bit_layout_matrix
+        mat = np.ascontiguousarray(matrix, dtype=np.uint8)
+        bdmats = self._mats.setdefault(mat.tobytes(), {})
+        bits = _bit_layout_matrix(mat)
+        out = gf_matmul_planes(bits, self.planes, mat.shape[0],
+                               interpret=self.interpret, bdmats=bdmats)
+        return out[..., : self.n]

@@ -18,6 +18,25 @@ two 32-bit GF(2) matrix applications) — so batched results are
 **bit-identical** to the unbatched path, asserted in
 tests/test_batch_engine.py and before any bench timing.
 
+Two lanes share the machinery but accumulate separately.  The
+**write lane** (PR 8) carries encode+digest for the client write
+stream.  The **reconstruct lane** carries the degraded path —
+degraded reads, recovery pushes, backfill pulls, and scrub parity
+rechecks — grouped per (code identity, erasure pattern, size
+bucket) so one fused launch reconstructs a whole sweep's worth of
+objects: a single ``GFLinear`` over the plan's stacked
+``[k + p, k]`` recovery matrix on CPU/1-chip, the resident
+bit-plane path (``ops.gf_pallas2.ResidentPlanes``,
+expand-once/multiply-many with per-matrix operands held across the
+sweep) when planes are selected, or the ``parallel.reconstruct``
+shard_map program over a (dp, shard) mesh.  Erased *parity* rows
+ride the same launch via the plan's composed ``coding ∘ dm``
+matrix (GF associativity makes the composition byte-exact).  The
+lane has its own knobs (``recon_*``, defaulting to the write
+lane's) and its own stats (``recon_`` prefix); each lane flush
+reports to ``on_lane_flush`` so the OSD can debit the mClock
+recovery reservation for the bandwidth the lane just consumed.
+
 Flush policy (reference: the OSD op queue's batching heuristics):
 
 - ``max_bytes`` / ``max_ops`` — size triggers, checked at submit;
@@ -97,28 +116,32 @@ class Completion:
 
 class _Op:
     __slots__ = ("kind", "key", "chunks", "payload", "length",
-                 "nbytes", "comp", "span")
+                 "nbytes", "comp", "span", "want", "passthrough")
 
     def __init__(self, kind, key, comp, span, length, nbytes,
-                 chunks=None, payload=None):
-        self.kind = kind            # "encode" | "digest"
+                 chunks=None, payload=None, want=None,
+                 passthrough=None):
+        self.kind = kind            # "encode"|"digest"|"recon"|"recheck"
         self.key = key              # executable-identity group key
         self.comp = comp
         self.span = span
         self.length = length        # true (unpadded) per-row length
         self.nbytes = nbytes
-        self.chunks = chunks        # encode: [k, length] uint8
+        self.chunks = chunks        # encode/recheck: [k, length];
+        #                             recon: survivor stack [k, length]
         self.payload = payload      # digest: bytes
+        self.want = want            # recon: frozenset of wanted ids
+        self.passthrough = passthrough  # recon: {id: chunk} present+wanted
 
 
 class _Flight:
     """One dispatched launch awaiting its fence."""
 
     __slots__ = ("kind", "ops", "out", "length", "bucket", "ln",
-                 "span", "reason")
+                 "span", "reason", "plan")
 
     def __init__(self, kind, ops, out, length, bucket, ln, span,
-                 reason):
+                 reason, plan=None):
         self.kind = kind
         self.ops = ops
         self.out = out              # device value(s), un-fenced
@@ -127,6 +150,7 @@ class _Flight:
         self.ln = ln                # profiler launch (overlap) or None
         self.span = span
         self.reason = reason
+        self.plan = plan            # recon: DecodePlan (row_of mapping)
 
 
 class BatchEngine:
@@ -135,12 +159,29 @@ class BatchEngine:
     def __init__(self, name: str = "", *, enabled: bool = True,
                  max_bytes: int = 8 << 20, max_ops: int = 64,
                  flush_ms: float = 0.0, schedule=None,
-                 profiler=None, tracer=None):
+                 profiler=None, tracer=None,
+                 recon_enabled: bool | None = None,
+                 recon_max_bytes: int | None = None,
+                 recon_max_ops: int | None = None,
+                 recon_flush_ms: float | None = None,
+                 use_mesh: bool = False, on_lane_flush=None):
         self.name = name
         self.enabled = bool(enabled)
         self.max_bytes = int(max_bytes)
         self.max_ops = int(max_ops)
         self.flush_ms = float(flush_ms)
+        # reconstruct-lane knobs default to the write lane's values
+        self.recon_enabled = (self.enabled if recon_enabled is None
+                              else bool(recon_enabled))
+        self.recon_max_bytes = (self.max_bytes if recon_max_bytes
+                                is None else int(recon_max_bytes))
+        self.recon_max_ops = (self.max_ops if recon_max_ops is None
+                              else int(recon_max_ops))
+        self.recon_flush_ms = (self.flush_ms if recon_flush_ms is None
+                               else float(recon_flush_ms))
+        self.use_mesh = bool(use_mesh)
+        self.use_planes: bool | None = None  # None = auto (TPU only)
+        self.on_lane_flush = on_lane_flush   # (lane, ops, bytes) hook
         self._schedule = schedule   # schedule(delay_s, fn) -> token
         self.profiler = profiler
         self.tracer = tracer
@@ -150,7 +191,16 @@ class BatchEngine:
         self._pending_bytes = 0
         self._pending_since: float | None = None
         self._deadline_armed = False
+        self._pending_recon: list[_Op] = []
+        self._pending_recon_bytes = 0
+        self._recon_since: float | None = None
+        self._recon_armed = False
         self._fused: dict = {}               # code key → GFEncodeDigest
+        self._rexec: dict = {}               # recon/recheck key → GFLinear
+        self._plan_cache: dict = {}          # DecodePlan per erasure set
+        self._plane_mats: dict = {}          # bit-plane matrix operands
+        self._sharded: dict = {}             # code key → ShardedEC
+        self._mesh = None
         self._flights: queue.Queue = queue.Queue()
         self._worker: threading.Thread | None = None
         self._stopped = False
@@ -231,63 +281,225 @@ class BatchEngine:
         hinfos = {i: crc32c(shard_chunks[i]) for i in range(n)}
         return shard_chunks, hinfos
 
-    def _enqueue(self, op: _Op):
+    # -- reconstruct lane --------------------------------------------------
+
+    def submit_reconstruct(self, ec, chunks, *, want=None, span=None,
+                           callback=None) -> Completion:
+        """Queue a degraded decode; the completion's value is
+        ``{chunk_id: uint8 array}`` for every wanted id — byte-identical
+        to ``ec.decode(want, chunks)``.
+
+        ``want`` defaults to the k data ids (the client-read case).
+        When every wanted id is already present the op completes
+        synchronously with no device work (the systematic fast path,
+        mirroring ``ErasureCode.decode``'s early-out); otherwise ops
+        group per (code identity, erasure pattern, size bucket) and
+        one fused launch recovers the whole group."""
+        comp = Completion(callback)
+        self.stats["recon_ops_submitted"] += 1
+        value = None
+        try:
+            from ..ec.interface import ECError
+            present = {int(i): np.asarray(c, dtype=np.uint8)
+                       for i, c in chunks.items()}
+            if not present:
+                raise ECError("no chunks to decode from")
+            want_ids = frozenset(
+                int(i) for i in (want if want is not None
+                                 else range(ec.k)))
+            if want_ids <= present.keys():
+                # systematic fast path: nothing to reconstruct
+                self.stats["recon_fast_path"] += 1
+                value = {i: present[i] for i in want_ids}
+            else:
+                eng = self._matrix_engine(ec)
+                if (eng is None or not self.enabled
+                        or not self.recon_enabled or self._stopped):
+                    value = self._reconstruct_unbatched(
+                        ec, want_ids, chunks)
+                else:
+                    sizes = {c.size for c in present.values()}
+                    if len(sizes) != 1:
+                        raise ECError("chunk sizes differ")
+                    if len(present) < eng.k:
+                        raise ECError(
+                            f"{len(present)} chunks < k={eng.k}")
+                    erasures = tuple(i for i in range(eng.k + eng.m)
+                                     if i not in present)
+                    avail = sorted(present)
+                    surv = np.ascontiguousarray(np.stack(
+                        [present[i] for i in avail[:eng.k]]))
+                    op = _Op("recon",
+                             ("recon", eng.k, eng.m,
+                              eng.coding.tobytes(), erasures),
+                             comp, span, length=int(surv.shape[1]),
+                             nbytes=int(surv.nbytes), chunks=surv,
+                             want=want_ids,
+                             passthrough={i: present[i]
+                                          for i in want_ids
+                                          if i in present})
+                    self._enqueue(op, lane="recon")
+                    return comp
+        except Exception as e:      # noqa: BLE001 — poisoned payloads
+            self.stats["recon_ops_failed"] += 1
+            comp._fire(error=e)
+            return comp
+        comp._fire(value=value)
+        return comp
+
+    def submit_recheck(self, ec, data, *, span=None,
+                       callback=None) -> Completion:
+        """Queue a scrub parity re-encode; completion value is the
+        ``[m, length]`` parity array, byte-identical to
+        ``np.asarray(ec._encode_chunks(data))`` — so deep-scrub parity
+        rechecks coalesce with recovery reconstructs instead of
+        launching standalone."""
+        comp = Completion(callback)
+        self.stats["recon_ops_submitted"] += 1
+        try:
+            eng = self._matrix_engine(ec)
+            arr = np.ascontiguousarray(data, dtype=np.uint8)
+            if (eng is None or not self.enabled
+                    or not self.recon_enabled or self._stopped):
+                value = np.asarray(ec._encode_chunks(arr))
+            else:
+                op = _Op("recheck",
+                         ("recheck", eng.k, eng.m,
+                          eng.coding.tobytes()),
+                         comp, span, length=int(arr.shape[1]),
+                         nbytes=int(arr.nbytes), chunks=arr)
+                self._enqueue(op, lane="recon")
+                return comp
+        except Exception as e:      # noqa: BLE001
+            self.stats["recon_ops_failed"] += 1
+            comp._fire(error=e)
+            return comp
+        comp._fire(value=value)
+        return comp
+
+    @staticmethod
+    def _reconstruct_unbatched(ec, want, chunks):
+        """The exact pre-lane semantics — the bit-identity reference."""
+        return ec.decode(set(want), chunks)
+
+    def _enqueue(self, op: _Op, lane: str = "write"):
         arm = False
         fire = None
+        recon = lane == "recon"
+        max_ops = self.recon_max_ops if recon else self.max_ops
+        max_bytes = self.recon_max_bytes if recon else self.max_bytes
+        flush_ms = self.recon_flush_ms if recon else self.flush_ms
         with self._lock:
-            self._pending.append(op)
-            self._pending_bytes += op.nbytes
-            if self._pending_since is None:
-                self._pending_since = time.monotonic()
-            if len(self._pending) >= self.max_ops:
+            if recon:
+                self._pending_recon.append(op)
+                self._pending_recon_bytes += op.nbytes
+                if self._recon_since is None:
+                    self._recon_since = time.monotonic()
+                n, nbytes = (len(self._pending_recon),
+                             self._pending_recon_bytes)
+                armed = self._recon_armed
+            else:
+                self._pending.append(op)
+                self._pending_bytes += op.nbytes
+                if self._pending_since is None:
+                    self._pending_since = time.monotonic()
+                n, nbytes = len(self._pending), self._pending_bytes
+                armed = self._deadline_armed
+            if n >= max_ops:
                 fire = "max_ops"
-            elif self._pending_bytes >= self.max_bytes:
+            elif nbytes >= max_bytes:
                 fire = "max_bytes"
-            elif self.flush_ms <= 0:
+            elif flush_ms <= 0:
                 fire = "immediate"
-            elif not self._deadline_armed and self._schedule is not None:
-                self._deadline_armed = True
+            elif not armed and self._schedule is not None:
+                if recon:
+                    self._recon_armed = True
+                else:
+                    self._deadline_armed = True
                 arm = True
         if fire is not None:
-            self.flush(reason=fire)
+            self.flush(reason=fire, lane=lane)
         elif arm:
-            self._schedule(self.flush_ms / 1000.0, self._on_deadline)
+            self._schedule(flush_ms / 1000.0,
+                           self._on_recon_deadline if recon
+                           else self._on_deadline)
 
     def _on_deadline(self):
-        self.flush(reason="deadline")
+        self.flush(reason="deadline", lane="write")
+
+    def _on_recon_deadline(self):
+        self.flush(reason="deadline", lane="recon")
 
     def maybe_flush(self) -> bool:
-        """Tick backstop: flush if the oldest pending op has waited
-        past the deadline window (covers a lost/absent timer)."""
+        """Tick backstop: flush any lane whose oldest pending op has
+        waited past its deadline window (covers a lost/absent timer)."""
+        now = time.monotonic()
         with self._lock:
-            since = self._pending_since
-            if not self._pending or since is None:
-                return False
-            if (time.monotonic() - since) * 1000.0 < self.flush_ms:
-                return False
-        self.flush(reason="deadline")
-        return True
+            w = (bool(self._pending)
+                 and self._pending_since is not None
+                 and (now - self._pending_since) * 1000.0
+                 >= self.flush_ms)
+            r = (bool(self._pending_recon)
+                 and self._recon_since is not None
+                 and (now - self._recon_since) * 1000.0
+                 >= self.recon_flush_ms)
+        if w:
+            self.flush(reason="deadline", lane="write")
+        if r:
+            self.flush(reason="deadline", lane="recon")
+        return w or r
 
     # -- flush / dispatch --------------------------------------------------
 
-    def flush(self, reason: str = "manual") -> int:
+    def flush(self, reason: str = "manual", lane: str | None = None
+              ) -> int:
         """Dispatch everything pending as megabatch launches.  In
         immediate mode the flights complete inline (after all engine
         locks drop); in batched mode they go to the FIFO completion
-        worker so the next tick stages while these fence."""
+        worker so the next tick stages while these fence.  ``lane``
+        limits the flush to one lane; default flushes both."""
+        lanes = ("write", "recon") if lane is None else (lane,)
+        return sum(self._flush_lane(ln, reason) for ln in lanes)
+
+    def flush_sync(self, lane: str, reason: str = "manual") -> int:
+        """Dispatch and complete a lane's pending inline on the
+        calling thread, bypassing the completion worker.  For
+        submitters that must consume results synchronously while
+        possibly holding the daemon lock (deep-scrub parity recheck):
+        inline completion re-enters that lock on the caller's own
+        thread (RLock), so the caller never waits behind worker-queue
+        flights whose callbacks need the lock it holds."""
+        return self._flush_lane(lane, reason, force_inline=True)
+
+    def _flush_lane(self, lane: str, reason: str,
+                    force_inline: bool = False) -> int:
         inline: list[_Flight] = []
+        recon = lane == "recon"
         n = 0
         with self._flush_lock:
             with self._lock:
-                pending, self._pending = self._pending, []
-                self._pending_bytes = 0
-                self._pending_since = None
-                self._deadline_armed = False
-                use_worker = self.flush_ms > 0 and not self._stopped
+                if recon:
+                    pending = self._pending_recon
+                    self._pending_recon = []
+                    staged = self._pending_recon_bytes
+                    self._pending_recon_bytes = 0
+                    self._recon_since = None
+                    self._recon_armed = False
+                    ms = self.recon_flush_ms
+                else:
+                    pending, self._pending = self._pending, []
+                    staged = self._pending_bytes
+                    self._pending_bytes = 0
+                    self._pending_since = None
+                    self._deadline_armed = False
+                    ms = self.flush_ms
+                use_worker = (ms > 0 and not self._stopped
+                              and not force_inline)
             if not pending:
                 return 0
-            self.stats[f"flush_{reason}"] += 1
-            flights = self._dispatch(pending, reason)
+            prefix = "recon_" if recon else ""
+            self.stats[f"{prefix}flush_{reason}"] += 1
+            flights = self._dispatch(pending, reason, lane)
             n = len(flights)
             for fl in flights:
                 if use_worker:
@@ -297,6 +509,11 @@ class BatchEngine:
                     inline.append(fl)
         for fl in inline:
             self._complete(fl)
+        if self.on_lane_flush is not None:
+            try:
+                self.on_lane_flush(lane, len(pending), staged)
+            except Exception:       # noqa: BLE001 — accounting hook
+                self.stats["callback_errors"] += 1
         return n
 
     def drain(self):
@@ -342,8 +559,9 @@ class BatchEngine:
             groups.setdefault((op.key, bucket_len), []).append(op)
         return groups
 
-    def _dispatch(self, pending, reason) -> list[_Flight]:
+    def _dispatch(self, pending, reason, lane="write") -> list[_Flight]:
         flights = []
+        launches_key = "recon_launches" if lane == "recon" else "launches"
         for (key, bucket_len), ops in self._groups(pending).items():
             rows = _next_pow2(len(ops))
             span = None
@@ -351,7 +569,8 @@ class BatchEngine:
                 span = self.tracer.start_span(
                     "megabatch_flush", tags={
                         "layer": "device", "kernel": "megabatch",
-                        "op": key[0], "members": len(ops),
+                        "op": key[0], "lane": lane,
+                        "members": len(ops),
                         "rows": rows, "row_len": bucket_len,
                         "reason": reason})
                 if span is not None:
@@ -362,19 +581,25 @@ class BatchEngine:
                 if key[0] == "encode":
                     fl = self._launch_encode(key, ops, rows,
                                              bucket_len, span, reason)
-                else:
+                elif key[0] == "digest":
                     fl = self._launch_digest(ops, rows, bucket_len,
                                              span, reason)
+                elif key[0] == "recon":
+                    fl = self._launch_reconstruct(
+                        key, ops, rows, bucket_len, span, reason)
+                else:
+                    fl = self._launch_recheck(key, ops, rows,
+                                              bucket_len, span, reason)
             except Exception as e:  # noqa: BLE001 — one group's
                 # launch failure must not kill sibling groups
                 self._fail_group(ops, e, span)
                 continue
             flights.append(fl)
-            self.stats["launches"] += 1
+            self.stats[launches_key] += 1
         return flights
 
     def _prof_start(self, ops, rows, staged_bytes, reason, op_kind,
-                    cache_hit):
+                    cache_hit, lane="write"):
         if self.profiler is None:
             return None
         return self.profiler.start(
@@ -382,7 +607,7 @@ class BatchEngine:
             bytes_used=sum(o.nbytes for o in ops),
             rows=rows, rows_used=len(ops), overlap=True,
             members=len(ops), reason=reason, op=op_kind,
-            cache_hit=cache_hit)
+            cache_hit=cache_hit, lane=lane)
 
     def _launch_encode(self, key, ops, rows, bucket_len, span,
                        reason) -> _Flight:
@@ -431,30 +656,161 @@ class BatchEngine:
         return _Flight("digest", ops, out, bucket_len, rows, ln, span,
                        reason)
 
+    def _launch_reconstruct(self, key, ops, rows, bucket_len, span,
+                            reason) -> _Flight:
+        from ..parallel.reconstruct import decode_plan
+        _kind, k, m, mat, erasures = key
+        coding = np.frombuffer(mat, dtype=np.uint8).reshape(m, k)
+        plan = decode_plan(coding, k, m, erasures,
+                           cache=self._plan_cache)
+        batch = np.zeros((rows, k, bucket_len), dtype=np.uint8)
+        for i, op in enumerate(ops):
+            batch[i, :, :op.length] = op.chunks
+        ln = self._prof_start(ops, rows, batch.nbytes, reason,
+                              "recon", key in self._rexec,
+                              lane="recon")
+        try:
+            out = self._run_reconstruct(key, plan, batch)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.dispatched()
+        return _Flight("recon", ops, out, bucket_len, rows, ln, span,
+                       reason, plan=plan)
+
+    def _run_reconstruct(self, key, plan, batch):
+        """Pick the reconstruct strategy for one fused group:
+
+        - mesh (``use_mesh`` and >1 device): the shard_map program of
+          ``parallel.reconstruct.ShardedEC`` — survivor rows scattered
+          to their chunk-id positions, batch padded to a dp multiple.
+          Only for pure-data erasure patterns (the common recovery
+          case); composed parity rows stay on the fused path.
+        - resident planes (``use_planes``, auto on TPU): expand the
+          survivor batch to bit planes once, multiply by the plan's
+          stacked matrix — per-matrix operands persist in
+          ``_plane_mats`` across the whole sweep.
+        - default: one cached ``GFLinear`` over the plan's fused
+          ``[k + p, k]`` matrix — a single launch per group.
+        """
+        import jax
+        if (self.use_mesh and plan.parity_matrix is None
+                and len(jax.devices()) > 1):
+            return self._run_mesh(key, plan, batch)
+        planes = (self.use_planes if self.use_planes is not None
+                  else jax.default_backend() == "tpu")
+        if planes:
+            from ..ops.gf_pallas2 import ResidentPlanes
+            rp = ResidentPlanes(
+                batch, interpret=jax.default_backend() != "tpu",
+                mats=self._plane_mats)
+            return rp.multiply(plan.matrix)
+        prog = self._rexec.get(key)
+        if prog is None:
+            from ..ops.gf_jax import GFLinear
+            prog = self._rexec[key] = GFLinear(plan.matrix)
+        return prog(batch)
+
+    def _run_mesh(self, key, plan, batch):
+        from ..parallel.mesh import make_mesh
+        from ..parallel.reconstruct import ShardedEC
+        code_key = key[:4]
+        sh = self._sharded.get(code_key)
+        if sh is None:
+            if self._mesh is None:
+                self._mesh = make_mesh()
+            _kind, k, m, mat = code_key
+            coding = np.frombuffer(mat, dtype=np.uint8).reshape(m, k)
+            # byte payloads in, byte payloads out: word_native stays
+            # off so host staging needs no dtype views
+            sh = self._sharded[code_key] = ShardedEC(
+                coding, k, m, self._mesh, word_native=False)
+        rows, _k, length = batch.shape
+        dp = sh.mesh.shape["dp"]
+        b_pad = -(-rows // dp) * dp
+        full = np.zeros((b_pad, sh.n_pad, length), dtype=np.uint8)
+        for r, sid in enumerate(plan.survivors):
+            full[:rows, sid] = batch[:, r]
+        out = sh.reconstruct(full, plan.erasures)
+        return out[:rows]
+
+    def _launch_recheck(self, key, ops, rows, bucket_len, span,
+                        reason) -> _Flight:
+        _kind, k, m, mat = key
+        cache_hit = key in self._rexec
+        prog = self._rexec.get(key)
+        if prog is None:
+            from ..ops.gf_jax import GFLinear
+            prog = self._rexec[key] = GFLinear(
+                np.frombuffer(mat, dtype=np.uint8).reshape(m, k))
+        batch = np.zeros((rows, k, bucket_len), dtype=np.uint8)
+        for i, op in enumerate(ops):
+            batch[i, :, :op.length] = op.chunks
+        ln = self._prof_start(ops, rows, batch.nbytes, reason,
+                              "recheck", cache_hit, lane="recon")
+        try:
+            out = prog(batch)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.dispatched()
+        return _Flight("recheck", ops, out, bucket_len, rows, ln, span,
+                       reason)
+
     # -- completion --------------------------------------------------------
 
     def _complete(self, fl: _Flight):
         from ..scrub.crc32c_jax import crc32c_zero_unpad
+        parity = crcs = rec = None
         try:
             if fl.kind == "encode":
                 parity = np.asarray(fl.out[0])
                 crcs = np.asarray(fl.out[1])
-            else:
+                bytes_out = int(parity.nbytes) + int(crcs.nbytes)
+            elif fl.kind == "digest":
                 crcs = np.asarray(fl.out)
-                parity = None
+                bytes_out = int(crcs.nbytes)
+            else:               # recon | recheck
+                rec = np.asarray(fl.out)
+                bytes_out = int(rec.nbytes)
         except Exception as e:      # noqa: BLE001 — launch died at the
             if fl.ln is not None:   # fence: fail every member
                 fl.ln.abort()
             self._fail_group(fl.ops, e, fl.span)
             return
         if fl.ln is not None:
-            fl.ln.finish(bytes_out=int(crcs.nbytes) +
-                         (int(parity.nbytes) if parity is not None
-                          else 0))
+            fl.ln.finish(bytes_out=bytes_out)
         if fl.span is not None:
             fl.span.finish()
         info = {"rows": fl.bucket, "members": len(fl.ops),
                 "row_len": fl.length, "reason": fl.reason}
+        if rec is not None:
+            info["lane"] = "recon"
+            plan = fl.plan
+            for i, op in enumerate(fl.ops):
+                try:
+                    if fl.kind == "recheck":
+                        value = np.ascontiguousarray(
+                            rec[i, :, :op.length])
+                    else:
+                        value = {
+                            cid: (op.passthrough[cid]
+                                  if cid in op.passthrough else
+                                  np.ascontiguousarray(
+                                      rec[i, plan.row_of[cid],
+                                          :op.length]))
+                            for cid in op.want}
+                    op.comp.info = info
+                    op.comp._fire(value=value)
+                    self.stats["recon_ops_completed"] += 1
+                except Exception:   # noqa: BLE001 — a member's
+                    # callback blowing up must not starve its siblings
+                    self.stats["callback_errors"] += 1
+            return
         for i, op in enumerate(fl.ops):
             pad = fl.length - op.length
             try:
@@ -484,7 +840,9 @@ class BatchEngine:
             span.set_tag("error", repr(err))
             span.finish()
         for op in ops:
-            self.stats["ops_failed"] += 1
+            self.stats["recon_ops_failed"
+                       if op.kind in ("recon", "recheck")
+                       else "ops_failed"] += 1
             try:
                 op.comp._fire(error=err)
             except Exception:       # noqa: BLE001
@@ -496,9 +854,19 @@ class BatchEngine:
         with self._lock:
             pending = len(self._pending)
             pending_bytes = self._pending_bytes
+            rpending = len(self._pending_recon)
+            rpending_bytes = self._pending_recon_bytes
         d = dict(self.stats)
         d.update(enabled=self.enabled, flush_ms=self.flush_ms,
                  max_bytes=self.max_bytes, max_ops=self.max_ops,
                  pending_ops=pending, pending_bytes=pending_bytes,
+                 recon_enabled=self.recon_enabled,
+                 recon_flush_ms=self.recon_flush_ms,
+                 recon_max_bytes=self.recon_max_bytes,
+                 recon_max_ops=self.recon_max_ops,
+                 recon_pending_ops=rpending,
+                 recon_pending_bytes=rpending_bytes,
+                 recon_use_mesh=self.use_mesh,
+                 recon_plans=len(self._plan_cache),
                  inflight=self._flights.unfinished_tasks)
         return d

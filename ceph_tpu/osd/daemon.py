@@ -210,24 +210,35 @@ class OSDaemon(Dispatcher):
             max_ops=int(self.config.get("osd_batch_max_ops") or 64),
             flush_ms=float(
                 self.config.get("osd_batch_flush_ms") or 0.0),
+            recon_enabled=bool(
+                self.config.get("osd_recovery_batch_enable")),
+            recon_max_bytes=int(
+                self.config.get("osd_recovery_batch_max_bytes")
+                or (8 << 20)),
+            recon_max_ops=int(
+                self.config.get("osd_recovery_batch_max_ops") or 64),
+            recon_flush_ms=float(
+                self.config.get("osd_recovery_batch_flush_ms") or 0.0),
+            use_mesh=bool(
+                self.config.get("osd_recovery_batch_mesh")),
+            on_lane_flush=self._on_lane_flush,
             schedule=lambda d, fn: self.timer.add_event_after(d, fn),
             profiler=self.profiler, tracer=self.tracer)
-        self.config.add_observer(
-            "osd_batch_enable",
-            lambda _n, v: setattr(self.batch_engine, "enabled",
-                                  bool(v)))
-        self.config.add_observer(
-            "osd_batch_max_bytes",
-            lambda _n, v: setattr(self.batch_engine, "max_bytes",
-                                  int(v)))
-        self.config.add_observer(
-            "osd_batch_max_ops",
-            lambda _n, v: setattr(self.batch_engine, "max_ops",
-                                  int(v)))
-        self.config.add_observer(
-            "osd_batch_flush_ms",
-            lambda _n, v: setattr(self.batch_engine, "flush_ms",
-                                  float(v)))
+        for _opt, _attr, _cast in (
+                ("osd_batch_enable", "enabled", bool),
+                ("osd_batch_max_bytes", "max_bytes", int),
+                ("osd_batch_max_ops", "max_ops", int),
+                ("osd_batch_flush_ms", "flush_ms", float),
+                ("osd_recovery_batch_enable", "recon_enabled", bool),
+                ("osd_recovery_batch_max_bytes", "recon_max_bytes",
+                 int),
+                ("osd_recovery_batch_max_ops", "recon_max_ops", int),
+                ("osd_recovery_batch_flush_ms", "recon_flush_ms",
+                 float),
+                ("osd_recovery_batch_mesh", "use_mesh", bool)):
+            self.config.add_observer(
+                _opt, lambda _n, v, _a=_attr, _c=_cast: setattr(
+                    self.batch_engine, _a, _c(v)))
         self.admin_socket = AdminSocket(
             admin_socket_path or default_path(f"osd.{whoami}"))
         self._register_admin_commands()
@@ -571,6 +582,20 @@ class OSDaemon(Dispatcher):
         self.monc.shutdown()
         self.msgr.shutdown()
         self.store.umount()
+
+    def _on_lane_flush(self, lane: str, ops: int, nbytes: int):
+        """Batch-engine flush hook: debit the op queue for the device
+        bandwidth the reconstruct lane just consumed, so queued
+        recovery-class work defers in proportion and client ops keep
+        their p99 through a recovery sweep (the mClock recovery
+        reservation governs the lane even though its megabatches
+        bypass the queue itself)."""
+        if lane != "recon" or not ops:
+            return
+        q = getattr(self, "op_queue", None)
+        account = getattr(q, "account", None)
+        if account is not None:
+            account(RECOVERY, float(ops))
 
     def _send_boot(self):
         self.monc.send(MM.MOSDBoot(

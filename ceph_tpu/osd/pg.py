@@ -1414,9 +1414,43 @@ def _push_is_stale(store, cid: str, msg) -> bool:
 
 
 # ===========================================================================
+# Backend base — shared pull bookkeeping
+# ===========================================================================
+class PGBackendBase:
+    """The pull-tracking protocol both backends share (reference
+    ``PGBackend``): one in-flight pull per object, identified by a
+    monotonically increasing per-PG pull tid that ``on_change``
+    invalidates wholesale (``_pulls.clear()`` on interval change)."""
+
+    pg: PG
+
+    def _alloc_pull(self, oid: str) -> int | None:
+        """Register a pull intent for ``oid``; None when a pull for it
+        is already in flight (the single dedup point both recovery
+        paths and the scrub donor-pull go through)."""
+        pg = self.pg
+        if any(oid == o for o in pg._pulls.values()):
+            return None
+        pg._pull_tid += 1
+        pg._pulls[pg._pull_tid] = oid
+        return pg._pull_tid
+
+    def _send_pull(self, peer: int, oid: str) -> int | None:
+        """Allocate a pull tid and request ``oid`` from ``peer``."""
+        pg = self.pg
+        tid = self._alloc_pull(oid)
+        if tid is None:
+            return None
+        pg.daemon.send_to_osd(peer, M.MOSDPGPull(
+            pgid=str(pg.pgid), epoch=pg.daemon.osdmap.epoch, oid=oid,
+            from_osd=pg.daemon.whoami, pull_tid=tid))
+        return tid
+
+
+# ===========================================================================
 # Replicated backend
 # ===========================================================================
-class ReplicatedBackend:
+class ReplicatedBackend(PGBackendBase):
     """Primary-copy replication (reference ReplicatedBackend)."""
 
     def __init__(self, pg: PG):
@@ -1789,14 +1823,8 @@ class ReplicatedBackend:
                     # (recover_primary_object would pick any peer,
                     # including another inconsistent one)
                     donor = next((o for o in good if o != me), None)
-                    if donor is not None and not any(
-                            oid == o for o in pg._pulls.values()):
-                        pg._pull_tid += 1
-                        pg._pulls[pg._pull_tid] = oid
-                        pg.daemon.send_to_osd(donor, M.MOSDPGPull(
-                            pgid=str(pg.pgid),
-                            epoch=pg.daemon.osdmap.epoch, oid=oid,
-                            from_osd=me, pull_tid=pg._pull_tid))
+                    if donor is not None:
+                        self._send_pull(donor, oid)
                 else:
                     pg.peer_missing.setdefault(osd, {})[oid] = ver
             if shard_report:
@@ -1902,18 +1930,11 @@ class ReplicatedBackend:
 
     def recover_primary_object(self, oid: str, version: tuple):
         """Pull from any peer whose info covers the version."""
-        pg, daemon = self.pg, self.pg.daemon
-        if any(oid == o for o in pg._pulls.values()):
-            return
-        for o, pi in pg.peer_info.items():
-            if pi.last_update >= version:
-                pg._pull_tid += 1
-                pg._pulls[pg._pull_tid] = oid
-                daemon.send_to_osd(o, M.MOSDPGPull(
-                    pgid=str(pg.pgid), epoch=daemon.osdmap.epoch,
-                    oid=oid, from_osd=daemon.whoami,
-                    pull_tid=pg._pull_tid))
-                return
+        pg = self.pg
+        donor = next((o for o, pi in pg.peer_info.items()
+                      if pi.last_update >= version), None)
+        if donor is not None:
+            self._send_pull(donor, oid)
 
     def answer_pull(self, msg: M.MOSDPGPull):
         pg, daemon = self.pg, self.pg.daemon
@@ -1974,7 +1995,7 @@ class ReplicatedBackend:
 # ===========================================================================
 # EC backend
 # ===========================================================================
-class ECBackend:
+class ECBackend(PGBackendBase):
     """Erasure-coded I/O (reference ECBackend): full-object writes are
     encoded into k+m shard chunks on the TPU engine; reads gather
     ``minimum_to_decode`` shards and decode (straight concat when the
@@ -2793,7 +2814,64 @@ class ECBackend:
         del self._reads[tid]
         chunks = {s: np.frombuffer(c, dtype=np.uint8)
                   for s, c in st["chunks"].items()}
-        decoded = self.engine.decode(st["want"], chunks)
+        self._submit_decode(st, chunks)
+
+    def _submit_decode(self, st: dict, chunks: dict):
+        """The decode half of a gathered read, split submit/completion
+        through the batch engine's reconstruct lane (mirroring the
+        write path's ``_finish_apply`` split): degraded client reads,
+        ``recover_primary_object`` reconstructs, and backfill/repair
+        pushes — from every PG on this OSD — coalesce into fused
+        per-(code, erasure-pattern, size-bucket) megabatch launches.
+        With the lane disabled or ``recon_flush_ms=0`` the completion
+        fires synchronously before submit returns, preserving the old
+        one-decode-at-a-time semantics exactly."""
+        pg = self.pg
+        daemon = pg.daemon
+        epoch = pg.interval_epoch
+        span = daemon.tracer.start_span(
+            "gf_decode", tags={
+                "layer": "device", "kernel": "gf_decode",
+                "pgid": str(pg.pgid), "shards": len(chunks),
+                "want": len(st["want"])})
+
+        def _decoded(comp):
+            with daemon.lock:
+                if span is not None:
+                    if comp.info:
+                        span.set_tag("batch_rows",
+                                     comp.info.get("rows"))
+                        span.set_tag("batch_members",
+                                     comp.info.get("members"))
+                    span.finish()
+                if pg.interval_epoch != epoch:
+                    # the interval changed while the decode was in
+                    # flight: on_change already reset the read/pull
+                    # world this completion would touch — drop it
+                    # (clients resend, recovery re-peers)
+                    if st.get("on_fail") is not None:
+                        st["on_fail"]()
+                    return
+                if comp.error is not None:
+                    if st.get("on_fail") is not None:
+                        st["on_fail"]()
+                    if st["msg"] is not None and \
+                            st.get("on_chunks") is None:
+                        pg._reply(st["msg"], -5,
+                                  f"decode failed: {comp.error!r}")
+                    return
+                self._finish_decoded(st, comp.value)
+
+        with daemon.profiler.bind():
+            daemon.batch_engine.submit_reconstruct(
+                self.engine, chunks, want=st["want"], span=span,
+                callback=_decoded)
+
+    def _finish_decoded(self, st: dict, decoded: dict):
+        """Completion half: assemble the client reply (or hand the
+        decoded chunks to the recovery continuation).  Runs under the
+        daemon lock either way — inline for immediate mode, on the
+        engine's FIFO completion worker for batched mode."""
         if st["on_chunks"] is not None:
             st["on_chunks"](decoded, st.get("meta") or {})
             return
@@ -2847,12 +2925,10 @@ class ECBackend:
 
     def recover_primary_object(self, oid: str, version: tuple):
         pg = self.pg
-        if any(oid == o for o in pg._pulls.values()):
+        pull_tid = self._alloc_pull(oid)
+        if pull_tid is None:
             return
         shard = pg.shard
-        pg._pull_tid += 1
-        pull_tid = pg._pull_tid
-        pg._pulls[pull_tid] = oid
         fake = M.MOSDOp(tid=0, client="recovery", pgid=str(pg.pgid),
                         oid=oid, epoch=pg.daemon.osdmap.epoch,
                         ops=[], flags=0)
@@ -2940,9 +3016,10 @@ class ECBackend:
         through the GF(2^8) matmul engine and compare recomputed
         parity against the stored parity shards; an inconsistent
         stripe whose shards all pass their own hinfo self-check is
-        attributed by single-erasure hypothesis testing
-        (``scrub.engine.isolate_culprit``) and repaired through the
-        same reconstruct path."""
+        attributed by erasure hypothesis testing — singles first,
+        then pairs when the code has parity to spare
+        (``scrub.engine.isolate_culprits``) — and repaired through
+        the same reconstruct path."""
         pg = self.pg
         me = pg.daemon.whoami
         oids = set()
@@ -3018,7 +3095,9 @@ class ECBackend:
         if span is not None:
             span.add_link(getattr(pg, "_scrub_trace", None))
         with pg.daemon.profiler.bind():
-            verdicts = eng.recheck_parity(ec, stripes)
+            verdicts = eng.recheck_parity(
+                ec, stripes,
+                batch=getattr(pg.daemon, "batch_engine", None))
         if span is not None:
             span.set_tag("bytes", eng.parity_bytes - before)
             span.finish()
@@ -3029,26 +3108,27 @@ class ECBackend:
             if not inconsistent:
                 continue
             errors += 1
-            culprit = scrub_engine.isolate_culprit(ec, stripes[oid])
+            culprits = scrub_engine.isolate_culprits(ec, stripes[oid])
             osd_by_shard = {s: o for o, s in shard_of.items()}
             shard_report: dict[tuple, dict] = {}
-            if culprit is None:
+            kinds = ["parity_mismatch"]
+            if not culprits:
                 # detected but unattributable (m=1 has no
-                # discriminating redundancy): report only
+                # discriminating redundancy; ambiguous multi-shard
+                # evidence must not pick scapegoats): report only
                 for osd, s in shard_of.items():
                     shard_report[osd, s] = {
                         "errors": ["parity_mismatch"]}
-                kinds = ["parity_mismatch"]
             else:
-                osd = osd_by_shard[culprit]
-                shard_report[osd, culprit] = {
-                    "errors": ["parity_mismatch"]}
-                kinds = ["parity_mismatch"]
                 ver = versions[oid]
-                if osd == me:
-                    pg.missing[oid] = ver
-                else:
-                    pg.peer_missing.setdefault(osd, {})[oid] = ver
+                for culprit in culprits:
+                    osd = osd_by_shard[culprit]
+                    shard_report[osd, culprit] = {
+                        "errors": ["parity_mismatch"]}
+                    if osd == me:
+                        pg.missing[oid] = ver
+                    else:
+                        pg.peer_missing.setdefault(osd, {})[oid] = ver
             report.append(scrub_engine.inconsistent_entry(
                 oid, kinds, shard_report))
         return errors

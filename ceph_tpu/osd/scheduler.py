@@ -83,6 +83,18 @@ class WeightedPriorityQueue:
                 if not self._cv.wait(timeout):
                     return None
 
+    def account(self, klass: str, cost: float = 1.0):
+        """Charge out-of-band work to a class (the batch engine's
+        reconstruct-lane flushes bypass the queue — the device work
+        already happened — but must still debit the class's fair
+        share so subsequent queued work of that class defers)."""
+        with self._cv:
+            if klass not in self._credit:
+                self._queues.setdefault(klass, collections.deque())
+                self.weights.setdefault(klass, 1)
+                self._credit[klass] = 0.0
+            self._credit[klass] -= float(cost)
+
     def close(self):
         with self._cv:
             self._closed = True
@@ -263,6 +275,31 @@ class MClockScheduler:
                 waits = [w - now for w in (item_or_wake, deadline)
                          if w is not None and w < _INF]
                 self._cv.wait(min(waits) if waits else None)
+
+    def account(self, klass: str, cost: float = 1.0):
+        """Charge ``cost`` completed-elsewhere ops to a class's QoS
+        streams (reference: dmclock's delta/rho feedback, here fed by
+        the batch engine's reconstruct-lane flushes).  The class limit
+        tag and the anonymous stream's reservation/proportional tags
+        advance by cost/rate, so NEW arrivals of that class space out
+        as if the lane's megabatch had been served from the queue —
+        already-queued items keep the tags they got at enqueue."""
+        with self._cv:
+            if klass == PEERING or cost <= 0:
+                return
+            now = self.clock()
+            res, wgt, lim = self.profiles.get(klass, _MCLOCK_FALLBACK)
+            if lim > 0:
+                pl = self._lim_prev.get(klass, -_INF)
+                self._lim_prev[klass] = max(now, pl) + cost / lim
+            key = (klass, None)
+            pr, pp = self._prev.get(key, (-_INF, -_INF))
+            if res > 0:
+                pr = max(now, pr) + cost / res
+            pp = max(now, pp) + cost / max(wgt, 1e-9)
+            self._prev[key] = (pr, pp)
+            self._last_seen[key] = now
+            self._cv.notify_all()
 
     def reload_profiles(self, profiles: dict[str, tuple[float, float,
                                                         float]]):

@@ -30,10 +30,71 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import rs
+from ..ops.gf import gf_matmul
 from ..utils.jaxcompat import enable_x64, shard_map
 from ..ops.gf_jax import _bit_layout_matrix, gf_matmul_bits
 from ..ops.gf_pallas2 import (_BIT_MASK, _gf_apply_words, block_diag4,
                               _word_operands)
+
+
+class DecodePlan:
+    """Survivor selection + recovery matrices for one erasure pattern.
+
+    One plan answers every question a batched reconstruct needs about
+    a (coding matrix, erasure set) pair:
+
+    - ``survivors``: the first k surviving chunk ids in id order —
+      the exact selection ``ErasureCode::_minimum_to_decode`` makes,
+      so plan-driven decodes are byte-identical to the per-stripe
+      path;
+    - ``dm`` [k, k]: the decode matrix over those survivors;
+    - ``parity_matrix`` [p, k] or None: for the p *erased parity*
+      rows, the GF(2^8) composition ``coding[j] ∘ dm`` — parity
+      straight from survivors, no decode-then-encode round trip
+      (associativity makes the composition byte-exact);
+    - ``matrix`` [k + p, k]: dm and parity_matrix stacked, so one
+      fused matmul yields every recoverable row;
+    - ``row_of``: chunk id → row in that fused output.
+    """
+
+    __slots__ = ("k", "m", "erasures", "survivors", "dm",
+                 "parity_matrix", "matrix", "out_ids", "row_of")
+
+    def __init__(self, coding: np.ndarray, k: int, m: int,
+                 erasures: tuple[int, ...]):
+        coding = np.asarray(coding, dtype=np.uint8)
+        self.k, self.m = k, m
+        self.erasures = tuple(sorted(erasures))
+        self.survivors = tuple(
+            i for i in range(k + m) if i not in self.erasures)[:k]
+        self.dm = rs.decode_matrix(coding, k, list(self.erasures))
+        miss_par = [j for j in range(m) if k + j in self.erasures]
+        if miss_par:
+            self.parity_matrix = gf_matmul(coding[miss_par], self.dm)
+            self.matrix = np.vstack([self.dm, self.parity_matrix])
+        else:
+            self.parity_matrix = None
+            self.matrix = self.dm
+        self.out_ids = tuple(range(k)) + tuple(k + j for j in miss_par)
+        self.row_of = {cid: r for r, cid in enumerate(self.out_ids)}
+
+
+_PLAN_CACHE: dict = {}
+
+
+def decode_plan(coding: np.ndarray, k: int, m: int,
+                erasures, cache: dict | None = None) -> DecodePlan:
+    """Cached :class:`DecodePlan` lookup.  Real clusters see a handful
+    of erasure patterns at a time (reference: ECBackend caches decode
+    tables per want/avail set), so plans persist for a whole recovery
+    sweep — pass ``cache`` to scope the cache to an owner (the batch
+    engine's reconstruct lane), default is process-wide."""
+    key = (coding.tobytes(), k, m, tuple(sorted(erasures)))
+    store = _PLAN_CACHE if cache is None else cache
+    plan = store.get(key)
+    if plan is None:
+        plan = store[key] = DecodePlan(coding, k, m, key[3])
+    return plan
 
 
 class ShardedEC:
@@ -255,6 +316,18 @@ class ShardedEC:
         if ln is not None:
             ln.finish(out=out, bytes_out=getattr(out, "nbytes", 0))
         return out
+
+    def reconstruct_batch(self, groups: dict) -> dict:
+        """Batched multi-pattern entry: ``{erasures: chunks_padded
+        [B, n_pad, C]}`` → ``{erasures: data [B, k, C]}``.
+
+        One shard_map launch per distinct erasure pattern; decode
+        programs come from the per-instance ``_decode_cache``, so a
+        recovery sweep that mixes patterns (different failed shards
+        across PGs) compiles each pattern once and then replays
+        executables.  Results stay on device (callers fence)."""
+        return {tuple(sorted(er)): self.reconstruct(cp, er)
+                for er, cp in groups.items()}
 
     def assemble_chunks(self, data_padded, parity) -> jnp.ndarray:
         """Lay out the [B, n_pad, C] chunk array `_decode_fn` expects:

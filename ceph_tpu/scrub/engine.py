@@ -141,7 +141,7 @@ class ScrubEngine:
 
     # ------------------------------------------------- parity recheck
 
-    def recheck_parity(self, ec, stripes: dict) -> dict:
+    def recheck_parity(self, ec, stripes: dict, batch=None) -> dict:
         """{oid: {shard_index: uint8 chunk}} → {oid: inconsistent bool}.
 
         `ec` is an ``ErasureCodeInterface`` plugin (k data + m parity
@@ -149,7 +149,18 @@ class ScrubEngine:
         carry all k+m equal-length shards.  Re-encodes data shards in
         per-chunk-size batches and byte-compares recomputed parity
         against the stored parity shards.
+
+        ``batch`` (a ``BatchEngine``) routes the re-encodes through
+        the engine's reconstruct lane instead of launching standalone,
+        so scrub rechecks coalesce with in-flight recovery
+        reconstructs; any lane failure falls back wholesale to the
+        standalone path below (identical results either way).
         """
+        if (batch is not None and getattr(batch, "enabled", False)
+                and getattr(batch, "recon_enabled", False)):
+            out = self._recheck_batched(ec, stripes, batch)
+            if out is not None:
+                return out
         k, m = ec.k, ec.m
         by_size: dict[int, list] = {}
         for oid, shards in stripes.items():
@@ -186,6 +197,39 @@ class ScrubEngine:
                 out[oid] = not np.array_equal(par, stored)
         return out
 
+    def _recheck_batched(self, ec, stripes: dict, batch) -> dict | None:
+        """Submit every stripe's re-encode to the reconstruct lane and
+        flush it synchronously (inline completion on this thread — the
+        scrub may hold the daemon lock, so it must not wait behind the
+        engine's completion worker).  Returns None to signal wholesale
+        fallback to the standalone path."""
+        k, m = ec.k, ec.m
+        comps = {}
+        added = 0
+        try:
+            for oid, shards in stripes.items():
+                data = np.stack([
+                    np.frombuffer(memoryview(shards[i]), np.uint8)
+                    for i in range(k)])
+                added += data.size
+                self.parity_bytes += data.size
+                comps[oid] = batch.submit_recheck(ec, data)
+            batch.flush_sync("recon", reason="scrub")
+            out: dict = {}
+            for oid, comp in comps.items():
+                par = np.asarray(comp.result(timeout=60.0))
+                shards = stripes[oid]
+                stored = np.stack([
+                    np.frombuffer(memoryview(shards[k + j]), np.uint8)
+                    for j in range(m)])
+                out[oid] = not np.array_equal(par, stored)
+            return out
+        except Exception:       # noqa: BLE001 — lane unusable for
+            # this code/engine combination: undo the provisional byte
+            # accounting and let the standalone path redo everything
+            self.parity_bytes -= added
+            return None
+
 
 def isolate_culprit(ec, shards: dict) -> int | None:
     """Given one inconsistent stripe {shard_index: uint8 chunk} with
@@ -219,6 +263,52 @@ def isolate_culprit(ec, shards: dict) -> int | None:
     # only a UNIQUE consistent hypothesis is an attribution (with m=1
     # every hypothesis passes; ambiguity must not pick a scapegoat)
     return candidates[0] if len(candidates) == 1 else None
+
+
+def isolate_culprits(ec, shards: dict,
+                     max_erasures: int = 2) -> tuple[int, ...]:
+    """Multi-shard culprit attribution for one inconsistent stripe
+    with all k+m shards present: try single-erasure hypotheses first
+    (:func:`isolate_culprit`), then search erasure PAIRS when no
+    single shard explains the mismatch and the code has parity to
+    spare.  Returns the attributed shard indices, or ``()`` when the
+    stripe is unattributable or the evidence is ambiguous.
+
+    Pair attribution needs m >= 3 in general: decoding a pair from
+    the n-2 survivors leaves m-2 surviving parity rows *beyond* the
+    decode basis as witnesses, and with m=2 there are none — every
+    pair hypothesis re-satisfies the code, so all pairs tie and ()
+    is returned (ambiguity must not pick scapegoats)."""
+    import itertools
+
+    k, m = ec.k, ec.m
+    n = k + m
+    single = isolate_culprit(ec, shards)
+    if single is not None:
+        return (single,)
+    if m < 2 or max_erasures < 2:
+        return ()
+    arrs = {i: np.frombuffer(memoryview(shards[i]), np.uint8)
+            for i in range(n)}
+    candidates = []
+    for pair in itertools.combinations(range(n), 2):
+        survivors = {i: arrs[i] for i in range(n) if i not in pair}
+        try:
+            rebuilt = ec.decode(set(pair), survivors)
+        except Exception:       # noqa: BLE001 — undecodable pattern
+            continue
+        if all(np.array_equal(np.asarray(rebuilt[c]), arrs[c])
+               for c in pair):
+            continue            # hypothesis changes nothing — not it
+        fixed = dict(arrs)
+        for c in pair:
+            fixed[c] = np.asarray(rebuilt[c], dtype=np.uint8)
+        parity = np.asarray(ec._encode_chunks(
+            np.stack([fixed[i] for i in range(k)])))
+        if all(np.array_equal(parity[j], fixed[k + j])
+               for j in range(m)):
+            candidates.append(pair)
+    return tuple(candidates[0]) if len(candidates) == 1 else ()
 
 
 def inconsistent_entry(oid: str, errors: list[str],
