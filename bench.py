@@ -1214,6 +1214,122 @@ def _recovery_cluster_part():
         c.stop()
 
 
+def _efficiency_leg(on_tpu: bool):
+    """Storage-efficiency lanes: a write mix pushed through one
+    BatchEngine's compression lane (device-batched RLE + entropy
+    model) and the dedup fingerprint lane (gear-hash content-defined
+    chunking) — the two on-device stages of ``ceph_tpu/compress``.
+    The headline numbers:
+
+    - compress_effective_GBps — logical bytes sealed / wall with the
+      lane's deadline batching on;
+    - compression_ratio — lane bytes_in / bytes_out on the mix
+      (asserted > 1.5x: the mix is mostly run-structured payloads
+      with an incompressible tail that must pass through);
+    - dedup_ratio — referenced / unique chunk bytes over a duplicated
+      stream (asserted > 2x at 4 copies per block);
+    - bit-identity asserted in-leg: every sealed blob decompresses to
+      its exact payload, every pass-through IS its payload, and a
+      sample replayed through a disabled engine matches."""
+    import numpy as np
+    from ceph_tpu.compress.chunker import Chunker, fingerprint
+    from ceph_tpu.compress.registry import create_codec
+    from ceph_tpu.osd.batch_engine import BatchEngine
+
+    rng = np.random.default_rng(19)
+    codec = create_codec("rle")
+    size = (1 << 20) if on_tpu else (256 << 10)
+    nobj = 64 if on_tpu else 24
+    payloads = []
+    for i in range(nobj):
+        if i % 8 == 7:      # incompressible tail: must pass through
+            payloads.append(
+                rng.integers(0, 256, size, np.uint8).tobytes())
+        else:               # run-structured (device logs, zero pages)
+            run = int(rng.integers(16, 128))
+            vals = rng.integers(0, 256, size // run + 1, np.uint8)
+            payloads.append(
+                np.repeat(vals, run)[:size].tobytes())
+
+    eng = BatchEngine("eff", flush_ms=2.0, max_ops=64,
+                      max_bytes=64 << 20)
+    eng.submit_compress(codec, payloads[0])         # warm the bucket
+    eng.drain()
+    for key in list(eng.stats):
+        eng.stats[key] = 0
+
+    t0 = time.monotonic()
+    comps = [eng.submit_compress(codec, p) for p in payloads]
+    eng.drain()
+    wall = time.monotonic() - t0
+    assert all(c.done() and c.error is None for c in comps), \
+        "compress op failed"
+    passthrough = 0
+    for c, p in zip(comps, payloads):
+        blob, hdr = c.result()
+        if hdr is None:
+            passthrough += 1
+            assert bytes(blob) == p, "pass-through mutated payload"
+        else:
+            assert eng.decompress(blob, hdr) == p, \
+                "compression round-trip diverged"
+    assert passthrough >= nobj // 8, \
+        "incompressible payloads did not pass through"
+    ratio = (eng.stats["comp_bytes_in"]
+             / max(1, eng.stats["comp_bytes_out"]))
+    assert ratio > 1.5, f"compression ratio {ratio:.2f} <= 1.5"
+    sustained = sum(len(p) for p in payloads) / wall / 1e9
+
+    # engine-off bit-identity: same codec path, no batching
+    off = BatchEngine("eff-off", enabled=False)
+    for j in (0, 7, nobj - 1):
+        assert comps[j].result() == \
+            off.submit_compress(codec, payloads[j]).result(), \
+            "batched compress result diverged"
+
+    # dedup fingerprint lane: 4 copies of each base block, shuffled —
+    # the CDC chunker must converge on identical fingerprints for the
+    # identical content regardless of order.  Blocks are many chunks
+    # long so seam-spanning chunks (which legitimately differ per
+    # neighbor) stay a small fraction of the stream.
+    chunker = Chunker(avg_size=4096)
+    blocks = [rng.integers(0, 256, 64 << 10, np.uint8).tobytes()
+              for _ in range(8 if on_tpu else 4)]
+    order = list(range(len(blocks))) * 4
+    rng.shuffle(order)
+    stream = b"".join(blocks[i] for i in order)
+    t0 = time.monotonic()
+    fpc = eng.submit_fingerprint(chunker, stream)
+    eng.drain()
+    fp_wall = time.monotonic() - t0
+    spans = fpc.result()
+    referenced = sum(ln for _off, ln, _fp in spans)
+    assert referenced == len(stream), "chunk spans do not tile"
+    uniq = {}
+    for _off, ln, fp in spans:
+        uniq.setdefault(fp, ln)
+    dedup_ratio = referenced / max(1, sum(uniq.values()))
+    assert dedup_ratio > 2.0, f"dedup ratio {dedup_ratio:.2f} <= 2"
+    # fingerprint ground truth on one span
+    off0, ln0, fp0 = spans[0]
+    assert fingerprint(stream[off0:off0 + ln0]) == fp0, \
+        "lane fingerprint mismatch"
+    eng.stop()
+    off.stop()
+    return {
+        "compress_effective_GBps": round(sustained, 3),
+        "compression_ratio": round(ratio, 2),
+        "objects": nobj,
+        "passthrough": passthrough,
+        "comp_launches": eng.stats.get("comp_launches", 0),
+        "dedup_ratio": round(dedup_ratio, 2),
+        "dedup_unique_chunks": len(uniq),
+        "dedup_referenced_bytes": referenced,
+        "fingerprint_MBps": round(len(stream) / fp_wall / 1e6, 1),
+        "bit_identical": True,
+    }
+
+
 def _crush_leg():
     """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
     (BASELINE.md row 4, scaled to fit a bench-run budget)."""
@@ -1348,7 +1464,8 @@ def child_main():
             out["dataplane"] = {"error": str(e)[:200]}
     else:
         out["dataplane"] = {"skipped": "wall budget exhausted"}
-    print(json.dumps(dict(out, recovery={"skipped": "timeout"})),
+    print(json.dumps(dict(out, recovery={"skipped": "timeout"},
+                          efficiency={"skipped": "timeout"})),
           flush=True)
     # recovery lane: a degraded sweep through the reconstruct lane
     if _budget_left() > 0.03:
@@ -1358,6 +1475,16 @@ def child_main():
             out["recovery"] = {"error": str(e)[:200]}
     else:
         out["recovery"] = {"skipped": "wall budget exhausted"}
+    print(json.dumps(dict(out, efficiency={"skipped": "timeout"})),
+          flush=True)
+    # storage-efficiency lanes: compression + fingerprint micro leg
+    if _budget_left() > 0.02:
+        try:
+            out["efficiency"] = _efficiency_leg(on_tpu)
+        except Exception as e:    # noqa: BLE001 — keep the headline
+            out["efficiency"] = {"error": str(e)[:200]}
+    else:
+        out["efficiency"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(out))
     try:
         dev = jax.devices()[0].device_kind

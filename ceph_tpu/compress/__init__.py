@@ -1,0 +1,19 @@
+"""Storage-efficiency subsystem — inline compression + dedup lanes.
+
+ROADMAP item 4: the pluggable codec registry (``registry``), the
+device-batched RLE+entropy hybrid codec (``codec``), gear-hash
+content-defined chunking with batched CRC fingerprints (``chunker``),
+and the os_store refcount conventions for the dedup index (``dedup``).
+The batch engine's compression/fingerprint lanes
+(``osd.batch_engine``) and the pool options (``compression_mode``,
+``compression_algorithm``, ``dedup_enable``) are the consumers.
+"""
+
+from .codec import Codec, CodecError
+from .registry import create_codec, list_codecs, register_codec
+from .chunker import Chunker, fingerprint, fingerprints_batch
+from . import dedup
+
+__all__ = ["Codec", "CodecError", "create_codec", "list_codecs",
+           "register_codec", "Chunker", "fingerprint",
+           "fingerprints_batch", "dedup"]

@@ -1,0 +1,171 @@
+"""Content-defined chunking + batched fingerprints (the dedup front).
+
+The reference's RGW dedup and the CDC literature cut chunk boundaries
+where a rolling hash of the trailing window hits a mask — so identical
+content yields identical chunks regardless of byte offset.  We use the
+*gear* hash: ``h_i = Σ_{j<W} GEAR[x_{i-j}] << j`` — unlike the
+recurrence form ``h = (h<<1) + GEAR[b]`` it has **no sequential
+dependency**, so the whole ``[rows, length]`` megabatch evaluates as
+W shifted adds in one jitted launch (the "rolling-hash boundaries as
+a jitted scan" of ROADMAP item 4).  The two forms are identical
+because the recurrence telescopes: after W steps the shifted-out bits
+of older terms have left the 32-bit window.
+
+Boundary candidates are positions where ``h & (avg-1) == 0``; the
+host pass enforces min/max chunk bounds on the (sparse) candidate
+list.  Fingerprints are two independent CRC polynomials + the length
+— CRC-32C through the ``scrub.crc32c_jax`` bit-matrix batch kernel
+(one launch digests every chunk of a flush, pow2-padded and corrected
+with ``crc32c_zero_unpad``) and host CRC-32 (zlib) as the second
+opinion.  A collision needs simultaneous 64-bit agreement at equal
+length; corruption from a false dedup hit additionally requires the
+lengths to match.  This is the standard fingerprint-trust tradeoff —
+documented here rather than hidden.
+"""
+
+from __future__ import annotations
+
+import functools
+import zlib
+
+import numpy as np
+
+from ..scrub.crc32c_jax import crc32c, _batch_kernel, crc32c_zero_unpad
+
+_WINDOW = 32
+# deterministic gear table: chunk boundaries must agree across every
+# OSD and every process lifetime, or dedup silently stops matching
+_GEAR = np.random.default_rng(0x43455048).integers(
+    0, 1 << 32, size=256, dtype=np.uint32)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+@functools.lru_cache(maxsize=None)
+def _gear_kernel(length: int):
+    """[rows, length] uint8 → uint32 gear hashes, one fused launch of
+    W=32 shifted adds (cached per pow2 bucket length)."""
+    import jax
+    import jax.numpy as jnp
+
+    gear = np.asarray(_GEAR)
+
+    @jax.jit
+    def kern(batch):
+        g = jnp.asarray(gear)[batch.astype(jnp.int32)]
+        padded = jnp.pad(g, ((0, 0), (_WINDOW - 1, 0)))
+        acc = jnp.zeros_like(g)
+        for j in range(_WINDOW):
+            acc = acc + (padded[:, _WINDOW - 1 - j:
+                                _WINDOW - 1 - j + length]
+                         << jnp.uint32(j))
+        return acc
+
+    return kern
+
+
+def gear_hashes_host(row: np.ndarray) -> np.ndarray:
+    """Host mirror of ``_gear_kernel`` for one row — the bit-identity
+    reference for the unbatched path and the tests."""
+    g = _GEAR[row.astype(np.intp)]
+    padded = np.pad(g, (_WINDOW - 1, 0))
+    acc = np.zeros(len(row), dtype=np.uint32)
+    for j in range(_WINDOW):
+        acc += padded[_WINDOW - 1 - j:
+                      _WINDOW - 1 - j + len(row)] << np.uint32(j)
+    return acc
+
+
+def fingerprint(chunk: bytes) -> str:
+    """24 hex chars: crc32c ‖ crc32 ‖ length (host reference)."""
+    chunk = bytes(chunk)
+    return (f"{crc32c(chunk):08x}"
+            f"{zlib.crc32(chunk) & 0xFFFFFFFF:08x}"
+            f"{len(chunk):08x}")
+
+
+class Chunker:
+    """CDC parameters + the boundary/fingerprint passes.
+
+    ``avg_size`` must be a power of two (it becomes the hash mask);
+    chunks are clamped to ``[min_size, max_size]`` with a forced cut
+    at ``max_size`` — forced cuts are the only content-independent
+    boundaries, the standard CDC escape hatch for pathological data.
+    """
+
+    def __init__(self, avg_size: int = 4096, min_size: int | None = None,
+                 max_size: int | None = None):
+        self.avg = _next_pow2(max(int(avg_size), 64))
+        self.min = int(min_size) if min_size else max(self.avg // 4, 64)
+        self.max = int(max_size) if max_size else self.avg * 4
+        if not self.min <= self.avg <= self.max:
+            raise ValueError("need min <= avg <= max chunk size")
+        self.mask = np.uint32(self.avg - 1)
+
+    def key(self) -> tuple:
+        """Engine group key: one launch shape family per parameter set."""
+        return ("cdc", self.avg, self.min, self.max)
+
+    def hash_batch(self, batch: np.ndarray):
+        """Device gear hashes for a padded megabatch."""
+        return _gear_kernel(batch.shape[1])(batch)
+
+    def cuts_from_hashes(self, hashes: np.ndarray,
+                         length: int) -> list[int]:
+        """Exclusive chunk end offsets from a (possibly padded) hash
+        row; deterministic given the bytes alone."""
+        if length == 0:
+            return []
+        h = np.asarray(hashes[:length])
+        cand = np.flatnonzero((h & self.mask) == 0) + 1
+        cuts: list[int] = []
+        last = 0
+        for c in cand:
+            c = int(c)
+            while c - last > self.max:
+                last += self.max
+                cuts.append(last)
+            if c - last >= self.min and c < length:
+                cuts.append(c)
+                last = c
+        while length - last > self.max:
+            last += self.max
+            cuts.append(last)
+        cuts.append(length)
+        return cuts
+
+    def chunks(self, data: bytes) -> list[tuple[int, int]]:
+        """(offset, length) spans for ``data`` — host path."""
+        row = np.frombuffer(bytes(data), dtype=np.uint8)
+        cuts = self.cuts_from_hashes(gear_hashes_host(row), len(row))
+        out = []
+        last = 0
+        for c in cuts:
+            out.append((last, c - last))
+            last = c
+        return out
+
+
+def fingerprints_batch(chunks: list[bytes]) -> list[str]:
+    """Digest many chunks in one CRC-32C launch: stack pow2-padded,
+    run the bit-matrix batch kernel, strip each row's zero pad with
+    the GF(2) unpad algebra — identical to host ``fingerprint`` per
+    chunk, asserted in tests."""
+    if not chunks:
+        return []
+    import jax.numpy as jnp
+    bucket = _next_pow2(max(max(len(c) for c in chunks), 32))
+    rows = len(chunks)
+    batch = np.zeros((rows, bucket), dtype=np.uint8)
+    for i, c in enumerate(chunks):
+        batch[i, :len(c)] = np.frombuffer(c, dtype=np.uint8)
+    crcs = np.asarray(_batch_kernel(bucket)(
+        jnp.asarray(batch), jnp.zeros(rows, jnp.uint32)))
+    out = []
+    for i, c in enumerate(chunks):
+        crc = crc32c_zero_unpad(int(crcs[i]), bucket - len(c))
+        out.append(f"{crc:08x}{zlib.crc32(c) & 0xFFFFFFFF:08x}"
+                   f"{len(c):08x}")
+    return out

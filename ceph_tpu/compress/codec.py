@@ -1,0 +1,213 @@
+"""Device-batched compression codecs.
+
+The reference ships BlueStore inline compression behind a compressor
+plugin interface (``src/compressor/Compressor.h``: zlib/snappy/lz4/
+zstd selected per pool via ``compression_algorithm``).  This module is
+the same seam with a codec family that fits the repo's device idiom:
+the expensive full-payload *scan* runs as a jitted kernel over a
+size-bucketed ``[rows, length]`` uint8 megabatch (one launch for a
+whole batch-engine flush), and only the compact run descriptors are
+finalized on the host.
+
+``rle`` — the built-in LZ-class hybrid — is run-length coding with an
+entropy second stage: the device scan marks run boundaries
+(``x[i] != x[i-1]``, a single vectorized compare across the whole
+megabatch), the host compacts them into ``(count, byte)`` pairs with
+pure numpy (``flatnonzero``/``diff``/``repeat`` — no per-byte Python),
+and when the run alphabet fits in 16 symbols the pairs are re-coded as
+a nibble-packed dictionary stream (the entropy stage; worth ~25% on
+top of RLE for low-entropy payloads).  Decompression is a single
+``np.repeat`` gather — exact, and cheap enough to stay on the host.
+
+Round trips are bit-identical by construction and asserted in
+tests/test_compress.py on empty/tiny/incompressible/oversized corpora;
+callers (the batch engine's compression lane) fall back to
+pass-through storage when a blob does not shrink.
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+import zlib
+
+import numpy as np
+
+
+class CodecError(Exception):
+    pass
+
+
+_MODE_RLE8 = 1      # (count u8, byte u8) pairs
+_MODE_RLE4 = 2      # nibble-packed dictionary symbols + count stream
+
+
+class Codec:
+    """One compression algorithm (reference ``Compressor``).
+
+    ``compress``/``decompress`` are the host reference semantics;
+    codecs that can batch expose ``scan_batch`` (a jitted device pass
+    over a padded ``[rows, length]`` uint8 megabatch) plus
+    ``compress_from_scan`` to finalize one member from the scan
+    output — **bit-identical** to ``compress`` by construction.
+    """
+
+    name = "?"
+
+    def compress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, blob: bytes, out_len: int) -> bytes:
+        raise NotImplementedError
+
+    # device-batched entry points (None ⇒ host-only codec: the lane
+    # still coalesces accounting but finalizes each member on host)
+    scan_batch = None
+
+    def compress_from_scan(self, row: np.ndarray, length: int,
+                           scan_row: np.ndarray) -> bytes:
+        raise NotImplementedError
+
+
+class PassthroughCodec(Codec):
+    """``none``: stores bytes verbatim (the pool-mode-off reference)."""
+
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        return bytes(data)
+
+    def decompress(self, blob: bytes, out_len: int) -> bytes:
+        if len(blob) != out_len:
+            raise CodecError(f"passthrough length {len(blob)} != "
+                             f"{out_len}")
+        return bytes(blob)
+
+
+@functools.lru_cache(maxsize=None)
+def _boundary_kernel(length: int):
+    """[rows, length] uint8 → bool run-start mask, one fused launch.
+
+    Cached per bucket length like ``crc32c_jax._batch_kernel`` so the
+    jit cache stays bounded by the engine's pow2 size buckets.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kern(batch):
+        cur = batch.astype(jnp.int16)
+        prev = jnp.concatenate(
+            [jnp.full((batch.shape[0], 1), -1, jnp.int16),
+             cur[:, :-1]], axis=1)
+        return cur != prev
+
+    return kern
+
+
+def _run_starts_host(row: np.ndarray) -> np.ndarray:
+    """Host mirror of ``_boundary_kernel`` for one row — the
+    bit-identity reference for the unbatched path."""
+    mask = np.empty(len(row), dtype=bool)
+    if len(row):
+        mask[0] = True
+        np.not_equal(row[1:], row[:-1], out=mask[1:])
+    return mask
+
+
+class RleCodec(Codec):
+    """RLE + nibble-dictionary entropy hybrid (the ``rle`` builtin)."""
+
+    name = "rle"
+
+    @property
+    def scan_batch(self):
+        return self._scan_batch
+
+    @staticmethod
+    def _scan_batch(batch: np.ndarray):
+        return _boundary_kernel(batch.shape[1])(batch)
+
+    def compress(self, data: bytes) -> bytes:
+        row = np.frombuffer(bytes(data), dtype=np.uint8)
+        return self.compress_from_scan(row, len(row),
+                                       _run_starts_host(row))
+
+    def compress_from_scan(self, row: np.ndarray, length: int,
+                           scan_row: np.ndarray) -> bytes:
+        if length == 0:
+            return bytes([_MODE_RLE8])
+        starts = np.flatnonzero(np.asarray(scan_row[:length]))
+        lens = np.diff(np.append(starts, length))
+        syms = row[starts]
+        # runs longer than 255 split into u8-countable pieces; the
+        # count stream stays fixed-width so decode is one reshape
+        pieces = (lens + 254) // 255
+        total = int(pieces.sum())
+        out_syms = np.repeat(syms, pieces)
+        counts = np.full(total, 255, dtype=np.int64)
+        counts[np.cumsum(pieces) - 1] = lens - (pieces - 1) * 255
+        counts = counts.astype(np.uint8)
+        pairs = np.empty((total, 2), dtype=np.uint8)
+        pairs[:, 0] = counts
+        pairs[:, 1] = out_syms
+        rle8 = bytes([_MODE_RLE8]) + pairs.tobytes()
+        alphabet = np.unique(out_syms)
+        if len(alphabet) > 16:
+            return rle8
+        # entropy stage: symbols become 4-bit dictionary indices
+        idx = np.searchsorted(alphabet, out_syms).astype(np.uint8)
+        if total % 2:
+            idx = np.append(idx, np.uint8(0))
+        packed = (idx[0::2] << 4) | idx[1::2]
+        rle4 = (bytes([_MODE_RLE4, len(alphabet)]) + alphabet.tobytes()
+                + struct.pack("<I", total) + packed.tobytes()
+                + counts.tobytes())
+        return rle4 if len(rle4) < len(rle8) else rle8
+
+    def decompress(self, blob: bytes, out_len: int) -> bytes:
+        if not blob:
+            raise CodecError("empty rle blob")
+        mode = blob[0]
+        if mode == _MODE_RLE8:
+            pairs = np.frombuffer(blob, dtype=np.uint8, offset=1)
+            if len(pairs) % 2:
+                raise CodecError("truncated rle8 stream")
+            pairs = pairs.reshape(-1, 2)
+            out = np.repeat(pairs[:, 1], pairs[:, 0])
+        elif mode == _MODE_RLE4:
+            nsym = blob[1]
+            alphabet = np.frombuffer(blob, np.uint8, nsym, offset=2)
+            (total,) = struct.unpack_from("<I", blob, 2 + nsym)
+            off = 6 + nsym
+            npack = (total + 1) // 2
+            packed = np.frombuffer(blob, np.uint8, npack, offset=off)
+            counts = np.frombuffer(blob, np.uint8, total,
+                                   offset=off + npack)
+            idx = np.empty(npack * 2, dtype=np.uint8)
+            idx[0::2] = packed >> 4
+            idx[1::2] = packed & 0x0F
+            out = np.repeat(alphabet[idx[:total]], counts)
+        else:
+            raise CodecError(f"unknown rle mode {mode}")
+        if len(out) != out_len:
+            raise CodecError(
+                f"rle expanded to {len(out)} bytes, expected {out_len}")
+        return out.tobytes()
+
+
+class ZlibCodec(Codec):
+    """Host reference codec (the upstream default compressor); no
+    device scan — the lane batches its accounting only."""
+
+    name = "zlib"
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(bytes(data), 6)
+
+    def decompress(self, blob: bytes, out_len: int) -> bytes:
+        out = zlib.decompress(bytes(blob))
+        if len(out) != out_len:
+            raise CodecError(
+                f"zlib expanded to {len(out)} bytes, expected {out_len}")
+        return out

@@ -1,0 +1,57 @@
+"""Codec registry — the compressor analog of ``ec/registry.py``.
+
+The reference registers compressor plugins by name
+(``src/compressor/Compressor.cc``: ``Compressor::create`` switches on
+the pool's ``compression_algorithm``).  Codecs register in-process
+here the same way EC plugins do; pool options and the mon's
+``osd pool set`` validation resolve through ``list_codecs``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from .codec import Codec, CodecError
+
+_CODECS: dict[str, Callable[[], Codec]] = {}
+_BUILTINS_LOADED = False
+_LOAD_LOCK = threading.Lock()
+
+
+def register_codec(name: str, factory: Callable[[], Codec]):
+    _CODECS[name] = factory
+
+
+def list_codecs() -> list[str]:
+    _load_builtin()
+    return sorted(_CODECS)
+
+
+def _load_builtin():
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    # same double-checked pattern as ec.registry: many OSD threads hit
+    # their first compress at once — the flag flips only after every
+    # builtin is registered
+    with _LOAD_LOCK:
+        if _BUILTINS_LOADED:
+            return
+        from .codec import PassthroughCodec, RleCodec, ZlibCodec
+        register_codec("none", PassthroughCodec)
+        register_codec("rle", RleCodec)
+        # the device-batched hybrid under its framework name, the way
+        # "jax_tpu" aliases jerasure in the EC registry
+        register_codec("rle_jax", RleCodec)
+        register_codec("zlib", ZlibCodec)
+        _BUILTINS_LOADED = True
+
+
+def create_codec(name: str) -> Codec:
+    _load_builtin()
+    factory = _CODECS.get(name)
+    if factory is None:
+        raise CodecError(f"unknown compression codec {name!r}"
+                         f" (available: {sorted(_CODECS)})")
+    return factory()

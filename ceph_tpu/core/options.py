@@ -146,6 +146,26 @@ def build_options() -> list[Option]:
         Option("osd_recovery_batch_mesh", bool, False,
                "shard reconstruct megabatches over a (dp, shard) "
                "device mesh when more than one device is visible"),
+        Option("osd_compress_batch_enable", bool, True,
+               "coalesce inline compression / fingerprint scans into "
+               "the batch engine's compression lane"),
+        Option("osd_compress_batch_max_bytes", int, 8 << 20,
+               "flush the compression lane at this many pending "
+               "payload bytes", min=1),
+        Option("osd_compress_batch_max_ops", int, 64,
+               "flush the compression lane at this many pending ops",
+               min=1),
+        Option("osd_compress_batch_flush_ms", float, 0.0,
+               "compression-lane accumulation window (ms); 0 = flush "
+               "each submit immediately (the CPU-safe synchronous "
+               "default)", min=0.0),
+        Option("osd_compress_segment_bytes", int, 1 << 20,
+               "payloads above this split into fixed segments that "
+               "batch across objects (streaming compression); 0 = "
+               "never segment", min=0),
+        Option("osd_dedup_chunk_avg", int, 4096,
+               "content-defined chunking target size for dedup "
+               "fingerprint scans (min/max derive from it)", min=64),
         # -- erasure coding ----------------------------------------------
         Option("osd_pool_default_erasure_code_profile", str,
                "plugin=jerasure technique=reed_sol_van k=2 m=2",

@@ -170,6 +170,48 @@ class Exporter:
             emit("ceph_cluster_slow_ops_oldest_age_seconds", worst_age,
                  help_="age of the oldest slow op")
 
+        # storage-efficiency gauges per pool (reference prometheus
+        # module's ceph_pool_* compression family): stored vs logical
+        # bytes and the derived ratios from `df`
+        try:
+            rc, _, df = self.monc.command({"prefix": "df"})
+        except Exception:
+            rc, df = -1, None
+        if rc == 0 and df:
+            first = True
+            for p in df.get("pools") or []:
+                lab = {"name": p.get("name", ""),
+                       "pool_id": str(p.get("id", ""))}
+                emit("ceph_pool_stored_bytes",
+                     p.get("bytes_used", 0), labels=lab,
+                     help_="physical pool bytes (post-compression)"
+                     if first else None)
+                emit("ceph_pool_logical_bytes",
+                     p.get("bytes_logical", 0), labels=lab,
+                     help_="logical pool bytes (client view)"
+                     if first else None)
+                emit("ceph_pool_compress_ratio",
+                     round(float(p.get("compress_ratio", 1.0)), 4),
+                     labels=lab,
+                     help_="logical/stored compression ratio"
+                     if first else None)
+                if "dedup_ratio" in p:
+                    emit("ceph_pool_dedup_ratio",
+                         round(float(p["dedup_ratio"]), 4),
+                         labels=lab,
+                         help_="referenced/stored dedup ratio")
+                first = False
+            ded = df.get("dedup") or {}
+            if ded:
+                emit("ceph_dedup_chunks", ded.get("chunks", 0),
+                     help_="unique dedup chunks stored")
+                emit("ceph_dedup_stored_bytes",
+                     ded.get("stored_bytes", 0),
+                     help_="dedup chunk bytes stored once")
+                emit("ceph_dedup_referenced_bytes",
+                     ded.get("referenced_bytes", 0),
+                     help_="bytes the chunk store logically serves")
+
         # device-plane series from the mgr telemetry spine (profiler
         # aggregates + derived rates the OSDs beacon via osd_stats)
         if self.telemetry is not None:

@@ -139,6 +139,14 @@ class TelemetrySpine(MgrModule):
                 self._lat_count.setdefault(
                     daemon, SeriesRing(self.RING_CAPACITY)).append(
                         now, float(lat.get("count", 0)))
+            comp = st.get("comp")
+            if isinstance(comp, dict):
+                # storage-efficiency lane counters → per-lane byte
+                # rates (compress in/out, decompress, fingerprint)
+                for c in ("bytes_in", "bytes_out",
+                          "decompress_bytes", "fingerprint_bytes"):
+                    self._ring(daemon, f"comp_{c}").append(
+                        now, float(comp.get(c, 0)))
             prof = st.get("profiler")
             if isinstance(prof, dict):
                 self.profiler[daemon] = prof
@@ -170,6 +178,10 @@ class TelemetrySpine(MgrModule):
             "bytes_per_sec": r("op_in_bytes"),
             "launches_per_sec": r("device_launches"),
             "device_bytes_per_sec": r("device_bytes"),
+            "compress_bytes_per_sec": r("comp_bytes_in"),
+            "compressed_bytes_per_sec": r("comp_bytes_out"),
+            "decompress_bytes_per_sec": r("comp_decompress_bytes"),
+            "fingerprint_bytes_per_sec": r("comp_fingerprint_bytes"),
         }
 
     def commit_latency_ms(self, daemon: str) -> float:
@@ -224,14 +236,14 @@ class TelemetrySpine(MgrModule):
         osds = sorted((d for d in self.series if d.startswith("osd.")),
                       key=lambda d: int(d.split(".", 1)[1]))
         per = {d: self.daemon_rates(d) for d in osds}
-        cluster = {k: sum(v[k] for v in per.values())
-                   for k in ("ops_per_sec", "write_ops_per_sec",
-                             "read_ops_per_sec", "bytes_per_sec",
-                             "launches_per_sec",
-                             "device_bytes_per_sec")} if per else {
-            "ops_per_sec": 0.0, "write_ops_per_sec": 0.0,
-            "read_ops_per_sec": 0.0, "bytes_per_sec": 0.0,
-            "launches_per_sec": 0.0, "device_bytes_per_sec": 0.0}
+        keys = ("ops_per_sec", "write_ops_per_sec",
+                "read_ops_per_sec", "bytes_per_sec",
+                "launches_per_sec", "device_bytes_per_sec",
+                "compress_bytes_per_sec", "compressed_bytes_per_sec",
+                "decompress_bytes_per_sec",
+                "fingerprint_bytes_per_sec")
+        cluster = ({k: sum(v[k] for v in per.values()) for k in keys}
+                   if per else {k: 0.0 for k in keys})
         return {"cluster": cluster, "osds": per}
 
     def osd_perf(self) -> dict:

@@ -94,9 +94,10 @@ class PGMap:
                    for st in self.pg_stats.values())
 
     def pool_usage(self, live_pools: set[int]) -> dict[int, list]:
-        """pool id → [objects, bytes], pruned to live pools first so
-        a deleted pool's stale stats can't count against a reused
-        id."""
+        """pool id → [objects, stored_bytes, logical_bytes], pruned
+        to live pools first so a deleted pool's stale stats can't
+        count against a reused id.  stored is PHYSICAL (post
+        compression/dedup); logical is what clients wrote."""
         self.prune(live_pools)
         usage: dict[int, list] = {}
         for pgid_s, st in self.pg_stats.items():
@@ -104,10 +105,23 @@ class PGMap:
                 pid = int(pgid_s.split(".", 1)[0])
             except ValueError:
                 continue
-            row = usage.setdefault(pid, [0, 0])
+            row = usage.setdefault(pid, [0, 0, 0])
             row[0] += int(st.get("num_objects", 0))
             row[1] += int(st.get("num_bytes", 0))
+            row[2] += int(st.get("num_bytes_logical",
+                                 st.get("num_bytes", 0)))
         return usage
+
+    def dedup_totals(self) -> dict:
+        """Cluster-wide dedup index totals summed over osd_stats (the
+        chunk store is per-OSD-global, outside any pool)."""
+        out = {"chunks": 0, "refs": 0, "stored_bytes": 0,
+               "referenced_bytes": 0}
+        for st in self.osd_stats.values():
+            d = st.get("dedup") or {}
+            for k in out:
+                out[k] += int(d.get(k, 0))
+        return out
 
 
 # -- evaluators --------------------------------------------------------------
@@ -571,19 +585,42 @@ class HealthMonitor(PaxosService):
             osdsvc = self.mon.services["osdmap"]
             m = osdsvc.osdmap
             usage = self.mon.pgmap.pool_usage(set(m.pools))
+            dedup = self.mon.pgmap.dedup_totals()
+            dedup_ratio = (dedup["referenced_bytes"]
+                           / dedup["stored_bytes"]
+                           if dedup["stored_bytes"] else 1.0)
             out = {"pools": []}
             for name, pid in sorted(m.pool_name.items()):
                 pool = m.pools.get(pid)
-                row = usage.get(pid, [0, 0])
-                out["pools"].append({
+                row = usage.get(pid, [0, 0, 0])
+                stored, logical = row[1], row[2]
+                prow = {
                     "name": name, "id": pid,
                     "pg_num": pool.pg_num if pool else 0,
                     "objects": row[0],
-                    "bytes_used": row[1]})
+                    # bytes_used stays the PHYSICAL footprint
+                    # (post-compression), mirroring the reference's
+                    # USED vs STORED split in `ceph df detail`
+                    "bytes_used": stored,
+                    "bytes_logical": logical,
+                    "compress_ratio": (logical / stored
+                                       if stored else 1.0)}
+                if pool is not None and getattr(pool, "dedup_enable",
+                                                False):
+                    # the chunk index is store-global, so the per-pool
+                    # ratio is the cluster chunk index's ratio (one
+                    # dedup domain per cluster, like the reference's
+                    # single chunk pool per base pool tier)
+                    prow["dedup_ratio"] = dedup_ratio
+                out["pools"].append(prow)
             out["total_objects"] = sum(p["objects"]
                                        for p in out["pools"])
             out["total_bytes_used"] = sum(p["bytes_used"]
-                                          for p in out["pools"])
+                                          for p in out["pools"]) \
+                + dedup["stored_bytes"]
+            out["total_bytes_logical"] = sum(p["bytes_logical"]
+                                             for p in out["pools"])
+            out["dedup"] = dict(dedup, ratio=dedup_ratio)
             return 0, "", out
         if prefix == "osd df":
             # per-osd utilization (reference `ceph osd df`)

@@ -154,6 +154,48 @@ class OSDMonitor(PaxosService):
             else self.osdmap
         return osdmap_from_dict(osdmap_to_dict(base))
 
+    @staticmethod
+    def _pool_set_efficiency(pool, var: str, val):
+        """Validate + apply one storage-efficiency pool option; None
+        on success, an (rc, msg, data) error triple otherwise."""
+        if var == "compression_mode":
+            mode = str(val or "").lower()
+            if mode not in ("none", "passive", "aggressive", "force"):
+                return -22, f"invalid compression_mode {val!r} " \
+                    "(none|passive|aggressive|force)", None
+            pool.compression_mode = mode
+            if mode != "none" and not pool.compression_algorithm:
+                pool.compression_algorithm = "rle"
+            return None
+        if var == "compression_algorithm":
+            from ..compress.registry import list_codecs
+            algo = str(val or "")
+            if algo and algo not in list_codecs():
+                return -22, f"unknown compression_algorithm " \
+                    f"{algo!r} (available: {list_codecs()})", None
+            pool.compression_algorithm = algo
+            return None
+        # dedup_enable
+        sval = str(val).lower()
+        if sval in ("true", "1", "yes", "on"):
+            enable = True
+        elif sval in ("false", "0", "no", "off"):
+            enable = False
+        else:
+            return -22, f"invalid dedup_enable {val!r} " \
+                "(true|false)", None
+        if enable and pool.is_erasure():
+            # an EC manifest would need a separately-coded chunk pool
+            # (the reference's dedup-tier architecture) — replicated
+            # chunks ride the ordinary replica txn instead
+            return -95, "dedup is not supported on erasure-coded " \
+                "pools", None
+        if enable and pool.snaps:
+            return -22, "dedup cannot be enabled on a pool with " \
+                "snapshots", None
+        pool.dedup_enable = enable
+        return None
+
     # seconds without ANY report (stats tick ≈1s) before the mon
     # itself marks an OSD down — the failure-report path needs live
     # PEERS, so a whole-cluster outage would otherwise never be
@@ -331,7 +373,11 @@ class OSDMonitor(PaxosService):
                 # leader's in-memory PGMap starts blank — never lift
                 # a FULL flag on missing data
                 continue
-            objs, nbytes = usage[pid]
+            # quotas bill LOGICAL bytes (what clients wrote) —
+            # compression shrinking the physical footprint must not
+            # raise a pool's effective quota (reference: num_bytes is
+            # pre-compression)
+            objs, _stored, nbytes = usage[pid]
             over = (pool.quota_max_objects and
                     objs >= pool.quota_max_objects) or \
                 (pool.quota_max_bytes and
@@ -508,6 +554,13 @@ class OSDMonitor(PaxosService):
                                  size=size, min_size=min_size,
                                  type=ptype, crush_rule=rule_id,
                                  erasure_code_profile=profile_name)
+            for var in ("compression_mode", "compression_algorithm",
+                        "dedup_enable"):
+                if cmd.get(var) is not None:
+                    err = self._pool_set_efficiency(pool, var,
+                                                    cmd[var])
+                    if err is not None:
+                        return err
             if m.stretch_mode_enabled and ptype == TYPE_REPLICATED \
                     and rule_id == 0:
                 # pools born into a stretch cluster span the sites
@@ -533,6 +586,12 @@ class OSDMonitor(PaxosService):
                 # support similarly)
                 return -95, "pool snapshots are not supported on " \
                     "erasure-coded pools", None
+            if pool.dedup_enable:
+                # a clone would need its own manifest references; the
+                # refcount layer deliberately keeps one manifest per
+                # head object (see compress/dedup.py)
+                return -95, "pool snapshots are not supported on " \
+                    "dedup-enabled pools", None
             if cmd["snap"] in pool.snaps.values():
                 return -17, f"snapshot {cmd['snap']!r} exists", None
             pool.snap_seq += 1
@@ -561,8 +620,23 @@ class OSDMonitor(PaxosService):
             if name not in self.osdmap.pool_name:
                 return -2, f"pool '{name}' does not exist", None
             var = cmd.get("var", "")
-            if var not in ("pg_num", "pgp_num", "size", "min_size"):
+            int_vars = ("pg_num", "pgp_num", "size", "min_size")
+            eff_vars = ("compression_mode", "compression_algorithm",
+                        "dedup_enable")
+            if var not in int_vars + eff_vars:
                 return -22, f"unsupported pool var {var!r}", None
+            if var in eff_vars:
+                m = self._working()
+                pool = m.pools[m.pool_name[name]]
+                err = self._pool_set_efficiency(pool, var,
+                                                cmd.get("val"))
+                if err is not None:
+                    return err
+                pool.last_change = m.epoch + 1
+                self._stage_map(m)
+                self.mon.propose()
+                return 0, f"set pool {name} {var} to " \
+                    f"{cmd.get('val')}", None
             try:
                 val = int(cmd["val"])
             except (KeyError, ValueError, TypeError):
@@ -616,6 +690,26 @@ class OSDMonitor(PaxosService):
             self._stage_map(m)
             self.mon.propose()
             return 0, f"set pool {name} {var} to {val}", None
+        if prefix == "osd pool get":
+            name = cmd["pool"]
+            if name not in self.osdmap.pool_name:
+                return -2, f"pool '{name}' does not exist", None
+            pool = self.osdmap.pools[self.osdmap.pool_name[name]]
+            gettable = {
+                "pg_num": pool.pg_num, "pgp_num": pool.pgp_num,
+                "size": pool.size, "min_size": pool.min_size,
+                "crush_rule": pool.crush_rule,
+                "compression_mode": pool.compression_mode,
+                "compression_algorithm": pool.compression_algorithm,
+                "dedup_enable": pool.dedup_enable,
+            }
+            var = cmd.get("var", "")
+            if var == "all" or not var:
+                return 0, "\n".join(f"{k}: {v}" for k, v in
+                                    gettable.items()), gettable
+            if var not in gettable:
+                return -22, f"unsupported pool var {var!r}", None
+            return 0, f"{var}: {gettable[var]}", {var: gettable[var]}
         if prefix == "osd tier add":
             # reference OSDMonitor tier commands: attach `tierpool`
             # as a cache tier of `pool`
@@ -2078,7 +2172,8 @@ def _is_mutating(cmd: dict) -> bool:
     # forwarded there), so those commands redirect to it for an
     # authoritative answer
     read_only = ("osd dump", "osd getmap", "osd tree", "osd stat",
-                 "osd pool ls", "osd erasure-code-profile get",
+                 "osd pool ls", "osd pool get",
+                 "osd erasure-code-profile get",
                  "osd erasure-code-profile ls", "auth get", "auth ls",
                  "config-key get", "config-key ls", "log last",
                  "mon dump", "quorum_status", "fs ls", "fs dump",

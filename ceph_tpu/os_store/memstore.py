@@ -16,6 +16,7 @@ from ..core.mempool import pool as _mempool
 from ..core.threading_utils import Finisher
 from .objectstore import (Collection, ObjectStore, StoredObject,
                           Transaction, OP_CLONE, OP_COLL_MOVE,
+                          OP_DEDUP_INGEST, OP_DEDUP_RELEASE,
                           OP_MKCOLL, OP_OMAP_RMKEYS, OP_OMAP_SETKEYS,
                           OP_REMOVE, OP_RMATTR, OP_RMCOLL, OP_SETATTRS,
                           OP_TOUCH, OP_TRUNCATE, OP_WRITE, OP_ZERO)
@@ -82,7 +83,8 @@ class MemStore(ObjectStore):
     def _apply_op(self, op: list):
         code, cid, oid = op[0], op[1], op[2]
         track = code in (OP_WRITE, OP_ZERO, OP_TRUNCATE, OP_REMOVE,
-                        OP_CLONE, OP_RMCOLL)
+                        OP_CLONE, OP_RMCOLL, OP_DEDUP_INGEST,
+                        OP_DEDUP_RELEASE)
         before = 0
         if track:
             if code == OP_RMCOLL:
@@ -91,6 +93,10 @@ class MemStore(ObjectStore):
                              for o in c.objects.values()) if c else 0
             elif code == OP_CLONE:
                 before = self._obj_bytes(cid, op[3])
+            elif code in (OP_DEDUP_INGEST, OP_DEDUP_RELEASE):
+                # the mutated object is the chunk, keyed off the fp
+                # in the oid slot
+                before = self._obj_bytes(cid, "chunk_" + oid)
             else:
                 before = self._obj_bytes(cid, oid)
         self._apply_op_inner(op)
@@ -99,6 +105,8 @@ class MemStore(ObjectStore):
                 after = 0
             elif code == OP_CLONE:
                 after = self._obj_bytes(cid, op[3])
+            elif code in (OP_DEDUP_INGEST, OP_DEDUP_RELEASE):
+                after = self._obj_bytes(cid, "chunk_" + oid)
             else:
                 after = self._obj_bytes(cid, oid)
             self._tracked_bytes += after - before
@@ -157,6 +165,29 @@ class MemStore(ObjectStore):
             dst.data = bytearray(src.data)
             dst.xattrs = dict(src.xattrs)
             dst.omap = dict(src.omap)
+        elif code == OP_DEDUP_INGEST:
+            # conditional at apply time: each store consults its OWN
+            # index (compress/dedup.py conventions), so the same txn
+            # replicated to every acting member stays correct whatever
+            # chunks each replica already holds
+            fp, data = oid, op[3]
+            self.colls.setdefault(cid, Collection(cid))
+            idx = self._obj(cid, "_dedup_index", create=True)
+            refs = int(idx.omap.get(fp, b"0"))
+            if refs <= 0:
+                chunk = self._obj(cid, "chunk_" + fp, create=True)
+                chunk.data = bytearray(data)
+            idx.omap[fp] = str(refs + 1).encode()
+        elif code == OP_DEDUP_RELEASE:
+            fp = oid
+            self.colls.setdefault(cid, Collection(cid))
+            idx = self._obj(cid, "_dedup_index", create=True)
+            refs = int(idx.omap.get(fp, b"0")) - 1
+            if refs <= 0:
+                idx.omap.pop(fp, None)
+                self._coll(cid).objects.pop("chunk_" + fp, None)
+            else:
+                idx.omap[fp] = str(refs).encode()
         else:
             raise ValueError(f"unknown transaction op {code!r}")
 

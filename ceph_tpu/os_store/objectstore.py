@@ -35,6 +35,11 @@ OP_CLONE = "clone"
 OP_MKCOLL = "create_collection"
 OP_RMCOLL = "remove_collection"
 OP_COLL_MOVE = "coll_move"      # reference OP_COLL_MOVE_RENAME (split)
+# dedup refcount layer (compress/dedup.py conventions): conditional at
+# apply time, so every acting member applies against its own local
+# chunk index — the primary never needs to know replica state
+OP_DEDUP_INGEST = "dedup_ingest"
+OP_DEDUP_RELEASE = "dedup_release"
 
 
 class Transaction:
@@ -115,6 +120,21 @@ class Transaction:
 
     def remove_collection(self, cid: str) -> "Transaction":
         self.ops.append([OP_RMCOLL, cid, ""])
+        return self
+
+    def dedup_ingest(self, cid: str, fp: str,
+                     data: bytes) -> "Transaction":
+        """Conditionally store a dedup chunk: if ``fp`` is unknown to
+        the applying store's index, write the chunk object and set its
+        refcount to 1; if known, just bump the refcount (the payload
+        is carried either way — apply decides, see memstore)."""
+        self.ops.append([OP_DEDUP_INGEST, cid, fp, bytes(data)])
+        return self
+
+    def dedup_release(self, cid: str, fp: str) -> "Transaction":
+        """Drop one reference to ``fp``; the applying store removes
+        the chunk object when its refcount reaches zero."""
+        self.ops.append([OP_DEDUP_RELEASE, cid, fp])
         return self
 
     def append(self, other: "Transaction") -> "Transaction":

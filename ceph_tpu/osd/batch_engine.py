@@ -18,9 +18,18 @@ two 32-bit GF(2) matrix applications) — so batched results are
 **bit-identical** to the unbatched path, asserted in
 tests/test_batch_engine.py and before any bench timing.
 
-Two lanes share the machinery but accumulate separately.  The
+Three lanes share the machinery but accumulate separately.  The
 **write lane** (PR 8) carries encode+digest for the client write
-stream.  The **reconstruct lane** carries the degraded path —
+stream.  The **compression lane** carries the storage-efficiency
+pre-pass of the write path: per-pool inline compression (the codec's
+device scan runs once over the whole size-bucketed megabatch, see
+``compress/codec.py``) and dedup fingerprinting (gear-hash CDC
+boundaries as one jitted launch + one batched CRC-32C launch per
+flush, ``compress/chunker.py``), with its own knobs
+(``osd_compress_batch_*`` → ``comp_*``, defaulting to the write
+lane's) and stats (``comp_`` prefix).  Oversized payloads split into
+fixed segments that batch *across* objects — the streaming segment
+path.  The **reconstruct lane** carries the degraded path —
 degraded reads, recovery pushes, backfill pulls, and scrub parity
 rechecks — grouped per (code identity, erasure pattern, size
 bucket) so one fused launch reconstructs a whole sweep's worth of
@@ -116,12 +125,15 @@ class Completion:
 
 class _Op:
     __slots__ = ("kind", "key", "chunks", "payload", "length",
-                 "nbytes", "comp", "span", "want", "passthrough")
+                 "nbytes", "comp", "span", "want", "passthrough",
+                 "codec", "mode", "chunker")
 
     def __init__(self, kind, key, comp, span, length, nbytes,
                  chunks=None, payload=None, want=None,
-                 passthrough=None):
-        self.kind = kind            # "encode"|"digest"|"recon"|"recheck"
+                 passthrough=None, codec=None, mode=None,
+                 chunker=None):
+        self.kind = kind            # "encode"|"digest"|"recon"|
+        #                             "recheck"|"compress"|"fingerprint"
         self.key = key              # executable-identity group key
         self.comp = comp
         self.span = span
@@ -129,9 +141,12 @@ class _Op:
         self.nbytes = nbytes
         self.chunks = chunks        # encode/recheck: [k, length];
         #                             recon: survivor stack [k, length]
-        self.payload = payload      # digest: bytes
+        self.payload = payload      # digest/compress/fingerprint: bytes
         self.want = want            # recon: frozenset of wanted ids
         self.passthrough = passthrough  # recon: {id: chunk} present+wanted
+        self.codec = codec          # compress: Codec instance
+        self.mode = mode            # compress: pool compression_mode
+        self.chunker = chunker      # fingerprint: Chunker instance
 
 
 class _Flight:
@@ -164,6 +179,11 @@ class BatchEngine:
                  recon_max_bytes: int | None = None,
                  recon_max_ops: int | None = None,
                  recon_flush_ms: float | None = None,
+                 comp_enabled: bool | None = None,
+                 comp_max_bytes: int | None = None,
+                 comp_max_ops: int | None = None,
+                 comp_flush_ms: float | None = None,
+                 comp_segment_bytes: int = 1 << 20,
                  use_mesh: bool = False, on_lane_flush=None):
         self.name = name
         self.enabled = bool(enabled)
@@ -179,6 +199,16 @@ class BatchEngine:
                               else int(recon_max_ops))
         self.recon_flush_ms = (self.flush_ms if recon_flush_ms is None
                                else float(recon_flush_ms))
+        # compression-lane knobs default to the write lane's values
+        self.comp_enabled = (self.enabled if comp_enabled is None
+                             else bool(comp_enabled))
+        self.comp_max_bytes = (self.max_bytes if comp_max_bytes
+                               is None else int(comp_max_bytes))
+        self.comp_max_ops = (self.max_ops if comp_max_ops is None
+                             else int(comp_max_ops))
+        self.comp_flush_ms = (self.flush_ms if comp_flush_ms is None
+                              else float(comp_flush_ms))
+        self.comp_segment_bytes = int(comp_segment_bytes)
         self.use_mesh = bool(use_mesh)
         self.use_planes: bool | None = None  # None = auto (TPU only)
         self.on_lane_flush = on_lane_flush   # (lane, ops, bytes) hook
@@ -195,6 +225,10 @@ class BatchEngine:
         self._pending_recon_bytes = 0
         self._recon_since: float | None = None
         self._recon_armed = False
+        self._pending_comp: list[_Op] = []
+        self._pending_comp_bytes = 0
+        self._comp_since: float | None = None
+        self._comp_armed = False
         self._fused: dict = {}               # code key → GFEncodeDigest
         self._rexec: dict = {}               # recon/recheck key → GFLinear
         self._plan_cache: dict = {}          # DecodePlan per erasure set
@@ -382,15 +416,185 @@ class BatchEngine:
         """The exact pre-lane semantics — the bit-identity reference."""
         return ec.decode(set(want), chunks)
 
+    # -- compression lane --------------------------------------------------
+
+    def submit_compress(self, codec, payload, *, mode: str = "aggressive",
+                        span=None, callback=None) -> Completion:
+        """Queue an inline-compression pass; the completion's value is
+        ``(stored_bytes, header | None)``.  ``header is None`` means
+        pass-through — the payload did not shrink under an
+        ``aggressive`` mode and is stored verbatim (``force`` always
+        stores compressed).  The header (``{"algo", "len"}``, plus
+        ``{"seg", "segs"}`` on the streaming segment path) is what the
+        caller persists in the object meta so reads can expand.
+
+        Payloads above ``comp_segment_bytes`` split into fixed
+        segments that batch across objects — the oversized path keeps
+        one row per segment instead of blowing up the bucket ladder.
+        Batched and unbatched paths are bit-identical: the device scan
+        feeds the same host finalize the single-op path uses."""
+        comp = Completion(callback)
+        self.stats["comp_ops_submitted"] += 1
+        try:
+            buf = bytes(payload)
+            if len(buf) > self.comp_segment_bytes > 0:
+                return self._submit_compress_segmented(
+                    codec, buf, mode, span, comp)
+            if (not self.enabled or not self.comp_enabled
+                    or self._stopped or not buf):
+                value = self._compress_unbatched(codec, buf, mode)
+            else:
+                op = _Op("compress", ("compress", codec.name), comp,
+                         span, length=len(buf), nbytes=len(buf),
+                         payload=buf, codec=codec, mode=mode)
+                self._enqueue(op, lane="comp")
+                return comp
+        except Exception as e:      # noqa: BLE001 — poisoned payloads
+            self.stats["comp_ops_failed"] += 1
+            comp._fire(error=e)
+            return comp
+        comp._fire(value=value)
+        return comp
+
+    def _compress_unbatched(self, codec, buf: bytes, mode: str):
+        """Single-op host semantics — the bit-identity reference for
+        the lane (same codec, same fallback rule)."""
+        blob = codec.compress(buf)
+        self.stats["comp_bytes_in"] += len(buf)
+        if mode != "force" and len(blob) >= len(buf):
+            self.stats["comp_passthrough"] += 1
+            self.stats["comp_bytes_out"] += len(buf)
+            return buf, None
+        self.stats["comp_bytes_out"] += len(blob)
+        return blob, {"algo": codec.name, "len": len(buf)}
+
+    def _submit_compress_segmented(self, codec, buf: bytes, mode: str,
+                                   span, comp: Completion) -> Completion:
+        """Streaming segment path: fixed-size segments submitted as
+        ordinary lane members (they coalesce with other objects'
+        segments), joined back into one blob whose header carries the
+        per-segment compressed lengths."""
+        seg = self.comp_segment_bytes
+        segs = [buf[i:i + seg] for i in range(0, len(buf), seg)]
+        results: list = [None] * len(segs)
+        state = {"left": len(segs), "err": None}
+        lock = threading.Lock()
+
+        def _child(i):
+            def cb(child):
+                with lock:
+                    if child.error is not None:
+                        state["err"] = state["err"] or child.error
+                    else:
+                        results[i] = child.value
+                    state["left"] -= 1
+                    if state["left"]:
+                        return
+                if state["err"] is not None:
+                    comp._fire(error=state["err"])
+                    return
+                clens = [[len(b), 1 if h is None else 0]
+                         for b, h in results]
+                total = sum(c for c, _raw in clens)
+                if mode != "force" and total >= len(buf):
+                    self.stats["comp_passthrough"] += 1
+                    comp._fire(value=(buf, None))
+                    return
+                blob = b"".join(b for b, _h in results)
+                comp._fire(value=(blob, {
+                    "algo": codec.name, "len": len(buf),
+                    "seg": seg, "segs": clens}))
+            return cb
+
+        for i, s in enumerate(segs):
+            self.submit_compress(codec, s, mode=mode, span=span,
+                                 callback=_child(i))
+        return comp
+
+    def decompress(self, blob, header: dict) -> bytes:
+        """Expand a sealed blob back to its logical bytes (the
+        read/recovery half).  Host work by design: RLE expansion is a
+        single ``np.repeat`` gather with nothing for the MXU to win,
+        so it stays synchronous where the read path needs it — the
+        lane's device budget goes to the write-side scans.  Counted
+        under ``comp_decompress_bytes`` for the telemetry spine."""
+        from ..compress.codec import CodecError
+        from ..compress.registry import create_codec
+        blob = bytes(blob)
+        codec = create_codec(header["algo"])
+        total = int(header["len"])
+        segs = header.get("segs")
+        if segs is None:
+            out = codec.decompress(blob, total)
+        else:
+            seg = int(header["seg"])
+            parts = []
+            off = 0
+            for i, (clen, raw) in enumerate(segs):
+                llen = min(seg, total - i * seg)
+                piece = blob[off:off + clen]
+                off += clen
+                parts.append(bytes(piece) if raw
+                             else codec.decompress(piece, llen))
+            out = b"".join(parts)
+        if len(out) != total:
+            raise CodecError(
+                f"decompress produced {len(out)} of {total} bytes")
+        self.stats["comp_decompress_bytes"] += len(out)
+        return out
+
+    def submit_fingerprint(self, chunker, payload, *, span=None,
+                           callback=None) -> Completion:
+        """Queue a dedup fingerprint pass; the completion's value is
+        ``[(off, length, fp), ...]`` — content-defined chunk spans
+        with their fingerprints.  The gear-hash boundary scan runs as
+        one fused launch over the size-bucketed megabatch and every
+        chunk of the flush digests through one batched CRC-32C
+        launch; the host path (lane off / empty payload) computes the
+        identical spans and fingerprints."""
+        comp = Completion(callback)
+        self.stats["comp_ops_submitted"] += 1
+        try:
+            buf = bytes(payload)
+            if (not self.enabled or not self.comp_enabled
+                    or self._stopped or not buf):
+                value = self._fingerprint_unbatched(chunker, buf)
+            else:
+                op = _Op("fingerprint",
+                         ("fingerprint",) + chunker.key(), comp, span,
+                         length=len(buf), nbytes=len(buf),
+                         payload=buf, chunker=chunker)
+                self._enqueue(op, lane="comp")
+                return comp
+        except Exception as e:      # noqa: BLE001
+            self.stats["comp_ops_failed"] += 1
+            comp._fire(error=e)
+            return comp
+        comp._fire(value=value)
+        return comp
+
+    def _fingerprint_unbatched(self, chunker, buf: bytes):
+        from ..compress.chunker import fingerprint
+        self.stats["comp_fingerprint_bytes"] += len(buf)
+        return [(off, ln, fingerprint(buf[off:off + ln]))
+                for off, ln in chunker.chunks(buf)]
+
+    def _lane_knobs(self, lane: str) -> tuple[int, int, float]:
+        """(max_ops, max_bytes, flush_ms) for one lane."""
+        if lane == "recon":
+            return (self.recon_max_ops, self.recon_max_bytes,
+                    self.recon_flush_ms)
+        if lane == "comp":
+            return (self.comp_max_ops, self.comp_max_bytes,
+                    self.comp_flush_ms)
+        return self.max_ops, self.max_bytes, self.flush_ms
+
     def _enqueue(self, op: _Op, lane: str = "write"):
         arm = False
         fire = None
-        recon = lane == "recon"
-        max_ops = self.recon_max_ops if recon else self.max_ops
-        max_bytes = self.recon_max_bytes if recon else self.max_bytes
-        flush_ms = self.recon_flush_ms if recon else self.flush_ms
+        max_ops, max_bytes, flush_ms = self._lane_knobs(lane)
         with self._lock:
-            if recon:
+            if lane == "recon":
                 self._pending_recon.append(op)
                 self._pending_recon_bytes += op.nbytes
                 if self._recon_since is None:
@@ -398,6 +602,14 @@ class BatchEngine:
                 n, nbytes = (len(self._pending_recon),
                              self._pending_recon_bytes)
                 armed = self._recon_armed
+            elif lane == "comp":
+                self._pending_comp.append(op)
+                self._pending_comp_bytes += op.nbytes
+                if self._comp_since is None:
+                    self._comp_since = time.monotonic()
+                n, nbytes = (len(self._pending_comp),
+                             self._pending_comp_bytes)
+                armed = self._comp_armed
             else:
                 self._pending.append(op)
                 self._pending_bytes += op.nbytes
@@ -412,42 +624,37 @@ class BatchEngine:
             elif flush_ms <= 0:
                 fire = "immediate"
             elif not armed and self._schedule is not None:
-                if recon:
+                if lane == "recon":
                     self._recon_armed = True
+                elif lane == "comp":
+                    self._comp_armed = True
                 else:
                     self._deadline_armed = True
                 arm = True
         if fire is not None:
             self.flush(reason=fire, lane=lane)
         elif arm:
-            self._schedule(flush_ms / 1000.0,
-                           self._on_recon_deadline if recon
-                           else self._on_deadline)
-
-    def _on_deadline(self):
-        self.flush(reason="deadline", lane="write")
-
-    def _on_recon_deadline(self):
-        self.flush(reason="deadline", lane="recon")
+            self._schedule(
+                flush_ms / 1000.0,
+                lambda: self.flush(reason="deadline", lane=lane))
 
     def maybe_flush(self) -> bool:
         """Tick backstop: flush any lane whose oldest pending op has
         waited past its deadline window (covers a lost/absent timer)."""
         now = time.monotonic()
+        due = []
         with self._lock:
-            w = (bool(self._pending)
-                 and self._pending_since is not None
-                 and (now - self._pending_since) * 1000.0
-                 >= self.flush_ms)
-            r = (bool(self._pending_recon)
-                 and self._recon_since is not None
-                 and (now - self._recon_since) * 1000.0
-                 >= self.recon_flush_ms)
-        if w:
-            self.flush(reason="deadline", lane="write")
-        if r:
-            self.flush(reason="deadline", lane="recon")
-        return w or r
+            for lane, pending, since in (
+                    ("write", self._pending, self._pending_since),
+                    ("recon", self._pending_recon, self._recon_since),
+                    ("comp", self._pending_comp, self._comp_since)):
+                ms = self._lane_knobs(lane)[2]
+                if pending and since is not None \
+                        and (now - since) * 1000.0 >= ms:
+                    due.append(lane)
+        for lane in due:
+            self.flush(reason="deadline", lane=lane)
+        return bool(due)
 
     # -- flush / dispatch --------------------------------------------------
 
@@ -457,8 +664,8 @@ class BatchEngine:
         immediate mode the flights complete inline (after all engine
         locks drop); in batched mode they go to the FIFO completion
         worker so the next tick stages while these fence.  ``lane``
-        limits the flush to one lane; default flushes both."""
-        lanes = ("write", "recon") if lane is None else (lane,)
+        limits the flush to one lane; default flushes all."""
+        lanes = ("write", "recon", "comp") if lane is None else (lane,)
         return sum(self._flush_lane(ln, reason) for ln in lanes)
 
     def flush_sync(self, lane: str, reason: str = "manual") -> int:
@@ -474,30 +681,35 @@ class BatchEngine:
     def _flush_lane(self, lane: str, reason: str,
                     force_inline: bool = False) -> int:
         inline: list[_Flight] = []
-        recon = lane == "recon"
         n = 0
+        ms = self._lane_knobs(lane)[2]
         with self._flush_lock:
             with self._lock:
-                if recon:
+                if lane == "recon":
                     pending = self._pending_recon
                     self._pending_recon = []
                     staged = self._pending_recon_bytes
                     self._pending_recon_bytes = 0
                     self._recon_since = None
                     self._recon_armed = False
-                    ms = self.recon_flush_ms
+                elif lane == "comp":
+                    pending = self._pending_comp
+                    self._pending_comp = []
+                    staged = self._pending_comp_bytes
+                    self._pending_comp_bytes = 0
+                    self._comp_since = None
+                    self._comp_armed = False
                 else:
                     pending, self._pending = self._pending, []
                     staged = self._pending_bytes
                     self._pending_bytes = 0
                     self._pending_since = None
                     self._deadline_armed = False
-                    ms = self.flush_ms
                 use_worker = (ms > 0 and not self._stopped
                               and not force_inline)
             if not pending:
                 return 0
-            prefix = "recon_" if recon else ""
+            prefix = {"recon": "recon_", "comp": "comp_"}.get(lane, "")
             self.stats[f"{prefix}flush_{reason}"] += 1
             flights = self._dispatch(pending, reason, lane)
             n = len(flights)
@@ -561,7 +773,8 @@ class BatchEngine:
 
     def _dispatch(self, pending, reason, lane="write") -> list[_Flight]:
         flights = []
-        launches_key = "recon_launches" if lane == "recon" else "launches"
+        launches_key = {"recon": "recon_launches",
+                        "comp": "comp_launches"}.get(lane, "launches")
         for (key, bucket_len), ops in self._groups(pending).items():
             rows = _next_pow2(len(ops))
             span = None
@@ -587,6 +800,13 @@ class BatchEngine:
                 elif key[0] == "recon":
                     fl = self._launch_reconstruct(
                         key, ops, rows, bucket_len, span, reason)
+                elif key[0] == "compress":
+                    fl = self._launch_compress(ops, rows, bucket_len,
+                                               span, reason)
+                elif key[0] == "fingerprint":
+                    fl = self._launch_fingerprint(ops, rows,
+                                                  bucket_len, span,
+                                                  reason)
                 else:
                     fl = self._launch_recheck(key, ops, rows,
                                               bucket_len, span, reason)
@@ -761,10 +981,109 @@ class BatchEngine:
         return _Flight("recheck", ops, out, bucket_len, rows, ln, span,
                        reason)
 
+    def _launch_compress(self, ops, rows, bucket_len, span,
+                         reason) -> _Flight:
+        """Stage one codec's ops into a pow2 megabatch and run the
+        codec's device boundary scan (``scan_batch``); host-only
+        codecs (zlib) fly with ``out=None`` and finalize entirely in
+        ``_complete_comp`` — they still gain the shared flush cadence
+        and stats spine."""
+        codec = ops[0].codec
+        scan = getattr(codec, "scan_batch", None)
+        out = None
+        if scan is not None:
+            batch = np.zeros((rows, bucket_len), dtype=np.uint8)
+            for i, op in enumerate(ops):
+                batch[i, :op.length] = np.frombuffer(op.payload,
+                                                     np.uint8)
+            staged = batch.nbytes
+        else:
+            staged = sum(op.length for op in ops)
+        ln = self._prof_start(ops, rows, staged, reason, "compress",
+                              True, lane="comp")
+        try:
+            if scan is not None:
+                out = scan(batch)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.dispatched()
+        return _Flight("compress", ops, out, bucket_len, rows, ln,
+                       span, reason)
+
+    def _launch_fingerprint(self, ops, rows, bucket_len, span,
+                            reason) -> _Flight:
+        """Two fused launches per flush: the gear-hash boundary scan
+        over the pow2 megabatch, then — after the host pass walks the
+        sparse candidate lists into bounded chunk spans — one CRC-32C
+        batch launch digesting *every* chunk of the flush at once.
+        The flight carries the finished per-op values; the fence in
+        ``_complete_comp`` only fires completions."""
+        import zlib as _zlib
+        import jax.numpy as jnp
+        from ..scrub.crc32c_jax import (_batch_kernel,
+                                        crc32c_zero_unpad)
+        chunker = ops[0].chunker
+        batch = np.zeros((rows, bucket_len), dtype=np.uint8)
+        for i, op in enumerate(ops):
+            batch[i, :op.length] = np.frombuffer(op.payload, np.uint8)
+        ln = self._prof_start(ops, rows, batch.nbytes, reason,
+                              "fingerprint", True, lane="comp")
+        try:
+            hashes = np.asarray(chunker.hash_batch(batch))
+            spans_per_op = []
+            all_chunks = []
+            for i, op in enumerate(ops):
+                spans = []
+                last = 0
+                for c in chunker.cuts_from_hashes(hashes[i],
+                                                  op.length):
+                    spans.append((last, c - last))
+                    all_chunks.append(op.payload[last:c])
+                    last = c
+                spans_per_op.append(spans)
+            if all_chunks:
+                cbucket = _next_pow2(
+                    max(max(len(c) for c in all_chunks), 32))
+                cbatch = np.zeros((len(all_chunks), cbucket),
+                                  dtype=np.uint8)
+                for i, c in enumerate(all_chunks):
+                    cbatch[i, :len(c)] = np.frombuffer(c, np.uint8)
+                crcs = np.asarray(_batch_kernel(cbucket)(
+                    jnp.asarray(cbatch),
+                    jnp.zeros(len(all_chunks), jnp.uint32)))
+            values = []
+            j = 0
+            for spans in spans_per_op:
+                vals = []
+                for off, clen in spans:
+                    c = all_chunks[j]
+                    crc = crc32c_zero_unpad(int(crcs[j]),
+                                            cbucket - len(c))
+                    vals.append((off, clen,
+                                 f"{crc:08x}"
+                                 f"{_zlib.crc32(c) & 0xFFFFFFFF:08x}"
+                                 f"{len(c):08x}"))
+                    j += 1
+                values.append(vals)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.dispatched()
+        return _Flight("fingerprint", ops, values, bucket_len, rows,
+                       ln, span, reason)
+
     # -- completion --------------------------------------------------------
 
     def _complete(self, fl: _Flight):
         from ..scrub.crc32c_jax import crc32c_zero_unpad
+        if fl.kind in ("compress", "fingerprint"):
+            self._complete_comp(fl)
+            return
         parity = crcs = rec = None
         try:
             if fl.kind == "encode":
@@ -835,6 +1154,69 @@ class BatchEngine:
                 # callback blowing up must not starve its siblings
                 self.stats["callback_errors"] += 1
 
+    def _complete_comp(self, fl: _Flight):
+        """Fence + per-member finalize for the compression lane.  A
+        member whose codec finalize blows up fails alone — group
+        isolation inside the flight, same contract as the write
+        lane's per-group isolation outside it."""
+        try:
+            if fl.kind == "compress":
+                mask = (np.asarray(fl.out) if fl.out is not None
+                        else None)
+            else:
+                values = fl.out     # precomputed in the launch half
+        except Exception as e:      # noqa: BLE001 — died at the fence
+            if fl.ln is not None:
+                fl.ln.abort()
+            self._fail_group(fl.ops, e, fl.span)
+            return
+        info = {"rows": fl.bucket, "members": len(fl.ops),
+                "row_len": fl.length, "reason": fl.reason,
+                "lane": "comp"}
+        bytes_out = 0
+        for i, op in enumerate(fl.ops):
+            try:
+                if fl.kind == "compress":
+                    codec = op.codec
+                    if mask is not None:
+                        row = np.frombuffer(op.payload, np.uint8)
+                        blob = codec.compress_from_scan(
+                            row, op.length, mask[i])
+                    else:
+                        blob = codec.compress(op.payload)
+                    self.stats["comp_bytes_in"] += op.length
+                    if op.mode != "force" and len(blob) >= op.length:
+                        self.stats["comp_passthrough"] += 1
+                        self.stats["comp_bytes_out"] += op.length
+                        bytes_out += op.length
+                        value = (op.payload, None)
+                    else:
+                        self.stats["comp_bytes_out"] += len(blob)
+                        bytes_out += len(blob)
+                        value = (blob, {"algo": codec.name,
+                                        "len": op.length})
+                else:
+                    self.stats["comp_fingerprint_bytes"] += op.length
+                    value = values[i]
+            except Exception as e:  # noqa: BLE001 — poisoned member
+                self.stats["comp_ops_failed"] += 1
+                try:
+                    op.comp._fire(error=e)
+                except Exception:   # noqa: BLE001
+                    self.stats["callback_errors"] += 1
+                continue
+            op.comp.info = info
+            try:
+                op.comp._fire(value=value)
+                self.stats["comp_ops_completed"] += 1
+            except Exception:       # noqa: BLE001 — a member's
+                # callback blowing up must not starve its siblings
+                self.stats["callback_errors"] += 1
+        if fl.ln is not None:
+            fl.ln.finish(bytes_out=bytes_out)
+        if fl.span is not None:
+            fl.span.finish()
+
     def _fail_group(self, ops, err, span):
         if span is not None:
             span.set_tag("error", repr(err))
@@ -842,6 +1224,8 @@ class BatchEngine:
         for op in ops:
             self.stats["recon_ops_failed"
                        if op.kind in ("recon", "recheck")
+                       else "comp_ops_failed"
+                       if op.kind in ("compress", "fingerprint")
                        else "ops_failed"] += 1
             try:
                 op.comp._fire(error=err)
@@ -856,6 +1240,8 @@ class BatchEngine:
             pending_bytes = self._pending_bytes
             rpending = len(self._pending_recon)
             rpending_bytes = self._pending_recon_bytes
+            cpending = len(self._pending_comp)
+            cpending_bytes = self._pending_comp_bytes
         d = dict(self.stats)
         d.update(enabled=self.enabled, flush_ms=self.flush_ms,
                  max_bytes=self.max_bytes, max_ops=self.max_ops,
@@ -868,5 +1254,12 @@ class BatchEngine:
                  recon_pending_bytes=rpending_bytes,
                  recon_use_mesh=self.use_mesh,
                  recon_plans=len(self._plan_cache),
+                 comp_enabled=self.comp_enabled,
+                 comp_flush_ms=self.comp_flush_ms,
+                 comp_max_bytes=self.comp_max_bytes,
+                 comp_max_ops=self.comp_max_ops,
+                 comp_segment_bytes=self.comp_segment_bytes,
+                 comp_pending_ops=cpending,
+                 comp_pending_bytes=cpending_bytes,
                  inflight=self._flights.unfinished_tasks)
         return d

@@ -21,6 +21,7 @@ Reference behavior re-created (``src/osd/OSD.{h,cc}``; SURVEY.md §3.5,
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -219,6 +220,19 @@ class OSDaemon(Dispatcher):
                 self.config.get("osd_recovery_batch_max_ops") or 64),
             recon_flush_ms=float(
                 self.config.get("osd_recovery_batch_flush_ms") or 0.0),
+            comp_enabled=bool(
+                self.config.get("osd_compress_batch_enable")),
+            comp_max_bytes=int(
+                self.config.get("osd_compress_batch_max_bytes")
+                or (8 << 20)),
+            comp_max_ops=int(
+                self.config.get("osd_compress_batch_max_ops") or 64),
+            comp_flush_ms=float(
+                self.config.get("osd_compress_batch_flush_ms")
+                or 0.0),
+            comp_segment_bytes=int(
+                self.config.get("osd_compress_segment_bytes")
+                or (1 << 20)),
             use_mesh=bool(
                 self.config.get("osd_recovery_batch_mesh")),
             on_lane_flush=self._on_lane_flush,
@@ -235,6 +249,14 @@ class OSDaemon(Dispatcher):
                 ("osd_recovery_batch_max_ops", "recon_max_ops", int),
                 ("osd_recovery_batch_flush_ms", "recon_flush_ms",
                  float),
+                ("osd_compress_batch_enable", "comp_enabled", bool),
+                ("osd_compress_batch_max_bytes", "comp_max_bytes",
+                 int),
+                ("osd_compress_batch_max_ops", "comp_max_ops", int),
+                ("osd_compress_batch_flush_ms", "comp_flush_ms",
+                 float),
+                ("osd_compress_segment_bytes", "comp_segment_bytes",
+                 int),
                 ("osd_recovery_batch_mesh", "use_mesh", bool)):
             self.config.add_observer(
                 _opt, lambda _n, v, _a=_attr, _c=_cast: setattr(
@@ -1048,20 +1070,35 @@ class OSDaemon(Dispatcher):
             # the byte count goes stale (review r3)
             key = (pg.info.last_update, len(objs))
             if cache is not None and cache[0] == key:
-                nbytes = cache[1]
+                nbytes, lbytes = cache[1], cache[2]
             else:
+                # physical (stored) vs logical bytes: sealed objects
+                # (pool compression / dedup) store fewer bytes than
+                # they logically hold — `num_bytes` stays PHYSICAL so
+                # capacity accounting reflects post-compression
+                # reality; `num_bytes_logical` feeds the df/ratio view
                 nbytes = 0
+                lbytes = 0
                 for o in objs:
+                    phys = 0
                     try:
-                        nbytes += self.store.stat(pg.cid, o)["size"]
+                        phys = self.store.stat(pg.cid, o)["size"]
                     except KeyError:
                         pass
-                pg._usage_cache = (key, nbytes)
+                    nbytes += phys
+                    try:
+                        meta = json.loads(bytes(self.store.getattr(
+                            pg.cid, o, "_")))
+                        lbytes += int(meta.get("size", phys))
+                    except (KeyError, ValueError):
+                        lbytes += phys
+                pg._usage_cache = (key, nbytes, lbytes)
             stats[str(pgid)] = {
                 "state": pg.state + ("+scrubbing" if pg.scrubbing
                                      else ""),
                 "num_objects": len(objs),
                 "num_bytes": nbytes,
+                "num_bytes_logical": lbytes,
                 "log_size": len(pg.log.entries),
                 "missing": len(pg.missing) + sum(
                     len(pm) for pm in pg.peer_missing.values()),
@@ -1087,11 +1124,34 @@ class OSDaemon(Dispatcher):
                 stats[str(pgid)]["scrub_chunks_total"] = \
                     pg.scrub_chunks_total()
         if stats or self.pgs:
-            bytes_used = sum(st["num_bytes"] for st in stats.values())
+            # dedup chunk bytes live in the store-global "dedup"
+            # collection, outside any PG — capacity accounting must
+            # include them or dedup pools look free
+            from ..compress import dedup as dd
+            dstats = dd.dedup_stats(self.store)
+            bytes_used = sum(st["num_bytes"]
+                             for st in stats.values()) \
+                + dstats["stored_bytes"]
+            eng = self.batch_engine.stats
             self.monc.send(MM.MPGStats(
                 osd=self.whoami, epoch=self.osdmap.epoch,
                 pg_stats=stats,
                 osd_stats={"num_pgs": len(self.pgs),
+                           # storage-efficiency lane aggregates: the
+                           # telemetry spine differentiates these into
+                           # compress/decompress/fingerprint byte
+                           # rates; dedup index totals ride whole
+                           "dedup": dstats,
+                           "comp": {
+                               "bytes_in": eng.get("comp_bytes_in", 0),
+                               "bytes_out": eng.get("comp_bytes_out",
+                                                    0),
+                               "decompress_bytes": eng.get(
+                                   "comp_decompress_bytes", 0),
+                               "fingerprint_bytes": eng.get(
+                                   "comp_fingerprint_bytes", 0),
+                               "passthrough": eng.get(
+                                   "comp_passthrough", 0)},
                            # stub capacity accounting for the
                            # OSD_NEARFULL check: primary-PG bytes vs a
                            # configured synthetic device size

@@ -129,10 +129,13 @@ class MOSDPGPush(_JsonMessage):
     MOSDPGPush carrying PushOp).  `clones`/`snapmap` carry the head's
     snap clones and their SnapMapper index rows — the reference's
     SnapSet-aware push (a recovered head without its clones would
-    silently lose snapshot history)."""
+    silently lose snapshot history).  `dedup`: {fp: chunk frame hex}
+    for a dedup-manifested head — chunk payloads travel with the
+    manifest so the target can ingest them into its own refcount
+    index (decodes to None on pushes from older senders)."""
     TYPE = 52
     FIELDS = ("pgid", "epoch", "oid", "data", "attrs", "omap", "version",
-              "from_osd", "pull_tid", "clones", "snapmap")
+              "from_osd", "pull_tid", "clones", "snapmap", "dedup")
 
 
 @register_message

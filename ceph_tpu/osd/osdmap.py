@@ -116,6 +116,13 @@ class PGPool:
     # `stretch_min_size` once both sites are back.
     is_stretch: bool = False
     stretch_min_size: int = 0        # healthy min_size to restore
+    # storage efficiency (reference pg_pool_t compression_* options +
+    # dedup tiering): mode none|passive|aggressive|force gates the
+    # OSD's inline compression lane; dedup is replicated-pool-only
+    # and mutually exclusive with pool snapshots (mon-enforced).
+    compression_mode: str = "none"
+    compression_algorithm: str = ""
+    dedup_enable: bool = False
 
     def __post_init__(self):
         if self.pgp_num == 0:

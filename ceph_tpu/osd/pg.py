@@ -63,11 +63,27 @@ def is_snap_clone(name: str) -> bool:
     return _SNAP_SEP in name
 
 
-def _obj_meta(version, size: int, hinfo: int | None = None) -> bytes:
+def _obj_meta(version, size: int, hinfo: int | None = None,
+              extra: dict | None = None) -> bytes:
+    """Object "_" attribute.  ``size`` is always the LOGICAL length;
+    storage-efficiency extras describe the physical form: ``stored``
+    (physical payload bytes), ``comp`` (compression header), ``dedup``
+    (chunk manifest ``[[fp, len], ...]``)."""
     d = {"version": list(version), "size": size}
     if hinfo is not None:
         d["hinfo"] = hinfo
+    if extra:
+        d.update(extra)
     return json.dumps(d).encode()
+
+
+def _meta_extra(meta: dict | None) -> dict | None:
+    """The storage-efficiency extras of an existing "_" meta (to carry
+    through rewrites that don't change the payload)."""
+    if not meta:
+        return None
+    out = {k: meta[k] for k in ("stored", "comp", "dedup") if k in meta}
+    return out or None
 
 
 class PG:
@@ -145,6 +161,10 @@ class PG:
         self.watchers: dict[str, dict[str, object]] = {}
         self._notifies: dict[int, dict] = {}
         self._notify_id = 0
+        # storage-efficiency caches (codec keyed by pool algorithm,
+        # chunker by the daemon's CDC target — both cheap to rebuild)
+        self._codec_cache = None
+        self._chunker_cache = None
         self.backend = (ECBackend(self) if pool.is_erasure()
                         else ReplicatedBackend(self))
 
@@ -167,6 +187,168 @@ class PG:
         m = self.daemon.osdmap
         return [o for o in self.acting
                 if o != CRUSH_ITEM_NONE and m.is_up(o)]
+
+    # -- storage efficiency (pool compression / dedup) ---------------------
+    # The pool flags live on the OSDMap pool entry (self.pool is
+    # refreshed on every map advance), so `osd pool set` takes effect
+    # on the next write without touching the PG.  Reference:
+    # BlueStore inline compression modes + the tiering-based dedup
+    # engine (manifest objects over a refcounted chunk store).
+    @property
+    def compression_on(self) -> bool:
+        mode = getattr(self.pool, "compression_mode", "none")
+        return (mode in ("aggressive", "force")
+                and bool(getattr(self.pool, "compression_algorithm",
+                                 "")))
+
+    @property
+    def dedup_on(self) -> bool:
+        return (bool(getattr(self.pool, "dedup_enable", False))
+                and not self.pool.is_erasure())
+
+    @property
+    def efficiency_on(self) -> bool:
+        return self.compression_on or self.dedup_on
+
+    def _codec(self):
+        from ..compress.registry import create_codec
+        name = getattr(self.pool, "compression_algorithm", "") or "rle"
+        if self._codec_cache is None or self._codec_cache.name != name:
+            self._codec_cache = create_codec(name)
+        return self._codec_cache
+
+    def _chunker(self):
+        if self._chunker_cache is None:
+            from ..compress.chunker import Chunker
+            avg = int(self.daemon.config.get("osd_dedup_chunk_avg")
+                      or 4096)
+            self._chunker_cache = Chunker(avg_size=avg)
+        return self._chunker_cache
+
+    def seal_payload(self, data: bytes, span, done):
+        """Turn a logical payload into its stored form through the
+        batch engine's comp lane.  ``done(err, stored, extra, ingest)``:
+        ``stored`` = bytes to write to the object (b"" for dedup —
+        the manifest in ``extra`` IS the object), ``extra`` = meta
+        extras dict or None (None ⇒ plain object, bit-identical to
+        efficiency-off), ``ingest`` = [(fp, frame)] chunk payloads the
+        txn must dedup_ingest."""
+        engine = self.daemon.batch_engine
+        data = bytes(data)
+        if self.dedup_on:
+            mode = ("force" if getattr(self.pool, "compression_mode",
+                                       "none") == "force"
+                    else "aggressive")
+            compress = self.compression_on
+
+            def _chunked(comp):
+                if comp.error is not None:
+                    done(comp.error, None, None, None)
+                    return
+                spans = comp.value
+                manifest = [[fp, ln] for _off, ln, fp in spans]
+                uniq: dict[str, bytes] = {}
+                for off, ln, fp in spans:
+                    if fp not in uniq:
+                        uniq[fp] = data[off:off + ln]
+                self._seal_chunks(engine, manifest, uniq, compress,
+                                  mode, span, done)
+
+            engine.submit_fingerprint(self._chunker(), data, span=span,
+                                      callback=_chunked)
+            return
+        if self.compression_on:
+            mode = ("force" if getattr(self.pool, "compression_mode",
+                                       "none") == "force"
+                    else "aggressive")
+
+            def _compressed(comp):
+                if comp.error is not None:
+                    done(comp.error, None, None, None)
+                    return
+                blob, hdr = comp.value
+                if hdr is None:      # didn't shrink → stored verbatim
+                    done(None, blob, None, [])
+                else:
+                    done(None, blob,
+                         {"stored": len(blob), "comp": hdr}, [])
+
+            engine.submit_compress(self._codec(), data, mode=mode,
+                                   span=span, callback=_compressed)
+            return
+        done(None, data, None, [])
+
+    def _seal_chunks(self, engine, manifest, uniq, compress, mode,
+                     span, done):
+        """Dedup phase 2: frame each unique chunk (compressing when
+        the pool also enables compression — chunking happens on RAW
+        content so identical chunks dedup across compression modes)."""
+        from ..compress import dedup as dd
+        if not uniq:
+            done(None, b"", {"stored": 0, "dedup": manifest}, [])
+            return
+        if not compress:
+            raws = {fp: dd.frame_raw(c) for fp, c in uniq.items()}
+            # one ingest per manifest ENTRY (dup fps repeat): the
+            # refcount invariant counts references, not unique chunks
+            done(None, b"", {"stored": 0, "dedup": manifest},
+                 [(fp, raws[fp]) for fp, _ln in manifest])
+            return
+        codec = self._codec()
+        state = {"left": len(uniq), "err": None}
+        frames: dict[str, bytes] = {}
+        lock = self.daemon.lock
+
+        def _one(fp, chunk):
+            def _cb(comp):
+                with lock:
+                    if comp.error is not None:
+                        if state["err"] is None:
+                            state["err"] = comp.error
+                    else:
+                        blob, hdr = comp.value
+                        frames[fp] = (dd.frame_raw(chunk) if hdr is None
+                                      else dd.frame_sealed(blob, hdr))
+                    state["left"] -= 1
+                    if state["left"] == 0:
+                        if state["err"] is not None:
+                            done(state["err"], None, None, None)
+                        else:
+                            done(None, b"",
+                                 {"stored": 0, "dedup": manifest},
+                                 [(fp, frames[fp])
+                                  for fp, _ln in manifest
+                                  if fp in frames])
+            engine.submit_compress(codec, chunk, mode=mode, span=span,
+                                   callback=_cb)
+
+        for fp, chunk in list(uniq.items()):
+            _one(fp, chunk)
+
+    def unseal_payload(self, raw, meta: dict | None) -> bytes:
+        """Stored form → logical bytes (host path: expansion is
+        np.repeat/zlib, nothing for the MXU)."""
+        engine = self.daemon.batch_engine
+        meta = meta or {}
+        manifest = list(meta.get("dedup") or [])
+        if manifest:
+            from ..compress import dedup as dd
+            store = self.daemon.store
+            parts = []
+            for fp, ln in manifest:
+                frame = store.read(dd.DEDUP_COLL, dd.chunk_oid(fp))
+                payload, hdr = dd.unframe(frame)
+                chunk = (bytes(payload) if hdr is None
+                         else engine.decompress(payload, hdr))
+                if len(chunk) != ln:
+                    raise ValueError(
+                        f"dedup chunk {fp}: {len(chunk)} != {ln}")
+                parts.append(chunk)
+            return b"".join(parts)
+        if "comp" in meta:
+            stored = int(meta.get("stored", len(bytes(raw))))
+            return engine.decompress(bytes(raw)[:stored], meta["comp"])
+        return bytes(raw)
 
     # -- EC shard reality (split / re-placement) ---------------------------
     def _held_shards(self) -> list[int]:
@@ -1456,6 +1638,11 @@ class ReplicatedBackend(PGBackendBase):
     def __init__(self, pg: PG):
         self.pg = pg
         self._inflight: dict[str, dict] = {}   # reqid → waiting state
+        # per-object gate for sealed (compressed/dedup) writes: the
+        # read-modify-seal pipeline is asynchronous through the comp
+        # lane, so concurrent writes to one object must serialize
+        # (mirrors ECBackend._rmw at object granularity)
+        self._seal_gate: dict[str, list] = {}
 
     def on_change(self):
         # cross-interval repops die here and their clients resend
@@ -1464,11 +1651,15 @@ class ReplicatedBackend(PGBackendBase):
         for st in self._inflight.values():
             self.pg.finish_tracked(st.get("msg"), "reset")
         self._inflight.clear()
+        self._seal_gate.clear()
 
     # -- writes ------------------------------------------------------------
     def submit_write(self, msg: M.MOSDOp, reqid: str):
         pg, daemon = self.pg, self.pg.daemon
         cid, oid = pg.cid, msg.oid
+        if self._needs_seal(msg):
+            self._submit_write_sealed(msg, reqid)
+            return
         version = pg.next_version()
         prior = self._object_version(oid)
         snap_txn = self._maybe_clone_for_snap(cid, oid, msg)
@@ -1504,12 +1695,197 @@ class ReplicatedBackend(PGBackendBase):
             self._maybe_ack(reqid)
 
     def _object_version(self, oid: str) -> tuple:
+        meta = self._read_local_meta(oid)
+        return tuple(meta["version"]) if meta else ZERO
+
+    def _read_local_meta(self, oid: str) -> dict | None:
         try:
-            meta = json.loads(bytes(
-                self.pg.daemon.store.getattr(self.pg.cid, oid, "_")))
-            return tuple(meta["version"])
-        except KeyError:
-            return ZERO
+            return json.loads(bytes(self.pg.daemon.store.getattr(
+                self.pg.cid, oid, "_")))
+        except (KeyError, ValueError):
+            return None
+
+    # -- sealed writes (pool compression / dedup) --------------------------
+    def _needs_seal(self, msg: M.MOSDOp) -> bool:
+        """Data mutations route through the seal pipeline when the
+        pool wants efficiency OR the object is already stored sealed
+        (so turning a pool's compression off re-plains objects on
+        their next write, and deletes release dedup references)."""
+        if not any(op.get("op") in ("write", "write_full", "append",
+                                    "truncate", "delete")
+                   for op in msg.ops):
+            return False
+        if self.pg.efficiency_on:
+            return True
+        return _meta_extra(self._read_local_meta(msg.oid)) is not None
+
+    def _submit_write_sealed(self, msg: M.MOSDOp, reqid: str):
+        pg = self.pg
+        oid = msg.oid
+        if oid in self._seal_gate:
+            self._seal_gate[oid].append(
+                lambda: self._submit_write_sealed(msg, reqid))
+            return
+        self._seal_gate[oid] = []
+        try:
+            self._seal_and_submit(msg, reqid)
+        except Exception as e:   # noqa: BLE001 — a poisoned op must
+            # release the gate, not wedge every later write
+            self._release_seal_gate(oid)
+            pg._reply(msg, -22, f"write failed: {e!r}")
+
+    def _release_seal_gate(self, oid: str):
+        waiters = self._seal_gate.pop(oid, [])
+        for fn in waiters:
+            fn()
+
+    def _seal_and_submit(self, msg: M.MOSDOp, reqid: str):
+        """Read-modify-seal: materialize the old LOGICAL bytes, apply
+        the ops logically (the EC switch shape), then run the result
+        through the batch engine's comp lane; the continuation builds
+        the replicated txn at its own version (assigned at txn-build
+        time so the log stays monotone under async sealing)."""
+        pg, daemon = self.pg, self.pg.daemon
+        cid, oid = pg.cid, msg.oid
+        store = daemon.store
+        old_meta = self._read_local_meta(oid)
+        cur = b""
+        if store.exists(cid, oid):
+            cur = pg.unseal_payload(store.read(cid, oid), old_meta)
+        delete = False
+        attr_ops = []
+        results = []
+        for op in msg.ops:
+            kind = op.get("op")
+            if kind in _NOOP_OPS:
+                results.append({})
+            elif kind == "write_full":
+                cur = bytes.fromhex(op["data"])
+                results.append({})
+            elif kind == "write":
+                buf = bytes.fromhex(op["data"])
+                off = int(op.get("off", 0))
+                base = bytearray(cur)
+                if len(base) < off:
+                    base.extend(b"\x00" * (off - len(base)))
+                base[off:off + len(buf)] = buf
+                cur = bytes(base)
+                results.append({})
+            elif kind == "append":
+                cur = cur + bytes.fromhex(op["data"])
+                results.append({})
+            elif kind == "truncate":
+                size = int(op["size"])
+                cur = (cur[:size] if size <= len(cur)
+                       else cur + b"\x00" * (size - len(cur)))
+                results.append({})
+            elif kind == "delete":
+                want = op.get("if_version")
+                if want is not None and \
+                        list(self._object_version(oid)) != list(want):
+                    raise ValueError(
+                        "if_version mismatch: object changed")
+                delete = True
+                results.append({})
+            elif kind in ("setxattr", "rmxattr", "omap_set",
+                          "omap_rm"):
+                attr_ops.append(op)
+                results.append({})
+            else:
+                raise ValueError(f"unknown write op {kind!r}")
+        if delete:
+            self._finish_sealed(msg, reqid, old_meta, 0, True,
+                                attr_ops, results, b"", None, [])
+            return
+        span = getattr(getattr(msg, "tracked", None), "span", None)
+
+        def _sealed(err, stored, extra, ingest):
+            with daemon.lock:
+                if err is not None:
+                    self._release_seal_gate(oid)
+                    pg._reply(msg, -22, f"write failed: {err!r}")
+                    return
+                try:
+                    self._finish_sealed(
+                        msg, reqid, old_meta, len(cur), False,
+                        attr_ops, results, stored, extra, ingest)
+                except Exception as e:   # noqa: BLE001
+                    self._release_seal_gate(oid)
+                    pg._reply(msg, -22, f"write failed: {e!r}")
+
+        pg.seal_payload(cur, span, _sealed)
+
+    def _finish_sealed(self, msg: M.MOSDOp, reqid: str, old_meta,
+                       logical_size: int, delete: bool, attr_ops,
+                       results, stored: bytes, extra, ingest):
+        """Build + fan out the sealed txn (under the daemon lock —
+        inline for immediate flush, from the completion worker for a
+        deadline lane).  New chunk references ingest BEFORE the old
+        manifest releases so shared chunks never dip to zero."""
+        from ..compress import dedup as dd
+        pg, daemon = self.pg, self.pg.daemon
+        cid, oid = pg.cid, msg.oid
+        version = pg.next_version()
+        prior = tuple(old_meta["version"]) if old_meta else ZERO
+        old_manifest = dd.manifest_entries(old_meta)
+        snap_txn = (None if delete or pg.dedup_on
+                    else self._maybe_clone_for_snap(cid, oid, msg))
+        txn = Transaction()
+        if delete:
+            txn.remove(cid, oid)
+        else:
+            txn.truncate(cid, oid, 0)
+            if stored:
+                txn.write(cid, oid, 0, stored)
+            txn.setattrs(cid, oid, {"_": _obj_meta(
+                version, logical_size, extra=extra)})
+            for op in attr_ops:
+                kind = op["op"]
+                if kind == "setxattr":
+                    txn.setattrs(cid, oid, {
+                        op["name"]: bytes.fromhex(op["data"])})
+                elif kind == "rmxattr":
+                    txn.rmattr(cid, oid, op["name"])
+                elif kind == "omap_set":
+                    txn.omap_setkeys(cid, oid, {
+                        k: bytes.fromhex(v)
+                        for k, v in op["kv"].items()})
+                elif kind == "omap_rm":
+                    txn.omap_rmkeys(cid, oid, list(op["keys"]))
+            for fp, frame in ingest:
+                txn.dedup_ingest(dd.DEDUP_COLL, fp, frame)
+        for fp, _ln in old_manifest:
+            txn.dedup_release(dd.DEDUP_COLL, fp)
+        if snap_txn is not None:
+            snap_txn.append(txn)
+            txn = snap_txn
+        entry = LogEntry(op=DELETE if delete else MODIFY, oid=oid,
+                         version=version, prior_version=prior,
+                         reqid=reqid, mtime=time.time())
+        pg.append_log_entry(entry, txn)
+        peers = [o for o in pg._peer_osds()
+                 if pg.backfill_gate(o, oid, is_delete=delete)]
+        state = {"waiting": set(peers), "msg": msg,
+                 "version": version, "results": results}
+        self._inflight[reqid] = state
+        wire_txn = txn.to_dict()
+        span = getattr(getattr(msg, "tracked", None), "span", None)
+        trace = span.ctx() if span is not None \
+            else getattr(msg, "trace", None)
+        for o in peers:
+            daemon.send_to_osd(o, M.MOSDRepOp(
+                reqid=reqid, pgid=str(pg.pgid),
+                epoch=daemon.osdmap.epoch, txn=wire_txn,
+                version=list(version),
+                log_entries=[entry.to_dict()],
+                pg_info=pg.info.to_dict(), trace=trace))
+        daemon.store.queue_transaction(txn)
+        # gate drops once the local (primary) apply is queued —
+        # replicated primaries apply immediately, so the next queued
+        # write reads this write's bytes
+        self._release_seal_gate(oid)
+        if not peers:
+            self._maybe_ack(reqid)
 
     # -- pool snapshots (reference PrimaryLogPG make_writeable +
     # SnapMapper: clone the head before the first write past each
@@ -1592,11 +1968,20 @@ class ReplicatedBackend(PGBackendBase):
         txn = Transaction()
         results = []
         delete = False
-        size = 0
-        try:
-            size = store.stat(cid, oid)["size"]
-        except KeyError:
-            pass
+        # logical size + storage-efficiency extras come from the
+        # existing meta (a sealed object's physical stat lies about
+        # its length; attr-only rewrites must not clobber the extras)
+        extra = None
+        meta = self._read_local_meta(oid)
+        if meta is not None:
+            size = int(meta.get("size", 0))
+            extra = _meta_extra(meta)
+        else:
+            size = 0
+            try:
+                size = store.stat(cid, oid)["size"]
+            except KeyError:
+                pass
         for op in ops:
             kind = op.get("op")
             if kind in _NOOP_OPS:
@@ -1651,7 +2036,8 @@ class ReplicatedBackend(PGBackendBase):
             else:
                 raise ValueError(f"unknown write op {kind!r}")
         if not delete:
-            txn.setattrs(cid, oid, {"_": _obj_meta(version, size)})
+            txn.setattrs(cid, oid,
+                         {"_": _obj_meta(version, size, extra=extra)})
         return txn, results, delete
 
     def _maybe_ack(self, reqid: str):
@@ -1705,12 +2091,27 @@ class ReplicatedBackend(PGBackendBase):
             if kind in _NOOP_OPS:
                 results.append({})
             elif kind == "read":
-                length = op.get("len")
-                data = store.read(cid, src, int(op.get("off", 0)),
-                                  None if length is None else int(length))
-                results.append({"data": data.hex()})
+                meta = self._read_local_meta(src)
+                if _meta_extra(meta) is not None:
+                    # sealed object: expand to logical, then slice
+                    full = self.pg.unseal_payload(
+                        store.read(cid, src), meta)
+                    off = int(op.get("off", 0))
+                    length = op.get("len")
+                    end = (len(full) if length is None
+                           else off + int(length))
+                    results.append({"data": full[off:end].hex()})
+                else:
+                    length = op.get("len")
+                    data = store.read(
+                        cid, src, int(op.get("off", 0)),
+                        None if length is None else int(length))
+                    results.append({"data": data.hex()})
             elif kind == "stat":
-                results.append({"size": store.stat(cid, src)["size"],
+                meta = self._read_local_meta(src)
+                size = (int(meta["size"]) if meta and "size" in meta
+                        else store.stat(cid, src)["size"])
+                results.append({"size": size,
                                 "version": self._object_version(oid)})
             elif kind == "getxattr":
                 results.append(
@@ -1910,6 +2311,27 @@ class ReplicatedBackend(PGBackendBase):
                 rows[key] = val.hex()
         return clones or None, rows or None
 
+    @staticmethod
+    def _dedup_payload(store, attrs) -> dict | None:
+        """{fp: chunk frame hex} for a manifested head's push — chunk
+        payloads travel with the manifest so the target can ingest
+        them into its own refcount index."""
+        from ..compress import dedup as dd
+        try:
+            meta = json.loads(bytes(attrs.get("_", b"{}")) or b"{}")
+        except ValueError:
+            return None
+        frames = {}
+        for fp, _ln in dd.manifest_entries(meta):
+            if fp in frames:
+                continue
+            try:
+                frames[fp] = bytes(store.read(
+                    dd.DEDUP_COLL, dd.chunk_oid(fp))).hex()
+            except KeyError:
+                continue
+        return frames or None
+
     def push_object(self, peer: int, oid: str, version: tuple):
         pg, daemon = self.pg, self.pg.daemon
         cid = pg.cid
@@ -1926,7 +2348,8 @@ class ReplicatedBackend(PGBackendBase):
             attrs={k: v.hex() for k, v in attrs.items()},
             omap={k: v.hex() for k, v in omap.items()},
             version=list(version), from_osd=daemon.whoami,
-            pull_tid=None, clones=clones, snapmap=snaprows))
+            pull_tid=None, clones=clones, snapmap=snaprows,
+            dedup=self._dedup_payload(daemon.store, attrs)))
 
     def recover_primary_object(self, oid: str, version: tuple):
         """Pull from any peer whose info covers the version."""
@@ -1954,7 +2377,8 @@ class ReplicatedBackend(PGBackendBase):
             omap={k: v.hex() for k, v in omap.items()},
             version=meta.get("version", list(ZERO)),
             from_osd=daemon.whoami, pull_tid=msg.pull_tid,
-            clones=clones, snapmap=snaprows))
+            clones=clones, snapmap=snaprows,
+            dedup=self._dedup_payload(daemon.store, attrs)))
 
     def apply_push(self, msg: M.MOSDPGPush):
         pg, daemon = self.pg, self.pg.daemon
@@ -1966,14 +2390,39 @@ class ReplicatedBackend(PGBackendBase):
             # and the cluster re-push forever
             pg.missing.pop(msg.oid, None)
             return
+        from ..compress import dedup as dd
+        old_meta = None
+        try:
+            old_meta = json.loads(bytes(daemon.store.getattr(
+                cid, msg.oid, "_")))
+        except (KeyError, ValueError):
+            pass
         t = Transaction()
         if not daemon.store.collection_exists(cid):
             t.create_collection(cid)
         t.remove(cid, msg.oid)
-        t.write(cid, msg.oid, 0, bytes.fromhex(msg.data))
+        t.touch(cid, msg.oid)
+        if msg.data:
+            t.write(cid, msg.oid, 0, bytes.fromhex(msg.data))
         if msg.attrs:
             t.setattrs(cid, msg.oid,
                        {k: bytes.fromhex(v) for k, v in msg.attrs.items()})
+        # dedup bookkeeping: ingest the pushed manifest's chunks (one
+        # ref per entry) BEFORE releasing the replaced local copy's
+        # references — shared chunks must never dip to zero
+        new_meta = None
+        try:
+            new_meta = json.loads(bytes.fromhex(
+                (msg.attrs or {}).get("_", "")))
+        except ValueError:
+            pass
+        frames = msg.dedup or {}
+        for fp, _ln in dd.manifest_entries(new_meta):
+            if fp in frames:
+                t.dedup_ingest(dd.DEDUP_COLL, fp,
+                               bytes.fromhex(frames[fp]))
+        for fp, _ln in dd.manifest_entries(old_meta):
+            t.dedup_release(dd.DEDUP_COLL, fp)
         if msg.omap:
             t.omap_setkeys(cid, msg.oid, {
                 k: bytes.fromhex(v) for k, v in msg.omap.items()})
@@ -2099,8 +2548,11 @@ class ECBackend(PGBackendBase):
             def on_chunks(decoded, meta):
                 size = int(meta.get("size", 0))
                 k = self.engine.k
-                old = b"".join(
-                    decoded[i].tobytes() for i in range(k))[:size]
+                stored = (int(meta.get("stored", size))
+                          if "comp" in meta else size)
+                raw = b"".join(
+                    decoded[i].tobytes() for i in range(k))[:stored]
+                old = pg.unseal_payload(raw, meta)
                 try:
                     self._apply_ops(msg, reqid, old)
                 except Exception as e:   # noqa: BLE001 — same
@@ -2212,7 +2664,13 @@ class ECBackend(PGBackendBase):
                     "layer": "device", "kernel": "gf_encode",
                     "bytes": len(data), "k": k, "m": m})
 
-            def _encoded(comp, _dlen=len(data)):
+            def _fail(e):
+                self._inflight.pop(reqid, None)
+                self._active_reqids.discard(reqid)
+                self._release_rmw(oid)
+                pg._reply(msg, -22, f"write failed: {e!r}")
+
+            def _encoded(comp, _extra, _dlen=len(data)):
                 with daemon.lock:
                     if span is not None:
                         if comp.info:
@@ -2224,35 +2682,53 @@ class ECBackend(PGBackendBase):
                     if reqid not in self._active_reqids:
                         return      # op reset (on_change) mid-encode
                     if comp.error is not None:
-                        self._inflight.pop(reqid, None)
-                        self._active_reqids.discard(reqid)
-                        self._release_rmw(oid)
-                        pg._reply(msg, -22,
-                                  f"write failed: {comp.error!r}")
+                        _fail(comp.error)
                         return
                     shard_chunks, hinfos = comp.value
                     try:
                         self._finish_apply(
                             msg, reqid, oid, entry, version, results,
                             shard_chunks, hinfos, delete, attr_ops,
-                            _dlen)
+                            _dlen, extra=_extra)
                     except Exception as e:   # noqa: BLE001 — poisoned
                         # op past encode: same cleanup as submit_write
-                        self._inflight.pop(reqid, None)
-                        self._active_reqids.discard(reqid)
-                        self._release_rmw(oid)
-                        pg._reply(msg, -22, f"write failed: {e!r}")
+                        _fail(e)
 
-            with daemon.profiler.bind():
-                daemon.batch_engine.submit_encode(
-                    self.engine, data, span=span, callback=_encoded)
+            def _encode(payload, extra):
+                with daemon.profiler.bind():
+                    daemon.batch_engine.submit_encode(
+                        self.engine, payload, span=span,
+                        callback=lambda comp: _encoded(comp, extra))
+
+            if pg.compression_on:
+                # inline compression before the erasure code: the
+                # SEALED payload is what shards into chunks — hinfo
+                # CRCs stay consistent across replicas, scrub and
+                # recovery move sealed bytes, reads truncate the
+                # decoded concat to `stored` then expand
+                def _sealed(err, stored, extra, _ingest):
+                    with daemon.lock:
+                        if reqid not in self._active_reqids:
+                            return
+                        if err is not None:
+                            _fail(err)
+                            return
+                        try:
+                            _encode(stored, extra)
+                        except Exception as e:   # noqa: BLE001
+                            _fail(e)
+
+                pg.seal_payload(data, span, _sealed)
+            else:
+                _encode(data, None)
             return
         self._finish_apply(msg, reqid, oid, entry, version, results,
                            None, None, delete, attr_ops, None)
 
     def _finish_apply(self, msg: M.MOSDOp, reqid: str, oid: str,
                       entry, version, results, shard_chunks, hinfos,
-                      delete: bool, attr_ops, logical_size):
+                      delete: bool, attr_ops, logical_size,
+                      extra=None):
         """The post-encode half of a write: min_size gate, per-shard
         transactions, primary-applies-last fan-out.  Runs inline for
         data-less ops and as the batch engine's completion for
@@ -2294,7 +2770,8 @@ class ECBackend(PGBackendBase):
         remote = [(s, o) for s, o in live if o != daemon.whoami]
         local_txns = [self._shard_txn(s, oid, shard_chunks, delete,
                                       attr_ops, version,
-                                      logical_size, hinfos=hinfos)
+                                      logical_size, hinfos=hinfos,
+                                      extra=extra)
                       for s, _ in local]
         state = {"waiting": {s for s, _ in remote}, "msg": msg,
                  "version": version, "results": results,
@@ -2307,7 +2784,7 @@ class ECBackend(PGBackendBase):
         for s, o in remote:
             txn = self._shard_txn(s, oid, shard_chunks, delete,
                                   attr_ops, version, logical_size,
-                                  hinfos=hinfos)
+                                  hinfos=hinfos, extra=extra)
             daemon.send_to_osd(o, M.MOSDECSubOpWrite(
                 reqid=reqid, pgid=str(pg.pgid), shard=s,
                 epoch=daemon.osdmap.epoch, txn=txn.to_dict(),
@@ -2318,7 +2795,7 @@ class ECBackend(PGBackendBase):
 
     def _shard_txn(self, shard: int, oid: str, chunks, delete: bool,
                    attr_ops, version, logical_size,
-                   hinfos=None) -> Transaction:
+                   hinfos=None, extra=None) -> Transaction:
         pg = self.pg
         cid = pg.cid_for_shard(shard)
         t = Transaction()
@@ -2336,7 +2813,7 @@ class ECBackend(PGBackendBase):
             t.truncate(cid, oid, 0)
             t.write(cid, oid, 0, chunk)
             t.setattrs(cid, oid, {"_": _obj_meta(
-                version, logical_size, hinfo=hinfo)})
+                version, logical_size, hinfo=hinfo, extra=extra)})
         # attr-only mutations leave "_" untouched: it carries the
         # shard's data hinfo, which an attr update must not clobber
         # (the log entry alone records the new version)
@@ -2877,8 +3354,11 @@ class ECBackend(PGBackendBase):
             return
         meta = st.get("meta") or {}
         size = int(meta.get("size", 0))
-        payload = np.concatenate(
-            [decoded[i] for i in sorted(st["want"])]).tobytes()[:size]
+        stored = (int(meta.get("stored", size))
+                  if "comp" in meta else size)
+        raw = np.concatenate(
+            [decoded[i] for i in sorted(st["want"])]).tobytes()[:stored]
+        payload = self.pg.unseal_payload(raw, meta)
         results = []
         msg = st["msg"]
         for op in msg.ops:
@@ -2916,7 +3396,8 @@ class ECBackend(PGBackendBase):
                 attrs={"_": _obj_meta(
                     tuple(meta.get("version", version)),
                     int(meta.get("size", 0)),
-                    hinfo=crc32c(chunk)).hex()},
+                    hinfo=crc32c(chunk),
+                    extra=_meta_extra(meta)).hex()},
                 omap={}, version=list(version),
                 from_osd=pg.daemon.whoami, pull_tid=None))
 
@@ -2944,7 +3425,8 @@ class ECBackend(PGBackendBase):
             t.write(cid, oid, 0, chunk)
             t.setattrs(cid, oid, {"_": _obj_meta(
                 tuple(meta.get("version", version)),
-                int(meta.get("size", 0)), hinfo=crc32c(chunk))})
+                int(meta.get("size", 0)), hinfo=crc32c(chunk),
+                extra=_meta_extra(meta))})
             pg.daemon.store.queue_transaction(t)
             pg._pulls.pop(pull_tid, None)
             pg.missing.pop(oid, None)

@@ -106,7 +106,10 @@ class Rados:
     def create_pool(self, name: str, *, pg_num: int = 8,
                     pool_type: str = "replicated", size: int = 3,
                     erasure_code_profile: str = "", rule: int = 0,
-                    min_size: int | None = None):
+                    min_size: int | None = None,
+                    compression_mode: str | None = None,
+                    compression_algorithm: str | None = None,
+                    dedup_enable: bool | None = None):
         cmd = {"prefix": "osd pool create", "pool": name,
                "pg_num": pg_num, "pool_type": pool_type, "size": size,
                "rule": rule}
@@ -114,6 +117,12 @@ class Rados:
             cmd["min_size"] = min_size
         if erasure_code_profile:
             cmd["erasure_code_profile"] = erasure_code_profile
+        if compression_mode is not None:
+            cmd["compression_mode"] = compression_mode
+        if compression_algorithm is not None:
+            cmd["compression_algorithm"] = compression_algorithm
+        if dedup_enable is not None:
+            cmd["dedup_enable"] = dedup_enable
         rc, outs, _ = self.monc.command(cmd)
         _raise(rc, outs)
 

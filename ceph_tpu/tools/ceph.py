@@ -12,6 +12,9 @@
     ceph -m ... pg stat | pg dump
     ceph -m ... osd tree | osd dump | osd stat | osd pool ls
     ceph -m ... osd pool create NAME [--pg-num N] [--size N] [--type T]
+        [--compression-mode M] [--compression-algorithm A] [--dedup]
+    ceph -m ... osd pool set POOL VAR VAL | osd pool get POOL [VAR]
+        (VAR incl. compression_mode|compression_algorithm|dedup_enable)
     ceph -m ... osd out ID | osd in ID | osd down ID
     ceph -m ... osd reweight ID WEIGHT
     ceph -m ... osd pool mksnap POOL SNAP | rmsnap POOL SNAP
@@ -154,12 +157,22 @@ def _dispatch(args, rest) -> int:
             sub.add_argument("--size", type=int, default=3)
             sub.add_argument("--type", default="replicated")
             sub.add_argument("--profile", default="")
+            sub.add_argument("--compression-mode", default=None)
+            sub.add_argument("--compression-algorithm", default=None)
+            sub.add_argument("--dedup", action="store_true",
+                             default=None)
             a = sub.parse_args(rest[3:])
             cmd = {"prefix": "osd pool create", "pool": a.name,
                    "pg_num": a.pg_num, "size": a.size,
                    "pool_type": a.type}
             if a.profile:
                 cmd["erasure_code_profile"] = a.profile
+            if a.compression_mode is not None:
+                cmd["compression_mode"] = a.compression_mode
+            if a.compression_algorithm is not None:
+                cmd["compression_algorithm"] = a.compression_algorithm
+            if a.dedup is not None:
+                cmd["dedup_enable"] = a.dedup
         elif rest[0] == "osd" and rest[1:2] == ["pool"] and \
                 rest[2:3] in (["mksnap"], ["rmsnap"]):
             cmd = {"prefix": f"osd pool {rest[2]}", "pool": rest[3],
@@ -175,6 +188,17 @@ def _dispatch(args, rest) -> int:
         elif rest[0] == "osd" and rest[1:2] in (["set"], ["unset"]) \
                 and len(rest) == 3:
             cmd = {"prefix": f"osd {rest[1]}", "key": rest[2]}
+        elif rest[0] == "osd" and rest[1:2] == ["pool"] and \
+                rest[2:3] == ["set"] and len(rest) == 6:
+            # `ceph osd pool set POOL VAR VAL` — the mon coerces the
+            # string val per var (pg-num ints, efficiency enums/bools)
+            cmd = {"prefix": "osd pool set", "pool": rest[3],
+                   "var": rest[4], "val": rest[5]}
+        elif rest[0] == "osd" and rest[1:2] == ["pool"] and \
+                rest[2:3] == ["get"] and len(rest) >= 4:
+            cmd = {"prefix": "osd pool get", "pool": rest[3]}
+            if len(rest) > 4:
+                cmd["var"] = rest[4]
         elif rest[0] == "osd" and rest[1:2] == ["pool"] and \
                 rest[2:3] == ["set-quota"]:
             cmd = {"prefix": "osd pool set-quota", "pool": rest[3],
@@ -369,7 +393,11 @@ def _render_iostat(out: dict) -> str:
         f"(rd {c.get('read_ops_per_sec', 0.0):.1f}, "
         f"wr {c.get('write_ops_per_sec', 0.0):.1f}), "
         f"{c.get('bytes_per_sec', 0.0):.0f} B/s, "
-        f"{c.get('launches_per_sec', 0.0):.1f} launches/s",
+        f"{c.get('launches_per_sec', 0.0):.1f} launches/s, "
+        f"comp {c.get('compress_bytes_per_sec', 0.0):.0f}→"
+        f"{c.get('compressed_bytes_per_sec', 0.0):.0f} B/s "
+        f"(rd {c.get('decompress_bytes_per_sec', 0.0):.0f}, "
+        f"fp {c.get('fingerprint_bytes_per_sec', 0.0):.0f})",
         f"{'OSD':<8}{'OP/S':>10}{'RD/S':>10}{'WR/S':>10}"
         f"{'B/S':>12}{'LAUNCH/S':>10}",
     ]
@@ -434,13 +462,26 @@ def _render(prefix: str, out) -> str | None:
     if prefix == "df":
         lines = ["--- POOLS ---",
                  f"{'NAME':<16}{'ID':>4}{'PGS':>6}{'OBJECTS':>10}"
-                 f"{'USED':>12}"]
+                 f"{'USED':>12}{'LOGICAL':>12}{'RATIO':>7}"]
         for p in out.get("pools", []):
+            ratio = p.get("compress_ratio", 1.0)
+            logical = p.get("bytes_logical", p["bytes_used"])
+            dr = p.get("dedup_ratio")
+            tail = f" dedup {dr:.2f}x" if dr is not None else ""
             lines.append(f"{p['name']:<16}{p['id']:>4}"
                          f"{p['pg_num']:>6}{p['objects']:>10}"
-                         f"{p['bytes_used']:>12}")
+                         f"{p['bytes_used']:>12}{logical:>12}"
+                         f"{ratio:>6.2f}x{tail}")
         lines.append(f"TOTAL objects={out.get('total_objects')} "
-                     f"used={out.get('total_bytes_used')}B")
+                     f"used={out.get('total_bytes_used')}B "
+                     f"logical={out.get('total_bytes_logical')}B")
+        dd = out.get("dedup") or {}
+        if dd.get("chunks"):
+            lines.append(
+                f"DEDUP chunks={dd['chunks']} refs={dd.get('refs')} "
+                f"stored={dd.get('stored_bytes')}B "
+                f"referenced={dd.get('referenced_bytes')}B "
+                f"ratio={dd.get('ratio', 1.0):.2f}x")
         return "\n".join(lines)
     if prefix == "osd df":
         lines = [f"{'ID':>4}{'UP':>6}{'PGS':>6}{'OPS':>10}"]

@@ -59,6 +59,9 @@ def osdmap_to_dict(m: OSDMap) -> dict:
             "tiers": list(p.tiers),
             "is_stretch": p.is_stretch,
             "stretch_min_size": p.stretch_min_size,
+            "compression_mode": p.compression_mode,
+            "compression_algorithm": p.compression_algorithm,
+            "dedup_enable": p.dedup_enable,
         } for p in m.pools.values()],
         "stretch": {
             "enabled": m.stretch_mode_enabled,

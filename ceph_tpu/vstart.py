@@ -297,7 +297,29 @@ class MiniCluster:
             time.sleep(0.05)
         raise TimeoutError("no active MDS")
 
+    def dedup_leak_check(self) -> list[str]:
+        """Refcount balance audit over every live OSD store: each
+        fingerprint's refcount must equal its live manifest references
+        and zero-ref chunks must be gone (deletes balance to zero).
+        Engages only on stores that ever ingested a chunk."""
+        from .compress import dedup as dd
+        problems = []
+        for i, osd in sorted(self.osds.items()):
+            store = osd.store
+            try:
+                if dd.DEDUP_COLL not in store.list_collections():
+                    continue
+            except Exception:
+                continue
+            problems += [f"osd.{i}: {p}"
+                         for p in dd.verify_refcounts(store)]
+        return problems
+
     def stop(self):
+        try:
+            dedup_problems = self.dedup_leak_check()
+        except Exception:
+            dedup_problems = []
         for c in self._fs_clients:
             try:
                 c.unmount()
@@ -334,6 +356,9 @@ class MiniCluster:
                 m.shutdown()
             except Exception:
                 pass
+        if dedup_problems:
+            raise AssertionError("dedup refcount leak at teardown: "
+                                 + "; ".join(dedup_problems))
 
     def __enter__(self):
         return self.start()
