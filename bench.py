@@ -1330,6 +1330,41 @@ def _efficiency_leg(on_tpu: bool):
     }
 
 
+def _controlplane_leg():
+    """Million-PG array control plane (no daemons, no sockets): one
+    full health-evaluator pass, one summary fold, and one balancer
+    round over a synthetic 4096-OSD / 2^20-PG harness.  The bar from
+    the array-PGMap refactor: a complete health evaluation over a
+    million PGs must stay under 100 ms on CPU — pure numpy/jax
+    reductions, so it holds on any backend."""
+    from ceph_tpu.vstart import ScaleHarness
+
+    h = ScaleHarness(n_osds=4096, pg_num=1 << 20, seed=1)
+    checks = h.evaluate()             # warm lazy caches / interning
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        checks = h.evaluate()
+        best = min(best, time.perf_counter() - t0)
+    health_ms = best * 1e3
+    assert health_ms <= 100.0, \
+        f"health eval @1M took {health_ms:.1f} ms (bar: 100 ms)"
+    t0 = time.perf_counter()
+    moves = h.balancer().optimize(max_changes=10, use_arrays=True)
+    bal_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    h.summary()
+    summary_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "n_osds": 4096, "pg_num": 1 << 20,
+        "health_eval_ms@1M": round(health_ms, 2),
+        "balancer_round_ms@1M": round(bal_ms, 2),
+        "summary_ms@1M": round(summary_ms, 2),
+        "checks": {c["code"]: c["count"] for c in checks},
+        "balancer_moves": len(moves),
+    }
+
+
 def _crush_leg():
     """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
     (BASELINE.md row 4, scaled to fit a bench-run budget)."""
@@ -1485,6 +1520,16 @@ def child_main():
             out["efficiency"] = {"error": str(e)[:200]}
     else:
         out["efficiency"] = {"skipped": "wall budget exhausted"}
+    print(json.dumps(dict(out, controlplane={"skipped": "timeout"})),
+          flush=True)
+    # million-PG array control plane: health + summary + balancer
+    if _budget_left() > 0.02:
+        try:
+            out["controlplane"] = _controlplane_leg()
+        except Exception as e:    # noqa: BLE001 — keep the headline
+            out["controlplane"] = {"error": str(e)[:200]}
+    else:
+        out["controlplane"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(out))
     try:
         dev = jax.devices()[0].device_kind

@@ -9,9 +9,14 @@ underfull ones — never violating the rule's failure domain.
 
 TPU-first: the full-pool placement matrix comes from ONE BatchMapper
 launch (`tools.osdmaptool.map_pool_pgs`) instead of the reference's
-per-PG scalar loop — this module is crush_tpu's first in-system
-consumer: every optimize() round is a batched what-if evaluation of
-the whole pool.
+per-PG scalar loop, and — since the array control-plane refactor —
+the optimize round itself is array-native: per-OSD PG counts are a
+scatter-add over the placement matrix, overfull→underfull candidates
+come from sorted deviation arrays, and the domain-conflict check is
+one boolean [pgs-on-omax, underfull] eligibility matrix per round
+instead of a per-PG dict walk.  ``optimize(use_arrays=False)`` keeps
+the original per-PG walk as the equality oracle; both paths propose
+byte-identical moves.
 
 Apply through the mon: ``{"prefix": "osd pg-upmap-items", "pgid":
 "<p.s>", "mappings": [[from, to], ...]}`` (same command the reference
@@ -23,20 +28,33 @@ from __future__ import annotations
 import numpy as np
 
 from ..crush.map import CRUSH_ITEM_NONE
-from ..osd.osdmap import OSDMap, PGid
+from ..osd.osdmap import UP, OSDMap, PGid
+
+# domain sentinel for placement slots that must not contribute a
+# used-domain (holes, the overfull OSD itself).  Must sit outside the
+# whole domain-value space: bucket ids are negative and "osd has no
+# domain" is -1 (which DOES collide with a domain-less candidate,
+# matching the legacy None-vs-None check) — so a large positive.
+_DOM_IGNORE = 1 << 62
 
 
 class UpmapBalancer:
     def __init__(self, osdmap: OSDMap, pool_id: int,
-                 use_jax: bool = True, require_batched: bool = False):
+                 use_jax: bool = True, require_batched: bool = False,
+                 placements: np.ndarray | None = None):
+        """``placements``: optional precomputed [pg_num, size] raw
+        CRUSH matrix (CRUSH_ITEM_NONE holes) — the scale harness
+        injects synthetic or cached placements so a million-PG round
+        doesn't recompute the mapping."""
         from ..utils.platform import ensure_x64
-        if use_jax:
+        if use_jax and placements is None:
             ensure_x64()        # BatchMapper needs 64-bit straw2 draws
         self.use_jax = use_jax
         self.require_batched = require_batched
         self.m = osdmap
         self.pool = osdmap.pools[pool_id]
         self.rule = osdmap.crush.rule_by_id(self.pool.crush_rule)
+        self._raw_placements = placements
         # failure-domain type of the rule's choose step (0 = osd)
         self.domain_type = 0
         for s in self.rule.steps:
@@ -71,10 +89,15 @@ class UpmapBalancer:
         return dom
 
     # -- placement snapshot ------------------------------------------------
-    def _placements(self) -> dict[PGid, list[int]]:
+    def _raw_matrix(self) -> np.ndarray:
+        if self._raw_placements is not None:
+            return self._raw_placements
         from ..tools.osdmaptool import map_pool_pgs
-        raw = map_pool_pgs(self.m, self.pool, use_jax=self.use_jax,
-                           require_batched=self.require_batched)
+        return map_pool_pgs(self.m, self.pool, use_jax=self.use_jax,
+                            require_batched=self.require_batched)
+
+    def _placements(self) -> dict[PGid, list[int]]:
+        raw = self._raw_matrix()
         place: dict[PGid, list[int]] = {}
         for seed in range(self.pool.pg_num):
             pgid = PGid(self.pool.id, seed)
@@ -84,8 +107,39 @@ class UpmapBalancer:
                            if o != CRUSH_ITEM_NONE and self.m.is_up(o)]
         return place
 
+    def _placement_matrix(self) -> np.ndarray:
+        """[pg_num, size] int64 placement with upmaps applied and
+        invalid (hole / not-up) slots as CRUSH_ITEM_NONE — the
+        array-round state.  Upmap overrides are sparse, so only those
+        rows take the per-PG path; everything else is two vectorized
+        masks over the raw CRUSH matrix."""
+        raw = np.asarray(self._raw_matrix(), dtype=np.int64)
+        mat = raw.copy()
+        pg_num, size = self.pool.pg_num, self.pool.size
+        override = {p.seed for p in self.m.pg_upmap
+                    if p.pool == self.pool.id and p.seed < pg_num}
+        override |= {p.seed for p in self.m.pg_upmap_items
+                     if p.pool == self.pool.id and p.seed < pg_num}
+        for seed in override:
+            pgid = PGid(self.pool.id, seed)
+            row = [o for o in raw[seed] if o != CRUSH_ITEM_NONE]
+            row = list(self.m._apply_upmap(pgid, row))[:size]
+            mat[seed] = CRUSH_ITEM_NONE
+            mat[seed, :len(row)] = row
+        # mask holes and down/nonexistent OSDs in one pass
+        valid = (mat >= 0) & (mat < self.m.max_osd)
+        up = np.asarray(self.m.osd_state, dtype=np.int64) & UP != 0
+        live = np.zeros_like(mat, dtype=bool)
+        live[valid] = up[mat[valid]]
+        mat[~live] = CRUSH_ITEM_NONE
+        return mat
+
     def pg_counts(self, place=None) -> np.ndarray:
-        place = place if place is not None else self._placements()
+        if place is None:
+            mat = self._placement_matrix()
+            flat = mat[mat != CRUSH_ITEM_NONE]
+            return np.bincount(flat, minlength=self.m.max_osd
+                               ).astype(np.int64)
         counts = np.zeros(self.m.max_osd, dtype=np.int64)
         for osds in place.values():
             for o in osds:
@@ -108,14 +162,105 @@ class UpmapBalancer:
             return np.zeros_like(w)
         return total_slots * w / w.sum()
 
+    def _live_mask(self) -> np.ndarray:
+        st = np.asarray(self.m.osd_state, dtype=np.int64)
+        wt = np.asarray(self.m.osd_weight, dtype=np.int64)
+        return ((st & UP) != 0) & (wt != 0)
+
+    def _rewire_items(self, pgid: PGid, omax: int,
+                      ou: int) -> list[tuple[int, int]]:
+        """The PG may sit on omax only VIA an existing upmap pair
+        (raw→omax): rewrite that pair's target instead of appending a
+        no-op (omax, ou) that _apply_upmap would ignore."""
+        items = []
+        rewired = False
+        for a, b in self.m.pg_upmap_items.get(pgid, []):
+            if b == omax and not rewired:
+                items.append((a, ou))
+                rewired = True
+            else:
+                items.append((a, b))
+        if not rewired:
+            items.append((omax, ou))
+        return items
+
     # -- optimization ------------------------------------------------------
     def optimize(self, max_changes: int = 10,
-                 deviation_stop: float = 1.0
+                 deviation_stop: float = 1.0,
+                 use_arrays: bool = True
                  ) -> dict[PGid, list[tuple[int, int]]]:
         """Propose up to max_changes pg_upmap_items changes.  Greedy
         per-round: move one replica off the currently fullest OSD to
         the most underfull compatible OSD (reference calc_pg_upmaps'
-        retry loop, simplified to single-replica swaps)."""
+        retry loop, simplified to single-replica swaps).  The default
+        array path and the legacy per-PG walk
+        (``use_arrays=False``) propose identical moves."""
+        if not use_arrays:
+            return self._optimize_legacy(max_changes, deviation_stop)
+        max_osd = self.m.max_osd
+        mat = self._placement_matrix()
+        flat = mat[mat != CRUSH_ITEM_NONE]
+        counts = np.bincount(flat, minlength=max_osd
+                             ).astype(np.float64)
+        targets = self._targets()
+        live = self._live_mask()
+        # osd → failure-domain as an array (-1: no domain recorded)
+        dom = np.full(max_osd, -1, dtype=np.int64)
+        for o, d in self._domain_of.items():
+            if 0 <= o < max_osd:
+                dom[o] = d
+        proposals: dict[PGid, list[tuple[int, int]]] = {}
+
+        for _ in range(max_changes):
+            dev = counts - targets
+            dev[~live] = 0      # ignore out/down osds entirely
+            omax = int(np.argmax(dev))
+            if dev[omax] <= deviation_stop:
+                break
+            cand = np.nonzero(live & (dev < -0.5))[0]
+            # stable sort keeps ascending-osd tie order, matching the
+            # legacy sorted(..., key=dev) walk
+            order = cand[np.argsort(dev[cand], kind="stable")]
+            rows = np.nonzero((mat == omax).any(axis=1))[0]
+            if order.size == 0 or rows.size == 0:
+                break
+            sub = mat[rows]                          # [P, S]
+            # candidate already holds a replica of the PG?
+            member = (sub[:, None, :] ==
+                      order[None, :, None]).any(axis=2)      # [P, U]
+            elig = ~member
+            if self.domain_type:
+                dsub = dom[np.clip(sub, 0, max_osd - 1)]
+                invalid = (sub == omax) | (sub < 0) | (sub >= max_osd)
+                dsub = np.where(invalid, _DOM_IGNORE, dsub)  # [P, S]
+                d_ou = dom[order]                            # [U]
+                conflict = (dsub[:, None, :] ==
+                            d_ou[None, :, None]).any(axis=2)
+                elig &= ~conflict
+            hit = elig.any(axis=1)
+            if not hit.any():
+                break
+            # first PG in seed order with a compatible candidate,
+            # then its most-underfull compatible candidate — the
+            # exact pair the legacy nested loops pick
+            r = int(np.argmax(hit))
+            ou = int(order[int(np.argmax(elig[r]))])
+            seed = int(rows[r])
+            pgid = PGid(self.pool.id, seed)
+            items = self._rewire_items(pgid, omax, ou)
+            proposals[pgid] = items
+            # apply locally for subsequent rounds
+            self.m.pg_upmap_items[pgid] = items
+            mat[seed][mat[seed] == omax] = ou
+            counts[omax] -= 1
+            counts[ou] += 1
+        return proposals
+
+    def _optimize_legacy(self, max_changes: int = 10,
+                         deviation_stop: float = 1.0
+                         ) -> dict[PGid, list[tuple[int, int]]]:
+        """The original per-PG dict walk, kept verbatim as the
+        equality oracle for the array round."""
         place = self._placements()
         counts = self.pg_counts(place).astype(np.float64)
         targets = self._targets()
@@ -151,20 +296,7 @@ class UpmapBalancer:
                     if self.domain_type and \
                             self._domain_of.get(ou) in used_domains:
                         continue
-                    # the PG may sit on omax only VIA an existing
-                    # upmap pair (raw→omax): rewrite that pair's
-                    # target instead of appending a no-op (omax, ou)
-                    # that _apply_upmap would ignore
-                    items = []
-                    rewired = False
-                    for a, b in self.m.pg_upmap_items.get(pgid, []):
-                        if b == omax and not rewired:
-                            items.append((a, ou))
-                            rewired = True
-                        else:
-                            items.append((a, b))
-                    if not rewired:
-                        items.append((omax, ou))
+                    items = self._rewire_items(pgid, omax, ou)
                     proposals[pgid] = items
                     # apply locally for subsequent rounds
                     self.m.pg_upmap_items[pgid] = items
@@ -184,6 +316,5 @@ class UpmapBalancer:
 
     def stddev(self) -> float:
         counts = self.pg_counts().astype(np.float64)
-        live = [o for o in range(self.m.max_osd)
-                if self.m.is_up(o) and not self.m.is_out(o)]
+        live = self._live_mask()
         return float(np.std(counts[live]))

@@ -133,25 +133,45 @@ class Exporter:
                      if first else None)
                 first = False
 
-        # cluster-wide scrub totals from the PGMap (per-OSD rates come
-        # from the perf-dump scrape below: scrub_digest_bytes etc.)
+        # cluster-wide scrub totals + per-pool/per-state PG gauges
+        # from the mon's array PGMap: ONE `pg summary` reply of
+        # masked reductions per scrape — never a per-PG dump, so
+        # scrape time stays flat as PG count grows.  `pg dump` is the
+        # fallback for mons (or test fakes) that don't serve it.
         try:
-            rc, _, dump = self.monc.command({"prefix": "pg dump"})
+            rc, _, summ = self.monc.command({"prefix": "pg summary"})
         except Exception:
-            rc, dump = -1, None
-        if rc == 0 and dump:
-            pg_stats = (dump.get("pg_stats") or {}).values()
-            emit("ceph_pg_scrub_errors",
-                 sum(st.get("scrub_errors", 0) for st in pg_stats),
+            rc, summ = -1, None
+        if rc != 0 or not summ or "scrub_errors" not in summ:
+            summ = self._summary_from_dump()
+        if summ is not None:
+            emit("ceph_pg_scrub_errors", summ["scrub_errors"],
                  help_="scrub inconsistencies outstanding")
             emit("ceph_pg_inconsistent_objects",
-                 sum(len(st.get("inconsistent_objects") or [])
-                     for st in pg_stats),
+                 summ["inconsistent_objects"],
                  help_="objects flagged by list-inconsistent-obj")
+            first = True
+            for pid, pool in sorted(
+                    (summ.get("pools") or {}).items()):
+                lab = {"name": str(pool.get("name", "")),
+                       "pool_id": str(pid)}
+                emit("ceph_pool_pg_total", pool.get("pgs", 0),
+                     labels=lab,
+                     help_="reported PGs per pool" if first else None)
+                emit("ceph_pool_objects", pool.get("objects", 0),
+                     labels=lab,
+                     help_="objects per pool" if first else None)
+                for state, n in sorted(
+                        (pool.get("by_state") or {}).items()):
+                    emit("ceph_pool_pgs_by_state", n,
+                         labels={**lab, "state": state},
+                         help_="PGs per pool and state"
+                         if first else None)
+                first = False
             # slow-op gauges (reference ceph_healthcheck_slow_ops +
             # per-daemon slow op counts): fed from the osd_stats each
             # OSD reports out of its op tracker
-            osd_stats = dump.get("osd_stats") or {}
+            osd_stats = summ.get("osd_stats") or {}
             total_slow, worst_age = 0, 0.0
             first = True
             for name, st in sorted(osd_stats.items()):
@@ -256,6 +276,26 @@ class Exporter:
                             emit_type(base, "counter")
                         emit(base, val, labels=lab)
         return "\n".join(lines) + "\n"
+
+    def _summary_from_dump(self) -> dict | None:
+        """`pg summary`-shaped totals rebuilt from a legacy
+        `pg dump` (compat path for old mons / test doubles)."""
+        try:
+            rc, _, dump = self.monc.command({"prefix": "pg dump"})
+        except Exception:
+            rc, dump = -1, None
+        if rc != 0 or not dump:
+            return None
+        pg_stats = (dump.get("pg_stats") or {}).values()
+        return {
+            "scrub_errors": sum(st.get("scrub_errors", 0)
+                                for st in pg_stats),
+            "inconsistent_objects": sum(
+                len(st.get("inconsistent_objects") or [])
+                for st in pg_stats),
+            "pools": {},
+            "osd_stats": dump.get("osd_stats") or {},
+        }
 
     @staticmethod
     def _emit_device_series(emit, emit_type, view):

@@ -80,7 +80,11 @@ class IostatModule(MgrModule):
                       "read_op_per_sec": 0.0}
 
     def _totals(self) -> dict | None:
-        rc, _, dump = self.ctx.mon_command({"prefix": "pg dump"})
+        # osd_stats counters only: `pg summary` carries them without
+        # a per-PG dump (fall back for mons that don't serve it)
+        rc, _, dump = self.ctx.mon_command({"prefix": "pg summary"})
+        if rc != 0 or not dump or "osd_stats" not in dump:
+            rc, _, dump = self.ctx.mon_command({"prefix": "pg dump"})
         if rc != 0 or not dump:
             return None
         tot = {"op": 0.0, "op_w": 0.0, "op_r": 0.0}

@@ -114,18 +114,43 @@ class ProgressModule(MgrModule):
         out = {o for o in range(m.max_osd)
                if m.exists(o) and m.is_out(o)}
         prev, self._prev_out = self._prev_out, out
+        # `pg summary` serves the recovery/scrub totals and the
+        # sparse mid-flight chunk positions as mon-side reductions —
+        # O(pools + scrubbing PGs) instead of a full per-PG dump.
+        # Fall back to `pg dump` against mons (or test fakes) that
+        # don't serve it.
         try:
-            rc, _, dump = self.ctx.mon_command({"prefix": "pg dump"})
+            rc, _, summ = self.ctx.mon_command(
+                {"prefix": "pg summary"})
         except Exception:       # noqa: BLE001 — mon churn: next tick
             return
-        if rc != 0 or not dump:
-            return
-        pg_stats = dump.get("pg_stats") or {}
-        work = sum(int(st.get("missing", 0))
-                   + int(st.get("backfill_remaining", 0))
-                   for st in pg_stats.values())
-        scrubbing = sum(1 for st in pg_stats.values()
-                        if "scrubbing" in str(st.get("state", "")))
+        if rc == 0 and summ and "missing" in summ:
+            work = int(summ.get("missing", 0)) \
+                + int(summ.get("backfill_remaining", 0))
+            scrubbing = int(summ.get("scrubbing_pgs", 0))
+            scrub_pos = {pgid: (int(d), int(t)) for pgid, (d, t)
+                         in (summ.get("scrubbing") or {}).items()}
+        else:
+            try:
+                rc, _, dump = self.ctx.mon_command(
+                    {"prefix": "pg dump"})
+            except Exception:   # noqa: BLE001 — mon churn: next tick
+                return
+            if rc != 0 or not dump:
+                return
+            pg_stats = dump.get("pg_stats") or {}
+            work = sum(int(st.get("missing", 0))
+                       + int(st.get("backfill_remaining", 0))
+                       for st in pg_stats.values())
+            scrubbing = sum(1 for st in pg_stats.values()
+                            if "scrubbing" in str(st.get("state", "")))
+            scrub_pos = {}
+            for pgid, st in pg_stats.items():
+                total = int(st.get("scrub_chunks_total") or 0)
+                if "scrubbing" in str(st.get("state", "")) \
+                        and total > 0:
+                    scrub_pos[pgid] = (
+                        int(st.get("scrub_chunks_done") or 0), total)
 
         if prev is not None:
             for o in sorted(out - prev):
@@ -164,17 +189,12 @@ class ProgressModule(MgrModule):
         # `ceph progress` narrates individual sweeps, not just the
         # cluster-wide scrub-sweep aggregate below
         seen: set[str] = set()
-        for pgid, st in pg_stats.items():
-            total = int(st.get("scrub_chunks_total") or 0)
-            if "scrubbing" not in str(st.get("state", "")) \
-                    or total <= 0:
-                continue
+        for pgid, (done, total) in scrub_pos.items():
             eid = f"pg_scrub/{pgid}"
             seen.add(eid)
             ev = self.events.get(eid)
             if ev is None:
                 ev = self._open(eid, f"Scrubbing pg {pgid}", now)
-            done = int(st.get("scrub_chunks_done") or 0)
             self._advance(ev, done / total, now)
         for eid in [e for e in self.events
                     if e.startswith("pg_scrub/") and e not in seen]:

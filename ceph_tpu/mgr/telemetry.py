@@ -28,6 +28,8 @@ from __future__ import annotations
 import collections
 import time
 
+import numpy as np
+
 from .daemon import MgrModule
 
 # counters lifted verbatim off each osd_stats beacon into rings
@@ -36,11 +38,21 @@ _COUNTERS = ("op", "op_w", "op_r", "op_in_bytes")
 
 class SeriesRing:
     """Fixed-capacity (t, value) ring: when full, decimate by two and
-    double the sampling stride — old history thins, recent stays."""
+    double the sampling stride — old history thins, recent stays.
+
+    Backed by one preallocated ``[capacity+1, 2]`` float64 buffer so
+    a telemetry spine tracking thousands of daemons never churns
+    per-sample Python tuples: appends are two scalar stores,
+    decimation is a strided copy, and ``rate()`` reads the tail
+    directly."""
+
+    __slots__ = ("capacity", "_buf", "_len", "_stride", "_pending")
 
     def __init__(self, capacity: int = 256):
         self.capacity = max(4, int(capacity))
-        self.samples: list[tuple[float, float]] = []
+        # +1: the overflowing sample lands before decimation
+        self._buf = np.empty((self.capacity + 1, 2), dtype=np.float64)
+        self._len = 0
         self._stride = 1
         self._pending = 0
 
@@ -49,51 +61,70 @@ class SeriesRing:
         if self._pending < self._stride:
             return
         self._pending = 0
-        self.samples.append((t, float(v)))
-        if len(self.samples) > self.capacity:
-            self.samples = self.samples[::2]
+        self._buf[self._len, 0] = t
+        self._buf[self._len, 1] = v
+        self._len += 1
+        if self._len > self.capacity:
+            kept = self._buf[:self._len:2].copy()
+            self._len = len(kept)
+            self._buf[:self._len] = kept
             self._stride *= 2
 
+    @property
+    def samples(self) -> list[tuple[float, float]]:
+        """Materialized (t, value) tuples — the legacy list shape for
+        dump/test surfaces; hot paths read the buffer directly."""
+        return [(float(t), float(v))
+                for t, v in self._buf[:self._len]]
+
+    def array(self) -> np.ndarray:
+        """The live [n, 2] window (no copy) for vectorized consumers."""
+        return self._buf[:self._len]
+
     def last(self) -> tuple[float, float] | None:
-        return self.samples[-1] if self.samples else None
+        if self._len == 0:
+            return None
+        t, v = self._buf[self._len - 1]
+        return (float(t), float(v))
 
     def rate(self) -> float:
         """Per-second rate from the two most recent samples of a
         cumulative counter (>= 0: restarts step counters backwards)."""
-        if len(self.samples) < 2:
+        if self._len < 2:
             return 0.0
-        (t0, v0), (t1, v1) = self.samples[-2], self.samples[-1]
+        t0, v0 = self._buf[self._len - 2]
+        t1, v1 = self._buf[self._len - 1]
         dt = t1 - t0
         if dt <= 0:
             return 0.0
-        return max(0.0, (v1 - v0) / dt)
+        return max(0.0, float((v1 - v0) / dt))
 
     def __len__(self):
-        return len(self.samples)
+        return self._len
 
 
-def hist_quantile(counts: list[int], q: float) -> float:
+def hist_quantile(counts, q: float) -> float:
     """Approximate quantile of a log2-bucketed histogram (bucket i
     holds values in [2^i - 1, 2^(i+1) - 1)): returns the upper bound
-    of the bucket where the cumulative count crosses q."""
-    total = sum(counts)
+    of the bucket where the cumulative count crosses q — one
+    cumsum + searchsorted instead of a Python scan."""
+    c = np.asarray(counts, dtype=np.float64)
+    total = float(c.sum())
     if total <= 0:
         return 0.0
-    target = q * total
-    cum = 0
-    for i, c in enumerate(counts):
-        cum += c
-        if cum >= target:
-            return float((1 << (i + 1)) - 1)
-    return float((1 << len(counts)) - 1)
+    cum = np.cumsum(c)
+    i = int(np.searchsorted(cum, q * total, side="left"))
+    i = min(i, len(c) - 1)
+    return float((1 << (i + 1)) - 1)
 
 
-def _hist_delta(new: list[int], old: list[int]) -> list[int]:
-    if not old or len(old) != len(new):
-        return list(new)
-    d = [n - o for n, o in zip(new, old)]
+def _hist_delta(new, old) -> np.ndarray:
+    n = np.asarray(new, dtype=np.int64)
+    if old is None or len(old) != len(n):
+        return n
+    d = n - np.asarray(old, dtype=np.int64)
     # a reset profiler steps buckets backwards: fall back to lifetime
-    return list(new) if any(v < 0 for v in d) else d
+    return n if bool((d < 0).any()) else d
 
 
 class TelemetrySpine(MgrModule):
@@ -119,8 +150,14 @@ class TelemetrySpine(MgrModule):
             counter, SeriesRing(self.RING_CAPACITY))
 
     def serve_tick(self):
+        # only the osd_stats beacons are ingested — `pg summary`
+        # carries them without materializing a per-PG dump; fall back
+        # to `pg dump` for mons that don't serve it
         try:
-            rc, _, dump = self.ctx.mon_command({"prefix": "pg dump"})
+            rc, _, dump = self.ctx.mon_command({"prefix": "pg summary"})
+            if rc != 0 or not dump or "osd_stats" not in dump:
+                rc, _, dump = self.ctx.mon_command(
+                    {"prefix": "pg dump"})
         except Exception:       # noqa: BLE001 — mon churn: next tick
             return
         if rc != 0 or not dump:
@@ -190,11 +227,12 @@ class TelemetrySpine(MgrModule):
         s, c = self._latency.get(daemon), self._lat_count.get(daemon)
         if s is None or c is None or len(s) < 2 or len(c) < 2:
             return 0.0
-        ds = s.samples[-1][1] - s.samples[-2][1]
-        dc = c.samples[-1][1] - c.samples[-2][1]
+        sv, cv = s.array()[:, 1], c.array()[:, 1]
+        ds = float(sv[-1] - sv[-2])
+        dc = float(cv[-1] - cv[-2])
         if dc <= 0:
             # nothing completed this window: lifetime average instead
-            tot_s, tot_c = s.samples[-1][1], c.samples[-1][1]
+            tot_s, tot_c = float(sv[-1]), float(cv[-1])
             return 1000.0 * tot_s / tot_c if tot_c > 0 else 0.0
         return 1000.0 * max(ds, 0.0) / dc
 

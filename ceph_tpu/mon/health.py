@@ -28,100 +28,15 @@ import json
 import time
 
 from ..osd.osdmap import CLUSTER_FLAGS
+from .pgmap import PG_STALE_GRACE, LegacyPGMap, PGMap  # noqa: F401
 from .service import PaxosService
 
-PG_STALE_GRACE = 6.0     # seconds without a primary report → stale
 # PG_NOT_SCRUBBED: warn when a PG's effective scrub stamp is older
 # than this (reference: osd_scrub_interval × mon_warn ratio).  Module
 # constants so tests can shrink them without threading config through
 # the pure evaluators.
 SCRUB_WARN_INTERVAL = 1.5 * 86400.0
 NEARFULL_RATIO = 0.85    # OSD_NEARFULL: bytes_used / bytes_total
-
-
-class PGMap:
-    """Cluster-wide PG state aggregation (reference ``src/mon/
-    PGMap.cc``; held in memory on the leader like the modern mgr's
-    copy — stats are telemetry, not paxos state)."""
-
-    def __init__(self):
-        # pgid str → {"state", "num_objects", ..., "osd", "stamp"}
-        self.pg_stats: dict[str, dict] = {}
-        self.osd_stats: dict[int, dict] = {}
-
-    def apply_report(self, osd: int, pg_stats: dict, osd_stats: dict):
-        now = time.time()
-        for pgid, st in (pg_stats or {}).items():
-            st = dict(st)
-            st["osd"] = osd
-            st["stamp"] = now
-            self.pg_stats[pgid] = st
-        if osd_stats:
-            self.osd_stats[osd] = dict(osd_stats, stamp=now)
-
-    def prune(self, live_pools: set[int]):
-        """Drop stats for PGs of deleted pools — their primaries stop
-        reporting, and without pruning they'd read as stale forever
-        (reference: PGMap consumes pool deletions from the OSDMap)."""
-        for pgid in list(self.pg_stats):
-            try:
-                pool = int(pgid.split(".", 1)[0])
-            except ValueError:
-                pool = -1
-            if pool not in live_pools:
-                del self.pg_stats[pgid]
-
-    def states(self, total_expected: int | None = None) -> dict:
-        """state string → count; primaries silent past the grace are
-        'stale+<last state>', PGs never reported at all are
-        'unknown' (reference pg states of the same names)."""
-        now = time.time()
-        out: dict[str, int] = {}
-        for st in self.pg_stats.values():
-            s = st.get("state", "unknown")
-            if now - st["stamp"] > PG_STALE_GRACE:
-                s = f"stale+{s}"
-            out[s] = out.get(s, 0) + 1
-        if total_expected is not None:
-            known = len(self.pg_stats)
-            if total_expected > known:
-                out["unknown"] = out.get("unknown", 0) + \
-                    (total_expected - known)
-        return out
-
-    def num_objects(self) -> int:
-        return sum(int(st.get("num_objects", 0))
-                   for st in self.pg_stats.values())
-
-    def pool_usage(self, live_pools: set[int]) -> dict[int, list]:
-        """pool id → [objects, stored_bytes, logical_bytes], pruned
-        to live pools first so a deleted pool's stale stats can't
-        count against a reused id.  stored is PHYSICAL (post
-        compression/dedup); logical is what clients wrote."""
-        self.prune(live_pools)
-        usage: dict[int, list] = {}
-        for pgid_s, st in self.pg_stats.items():
-            try:
-                pid = int(pgid_s.split(".", 1)[0])
-            except ValueError:
-                continue
-            row = usage.setdefault(pid, [0, 0, 0])
-            row[0] += int(st.get("num_objects", 0))
-            row[1] += int(st.get("num_bytes", 0))
-            row[2] += int(st.get("num_bytes_logical",
-                                 st.get("num_bytes", 0)))
-        return usage
-
-    def dedup_totals(self) -> dict:
-        """Cluster-wide dedup index totals summed over osd_stats (the
-        chunk store is per-OSD-global, outside any pool)."""
-        out = {"chunks": 0, "refs": 0, "stored_bytes": 0,
-               "referenced_bytes": 0}
-        for st in self.osd_stats.values():
-            d = st.get("dedup") or {}
-            for k in out:
-                out[k] += int(d.get(k, 0))
-        return out
 
 
 # -- evaluators --------------------------------------------------------------
@@ -139,7 +54,8 @@ class HealthContext:
         self.quorum = list(quorum)
         self.now = time.time() if now is None else now
         self.total_pgs = sum(p.pg_num for p in osdmap.pools.values())
-        self.states = pgmap.states(total_expected=self.total_pgs)
+        self.states = pgmap.states(total_expected=self.total_pgs,
+                                   now=self.now)
 
 
 CHECKS: list = []
@@ -267,18 +183,25 @@ def _pg_availability(ctx):
 @health_check
 def _pg_damaged(ctx):
     # scrub found inconsistencies that repair has not cleared yet —
-    # the one stock ERR-severity check (reference PG_DAMAGED)
-    bad = {pgid: int(st.get("scrub_errors", 0))
-           for pgid, st in ctx.pgmap.pg_stats.items()
-           if int(st.get("scrub_errors", 0)) > 0}
+    # the one stock ERR-severity check (reference PG_DAMAGED).  Both
+    # PGMap flavors expose the reduction; the dict fallback keeps
+    # duck-typed stand-ins working.
+    dmg = getattr(ctx.pgmap, "damaged", None)
+    if dmg is not None:
+        bad = dmg()
+    else:
+        bad = sorted((pgid, int(st.get("scrub_errors", 0)))
+                     for pgid, st in ctx.pgmap.pg_stats.items()
+                     if int(st.get("scrub_errors", 0)) > 0)
     if not bad:
         return None
+    total = sum(n for _pgid, n in bad)
     return _check("PG_DAMAGED", "ERR",
                   f"{len(bad)} pgs inconsistent "
-                  f"({sum(bad.values())} scrub errors)",
+                  f"({total} scrub errors)",
                   [f"pg {pgid} has {n} scrub errors"
-                   for pgid, n in sorted(bad.items())],
-                  count=sum(bad.values()))
+                   for pgid, n in bad],
+                  count=total)
 
 
 @health_check
@@ -307,22 +230,26 @@ def _degraded_stretch_mode(ctx):
 @health_check
 def _pg_not_scrubbed(ctx):
     # effective stamp (max of last scrub and PG creation) reported by
-    # the primary; never-reported PGs are PG_AVAILABILITY's problem
-    late = {}
-    for pgid, st in ctx.pgmap.pg_stats.items():
-        stamp = st.get("last_scrub_stamp")
-        if stamp is None:
-            continue
-        age = ctx.now - float(stamp)
-        if age > SCRUB_WARN_INTERVAL:
-            late[pgid] = age
+    # the primary; never-reported PGs are PG_AVAILABILITY's problem.
+    # SCRUB_WARN_INTERVAL is read at call time (tests monkeypatch it)
+    # and passed into the masked reduction.
+    sl = getattr(ctx.pgmap, "scrub_late", None)
+    if sl is not None:
+        late = sl(ctx.now, SCRUB_WARN_INTERVAL)
+    else:
+        late = sorted(
+            (pgid, ctx.now - float(st["last_scrub_stamp"]))
+            for pgid, st in ctx.pgmap.pg_stats.items()
+            if st.get("last_scrub_stamp") is not None
+            and ctx.now - float(st["last_scrub_stamp"])
+            > SCRUB_WARN_INTERVAL)
     if not late:
         return None
     return _check(
         "PG_NOT_SCRUBBED", "WARN",
         f"{len(late)} pgs not scrubbed in time",
         [f"pg {pgid} not scrubbed for {age:.0f}s"
-         for pgid, age in sorted(late.items())])
+         for pgid, age in late])
 
 
 @health_check
@@ -565,10 +492,30 @@ class HealthMonitor(PaxosService):
         if prefix == "pg dump":
             self.mon.pgmap.prune(
                 set(self.mon.services["osdmap"].osdmap.pools))
-            return 0, "", {"pg_stats": self.mon.pgmap.pg_stats,
+            # materialized plain dicts: the reply is JSON-encoded on
+            # the wire, and the array PGMap's view doesn't serialize
+            pgm = self.mon.pgmap
+            stats = pgm.dump() if hasattr(pgm, "dump") else \
+                {k: dict(v) for k, v in pgm.pg_stats.items()}
+            return 0, "", {"pg_stats": stats,
                            "osd_stats": {
                                str(o): s for o, s in
-                               self.mon.pgmap.osd_stats.items()}}
+                               pgm.osd_stats.items()}}
+        if prefix == "pg summary":
+            # the O(pools + offenders) aggregate the mgr-side loops
+            # (exporter scrapes, progress/telemetry ticks) consume
+            # instead of materializing a full per-PG dump
+            m = self.mon.services["osdmap"].osdmap
+            total_pgs = sum(p.pg_num for p in m.pools.values())
+            out = self.mon.pgmap.summary(
+                live_pools=set(m.pools), now=time.time(),
+                total_expected=total_pgs)
+            names = {str(pid): name
+                     for name, pid in m.pool_name.items()}
+            for pid, row in out.get("pools", {}).items():
+                if pid in names:
+                    row["name"] = names[pid]
+            return 0, "", out
         if prefix == "pg list-inconsistent-obj":
             # the `rados list-inconsistent-obj` backend: the primary's
             # last scrub report as carried by MPGStats into the PGMap

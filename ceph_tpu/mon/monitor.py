@@ -346,15 +346,15 @@ class OSDMonitor(PaxosService):
         return False
 
     def _stretch_recovery_done(self, m: OSDMap) -> bool:
-        """Every PG of every stretch pool reports active+clean."""
-        stats = self.mon.pgmap.pg_stats
+        """Every PG of every stretch pool reports active+clean (one
+        masked reduction per pool on the array PGMap)."""
+        pgm = self.mon.pgmap
         for pool in m.pools.values():
             if not pool.is_stretch:
                 continue
-            for seed in range(pool.pg_num):
-                st = stats.get(f"{pool.id}.{seed:x}")
-                if st is None or st.get("state") != "active+clean":
-                    return False
+            if pgm.pool_clean_count(pool.id, pool.pg_num) \
+                    != pool.pg_num:
+                return False
         return True
 
     def _check_quotas(self, cur) -> list:

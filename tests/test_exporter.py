@@ -113,6 +113,63 @@ class TestExposition:
         assert unescaped == nasty
 
 
+class _SummaryMonc:
+    """MonClient stand-in serving the array PGMap's `pg summary`
+    reply — the mon-side reduction runs once at construction, the way
+    a scrape sees it as one aggregate command reply."""
+
+    def __init__(self, harness):
+        summ = harness.summary()
+        summ["pools"] = {
+            pid: dict(p, name=f"pool{pid}")
+            for pid, p in summ["pools"].items()}
+        self._summary = summ
+
+    def command(self, cmd):
+        if cmd.get("prefix") == "pg summary":
+            return 0, "", self._summary
+        return -22, "unknown", None
+
+
+class TestScrapeFlatVsPGCount:
+    def test_pool_gauges_come_from_summary(self):
+        from ceph_tpu.vstart import ScaleHarness
+        h = ScaleHarness(n_osds=16, pg_num=256, seed=2)
+        text = Exporter(_SummaryMonc(h)).collect()
+        assert re.search(
+            r'ceph_pool_pg_total\{name="pool0",pool_id="0"\} 256',
+            text), text
+        by_state = {
+            m.group(1): int(float(m.group(2)))
+            for m in re.finditer(
+                r'ceph_pool_pgs_by_state\{name="pool0",pool_id="0",'
+                r'state="([^"]+)"\} (\S+)', text)}
+        assert sum(by_state.values()) == 256
+        assert by_state.get("active+clean", 0) > 200
+        # slow-op families still render from summary osd_stats
+        assert "ceph_cluster_slow_ops 0" in text
+
+    def test_scrape_time_flat_as_pgs_grow(self):
+        # the scrape consumes per-pool/per-state aggregates, never a
+        # per-PG dump: 32x the PGs must not move collect() time
+        # beyond noise (the old dump-walk path scaled linearly)
+        from ceph_tpu.vstart import ScaleHarness
+        small = Exporter(_SummaryMonc(
+            ScaleHarness(n_osds=64, pg_num=1 << 14, seed=2)))
+        big = Exporter(_SummaryMonc(
+            ScaleHarness(n_osds=64, pg_num=1 << 19, seed=2)))
+        small.collect(), big.collect()          # warm
+        t_small = min(_timed(small.collect) for _ in range(5))
+        t_big = min(_timed(big.collect) for _ in range(5))
+        assert t_big < t_small * 5 + 2e-3, (t_small, t_big)
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 class TestExporter:
     def test_metrics_endpoint(self):
         c = MiniCluster(n_mons=1, n_osds=2)
