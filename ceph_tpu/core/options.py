@@ -105,6 +105,14 @@ def build_options() -> list[Option]:
         Option("osd_mclock_scheduler_scrub_lim", float, 100.0,
                "scrub: limit ops/s (0 = unlimited)",
                min=0.0),
+        # per-tenant QoS overrides: JSON {tenant: [res, wgt, lim]}.
+        # A tenant named here gets its own reservation/weight/limit
+        # streams inside the client class (the limit becomes
+        # per-tenant, so capping an aggressor never caps the victim);
+        # unnamed tenants keep the class-wide triple above.
+        Option("osd_mclock_scheduler_client_qos", str, "",
+               "per-tenant client QoS: JSON {tenant: [res, wgt, "
+               "lim]} ('' = none)"),
         Option("osd_recovery_max_active", int, 3,
                "concurrent recovery ops per OSD"),
         Option("osd_scrub_interval", float, 86400.0,
@@ -173,6 +181,22 @@ def build_options() -> list[Option]:
         Option("ec_batch_stripes", int, 64,
                "stripes coalesced per TPU launch", Level.ADVANCED,
                min=1, max=65536),
+        # -- rgw front door (rgw/gateway.py) ------------------------------
+        Option("rgw_frontend_threads", int, 16,
+               "request-handler worker pool size (reference "
+               "rgw_thread_pool_size)", min=1),
+        Option("rgw_max_concurrent_requests", int, 64,
+               "admission ceiling: in-flight + queued requests above "
+               "the pool get 503 SlowDown (reference "
+               "rgw_max_concurrent_requests)", min=0),
+        Option("rgw_retry_after", float, 1.0,
+               "Retry-After seconds sent with 503 SlowDown",
+               min=0.0),
+        Option("rgw_obj_stripe_size", int, 4 << 20,
+               "multipart part bodies above this stripe into "
+               "rgw_obj_stripe_size RADOS objects written "
+               "concurrently (feeds the batch engine); 0 = never "
+               "stripe (reference rgw_obj_stripe_size)", min=0),
         # -- objectstore --------------------------------------------------
         Option("objectstore", str, "memstore", "backend",
                enum_allowed=("memstore", "kstore")),

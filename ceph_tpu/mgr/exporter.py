@@ -240,6 +240,7 @@ class Exporter:
             except Exception:
                 view = {}
             self._emit_device_series(emit, emit_type, view)
+            self._emit_slo_series(emit, view)
 
         for daemon, path in sorted(self.asok_paths.items()):
             try:
@@ -345,6 +346,51 @@ class Exporter:
                  labels={"ceph_daemon": daemon},
                  help_="client write bytes per second (windowed)"
                  if first else None)
+            first = False
+
+    @staticmethod
+    def _emit_slo_series(emit, view):
+        """SLO-harness reports ("slo ingest" → export_view()["slo"])
+        → per-tenant/per-op-class gauges.  The workload scenarios push
+        whole reports; here each (scenario, tenant, op_class) lane
+        becomes one labeled series so dashboards can plot victim vs
+        aggressor p99 side by side."""
+        slo = view.get("slo") or {}
+        first = True
+        for scenario in sorted(slo):
+            rep = slo[scenario] or {}
+            emit("ceph_slo_offered_rate",
+                 round(float(rep.get("offered_rate", 0.0)), 3),
+                 labels={"scenario": scenario},
+                 help_="open-loop offered ops per second"
+                 if first else None)
+            emit("ceph_slo_goodput_ops",
+                 round(float(rep.get("goodput_ops", 0.0)), 3),
+                 labels={"scenario": scenario},
+                 help_="ops/s completed OK and within SLO target"
+                 if first else None)
+            for tenant in sorted(rep.get("tenants") or {}):
+                lanes = rep["tenants"][tenant] or {}
+                for klass in sorted(lanes):
+                    lane = lanes[klass] or {}
+                    lab = {"scenario": scenario, "tenant": tenant,
+                           "op_class": klass}
+                    for q in ("p50_ms", "p99_ms", "p999_ms"):
+                        emit(f"ceph_slo_latency_{q}",
+                             round(float(lane.get(q, 0.0)), 3),
+                             labels=lab)
+                    emit("ceph_slo_ops_total",
+                         int(lane.get("count", 0)), labels=lab)
+                    emit("ceph_slo_throttled_total",
+                         int(lane.get("throttled", 0)), labels=lab)
+                    emit("ceph_slo_errors_total",
+                         int(lane.get("errors", 0)), labels=lab)
+                    emit("ceph_slo_in_violation",
+                         int(bool(lane.get("in_violation"))),
+                         labels=lab)
+                    emit("ceph_slo_violation_seconds",
+                         round(float(lane.get("violation_s", 0.0)),
+                               3), labels=lab)
             first = False
 
     @staticmethod

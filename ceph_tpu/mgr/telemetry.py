@@ -142,6 +142,8 @@ class TelemetrySpine(MgrModule):
         self._hists: dict[str, collections.deque] = {}
         self._latency: dict[str, SeriesRing] = {}  # op_latency sum ring
         self._lat_count: dict[str, SeriesRing] = {}
+        # latest SLO-harness report per scenario ("slo ingest")
+        self.slo: dict[str, dict] = {}
 
     # -- ingest ------------------------------------------------------------
 
@@ -308,10 +310,12 @@ class TelemetrySpine(MgrModule):
 
     def export_view(self) -> dict:
         """What the prometheus exporter consumes: latest profiler
-        aggregate + derived rates per daemon."""
+        aggregate + derived rates per daemon + the last SLO-harness
+        reports."""
         return {"profiler": dict(self.profiler),
                 "rates": {d: self.daemon_rates(d)
-                          for d in self.series}}
+                          for d in self.series},
+                "slo": dict(self.slo)}
 
     def handle_command(self, cmd: dict):
         prefix = cmd.get("prefix", "")
@@ -321,4 +325,15 @@ class TelemetrySpine(MgrModule):
             return 0, "", self.osd_perf()
         if prefix == "telemetry series":
             return 0, "", self.series_dump(cmd.get("daemon"))
+        if prefix == "slo ingest":
+            report = cmd.get("report")
+            if not isinstance(report, dict):
+                return -22, "", "slo ingest needs a report dict"
+            self.slo[str(cmd.get("scenario") or "default")] = report
+            return 0, "", ""
+        if prefix == "slo report":
+            scenario = cmd.get("scenario")
+            if scenario is not None:
+                return 0, "", self.slo.get(str(scenario), {})
+            return 0, "", dict(self.slo)
         return None

@@ -1202,8 +1202,14 @@ class OSDaemon(Dispatcher):
                 rho = int(dmc.get("rho", 1))
             except (TypeError, ValueError):
                 delta = rho = 1
+            # the tenant QoS tag (RGW auth uid) outranks the wire
+            # entity as the mClock client key: isolation is
+            # per-tenant, not per-connection — every connection a
+            # tenant opens shares ONE set of QoS streams
             self.op_queue.enqueue(
-                klass, msg, client=getattr(msg, "client", None),
+                klass, msg,
+                client=(getattr(msg, "qos_client", None)
+                        or getattr(msg, "client", None)),
                 delta=delta, rho=rho)
         else:
             self.op_queue.enqueue(klass, msg)

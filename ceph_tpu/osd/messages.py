@@ -29,10 +29,14 @@ class MOSDOp(_JsonMessage):
     per-op "snapid" for snapshot reads.  ``dmc``: distributed-dmclock
     feedback {"delta", "rho"} — how many of this client's requests
     completed anywhere (delta) / under reservation (rho) since its
-    last request to THIS osd (reference src/dmclock ReqParams)."""
+    last request to THIS osd (reference src/dmclock ReqParams).
+    ``qos_client``: optional tenant/uid QoS tag (reference the rgw
+    user riding req_state) — when set, the mClock scheduler keys its
+    per-client streams by tenant instead of the wire entity, so
+    noisy-neighbor isolation is per-tenant, not per-connection."""
     TYPE = 40
     FIELDS = ("tid", "client", "pgid", "oid", "epoch", "ops", "flags",
-              "snapc", "dmc", "trace")
+              "snapc", "dmc", "trace", "qos_client")
 
 
 @register_message

@@ -151,9 +151,19 @@ class MClockScheduler:
     def __init__(self,
                  profiles: dict[str, tuple[float, float, float]]
                  | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 client_qos: dict[str, tuple[float, float, float]]
+                 | None = None):
         self.profiles = self._normalize(
             profiles or default_mclock_profiles())
+        # per-tenant overrides inside the CLIENT class (reference
+        # dmclock's per-client ClientInfo, exposed upstream through
+        # rgw qos / the mclock client profiles): a tenant named here
+        # gets its own (res, wgt, lim) — including a PRIVATE limit
+        # stream, so capping an aggressor tenant never throttles the
+        # victim sharing the class
+        self.client_qos = self._normalize(dict(client_qos or {}))
+        self._client_lim_prev: dict[str, float] = {}
         self.clock = clock
         # per (class, client): deque of (r_tag, p_tag, l_tag, item)
         # — distributed dmclock tracks R/P tags per client within a
@@ -191,16 +201,24 @@ class MClockScheduler:
                 return
             now = self.clock()
             res, wgt, lim = self.profiles.get(klass, _MCLOCK_FALLBACK)
+            override = (klass == CLIENT
+                        and client in self.client_qos)
+            if override:
+                res, wgt, lim = self.client_qos[client]
             key = (klass, client)
             pr, pp = self._prev.get(key, (-_INF, -_INF))
-            pl = self._lim_prev.get(klass, -_INF)
+            pl = (self._client_lim_prev.get(client, -_INF) if override
+                  else self._lim_prev.get(klass, -_INF))
             delta = max(int(delta), 1)
             rho = max(int(rho), 1)
             r = max(now, pr + rho / res) if res > 0 else _INF
             p = max(now, pp + delta / max(wgt, 1e-9))
             lt = max(now, pl + 1.0 / lim) if lim > 0 else 0.0
             self._prev[key] = (r if res > 0 else pr, p)
-            self._lim_prev[klass] = lt
+            if override:
+                self._client_lim_prev[client] = lt
+            else:
+                self._lim_prev[klass] = lt
             self._last_seen[key] = now
             self._queues.setdefault(key,
                                     collections.deque()).append(
@@ -240,6 +258,8 @@ class MClockScheduler:
             del self._queues[key]
             self._prev.pop(key, None)
             self._last_seen.pop(key, None)
+            if key[0] == CLIENT:
+                self._client_lim_prev.pop(key[1], None)
         choice = best_r or best_p
         if choice is None:
             return None, wake
@@ -311,6 +331,19 @@ class MClockScheduler:
             self.profiles.update(self._normalize(profiles))
             self._cv.notify_all()
 
+    def set_client_qos(self, client_qos: dict[str, tuple[float, float,
+                                                         float]]):
+        """Replace the per-tenant override map on a live scheduler
+        (runtime `config set osd_mclock_scheduler_client_qos`).
+        Tenants dropped from the map fall back to the class-wide
+        triple; their private limit stream is forgotten."""
+        with self._cv:
+            self.client_qos = self._normalize(dict(client_qos))
+            for c in list(self._client_lim_prev):
+                if c not in self.client_qos:
+                    del self._client_lim_prev[c]
+            self._cv.notify_all()
+
     def close(self):
         with self._cv:
             self._closed = True
@@ -345,6 +378,32 @@ def profiles_from_config(config) -> dict[str, tuple[float, float,
     return out
 
 
+def client_qos_from_config(config) -> dict[str, tuple[float, float,
+                                                      float]]:
+    """Parse osd_mclock_scheduler_client_qos: JSON
+    ``{tenant: [res, wgt, lim]}``.  Untrusted operator input —
+    malformed JSON or triples degrade to no overrides / skip the
+    entry rather than killing the daemon."""
+    import json
+    text = str(config.get("osd_mclock_scheduler_client_qos") or "")
+    if not text.strip():
+        return {}
+    try:
+        raw = json.loads(text)
+    except ValueError:
+        return {}
+    out = {}
+    if isinstance(raw, dict):
+        for tenant, triple in raw.items():
+            try:
+                res, wgt, lim = (float(triple[0]), float(triple[1]),
+                                 float(triple[2]))
+            except (TypeError, ValueError, IndexError, KeyError):
+                continue
+            out[str(tenant)] = (res, wgt, lim)
+    return out
+
+
 def make_op_queue(config):
     """The `osd_op_queue` seam (reference OpScheduler::make_scheduler):
     the option enum is honest — "mclock" builds the QoS scheduler,
@@ -353,14 +412,20 @@ def make_op_queue(config):
     matching the reference's runtime-adjustable dmclock options)."""
     kind = config.get("osd_op_queue")
     if kind == "mclock":
-        q = MClockScheduler(profiles_from_config(config))
+        q = MClockScheduler(profiles_from_config(config),
+                            client_qos=client_qos_from_config(config))
 
         def _retune(_name, _val):
             q.reload_profiles(profiles_from_config(config))
+
+        def _retune_qos(_name, _val):
+            q.set_client_qos(client_qos_from_config(config))
 
         for opt in ("client", "subop", "recovery", "scrub"):
             for suffix in ("res", "wgt", "lim"):
                 config.add_observer(
                     f"osd_mclock_scheduler_{opt}_{suffix}", _retune)
+        config.add_observer("osd_mclock_scheduler_client_qos",
+                            _retune_qos)
         return q
     return WeightedPriorityQueue()

@@ -95,6 +95,14 @@ class Rados:
             self.objecter.shutdown()
         self.monc.shutdown()
 
+    def set_qos_tag(self, tag: str | None):
+        """Tag ops submitted from this thread with a tenant/uid: the
+        OSDs' mClock scheduler keys its per-client QoS streams by the
+        tag (per-tenant isolation even when many tenants share one
+        connection).  None clears."""
+        if self.objecter:
+            self.objecter.set_qos_tag(tag)
+
     def mgr_command(self, cmd: dict | str,
                     timeout: float | None = None):
         """Command served by the active mgr (reference

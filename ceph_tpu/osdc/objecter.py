@@ -32,9 +32,10 @@ from ..tools.osdmaptool import osdmap_from_dict
 class _Op:
     __slots__ = ("tid", "pool", "oid", "ops", "on_reply", "pgid",
                  "target_osd", "attempts", "submitted", "direct",
-                 "next_resend", "resend_delay", "span")
+                 "next_resend", "resend_delay", "span", "qos_client")
 
-    def __init__(self, tid, pool, oid, ops, on_reply, direct=False):
+    def __init__(self, tid, pool, oid, ops, on_reply, direct=False,
+                 qos_client=None):
         self.tid = tid
         self.pool = pool
         self.oid = oid
@@ -49,6 +50,7 @@ class _Op:
         self.next_resend = 0.0
         self.resend_delay = 0.0
         self.span = None            # objecter op span when tracing
+        self.qos_client = qos_client    # tenant tag for mClock
 
 
 class BackoffRegistry:
@@ -154,7 +156,18 @@ class Objecter(Dispatcher):
         self._dmc_total = 0
         self._dmc_res = 0
         self._dmc_osd_snap: dict[int, tuple[int, int]] = {}
+        # per-thread tenant QoS tag: the RGW front door serves many
+        # tenants over ONE objecter, so the tag rides thread-local
+        # state (set around each request) and is captured onto the op
+        # at submit — resends keep the original tenant attribution
+        self._qos_local = threading.local()
         self._map_waiters: list[threading.Event] = []
+        # server-directed backoffs (MOSDBackoff): ops targeting a
+        # blocked (osd, pg) park here instead of resending.  Must
+        # exist BEFORE the osdmap callback is hooked up — _on_osdmap
+        # prunes it, and the first map can land on the dispatch
+        # thread while __init__ is still running
+        self.backoffs = BackoffRegistry(expire_s=backoff_expire)
         self.monc.on_osdmap = self._on_osdmap
         self.monc.sub_want("osdmap")
         # op resend tick: an op can be dropped server-side by an
@@ -167,9 +180,6 @@ class Objecter(Dispatcher):
         self._resend_max = resend_max
         self._resend_jitter = resend_jitter
         self._rng = random.Random()
-        # server-directed backoffs (MOSDBackoff): ops targeting a
-        # blocked (osd, pg) park here instead of resending
-        self.backoffs = BackoffRegistry(expire_s=backoff_expire)
         self._stop = threading.Event()
         self._ticker = threading.Thread(
             target=self._resend_loop, name=f"{entity}-resend",
@@ -278,12 +288,22 @@ class Objecter(Dispatcher):
         return pgid, primary
 
     # -- submission --------------------------------------------------------
+    def set_qos_tag(self, tag: str | None):
+        """Tag every op submitted from THIS thread with a tenant/uid
+        for mClock client classification (None clears).  The tag is
+        per-thread, not per-objecter: a concurrent gateway sets it
+        after auth and clears it in the worker's finally."""
+        self._qos_local.tag = tag
+
+    def get_qos_tag(self) -> str | None:
+        return getattr(self._qos_local, "tag", None)
+
     def op_submit(self, pool: int, oid: str, ops: list[dict],
                   on_reply, direct: bool = False) -> int:
         with self.lock:
             self._tid += 1
             op = _Op(self._tid, pool, oid, list(ops), on_reply,
-                     direct=direct)
+                     direct=direct, qos_client=self.get_qos_tag())
             op.span = self.tracer.start_span(
                 f"objecter_op:{oid}",
                 tags={"layer": "objecter", "pool": pool,
@@ -345,6 +365,7 @@ class Objecter(Dispatcher):
                 tid=op.tid, client=self.entity, pgid=str(pgid),
                 oid=op.oid, epoch=self.osdmap.epoch, ops=op.ops,
                 flags=0, snapc=snapc, dmc=dmc,
+                qos_client=op.qos_client,
                 trace=None if op.span is None else op.span.ctx()))
         except ConnectionError:
             self._osd_cons.pop(primary, None)

@@ -35,13 +35,18 @@ same buckets/objects as the S3 dialect.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import http.client
+import io
 import json
+import math
+import socket
 import threading
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 from xml.sax.saxutils import escape as _xesc
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 
 from ..core.lockdep import Mutex
 from ..osdc.librados import ObjectNotFound
@@ -92,14 +97,30 @@ def _part_oid(bucket: str, upload_id: str, n: int) -> str:
     return f"{bucket}\x00_mp_{upload_id}\x00{n:05d}"
 
 
+def _stripe_oid(bucket: str, upload_id: str, n: int, j: int) -> str:
+    """One stripe of a striped multipart part (reference the RGW
+    manifest's rgw_obj_stripe_size layout: big parts split into
+    fixed-size tail stripes)."""
+    return f"{_part_oid(bucket, upload_id, n)}\x00s{j:04d}"
+
+
 class RGWStore:
     """The op layer (reference rgw_op.cc + rgw_rados.cc, trimmed)."""
 
-    def __init__(self, rados):
+    def __init__(self, rados, *, stripe_size: int = 0,
+                 data_pool_opts: dict | None = None):
         self.rados = rados
-        for pool in (DATA_POOL, META_POOL):
+        # stripe_size: multipart part bodies above this split into
+        # stripe_size RADOS objects written CONCURRENTLY via the aio
+        # path — on an EC data pool the stripes land in the batch
+        # engine's encode lane together and coalesce into megabatch
+        # launches (0 = never stripe)
+        self.stripe_size = int(stripe_size)
+        for pool, opts in ((DATA_POOL, data_pool_opts or {}),
+                           (META_POOL, {})):
             try:
-                rados.create_pool(pool, pg_num=8, size=2)
+                rados.create_pool(pool, **{
+                    "pg_num": 8, "size": 2, **opts})
             except Exception:
                 pass        # exists
         self.meta = rados.open_ioctx(META_POOL)
@@ -903,18 +924,59 @@ class RGWStore:
             "_key": key.encode()})
         return upload_id
 
+    def _part_row_oids(self, bucket: str, upload_id: str, k: str,
+                       row: bytes | dict | None) -> list[str]:
+        """Every data oid a part row references (striped or not)."""
+        if row is None:
+            return []
+        meta = (row if isinstance(row, dict)
+                else json.loads(bytes(row)))
+        return (meta.get("stripes")
+                or [_part_oid(bucket, upload_id, int(k))])
+
     def put_part(self, bucket: str, upload_id: str, part_num: int,
                  body: bytes) -> str:
         if not 1 <= part_num <= 10000:
             raise ValueError("part number out of range")
-        rows = self.meta.omap_get(_mp_oid(bucket, upload_id))  # raises
-        del rows
+        rows = self.meta.omap_get(_mp_oid(bucket, upload_id),
+                                  keys=[f"{part_num:05d}"])  # raises
+        old = rows.get(f"{part_num:05d}")
         etag = hashlib.md5(body).hexdigest()
-        self.data.write_full(_part_oid(bucket, upload_id, part_num),
-                             body)
+        meta = {"size": len(body), "etag": etag}
+        ss = self.stripe_size
+        if ss > 0 and len(body) > ss:
+            # stripe the part across stripe_size RADOS objects and
+            # write them CONCURRENTLY: the aio writes arrive at the
+            # OSDs together, so on an EC/compressing data pool they
+            # coalesce in the batch engine instead of round-tripping
+            # the device once per stripe
+            oids = [_stripe_oid(bucket, upload_id, part_num, j)
+                    for j in range((len(body) + ss - 1) // ss)]
+            comps = [self.data.aio_write_full(o, body[j * ss:
+                                                      (j + 1) * ss])
+                     for j, o in enumerate(oids)]
+            for c in comps:
+                if not c.wait_for_complete(30.0):
+                    raise TimeoutError("stripe write timed out")
+                if c.rc != 0:
+                    raise OSError(c.rc, "stripe write failed")
+            meta["stripes"] = oids
+            new_oids = set(oids)
+        else:
+            self.data.write_full(
+                _part_oid(bucket, upload_id, part_num), body)
+            new_oids = {_part_oid(bucket, upload_id, part_num)}
+        # a re-uploaded part may shrink (fewer stripes) or switch
+        # layout: remove the previous upload's now-orphaned oids
+        for o in self._part_row_oids(bucket, upload_id,
+                                     f"{part_num:05d}", old):
+            if o not in new_oids:
+                try:
+                    self.data.remove(o)
+                except Exception:
+                    pass
         self.meta.omap_set(_mp_oid(bucket, upload_id), {
-            f"{part_num:05d}": json.dumps({
-                "size": len(body), "etag": etag}).encode()})
+            f"{part_num:05d}": json.dumps(meta).encode()})
         return etag
 
     def list_parts(self, bucket: str, upload_id: str) -> list[dict]:
@@ -939,8 +1001,11 @@ class RGWStore:
             "size": sum(m["size"] for _, m in parts),
             "etag": etag,
             "mtime": _time.time(),
-            "parts": [_part_oid(bucket, upload_id, n)
-                      for n, _ in parts],
+            # striped parts flatten into the manifest in stripe order
+            # — GET/_drop_parts walk one flat oid list either way
+            "parts": [o for n, m in parts
+                      for o in (m.get("stripes")
+                                or [_part_oid(bucket, upload_id, n)])],
         }
         oid, lk = self._key_index_ref(bucket, key)
         with lk:
@@ -963,14 +1028,14 @@ class RGWStore:
             rows = self.meta.omap_get(_mp_oid(bucket, upload_id))
         except ObjectNotFound:
             return
-        for k in rows:
+        for k, v in rows.items():
             if k == "_key":
                 continue
-            try:
-                self.data.remove(
-                    _part_oid(bucket, upload_id, int(k)))
-            except Exception:
-                pass
+            for o in self._part_row_oids(bucket, upload_id, k, v):
+                try:
+                    self.data.remove(o)
+                except Exception:
+                    pass
         self.meta.remove(_mp_oid(bucket, upload_id))
 
     def list_multipart_uploads(self, bucket: str) -> list[dict]:
@@ -1075,7 +1140,27 @@ class _Handler(BaseHTTPRequestHandler):
                          f"</Error>".encode())
         return False
 
+    def _tag_tenant(self, uid: str | None):
+        """Stamp this worker thread's RADOS ops with the caller's
+        tenant: the tag rides every MOSDOp as ``qos_client`` and keys
+        the OSDs' mClock per-client streams, so QoS isolation follows
+        the TENANT (all its connections together), not the gateway's
+        shared client entity.  Unauthenticated deployments can tag
+        via the ``x-rgw-tenant`` header (test/bench hook)."""
+        tag = uid or self.headers.get("x-rgw-tenant")
+        if tag:
+            try:
+                self.store.rados.set_qos_tag(f"rgw:{tag}")
+            except Exception:   # noqa: BLE001 — QoS tagging is
+                pass            # advisory, never fails a request
+
     def _check_auth(self, body: bytes) -> bool:
+        ok = self._check_auth_inner(body)
+        if ok:
+            self._tag_tenant(getattr(self, "_auth_uid", None))
+        return ok
+
+    def _check_auth_inner(self, body: bytes) -> bool:
         """Auth + authorization gate (reference rgw_auth_s3.cc +
         rgw_iam_policy): a signed request resolves to its user; an
         UNSIGNED request proceeds as anonymous and may only do what a
@@ -1204,6 +1289,7 @@ class _Handler(BaseHTTPRequestHandler):
         ok, uid = self._swift_identity()
         if not ok:
             return self._reply(401)
+        self._tag_tenant(uid)
         parts = rest.split("/", 1) if rest else []
         container = parts[0] if parts else None
         obj = parts[1] if len(parts) > 1 else None
@@ -1514,26 +1600,240 @@ class _Handler(BaseHTTPRequestHandler):
         return self._reply(204, headers=hdrs)
 
 
+class _BufferedSocket:
+    """Duck-typed socket for replaying ONE parsed request through a
+    `BaseHTTPRequestHandler` off-reactor: the already-read request
+    bytes come out of `makefile`, the handler's response bytes land
+    in `captured` (the stdlib handler writes via ``sendall`` — its
+    default wfile is a ``_SocketWriter`` over the connection)."""
+
+    def __init__(self, raw: bytes):
+        self._in = io.BytesIO(raw)
+        self._out = bytearray()
+
+    def makefile(self, mode="rb", *a, **kw):
+        return self._in
+
+    def sendall(self, data):
+        self._out += data
+
+    def settimeout(self, t):
+        pass
+
+    def setsockopt(self, *a):
+        pass
+
+    def shutdown(self, how):
+        pass
+
+    def close(self):
+        pass
+
+    @property
+    def captured(self) -> bytes:
+        return bytes(self._out)
+
+
+def _one_shot(handler_cls):
+    """A handler subclass whose `handle` serves exactly ONE request
+    (the front door framed it already) instead of the stdlib's
+    read-until-EOF loop — which would always force
+    ``close_connection`` when the buffered request runs dry and lose
+    the real keep-alive decision.  `parse_request` re-derives
+    close_connection from the request's own headers/protocol, so the
+    post-run flag is the true verdict."""
+
+    class _OneShot(handler_cls):
+        def handle(self):
+            self.close_connection = True
+            try:
+                self.handle_one_request()
+            finally:
+                # worker threads are pooled: never leak one request's
+                # tenant QoS tag into the next tenant's ops
+                st = getattr(self, "store", None)
+                if st is not None:
+                    try:
+                        st.rados.set_qos_tag(None)
+                    except Exception:   # noqa: BLE001
+                        pass
+
+    return _OneShot
+
+
+_RESP_500 = (b"HTTP/1.1 500 Internal Server Error\r\n"
+             b"Content-Length: 0\r\nConnection: close\r\n\r\n")
+
+
+class _AsyncFrontDoor:
+    """The concurrent request front end (reference rgw_asio_frontend:
+    a reactor accepting/framing requests + a bounded worker pool
+    executing them).  One asyncio loop thread parses HTTP framing
+    (header block + Content-Length body) per connection; admitted
+    requests run on a `pool_size` executor, at most `max_concurrent`
+    in flight (executing + queued).  Saturation answers **503
+    SlowDown with Retry-After** immediately instead of letting the
+    accept queue build invisible latency — bounded admission is what
+    keeps an open-loop load test honest."""
+
+    def __init__(self, handler_cls, host: str = "127.0.0.1",
+                 port: int = 0, *, pool_size: int = 16,
+                 max_concurrent: int = 64, retry_after: float = 1.0):
+        self._oneshot = _one_shot(handler_cls)
+        self.pool_size = max(1, int(pool_size))
+        self.max_concurrent = int(max_concurrent)   # 0 = unlimited
+        self.retry_after = float(retry_after)
+        # bind synchronously so the port is known at construction
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((host, port))
+        sock.listen(128)
+        self._sock = sock
+        self.port = sock.getsockname()[1]
+        self._pool = ThreadPoolExecutor(
+            self.pool_size, thread_name_prefix="rgw-http")
+        self._inflight = 0          # loop-thread confined
+        self.stats = {"accepted": 0, "rejected": 0}
+        self._loop = asyncio.new_event_loop()
+        self._tasks: set = set()
+        self._stop_ev = None
+        self._thread = threading.Thread(
+            target=self._run, name="rgw-frontdoor", daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+        self._stop_ev = asyncio.Event()
+        try:
+            self._loop.run_until_complete(self._serve())
+        finally:
+            self._loop.close()
+
+    async def _serve(self):
+        server = await asyncio.start_server(self._client,
+                                            sock=self._sock)
+        await self._stop_ev.wait()
+        server.close()
+        await server.wait_closed()
+        for t in list(self._tasks):
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    def _canned_503(self, head_only: bool) -> bytes:
+        body = (b"<Error><Code>SlowDown</Code>"
+                b"<Message>request pool saturated</Message></Error>")
+        hdr = (f"HTTP/1.1 503 Slow Down\r\n"
+               f"Content-Type: application/xml\r\n"
+               f"Content-Length: {len(body)}\r\n"
+               f"Retry-After: {max(1, math.ceil(self.retry_after))}"
+               f"\r\n\r\n").encode()
+        return hdr if head_only else hdr + body
+
+    async def _client(self, reader, writer):
+        self._tasks.add(asyncio.current_task())
+        try:
+            while True:
+                try:
+                    head = await reader.readuntil(b"\r\n\r\n")
+                except (asyncio.IncompleteReadError,
+                        asyncio.LimitOverrunError, ConnectionError):
+                    break
+                length = 0
+                for line in head.split(b"\r\n")[1:]:
+                    if line[:15].lower() == b"content-length:":
+                        try:
+                            length = int(line.split(b":", 1)[1])
+                        except ValueError:
+                            length = 0
+                try:
+                    body = (await reader.readexactly(length)
+                            if length > 0 else b"")
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                method = head.split(b" ", 1)[0].upper()
+                if self.max_concurrent \
+                        and self._inflight >= self.max_concurrent:
+                    # the body was drained above, so the connection
+                    # stays framed — reject THIS request, keep it
+                    self.stats["rejected"] += 1
+                    writer.write(self._canned_503(method == b"HEAD"))
+                    await writer.drain()
+                    continue
+                self.stats["accepted"] += 1
+                self._inflight += 1
+                try:
+                    resp, close = await self._loop.run_in_executor(
+                        self._pool, self._handle, head + body)
+                finally:
+                    self._inflight -= 1
+                writer.write(resp)
+                await writer.drain()
+                if close:
+                    break
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._tasks.discard(asyncio.current_task())
+            try:
+                writer.close()
+            except Exception:   # noqa: BLE001
+                pass
+
+    def _handle(self, raw: bytes) -> tuple[bytes, bool]:
+        sock = _BufferedSocket(raw)
+        try:
+            h = self._oneshot(sock, ("127.0.0.1", 0), None)
+            close = h.close_connection
+        except Exception:   # noqa: BLE001 — a handler crash must
+            return _RESP_500, True   # not kill the worker
+        out = sock.captured
+        if not out:
+            return _RESP_500, True
+        return out, close
+
+    def shutdown(self):
+        if self._thread.is_alive() and self._stop_ev is not None:
+            self._loop.call_soon_threadsafe(self._stop_ev.set)
+            self._thread.join(timeout=5.0)
+        self._pool.shutdown(wait=False)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 class RGWService:
-    """The gateway daemon: HTTP frontend bound to a RADOS cluster,
-    plus the lifecycle worker (reference RGWLC thread)."""
+    """The gateway daemon: concurrent HTTP frontend bound to a RADOS
+    cluster, plus the lifecycle worker (reference RGWLC thread).
+    `pool_size`/`max_concurrent`/`retry_after`/`stripe_size` default
+    to the rgw_* option-table values (rgw_frontend_threads,
+    rgw_max_concurrent_requests, rgw_retry_after,
+    rgw_obj_stripe_size)."""
 
     LC_INTERVAL = 5.0
 
     def __init__(self, rados, host: str = "127.0.0.1", port: int = 0,
                  require_auth: bool = False,
-                 allow_unsigned_payload: bool = False):
-        self.store = RGWStore(rados)
+                 allow_unsigned_payload: bool = False, *,
+                 pool_size: int = 16, max_concurrent: int = 64,
+                 retry_after: float = 1.0,
+                 stripe_size: int = 4 << 20,
+                 data_pool_opts: dict | None = None):
+        self.store = RGWStore(rados, stripe_size=stripe_size,
+                              data_pool_opts=data_pool_opts)
         handler = type("Handler", (_Handler,), {
             "store": self.store, "require_auth": require_auth,
             "allow_unsigned_payload": allow_unsigned_payload})
-        self.httpd = ThreadingHTTPServer((host, port), handler)
-        self.port = self.httpd.server_address[1]
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, name="rgw", daemon=True)
+        self.frontdoor = _AsyncFrontDoor(
+            handler, host, port, pool_size=pool_size,
+            max_concurrent=max_concurrent, retry_after=retry_after)
+        self.port = self.frontdoor.port
 
     def start(self):
-        self._thread.start()
+        self.frontdoor.start()
         self._lc_stop = threading.Event()
         self._lc_thread = threading.Thread(
             target=self._lc_loop, name="rgw-lc", daemon=True)
@@ -1550,24 +1850,57 @@ class RGWService:
     def shutdown(self):
         if getattr(self, "_lc_stop", None) is not None:
             self._lc_stop.set()
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        self.frontdoor.shutdown()
 
 
 class S3Client:
     """Tiny S3-dialect client for tests/tools.  With credentials it
-    SigV4-signs every request (reference: any AWS SDK client)."""
+    SigV4-signs every request (reference: any AWS SDK client).
+
+    Connections are **keep-alive, one per calling thread**: the old
+    fresh-connection-per-request client serialized on the TCP
+    handshake and hid the concurrent server's framing behavior.  A
+    request that fails on a previously-used connection (the server
+    closed an idle keep-alive) retries ONCE on a fresh one; a failure
+    on a fresh connection propagates."""
 
     def __init__(self, host: str, port: int,
                  access_key: str | None = None,
-                 secret_key: str | None = None):
+                 secret_key: str | None = None,
+                 tenant: str | None = None):
         self.host, self.port = host, port
         self.access_key, self.secret_key = access_key, secret_key
+        self.tenant = tenant        # rides x-rgw-tenant (QoS tag)
+        self._local = threading.local()
 
-    def _req(self, method: str, path: str, body: bytes = b""):
+    def _conn(self) -> tuple[http.client.HTTPConnection, bool]:
+        """→ (connection, is_reused)."""
+        con = getattr(self._local, "con", None)
+        if con is not None:
+            return con, True
         con = http.client.HTTPConnection(self.host, self.port,
                                          timeout=10)
+        self._local.con = con
+        return con, False
+
+    def _drop_conn(self, con):
+        try:
+            con.close()
+        except Exception:   # noqa: BLE001
+            pass
+        self._local.con = None
+
+    def close(self):
+        """Close THIS thread's cached connection (pooled threads
+        outliving the gateway should drop theirs)."""
+        con = getattr(self._local, "con", None)
+        if con is not None:
+            self._drop_conn(con)
+
+    def _req(self, method: str, path: str, body: bytes = b""):
         headers = {}
+        if self.tenant:
+            headers["x-rgw-tenant"] = self.tenant
         if self.access_key and self.secret_key:
             from . import sigv4
             from urllib.parse import parse_qs
@@ -1578,13 +1911,23 @@ class S3Client:
             headers.update(sigv4.sign(
                 method, raw_path, query, headers, body,
                 self.access_key, self.secret_key))
-        try:
-            con.request(method, path, body=body or None,
-                        headers=headers)
-            resp = con.getresponse()
-            return resp.status, dict(resp.getheaders()), resp.read()
-        finally:
-            con.close()
+        while True:
+            con, reused = self._conn()
+            try:
+                con.request(method, path, body=body or None,
+                            headers=headers)
+                resp = con.getresponse()
+                out = (resp.status, dict(resp.getheaders()),
+                       resp.read())
+            except (http.client.HTTPException, ConnectionError,
+                    TimeoutError, OSError):
+                self._drop_conn(con)
+                if not reused:
+                    raise
+                continue    # stale keep-alive: retry once, fresh
+            if resp.will_close:
+                self._drop_conn(con)
+            return out
 
     def make_bucket(self, b):
         return self._req("PUT", f"/{b}")[0]

@@ -157,6 +157,7 @@ class MiniCluster:
         self.mdss: dict[str, MDSDaemon] = {}
         self.mgrs: dict[str, object] = {}
         self._fs_clients: list = []
+        self._rgws: list = []
         # (injector, src, dst) triples the site primitives installed,
         # so heal_sites removes exactly what it added
         self._site_rules: list[tuple] = []
@@ -320,6 +321,11 @@ class MiniCluster:
             dedup_problems = self.dedup_leak_check()
         except Exception:
             dedup_problems = []
+        for gw in self._rgws:
+            try:
+                gw.shutdown()
+            except Exception:
+                pass
         for c in self._fs_clients:
             try:
                 c.unmount()
@@ -375,6 +381,17 @@ class MiniCluster:
                   config=config).connect()
         self._clients.append(r)
         return r
+
+    def start_rgw(self, rados=None, **kw):
+        """Start an RGW gateway against this cluster (tracked: stop()
+        shuts it down).  kwargs pass through to `RGWService`
+        (pool_size, max_concurrent, stripe_size, data_pool_opts,
+        require_auth, ...)."""
+        from .rgw import RGWService
+        gw = RGWService(rados if rados is not None else self.rados(),
+                        **kw).start()
+        self._rgws.append(gw)
+        return gw
 
     # -- fault fabric ------------------------------------------------------
     def partition_osds(self, a: int, b: int, *,

@@ -1,0 +1,21 @@
+"""Open-loop SLO harness: seeded load generation, per-tenant SLO
+tracking, and scenario scripts over the MiniCluster + RGW front door.
+"""
+
+from .generator import (S3_GET, S3_PUT, RBD_READ, RBD_WRITE, FS_READ,
+                        FS_WRITE, ArrivalSchedule, LoadGenerator,
+                        OpMix, OpRecord, TenantProfile, Throttled,
+                        merge_profiles)
+from .slo import SLOTracker
+from .scenarios import (game_day_under_load, make_executor,
+                        noisy_neighbor, publish_slo, ramp_to_collapse,
+                        schedule_fingerprint, smoke, steady_state)
+
+__all__ = [
+    "S3_GET", "S3_PUT", "RBD_READ", "RBD_WRITE", "FS_READ",
+    "FS_WRITE", "ArrivalSchedule", "LoadGenerator", "OpMix",
+    "OpRecord", "TenantProfile", "Throttled", "merge_profiles",
+    "SLOTracker", "game_day_under_load", "make_executor",
+    "noisy_neighbor", "publish_slo", "ramp_to_collapse",
+    "schedule_fingerprint", "smoke", "steady_state",
+]

@@ -1,0 +1,294 @@
+"""Open-loop load generation — seeded arrival schedules + a worker
+pool that never waits for a completion to issue the next op.
+
+Closed-loop drivers (issue → wait → issue) hide queueing collapse:
+when the server slows down, a closed loop slows its OFFERED load with
+it, so the measured latency stays flat right up to the cliff that
+production traffic — which does not politely back off — falls over.
+The open-loop generator here issues ops at their scheduled arrival
+times regardless of completions (the wrk2/"coordinated omission"
+discipline): the arrival schedule is a **pure function of the logged
+seed** (replay = identical schedule, the acceptance hook), and the
+only honesty metric is *issue-time drift* — how far behind the
+schedule the pool fell.
+
+Op classes span the three client surfaces (mixed traffic per ROADMAP
+item 2): ``s3_put``/``s3_get`` (RGW), ``rbd_write``/``rbd_read``,
+``fs_write``/``fs_read``.  The generator itself is transport-
+agnostic — an *executor* callable maps an `OpRecord` onto a real
+client call; `workload/scenarios.py` builds those.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import time
+
+# op classes (each maps to one client-surface call in scenarios.py)
+S3_PUT = "s3_put"
+S3_GET = "s3_get"
+RBD_WRITE = "rbd_write"
+RBD_READ = "rbd_read"
+FS_WRITE = "fs_write"
+FS_READ = "fs_read"
+
+
+class Throttled(Exception):
+    """The server shed this op (503 SlowDown).  Counted separately
+    from hard errors: shedding under overload is the *correct*
+    bounded-admission behavior, not a crash."""
+
+
+class ArrivalSchedule:
+    """Deterministic arrival times on [0, duration): a pure function
+    of (kind, rate, duration, seed) so a run replays exactly from its
+    logged seed."""
+
+    def __init__(self, times: list[float], *, kind: str, rate: float,
+                 duration: float, seed: int):
+        self.times = times
+        self.kind = kind
+        self.rate = float(rate)
+        self.duration = float(duration)
+        self.seed = int(seed)
+
+    @classmethod
+    def fixed(cls, rate: float, duration: float,
+              seed: int = 0) -> "ArrivalSchedule":
+        """Constant inter-arrival gap 1/rate (deterministic even
+        without the seed; it is carried for the replay log)."""
+        n = int(rate * duration)
+        return cls([i / rate for i in range(n)], kind="fixed",
+                   rate=rate, duration=duration, seed=seed)
+
+    @classmethod
+    def poisson(cls, rate: float, duration: float,
+                seed: int = 0) -> "ArrivalSchedule":
+        """Exponential inter-arrivals from a seeded RNG — the
+        memoryless arrivals real multi-tenant front doors see."""
+        rng = random.Random(seed)
+        times, t = [], 0.0
+        while True:
+            t += rng.expovariate(rate)
+            if t >= duration:
+                break
+            times.append(t)
+        return cls(times, kind="poisson", rate=rate,
+                   duration=duration, seed=seed)
+
+    @classmethod
+    def build(cls, kind: str, rate: float, duration: float,
+              seed: int = 0) -> "ArrivalSchedule":
+        if kind == "fixed":
+            return cls.fixed(rate, duration, seed)
+        if kind == "poisson":
+            return cls.poisson(rate, duration, seed)
+        raise ValueError(f"unknown schedule kind {kind!r}")
+
+    def __len__(self):
+        return len(self.times)
+
+
+class OpMix:
+    """Weighted op-class mix; the draw sequence is seeded alongside
+    the arrival schedule so replay reproduces not just WHEN ops fire
+    but WHAT each one is."""
+
+    def __init__(self, weights: dict[str, float]):
+        items = [(k, float(w)) for k, w in weights.items() if w > 0]
+        if not items:
+            raise ValueError("empty op mix")
+        self.classes = [k for k, _ in items]
+        self.weights = [w for _, w in items]
+
+    @classmethod
+    def s3_default(cls) -> "OpMix":
+        return cls({S3_PUT: 3, S3_GET: 7})
+
+    def draw(self, rng: random.Random, n: int) -> list[str]:
+        return rng.choices(self.classes, weights=self.weights, k=n)
+
+
+class OpRecord:
+    """One scheduled op: everything the executor needs, plus the
+    schedule bookkeeping the drift metric reads."""
+
+    __slots__ = ("tenant", "op_class", "t_sched", "seq", "size")
+
+    def __init__(self, tenant: str, op_class: str, t_sched: float,
+                 seq: int, size: int):
+        self.tenant = tenant
+        self.op_class = op_class
+        self.t_sched = t_sched
+        self.seq = seq
+        self.size = size
+
+    def __repr__(self):
+        return (f"OpRecord({self.tenant}:{self.op_class}"
+                f"@{self.t_sched:.4f}#{self.seq})")
+
+
+class TenantProfile:
+    """One tenant's traffic: rate, schedule kind, op mix, object
+    size.  `ops(duration)` expands it into the deterministic op list
+    — same profile + same duration ⇒ byte-identical schedule."""
+
+    def __init__(self, name: str, rate: float, *,
+                 kind: str = "poisson", mix: OpMix | None = None,
+                 size: int = 4096, seed: int = 0):
+        self.name = name
+        self.rate = float(rate)
+        self.kind = kind
+        self.mix = mix or OpMix.s3_default()
+        self.size = int(size)
+        self.seed = int(seed)
+
+    def schedule(self, duration: float) -> ArrivalSchedule:
+        return ArrivalSchedule.build(self.kind, self.rate, duration,
+                                     self.seed)
+
+    def ops(self, duration: float) -> list[OpRecord]:
+        sched = self.schedule(duration)
+        # the mix stream gets its own derived seed: inserting arrivals
+        # must not perturb WHICH ops the survivors are
+        classes = self.mix.draw(random.Random(self.seed ^ 0x5EED),
+                                len(sched))
+        return [OpRecord(self.name, k, t, i, self.size)
+                for i, (t, k) in enumerate(zip(sched.times, classes))]
+
+
+def merge_profiles(profiles: list[TenantProfile],
+                   duration: float) -> list[OpRecord]:
+    """The combined multi-tenant schedule, in arrival order (ties
+    break deterministically by tenant name + seq)."""
+    ops = [op for p in profiles for op in p.ops(duration)]
+    ops.sort(key=lambda o: (o.t_sched, o.tenant, o.seq))
+    return ops
+
+
+class LoadGenerator:
+    """Drive a merged multi-tenant schedule open-loop.
+
+    One issuer thread releases each op into the worker queue at its
+    scheduled time — it NEVER waits for a completion.  `workers` pool
+    threads execute ops via `execute(op)`; if they all lag, the queue
+    grows and per-op *issue drift* (worker-pickup time minus
+    scheduled time) records exactly how far the system fell behind
+    the offered load.  `tracker` (an `slo.SLOTracker`) gets every
+    completion."""
+
+    def __init__(self, profiles: list[TenantProfile], execute, *,
+                 duration: float, workers: int = 8, tracker=None):
+        self.profiles = profiles
+        self.execute = execute
+        self.duration = float(duration)
+        self.workers = max(1, int(workers))
+        self.tracker = tracker
+        self.ops = merge_profiles(profiles, self.duration)
+        self._q: queue.Queue = queue.Queue()
+        self._drifts: list[float] = []
+        self._lock = threading.Lock()
+        self.counts = {"issued": 0, "ok": 0, "throttled": 0,
+                       "errors": 0, "abandoned": 0}
+        self.error_samples: list[str] = []
+        self._stopped = threading.Event()
+
+    def stop(self):
+        """Abandon the unexecuted remainder of the schedule: the
+        issuer stops releasing, already-queued ops are counted as
+        ``abandoned`` instead of executed (in-flight ops finish).
+        For flood sources whose backlog nobody needs to drain —
+        e.g. a throttled noisy neighbor whose measurement window
+        has closed."""
+        self._stopped.set()
+
+    def _issuer(self, t0: float):
+        for op in self.ops:
+            delay = t0 + op.t_sched - time.monotonic()
+            if delay > 0 and self._stopped.wait(delay):
+                return
+            if self._stopped.is_set():
+                return
+            self._q.put(op)
+            with self._lock:
+                self.counts["issued"] += 1
+
+    def _worker(self, t0: float):
+        while True:
+            op = self._q.get()
+            if op is None:
+                return
+            if self._stopped.is_set():
+                with self._lock:
+                    self.counts["abandoned"] += 1
+                continue
+            start = time.monotonic()
+            drift = start - (t0 + op.t_sched)
+            ok, throttled, err = True, False, None
+            try:
+                self.execute(op)
+            except Throttled:
+                ok, throttled = False, True
+            except Exception as e:      # noqa: BLE001 — the harness
+                ok, err = False, str(e)     # must outlive bad ops
+            latency = time.monotonic() - start
+            with self._lock:
+                self._drifts.append(drift)
+                if ok:
+                    self.counts["ok"] += 1
+                elif throttled:
+                    self.counts["throttled"] += 1
+                else:
+                    self.counts["errors"] += 1
+                    if len(self.error_samples) < 8:
+                        self.error_samples.append(
+                            f"{op.op_class}: {err}")
+            if self.tracker is not None:
+                self.tracker.record(op.tenant, op.op_class, latency,
+                                    ok=ok, throttled=throttled)
+
+    def run(self) -> dict:
+        """Execute the whole schedule; → the open-loop report."""
+        t0 = time.monotonic()
+        if self.tracker is not None:
+            self.tracker.start(t0=t0, offered=len(self.ops),
+                               duration=self.duration)
+        threads = [threading.Thread(target=self._worker, args=(t0,),
+                                    name=f"wl-worker-{i}",
+                                    daemon=True)
+                   for i in range(self.workers)]
+        for t in threads:
+            t.start()
+        issuer = threading.Thread(target=self._issuer, args=(t0,),
+                                  name="wl-issuer", daemon=True)
+        issuer.start()
+        issuer.join()
+        for _ in threads:
+            self._q.put(None)
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+        with self._lock:
+            drifts = sorted(self._drifts)
+            counts = dict(self.counts)
+        n = len(drifts)
+        mean_drift = (sum(drifts) / n) if n else 0.0
+        p99_drift = drifts[min(n - 1, int(0.99 * n))] if n else 0.0
+        return {
+            "offered_ops": len(self.ops),
+            "offered_rate": (len(self.ops) / self.duration
+                             if self.duration else 0.0),
+            "elapsed_s": elapsed,
+            "seeds": {p.name: p.seed for p in self.profiles},
+            "mean_drift_s": mean_drift,
+            "p99_drift_s": p99_drift,
+            "max_drift_s": drifts[-1] if n else 0.0,
+            # the honesty metric: mean lateness as a fraction of the
+            # schedule's span — <10% means the pool actually kept the
+            # offered arrival process
+            "drift_pct": (100.0 * mean_drift / self.duration
+                          if self.duration else 0.0),
+            **counts,
+        }

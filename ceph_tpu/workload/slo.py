@@ -1,0 +1,201 @@
+"""SLO tracking — per-tenant/per-op-class latency, windowed
+quantiles, goodput vs offered load, time-in-violation.
+
+Latencies land in the repo's log2 histograms
+(`core.perf_counters.LogHistogram`, the reference PerfHistogram
+shape) in **microseconds**, and quantiles come from the same
+`hist_quantile` the mgr telemetry spine uses — so a number printed by
+a scenario is bucket-for-bucket comparable with `ceph osd perf` /
+exporter output.  Windowed p50/p99/p999 subtract periodic bucket
+snapshots (counts are monotone, so window = now − snapshot(t−w)).
+
+**Goodput** counts only ops that completed OK *and* under their SLO
+target (throttled ops and SLO-busting stragglers are offered load
+that produced no good work — the gap between the two curves is the
+collapse signature).  **Violation accounting** integrates wall time
+while a tracked (tenant, op-class)'s windowed p99 sits above target.
+
+Thread-safe: one lock, taken briefly per record — the tracker rides
+inside the load generator's worker pool.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..core.perf_counters import LogHistogram
+from ..mgr.telemetry import hist_quantile
+
+X_BUCKETS = 32          # log2 µs buckets: covers ns..hours
+
+
+class _Lane:
+    """One (tenant, op_class) stream."""
+
+    __slots__ = ("hist", "snaps", "count", "ok", "throttled",
+                 "errors", "good", "lat_sum", "in_violation",
+                 "violation_s", "last_eval")
+
+    def __init__(self):
+        self.hist = LogHistogram(x_buckets=X_BUCKETS)
+        self.snaps: list[tuple[float, list[int]]] = []
+        self.count = 0
+        self.ok = 0
+        self.throttled = 0
+        self.errors = 0
+        self.good = 0           # ok AND within the SLO target
+        self.lat_sum = 0.0
+        self.in_violation = False
+        self.violation_s = 0.0
+        self.last_eval: float | None = None
+
+
+class SLOTracker:
+    """`slo_ms` maps op-class → p99 latency target in ms (`"*"` = any
+    class).  `window_s` is the sliding-quantile horizon."""
+
+    SNAP_INTERVAL_S = 0.25
+
+    def __init__(self, slo_ms: dict[str, float] | None = None, *,
+                 window_s: float = 5.0, clock=time.monotonic):
+        self.slo_ms = dict(slo_ms or {})
+        self.window_s = float(window_s)
+        self.clock = clock
+        self._lanes: dict[tuple[str, str], _Lane] = {}
+        self._lock = threading.Lock()
+        self._t0 = None
+        self._offered = 0
+        self._duration = 0.0
+
+    # -- ingest ------------------------------------------------------------
+    def start(self, *, t0: float | None = None, offered: int = 0,
+              duration: float = 0.0):
+        """Called by the generator at schedule start (optional for
+        standalone use): anchors elapsed time and the offered-load
+        denominator."""
+        with self._lock:
+            self._t0 = self.clock() if t0 is None else t0
+            self._offered += int(offered)
+            self._duration = max(self._duration, float(duration))
+
+    def target_ms(self, op_class: str) -> float | None:
+        t = self.slo_ms.get(op_class, self.slo_ms.get("*"))
+        return float(t) if t is not None else None
+
+    def record(self, tenant: str, op_class: str, latency_s: float,
+               *, ok: bool = True, throttled: bool = False):
+        now = self.clock()
+        us = max(0.0, latency_s * 1e6)
+        target = self.target_ms(op_class)
+        with self._lock:
+            lane = self._lanes.setdefault((tenant, op_class), _Lane())
+            lane.hist.add(us)
+            lane.count += 1
+            lane.lat_sum += latency_s
+            if ok:
+                lane.ok += 1
+                if target is None or latency_s * 1e3 <= target:
+                    lane.good += 1
+            elif throttled:
+                lane.throttled += 1
+            else:
+                lane.errors += 1
+            snaps = lane.snaps
+            if not snaps or now - snaps[-1][0] \
+                    >= self.SNAP_INTERVAL_S:
+                snaps.append((now, list(lane.hist.data[0])))
+                horizon = now - 2.0 * self.window_s
+                while len(snaps) > 2 and snaps[1][0] < horizon:
+                    snaps.pop(0)
+
+    # -- quantiles ---------------------------------------------------------
+    def _window_counts(self, lane: _Lane, now: float) -> list[int]:
+        cur = lane.hist.data[0]
+        base = None
+        for t, counts in reversed(lane.snaps):
+            if now - t >= self.window_s:
+                base = counts
+                break
+        if base is None:
+            return list(cur)        # younger than one window: lifetime
+        return [c - b for c, b in zip(cur, base)]
+
+    def quantiles(self, tenant: str, op_class: str,
+                  windowed: bool = False) -> dict:
+        """→ {"p50_ms", "p99_ms", "p999_ms"} (0s when no samples)."""
+        with self._lock:
+            lane = self._lanes.get((tenant, op_class))
+            if lane is None:
+                return {"p50_ms": 0.0, "p99_ms": 0.0, "p999_ms": 0.0}
+            counts = (self._window_counts(lane, self.clock())
+                      if windowed else lane.hist.data[0])
+        return {f"p{q}".replace(".", "") + "_ms":
+                hist_quantile(counts, float(f"0.{q}")) / 1e3
+                for q in ("50", "99", "999")}
+
+    # -- violation accounting ----------------------------------------------
+    def evaluate(self, now: float | None = None) -> dict[str, bool]:
+        """Tick the violation integrator: for every tracked lane with
+        an SLO target, compare the windowed p99 against it and accrue
+        time-in-violation.  → {tenant/op_class: in_violation}."""
+        now = self.clock() if now is None else now
+        out = {}
+        with self._lock:
+            for (tenant, klass), lane in self._lanes.items():
+                target = self.target_ms(klass)
+                if target is None:
+                    continue
+                p99_ms = hist_quantile(
+                    self._window_counts(lane, now), 0.99) / 1e3
+                violating = lane.count > 0 and p99_ms > target
+                if lane.in_violation and lane.last_eval is not None:
+                    lane.violation_s += now - lane.last_eval
+                lane.in_violation = violating
+                lane.last_eval = now
+                out[f"{tenant}/{klass}"] = violating
+        return out
+
+    # -- reporting ---------------------------------------------------------
+    def report(self) -> dict:
+        """The full scenario report: per-tenant/per-class quantiles +
+        counts, goodput vs offered load, violation time.  JSON-safe —
+        this dict rides `mgr_command("slo ingest")` into the
+        telemetry spine / exporter."""
+        now = self.clock()
+        elapsed = (now - self._t0) if self._t0 is not None else 0.0
+        denom = max(elapsed, 1e-9)
+        tenants: dict[str, dict] = {}
+        total_good = total_count = 0
+        with self._lock:
+            for (tenant, klass), lane in sorted(self._lanes.items()):
+                qs = {f"p{q}".replace(".", "") + "_ms":
+                      hist_quantile(lane.hist.data[0],
+                                    float(f"0.{q}")) / 1e3
+                      for q in ("50", "99", "999")}
+                total_good += lane.good
+                total_count += lane.count
+                tenants.setdefault(tenant, {})[klass] = {
+                    **qs,
+                    "count": lane.count,
+                    "ok": lane.ok,
+                    "good": lane.good,
+                    "throttled": lane.throttled,
+                    "errors": lane.errors,
+                    "mean_ms": (lane.lat_sum / lane.count * 1e3
+                                if lane.count else 0.0),
+                    "goodput_ops": lane.good / denom,
+                    "slo_ms": self.target_ms(klass),
+                    "in_violation": lane.in_violation,
+                    "violation_s": lane.violation_s,
+                }
+            offered = self._offered
+        return {
+            "elapsed_s": elapsed,
+            "offered_ops": offered,
+            "offered_rate": (offered / max(self._duration, 1e-9)
+                             if self._duration else offered / denom),
+            "completed_ops": total_count,
+            "goodput_ops": total_good / denom,
+            "tenants": tenants,
+        }

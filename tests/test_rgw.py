@@ -1,6 +1,8 @@
 """RGW S3-subset gateway over a live cluster (reference src/rgw REST
 frontend + op layer + cls_rgw bucket index, at slice scale)."""
 
+import time
+
 import pytest
 
 from ceph_tpu.rgw import RGWService, S3Client
@@ -231,3 +233,248 @@ class TestLifecycle:
         s3.make_bucket("short")          # recreate: no inherited rules
         st, _h, xml = s3.get_lifecycle("short")
         assert b"<Rule>" not in xml
+
+
+class TestFrontDoorSaturation:
+    def test_503_slowdown_when_pool_saturated(self, gateway):
+        """A 1-slot front door with its only worker wedged sheds the
+        next request with 503 SlowDown + Retry-After — and keeps the
+        connection (the body was drained), so the same client can
+        retry after backing off."""
+        import threading
+
+        c, gw, s3 = gateway
+        gw2 = RGWService(c.rados(), pool_size=1, max_concurrent=1,
+                         retry_after=2.0).start()
+        try:
+            blocked = S3Client("127.0.0.1", gw2.port)
+            shed = S3Client("127.0.0.1", gw2.port)
+            assert shed.make_bucket("sat") == 200
+            # wedge the single pool thread: hold the key's index
+            # shard lock so the PUT blocks inside the store
+            lk = gw2.store._shard_lock("sat", "k")
+            assert lk.acquire(timeout=5.0)
+            result = {}
+
+            def _put():
+                result["put"] = blocked.put("sat", "k", b"x" * 100)
+
+            t = threading.Thread(target=_put, daemon=True)
+            try:
+                t.start()
+                deadline = time.monotonic() + 5.0
+                while gw2.frontdoor._inflight < 1:
+                    assert time.monotonic() < deadline, \
+                        "PUT never occupied the pool slot"
+                    time.sleep(0.01)
+                st, hdrs, body = shed._req("GET", "/sat?")
+                assert st == 503
+                assert hdrs.get("Retry-After") == "2"
+                assert b"SlowDown" in body
+                # shed on a kept connection: the next request on the
+                # SAME client must still work once the slot frees
+            finally:
+                lk.release()
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+            assert result["put"][0] == 200
+            st, _h, _b = shed._req("GET", "/sat?")
+            assert st == 200
+            stats = gw2.frontdoor.stats
+            assert stats["rejected"] >= 1
+            assert stats["accepted"] >= 3
+        finally:
+            gw2.shutdown()
+
+
+class TestKeepAliveConcurrency:
+    def test_connection_reused_across_requests(self, gateway):
+        c, gw, _ = gateway
+        s3 = S3Client("127.0.0.1", gw.port)
+        try:
+            s3.make_bucket("ka")
+            con_after_first = s3._local.con
+            assert con_after_first is not None
+            s3.put("ka", "x", b"hello")
+            st, body = s3.get("ka", "x")
+            assert st == 200 and body == b"hello"
+            # all three rode ONE kept-alive connection
+            assert s3._local.con is con_after_first
+        finally:
+            s3.close()
+
+    def test_concurrent_clients_interleave_cleanly(self, gateway):
+        """16 threads, each PUT+GETting its own keys through one
+        shared client (per-thread connections): response framing must
+        never cross streams."""
+        import threading
+
+        c, gw, s3 = gateway
+        s3.make_bucket("conc")
+        shared = S3Client("127.0.0.1", gw.port)
+        errors = []
+
+        def _worker(i):
+            try:
+                for j in range(8):
+                    body = f"tenant{i}-obj{j}".encode() * 50
+                    st, _ = shared.put("conc", f"t{i}/o{j}", body)
+                    assert st == 200
+                    st, back = shared.get("conc", f"t{i}/o{j}")
+                    assert st == 200 and back == body, \
+                        f"cross-stream read t{i}/o{j}"
+            except Exception as e:      # noqa: BLE001
+                errors.append(f"worker{i}: {e}")
+            finally:
+                shared.close()      # drop THIS thread's connection
+
+        threads = [threading.Thread(target=_worker, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors, errors
+
+
+class TestMultipartStriping:
+    def test_striped_part_byte_identical_to_single_shot(self, gateway):
+        """Parts wider than the stripe size split into stripe-rank
+        objects; the completed object must read back byte-identical
+        to the same payload PUT in one shot."""
+        c, gw, _ = gateway
+        gws = RGWService(c.rados(), stripe_size=1024).start()
+        s3 = S3Client("127.0.0.1", gws.port)
+        try:
+            s3.make_bucket("stripes")
+            p1 = bytes(range(256)) * 20         # 5120B -> 5 stripes
+            p2 = b"tail" * 100                  # 400B -> inline
+            _, uid = s3.initiate_multipart("stripes", "wide.bin")
+            assert s3.put_part("stripes", "wide.bin", uid, 1, p1)[0] \
+                == 200
+            assert s3.put_part("stripes", "wide.bin", uid, 2, p2)[0] \
+                == 200
+            st, etag = s3.complete_multipart("stripes", "wide.bin",
+                                             uid)
+            assert st == 200
+            st, striped = s3.get("stripes", "wide.bin")
+            assert st == 200
+            # the reference: the same body as one single-shot PUT
+            s3.put("stripes", "oneshot.bin", p1 + p2)
+            st, oneshot = s3.get("stripes", "oneshot.bin")
+            assert st == 200
+            assert striped == oneshot == p1 + p2
+            # deleting the object drops every stripe object too
+            assert s3.delete("stripes", "wide.bin") == 204
+            io = gws.store.data
+            import pytest as _pytest
+            for j in range(5):
+                with _pytest.raises(Exception):
+                    io.read(f"stripes\x00mp\x00{uid}\x00"
+                            f"00001\x00s{j:04d}")
+        finally:
+            s3.close()
+            gws.shutdown()
+
+    def test_part_reupload_frees_stale_stripes(self, gateway):
+        c, gw, _ = gateway
+        gws = RGWService(c.rados(), stripe_size=1024).start()
+        s3 = S3Client("127.0.0.1", gws.port)
+        try:
+            s3.make_bucket("restripe")
+            _, uid = s3.initiate_multipart("restripe", "k")
+            s3.put_part("restripe", "k", uid, 1, b"A" * 5000)
+            # re-upload the same part smaller: 5 stripes -> 2
+            s3.put_part("restripe", "k", uid, 1, b"B" * 2000)
+            io = gws.store.data
+            import pytest as _pytest
+            for j in (2, 3, 4):         # stale high-rank stripes gone
+                with _pytest.raises(Exception):
+                    io.read(f"restripe\x00mp\x00{uid}\x00"
+                            f"00001\x00s{j:04d}")
+            s3.complete_multipart("restripe", "k", uid)
+            st, body = s3.get("restripe", "k")
+            assert st == 200 and body == b"B" * 2000
+        finally:
+            s3.close()
+            gws.shutdown()
+
+    def test_stripes_coalesce_through_batch_engine(self):
+        """Striped part writes land concurrently on an EC data pool:
+        the batch engine must coalesce them (launches < submitted
+        ops) and the object must survive the trip."""
+        from ceph_tpu.core.admin_socket import admin_command
+
+        c = MiniCluster(n_mons=1, n_osds=4,
+                        osd_config={"osd_batch_flush_ms": 25.0,
+                                    "osd_batch_max_ops": 64})
+        try:
+            c.start()
+            r = c.rados()
+            r.monc.command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "rgwec",
+                "profile": ["k=2", "m=1",
+                            "technique=reed_sol_van"]})
+            gw = RGWService(
+                r, stripe_size=4096,
+                data_pool_opts={"pool_type": "erasure",
+                                "erasure_code_profile": "rgwec",
+                                "pg_num": 4}).start()
+            s3 = S3Client("127.0.0.1", gw.port)
+            c.wait_for_clean()
+            s3.make_bucket("ecmp")
+            payload = bytes(range(256)) * 256       # 64 KiB
+            _, uid = s3.initiate_multipart("ecmp", "big")
+            assert s3.put_part("ecmp", "big", uid, 1, payload)[0] \
+                == 200                              # 16 stripes
+            st, _ = s3.complete_multipart("ecmp", "big", uid)
+            assert st == 200
+            st, body = s3.get("ecmp", "big")
+            assert st == 200 and body == payload
+            stats = [admin_command(o.admin_socket.path,
+                                   "dump_batch_engine")
+                     for o in c.osds.values()]
+            submitted = sum(s.get("ops_submitted", 0)
+                            for s in stats)
+            launches = sum(s.get("launches", 0) for s in stats)
+            failed = sum(s.get("ops_failed", 0) for s in stats)
+            assert failed == 0
+            assert 0 < launches < submitted, \
+                f"no coalescing: {launches}/{submitted}"
+            gw.shutdown()
+        finally:
+            c.stop()
+
+
+class TestTenantQoSTag:
+    def test_tenant_tag_reaches_mclock_scheduler(self):
+        """The per-request tenant tag (auth uid / x-rgw-tenant) must
+        ride the MOSDOp into the OSDs' mClock queue as the CLIENT-
+        class stream key — per TENANT, not per connection."""
+        from ceph_tpu.osd.scheduler import CLIENT, MClockScheduler
+
+        c = MiniCluster(n_mons=1, n_osds=3,
+                        osd_config={"osd_op_queue": "mclock"})
+        try:
+            c.start()
+            r = c.rados()
+            gw = RGWService(r).start()
+            s3 = S3Client("127.0.0.1", gw.port, tenant="acme")
+            c.wait_for_clean()
+            s3.make_bucket("tagged")
+            for i in range(8):
+                assert s3.put("tagged", f"o{i}", b"x" * 512)[0] \
+                    == 200
+            streams = set()
+            for o in c.osds.values():
+                assert isinstance(o.op_queue, MClockScheduler)
+                streams |= {k for k in o.op_queue._prev
+                            if k[0] == CLIENT}
+            assert ("client", "rgw:acme") in streams, streams
+            # untagged client traffic keeps its per-connection key
+            assert not any(cl.startswith("rgw:anon")
+                           for _k, cl in streams)
+            gw.shutdown()
+        finally:
+            c.stop()

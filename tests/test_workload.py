@@ -1,0 +1,326 @@
+"""Open-loop SLO harness (`ceph_tpu/workload/`): seeded schedules,
+the never-waits generator discipline, SLO tracking, and the scenario
+scripts over a live MiniCluster + RGW front door."""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.workload import (S3_GET, S3_PUT, ArrivalSchedule,
+                               LoadGenerator, OpMix, TenantProfile,
+                               Throttled, SLOTracker,
+                               merge_profiles, schedule_fingerprint)
+
+
+class TestArrivalSchedule:
+    def test_fixed_rate_spacing(self):
+        s = ArrivalSchedule.fixed(100.0, 2.0)
+        assert len(s) == 200
+        assert s.times[0] == 0.0
+        gaps = [b - a for a, b in zip(s.times, s.times[1:])]
+        assert all(abs(g - 0.01) < 1e-9 for g in gaps)
+
+    def test_poisson_seed_determinism(self):
+        a = ArrivalSchedule.poisson(50.0, 3.0, seed=42)
+        b = ArrivalSchedule.poisson(50.0, 3.0, seed=42)
+        c = ArrivalSchedule.poisson(50.0, 3.0, seed=43)
+        assert a.times == b.times
+        assert a.times != c.times
+        assert all(0.0 <= t < 3.0 for t in a.times)
+        # mean arrivals ~ rate * duration (loose: 4 sigma)
+        assert 90 < len(a) < 215
+
+    def test_profile_replay_is_exact(self):
+        """Same profile + duration ⇒ identical op list: WHEN each op
+        fires AND WHAT it is (the mix stream is seeded too)."""
+        mk = lambda: TenantProfile(  # noqa: E731
+            "t", 80.0, kind="poisson",
+            mix=OpMix({S3_PUT: 1, S3_GET: 1}), seed=9)
+        a, b = mk().ops(2.0), mk().ops(2.0)
+        assert [(o.t_sched, o.op_class, o.seq) for o in a] \
+            == [(o.t_sched, o.op_class, o.seq) for o in b]
+
+    def test_fingerprint_replay(self):
+        p = [TenantProfile("x", 40.0, seed=1),
+             TenantProfile("y", 60.0, seed=2)]
+        q = [TenantProfile("x", 40.0, seed=1),
+             TenantProfile("y", 60.0, seed=2)]
+        assert schedule_fingerprint(p, 2.0) \
+            == schedule_fingerprint(q, 2.0)
+        q[1] = TenantProfile("y", 60.0, seed=3)
+        assert schedule_fingerprint(p, 2.0) \
+            != schedule_fingerprint(q, 2.0)
+
+    def test_merge_orders_by_arrival(self):
+        ops = merge_profiles([TenantProfile("a", 50.0, seed=1),
+                              TenantProfile("b", 50.0, seed=2)], 1.0)
+        assert ops == sorted(
+            ops, key=lambda o: (o.t_sched, o.tenant, o.seq))
+        assert {o.tenant for o in ops} == {"a", "b"}
+
+
+class TestLoadGenerator:
+    def test_open_loop_never_waits(self):
+        """Slow executor + tiny pool: every op still gets ISSUED on
+        schedule (the issuer doesn't block on completions) and the
+        lag shows up as drift, not as reduced offered load."""
+        done = []
+
+        def execute(op):
+            time.sleep(0.02)
+            done.append(op.seq)
+
+        gen = LoadGenerator(
+            [TenantProfile("t", 100.0, kind="fixed", seed=0)],
+            execute, duration=0.5, workers=1)
+        rep = gen.run()
+        assert rep["offered_ops"] == 50
+        assert rep["issued"] == 50          # offered load undiminished
+        assert rep["ok"] == 50
+        # 1 worker * 50 ops * 20ms = 1s against a 0.5s schedule: the
+        # pool must fall visibly behind
+        assert rep["max_drift_s"] > 0.05
+
+    def test_throttled_and_errors_counted_separately(self):
+        def execute(op):
+            if op.seq % 3 == 0:
+                raise Throttled()
+            if op.seq % 3 == 1:
+                raise RuntimeError("boom")
+
+        gen = LoadGenerator(
+            [TenantProfile("t", 60.0, kind="fixed", seed=0)],
+            execute, duration=0.5, workers=4)
+        rep = gen.run()
+        assert rep["throttled"] == 10
+        assert rep["errors"] == 10
+        assert rep["ok"] == 10
+        assert gen.error_samples      # a sample of the error text kept
+
+    def test_tracker_receives_every_completion(self):
+        tr = SLOTracker({"*": 1000.0})
+        gen = LoadGenerator(
+            [TenantProfile("t", 80.0, kind="fixed", seed=0)],
+            lambda op: None, duration=0.5, workers=4, tracker=tr)
+        gen.run()
+        rep = tr.report()
+        assert rep["completed_ops"] == 40
+        assert rep["offered_ops"] == 40
+
+
+class TestSLOTracker:
+    def _fake_clock(self):
+        state = {"t": 0.0}
+
+        def clock():
+            return state["t"]
+
+        return state, clock
+
+    def test_quantiles_land_in_log2_buckets(self):
+        st, clock = self._fake_clock()
+        tr = SLOTracker({"*": 100.0}, clock=clock)
+        tr.start(offered=3, duration=1.0)
+        for ms in (1.0, 2.0, 50.0):
+            tr.record("t", S3_GET, ms / 1e3)
+        q = tr.quantiles("t", S3_GET)
+        # log2-µs buckets: upper bound 2^(i+1)-1 µs
+        assert q["p50_ms"] <= 4.1
+        assert 50.0 <= q["p999_ms"] <= 66.0
+
+    def test_goodput_excludes_slo_busters(self):
+        st, clock = self._fake_clock()
+        tr = SLOTracker({S3_PUT: 10.0}, clock=clock)
+        tr.start(offered=4, duration=1.0)
+        tr.record("t", S3_PUT, 0.002)               # good
+        tr.record("t", S3_PUT, 0.500)               # ok but over SLO
+        tr.record("t", S3_PUT, 0.001, ok=False, throttled=True)
+        tr.record("t", S3_PUT, 0.001, ok=False)     # hard error
+        st["t"] = 1.0
+        rep = tr.report()
+        lane = rep["tenants"]["t"][S3_PUT]
+        assert lane["count"] == 4
+        assert lane["ok"] == 2
+        assert lane["good"] == 1
+        assert lane["throttled"] == 1
+        assert lane["errors"] == 1
+        assert rep["goodput_ops"] == pytest.approx(1.0)
+
+    def test_violation_time_integrates(self):
+        st, clock = self._fake_clock()
+        tr = SLOTracker({S3_GET: 1.0}, window_s=60.0, clock=clock)
+        tr.record("t", S3_GET, 0.050)       # 50ms ≫ 1ms target
+        tr.evaluate()                       # flips in_violation
+        st["t"] = 2.0
+        tr.evaluate()                       # accrues 2s violating
+        st["t"] = 3.5
+        tr.evaluate()
+        lane = tr.report()["tenants"]["t"][S3_GET]
+        assert lane["in_violation"]
+        assert lane["violation_s"] == pytest.approx(3.5)
+
+    def test_windowed_quantiles_forget_old_samples(self):
+        st, clock = self._fake_clock()
+        tr = SLOTracker({"*": 1000.0}, window_s=5.0, clock=clock)
+        tr.record("t", S3_GET, 0.500)       # slow op at t=0
+        for i in range(1, 40):
+            st["t"] = i * 0.3               # ~12s of fast ops
+            tr.record("t", S3_GET, 0.001)
+        lifetime = tr.quantiles("t", S3_GET)
+        windowed = tr.quantiles("t", S3_GET, windowed=True)
+        assert lifetime["p999_ms"] > 400.0  # the straggler is there
+        assert windowed["p999_ms"] < 5.0    # ...but aged out
+
+    def test_wildcard_target(self):
+        tr = SLOTracker({"*": 25.0})
+        assert tr.target_ms(S3_GET) == 25.0
+        assert tr.target_ms("anything") == 25.0
+        assert SLOTracker({}).target_ms(S3_GET) is None
+
+
+class TestSmokeOnCluster:
+    def test_smoke_open_loop_keeps_schedule(self):
+        """Tier-1 bar: 50 ops/s for ~2s against a live MiniCluster's
+        front door — issue-time drift under 10% of the schedule span,
+        zero executor errors, zero SLO-tracker crashes."""
+        from ceph_tpu.workload import smoke
+        out = smoke(rate=50.0, duration=2.0, seed=5)
+        ol = out["open_loop"]
+        assert ol["offered_ops"] == 100
+        assert ol["errors"] == 0, out["open_loop"]
+        assert ol["drift_pct"] < 10.0
+        # the tracker saw every completion and produced a report
+        slo = out["slo"]
+        assert slo["completed_ops"] == ol["ok"] + ol["throttled"]
+        lanes = slo["tenants"]["tenantA"]
+        assert sum(v["count"] for v in lanes.values()) == 100
+        # replay contract: the logged seed is in the report
+        assert ol["seeds"] == {"tenantA": 5}
+
+
+@pytest.mark.slow
+class TestScenariosSlow:
+    def test_ramp_finds_the_knee(self):
+        from ceph_tpu.workload import ramp_to_collapse
+        out = ramp_to_collapse(start_rate=30.0, factor=3.0, steps=3,
+                               step_duration=1.5, slo_p99_ms=120.0,
+                               seed=11)
+        assert out["steps"], "ramp produced no steps"
+        assert out["knee_rate"] is not None, \
+            "no sustainable step found"
+        if out["collapse_rate"] is not None:
+            assert out["collapse_rate"] > out["knee_rate"]
+            # past the knee the ramp stops: no wasted melt steps
+            assert out["steps"][-1]["rate"] == out["collapse_rate"]
+
+    def test_noisy_neighbor_victim_p99_stays_flat(self):
+        """The acceptance bar: victim p99 within 1.5x of its solo
+        run while the aggressor floods — because the aggressor's
+        tenant tag is capped by per-tenant mClock QoS.
+
+        p99 over a few hundred samples is an order statistic two
+        samples deep, and this host is shared — one scheduling
+        spike in either phase moves the ratio.  A broken-isolation
+        regression holds the ratio up across seeds (~2x measured
+        with the victim reservation removed), so one retry on a
+        fresh seed keeps the gate honest while absorbing spikes."""
+        from ceph_tpu.workload import noisy_neighbor
+        for attempt, seed in enumerate((23, 31)):
+            out = noisy_neighbor(victim_rate=40.0,
+                                 aggressor_rate=120.0,
+                                 duration=6.0, seed=seed,
+                                 aggressor_limit=15.0)
+            assert out["victim_errors"] == 0
+            if out["p99_ratio"] <= 1.5:
+                break
+        assert out["p99_ratio"] <= 1.5, out
+        # the aggressor was actually hurt: offered 120 ops/s against
+        # a 40 ops/s cap, its PUT lane must show SLO-busting latency
+        agg = out["duo"]["slo"]["tenants"]["aggressor"][S3_PUT]
+        assert agg["p99_ms"] > out["duo"]["slo"]["tenants"][
+            "victim"][S3_GET]["p99_ms"]
+
+    def test_game_day_under_load(self):
+        """PR 6 site-loss drill with the SLO tracker live: blackout,
+        degraded writes, heal — the load generator drains, the drill
+        phases complete, and the report carries per-phase marks."""
+        from ceph_tpu.workload import game_day_under_load
+        out = game_day_under_load(rate=15.0, duration=12.0, seed=31)
+        phases = [p["phase"] for p in out["drill"]]
+        assert phases == ["blackout", "degraded-mark", "heal",
+                          "healed-mark"]
+        assert "degraded" in out["marks"]
+        assert "healed" in out["marks"]
+        ol = out["open_loop"]
+        assert ol["ok"] > 0
+        # ops during the blackout may 503/error; the harness itself
+        # must never lose accounting
+        assert ol["ok"] + ol["throttled"] + ol["errors"] \
+            == ol["issued"]
+        healed = out["marks"]["healed"]
+        assert healed["completed_ops"] >= \
+            out["marks"]["degraded"]["completed_ops"]
+
+
+class TestSLOPublish:
+    def test_ingest_report_roundtrip_and_gauges(self):
+        """`slo ingest` lands a scenario report in the telemetry
+        spine; `slo report` reads it back; the exporter renders the
+        per-tenant ceph_slo_* gauges."""
+        from ceph_tpu.mgr.exporter import Exporter
+        from ceph_tpu.vstart import MiniCluster
+        c = MiniCluster(n_mons=1, n_osds=1)
+        try:
+            c.start()
+            r = c.rados()
+            c.start_mgr("x")
+            report = {
+                "offered_rate": 50.0, "goodput_ops": 48.25,
+                "tenants": {"victim": {S3_GET: {
+                    "p50_ms": 4.1, "p99_ms": 16.4, "p999_ms": 32.8,
+                    "count": 100, "throttled": 2, "errors": 0,
+                    "in_violation": True, "violation_s": 1.25}}},
+            }
+            deadline = time.monotonic() + 10.0
+            rc = -1
+            while time.monotonic() < deadline:
+                rc, _, _ = r.mgr_command(
+                    {"prefix": "slo ingest", "scenario": "nn",
+                     "report": report}, timeout=5.0)
+                if rc == 0:
+                    break
+                time.sleep(0.25)    # mgr module still loading
+            assert rc == 0
+            rc, _, back = r.mgr_command(
+                {"prefix": "slo report", "scenario": "nn"},
+                timeout=5.0)
+            assert rc == 0
+            assert back["tenants"]["victim"][S3_GET]["p99_ms"] \
+                == 16.4
+            view = {"slo": {"nn": report}}
+            text = Exporter(r.monc,
+                            telemetry=lambda: view).collect()
+            assert ('ceph_slo_latency_p99_ms{scenario="nn",'
+                    'tenant="victim",op_class="s3_get"} 16.4') \
+                in text
+            assert ('ceph_slo_in_violation{scenario="nn",'
+                    'tenant="victim",op_class="s3_get"} 1') in text
+            assert 'ceph_slo_goodput_ops{scenario="nn"} 48.25' \
+                in text
+        finally:
+            c.stop()
+
+    def test_malformed_ingest_rejected(self):
+        from ceph_tpu.mgr.telemetry import TelemetrySpine
+
+        class _Ctx:
+            def mon_command(self, cmd):
+                return -1, "", None
+
+        spine = TelemetrySpine(_Ctx())
+        rc, _, _ = spine.handle_command(
+            {"prefix": "slo ingest", "report": "not-a-dict"})
+        assert rc == -22
+        rc, _, out = spine.handle_command({"prefix": "slo report"})
+        assert rc == 0 and out == {}
