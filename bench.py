@@ -1421,6 +1421,113 @@ def _frontdoor_leg():
     }
 
 
+def _durability_leg():
+    """Crash-consistency engine: (1) sustained WAL append GB/s with
+    group commit (sync_mode=batch, one fsync per kick window) vs the
+    no-fsync floor (sync_mode=none) — the acceptance bar is <15%
+    group-commit overhead on the batched path; (2) cold-restart replay
+    time for a 10k-op log; (3) a seeded crash-sweep smoke: every named
+    crash point fires once and no acked write is lost on remount."""
+    import tempfile
+
+    from ceph_tpu.os_store import (CRASH_POINTS, CrashInjector,
+                                   SimulatedPowerLoss, WALStore)
+    from ceph_tpu.os_store.objectstore import Transaction
+    import shutil
+
+    out = {}
+    d = tempfile.mkdtemp(prefix="ceph-tpu-durability-")
+    # 4 KiB ops: the small-object RADOS shape where per-op CPU cost
+    # dominates per-byte disk cost — the regime group commit targets.
+    # (ext4 fsync is ~2 ms/MiB of dirty data, so huge payloads would
+    # measure the disk's writeback rate, not the commit policy.)
+    payload = os.urandom(4 << 10)
+    n_ops, kick_every = 2048, 64
+
+    def write_run(mode: str):
+        path = os.path.join(d, f"run.{mode}.wal")
+        s = WALStore(path, sync_mode=mode, name=f"bench-{mode}")
+        s.mount(); s.mkfs()
+        s.queue_transaction(Transaction().create_collection("1.0"))
+        t0 = time.perf_counter()
+        for i in range(n_ops):
+            s.queue_transaction(
+                Transaction().write("1.0", f"o{i}", 0, payload))
+            if mode == "batch" and (i + 1) % kick_every == 0:
+                s.kick()
+        if mode == "batch":
+            s.kick()
+            s.flush_commits(timeout=30.0)
+        dt = time.perf_counter() - t0
+        syncs = int(s.wal_stats["group_syncs"] + s.wal_stats["syncs"])
+        s.umount()
+        os.unlink(path)
+        return dt, syncs
+
+    dt_none, _ = write_run("none")
+    dt_batch, syncs = write_run("batch")
+    gb = n_ops * len(payload) / 1e9
+    overhead_pct = (dt_batch - dt_none) / dt_none * 100.0
+    assert overhead_pct < 15.0, \
+        f"group commit cost {overhead_pct:.1f}% vs none (bar: 15%)"
+    out["wal_append_GBps_sync_none"] = round(gb / dt_none, 3)
+    out["wal_append_GBps_sync_batch"] = round(gb / dt_batch, 3)
+    out["group_commit_overhead_pct"] = round(overhead_pct, 2)
+    out["group_syncs"] = syncs
+    out["ops_per_fsync"] = round(n_ops / max(1, syncs), 1)
+
+    # cold-restart replay: 10k-op log, time mount (scan + apply)
+    path = os.path.join(d, "replay.wal")
+    s = WALStore(path, sync_mode="none")
+    s.mount(); s.mkfs()
+    s.queue_transaction(Transaction().create_collection("1.0"))
+    small = b"x" * 512
+    for i in range(10_000):
+        s.queue_transaction(
+            Transaction().write("1.0", f"o{i % 256}", 0, small))
+    s.umount()
+    s2 = WALStore(path)
+    t0 = time.perf_counter()
+    s2.mount()
+    replay_s = time.perf_counter() - t0
+    assert s2.replay_stats["records"] == 10_001, s2.replay_stats
+    s2.umount()
+    os.unlink(path)
+    out["replay_10k_ops_s"] = round(replay_s, 3)
+    out["replay_ops_per_sec"] = round(10_001 / replay_s, 0)
+
+    # seeded crash sweep smoke: every point fires, acked data survives
+    swept = []
+    for point in CRASH_POINTS:
+        path = os.path.join(d, f"crash.{point}.wal")
+        inj = CrashInjector(seed=11, osd="bench")
+        s = WALStore(path, sync_mode="always", crash=inj)
+        s.mount(); s.mkfs()
+        s.queue_transaction(Transaction().create_collection("1.0"))
+        inj.arm(point)
+        acked = 0
+        try:
+            for i in range(8):
+                s.queue_transaction(
+                    Transaction().write("1.0", f"o{i}", 0, small))
+                acked += 1
+                if point == "mid_compaction":
+                    s.compact()
+        except SimulatedPowerLoss:
+            pass
+        assert inj.fired and inj.fired[0][0] == point, point
+        s2 = WALStore(path)
+        s2.mount()
+        for i in range(acked):
+            assert bytes(s2.read("1.0", f"o{i}")) == small, (point, i)
+        s2.umount()
+        os.unlink(path)
+        swept.append(point)
+    out["crash_sweep_points_ok"] = len(swept)
+    shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def _crush_leg():
     """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
     (BASELINE.md row 4, scaled to fit a bench-run budget)."""
@@ -1597,6 +1704,16 @@ def child_main():
             out["frontdoor"] = {"error": str(e)[:200]}
     else:
         out["frontdoor"] = {"skipped": "wall budget exhausted"}
+    print(json.dumps(dict(out, durability={"skipped": "timeout"})),
+          flush=True)
+    # crash-consistency engine: group-commit tax, replay, crash sweep
+    if _budget_left() > 0.02:
+        try:
+            out["durability"] = _durability_leg()
+        except Exception as e:    # noqa: BLE001 — keep the headline
+            out["durability"] = {"error": str(e)[:200]}
+    else:
+        out["durability"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(out))
     try:
         dev = jax.devices()[0].device_kind

@@ -124,6 +124,22 @@ def build_options() -> list[Option]:
         Option("osd_stub_capacity_bytes", int, 1 << 30,
                "synthetic device capacity reported in osd_stats "
                "(drives OSD_NEARFULL)", min=1),
+        # -- durable data path (os_store/kvstore.py) ----------------------
+        Option("osd_objectstore", str, "walstore",
+               "backing store vstart builds for each OSD: walstore = "
+               "durable WAL-backed (crash-restartable), memstore = "
+               "RAM only",
+               enum_allowed=("walstore", "memstore")),
+        Option("osd_wal_sync_mode", str, "batch",
+               "WAL durability policy: none = never fsync (power "
+               "loss eats the tail), batch = group-commit (one fsync "
+               "amortized across a flush, the default), always = "
+               "fsync per transaction",
+               enum_allowed=("none", "batch", "always")),
+        Option("osd_wal_compact_min_records", int, 0,
+               "checkpoint-compact the WAL (snapshot + atomic "
+               "rename) once it holds this many records (0 = manual "
+               "compaction only)", min=0),
         # -- device data plane (osd/batch_engine.py) ----------------------
         Option("osd_batch_enable", bool, True,
                "coalesce device ops (EC encode + CRC digest) into "

@@ -97,6 +97,24 @@ def _osd_down(ctx):
 
 
 @health_check
+def _osd_store_error(ctx):
+    # OSD_STORE_ERROR: an OSD's backing store failed a WAL append or
+    # fsync (ENOSPC, injected power loss) — it degraded to EIO-and-
+    # mark-down instead of crashing, and its last stats report carries
+    # the error string.  ERR severity: acked durability is gone on
+    # that OSD until an operator intervenes (fsck, mkfs, replace).
+    bad = [(o, st["store_error"])
+           for o, st in sorted(ctx.pgmap.osd_stats.items())
+           if st.get("store_error")]
+    if not bad:
+        return None
+    return _check(
+        "OSD_STORE_ERROR", "ERR",
+        f"{len(bad)} osd(s) with objectstore write failures",
+        [f"osd.{o}: {err}" for o, err in bad])
+
+
+@health_check
 def _slow_ops(ctx):
     # SLOW_OPS: OSDs report op_tracker slow-op counts in their
     # osd_stats (reference health check of the same name) — per-OSD

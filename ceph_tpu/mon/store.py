@@ -5,8 +5,14 @@ Reference behavior re-created (``src/mon/MonitorDBStore.h``; SURVEY.md
 "osdmap", "auth", ...) with atomic multi-op transactions, backing both
 Paxos state (proposals, commit points) and each service's versioned
 maps.  The reference sits on RocksDB; here: an in-memory dict + an
-append-only JSONL write-ahead log replayed on open — same atomicity
+append-only write-ahead log replayed on open — same atomicity
 contract (a transaction is one WAL record, applied all-or-nothing).
+
+Records use the CRC-framed format shared with the OSD's ``WALStore``
+(``os_store/walog.py``), so the torn/corrupt-tail recovery rule is one
+implementation across both daemons: open scans forward, stops at the
+first damaged frame, truncates the damage away, and ``replay_stats``
+reports what was recovered.
 """
 
 from __future__ import annotations
@@ -15,6 +21,8 @@ import base64
 import json
 import os
 import threading
+
+from ..os_store import walog
 
 
 class StoreTransaction:
@@ -51,6 +59,7 @@ class MonitorDBStore:
         self._path = path
         self._sync = sync
         self._wal = None
+        self.replay_stats: dict | None = None
         if path is not None:
             if os.path.exists(path):
                 self._replay(path)
@@ -58,16 +67,15 @@ class MonitorDBStore:
 
     # -- durability --------------------------------------------------------
     def _replay(self, path: str):
-        with open(path, "rb") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line.decode())
-                except json.JSONDecodeError:
-                    break  # torn tail write: stop at the last good record
-                self._apply(rec)
+        payloads, good_off, tail = walog.scan_path(path)
+        for payload in payloads:
+            self._apply(json.loads(payload.decode()))
+        if tail["status"] != "clean":
+            # shared torn-tail rule: the last good record wins; drop
+            # the damage before this process appends after it
+            walog.truncate_tail(path, good_off)
+        self.replay_stats = {"records": len(payloads),
+                             "tail": dict(tail)}
 
     def _apply(self, rec):
         for op in rec:
@@ -91,7 +99,8 @@ class MonitorDBStore:
                         if value is not None else None])
         with self._lock:
             if self._wal is not None:
-                self._wal.write(json.dumps(rec).encode() + b"\n")
+                self._wal.write(walog.encode_record(
+                    json.dumps(rec, separators=(",", ":")).encode()))
                 self._wal.flush()
                 if self._sync:
                     os.fsync(self._wal.fileno())

@@ -8,9 +8,11 @@ hot copies, so a RAM store is the idiomatic mapping, with the WAL
 store adding durability where the reference uses BlueStore).
 """
 
-from .objectstore import Collection, ObjectStore, Transaction
+from .objectstore import Collection, ObjectStore, StoreError, Transaction
 from .memstore import MemStore
 from .kvstore import WALStore
+from .crash import CRASH_POINTS, CrashInjector, SimulatedPowerLoss
 
-__all__ = ["Collection", "ObjectStore", "Transaction", "MemStore",
-           "WALStore"]
+__all__ = ["Collection", "ObjectStore", "StoreError", "Transaction",
+           "MemStore", "WALStore", "CRASH_POINTS", "CrashInjector",
+           "SimulatedPowerLoss"]

@@ -21,6 +21,13 @@ from __future__ import annotations
 import abc
 from typing import Callable
 
+class StoreError(OSError):
+    """The store's durable backing failed (WAL append/fsync error,
+    ENOSPC, simulated power loss).  Once raised, the store refuses
+    further writes: the daemon degrades (EIO to clients, mark-down)
+    instead of the op thread crashing."""
+
+
 # transaction opcodes (reference Transaction::OP_*)
 OP_TOUCH = "touch"
 OP_WRITE = "write"

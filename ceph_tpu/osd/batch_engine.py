@@ -184,7 +184,8 @@ class BatchEngine:
                  comp_max_ops: int | None = None,
                  comp_flush_ms: float | None = None,
                  comp_segment_bytes: int = 1 << 20,
-                 use_mesh: bool = False, on_lane_flush=None):
+                 use_mesh: bool = False, on_lane_flush=None,
+                 store_kick=None):
         self.name = name
         self.enabled = bool(enabled)
         self.max_bytes = int(max_bytes)
@@ -212,6 +213,9 @@ class BatchEngine:
         self.use_mesh = bool(use_mesh)
         self.use_planes: bool | None = None  # None = auto (TPU only)
         self.on_lane_flush = on_lane_flush   # (lane, ops, bytes) hook
+        # zero-arg durability nudge (WALStore.kick): one group-commit
+        # fsync per megabatch flush instead of one per op
+        self.store_kick = store_kick
         self._schedule = schedule   # schedule(delay_s, fn) -> token
         self.profiler = profiler
         self.tracer = tracer
@@ -725,6 +729,16 @@ class BatchEngine:
             try:
                 self.on_lane_flush(lane, len(pending), staged)
             except Exception:       # noqa: BLE001 — accounting hook
+                self.stats["callback_errors"] += 1
+        if self.store_kick is not None:
+            # durability boundary: the completions just dispatched
+            # queued their transactions — nudge the WAL group-commit
+            # thread so the whole megabatch shares ONE fsync and its
+            # acks (gated on commit) release together
+            try:
+                self.store_kick()
+                self.stats[f"{prefix}store_kicks"] += 1
+            except Exception:       # noqa: BLE001
                 self.stats["callback_errors"] += 1
         return n
 

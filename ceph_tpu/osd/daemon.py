@@ -35,7 +35,7 @@ from ..core.tracked_op import OpTracker
 from ..mon import messages as MM
 from ..mon.client import MonClient
 from ..msg import Dispatcher, EntityAddr, Messenger
-from ..os_store import MemStore
+from ..os_store import MemStore, WALStore
 from ..os_store.objectstore import Transaction
 from ..tools.osdmaptool import osdmap_from_dict
 from . import messages as M
@@ -266,6 +266,21 @@ class OSDaemon(Dispatcher):
         self._register_admin_commands()
         self.store = store if store is not None else MemStore(
             name=f"osd.{whoami}")
+        # durability wiring: the batch engine nudges the WAL group-
+        # commit thread at each megabatch flush boundary (one fsync
+        # covers the whole flush), and a failed append/fsync degrades
+        # the daemon instead of crashing its op thread
+        self._store_error: str | None = None
+        self.batch_engine.store_kick = getattr(self.store, "kick", None)
+        if isinstance(self.store, WALStore):
+            self.store.on_error = self._on_store_error
+            self.config.add_observer(
+                "osd_wal_sync_mode",
+                lambda _n, v: self.store.set_sync_mode(v))
+            self.config.add_observer(
+                "osd_wal_compact_min_records",
+                lambda _n, v: setattr(self.store,
+                                      "compact_min_records", int(v)))
         self.auth = auth
         # fault fabric: the messenger's injector is built from the
         # ms_inject_* options and stays retunable while the daemon
@@ -456,6 +471,14 @@ class OSDaemon(Dispatcher):
     # -- lifecycle ---------------------------------------------------------
     def start(self, wait_for_up: bool = True, timeout: float = 15.0):
         self.store.mount()
+        rs = getattr(self.store, "replay_stats", None)
+        if rs and not rs.get("clean_shutdown", True):
+            tail = rs.get("tail") or {}
+            note = (f"; dropped {tail.get('error')}"
+                    if tail.get("status") != "clean" else "")
+            self.clog.info(
+                f"osd.{self.whoami} unclean shutdown detected: "
+                f"replayed {rs.get('records', 0)} WAL records{note}")
         self.admin_socket.start()
         self.addr = self.msgr.bind()
         self.running = True
@@ -604,6 +627,30 @@ class OSDaemon(Dispatcher):
         self.monc.shutdown()
         self.msgr.shutdown()
         self.store.umount()
+
+    def _on_store_error(self, exc):
+        """The backing store can no longer durably commit (ENOSPC,
+        fsync failure, injected power loss).  Reference behavior
+        (BlueStore::_txc_state_proc on EIO → ceph_abort, softened to
+        our daemon model): clog the failure, self-report so the mon
+        marks us down, stop answering heartbeats so peers confirm it,
+        and fail client ops with EIO instead of crashing the op
+        thread.  May fire from the op worker (mid-queue_transaction)
+        or the WAL commit thread."""
+        if self._store_error is not None:
+            return
+        self._store_error = str(exc)
+        try:
+            self.clog.error(
+                f"osd.{self.whoami} objectstore write failure, "
+                f"marking self down: {exc}")
+        except Exception:   # noqa: BLE001 — degradation is best-effort
+            pass
+        try:
+            self.monc.send(MM.MOSDFailure(
+                target=self.whoami, reporter=self.whoami))
+        except Exception:   # noqa: BLE001
+            pass
 
     def _on_lane_flush(self, lane: str, ops: int, nbytes: int):
         """Batch-engine flush hook: debit the op queue for the device
@@ -1137,6 +1184,10 @@ class OSDaemon(Dispatcher):
                 osd=self.whoami, epoch=self.osdmap.epoch,
                 pg_stats=stats,
                 osd_stats={"num_pgs": len(self.pgs),
+                           # non-None once the backing store failed
+                           # (ENOSPC/fsync error): feeds the
+                           # OSD_STORE_ERROR health check
+                           "store_error": self._store_error,
                            # storage-efficiency lane aggregates: the
                            # telemetry spine differentiates these into
                            # compress/decompress/fingerprint byte
@@ -1217,6 +1268,28 @@ class OSDaemon(Dispatcher):
 
     def _route(self, msg) -> bool:
         with self.lock:
+            if self._store_error is not None:
+                # dead backing store: a silent heartbeat lets peers
+                # report us down, and client ops fail fast with EIO
+                # rather than acking writes that can never commit
+                if isinstance(msg, M.MOSDPing):
+                    return True
+                if isinstance(msg, M.MOSDOp):
+                    tracked = getattr(msg, "tracked", None)
+                    if tracked is not None:
+                        tracked.finish()
+                    if msg.connection is not None:
+                        try:
+                            msg.connection.send_message(M.MOSDOpReply(
+                                tid=msg.tid, rc=-5,
+                                outs="objectstore error: "
+                                     + self._store_error,
+                                results=None, version=[0, 0],
+                                epoch=self.osdmap.epoch,
+                                trace=getattr(msg, "trace", None)))
+                        except ConnectionError:
+                            pass
+                    return True
             if isinstance(msg, M.MOSDPing):
                 if msg.kind == "ping":
                     if msg.connection is not None:

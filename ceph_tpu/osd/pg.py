@@ -1566,6 +1566,12 @@ _WRITE_OPS = {"write", "write_full", "append", "delete", "truncate",
               "setxattr", "rmxattr", "omap_set", "omap_rm"}
 _NOOP_OPS = {"cls_noop"}
 
+# sentinel member of a repop's waiting set: the primary's own WAL
+# commit (peers are int OSD ids, so a string can never collide) —
+# ack-after-commit means the client reply waits for every replica's
+# committed reply AND this local durability signal
+_LOCAL_COMMIT = "local"
+
 
 def _omap_read_result(kv: dict, op: dict) -> dict:
     """Shared omap_get result shaping: optional server-side key
@@ -1674,8 +1680,10 @@ class ReplicatedBackend(PGBackendBase):
         pg.append_log_entry(entry, txn)
         peers = [o for o in pg._peer_osds()
                  if pg.backfill_gate(o, oid, is_delete=delete)]
-        state = {"waiting": set(peers), "msg": msg, "version": version,
-                 "results": results}
+        # ack-after-commit: the primary's own WAL commit is one more
+        # member of the waiting set, exactly like each replica's reply
+        state = {"waiting": set(peers) | {_LOCAL_COMMIT}, "msg": msg,
+                 "version": version, "results": results}
         self._inflight[reqid] = state
         wire_txn = txn.to_dict()
         # sub-ops join the trace as children of the OSD op span (fall
@@ -1690,9 +1698,23 @@ class ReplicatedBackend(PGBackendBase):
                 version=list(version),
                 log_entries=[entry.to_dict()],
                 pg_info=pg.info.to_dict(), trace=trace))
-        daemon.store.queue_transaction(txn)
-        if not peers:
-            self._maybe_ack(reqid)
+        daemon.store.queue_transaction(txn, self._local_commit_cb(reqid))
+
+    def _local_commit_cb(self, reqid: str):
+        """Commit callback gating the client ack on the primary's own
+        WAL durability.  Runs on the store finisher; a state that
+        vanished (interval change) means the client is resending —
+        nothing to do."""
+        daemon = self.pg.daemon
+
+        def _committed():
+            with daemon.lock:
+                st = self._inflight.get(reqid)
+                if st is None:
+                    return
+                st["waiting"].discard(_LOCAL_COMMIT)
+                self._maybe_ack(reqid)
+        return _committed
 
     def _object_version(self, oid: str) -> tuple:
         meta = self._read_local_meta(oid)
@@ -1865,7 +1887,7 @@ class ReplicatedBackend(PGBackendBase):
         pg.append_log_entry(entry, txn)
         peers = [o for o in pg._peer_osds()
                  if pg.backfill_gate(o, oid, is_delete=delete)]
-        state = {"waiting": set(peers), "msg": msg,
+        state = {"waiting": set(peers) | {_LOCAL_COMMIT}, "msg": msg,
                  "version": version, "results": results}
         self._inflight[reqid] = state
         wire_txn = txn.to_dict()
@@ -1879,13 +1901,12 @@ class ReplicatedBackend(PGBackendBase):
                 version=list(version),
                 log_entries=[entry.to_dict()],
                 pg_info=pg.info.to_dict(), trace=trace))
-        daemon.store.queue_transaction(txn)
+        daemon.store.queue_transaction(txn, self._local_commit_cb(reqid))
         # gate drops once the local (primary) apply is queued —
         # replicated primaries apply immediately, so the next queued
-        # write reads this write's bytes
+        # write reads this write's bytes (the ack still waits for the
+        # WAL commit via _LOCAL_COMMIT)
         self._release_seal_gate(oid)
-        if not peers:
-            self._maybe_ack(reqid)
 
     # -- pool snapshots (reference PrimaryLogPG make_writeable +
     # SnapMapper: clone the head before the first write past each
@@ -2070,11 +2091,17 @@ class ReplicatedBackend(PGBackendBase):
                 pg.info.last_update = e.version
         pg._maybe_trim_log()
         pg._persist_meta(txn)
-        daemon.store.queue_transaction(txn)
-        daemon.send_to_osd(pg.primary, M.MOSDRepOpReply(
+        reply = M.MOSDRepOpReply(
             reqid=msg.reqid, pgid=msg.pgid,
             epoch=daemon.osdmap.epoch, rc=0,
-            from_osd=daemon.whoami))
+            from_osd=daemon.whoami)
+
+        def _committed():
+            # the replica's ack is its commit promise — it must not
+            # leave this OSD before the txn is WAL-durable here
+            with daemon.lock:
+                daemon.send_to_osd(pg.primary, reply)
+        daemon.store.queue_transaction(txn, _committed)
 
     # -- reads -------------------------------------------------------------
     def do_reads(self, msg: M.MOSDOp):
@@ -2831,7 +2858,8 @@ class ECBackend(PGBackendBase):
                 t.omap_rmkeys(cid, oid, list(op["keys"]))
         return t
 
-    def _apply_shard_txn(self, txn: Transaction, entries):
+    def _apply_shard_txn(self, txn: Transaction, entries,
+                         on_commit=None):
         pg = self.pg
         for e in entries:
             # the applied txn supersedes any pending recovery for this
@@ -2843,18 +2871,24 @@ class ECBackend(PGBackendBase):
                 pg.info.last_update = e.version
         pg._maybe_trim_log()
         pg._persist_meta(txn)
-        pg.daemon.store.queue_transaction(txn)
+        pg.daemon.store.queue_transaction(txn, on_commit)
 
     def apply_sub_write(self, msg: M.MOSDECSubOpWrite):
         pg, daemon = self.pg, self.pg.daemon
         daemon.perf.inc("subop")
         txn = Transaction.from_dict(msg.txn)
         entries = [LogEntry.from_dict(e) for e in msg.log_entries or []]
-        self._apply_shard_txn(txn, entries)
-        pg._note_local_object_write()
-        daemon.send_to_osd(pg.primary, M.MOSDECSubOpWriteReply(
+        reply = M.MOSDECSubOpWriteReply(
             reqid=msg.reqid, pgid=msg.pgid, shard=msg.shard,
-            epoch=daemon.osdmap.epoch, rc=0, from_osd=daemon.whoami))
+            epoch=daemon.osdmap.epoch, rc=0, from_osd=daemon.whoami)
+
+        def _committed():
+            # the shard ack is a commit promise: it leaves only after
+            # the sub-write is WAL-durable on this OSD
+            with daemon.lock:
+                daemon.send_to_osd(pg.primary, reply)
+        self._apply_shard_txn(txn, entries, _committed)
+        pg._note_local_object_write()
 
     def handle_sub_write_reply(self, msg: M.MOSDECSubOpWriteReply):
         st = self._inflight.get(msg.reqid)
@@ -2867,18 +2901,35 @@ class ECBackend(PGBackendBase):
         st = self._inflight.get(reqid)
         if st is None or st["waiting"]:
             return
-        del self._inflight[reqid]
         pg = self.pg
-        # every live peer committed: NOW apply locally + log + ack
-        # (primary-applies-last -- see submit_write)
-        for txn in st.get("local_txns") or ():
-            pg.daemon.store.queue_transaction(txn)
-        entry = st.get("entry")
-        if entry is not None:
-            pg.missing.pop(st.get("oid"), None)
-            pg.log.add(entry)
-            pg.info.last_update = entry.version
-            pg.daemon.store.queue_transaction(pg._persist_meta())
+        daemon = pg.daemon
+        if not st.get("committing"):
+            # every live peer committed: NOW apply locally + log
+            # (primary-applies-last -- see submit_write).  The client
+            # ack additionally waits for the local shard txns and the
+            # meta txn to be WAL-durable: phase two below.
+            st["committing"] = True
+            txns = list(st.get("local_txns") or ())
+            entry = st.get("entry")
+            if entry is not None:
+                pg.missing.pop(st.get("oid"), None)
+                pg.log.add(entry)
+                pg.info.last_update = entry.version
+                txns.append(pg._persist_meta())
+            st["pending_commits"] = len(txns)
+
+            def _committed():
+                with daemon.lock:
+                    cur = self._inflight.get(reqid)
+                    if cur is not st:
+                        return      # interval change swept the state
+                    st["pending_commits"] -= 1
+                    self._maybe_ack(reqid)
+            for txn in txns:
+                daemon.store.queue_transaction(txn, _committed)
+        if st.get("pending_commits", 0) > 0:
+            return
+        del self._inflight[reqid]
         pg._reply(st["msg"], 0, "", results=st["results"],
                   version=st["version"])
         self._active_reqids.discard(reqid)

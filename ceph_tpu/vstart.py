@@ -10,15 +10,19 @@ fixture every end-to-end test runs on, and the substrate for the
 
 from __future__ import annotations
 
+import os
 import queue
 import random
+import shutil
 import socket
+import tempfile
 import time
 
 from .mds.daemon import MDSDaemon
 from .mon.monitor import MonMap, Monitor
 from .msg import EntityAddr
 from .msg.fault import site_pairs
+from .os_store import CrashInjector, WALStore
 from .osd.daemon import OSDaemon
 from .osdc.librados import Rados
 
@@ -151,6 +155,11 @@ class MiniCluster:
                              auth=self.auth)
                      for r in range(n_mons)]
         self._osd_stores = osd_stores
+        # durable backing (osd_objectstore=walstore, the default):
+        # per-OSD WAL files in a throwaway dir, paths remembered so a
+        # power-lossed OSD cold-remounts the SAME log on revive
+        self._wal_dir: str | None = None
+        self._wal_paths: dict[int, str] = {}
         self.osds: dict[int, OSDaemon] = {}
         self.n_osds = n_osds
         self._clients: list[Rados] = []
@@ -184,8 +193,48 @@ class MiniCluster:
             self.start_osd(i)
         return self
 
+    def _wal_path(self, i: int) -> str:
+        p = self._wal_paths.get(i)
+        if p is None:
+            if self._wal_dir is None:
+                # Prefer tmpfs for the throwaway default WAL dir:
+                # power loss here is simulated by truncation, so the
+                # semantics are identical, but group-commit fsyncs
+                # don't pay the ext4 journal (~2ms each).
+                base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+                self._wal_dir = tempfile.mkdtemp(
+                    prefix="ceph-tpu-wal-", dir=base)
+            p = os.path.join(self._wal_dir, f"osd.{i}.wal")
+            self._wal_paths[i] = p
+        return p
+
+    def _default_store(self, i: int):
+        """Fresh store for an OSD with no saved object: a WALStore on
+        the OSD's WAL path (so a cold restart replays whatever an
+        earlier incarnation committed) unless osd_objectstore asks for
+        RAM only.  Every WALStore carries a CrashInjector seeded from
+        the cluster fault seed — same seed, same crash schedule."""
+        if self._osd_config.get("osd_objectstore",
+                                "walstore") != "walstore":
+            return None     # OSDaemon defaults to MemStore
+        return WALStore(
+            self._wal_path(i),
+            sync_mode=self._osd_config.get("osd_wal_sync_mode",
+                                           "batch"),
+            name=f"osd.{i}",
+            crash=CrashInjector(seed=int(self.fault_seed or 0),
+                                osd=f"osd.{i}"),
+            compact_min_records=int(self._osd_config.get(
+                "osd_wal_compact_min_records", 0)))
+
     def start_osd(self, i: int, timeout: float = 30.0) -> OSDaemon:
-        store = self._osd_stores[i] if self._osd_stores else None
+        store = None
+        if self._osd_stores:
+            store = (self._osd_stores.get(i)
+                     if isinstance(self._osd_stores, dict)
+                     else self._osd_stores[i])
+        if store is None:
+            store = self._default_store(i)
         cfg = None
         if self._osd_config:
             from .core.config import ConfigProxy
@@ -221,6 +270,54 @@ class MiniCluster:
 
     def revive_osd(self, i: int, timeout: float = 30.0) -> OSDaemon:
         return self.start_osd(i, timeout=timeout)
+
+    def crash_osd(self, i: int):
+        """Power-loss an OSD: hard-stop the daemon AND destroy its
+        in-memory store — stable storage keeps only the fsynced WAL
+        prefix (plus any torn fragment an injected crash left).  The
+        store object is forgotten, so ``revive_osd`` cold-remounts
+        from the WAL path alone: the true power-cycle ``kill_osd``
+        deliberately is not."""
+        osd = self.osds.pop(i)
+        osd.running = False
+        osd.op_queue.close()
+        osd.timer.shutdown()
+        osd.admin_socket.shutdown()
+        osd.monc.shutdown()
+        osd.msgr.shutdown()
+        store = osd.store
+        path = getattr(store, "_path", None)
+        if path is not None:
+            self._wal_paths[i] = path
+        pl = getattr(store, "power_loss", None)
+        if pl is not None:
+            pl()
+        else:
+            try:
+                store.umount()      # RAM store: everything is lost
+            except Exception:
+                pass
+        if isinstance(self._osd_stores, dict):
+            self._osd_stores.pop(i, None)
+        elif self._osd_stores is not None:
+            self._osd_stores = {j: s for j, s in
+                                enumerate(self._osd_stores) if j != i}
+
+    def power_loss(self, revive: bool = True,
+                   timeout: float = 60.0) -> dict:
+        """Whole-cluster power-loss drill: cut power to every running
+        OSD at once, then (by default) cold-restart each from its WAL
+        path.  → {osd: replay_stats} for the revived OSDs."""
+        crashed = sorted(self.osds)
+        for i in crashed:
+            self.crash_osd(i)
+        report: dict[int, dict] = {}
+        if revive:
+            for i in crashed:
+                osd = self.revive_osd(i, timeout=timeout)
+                report[i] = dict(
+                    getattr(osd.store, "replay_stats", None) or {})
+        return report
 
     # -- mgr ---------------------------------------------------------------
     def start_mgr(self, name: str, **kw):
@@ -362,6 +459,9 @@ class MiniCluster:
                 m.shutdown()
             except Exception:
                 pass
+        if self._wal_dir is not None:
+            shutil.rmtree(self._wal_dir, ignore_errors=True)
+            self._wal_dir = None
         if dedup_problems:
             raise AssertionError("dedup refcount leak at teardown: "
                                  + "; ".join(dedup_problems))

@@ -109,6 +109,21 @@ class TestEvaluators:
         assert chk["severity"] == "WARN" and chk["count"] == 1
         assert chk["detail"] == ["osd.0 is near full (90% used)"]
 
+    def test_osd_store_error_is_err(self):
+        ctx = _synth_ctx()
+        ctx.pgmap.osd_stats[2] = {"stamp": ctx.now,
+                                  "store_error": "wal fsync failed: "
+                                                 "ENOSPC"}
+        ctx.pgmap.osd_stats[3] = {"stamp": ctx.now,
+                                  "store_error": None}
+        by_code = {c["code"]: c for c in evaluate_checks(ctx)}
+        chk = by_code["OSD_STORE_ERROR"]
+        assert chk["severity"] == "ERR" and chk["count"] == 1
+        assert "objectstore write failures" in chk["summary"]
+        assert "osd.2" in chk["detail"][0]
+        assert "ENOSPC" in chk["detail"][0]
+        assert rollup(list(by_code.values())) == "HEALTH_ERR"
+
     def test_diff_reports_transitions(self):
         old = {"status": "HEALTH_OK", "checks": [], "muted": []}
         chk = {"code": "OSD_DOWN", "severity": "WARN",

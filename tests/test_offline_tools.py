@@ -168,6 +168,70 @@ class TestObjectstoreTool:
             k.endswith("tag") for k in d["xattrs"])
 
 
+class TestObjectstoreToolFsck:
+    def _fresh_store(self, tmp_path):
+        from ceph_tpu.os_store.objectstore import Transaction
+        path = str(tmp_path / "osd.wal")
+        s = WALStore(path, sync_mode="none")
+        s.mount(); s.mkfs()
+        s.queue_transaction(
+            Transaction().create_collection("1.0")
+            .write("1.0", "a", 0, b"abc")
+            .setattrs("1.0", "a", {"k": b"v"}))
+        s.umount()
+        return path
+
+    def test_clean_store(self, tmp_path, capsys):
+        path = self._fresh_store(tmp_path)
+        assert objectstore_tool.main(
+            ["--data-path", path, "--op", "fsck"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["issues"] == []
+        assert rep["records"] == rep["records_replayed"] == 1
+        assert rep["tail"]["status"] == "clean"
+
+    def test_torn_tail_reported_not_repaired(self, tmp_path, capsys):
+        path = self._fresh_store(tmp_path)
+        size = None
+        with open(path, "ab") as f:
+            f.write(b"\xce\x01\x10\x00")      # magic + partial header
+        import os
+        size = os.path.getsize(path)
+        assert objectstore_tool.main(
+            ["--data-path", path, "--op", "fsck"]) == 1
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["tail"]["status"] == "torn"
+        assert rep["issues"] and not rep["truncated"]
+        # fsck without --truncate-tail must not touch the file
+        assert os.path.getsize(path) == size
+
+    def test_truncate_tail_repairs(self, tmp_path, capsys):
+        path = self._fresh_store(tmp_path)
+        with open(path, "ab") as f:
+            f.write(b"\xce\x01\x10\x00")
+        assert objectstore_tool.main(
+            ["--data-path", path, "--op", "fsck",
+             "--truncate-tail"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["truncated"] is True
+        assert objectstore_tool.main(
+            ["--data-path", path, "--op", "fsck"]) == 0
+        rep2 = json.loads(capsys.readouterr().out)
+        assert rep2["tail"]["status"] == "clean" and not rep2["issues"]
+
+    def test_corrupt_payload_flagged(self, tmp_path, capsys):
+        from ceph_tpu.os_store import walog
+        path = str(tmp_path / "osd.wal")
+        # a well-framed record whose payload is not a transaction
+        with open(path, "wb") as f:
+            f.write(walog.encode_record(b'{"not": "a txn"}'))
+        assert objectstore_tool.main(
+            ["--data-path", path, "--op", "fsck"]) == 1
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["records"] == 1 and rep["records_replayed"] == 0
+        assert any("replay failed" in i for i in rep["issues"])
+
+
 # ---------------------------------------------------------------------------
 # ceph-kvstore-tool
 # ---------------------------------------------------------------------------
