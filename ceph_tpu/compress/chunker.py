@@ -44,15 +44,19 @@ def _next_pow2(n: int) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _gear_kernel(length: int):
+def _gear_kernel(length: int, mesh=None):
     """[rows, length] uint8 → uint32 gear hashes, one fused launch of
-    W=32 shifted adds (cached per pow2 bucket length)."""
+    W=32 shifted adds (cached per pow2 bucket length).
+
+    With ``mesh`` (hashable — jax Mesh instances are) the megabatch is
+    sharded over the row axis across every mesh device: each row's
+    hash is independent, so the comp lane's fingerprint scan is pure
+    data parallelism with the gear table replicated."""
     import jax
     import jax.numpy as jnp
 
     gear = np.asarray(_GEAR)
 
-    @jax.jit
     def kern(batch):
         g = jnp.asarray(gear)[batch.astype(jnp.int32)]
         padded = jnp.pad(g, ((0, 0), (_WINDOW - 1, 0)))
@@ -63,7 +67,13 @@ def _gear_kernel(length: int):
                          << jnp.uint32(j))
         return acc
 
-    return kern
+    if mesh is None:
+        return jax.jit(kern)
+    from jax.sharding import NamedSharding, PartitionSpec
+    rows_sharded = NamedSharding(
+        mesh, PartitionSpec(tuple(mesh.axis_names), None))
+    return jax.jit(kern, in_shardings=(rows_sharded,),
+                   out_shardings=rows_sharded)
 
 
 def gear_hashes_host(row: np.ndarray) -> np.ndarray:
@@ -108,8 +118,12 @@ class Chunker:
         """Engine group key: one launch shape family per parameter set."""
         return ("cdc", self.avg, self.min, self.max)
 
-    def hash_batch(self, batch: np.ndarray):
-        """Device gear hashes for a padded megabatch."""
+    def hash_batch(self, batch: np.ndarray, mesh=None):
+        """Device gear hashes for a padded megabatch; with ``mesh``
+        (and rows divisible by its device count) the scan is sharded
+        data-parallel over the row axis."""
+        if mesh is not None and batch.shape[0] % mesh.size == 0:
+            return _gear_kernel(batch.shape[1], mesh)(batch)
         return _gear_kernel(batch.shape[1])(batch)
 
     def cuts_from_hashes(self, hashes: np.ndarray,

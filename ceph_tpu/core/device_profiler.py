@@ -56,12 +56,13 @@ class _Launch:
 
     __slots__ = ("_prof", "kernel", "t0", "t_dispatch",
                  "bytes_in", "bytes_used", "rows", "rows_used",
-                 "tags", "_overlap")
+                 "tags", "_overlap", "devices")
 
     def __init__(self, prof: "DeviceProfiler", kernel: str,
                  bytes_in: int, rows: int, rows_used: int,
                  tags: dict[str, Any], bytes_used: int | None = None,
-                 overlap: bool = False):
+                 overlap: bool = False,
+                 devices: tuple[str, ...] | None = None):
         self._prof = prof
         self.kernel = kernel
         self.t0 = time.monotonic()
@@ -73,6 +74,7 @@ class _Launch:
         self.rows_used = int(rows_used)
         self.tags = tags
         self._overlap = overlap
+        self.devices = devices
 
     def dispatched(self) -> None:
         """Mark the end of the (async) dispatch phase *now*.  A later
@@ -130,6 +132,7 @@ class DeviceProfiler:
         self._last_end: float | None = None   # for the idle-gap series
         self._agg: dict[str, dict] = {}
         self._lanes: dict[str, dict] = {}
+        self._devices: dict[str, dict] = {}
         self._hist = LogHistogram(LAUNCH_HIST_BUCKETS)
         self._totals = self._zero_agg()
 
@@ -155,6 +158,7 @@ class DeviceProfiler:
             self._ring.clear()
             self._agg.clear()
             self._lanes.clear()
+            self._devices.clear()
             self._totals = self._zero_agg()
             self._hist = LogHistogram(LAUNCH_HIST_BUCKETS)
             self._last_end = None
@@ -175,7 +179,9 @@ class DeviceProfiler:
 
     def start(self, kernel: str, bytes_in: int = 0, rows: int = 0,
               rows_used: int = 0, bytes_used: int | None = None,
-              overlap: bool = False, **tags) -> _Launch | None:
+              overlap: bool = False,
+              devices: tuple[str, ...] | None = None,
+              **tags) -> _Launch | None:
         """Open a launch; returns ``None`` when disabled or nested so
         call sites stay zero-alloc on the fast path.
 
@@ -187,7 +193,12 @@ class DeviceProfiler:
         at once (the batch engine's double-buffered flights) and
         guarantees no nested instrumented calls of its own; such a
         launch neither consults nor sets the thread-local nesting
-        flag."""
+        flag.
+
+        ``devices`` — the mesh devices an SPMD launch spans; the
+        sample folds into a per-device aggregate (times counted in
+        full per device — each device is occupied for the whole
+        launch — bytes/rows split evenly, the per-device slice)."""
         if not self.enabled:
             return None
         if not overlap:
@@ -196,7 +207,8 @@ class DeviceProfiler:
             _tls.in_launch = True
         return _Launch(self, kernel, bytes_in, rows,
                        max(rows_used, 0) or rows, tags,
-                       bytes_used=bytes_used, overlap=overlap)
+                       bytes_used=bytes_used, overlap=overlap,
+                       devices=devices)
 
     def _record(self, lnch: _Launch, compute: float, t_end: float,
                 bytes_out: int) -> None:
@@ -217,6 +229,7 @@ class DeviceProfiler:
             "rows": lnch.rows,
             "rows_used": lnch.rows_used,
             "tags": lnch.tags,
+            "devices": lnch.devices,
         }
         with self._lock:
             gap = None
@@ -245,6 +258,25 @@ class DeviceProfiler:
                 if gap is not None:
                     agg["gap_s"] += gap
                     agg["gaps"] += 1
+            if lnch.devices:
+                # SPMD occupancy semantics: every device of the mesh
+                # is busy for the launch's full dispatch+compute span,
+                # so times count in FULL per device; bytes/rows split
+                # evenly — each device touches 1/n of the megabatch
+                nd = len(lnch.devices)
+                for label in lnch.devices:
+                    dag = self._devices.setdefault(
+                        label, self._zero_agg())
+                    dag["launches"] += 1
+                    dag["dispatch_s"] += dispatch
+                    dag["compute_s"] += compute
+                    dag["bytes_in"] += lnch.bytes_in // nd
+                    dag["bytes_used"] += lnch.bytes_used // nd
+                    dag["bytes_out"] += bytes_out // nd
+                    dag["rows"] += lnch.rows // nd
+                    dag["rows_used"] += lnch.rows_used // nd
+                    if cache_hit:
+                        dag["cache_hits"] += 1
             self._hist.add(int(total * 1e6))
         if self.perf is not None:
             try:
@@ -268,6 +300,7 @@ class DeviceProfiler:
         with self._lock:
             kernels = {k: dict(v) for k, v in self._agg.items()}
             lanes = {k: dict(v) for k, v in self._lanes.items()}
+            devices = {k: dict(v) for k, v in self._devices.items()}
             tot = dict(self._totals)
             hist = list(self._hist.data[0])
         t = tot["dispatch_s"] + tot["compute_s"]
@@ -276,6 +309,7 @@ class DeviceProfiler:
             "enabled": self.enabled,
             "kernels": kernels,
             "lanes": lanes,
+            "devices": devices,
             "totals": tot,
             "launch_hist_us": hist,
             "dispatch_overhead_ratio":

@@ -31,11 +31,12 @@ from .map import (
 )
 from .mapper import do_rule
 from .jax_mapper import BatchMapper
+from .bucketed import BucketedMapper
 
 __all__ = [
     "crush_hash32", "crush_hash32_2", "crush_hash32_3", "crush_hash32_4",
     "ceph_str_hash_rjenkins", "crush_ln",
     "Bucket", "CrushMap", "Rule", "Step", "Tunables",
     "build_flat_map", "build_hierarchy",
-    "do_rule", "BatchMapper",
+    "do_rule", "BatchMapper", "BucketedMapper",
 ]

@@ -115,6 +115,46 @@ def measure() -> dict:
     # bm's tables and bit-compares against the pre-remap results
     bm.set_weights(cmap)
 
+    # size-class bucketing: a DIFFERENT cluster size in the same pow2
+    # class warm-starts from the canonical export — the compile tax a
+    # resized cluster used to pay becomes a cache load + table rebuild
+    from .bucketed import BucketedMapper
+    t0 = time.perf_counter()
+    bkA = BucketedMapper(cmap, 0, result_max=numrep, chunk=bm.chunk)
+    bkA(warm)
+    bkA_s = time.perf_counter() - t0
+    cmapB = build_hierarchy(1, max(1, hosts - hosts // 8),
+                            max(1, per_host - per_host // 16))
+    traces0 = _jm.TRACE_COUNT
+    t0 = time.perf_counter()
+    bkB = BucketedMapper(cmapB, 0, result_max=numrep, chunk=bm.chunk)
+    bkB(warm)
+    bkB_s = time.perf_counter() - t0
+    bk_n = min(done, 4 * bm.chunk)
+    t0 = time.perf_counter()
+    bkB(xs[:bk_n])
+    bk_map_s = time.perf_counter() - t0
+    result["crush_bucketed_warm"] = {
+        "size_class": list(map(str, bkA.size_class or ())),
+        "cold_compile_s": round(bkA_s, 2),
+        "warm_compile_s": round(bkB_s, 2),
+        "warm_cache_hit": bkB.cache_hit,
+        "warm_retraced": _jm.TRACE_COUNT != traces0,
+        "osds_b": sum(b.size for b in cmapB.buckets
+                      if b is not None and b.type == 1),
+        "pgs_per_sec": round(bk_n / bk_map_s, 1),
+    }
+    # oracle spot-check on the resized cluster (cheap, scalar python)
+    from .mapper import do_rule
+    gotB = bkB(xs[:64])
+    for i in range(0, 64, 7):
+        ref = do_rule(cmapB, cmapB.rule_by_id(0), int(xs[i]), numrep)
+        row = np.full(numrep, -0x7FFFFFFF, dtype=np.int32)
+        row[:len(ref)] = ref[:numrep]
+        if not np.array_equal(gotB[i], row):
+            result["crush_bucketed_warm"]["oracle_error"] = int(i)
+            break
+
     try:
         from .. import native
         native.ensure_built()

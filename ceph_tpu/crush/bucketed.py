@@ -1,0 +1,362 @@
+"""CRUSH warm-start by construction — pow2 size-class bucketing.
+
+`BatchMapper`'s export cache already makes a *repeated* topology free,
+but every new cluster SIZE (osds, hosts) is a new topology shape and
+pays the full trace+compile tax.  This module removes the shape from
+the program: a map is padded into its pow2 **size class** — hosts
+padded to ``H_pad = next_pow2(hosts)``, each host to
+``Q_pad = next_pow2(max host size)`` — and compiled once per class.
+Every concrete cluster in the class then rides the SAME exported
+program; its real item ids and weights enter as *runtime* tables.
+
+The mechanism is the one the balancer already uses: `choose_args`.
+The canonical map bakes placeholder items (``h * Q_pad + q`` for
+devices, dense negative ids for buckets), and the per-bucket
+``choose_args[bid]["ids"]`` / ``weight_set`` substitution injects the
+real ids into the straw2 hashes and the real weights into the draws —
+both are runtime arguments of the compiled program (`_WTAB_FIELDS`),
+so switching clusters within a class is a host-side table rebuild:
+zero retraces, zero XLA compiles.
+
+Why this is bit-exact vs the unbucketed mapper:
+
+- straw2 draws hash ``(x, hash_id, r)`` — the bucket's own id never
+  enters the hash, and the injected hash_ids ARE the real ids, so
+  every draw is numerically identical to the real map's;
+- phantom pad slots carry weight 0, and `_straw2_draws` maps zero
+  weight to INT64_MIN — a phantom never outdraws a real item (and an
+  all-zero bucket falls to index 0 in both maps, which the output
+  permutation sends to the same real item);
+- collision checks compare baked canonical items; the embedding
+  real → canonical is injective, so the collide pattern is identical;
+- `is_out` reweight rejection reads the runtime reweight vector by
+  baked item id — the caller's vector is scattered into canonical id
+  space.  The only id that leaks into a HASH is the device id inside
+  `dev_out`, and only for *fractional* overload reweights
+  (0 < w < 0x10000): when the canonical device ids differ from the
+  real ones AND a fractional reweight is present, `__call__` routes
+  through an exact unbucketed mapper instead of approximating.
+
+Supported shapes (the canonical families `build_flat_map` /
+`build_hierarchy` produce): a single-block rule whose take bucket is
+either a flat straw2 root holding devices, or a straw2 spine of
+size-1 buckets down to a fanout bucket whose children all hold only
+devices.  Anything else (legacy algs, existing choose_args, class
+shadows, deeper trees, multi-block rules) transparently degrades to a
+plain `BatchMapper` (``self.bucketed`` is False).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .jax_mapper import BatchMapper
+from .map import CRUSH_ITEM_NONE, Bucket, CrushMap, Rule, Step
+
+_NONE = CRUSH_ITEM_NONE
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (max(1, int(n)) - 1).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class _Shape:
+    """The analyzed real topology (ids/weights live on the map)."""
+    kind: str                      # "flat" | "tree"
+    take_id: int
+    spine_types: tuple[int, ...]   # size-1 buckets above the fanout
+    fanout_type: int               # flat: the root's own type
+    leaf_type: int                 # tree only
+    n_leaves: int                  # tree: real host count; flat: 1
+    H_pad: int
+    Q_pad: int
+
+    @property
+    def size_class(self) -> tuple:
+        """Everything the canonical TOPOLOGY depends on.  Rule steps,
+        tunables and result_max further partition the export cache
+        (they are part of `BatchMapper._cache_key`), but two maps with
+        equal size_class + rule + tunables share one program."""
+        return (self.kind, self.spine_types, self.fanout_type,
+                self.leaf_type, self.H_pad, self.Q_pad)
+
+
+def _analyze(cmap: CrushMap, rule: Rule) -> _Shape | None:
+    """Classify `cmap`/`rule` into a size class, or None → no bucketing."""
+    if cmap.choose_args:
+        return None             # a real weight-set would clash with ours
+    if sum(1 for s in rule.steps if s.op == "emit") != 1:
+        return None             # multi-block: BatchMapper splits it itself
+    takes = [s for s in rule.steps if s.op == "take"]
+    if len(takes) != 1 or takes[0].cls is not None:
+        return None
+    take_id = takes[0].arg1
+    try:
+        node = cmap.bucket(take_id)
+    except KeyError:
+        return None
+    spine: list[Bucket] = []
+    seen = set()
+    while node.size == 1 and node.items[0] < 0:
+        if node.alg != "straw2" or node.id in seen:
+            return None
+        seen.add(node.id)
+        spine.append(node)
+        node = cmap.bucket(node.items[0])
+    if node.alg != "straw2" or node.size == 0:
+        return None
+    if len(node.weights) != node.size:
+        return None
+    devs: list[int] = []
+    if all(i >= 0 for i in node.items):
+        devs = list(node.items)
+        shape = _Shape("flat", take_id,
+                       tuple(b.type for b in spine), node.type, 0, 1,
+                       1, _next_pow2(node.size))
+    elif all(i < 0 for i in node.items):
+        leaves = [cmap.bucket(i) for i in node.items]
+        if len({lb.type for lb in leaves}) != 1:
+            return None
+        for lb in leaves:
+            if (lb.alg != "straw2" or lb.size == 0
+                    or len(lb.weights) != lb.size
+                    or any(i < 0 for i in lb.items)):
+                return None
+            devs += lb.items
+        shape = _Shape("tree", take_id,
+                       tuple(b.type for b in spine), node.type,
+                       leaves[0].type, len(leaves),
+                       _next_pow2(len(leaves)),
+                       _next_pow2(max(lb.size for lb in leaves)))
+    else:
+        return None             # devices and buckets mixed in one bucket
+    if len(set(devs)) != len(devs):
+        return None
+    if devs and max(devs) >= max(cmap.max_devices, 1):
+        return None             # reweight vector could not cover them
+    return shape
+
+
+class BucketedMapper:
+    """`BatchMapper` with the topology SHAPE compiled out.
+
+    Drop-in for the common case: ``BucketedMapper(cmap, rule_id,
+    result_max=..., chunk=...)`` then ``__call__(xs, reweight=None)``,
+    ``set_weights(new_cmap)``, ``remap({bucket_id: weights})``.  Extra
+    surface: ``bucketed`` (False when the map fell back to a plain
+    mapper), ``size_class`` (the pow2 class tuple), and — the point —
+    `set_weights` accepts any map in the SAME size class, not just
+    weight-only changes: growing a 48-host cluster to 60 hosts rebinds
+    tables on the same executable."""
+
+    def __init__(self, cmap: CrushMap, rule: Rule | int = 0,
+                 result_max: int | None = None, chunk: int = 1 << 16):
+        if isinstance(rule, int):
+            rule = cmap.rule_by_id(rule)
+        self.cmap = cmap
+        self.rule = rule
+        self._result_max = result_max
+        self._req_chunk = chunk
+        self._exact: BatchMapper | None = None
+        shape = _analyze(cmap, rule)
+        if shape is None:
+            self._bm = BatchMapper(cmap, rule, result_max=result_max,
+                                   chunk=chunk)
+            self._exact = self._bm
+            self.bucketed = False
+            self.size_class = None
+            self._shape = None
+        else:
+            self.bucketed = True
+            self._shape = shape
+            self.size_class = shape.size_class
+            canon = self._canon_map(shape, cmap, rule)
+            self._install_runtime(shape, cmap, canon)
+            self._bm = BatchMapper(canon, canon.rules[0],
+                                   result_max=result_max, chunk=chunk)
+        self.cache_hit = self._bm.cache_hit
+        self.result_max = self._bm.result_max
+
+    @property
+    def chunk(self) -> int:
+        return self._bm.chunk
+
+    # -- canonical construction -------------------------------------------
+
+    @staticmethod
+    def _canon_topology(shape: _Shape) -> CrushMap:
+        """The class's canonical map — a pure function of the size
+        class, so every in-class cluster flattens to identical static
+        tables and hits the same export-cache entry."""
+        m = CrushMap(types={0: "osd"}, max_devices=shape.H_pad * shape.Q_pad)
+        for t in shape.spine_types + (shape.fanout_type,):
+            m.types.setdefault(t, f"t{t}")
+        ns = len(shape.spine_types)
+        fanout_id = -1 - ns            # spine[i] ↔ -(i+1), root-first
+        if shape.kind == "flat":
+            m.add_bucket(Bucket(id=fanout_id, type=shape.fanout_type,
+                                items=list(range(shape.Q_pad)),
+                                weights=[0] * shape.Q_pad))
+        else:
+            m.types.setdefault(shape.leaf_type, f"t{shape.leaf_type}")
+            leaf0 = fanout_id - 1
+            m.add_bucket(Bucket(
+                id=fanout_id, type=shape.fanout_type,
+                items=[leaf0 - h for h in range(shape.H_pad)],
+                weights=[0] * shape.H_pad))
+            for h in range(shape.H_pad):
+                m.add_bucket(Bucket(
+                    id=leaf0 - h, type=shape.leaf_type,
+                    items=[h * shape.Q_pad + q
+                           for q in range(shape.Q_pad)],
+                    weights=[0] * shape.Q_pad))
+        for i, t in enumerate(shape.spine_types):
+            m.add_bucket(Bucket(id=-(i + 1), type=t, items=[-(i + 2)],
+                                weights=[0x10000]))
+        return m
+
+    def _canon_map(self, shape: _Shape, cmap: CrushMap,
+                   rule: Rule) -> CrushMap:
+        m = self._canon_topology(shape)
+        m.tunables = dataclasses.replace(cmap.tunables)
+        # the canonical take is always the outermost canonical bucket
+        steps = [Step("take", -1) if s.op == "take"
+                 else Step(s.op, s.arg1, s.arg2) for s in rule.steps]
+        m.rules.append(Rule(id=0, name="bucketed", steps=steps,
+                            type=rule.type))
+        m.choose_args = self._canon_args(shape, cmap)
+        return m
+
+    @staticmethod
+    def _canon_args(shape: _Shape, cmap: CrushMap) -> dict[int, dict]:
+        """Real ids + weights as canonical `choose_args` (runtime
+        tables of the compiled program).  Phantom slots get their own
+        canonical id (value irrelevant — weight 0 never wins a draw)."""
+        args: dict[int, dict] = {}
+        node = cmap.bucket(shape.take_id)
+        while node.size == 1 and node.items[0] < 0:
+            node = cmap.bucket(node.items[0])
+        fanout_id = -1 - len(shape.spine_types)
+        if shape.kind == "flat":
+            ids = list(node.items) + list(range(node.size, shape.Q_pad))
+            ws = list(node.weights) + [0] * (shape.Q_pad - node.size)
+            args[fanout_id] = {"ids": ids, "weight_set": [ws]}
+            return args
+        leaf0 = fanout_id - 1
+        fo_ids = list(node.items) + [leaf0 - h for h in
+                                     range(node.size, shape.H_pad)]
+        fo_ws = list(node.weights) + [0] * (shape.H_pad - node.size)
+        args[fanout_id] = {"ids": fo_ids, "weight_set": [fo_ws]}
+        for h in range(shape.H_pad):
+            cid = leaf0 - h
+            if h < node.size:
+                lb = cmap.bucket(node.items[h])
+                ids = list(lb.items) + [h * shape.Q_pad + q for q in
+                                        range(lb.size, shape.Q_pad)]
+                ws = list(lb.weights) + [0] * (shape.Q_pad - lb.size)
+            else:
+                ids = [h * shape.Q_pad + q for q in range(shape.Q_pad)]
+                ws = [0] * shape.Q_pad
+            args[cid] = {"ids": ids, "weight_set": [ws]}
+        return args
+
+    def _install_runtime(self, shape: _Shape, cmap: CrushMap,
+                         canon: CrushMap) -> None:
+        """Output permutation + reweight scatter for this cluster."""
+        node = cmap.bucket(shape.take_id)
+        while node.size == 1 and node.items[0] < 0:
+            node = cmap.bucket(node.items[0])
+        perm = np.full(shape.H_pad * shape.Q_pad, _NONE, dtype=np.int32)
+        if shape.kind == "flat":
+            perm[:node.size] = node.items
+        else:
+            for h, hid in enumerate(node.items):
+                lb = cmap.bucket(hid)
+                perm[h * shape.Q_pad:
+                     h * shape.Q_pad + lb.size] = lb.items
+        self._perm = perm
+        self._slots = np.nonzero(perm != _NONE)[0].astype(np.int64)
+        self._real_devs = perm[self._slots].astype(np.int64)
+        self._ident = bool(np.array_equal(self._slots, self._real_devs))
+        self._real_W = max(cmap.max_devices, 1)
+        self._canon_W = max(canon.max_devices, 1)
+
+    # -- rebinds -----------------------------------------------------------
+
+    def set_weights(self, cmap: CrushMap) -> "BucketedMapper":
+        """Rebind to `cmap` without recompiling.  Unlike
+        `BatchMapper.set_weights` this accepts ANY map in the same
+        pow2 size class (same rule steps + tunables): a resize within
+        the class is a runtime-table rebuild, not a retrace."""
+        if not self.bucketed:
+            self._bm.set_weights(cmap)
+            self.cmap = cmap
+            return self
+        shape = _analyze(cmap, self.rule)
+        if shape is None or shape.size_class != self.size_class:
+            raise ValueError("size class changed: rebuild the mapper")
+        canon = self._canon_map(shape, cmap, self.rule)
+        self._bm.set_weights(canon)
+        self._shape = shape
+        self._install_runtime(shape, cmap, canon)
+        self.cmap = cmap
+        self._exact = None
+        return self
+
+    def remap(self, new_weights) -> "BucketedMapper":
+        """Weight-only rebind (same dict form as `BatchMapper.remap`)."""
+        if isinstance(new_weights, CrushMap):
+            return self.set_weights(new_weights)
+        by_id = dict(new_weights)
+        buckets = []
+        for b in self.cmap.buckets:
+            if b is not None and b.id in by_id:
+                ws = [int(w) for w in by_id.pop(b.id)]
+                if len(ws) != b.size:
+                    raise ValueError(
+                        f"bucket {b.id}: {len(ws)} weights != "
+                        f"size {b.size}")
+                b = dataclasses.replace(b, weights=ws)
+            buckets.append(b)
+        if by_id:
+            raise ValueError(f"unknown bucket ids {sorted(by_id)}")
+        return self.set_weights(
+            dataclasses.replace(self.cmap, buckets=buckets))
+
+    # -- mapping -----------------------------------------------------------
+
+    def _exact_mapper(self) -> BatchMapper:
+        if self._exact is None:
+            self._exact = BatchMapper(self.cmap, self.rule,
+                                      result_max=self._result_max,
+                                      chunk=self._req_chunk)
+        return self._exact
+
+    def __call__(self, xs, reweight=None) -> np.ndarray:
+        if not self.bucketed:
+            return self._bm(xs, reweight)
+        if reweight is None:
+            rw = np.full(self._real_W, 0x10000, dtype=np.uint32)
+        else:
+            rw = np.asarray(reweight, dtype=np.uint32)
+            if len(rw) < self._real_W:
+                rw = np.pad(rw, (0, self._real_W - len(rw)))
+            elif len(rw) > self._real_W:
+                rw = rw[:self._real_W]
+        if not self._ident and bool(
+                ((rw > 0) & (rw < 0x10000)).any()):
+            # fractional overload reweight hashes the DEVICE id inside
+            # is_out; with remapped ids that hash would differ from the
+            # real map's — take the exact path instead of approximating
+            return self._exact_mapper()(xs, rw)
+        wc = np.zeros(self._canon_W, dtype=np.uint32)
+        wc[self._slots] = rw[self._real_devs]
+        out = self._bm(xs, wc)
+        if self._ident:
+            return out
+        return np.where(out >= 0,
+                        self._perm[np.clip(out, 0, len(self._perm) - 1)],
+                        out).astype(np.int32)

@@ -289,16 +289,28 @@ class GFEncodeDigest:
     the engine double-buffer host↔device staging without 2x peak
     memory.  CPU (CI) skips donation — XLA:CPU can't alias them and
     would warn on every launch.
+
+    ``mesh`` shards the megabatch over the batch axis across every
+    mesh device (the bitmatrix and the CRC contribution matrix are
+    replicated closure constants, so the program is embarrassingly
+    data-parallel) — one OSD host drives all chips per launch.  Shapes
+    whose batch doesn't divide ``mesh.size`` fall back to the
+    single-device program, and the sharded variant skips the export
+    cache (serialized programs don't carry shardings); it still
+    amortizes through the in-process per-shape table.
     """
 
-    def __init__(self, coding: np.ndarray, donate: bool | None = None):
+    def __init__(self, coding: np.ndarray, donate: bool | None = None,
+                 mesh=None):
         self.coding = np.asarray(coding, dtype=np.uint8)
         self.m, self.k = self.coding.shape
         self._mat = jnp.asarray(_bit_layout_matrix(self.coding))
         self.donate = (jax.default_backend() == "tpu"
                        if donate is None else bool(donate))
+        self.mesh = mesh
         self._shape_fns: dict[tuple, object] = {}
         self.export_hits: dict[tuple, bool] = {}
+        self.mesh_hits: dict[tuple, bool] = {}
 
     def _make(self, batch: int, length: int):
         from ..scrub.crc32c_jax import _contrib
@@ -335,6 +347,15 @@ class GFEncodeDigest:
         batch, _k, length = shape
         run = self._make(batch, length)
         donate = (0,) if self.donate else ()
+        if self.mesh is not None and batch % self.mesh.size == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+            spec = PartitionSpec(tuple(self.mesh.axis_names), None, None)
+            fn = jax.jit(run, donate_argnums=donate,
+                         in_shardings=(NamedSharding(self.mesh, spec),))
+            self._shape_fns[shape] = fn
+            self.export_hits[shape] = False
+            self.mesh_hits[shape] = True
+            return fn
         fn, hit = jax.jit(run, donate_argnums=donate), False
         from ..native.aot import CompileCache, cached_export
         if CompileCache.default() is not None:
@@ -355,6 +376,7 @@ class GFEncodeDigest:
                 pass            # non-exportable on this jax: plain jit
         self._shape_fns[shape] = fn
         self.export_hits[shape] = hit
+        self.mesh_hits[shape] = False
         return fn
 
     def __call__(self, data) -> tuple[jax.Array, jax.Array]:

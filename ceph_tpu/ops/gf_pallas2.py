@@ -395,16 +395,23 @@ class ResidentPlanes:
     matrices upload once for the whole sweep instead of once per
     batch (the "held across a whole recovery sweep" half of the
     contract — planes live per batch, matrices per sweep).
+
+    ``mesh`` (a jax Mesh) shards a 3-D batch over the batch axis:
+    the planes expand once *sharded* and every :meth:`multiply` is a
+    ``shard_map`` of the local Pallas kernel — each device multiplies
+    only its resident plane slice, matrices replicated as closure
+    constants.  Batches that aren't 3-D or don't divide ``mesh.size``
+    silently stay single-device (same results, one chip).
     """
 
-    __slots__ = ("planes", "n", "interpret", "_mats")
+    __slots__ = ("planes", "n", "interpret", "_mats", "mesh", "_spec")
 
     # gf_expand_words tile contract: byte length % 512 == 0 so the
     # word planes split into whole 128-lane tiles
     _ALIGN = 512
 
     def __init__(self, data, interpret: bool = False,
-                 mats: dict | None = None):
+                 mats: dict | None = None, mesh=None):
         data = jnp.asarray(data, dtype=jnp.uint8)
         n = int(data.shape[-1])
         pad = -n % self._ALIGN
@@ -414,6 +421,16 @@ class ResidentPlanes:
         self.n = n
         self.interpret = interpret
         self._mats = mats if mats is not None else {}
+        if mesh is not None and (data.ndim != 3
+                                 or data.shape[0] % mesh.size):
+            mesh = None
+        self.mesh = mesh
+        self._spec = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._spec = PartitionSpec(tuple(mesh.axis_names),
+                                       None, None)
+            data = jax.device_put(data, NamedSharding(mesh, self._spec))
         self.planes = gf_expand_words(data)
 
     def multiply(self, matrix: np.ndarray) -> jnp.ndarray:
@@ -424,6 +441,32 @@ class ResidentPlanes:
         mat = np.ascontiguousarray(matrix, dtype=np.uint8)
         bdmats = self._mats.setdefault(mat.tobytes(), {})
         bits = _bit_layout_matrix(mat)
+        if self.mesh is not None:
+            return self._multiply_mesh(bits, mat.shape[0],
+                                       bdmats)[..., : self.n]
         out = gf_matmul_planes(bits, self.planes, mat.shape[0],
                                interpret=self.interpret, bdmats=bdmats)
         return out[..., : self.n]
+
+    def _multiply_mesh(self, bits, m: int, bdmats: dict) -> jnp.ndarray:
+        """shard_map of the local planes kernel over the batch axis —
+        a sharded operand fed straight to the jitted pallas_call would
+        be gathered to one device, so the kernel runs *inside* the
+        per-device program instead."""
+        from ..utils.jaxcompat import shard_map
+        bdmat = bdmats.get("v2")
+        if bdmat is None:
+            bdmat = bdmats["v2"] = jnp.asarray(
+                block_diag4(np.asarray(bits)))
+        interpret = self.interpret
+
+        def local_fn(planes):           # [Bl, 32k, nw] this device
+            out = _gf_apply_planes(bdmat, planes, m=m,
+                                   interpret=interpret)
+            return jax.lax.bitcast_convert_type(out, jnp.uint8).reshape(
+                planes.shape[0], m, -1)
+
+        with enable_x64(False):
+            return shard_map(local_fn, mesh=self.mesh,
+                             in_specs=self._spec, out_specs=self._spec,
+                             check_vma=False)(self.planes)

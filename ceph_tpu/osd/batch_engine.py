@@ -239,6 +239,7 @@ class BatchEngine:
         self._plane_mats: dict = {}          # bit-plane matrix operands
         self._sharded: dict = {}             # code key → ShardedEC
         self._mesh = None
+        self._mesh_devs: tuple[str, ...] | None = None
         self._flights: queue.Queue = queue.Queue()
         self._worker: threading.Thread | None = None
         self._stopped = False
@@ -833,15 +834,40 @@ class BatchEngine:
         return flights
 
     def _prof_start(self, ops, rows, staged_bytes, reason, op_kind,
-                    cache_hit, lane="write"):
+                    cache_hit, lane="write", devices=None):
         if self.profiler is None:
             return None
         return self.profiler.start(
             "megabatch", bytes_in=staged_bytes,
             bytes_used=sum(o.nbytes for o in ops),
             rows=rows, rows_used=len(ops), overlap=True,
+            devices=devices,
             members=len(ops), reason=reason, op=op_kind,
             cache_hit=cache_hit, lane=lane)
+
+    def _engine_mesh(self):
+        """The process-wide cluster mesh when ``use_mesh`` is on and
+        more than one device is visible, else None (single-chip paths
+        unchanged).  One mesh serves every lane, so all sharded
+        executable caches key off the same device grid."""
+        if not self.use_mesh:
+            return None
+        if self._mesh is None:
+            import jax
+            if len(jax.devices()) <= 1:
+                return None
+            from ..parallel.mesh import cluster_mesh
+            self._mesh = cluster_mesh()
+        return self._mesh
+
+    def _mesh_labels(self):
+        mesh = self._engine_mesh()
+        if mesh is None:
+            return None
+        if self._mesh_devs is None:
+            from ..parallel.mesh import mesh_device_labels
+            self._mesh_devs = mesh_device_labels(mesh)
+        return self._mesh_devs
 
     def _launch_encode(self, key, ops, rows, bucket_len, span,
                        reason) -> _Flight:
@@ -850,14 +876,23 @@ class BatchEngine:
         fused = self._fused.get(key)
         if fused is None:
             fused = self._fused[key] = GFEncodeDigest(
-                np.frombuffer(mat, dtype=np.uint8).reshape(m, k))
+                np.frombuffer(mat, dtype=np.uint8).reshape(m, k),
+                mesh=self._engine_mesh())
+        if fused.mesh is not None:
+            # pad the row bucket up so the batch axis divides the mesh
+            # (pow2 rows and pow2 device counts nest; odd device
+            # counts fall back silently inside GFEncodeDigest)
+            rows = max(rows, _next_pow2(fused.mesh.size))
         batch = np.zeros((rows, k, bucket_len), dtype=np.uint8)
         for i, op in enumerate(ops):
             batch[i, :, :op.length] = op.chunks
         shape = (rows, k, bucket_len)
         ln = self._prof_start(ops, rows, batch.nbytes, reason,
                               "encode", fused.export_hits.get(shape,
-                                                              False))
+                                                              False),
+                              devices=(self._mesh_labels()
+                                       if fused.mesh is not None
+                                       else None))
         try:
             out = fused(batch)
         except Exception:
@@ -902,7 +937,8 @@ class BatchEngine:
             batch[i, :, :op.length] = op.chunks
         ln = self._prof_start(ops, rows, batch.nbytes, reason,
                               "recon", key in self._rexec,
-                              lane="recon")
+                              lane="recon",
+                              devices=self._mesh_labels())
         try:
             out = self._run_reconstruct(key, plan, batch)
         except Exception:
@@ -917,61 +953,76 @@ class BatchEngine:
     def _run_reconstruct(self, key, plan, batch):
         """Pick the reconstruct strategy for one fused group:
 
-        - mesh (``use_mesh`` and >1 device): the shard_map program of
-          ``parallel.reconstruct.ShardedEC`` — survivor rows scattered
-          to their chunk-id positions, batch padded to a dp multiple.
-          Only for pure-data erasure patterns (the common recovery
-          case); composed parity rows stay on the fused path.
         - resident planes (``use_planes``, auto on TPU): expand the
           survivor batch to bit planes once, multiply by the plan's
           stacked matrix — per-matrix operands persist in
-          ``_plane_mats`` across the whole sweep.
+          ``_plane_mats`` across the whole sweep.  With the mesh on,
+          the planes expand *sharded* over the batch axis and each
+          multiply is a shard_map of the local kernel.
+        - mesh (``use_mesh`` and >1 device): the shard_map program of
+          ``parallel.reconstruct.ShardedEC`` — survivor rows scattered
+          to their chunk-id positions, batch padded to a dp multiple.
+          Parity-hole erasure patterns ride this launch too: the
+          decode fn is built from the plan's stacked ``[k + p, k]``
+          matrix, so the all-gather reduce emits the composed
+          ``coding ∘ dm`` rows alongside the data rows.
         - default: one cached ``GFLinear`` over the plan's fused
           ``[k + p, k]`` matrix — a single launch per group.
         """
         import jax
-        if (self.use_mesh and plan.parity_matrix is None
-                and len(jax.devices()) > 1):
-            return self._run_mesh(key, plan, batch)
+        mesh = self._engine_mesh()
         planes = (self.use_planes if self.use_planes is not None
                   else jax.default_backend() == "tpu")
         if planes:
             from ..ops.gf_pallas2 import ResidentPlanes
             rp = ResidentPlanes(
                 batch, interpret=jax.default_backend() != "tpu",
-                mats=self._plane_mats)
+                mats=self._plane_mats, mesh=mesh)
             return rp.multiply(plan.matrix)
+        if mesh is not None:
+            return self._run_mesh(key, plan, batch)
         prog = self._rexec.get(key)
         if prog is None:
             from ..ops.gf_jax import GFLinear
             prog = self._rexec[key] = GFLinear(plan.matrix)
         return prog(batch)
 
-    def _run_mesh(self, key, plan, batch):
-        from ..parallel.mesh import make_mesh
-        from ..parallel.reconstruct import ShardedEC
-        code_key = key[:4]
+    def _sharded_ec(self, k, m, mat):
+        """Cached per-code ShardedEC over the cluster mesh — shared by
+        the recovery reconstruct and the scrub recheck paths (one
+        compiled program family per code, not per caller)."""
+        code_key = (k, m, mat)
         sh = self._sharded.get(code_key)
         if sh is None:
-            if self._mesh is None:
-                self._mesh = make_mesh()
-            _kind, k, m, mat = code_key
+            from ..parallel.reconstruct import ShardedEC
             coding = np.frombuffer(mat, dtype=np.uint8).reshape(m, k)
             # byte payloads in, byte payloads out: word_native stays
             # off so host staging needs no dtype views
             sh = self._sharded[code_key] = ShardedEC(
-                coding, k, m, self._mesh, word_native=False)
+                coding, k, m, self._engine_mesh(), word_native=False)
+        return sh
+
+    def _run_mesh(self, key, plan, batch):
+        _kind, k, m, mat = key[:4]
+        sh = self._sharded_ec(k, m, mat)
         rows, _k, length = batch.shape
         dp = sh.mesh.shape["dp"]
         b_pad = -(-rows // dp) * dp
         full = np.zeros((b_pad, sh.n_pad, length), dtype=np.uint8)
         for r, sid in enumerate(plan.survivors):
             full[:rows, sid] = batch[:, r]
-        out = sh.reconstruct(full, plan.erasures)
+        # emit="plan": the mesh launch returns the k data rows AND the
+        # composed parity rows in plan.out_ids order, so parity-hole
+        # patterns complete through the same plan.row_of indexing the
+        # fused single-chip matrix uses.
+        out = sh.reconstruct(full, plan.erasures, emit="plan")
         return out[:rows]
 
     def _launch_recheck(self, key, ops, rows, bucket_len, span,
                         reason) -> _Flight:
+        if self._engine_mesh() is not None:
+            return self._launch_recheck_mesh(key, ops, rows,
+                                             bucket_len, span, reason)
         _kind, k, m, mat = key
         cache_hit = key in self._rexec
         prog = self._rexec.get(key)
@@ -986,6 +1037,35 @@ class BatchEngine:
                               "recheck", cache_hit, lane="recon")
         try:
             out = prog(batch)
+        except Exception:
+            if ln is not None:
+                ln.abort()
+            raise
+        if ln is not None:
+            ln.dispatched()
+        return _Flight("recheck", ops, out, bucket_len, rows, ln, span,
+                       reason)
+
+    def _launch_recheck_mesh(self, key, ops, rows, bucket_len, span,
+                             reason) -> _Flight:
+        """Scrub parity recheck on the mesh: a recheck IS an encode,
+        so it rides the same chunk-sharded ShardedEC program the
+        recovery lane caches (per-device GF partials XOR-combined over
+        ICI) — bit-identical to the single-chip GFLinear, both being
+        oracle-exact."""
+        _kind, k, m, mat = key
+        cache_hit = (k, m, mat) in self._sharded
+        sh = self._sharded_ec(k, m, mat)
+        dp = sh.mesh.shape["dp"]
+        rows = -(-rows // dp) * dp
+        batch = np.zeros((rows, k, bucket_len), dtype=np.uint8)
+        for i, op in enumerate(ops):
+            batch[i, :, :op.length] = op.chunks
+        ln = self._prof_start(ops, rows, batch.nbytes, reason,
+                              "recheck", cache_hit, lane="recon",
+                              devices=self._mesh_labels())
+        try:
+            out = sh.encode(sh.pad_data(batch))
         except Exception:
             if ln is not None:
                 ln.abort()
@@ -1040,13 +1120,19 @@ class BatchEngine:
         from ..scrub.crc32c_jax import (_batch_kernel,
                                         crc32c_zero_unpad)
         chunker = ops[0].chunker
+        mesh = self._engine_mesh()
+        if mesh is not None:
+            # pad rows so the gear scan's row axis divides the mesh —
+            # zero rows hash to a constant the cut walk never reads
+            rows = max(rows, _next_pow2(mesh.size))
         batch = np.zeros((rows, bucket_len), dtype=np.uint8)
         for i, op in enumerate(ops):
             batch[i, :op.length] = np.frombuffer(op.payload, np.uint8)
         ln = self._prof_start(ops, rows, batch.nbytes, reason,
-                              "fingerprint", True, lane="comp")
+                              "fingerprint", True, lane="comp",
+                              devices=self._mesh_labels())
         try:
-            hashes = np.asarray(chunker.hash_batch(batch))
+            hashes = np.asarray(chunker.hash_batch(batch, mesh=mesh))
             spans_per_op = []
             all_chunks = []
             for i, op in enumerate(ops):

@@ -8,6 +8,8 @@ of PGs being independent); ``shard`` partitions the chunk axis of a stripe
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 import jax
@@ -33,3 +35,32 @@ def make_mesh(n_devices: int | None = None, shard: int | None = None) -> Mesh:
     dp = n_devices // shard
     arr = np.array(devices).reshape(dp, shard)
     return Mesh(arr, ("dp", "shard"))
+
+
+_cluster_mesh: Mesh | None = None
+_cluster_lock = threading.Lock()
+
+
+def cluster_mesh() -> Mesh:
+    """The process-wide cluster mesh over ALL visible devices.
+
+    Every batch-engine lane (write encode+CRC megabatches, recovery
+    reconstructs, comp fingerprint scans) shards over this one mesh, so
+    one OSD host drives all chips instead of one.  Built lazily on
+    first use and shared for the process lifetime — devices don't hot
+    plug, and a single mesh keeps every lane's sharded executable
+    cache coherent.
+    """
+    global _cluster_mesh
+    m = _cluster_mesh
+    if m is None:
+        with _cluster_lock:
+            if _cluster_mesh is None:
+                _cluster_mesh = make_mesh()
+            m = _cluster_mesh
+    return m
+
+
+def mesh_device_labels(mesh: Mesh) -> tuple[str, ...]:
+    """Stable per-device labels for profiler attribution."""
+    return tuple(str(d) for d in mesh.devices.flat)

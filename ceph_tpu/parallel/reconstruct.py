@@ -129,6 +129,8 @@ class ShardedEC:
         cpad[:, :k] = self.coding
         self._coding_pad = cpad
         self._decode_cache: dict[tuple[int, ...], object] = {}
+        from .mesh import mesh_device_labels
+        self._dev_labels = mesh_device_labels(mesh)
 
         self._encode = jax.jit(self._build_encode())
 
@@ -229,7 +231,8 @@ class ShardedEC:
         B = int(data_padded.shape[0])
         ln = DeviceProfiler.active().start(
             "sharded_encode", bytes_in=nbytes,
-            rows=B * self.k_pad, rows_used=B * self.k)
+            rows=B * self.k_pad, rows_used=B * self.k,
+            devices=self._dev_labels)
         try:
             out = self._encode(data_padded)
         except Exception:
@@ -254,14 +257,21 @@ class ShardedEC:
     def _build_decode_fn(self, erasures: tuple[int, ...]):
         mesh = self.mesh
         k, m = self.k, self.m
-        dm = rs.decode_matrix(self.coding, k, list(erasures))
-        survivors = tuple(i for i in range(k + m) if i not in erasures)[:k]
-        dmbits_np = _bit_layout_matrix(dm)
-        dmbits = jnp.asarray(dmbits_np)
-        surv_idx = jnp.asarray(np.array(survivors, dtype=np.int32))
+        # The plan's stacked [k + p, k] matrix covers parity-hole
+        # patterns too: rows 0..k-1 are the decode matrix (data
+        # chunks), rows k.. are the composed ``coding[j] ∘ dm`` for
+        # each erased parity row — GF associativity makes parity
+        # straight from survivors byte-exact, so the all-gather reduce
+        # path emits every recoverable row in one launch instead of
+        # bailing to single-chip whenever a parity row is erased.
+        plan = decode_plan(self.coding, k, m, erasures)
+        pbits_np = _bit_layout_matrix(plan.matrix)
+        pbits = jnp.asarray(pbits_np)
+        nrows = plan.matrix.shape[0]
+        surv_idx = jnp.asarray(np.array(plan.survivors, dtype=np.int32))
         if self.word_native:
             wcache: dict = {}
-            wbd, wmrow = _word_operands(dmbits_np, k, wcache)
+            wbd, wmrow = _word_operands(pbits_np, k, wcache)
         interpret = jax.default_backend() != "tpu"  # see _build_encode
 
         def local_fn(chunks):  # [Bl, nlocal, C] — this device's chunk rows
@@ -275,11 +285,11 @@ class ShardedEC:
             if self.word_native:
                 # fused Pallas word kernel (the production decode path)
                 data = _gf_apply_words(wbd, wmrow, surv,
-                                       k=k, m=dm.shape[0],
+                                       k=k, m=nrows,
                                        interpret=interpret)
             else:
                 # MXU bitmatrix decode (byte-exact vs the oracle)
-                data = gf_matmul_bits(dmbits, surv, dm.shape[0])
+                data = gf_matmul_bits(pbits, surv, nrows)
             return data
 
         def fn(chunks):  # [B, n_pad, C] sharded P('dp','shard',None)
@@ -293,22 +303,34 @@ class ShardedEC:
 
         return jax.jit(fn)
 
-    def reconstruct(self, chunks_padded, erasures: tuple[int, ...]) -> jax.Array:
-        """[B, n_pad, C] chunk-sharded -> recovered data [B, k, C].
+    def reconstruct(self, chunks_padded, erasures: tuple[int, ...],
+                    emit: str = "data") -> jax.Array:
+        """[B, n_pad, C] chunk-sharded -> recovered rows.
 
         ``erasures`` lists erased chunk ids; their rows in the input are
-        ignored (may be garbage/zeros).
+        ignored (may be garbage/zeros).  ``emit`` selects the output
+        rows: ``"data"`` (default) returns the k data chunks
+        [B, k, C]; ``"plan"`` returns every recoverable row in the
+        decode plan's ``out_ids`` order [B, k + p, C] — data chunks
+        followed by the erased parity chunks, so parity-hole erasure
+        patterns ride the mesh launch too (``DecodePlan.row_of`` maps
+        chunk id → row).
         """
         from ..core.device_profiler import DeviceProfiler
+        if emit not in ("data", "plan"):
+            raise ValueError(f"emit must be 'data' or 'plan': {emit!r}")
         key = tuple(sorted(erasures))
         B = int(chunks_padded.shape[0])
         ln = DeviceProfiler.active().start(
             "sharded_reconstruct",
             bytes_in=getattr(chunks_padded, "nbytes", 0),
             rows=B * self.n_pad, rows_used=B * (self.k + self.m),
-            cache_hit=key in self._decode_cache)
+            cache_hit=key in self._decode_cache,
+            devices=self._dev_labels)
         try:
             out = self._decode_fn(key)(chunks_padded)
+            if emit == "data":
+                out = out[:, :self.k]
         except Exception:
             if ln is not None:
                 ln.abort()
@@ -352,5 +374,5 @@ class ShardedEC:
         """
         parity = self._encode(data_padded)
         recovered = self._decode_fn(tuple(sorted(erasures)))(
-            self.assemble_chunks(data_padded, parity))
+            self.assemble_chunks(data_padded, parity))[:, :self.k]
         return parity, recovered

@@ -1674,7 +1674,15 @@ class _AsyncFrontDoor:
     in flight (executing + queued).  Saturation answers **503
     SlowDown with Retry-After** immediately instead of letting the
     accept queue build invisible latency — bounded admission is what
-    keeps an open-loop load test honest."""
+    keeps an open-loop load test honest.
+
+    Admission is keyed per tenant (the ``x-rgw-tenant`` tag that also
+    rides QoS): at the global ceiling only tenants at or above their
+    fair share ``max_concurrent // active_tenants`` are 503'd, so one
+    tenant's burst cannot starve everyone else's trickle.  An
+    under-share tenant may be admitted slightly past the ceiling; the
+    overshoot is bounded by the number of active tenants (each can
+    exceed its share by at most the one request being admitted)."""
 
     def __init__(self, handler_cls, host: str = "127.0.0.1",
                  port: int = 0, *, pool_size: int = 16,
@@ -1693,7 +1701,9 @@ class _AsyncFrontDoor:
         self._pool = ThreadPoolExecutor(
             self.pool_size, thread_name_prefix="rgw-http")
         self._inflight = 0          # loop-thread confined
-        self.stats = {"accepted": 0, "rejected": 0}
+        self._inflight_t: dict[str, int] = {}   # tenant → in flight
+        self.stats = {"accepted": 0, "rejected": 0,
+                      "rejected_by_tenant": {}}
         self._loop = asyncio.new_event_loop()
         self._tasks: set = set()
         self._stop_ev = None
@@ -1732,6 +1742,18 @@ class _AsyncFrontDoor:
                f"\r\n\r\n").encode()
         return hdr if head_only else hdr + body
 
+    def _reject(self, tenant: str) -> bool:
+        """At the global ceiling: 503 only tenants at/over their fair
+        share.  An under-share tenant is admitted (bounded overshoot:
+        at most one extra request per active tenant) unless the hard
+        absolute ceiling ``max_concurrent + active`` is hit."""
+        mine = self._inflight_t.get(tenant, 0)
+        active = len(self._inflight_t) \
+            + (0 if tenant in self._inflight_t else 1)
+        share = max(1, self.max_concurrent // active)
+        return (mine >= share
+                or self._inflight >= self.max_concurrent + active)
+
     async def _client(self, reader, writer):
         self._tasks.add(asyncio.current_task())
         try:
@@ -1754,21 +1776,36 @@ class _AsyncFrontDoor:
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
                 method = head.split(b" ", 1)[0].upper()
+                tenant = ""
+                for line in head.split(b"\r\n")[1:]:
+                    if line[:13].lower() == b"x-rgw-tenant:":
+                        tenant = line.split(b":", 1)[1].strip() \
+                            .decode("latin-1")
                 if self.max_concurrent \
-                        and self._inflight >= self.max_concurrent:
+                        and self._inflight >= self.max_concurrent \
+                        and self._reject(tenant):
                     # the body was drained above, so the connection
                     # stays framed — reject THIS request, keep it
                     self.stats["rejected"] += 1
+                    per = self.stats["rejected_by_tenant"]
+                    per[tenant] = per.get(tenant, 0) + 1
                     writer.write(self._canned_503(method == b"HEAD"))
                     await writer.drain()
                     continue
                 self.stats["accepted"] += 1
                 self._inflight += 1
+                self._inflight_t[tenant] = \
+                    self._inflight_t.get(tenant, 0) + 1
                 try:
                     resp, close = await self._loop.run_in_executor(
                         self._pool, self._handle, head + body)
                 finally:
                     self._inflight -= 1
+                    left = self._inflight_t.get(tenant, 1) - 1
+                    if left <= 0:
+                        self._inflight_t.pop(tenant, None)
+                    else:
+                        self._inflight_t[tenant] = left
                 writer.write(resp)
                 await writer.drain()
                 if close:

@@ -38,11 +38,21 @@ class ScrubEngine:
 
     def __init__(self, device_min_rows: int = 4,
                  device_min_bytes: int = 1 << 16,
-                 segment_bytes: int | None = None):
+                 segment_bytes: int | None = None,
+                 use_mesh: bool | None = None):
         mode = os.environ.get("CEPH_TPU_SCRUB_DEVICE", "auto").lower()
         self.mode = mode if mode in ("auto", "always", "never") else "auto"
         self.device_min_rows = device_min_rows
         self.device_min_bytes = device_min_bytes
+        # multichip digest scan: shard the CRC batch over the cluster
+        # mesh (off by default — standalone scrubs outside an engine
+        # keep seed single-chip behavior unless opted in)
+        if use_mesh is None:
+            use_mesh = os.environ.get(
+                "CEPH_TPU_SCRUB_MESH", "0").lower() in ("1", "true",
+                                                        "yes", "on")
+        self.use_mesh = bool(use_mesh)
+        self._mesh = None
         # streaming-digest granularity: objects larger than one
         # device buffer are digested as equal segments and folded
         # with crc32c_combine (GF(2) matrix exponentiation) — the
@@ -58,6 +68,19 @@ class ScrubEngine:
         self.parity_bytes = 0
 
     # ------------------------------------------------------- digests
+
+    def _digest_mesh(self):
+        """The cluster mesh for the digest scan, or None (mesh off or
+        a single visible device)."""
+        if not self.use_mesh:
+            return None
+        if self._mesh is None:
+            import jax
+            if len(jax.devices()) <= 1:
+                return None
+            from ..parallel.mesh import cluster_mesh
+            self._mesh = cluster_mesh()
+        return self._mesh
 
     def _use_device(self, rows: int, length: int) -> bool:
         if self.mode == "always":
@@ -117,14 +140,19 @@ class ScrubEngine:
             self.digest_bytes += length * len(group)
             if self._use_device(len(group), length):
                 from ..core.device_profiler import DeviceProfiler
+                mesh = self._digest_mesh()
+                devices = None
+                if mesh is not None:
+                    from ..parallel.mesh import mesh_device_labels
+                    devices = mesh_device_labels(mesh)
                 batch = np.frombuffer(
                     b"".join(b for _, b in group), dtype=np.uint8
                 ).reshape(len(group), length)
                 ln = DeviceProfiler.active().start(
                     "crc_digest", bytes_in=batch.nbytes,
-                    rows=len(group))
+                    rows=len(group), devices=devices)
                 try:
-                    crcs = crc32c_batch(batch)
+                    crcs = crc32c_batch(batch, mesh=mesh)
                 except Exception:
                     if ln is not None:
                         ln.abort()

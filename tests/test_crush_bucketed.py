@@ -1,0 +1,174 @@
+"""CRUSH warm-start by construction — pow2 size-class bucketing.
+
+The contract under test (crush.bucketed.BucketedMapper):
+
+- two clusters of DIFFERENT size in the same pow2 class share ONE
+  exported program: the second mapper is a cache hit with zero new
+  traces and zero new disk entries;
+- bucketed placements are bit-identical to the unbucketed BatchMapper
+  and the scalar `do_rule` oracle — plain, zero-weight reweight, and
+  (via the exact-path escape) fractional overload reweight;
+- `set_weights` accepts a *resize* within the class (table rebuild,
+  no retrace) and refuses a class change;
+- unsupported shapes transparently degrade to a plain BatchMapper.
+
+Tiny topologies (≤ 32 canonical devices) so the file runs on CPU in
+seconds.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (
+    BatchMapper,
+    BucketedMapper,
+    build_flat_map,
+    build_hierarchy,
+    do_rule,
+)
+from ceph_tpu.crush import jax_mapper as jm
+from ceph_tpu.crush.map import CRUSH_ITEM_NONE
+
+XS = np.arange(257, dtype=np.uint32)
+R = 3
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    """Hermetic per-test cache so hits/misses are this test's own."""
+    monkeypatch.setenv("CEPH_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("CEPH_TPU_EXPORT_CACHE", raising=False)
+    return tmp_path
+
+
+def _oracle(m, xs, result_max=R):
+    out = np.full((len(xs), result_max), CRUSH_ITEM_NONE, dtype=np.int32)
+    for j, x in enumerate(xs):
+        r = do_rule(m, 0, int(x), result_max)
+        out[j, :len(r)] = r
+    return out
+
+
+# 5x3 and 7x4 both land in class (H_pad=8, Q_pad=4): one program
+def _map_a():
+    return build_hierarchy(1, 5, 3)
+
+
+def _map_b():
+    return build_hierarchy(1, 7, 4)
+
+
+def _entries(cache_dir):
+    return list((cache_dir / "export" / "crush").glob("*.jaxpb"))
+
+
+def test_same_class_shares_one_export(cache_dir):
+    t0 = jm.TRACE_COUNT
+    bk_a = BucketedMapper(_map_a(), 0, result_max=R, chunk=256)
+    assert bk_a.bucketed and bk_a.cache_hit is False
+    assert jm.TRACE_COUNT == t0 + 1
+    got_a = bk_a(XS)
+    assert len(_entries(cache_dir)) == 1
+
+    # a DIFFERENT cluster size, same pow2 class: deserialized, never
+    # traced, no second entry — the compile tax a resize used to pay
+    t1 = jm.TRACE_COUNT
+    bk_b = BucketedMapper(_map_b(), 0, result_max=R, chunk=256)
+    assert bk_b.size_class == bk_a.size_class
+    assert bk_b.cache_hit is True
+    assert jm.TRACE_COUNT == t1
+    got_b = bk_b(XS)
+    assert len(_entries(cache_dir)) == 1
+
+    np.testing.assert_array_equal(got_a, _oracle(_map_a(), XS))
+    np.testing.assert_array_equal(got_b, _oracle(_map_b(), XS))
+
+
+def test_bit_identical_to_unbucketed(cache_dir):
+    cmap = _map_a()
+    bk = BucketedMapper(cmap, 0, result_max=R, chunk=256)
+    bm = BatchMapper(cmap, 0, result_max=R, chunk=256)
+    np.testing.assert_array_equal(bk(XS), bm(XS))
+
+    # osd.4 marked out (weight 0): rejection + retry paths agree
+    n = sum(b.size for b in cmap.buckets if b is not None and b.type == 1)
+    rw = np.full(n, 0x10000, dtype=np.uint32)
+    rw[4] = 0
+    np.testing.assert_array_equal(bk(XS, rw), bm(XS, rw))
+
+
+def test_fractional_reweight_takes_exact_path(cache_dir):
+    """Overload reweight hashes the DEVICE id inside is_out; with a
+    tree map the canonical ids differ, so the bucketed mapper must
+    route through an exact unbucketed mapper — and still match."""
+    cmap = _map_a()
+    bk = BucketedMapper(cmap, 0, result_max=R, chunk=256)
+    bm = BatchMapper(cmap, 0, result_max=R, chunk=256)
+    assert not bk._ident                    # tree: ids are remapped
+    n = sum(b.size for b in cmap.buckets if b is not None and b.type == 1)
+    rw = np.full(n, 0x10000, dtype=np.uint32)
+    rw[2] = 0x8000                          # 50% overload probability
+    assert bk._exact is None
+    np.testing.assert_array_equal(bk(XS, rw), bm(XS, rw))
+    assert bk._exact is not None            # escape hatch engaged
+
+
+def test_flat_map_identity_stays_bucketed(cache_dir):
+    """A flat root's canonical device ids ARE the real ids (identity
+    permutation), so even fractional reweights stay on the bucketed
+    program — including the is_out device-id hash."""
+    cmap = build_flat_map(23)               # Q_pad = 32
+    bk = BucketedMapper(cmap, 0, result_max=R, chunk=256)
+    bm = BatchMapper(cmap, 0, result_max=R, chunk=256)
+    assert bk.bucketed and bk._ident
+    rw = np.full(23, 0x10000, dtype=np.uint32)
+    rw[7] = 0x4000
+    rw[11] = 0
+    np.testing.assert_array_equal(bk(XS, rw), bm(XS, rw))
+    assert bk._exact is None                # never left the fast path
+
+
+def test_cross_size_set_weights_rebinds(cache_dir):
+    bk = BucketedMapper(_map_a(), 0, result_max=R, chunk=256)
+    t0 = jm.TRACE_COUNT
+    bk.set_weights(_map_b())                # resize within the class
+    assert jm.TRACE_COUNT == t0             # table rebuild, no retrace
+    np.testing.assert_array_equal(bk(XS), _oracle(_map_b(), XS))
+
+    with pytest.raises(ValueError, match="size class"):
+        bk.set_weights(build_hierarchy(1, 9, 3))   # H_pad 16 != 8
+
+
+def test_remap_skew_moves_pgs_without_retrace(cache_dir):
+    cmap = _map_a()
+    bk = BucketedMapper(cmap, 0, result_max=R, chunk=256)
+    before = bk(XS)
+    host0 = next(b for b in cmap.buckets if b is not None and b.type == 1)
+    skew = [w >> (2 * (i & 1)) for i, w in enumerate(host0.weights)]
+    t0 = jm.TRACE_COUNT
+    bk.remap({host0.id: skew})
+    after = bk(XS)
+    assert jm.TRACE_COUNT == t0
+    assert not np.array_equal(after, before), \
+        "skewed reweight moved no PGs — weights are not reaching the kernel"
+    skewed = dataclasses.replace(
+        cmap, buckets=[
+            dataclasses.replace(b, weights=skew) if b is host0 else b
+            for b in cmap.buckets])
+    np.testing.assert_array_equal(after, _oracle(skewed, XS))
+
+
+def test_unbucketable_falls_back_to_batch_mapper(cache_dir):
+    """A map with a real balancer weight-set cannot take the bucketing
+    choose_args slot — it degrades to a plain BatchMapper and still
+    maps correctly."""
+    cmap = _map_a()
+    host0 = next(b for b in cmap.buckets if b is not None and b.type == 1)
+    cmap.choose_args = {host0.id: {"ids": list(host0.items),
+                                   "weight_set": [list(host0.weights)]}}
+    bk = BucketedMapper(cmap, 0, result_max=R, chunk=256)
+    assert bk.bucketed is False and bk.size_class is None
+    bm = BatchMapper(cmap, 0, result_max=R, chunk=256)
+    np.testing.assert_array_equal(bk(XS), bm(XS))

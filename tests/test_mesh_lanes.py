@@ -1,0 +1,135 @@
+"""One mesh, every lane — multichip bit-identity on 8 forced devices.
+
+conftest forces ``--xla_force_host_platform_device_count=8``, so the
+process-wide :func:`cluster_mesh` spans 8 CPU devices and every
+batch-engine lane's sharded variant runs here exactly as it would on
+an 8-chip slice.  The contract per lane (write encode+digest,
+recovery reconstruct — including a PARITY-hole erasure —, comp
+fingerprint scan, scrub CRC sweep): the mesh-sharded program is
+bit-identical to the single-device kernel, and per-device profiler
+attribution covers every mesh device.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ceph_tpu.core.device_profiler import DeviceProfiler
+from ceph_tpu.ops import rs
+from ceph_tpu.ops.gf_jax import GFEncodeDigest, GFLinear
+from ceph_tpu.parallel import ShardedEC
+from ceph_tpu.parallel.mesh import cluster_mesh, mesh_device_labels
+from ceph_tpu.parallel.reconstruct import decode_plan
+
+K, M = 4, 3
+CODING = rs.reed_sol_van_matrix(K, M)
+RNG = np.random.default_rng(16)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    m = cluster_mesh()
+    assert m.size == len(jax.devices()) == 8, \
+        "conftest must force 8 host devices"
+    return m
+
+
+def test_cluster_mesh_is_shared_and_labeled(mesh):
+    assert cluster_mesh() is mesh          # one mesh per process
+    labels = mesh_device_labels(mesh)
+    assert len(labels) == mesh.size == 8
+    assert len(set(labels)) == 8           # stable distinct labels
+
+
+def test_encode_digest_mesh_bit_identical(mesh):
+    B, L = 2 * mesh.size, 96
+    data = RNG.integers(0, 256, size=(B, K, L), dtype=np.uint8)
+    enc_mesh = GFEncodeDigest(CODING, mesh=mesh)
+    enc_one = GFEncodeDigest(CODING)
+    pm, cm = enc_mesh(data)
+    p1, c1 = enc_one(data)
+    np.testing.assert_array_equal(np.asarray(pm), np.asarray(p1))
+    np.testing.assert_array_equal(np.asarray(cm), np.asarray(c1))
+    assert enc_mesh.mesh_hits.get((B, K, L)) is True
+
+
+def test_encode_digest_odd_batch_falls_back(mesh):
+    B = mesh.size + 1                      # not divisible by 8
+    data = RNG.integers(0, 256, size=(B, K, 64), dtype=np.uint8)
+    enc_mesh = GFEncodeDigest(CODING, mesh=mesh)
+    pm, cm = enc_mesh(data)
+    p1, c1 = GFEncodeDigest(CODING)(data)
+    assert enc_mesh.mesh_hits.get((B, K, 64)) is False
+    np.testing.assert_array_equal(np.asarray(pm), np.asarray(p1))
+    np.testing.assert_array_equal(np.asarray(cm), np.asarray(c1))
+
+
+def test_parity_hole_reconstruct_bit_identical(mesh):
+    """Erasures spanning data AND parity rows ride the same mesh
+    launch (plan.matrix stacks the parity rebuild under the data
+    rows) — bit-identical to the raw single-device GF kernel."""
+    erasures = (0, 3, K + 1)               # two data holes + a parity hole
+    sec = ShardedEC(CODING, K, M, mesh, word_native=False)
+    plan = decode_plan(CODING, K, M, erasures)
+    C = 128
+    B = 2 * mesh.shape["dp"]
+    data = RNG.integers(0, 256, size=(B, K, C), dtype=np.uint8)
+    padded = sec.shard_array(sec.pad_data(sec.to_payload(data)),
+                             P("dp", "shard", None))
+    parity = sec.encode(padded)
+    chunks = sec.shard_array(
+        np.asarray(sec.assemble_chunks(padded, parity)),
+        P("dp", "shard", None))
+
+    mesh_out = np.asarray(sec.reconstruct(chunks, erasures, emit="plan"))
+    surv = np.asarray(chunks)[:, plan.survivors]
+    raw_out = np.asarray(GFLinear(plan.matrix)(surv[:, :, :C]))
+    np.testing.assert_array_equal(mesh_out[:B, :, :C], raw_out)
+    np.testing.assert_array_equal(mesh_out[:B, :K, :C], data)
+
+
+def test_fingerprint_lane_mesh_bit_identical(mesh):
+    from ceph_tpu.compress.chunker import Chunker, gear_hashes_host
+
+    ck = Chunker(avg_size=256)
+    rows, length = 2 * mesh.size, 512
+    batch = RNG.integers(0, 256, size=(rows, length), dtype=np.uint8)
+    sharded = np.asarray(ck.hash_batch(batch, mesh=mesh))
+    single = np.asarray(ck.hash_batch(batch))
+    np.testing.assert_array_equal(sharded, single)
+    np.testing.assert_array_equal(sharded[0], gear_hashes_host(batch[0]))
+    # rows not divisible by the device count: silent single-device path
+    odd = batch[: mesh.size + 1]
+    np.testing.assert_array_equal(np.asarray(ck.hash_batch(odd, mesh=mesh)),
+                                  np.asarray(ck.hash_batch(odd)))
+
+
+def test_crc_lane_mesh_bit_identical(mesh):
+    from ceph_tpu.scrub.crc32c_jax import crc32c, crc32c_batch
+
+    n, length = mesh.size + 3, 200         # pad path: 11 rows -> 16
+    data = RNG.integers(0, 256, size=(n, length), dtype=np.uint8)
+    seeds = RNG.integers(0, 1 << 32, size=n, dtype=np.uint32)
+    got = crc32c_batch(data, seeds=seeds, mesh=mesh)
+    np.testing.assert_array_equal(got, crc32c_batch(data, seeds=seeds))
+    for i in (0, n - 1):
+        assert got[i] == crc32c(data[i].tobytes(), int(seeds[i]))
+
+
+def test_mesh_launch_attributes_every_device(mesh):
+    labels = mesh_device_labels(mesh)
+    B, L = 2 * mesh.size, 64
+    data = RNG.integers(0, 256, size=(B, K, L), dtype=np.uint8)
+    enc = GFEncodeDigest(CODING, mesh=mesh)
+    prof = DeviceProfiler(enabled=True)
+    with prof.bind():
+        ln = DeviceProfiler.active().start(
+            "mesh_encode", bytes_in=data.nbytes, rows=B, rows_used=B,
+            devices=labels)
+        np.asarray(enc(data)[1])
+        ln.finish()
+    dev = prof.aggregate().get("devices", {})
+    assert set(dev) == set(labels)
+    assert all(v["launches"] >= 1 for v in dev.values())

@@ -287,6 +287,83 @@ class TestFrontDoorSaturation:
             gw2.shutdown()
 
 
+class TestPerTenantAdmission:
+    def test_reject_fair_share_unit(self):
+        """`_reject` semantics (consulted only at the global ceiling):
+        a hog at/over its fair share sheds, a newcomer is admitted via
+        the bounded overshoot, the absolute ceiling caps it, and a
+        single tenant degenerates to the old global gate."""
+        from ceph_tpu.rgw.gateway import _AsyncFrontDoor
+
+        fd = object.__new__(_AsyncFrontDoor)
+        fd.max_concurrent = 4
+        fd._inflight = 4
+        fd._inflight_t = {"a": 4}
+        assert fd._reject("a")               # hog over fair share
+        assert not fd._reject("b")           # newcomer still admitted
+        fd._inflight = 6
+        fd._inflight_t = {"a": 4, "b": 2}
+        assert fd._reject("b")               # overshoot is bounded
+        fd._inflight = 4
+        fd._inflight_t = {"": 4}
+        assert fd._reject("")                # single tenant = old gate
+
+    def test_tenant_burst_cannot_starve_other_tenant(self, gateway):
+        """Tenant A wedges every pool slot; A's next request is shed
+        with 503 while tenant B's request is admitted (queued) and
+        completes once the pool frees — one tenant's burst can't 503
+        another."""
+        import threading
+
+        c, gw, _ = gateway
+        gw2 = RGWService(c.rados(), pool_size=2, max_concurrent=2,
+                         retry_after=1.0).start()
+        try:
+            hog1 = S3Client("127.0.0.1", gw2.port, tenant="acme")
+            hog2 = S3Client("127.0.0.1", gw2.port, tenant="acme")
+            shed = S3Client("127.0.0.1", gw2.port, tenant="acme")
+            other = S3Client("127.0.0.1", gw2.port, tenant="bob")
+            assert shed.make_bucket("sat2") == 200
+            lk = gw2.store._shard_lock("sat2", "k")
+            assert lk.acquire(timeout=5.0)
+            result = {}
+
+            def _put(name, cli):
+                result[name] = cli.put("sat2", "k", b"x" * 64)
+
+            threads = [
+                threading.Thread(target=_put, args=(n, cli), daemon=True)
+                for n, cli in (("h1", hog1), ("h2", hog2))]
+            try:
+                for t in threads:
+                    t.start()
+                deadline = time.monotonic() + 5.0
+                while gw2.frontdoor._inflight < 2:
+                    assert time.monotonic() < deadline, \
+                        "PUTs never occupied the pool slots"
+                    time.sleep(0.01)
+                st, _h, body = shed._req("GET", "/sat2?")
+                assert st == 503 and b"SlowDown" in body
+                tb = threading.Thread(
+                    target=_put, args=("b", other), daemon=True)
+                tb.start()
+                # admitted (no 503): give it a beat to queue, then free
+                time.sleep(0.1)
+            finally:
+                lk.release()
+            for t in threads:
+                t.join(timeout=10.0)
+            tb.join(timeout=10.0)
+            assert not tb.is_alive()
+            assert result["h1"][0] == 200 and result["h2"][0] == 200
+            assert result["b"][0] == 200               # served, not shed
+            by_tenant = gw2.frontdoor.stats["rejected_by_tenant"]
+            assert by_tenant.get("acme", 0) >= 1
+            assert "bob" not in by_tenant
+        finally:
+            gw2.shutdown()
+
+
 class TestKeepAliveConcurrency:
     def test_connection_reused_across_requests(self, gateway):
         c, gw, _ = gateway
