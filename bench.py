@@ -1673,6 +1673,83 @@ def _durability_leg():
     return out
 
 
+def _autotune_leg(on_tpu: bool):
+    """Self-tuning data plane: the regime-shift gauntlet (steady →
+    bursty → large-object → recovery-storm) under each hand-tuned
+    static config, then once more with the mgr autotuner closing the
+    telemetry→knobs loop.  Acceptance: the controller matches or
+    beats the best static config on sustained MB/s and worst-phase
+    p99 (the CPU smoke asserts parity with slack for host noise; on
+    TPU the ratios are recorded), and replaying the recorded signal
+    trace through a fresh engine with the same seed reproduces the
+    decision journal bit-identically."""
+    from ceph_tpu.mgr.autotune import AutotuneEngine, AutotuneModule
+    from ceph_tpu.mgr.telemetry import TelemetrySpine
+    from ceph_tpu.vstart import MiniCluster
+    from ceph_tpu.workload.scenarios import regime_shift
+
+    seed, dur = 0xA070, 2.0
+    statics = {
+        # immediate flush: tuned for the steady/low-latency regime
+        "immediate": {},
+        # wide coalescing window: tuned for the bursty regime
+        "coalesce": {"osd_batch_flush_ms": 2.0,
+                     "osd_batch_max_ops": 256},
+        # per-op fsync: tuned for nothing — the durability strawman
+        "paranoid": {"osd_wal_sync_mode": "always"},
+    }
+    runs = {}
+    for name, cfg in statics.items():
+        with MiniCluster(n_mons=1, n_osds=3, osd_config=cfg) as c:
+            runs[name] = regime_shift(cluster=c, phase_duration=dur,
+                                      seed=17, publish=False)
+    best = max(runs, key=lambda n: runs[n]["sustained_MBps"])
+
+    with MiniCluster(n_mons=1, n_osds=3) as c:
+        c.start_mgr("auto", modules=(TelemetrySpine, AutotuneModule))
+        c.wait_for_active_mgr()
+        r = c.rados()
+        rc, outs, _ = r.mgr_command(
+            {"prefix": "autotune enable", "seed": seed})
+        assert rc == 0, f"autotune enable failed: {outs}"
+        auto = regime_shift(cluster=c, phase_duration=dur, seed=17)
+        rc, outs, hist = r.mgr_command(
+            {"prefix": "autotune history", "trace": True})
+        assert rc == 0, f"autotune history failed: {outs}"
+    # seeded replay: recorded telemetry trace ⇒ identical journal
+    replayed = AutotuneEngine.replay(hist["seed"], hist["trace"])
+    assert replayed.journal_digest() == hist["journal_digest"], \
+        "seeded replay diverged from the live decision journal"
+
+    best_run = runs[best]
+    mbps_ratio = (auto["sustained_MBps"]
+                  / max(best_run["sustained_MBps"], 1e-9))
+    p99_ratio = (auto["worst_p99_ms"]
+                 / max(best_run["worst_p99_ms"], 1e-9))
+    if not on_tpu:
+        # CPU smoke: parity bars with slack for shared-host noise
+        assert mbps_ratio >= 0.85, \
+            f"controller lost to static '{best}': {mbps_ratio:.2f}x"
+        assert p99_ratio <= 1.5, \
+            f"controller p99 {p99_ratio:.2f}x static '{best}'"
+    return {
+        "best_static": best,
+        "static_MBps": {n: round(r["sustained_MBps"], 3)
+                        for n, r in runs.items()},
+        "static_worst_p99_ms": {n: round(r["worst_p99_ms"], 2)
+                                for n, r in runs.items()},
+        "autotuned_MBps": round(auto["sustained_MBps"], 3),
+        "autotuned_worst_p99_ms": round(auto["worst_p99_ms"], 2),
+        "sustained_ratio_vs_best_static": round(mbps_ratio, 3),
+        "p99_ratio_vs_best_static": round(p99_ratio, 3),
+        "decisions": int(hist["decisions_total"]),
+        "rollbacks": int(hist["rollbacks_total"]),
+        "journal_digest": hist["journal_digest"][:16],
+        "seed": seed,
+        "phases": auto["phases"],
+    }
+
+
 def _crush_leg():
     """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
     (BASELINE.md row 4, scaled to fit a bench-run budget)."""
@@ -1870,6 +1947,16 @@ def child_main():
             out["durability"] = {"error": str(e)[:200]}
     else:
         out["durability"] = {"skipped": "wall budget exhausted"}
+    print(json.dumps(dict(out, autotune={"skipped": "timeout"})),
+          flush=True)
+    # self-tuning data plane: regime shift, statics vs the controller
+    if _budget_left() > 0.02:
+        try:
+            out["autotune"] = _autotune_leg(on_tpu)
+        except Exception as e:    # noqa: BLE001 — keep the headline
+            out["autotune"] = {"error": str(e)[:200]}
+    else:
+        out["autotune"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(out))
     try:
         dev = jax.devices()[0].device_kind

@@ -113,8 +113,9 @@ def build_options() -> list[Option]:
         Option("osd_mclock_scheduler_client_qos", str, "",
                "per-tenant client QoS: JSON {tenant: [res, wgt, "
                "lim]} ('' = none)"),
-        Option("osd_recovery_max_active", int, 3,
-               "concurrent recovery ops per OSD"),
+        Option("osd_recovery_max_active", int, 8,
+               "in-flight recovery/backfill pushes per PG kick "
+               "(paces the backfill batch)", min=1, max=64),
         Option("osd_scrub_interval", float, 86400.0,
                "periodic (shallow) scrub target (s; 0 disables)"),
         Option("osd_deep_scrub_interval", float, 604800.0,
@@ -154,6 +155,11 @@ def build_options() -> list[Option]:
                "batch accumulation window (ms); 0 = flush each submit "
                "immediately (the CPU-safe synchronous default)",
                min=0.0),
+        Option("osd_batch_bucket_floor", int, 32,
+               "size-bucket ladder floor (bytes): payloads shorter "
+               "than this pad up to it, so a higher floor merges "
+               "small-op buckets into fewer launches at the cost of "
+               "padding", min=1, max=1 << 20),
         Option("osd_recovery_batch_enable", bool, True,
                "coalesce degraded reads / recovery / backfill decodes "
                "into the batch engine's reconstruct lane"),

@@ -1,0 +1,596 @@
+"""Self-tuning data plane — the mgr autotuner that closes the
+telemetry→knobs loop.
+
+Everything the controller needs already existed in isolation: the
+telemetry spine's device-plane signals (batch occupancy, idle gap,
+dispatch-overhead %, rolling launch p99, windowed commit latency),
+the SLO harness's violation pressure (``slo ingest`` reports, ringed
+per scenario), and the live-retunable knob surface (``osd_batch_*``,
+recovery/comp lane equivalents, size-bucket floor,
+``osd_wal_sync_mode``, ``osd_mclock_scheduler_*``, scrub/recovery
+pacing — all observer-wired, no OSD restart).  This module is the
+feedback loop on top (reference shape: mgr modules like ``balancer``
+and ``pg_autoscaler`` that continuously actuate cluster state from
+observed load).
+
+Design rules, in order of importance:
+
+1. **Deterministic.**  Every decision is a pure function of
+   ``(seed, signal trace)`` — the fault-fabric testing pattern.  The
+   engine keeps the trace it consumed; replaying it through a fresh
+   engine with the same seed reproduces the decision journal
+   byte-for-byte (``journal_digest`` is the acceptance hook).  No
+   wall-clock, no ambient randomness: logical ticks only.
+2. **Guarded.**  One decision in flight at a time.  Each knob has
+   hard bounds (inside the Option's declared min/max — the knob lint
+   enforces this), a post-decision evaluation window, automatic
+   rollback when the objective regresses, a cooldown after every
+   move (longer after a rollback), and a per-direction "that hurt"
+   memory so a rolled-back move is not retried immediately.
+3. **Paxos-free.**  The decision journal lives in the active mgr
+   only.  A failover loses it (a fresh engine starts from the
+   registry's initial values) — knob state is reconstructable and
+   the journal is telemetry, not truth, so it does not rate a
+   quorum round-trip.
+
+Actuation rides the existing per-daemon admin sockets: one
+``config set`` per OSD per decision, landing in the option observers
+each daemon already registers.  Surfaces: ``ceph autotune
+status|history|enable|disable|pin|unpin``, the ``ceph iostat`` panel,
+and the exporter's ``ceph_autotune_*`` gauges.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from ..core.admin_socket import admin_command
+from .daemon import MgrModule
+
+DEFAULT_SEED = 0xA070
+
+
+def objective(signals: dict) -> float:
+    """The scalar the controller climbs: device-plane throughput plus
+    SLO goodput, minus a steep penalty for time-in-violation.  Pure
+    arithmetic over the signal dict — replay-stable."""
+    osd = signals.get("osd") or {}
+    slo = signals.get("slo") or {}
+    return (float(osd.get("bytes_per_sec", 0.0)) / 1e6
+            + float(slo.get("goodput_ops", 0.0))
+            - 100.0 * float(slo.get("pressure", 0.0)))
+
+
+class Knob:
+    """One guarded controller: bounds, step rule, decide() guard.
+
+    ``kind``:
+      - ``"ladder"`` — hysteresis hill-climb over a fixed value
+        ladder (direction moves one rung);
+      - ``"aimd"`` — additive increase (``+ step``), multiplicative
+        decrease (``* decrease``), clamped to ``[lo, hi]``.
+
+    ``decide(signals, value)`` → ``(direction, reason)`` or ``None``;
+    it must be a pure function of its arguments (determinism)."""
+
+    def __init__(self, name: str, *, decide, cast=float,
+                 kind: str = "ladder", ladder=None, initial=None,
+                 step: float = 0.0, decrease: float = 0.5,
+                 lo=None, hi=None):
+        self.name = name
+        self.decide = decide
+        self.cast = cast
+        self.kind = kind
+        self.ladder = list(ladder) if ladder is not None else None
+        if kind == "ladder":
+            if not self.ladder:
+                raise ValueError(f"{name}: ladder knob needs a ladder")
+            self.lo, self.hi = self.ladder[0], self.ladder[-1]
+        else:
+            self.lo, self.hi = lo, hi
+        self.step = step
+        self.decrease = decrease
+        self.initial = (initial if initial is not None
+                        else (self.ladder[0] if self.ladder else lo))
+
+    def move(self, value, direction: int):
+        """One guarded step from ``value``; returns the clamped new
+        value (== value when already at the bound)."""
+        if self.kind == "ladder":
+            try:
+                i = self.ladder.index(value)
+            except ValueError:
+                # pinned/foreign value off the ladder: snap to the
+                # nearest rung first (strings compare by position 0)
+                i = 0
+                if not isinstance(value, str):
+                    i = min(range(len(self.ladder)),
+                            key=lambda j: abs(self.ladder[j] - value))
+            i = max(0, min(len(self.ladder) - 1, i + direction))
+            return self.ladder[i]
+        if direction > 0:
+            new = self.cast(value + self.step)
+        else:
+            new = self.cast(value * self.decrease)
+        if self.lo is not None:
+            new = max(self.lo, new)
+        if self.hi is not None:
+            new = min(self.hi, new)
+        return self.cast(new)
+
+
+# -- decide() guards --------------------------------------------------------
+# Each reads the aggregated signal dict:
+#   osd: occupancy, idle_gap_s, dispatch_overhead, launch_p99_us,
+#        commit_ms, bytes_per_sec, launches_per_sec
+#   slo: pressure (windowed time-in-violation rate), goodput_ops,
+#        worst_p99_ms
+#   degraded: fraction of PGs not active+clean
+
+
+def _osd(s):
+    return s.get("osd") or {}
+
+
+def _slo(s):
+    return s.get("slo") or {}
+
+
+def _decide_flush(s, v):
+    if _slo(s).get("pressure", 0.0) > 0.25 \
+            or _osd(s).get("commit_ms", 0.0) > 50.0:
+        return -1, "latency pressure: shrink the batch window"
+    if _osd(s).get("dispatch_overhead", 0.0) > 0.25 \
+            and _slo(s).get("pressure", 0.0) < 0.05:
+        return +1, "dispatch-bound: widen the batch window"
+    return None
+
+
+def _decide_ceiling(s, v):
+    if _slo(s).get("pressure", 0.0) > 0.25:
+        return -1, "latency pressure: lower the batch ceiling"
+    if _osd(s).get("occupancy", 1.0) > 0.85 \
+            and _osd(s).get("dispatch_overhead", 0.0) > 0.2:
+        return +1, "batches run full while dispatch-bound: raise ceiling"
+    return None
+
+
+def _decide_bucket_floor(s, v):
+    if _osd(s).get("occupancy", 1.0) < 0.35:
+        return -1, "padding waste: lower the size-bucket floor"
+    if _osd(s).get("dispatch_overhead", 0.0) > 0.3 \
+            and _osd(s).get("launches_per_sec", 0.0) > 50.0:
+        return +1, "many small launches: merge size buckets upward"
+    return None
+
+
+def _decide_wal_sync(s, v):
+    if _slo(s).get("pressure", 0.0) > 0.2 and v == "always":
+        return -1, "violation pressure: group-commit instead of " \
+                   "per-op fsync"
+    if _slo(s).get("pressure", 0.0) < 0.01 \
+            and _osd(s).get("commit_ms", 0.0) < 5.0 \
+            and _osd(s).get("bytes_per_sec", 0.0) < 1e5 and \
+            v == "batch":
+        return +1, "near-idle with headroom: buy per-op durability"
+    return None
+
+
+def _decide_recovery_lim(s, v):
+    if s.get("degraded", 0.0) > 0.0 \
+            and _slo(s).get("pressure", 0.0) > 0.15:
+        return -1, "clients violating during recovery: cut its feed"
+    if s.get("degraded", 0.0) > 0.0 \
+            and _slo(s).get("pressure", 0.0) < 0.02:
+        return +1, "recovery pending, clients healthy: feed it"
+    return None
+
+
+def _decide_scrub_lim(s, v):
+    if _slo(s).get("pressure", 0.0) > 0.3:
+        return -1, "violation pressure: throttle scrub ops"
+    if _slo(s).get("pressure", 0.0) < 0.01 and v < 100.0:
+        return +1, "pressure gone: restore scrub budget"
+    return None
+
+
+def _decide_scrub_interval(s, v):
+    if _slo(s).get("pressure", 0.0) > 0.3:
+        return +1, "violation pressure: defer periodic scrubs"
+    if _slo(s).get("pressure", 0.0) < 0.01 and v > 86400.0:
+        return -1, "pressure gone: restore the scrub cadence"
+    return None
+
+
+def _decide_recovery_active(s, v):
+    if _slo(s).get("pressure", 0.0) > 0.25:
+        return -1, "violation pressure: fewer in-flight pushes"
+    if s.get("degraded", 0.0) > 0.05 \
+            and _slo(s).get("pressure", 0.0) < 0.05:
+        return +1, "backlog with client headroom: push harder"
+    return None
+
+
+# The actuation registry — every knob the controller may touch.  The
+# knob-registry lint walks this: each name must be a declared Option
+# with a live observer (or an explicit live-read waiver), the bounds
+# must sit inside the Option's min/max, and ``initial`` must equal
+# the Option default (so a disabled autotuner changes nothing).
+KNOBS: dict[str, Knob] = {k.name: k for k in (
+    Knob("osd_batch_flush_ms", decide=_decide_flush, cast=float,
+         ladder=[0.0, 0.5, 1.0, 2.0, 4.0], initial=0.0),
+    Knob("osd_batch_max_ops", decide=_decide_ceiling, cast=int,
+         ladder=[32, 64, 128, 256, 512], initial=64),
+    Knob("osd_batch_max_bytes", decide=_decide_ceiling, cast=int,
+         ladder=[2 << 20, 4 << 20, 8 << 20, 16 << 20, 32 << 20],
+         initial=8 << 20),
+    Knob("osd_recovery_batch_flush_ms", decide=_decide_flush,
+         cast=float, ladder=[0.0, 0.5, 1.0, 2.0, 4.0], initial=0.0),
+    Knob("osd_recovery_batch_max_ops", decide=_decide_ceiling,
+         cast=int, ladder=[32, 64, 128, 256, 512], initial=64),
+    Knob("osd_compress_batch_flush_ms", decide=_decide_flush,
+         cast=float, ladder=[0.0, 0.5, 1.0, 2.0, 4.0], initial=0.0),
+    Knob("osd_compress_batch_max_ops", decide=_decide_ceiling,
+         cast=int, ladder=[32, 64, 128, 256, 512], initial=64),
+    Knob("osd_batch_bucket_floor", decide=_decide_bucket_floor,
+         cast=int, ladder=[32, 64, 128, 256, 512, 1024, 2048, 4096],
+         initial=32),
+    # durability ladder deliberately excludes "none": the autotuner
+    # may trade fsync granularity, never ack-without-durability
+    Knob("osd_wal_sync_mode", decide=_decide_wal_sync, cast=str,
+         ladder=["batch", "always"], initial="batch"),
+    Knob("osd_mclock_scheduler_recovery_lim",
+         decide=_decide_recovery_lim, cast=float, kind="aimd",
+         step=50.0, decrease=0.5, lo=25.0, hi=2000.0, initial=200.0),
+    Knob("osd_mclock_scheduler_scrub_lim", decide=_decide_scrub_lim,
+         cast=float, kind="aimd", step=10.0, decrease=0.5, lo=5.0,
+         hi=500.0, initial=100.0),
+    Knob("osd_scrub_interval", decide=_decide_scrub_interval,
+         cast=float, kind="aimd", step=43200.0, decrease=0.5,
+         lo=3600.0, hi=1209600.0, initial=86400.0),
+    Knob("osd_recovery_max_active", decide=_decide_recovery_active,
+         cast=int, ladder=[1, 2, 4, 8, 16], initial=8),
+)}
+
+
+class AutotuneEngine:
+    """The seeded decision core — no cluster, no clock, no I/O.
+
+    ``step(signals)`` consumes one tick's aggregated signal dict and
+    returns the decisions to actuate (``action`` in ``adjust`` /
+    ``rollback``).  The consumed trace and the journal are both
+    retained; ``AutotuneEngine(seed)`` re-stepped over the same trace
+    emits the identical journal (``journal_digest()``)."""
+
+    EVAL_TICKS = 2          # ticks between a move and its verdict
+    COOLDOWN = 4            # ticks a knob rests after a kept move
+    ROLLBACK_COOLDOWN = 10  # ticks a knob rests after a rollback
+    BAD_DIR_TICKS = 20      # ticks a rolled-back direction is barred
+    REGRESS_REL = 0.10      # objective drop fraction that trips rollback
+    REGRESS_ABS = 1.0       # ... with this absolute floor
+    TRACE_CAP = 4096        # retained signal ticks (journal is smaller)
+
+    def __init__(self, seed: int = DEFAULT_SEED,
+                 knobs: dict[str, Knob] | None = None):
+        self.seed = int(seed)
+        self.knobs = dict(knobs if knobs is not None else KNOBS)
+        self.values = {n: k.initial for n, k in self.knobs.items()}
+        self.pinned: dict[str, bool] = {}
+        self.tick = 0
+        self.trace: list[dict] = []
+        self.journal: list[dict] = []
+        self.decisions_total = 0
+        self.rollbacks_total = 0
+        self._obj_ema: float | None = None
+        self._pending: dict | None = None    # one decision in flight
+        self._cooldown_until: dict[str, int] = {}
+        self._bad_dir: dict[tuple[str, int], int] = {}
+
+    # -- determinism helpers ------------------------------------------------
+
+    def _scan_start(self, n: int) -> int:
+        """Seeded, tick-rotated scan offset: same (seed, tick) ⇒ same
+        knob exploration order — the only 'randomness' in the loop."""
+        h = (self.seed ^ (self.tick * 0x9E3779B1)) * 0x85EBCA6B
+        return (h & 0xFFFFFFFF) % max(1, n)
+
+    def journal_digest(self) -> str:
+        blob = json.dumps(self.journal, sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    # -- control-plane state (not journaled: pins are operator intent,
+    #    decisions are controller output) -----------------------------------
+
+    def pin(self, knob: str, value=None):
+        if knob not in self.knobs:
+            raise KeyError(knob)
+        self.pinned[knob] = True
+        if value is not None:
+            k = self.knobs[knob]
+            v = k.cast(value)
+            if k.lo is not None and not isinstance(v, str):
+                v = max(k.lo, min(k.hi, v))
+            self.values[knob] = v
+        return self.values[knob]
+
+    def unpin(self, knob: str):
+        self.pinned.pop(knob, None)
+
+    # -- the loop ------------------------------------------------------------
+
+    def step(self, signals: dict) -> list[dict]:
+        """One logical tick.  Returns journal entries that need
+        actuation (adjust/rollback); commit entries are bookkeeping."""
+        # JSON round-trip: the retained trace is exactly what a
+        # replayer will feed back, so replay floats are bit-identical
+        sig = json.loads(json.dumps(signals, sort_keys=True))
+        self.tick += 1
+        self.trace.append(sig)
+        if len(self.trace) > self.TRACE_CAP:
+            del self.trace[:len(self.trace) - self.TRACE_CAP]
+        obj = objective(sig)
+        self._obj_ema = (obj if self._obj_ema is None
+                         else 0.5 * self._obj_ema + 0.5 * obj)
+        out: list[dict] = []
+        verdict = self._evaluate_pending(obj)
+        if verdict is not None:
+            out.append(verdict)
+        if self._pending is None:
+            adj = self._consider(sig, obj)
+            if adj is not None:
+                out.append(adj)
+        return out
+
+    def _journal(self, entry: dict) -> dict:
+        entry["seq"] = len(self.journal)
+        entry["tick"] = self.tick
+        self.journal.append(entry)
+        return entry
+
+    def _evaluate_pending(self, obj: float) -> dict | None:
+        p = self._pending
+        if p is None or self.tick < p["eval_at"]:
+            return None
+        self._pending = None
+        knob, old, new = p["knob"], p["old"], p["new"]
+        before = p["obj_before"]
+        bar = before - max(self.REGRESS_ABS,
+                           self.REGRESS_REL * abs(before))
+        if self._obj_ema < bar:
+            self.values[knob] = old
+            self._cooldown_until[knob] = \
+                self.tick + self.ROLLBACK_COOLDOWN
+            self._bad_dir[(knob, p["dir"])] = \
+                self.tick + self.BAD_DIR_TICKS
+            self.rollbacks_total += 1
+            return self._journal({
+                "action": "rollback", "knob": knob,
+                "old": new, "new": old, "dir": -p["dir"],
+                "objective_before": before, "objective": self._obj_ema,
+                "reason": "objective regressed past tolerance"})
+        self._cooldown_until[knob] = self.tick + self.COOLDOWN
+        self._journal({
+            "action": "commit", "knob": knob, "value": new,
+            "objective_before": before, "objective": self._obj_ema})
+        return None     # commits change no knob: nothing to actuate
+
+    def _consider(self, sig: dict, obj: float) -> dict | None:
+        names = sorted(self.knobs)
+        start = self._scan_start(len(names))
+        for i in range(len(names)):
+            name = names[(start + i) % len(names)]
+            if self.pinned.get(name):
+                continue
+            if self.tick < self._cooldown_until.get(name, 0):
+                continue
+            knob = self.knobs[name]
+            got = knob.decide(sig, self.values[name])
+            if got is None:
+                continue
+            direction, reason = got
+            if self.tick < self._bad_dir.get((name, direction), 0):
+                continue
+            old = self.values[name]
+            new = knob.move(old, direction)
+            if new == old:
+                continue        # already at the bound
+            self.values[name] = new
+            self.decisions_total += 1
+            self._pending = {
+                "knob": name, "old": old, "new": new,
+                "dir": direction, "obj_before": self._obj_ema,
+                "eval_at": self.tick + self.EVAL_TICKS}
+            return self._journal({
+                "action": "adjust", "knob": name, "old": old,
+                "new": new, "dir": direction, "reason": reason,
+                "objective": self._obj_ema})
+        return None
+
+    # -- replay (the fault-fabric acceptance hook) ---------------------------
+
+    @classmethod
+    def replay(cls, seed: int, trace: list[dict],
+               knobs: dict[str, Knob] | None = None) -> "AutotuneEngine":
+        """Fresh engine stepped over a recorded signal trace; its
+        journal is byte-identical to the recorder's."""
+        eng = cls(seed=seed, knobs=knobs)
+        for sig in trace:
+            eng.step(sig)
+        return eng
+
+
+class AutotuneModule(MgrModule):
+    """The mgr host: gathers signals from the telemetry spine, steps
+    the engine, actuates decisions over the per-OSD admin sockets.
+    Ships disabled — ``ceph autotune enable`` arms it."""
+
+    NAME = "autotune"
+    TICK = 1.0
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self.engine = AutotuneEngine()
+        self.enabled = False
+        self.applied: dict[str, object] = {}
+        self.apply_errors = 0
+
+    # -- signal aggregation --------------------------------------------------
+
+    def _gather(self) -> dict | None:
+        spine = self.ctx._d.modules.get("telemetry_spine")
+        if spine is None:
+            return None
+        osds = sorted(d for d in (set(spine.series)
+                                  | set(spine.profiler))
+                      if d.startswith("osd."))
+        if not osds:
+            return None
+        occ = gap = dov = 0.0
+        p99 = commit = bps = lps = 0.0
+        for d in osds:
+            dev = spine.device_summary(d)
+            occ += float(dev.get("occupancy_ratio", 1.0))
+            gap += float(dev.get("idle_gap_avg_s", 0.0))
+            dov += float(dev.get("dispatch_overhead_ratio", 0.0))
+            p99 = max(p99, float(dev.get("p99_us", 0.0)))
+            commit = max(commit, spine.commit_latency_ms(d))
+            rates = spine.daemon_rates(d)
+            bps += float(rates.get("bytes_per_sec", 0.0))
+            lps += float(rates.get("launches_per_sec", 0.0))
+        n = float(len(osds))
+        pressure = (spine.slo_pressure()
+                    if hasattr(spine, "slo_pressure") else {})
+        degraded = 0.0
+        try:
+            rc, _, st = self.ctx.mon_command({"prefix": "status"})
+            if rc == 0 and st:
+                states = st.get("pg_states") or {}
+                total = float(sum(states.values()) or 0)
+                clean = float(states.get("active+clean", 0))
+                degraded = ((total - clean) / total) if total else 0.0
+        except Exception:   # noqa: BLE001 — mon churn: signal stays 0
+            pass
+        return {
+            "osd": {
+                "occupancy": occ / n, "idle_gap_s": gap / n,
+                "dispatch_overhead": dov / n, "launch_p99_us": p99,
+                "commit_ms": commit, "bytes_per_sec": bps,
+                "launches_per_sec": lps,
+            },
+            "slo": {
+                "pressure": float(pressure.get("pressure", 0.0)),
+                "goodput_ops": float(pressure.get("goodput_ops", 0.0)),
+                "worst_p99_ms": float(pressure.get("worst_p99_ms",
+                                                   0.0)),
+            },
+            "degraded": degraded,
+        }
+
+    # -- actuation -----------------------------------------------------------
+
+    def _apply(self, knob: str, value):
+        """One ``config set`` per OSD admin socket; the daemons' own
+        option observers do the live retune."""
+        for daemon, path in sorted(self.ctx._d.asok_paths.items()):
+            if not daemon.startswith("osd."):
+                continue
+            try:
+                admin_command(path, "config set", timeout=5.0,
+                              key=knob, value=value)
+            except Exception:   # noqa: BLE001 — daemon down: next tick
+                self.apply_errors += 1
+        self.applied[knob] = value
+
+    def serve_tick(self):
+        if not self.enabled:
+            return
+        signals = self._gather()
+        if signals is None:
+            return
+        for dec in self.engine.step(signals):
+            if dec.get("action") in ("adjust", "rollback"):
+                self._apply(dec["knob"], dec["new"])
+
+    # -- surfaces ------------------------------------------------------------
+
+    def status(self) -> dict:
+        eng = self.engine
+        knobs = {}
+        for name in sorted(eng.knobs):
+            k = eng.knobs[name]
+            last = next((e for e in reversed(eng.journal)
+                         if e.get("knob") == name), None)
+            knobs[name] = {
+                "value": eng.values[name],
+                "lo": k.lo, "hi": k.hi, "kind": k.kind,
+                "pinned": bool(eng.pinned.get(name)),
+                "cooldown_ticks": max(
+                    0, eng._cooldown_until.get(name, 0) - eng.tick),
+                "last_action": (last or {}).get("action"),
+            }
+        return {
+            "enabled": self.enabled, "seed": eng.seed,
+            "tick": eng.tick,
+            "decisions_total": eng.decisions_total,
+            "rollbacks_total": eng.rollbacks_total,
+            "apply_errors": self.apply_errors,
+            "journal_digest": eng.journal_digest(),
+            "knobs": knobs,
+        }
+
+    def export_view(self) -> dict:
+        """What the prometheus exporter consumes."""
+        return {
+            "enabled": self.enabled,
+            "decisions_total": self.engine.decisions_total,
+            "rollbacks_total": self.engine.rollbacks_total,
+            "knobs": dict(self.engine.values),
+        }
+
+    def handle_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if not prefix.startswith("autotune"):
+            return None
+        verb = (prefix.split(maxsplit=1)[1:] or ["status"])[0]
+        if verb == "status":
+            return 0, "", self.status()
+        if verb == "history":
+            n = int(cmd.get("count") or 0)
+            decisions = (self.engine.journal[-n:] if n
+                         else list(self.engine.journal))
+            out = {"seed": self.engine.seed,
+                   "decisions": decisions,
+                   "decisions_total": self.engine.decisions_total,
+                   "rollbacks_total": self.engine.rollbacks_total,
+                   "journal_digest": self.engine.journal_digest()}
+            if cmd.get("trace"):
+                out["trace"] = list(self.engine.trace)
+            return 0, "", out
+        if verb == "enable":
+            if "seed" in cmd:
+                self.engine = AutotuneEngine(seed=int(cmd["seed"]))
+                self.applied.clear()
+            self.enabled = True
+            return 0, "", {"enabled": True, "seed": self.engine.seed}
+        if verb == "disable":
+            self.enabled = False
+            return 0, "", {"enabled": False}
+        if verb in ("pin", "unpin"):
+            knob = cmd.get("knob")
+            if not knob or knob not in self.engine.knobs:
+                return -22, "", f"autotune {verb} needs a known knob " \
+                                f"(got {knob!r})"
+            if verb == "unpin":
+                self.engine.unpin(knob)
+                return 0, "", {"knob": knob, "pinned": False}
+            try:
+                v = self.engine.pin(knob, cmd.get("value"))
+            except (TypeError, ValueError) as e:
+                return -22, "", f"autotune pin: bad value: {e}"
+            if cmd.get("value") is not None:
+                self._apply(knob, v)
+            return 0, "", {"knob": knob, "pinned": True, "value": v}
+        return -22, "", ("usage: autotune status|history|enable"
+                         "|disable|pin <knob> [value]|unpin <knob>")

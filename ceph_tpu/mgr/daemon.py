@@ -131,7 +131,8 @@ class PrometheusModule(MgrModule):
         self.service = ExporterService(
             Exporter(ctx._d.monc, ctx._d.asok_paths,
                      progress_events=self._progress_events,
-                     telemetry=self._telemetry)).start()
+                     telemetry=self._telemetry,
+                     autotune=self._autotune)).start()
         self.port = self.service.port
 
     def _progress_events(self):
@@ -144,12 +145,17 @@ class PrometheusModule(MgrModule):
         mod = self.ctx._d.modules.get("telemetry_spine")
         return mod.export_view() if mod is not None else {}
 
+    def _autotune(self):
+        mod = self.ctx._d.modules.get("autotune")
+        return mod.export_view() if mod is not None else {}
+
     def shutdown(self):
         self.service.shutdown()
 
 
 def _default_modules():
     # late import: modules.py subclasses MgrModule from this file
+    from .autotune import AutotuneModule
     from .dashboard import DashboardModule
     from .modules import (CrashModule, IostatModule, StatusModule,
                           TelemetryModule)
@@ -161,9 +167,9 @@ def _default_modules():
     from .volumes import VolumesModule
     return (BalancerModule, PgAutoscalerModule, PrometheusModule,
             ProgressModule, StatusModule, IostatModule, CrashModule,
-            TelemetryModule, TelemetrySpine, DashboardModule,
-            VolumesModule, OrchestratorModule, DeviceHealthModule,
-            RbdSupportModule)
+            TelemetryModule, TelemetrySpine, AutotuneModule,
+            DashboardModule, VolumesModule, OrchestratorModule,
+            DeviceHealthModule, RbdSupportModule)
 
 
 class _MgrCommandServer(Dispatcher):

@@ -32,16 +32,19 @@ def _esc_label(v) -> str:
 
 class Exporter:
     def __init__(self, monc, asok_paths: dict[str, str] | None = None,
-                 progress_events=None, telemetry=None):
+                 progress_events=None, telemetry=None, autotune=None):
         """monc: a MonClient; asok_paths: daemon name → admin socket
         (scraped for perf counters); progress_events: nullary callable
         → open mgr progress events (ceph_progress_event gauge);
         telemetry: nullary callable → the telemetry spine's export
-        view (device-plane series + derived byte rates)."""
+        view (device-plane series + derived byte rates); autotune:
+        nullary callable → the autotune module's export view
+        (decision counters + current knob values)."""
         self.monc = monc
         self.asok_paths = dict(asok_paths or {})
         self.progress_events = progress_events
         self.telemetry = telemetry
+        self.autotune = autotune
 
     def collect(self) -> str:
         lines: list[str] = []
@@ -242,6 +245,14 @@ class Exporter:
             self._emit_device_series(emit, emit_type, view)
             self._emit_slo_series(emit, view)
 
+        # autotuner decision counters + actuated knob values
+        if self.autotune is not None:
+            try:
+                aview = self.autotune() or {}
+            except Exception:
+                aview = {}
+            self._emit_autotune(emit, aview)
+
         for daemon, path in sorted(self.asok_paths.items()):
             try:
                 dump = admin_command(path, "perf dump")
@@ -392,6 +403,43 @@ class Exporter:
                          round(float(lane.get("violation_s", 0.0)),
                                3), labels=lab)
             first = False
+
+    @staticmethod
+    def _emit_autotune(emit, view):
+        """Autotune export view → ceph_autotune_* families: the
+        decision/rollback counters, an armed flag, and one
+        ceph_autotune_knob_value series per numeric knob (string
+        knobs — e.g. osd_wal_sync_mode — become an info-style series
+        with the value in a label)."""
+        if not view:
+            return
+        emit("ceph_autotune_enabled",
+             int(bool(view.get("enabled"))),
+             help_="autotuner actively actuating knobs (1=yes)")
+        emit("ceph_autotune_decisions_total",
+             int(view.get("decisions_total", 0)),
+             help_="knob adjustments made since (re)seed",
+             typ="counter")
+        emit("ceph_autotune_rollbacks_total",
+             int(view.get("rollbacks_total", 0)),
+             help_="adjustments undone after objective regression",
+             typ="counter")
+        num_first = info_first = True
+        for knob in sorted(view.get("knobs") or {}):
+            value = view["knobs"][knob]
+            if isinstance(value, bool) or not isinstance(
+                    value, (int, float)):
+                emit("ceph_autotune_knob_info", 1,
+                     labels={"knob": knob, "value": str(value)},
+                     help_="current value of a non-numeric knob"
+                     if info_first else None)
+                info_first = False
+            else:
+                emit("ceph_autotune_knob_value", value,
+                     labels={"knob": knob},
+                     help_="current value of an actuated knob"
+                     if num_first else None)
+                num_first = False
 
     @staticmethod
     def _emit_histogram(emit, emit_type, base, lab, val):

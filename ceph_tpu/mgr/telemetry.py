@@ -301,6 +301,62 @@ class TelemetrySpine(MgrModule):
             }
         return {"osd_perf": out}
 
+    def _ingest_slo_series(self, scenario: str, report: dict):
+        """Thread each report's violation/goodput aggregates into
+        per-scenario rings (pseudo-daemon ``slo.<scenario>``) so the
+        autotuner and ``telemetry series`` see pressure *history*, not
+        just the latest point sample kept in ``self.slo``."""
+        now = time.monotonic()
+        daemon = f"slo.{scenario}"
+        violation_s = 0.0
+        lanes_in_violation = 0.0
+        for lanes in (report.get("tenants") or {}).values():
+            for lane in (lanes or {}).values():
+                violation_s += float(lane.get("violation_s", 0.0))
+                lanes_in_violation += bool(lane.get("in_violation"))
+        self._ring(daemon, "violation_s").append(now, violation_s)
+        self._ring(daemon, "goodput_ops").append(
+            now, float(report.get("goodput_ops", 0.0)))
+        self._ring(daemon, "lanes_in_violation").append(
+            now, lanes_in_violation)
+        self._ring(daemon, "offered_rate").append(
+            now, float(report.get("offered_rate", 0.0)))
+
+    def slo_pressure(self) -> dict:
+        """Windowed violation pressure for the autotuner: per scenario
+        the *rate* of cumulative time-in-violation (seconds of
+        violation per wall second, clamped to [0,1] — 1 means every
+        moment of the window was in violation somewhere), plus the
+        latest goodput and worst lane p99 from the retained reports."""
+        per = {}
+        for daemon, rings in self.series.items():
+            if not daemon.startswith("slo."):
+                continue
+            scenario = daemon.split(".", 1)[1]
+            ring = rings.get("violation_s")
+            rate = ring.rate() if ring is not None else 0.0
+            good = rings.get("goodput_ops")
+            per[scenario] = {
+                "pressure": min(1.0, rate),
+                "goodput_ops": (float(good.samples[-1][1])
+                                if good is not None and len(good)
+                                else 0.0),
+            }
+        worst_p99 = 0.0
+        for report in self.slo.values():
+            for lanes in (report.get("tenants") or {}).values():
+                for lane in (lanes or {}).values():
+                    worst_p99 = max(worst_p99,
+                                    float(lane.get("p99_ms", 0.0)))
+        return {
+            "pressure": max((s["pressure"] for s in per.values()),
+                            default=0.0),
+            "goodput_ops": sum(s["goodput_ops"]
+                               for s in per.values()),
+            "worst_p99_ms": worst_p99,
+            "scenarios": per,
+        }
+
     def series_dump(self, daemon: str | None = None) -> dict:
         """Raw rings (history surface for tests/tools)."""
         src = (self.series if daemon is None
@@ -314,8 +370,10 @@ class TelemetrySpine(MgrModule):
         reports."""
         return {"profiler": dict(self.profiler),
                 "rates": {d: self.daemon_rates(d)
-                          for d in self.series},
-                "slo": dict(self.slo)}
+                          for d in self.series
+                          if not d.startswith("slo.")},
+                "slo": dict(self.slo),
+                "slo_pressure": self.slo_pressure()}
 
     def handle_command(self, cmd: dict):
         prefix = cmd.get("prefix", "")
@@ -329,7 +387,9 @@ class TelemetrySpine(MgrModule):
             report = cmd.get("report")
             if not isinstance(report, dict):
                 return -22, "", "slo ingest needs a report dict"
-            self.slo[str(cmd.get("scenario") or "default")] = report
+            scenario = str(cmd.get("scenario") or "default")
+            self.slo[scenario] = report
+            self._ingest_slo_series(scenario, report)
             return 0, "", ""
         if prefix == "slo report":
             scenario = cmd.get("scenario")

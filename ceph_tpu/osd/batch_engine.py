@@ -184,6 +184,7 @@ class BatchEngine:
                  comp_max_ops: int | None = None,
                  comp_flush_ms: float | None = None,
                  comp_segment_bytes: int = 1 << 20,
+                 bucket_floor: int = 32,
                  use_mesh: bool = False, on_lane_flush=None,
                  store_kick=None):
         self.name = name
@@ -210,6 +211,7 @@ class BatchEngine:
         self.comp_flush_ms = (self.flush_ms if comp_flush_ms is None
                               else float(comp_flush_ms))
         self.comp_segment_bytes = int(comp_segment_bytes)
+        self.bucket_floor = int(bucket_floor)
         self.use_mesh = bool(use_mesh)
         self.use_planes: bool | None = None  # None = auto (TPU only)
         self.on_lane_flush = on_lane_flush   # (lane, ops, bytes) hook
@@ -781,8 +783,9 @@ class BatchEngine:
 
     def _groups(self, pending):
         groups: dict = {}
+        floor = max(1, int(self.bucket_floor))
         for op in pending:
-            bucket_len = _next_pow2(max(op.length, 32))
+            bucket_len = _next_pow2(max(op.length, floor))
             groups.setdefault((op.key, bucket_len), []).append(op)
         return groups
 
@@ -1345,6 +1348,7 @@ class BatchEngine:
         d = dict(self.stats)
         d.update(enabled=self.enabled, flush_ms=self.flush_ms,
                  max_bytes=self.max_bytes, max_ops=self.max_ops,
+                 bucket_floor=self.bucket_floor,
                  pending_ops=pending, pending_bytes=pending_bytes,
                  recon_enabled=self.recon_enabled,
                  recon_flush_ms=self.recon_flush_ms,

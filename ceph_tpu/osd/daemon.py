@@ -233,6 +233,8 @@ class OSDaemon(Dispatcher):
             comp_segment_bytes=int(
                 self.config.get("osd_compress_segment_bytes")
                 or (1 << 20)),
+            bucket_floor=int(
+                self.config.get("osd_batch_bucket_floor") or 32),
             use_mesh=bool(
                 self.config.get("osd_recovery_batch_mesh")),
             on_lane_flush=self._on_lane_flush,
@@ -257,10 +259,19 @@ class OSDaemon(Dispatcher):
                  float),
                 ("osd_compress_segment_bytes", "comp_segment_bytes",
                  int),
+                ("osd_batch_bucket_floor", "bucket_floor", int),
                 ("osd_recovery_batch_mesh", "use_mesh", bool)):
             self.config.add_observer(
                 _opt, lambda _n, v, _a=_attr, _c=_cast: setattr(
                     self.batch_engine, _a, _c(v)))
+        # recovery pacing: PGs read this live per backfill kick — an
+        # autotuner `config set` retunes the next batch, no restart
+        self.recovery_max_active = int(
+            self.config.get("osd_recovery_max_active") or 8)
+        self.config.add_observer(
+            "osd_recovery_max_active",
+            lambda _n, v: setattr(self, "recovery_max_active",
+                                  max(1, int(v))))
         self.admin_socket = AdminSocket(
             admin_socket_path or default_path(f"osd.{whoami}"))
         self._register_admin_commands()

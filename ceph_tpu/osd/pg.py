@@ -886,7 +886,7 @@ class PG:
         self._kick_backfill()
         self._maybe_clean()
 
-    BACKFILL_BATCH = 8
+    BACKFILL_BATCH = 8      # fallback when the daemon has no config
 
     def _object_version_onstore(self, oid: str) -> tuple:
         try:
@@ -950,7 +950,11 @@ class PG:
             objs = st["objs"]
             lo = bisect.bisect_right(objs, st["cursor"])
             batch = []
-            while lo < len(objs) and len(batch) < self.BACKFILL_BATCH:
+            # live pacing knob (osd_recovery_max_active observer on
+            # the daemon): autotuner-retunable per kick
+            cap = max(1, int(getattr(self.daemon, "recovery_max_active",
+                                     self.BACKFILL_BATCH)))
+            while lo < len(objs) and len(batch) < cap:
                 oid = objs[lo]
                 st["cursor"] = oid
                 lo += 1

@@ -252,6 +252,40 @@ def _dispatch(args, rest) -> int:
             rc, outs, outb = mc.mgr_command({"prefix": "iostat"})
             if rc == 0 and outb is not None and "json" not in rest[1:]:
                 print(_render_iostat(outb))
+                # autotune panel rides along when the module is loaded
+                arc, _, aout = mc.mgr_command(
+                    {"prefix": "autotune status"})
+                if arc == 0 and aout:
+                    print(_render_autotune(aout))
+                return 0
+            if outb is not None:
+                print(json.dumps(outb, indent=2, default=str))
+            if outs:
+                print(outs, file=sys.stderr)
+            return 0 if rc == 0 else 1
+        elif rest[0] == "autotune":
+            # mgr autotuner: status|history|enable|disable|pin|unpin
+            verb = rest[1] if len(rest) > 1 else "status"
+            cmd = {"prefix": f"autotune {verb}"}
+            json_out = False
+            pos = []
+            for tok in rest[2:]:
+                if tok == "json":
+                    json_out = True
+                elif "=" in tok:
+                    k, v = tok.split("=", 1)
+                    cmd[k] = int(v) if v.lstrip("-").isdigit() else v
+                else:
+                    pos.append(tok)
+            if verb in ("pin", "unpin") and pos:
+                cmd["knob"] = pos[0]
+                if verb == "pin" and len(pos) > 1:
+                    cmd["value"] = pos[1]
+            elif verb == "enable" and pos:
+                cmd["seed"] = int(pos[0])
+            rc, outs, outb = mc.mgr_command(cmd)
+            if rc == 0 and verb == "status" and outb and not json_out:
+                print(_render_autotune(outb))
                 return 0
             if outb is not None:
                 print(json.dumps(outb, indent=2, default=str))
@@ -408,6 +442,30 @@ def _render_iostat(out: dict) -> str:
             f"{r.get('write_ops_per_sec', 0.0):>10.1f}"
             f"{r.get('bytes_per_sec', 0.0):>12.0f}"
             f"{r.get('launches_per_sec', 0.0):>10.1f}")
+    return "\n".join(lines)
+
+
+def _render_autotune(out: dict) -> str:
+    """`ceph autotune status` panel: controller header + one row per
+    actuated knob."""
+    state = "enabled" if out.get("enabled") else "disabled"
+    lines = [
+        f"autotune: {state} seed={out.get('seed')} "
+        f"tick={out.get('tick', 0)} "
+        f"decisions={out.get('decisions_total', 0)} "
+        f"rollbacks={out.get('rollbacks_total', 0)} "
+        f"digest={str(out.get('journal_digest', ''))[:12]}",
+        f"{'KNOB':<36}{'VALUE':>12}{'PIN':>5}{'COOL':>6}"
+        f"{'LAST':>10}",
+    ]
+    for name, k in sorted((out.get("knobs") or {}).items()):
+        v = k.get("value")
+        vs = f"{v:g}" if isinstance(v, float) else str(v)
+        lines.append(
+            f"{name:<36}{vs:>12}"
+            f"{'*' if k.get('pinned') else '':>5}"
+            f"{k.get('cooldown_ticks', 0):>6}"
+            f"{str(k.get('last_action') or '-'):>10}")
     return "\n".join(lines)
 
 

@@ -384,6 +384,102 @@ def noisy_neighbor(*, victim_rate: float = 30.0,
         rig.close()
 
 
+def regime_shift(*, cluster=None, base_rate: float = 60.0,
+                 phase_duration: float = 2.0, seed: int = 17,
+                 workers: int = 12, size: int = 4096,
+                 large_size: int = 65536, recovery: bool = True,
+                 slo_ms: dict | None = None, publish: bool = True,
+                 scenario: str = "regime_shift") -> dict:
+    """The autotuner proving ground: one rig, four load regimes in
+    sequence — steady → bursty → large-object → recovery-storm — so a
+    config tuned for any single phase is wrong for another.  Each
+    phase runs its own seeded open-loop schedule and SLO tracker, and
+    publishes its report to the mgr (``slo ingest``) mid-run so a
+    live controller sees the pressure *while the next phase runs*.
+
+    Returns per-phase p99/goodput/violation numbers plus
+    ``sustained_MBps`` (goodput bytes over total measured time) and
+    ``worst_p99_ms`` — the two scalars the bench compares between
+    static configs and the controller.  Seeds are per-phase
+    (``seed + phase_index``); fingerprints make replays checkable."""
+    rig = _Rig(cluster, tenants=("shift",), size=size)
+    try:
+        slo = dict(slo_ms or DEFAULT_SLO_MS)
+        phases = [
+            ("steady", base_rate, size, None),
+            ("bursty", base_rate * 3.0, size, None),
+            ("large_object", max(8.0, base_rate / 4.0), large_size,
+             OpMix({S3_PUT: 1})),
+            ("recovery_storm", base_rate, size, None),
+        ]
+        cl = rig.cluster
+        can_storm = (recovery and hasattr(cl, "crash_osd")
+                     and len(getattr(cl, "osds", {})) >= 3)
+        out_phases: dict[str, dict] = {}
+        fingerprints: dict[str, str] = {}
+        good_bytes = 0.0
+        elapsed = 0.0
+        worst_p99 = 0.0
+        for i, (name, rate, psize, mix) in enumerate(phases):
+            reviver = None
+            if name == "recovery_storm" and can_storm:
+                victim = max(cl.osds)
+                cl.crash_osd(victim)
+                # revive mid-phase: backfill then storms the cluster
+                # while the remaining schedule is still offered
+                reviver = threading.Timer(
+                    phase_duration / 3.0,
+                    lambda: cl.revive_osd(victim, timeout=30.0))
+                reviver.daemon = True
+                reviver.start()
+            profile = TenantProfile("shift", rate, kind="poisson",
+                                    mix=mix, size=psize,
+                                    seed=seed + i)
+            tracker = SLOTracker(slo)
+            gen = LoadGenerator([profile], rig.executor(),
+                                duration=phase_duration,
+                                workers=workers, tracker=tracker)
+            res = _run_tracked(gen, tracker)
+            if reviver is not None:
+                reviver.join(timeout=60.0)
+            rep = res["slo"]
+            if publish:
+                publish_slo(rig.rados, rep, scenario=scenario)
+            lanes = rep["tenants"].get("shift", {})
+            p99 = max((lane["p99_ms"] for lane in lanes.values()),
+                      default=0.0)
+            worst_p99 = max(worst_p99, p99)
+            good_bytes += (rep["goodput_ops"] * rep["elapsed_s"]
+                           * psize)
+            elapsed += rep["elapsed_s"]
+            out_phases[name] = {
+                "rate": rate, "size": psize,
+                "p99_ms": p99,
+                "goodput_ops": rep["goodput_ops"],
+                "goodput_MBps": rep["goodput_ops"] * psize / 1e6,
+                "offered_rate": rep["offered_rate"],
+                "violation_s": sum(lane["violation_s"]
+                                   for lane in lanes.values()),
+                "throttled": res["open_loop"]["throttled"],
+                "errors": res["open_loop"]["errors"],
+            }
+            fingerprints[name] = schedule_fingerprint(
+                [profile], phase_duration)
+        if can_storm:
+            cl.wait_for_clean(timeout=60.0)
+        return {
+            "phases": out_phases,
+            "sustained_MBps": (good_bytes / elapsed / 1e6
+                               if elapsed else 0.0),
+            "worst_p99_ms": worst_p99,
+            "recovery_storm": can_storm,
+            "seed": seed,
+            "fingerprints": fingerprints,
+        }
+    finally:
+        rig.close()
+
+
 def game_day_under_load(*, rate: float = 30.0,
                         duration: float = 30.0, seed: int = 31,
                         workers: int = 16, size: int = 4096,

@@ -1,0 +1,152 @@
+"""Knob-registry lint — the autotuner stays attached to its knobs.
+
+Walks every Option the autotuner claims to actuate (``KNOBS`` in
+``mgr/autotune.py``) and asserts, against the source tree:
+
+- the Option is still declared in ``core/options.py``;
+- the controller's bounds sit inside the Option's declared min/max,
+  its ladder honors any enum, and its initial value equals the
+  Option default (a disabled autotuner must change nothing);
+- a live observer registration exists for the knob — a ``config
+  set`` lands without an OSD restart — or the knob carries an
+  explicit waiver naming the live per-tick read that consumes it.
+
+Pattern of ``test_device_plane_lint.py``: regex over sources plus an
+explicit justification dict, with staleness checks so a waiver whose
+reason disappears fails the test instead of rotting silently.  This
+is what makes a future knob rename loud: the renamed Option detaches
+from ``KNOBS`` (or its observer) and this file goes red.
+"""
+
+import pathlib
+import re
+
+import ceph_tpu
+from ceph_tpu.core.options import build_options
+from ceph_tpu.mgr.autotune import KNOBS
+
+ROOT = pathlib.Path(ceph_tpu.__file__).parent
+
+# Knobs with no add_observer registration, consumed by a live
+# per-tick read instead (equally restart-free).  file → the read the
+# staleness check verifies.
+LIVE_READ = {
+    "osd_scrub_interval":
+        ("osd/daemon.py", "read every heartbeat tick in "
+                          "_maybe_schedule_scrub"),
+}
+
+# Knobs whose observer registration builds the option name at
+# runtime (so the literal never appears at the add_observer call
+# site): file → the construction pattern that must still exist.
+CONSTRUCTED_OBSERVER = {
+    "osd_mclock_scheduler_recovery_lim":
+        ("osd/scheduler.py",
+         r"osd_mclock_scheduler_\{opt\}_\{suffix\}"),
+    "osd_mclock_scheduler_scrub_lim":
+        ("osd/scheduler.py",
+         r"osd_mclock_scheduler_\{opt\}_\{suffix\}"),
+}
+
+
+def _sources():
+    out = {}
+    for p in sorted(ROOT.rglob("*.py")):
+        out[p.relative_to(ROOT).as_posix()] = p.read_text()
+    return out
+
+
+def _options():
+    return {o.name: o for o in build_options()}
+
+
+def test_every_actuated_knob_is_a_declared_option():
+    opts = _options()
+    missing = sorted(n for n in KNOBS if n not in opts)
+    assert not missing, \
+        f"autotuner actuates undeclared options: {missing}"
+
+
+def test_bounds_inside_option_minmax_and_initial_is_default():
+    opts = _options()
+    for name, knob in KNOBS.items():
+        opt = opts[name]
+        assert knob.initial == opt.default, \
+            f"{name}: controller initial {knob.initial!r} != " \
+            f"Option default {opt.default!r}"
+        if opt.enum_allowed:
+            bad = [v for v in (knob.ladder or [])
+                   if v not in opt.enum_allowed]
+            assert not bad, f"{name}: ladder values {bad} outside " \
+                            f"enum {opt.enum_allowed}"
+            continue
+        values = (knob.ladder if knob.ladder is not None
+                  else [knob.lo, knob.hi])
+        assert values, name
+        if opt.min is not None:
+            assert min(values) >= opt.min, \
+                f"{name}: bound {min(values)} below Option min " \
+                f"{opt.min}"
+        if opt.max is not None:
+            assert max(values) <= opt.max, \
+                f"{name}: bound {max(values)} above Option max " \
+                f"{opt.max}"
+
+
+def test_every_actuated_knob_has_a_live_observer():
+    srcs = _sources()
+    observer_srcs = {rel: src for rel, src in srcs.items()
+                     if "add_observer" in src
+                     and rel != "core/config.py"}
+    detached = []
+    for name in KNOBS:
+        if name in LIVE_READ:
+            continue
+        if name in CONSTRUCTED_OBSERVER:
+            rel, pat = CONSTRUCTED_OBSERVER[name]
+            if not re.search(pat, srcs.get(rel, "")):
+                detached.append(f"{name} (pattern {pat} gone from "
+                                f"{rel})")
+            continue
+        if not any(name in src for src in observer_srcs.values()):
+            detached.append(name)
+    assert not detached, \
+        f"actuated knobs with no observer registration: {detached}"
+
+
+def test_live_read_waivers_are_not_stale():
+    srcs = _sources()
+    for name, (rel, why) in LIVE_READ.items():
+        assert name in KNOBS, \
+            f"waiver for {name} but the autotuner no longer " \
+            f"actuates it — drop it ({why})"
+        src = srcs.get(rel, "")
+        assert re.search(
+            rf"config\.get\(\s*[\"']{re.escape(name)}[\"']", src), \
+            f"{rel} no longer live-reads {name} — the waiver " \
+            f"({why}) is stale"
+        # a waiver must not shadow a real observer
+        assert not any(
+            name in s and "add_observer" in s
+            and re.search(
+                rf"add_observer\(\s*\n?\s*[\"']{re.escape(name)}", s)
+            for s in srcs.values()), \
+            f"{name} grew a real observer — drop the waiver"
+
+
+def test_constructed_observer_patterns_are_not_stale():
+    srcs = _sources()
+    for name, (rel, pat) in CONSTRUCTED_OBSERVER.items():
+        assert name in KNOBS, \
+            f"constructed-observer entry for {name} but the " \
+            f"autotuner no longer actuates it — drop it"
+        assert rel in srcs, f"{rel} vanished"
+        src = srcs[rel]
+        assert re.search(pat, src) and "add_observer" in src, \
+            f"{rel} no longer registers observers via {pat}"
+
+
+def test_wal_ladder_never_contains_none():
+    # safety invariant, not a bounds check: the controller may trade
+    # fsync granularity but must never pick ack-without-durability
+    assert "none" not in KNOBS["osd_wal_sync_mode"].ladder
