@@ -1750,6 +1750,71 @@ def _autotune_leg(on_tpu: bool):
     }
 
 
+def _procs_leg(on_tpu: bool):
+    """Process-parallel runtime vs threaded: the same seeded rados
+    ramp-to-collapse run in-process (every daemon sharing one GIL)
+    and against a procs cluster where mons, OSDs, and the open-loop
+    generator are each their own OS process — the knee separation is
+    what one interpreter costs the data path.  Then a kill -9 drill
+    on the procs cluster: SIGKILL the acting primary and time the
+    mon down-marking (detect) and the fresh-process WAL cold-remount
+    back to up-in-map (rejoin)."""
+    from ceph_tpu.procs import DaemonSpec, run_rados_ramp, spawn_daemon
+    from ceph_tpu.vstart import MiniCluster
+
+    seed = 0xBEEF
+    ramp = {"rates": [50, 100, 200, 400, 800],
+            "step_duration": 1.5, "slo_p99_ms": 250.0,
+            "object_kb": 8, "n_objects": 32, "workers": 8}
+
+    with MiniCluster(n_mons=1, n_osds=3, fault_seed=seed) as c:
+        threaded = run_rados_ramp(c.monmap, seed=seed, **ramp)
+
+    with MiniCluster(n_mons=1, n_osds=3, fault_seed=seed,
+                     procs=True) as c:
+        run_dir = c._procs_run_dir()
+        result_path = os.path.join(run_dir, "ramp.json")
+        spec = DaemonSpec(kind="workload", ident="ramp",
+                          monmap=c.monmap.to_dict(), fault_seed=seed,
+                          extra={"ramp": ramp,
+                                 "result_path": result_path})
+        h = spawn_daemon(spec, run_dir=run_dir, timeout=30)
+        rc = h.wait(timeout=300)
+        if rc != 0:
+            raise RuntimeError(
+                f"workload child rc={rc}: {h.log_tail()}")
+        with open(result_path) as f:
+            procs_run = json.load(f)
+        victim = c.pg_primary("0.0")
+        t0 = time.monotonic()
+        c.crash_osd(victim, hard=True)
+        c.wait_for_osd_down(victim, timeout=60)
+        detect_s = time.monotonic() - t0
+        t1 = time.monotonic()
+        # revive blocks until the fresh process replayed its WAL and
+        # is up in the map (the child's ready file lands after
+        # start(wait_for_up=True) returns)
+        c.revive_osd(victim, timeout=60)
+        rejoin_s = time.monotonic() - t1
+
+    knee_thr = threaded.get("knee_ops_per_sec") or 0
+    knee_procs = procs_run.get("knee_ops_per_sec") or 0
+    if not on_tpu:
+        # CPU smoke: real processes must never collapse EARLIER than
+        # one GIL-shared interpreter driving the identical ladder
+        assert knee_procs >= knee_thr, \
+            f"procs knee {knee_procs} < threaded knee {knee_thr}"
+    return {
+        "seed": seed,
+        "knee_ops_per_sec_threaded": knee_thr,
+        "knee_ops_per_sec_procs": knee_procs,
+        "kill9_detect_s": round(detect_s, 3),
+        "kill9_rejoin_s": round(rejoin_s, 3),
+        "threaded_steps": threaded["steps"],
+        "procs_steps": procs_run["steps"],
+    }
+
+
 def _crush_leg():
     """BatchMapper PGs/sec vs the native-C scalar crush_do_rule
     (BASELINE.md row 4, scaled to fit a bench-run budget)."""
@@ -1947,7 +2012,8 @@ def child_main():
             out["durability"] = {"error": str(e)[:200]}
     else:
         out["durability"] = {"skipped": "wall budget exhausted"}
-    print(json.dumps(dict(out, autotune={"skipped": "timeout"})),
+    print(json.dumps(dict(out, autotune={"skipped": "timeout"},
+                          procs={"skipped": "timeout"})),
           flush=True)
     # self-tuning data plane: regime shift, statics vs the controller
     if _budget_left() > 0.02:
@@ -1957,6 +2023,16 @@ def child_main():
             out["autotune"] = {"error": str(e)[:200]}
     else:
         out["autotune"] = {"skipped": "wall budget exhausted"}
+    print(json.dumps(dict(out, procs={"skipped": "timeout"})),
+          flush=True)
+    # process-parallel runtime: threaded-vs-procs knee + kill -9 drill
+    if _budget_left() > 0.02:
+        try:
+            out["procs"] = _procs_leg(on_tpu)
+        except Exception as e:    # noqa: BLE001 — keep the headline
+            out["procs"] = {"error": str(e)[:200]}
+    else:
+        out["procs"] = {"skipped": "wall budget exhausted"}
     print(json.dumps(out))
     try:
         dev = jax.devices()[0].device_kind

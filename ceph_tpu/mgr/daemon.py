@@ -217,7 +217,8 @@ class MgrDaemon:
                  beacon_interval: float = 0.4,
                  modules=None,
                  asok_paths: dict[str, str] | None = None,
-                 auth=None):
+                 auth=None,
+                 admin_socket_path: str | None = None):
         self.name = name
         self.monmap = monmap
         self.auth = auth
@@ -237,7 +238,8 @@ class MgrDaemon:
         self.addr = None
         # observability (reference: the mgr serves its own asok)
         from ..core.admin_socket import AdminSocket, default_path
-        self.admin_socket = AdminSocket(default_path(f"mgr.{name}"))
+        self.admin_socket = AdminSocket(
+            admin_socket_path or default_path(f"mgr.{name}"))
         self.admin_socket.register(
             "status", lambda c: {
                 "name": self.name, "state": self.state,

@@ -1552,7 +1552,8 @@ class LogMonitor(PaxosService):
 class Monitor(Dispatcher):
     def __init__(self, rank: int, monmap: MonMap,
                  store: MonitorDBStore | None = None,
-                 tick_interval: float = 0.25, auth=None):
+                 tick_interval: float = 0.25, auth=None,
+                 admin_socket_path: str | None = None):
         self.rank = rank
         self.name = f"mon.{rank}"
         self.monmap = monmap
@@ -1593,7 +1594,8 @@ class Monitor(Dispatcher):
         pb.add_u64_counter("elections", "election rounds entered")
         pb.add_u64_counter("commands", "client commands dispatched")
         self.perf = pb.create_perf_counters()
-        self.admin_socket = AdminSocket(default_path(self.name))
+        self.admin_socket = AdminSocket(
+            admin_socket_path or default_path(self.name))
         self.admin_socket.register(
             "perf dump", lambda c: self.perf.dump(),
             "dump perf counters")

@@ -309,6 +309,7 @@ class Connection:
         if self._closed or gen != self._gen:
             return    # a newer transport already took over
         self._gen += 1  # invalidate concurrent failure reports
+        self.msgr.transport_faults += 1
         if self._writer:
             self._writer.close()
             self._writer = None
@@ -424,6 +425,10 @@ class Messenger:
         self.tracer = None
         self.dispatchers: list[Dispatcher] = []
         self.connections: list[Connection] = []
+        # observability: every EPIPE/ECONNRESET/half-open cut that was
+        # absorbed as a clean connection fault (tests assert >0 after
+        # killing a peer process instead of grepping for tracebacks)
+        self.transport_faults = 0
         self._down = False
         self._server: asyncio.AbstractServer | None = None
         self._loop = asyncio.new_event_loop()
@@ -576,6 +581,29 @@ class Messenger:
                 f"ms_mode mismatch: we={self.mode} "
                 f"peer={reply.get('mode', 'crc')}")
         con.peer_name = reply.get("entity")
+        peer_nonce = reply.get("nonce")
+        if (resume and peer_nonce is not None
+                and con.peer_nonce is not None
+                and peer_nonce != con.peer_nonce):
+            # the peer PROCESS died and came back (kill -9 + respawn on
+            # the same addr): its session state — our in_seq as it knew
+            # it, its out stream — is gone.  Rebase instead of replaying
+            # old seqs at a server that would see them as a gap and
+            # re-ack 0 forever: restart its incoming stream at 1 by
+            # renumbering our unacked backlog in order, and accept its
+            # fresh outgoing stream from 1.  Dedup against the old
+            # incarnation is impossible (it took its receive state to
+            # the grave), so redelivery of acked-but-unapplied work is
+            # the application contract, same as any daemon restart.
+            replay = [con._unacked[s] for s in sorted(con._unacked)]
+            con._unacked = {}
+            for i, m in enumerate(replay, 1):
+                m.seq = i
+                con._unacked[i] = m
+            con.out_seq = len(replay)
+            con.in_seq = 0
+        if peer_nonce is not None:
+            con.peer_nonce = peer_nonce
         if ticket is not None:
             con.session_key = ticket.session_key
         con.secure = (self.mode == "secure")
@@ -676,7 +704,7 @@ class Messenger:
             w.close()
             return
         reply = {"entity": self.entity_name, "in_seq": con.in_seq,
-                 "mode": self.mode}
+                 "nonce": self._nonce, "mode": self.mode}
         payload = json.dumps(reply).encode()
         prefix = b"" if banner_sent else BANNER
         w.write(prefix + struct.pack("<I", len(payload)) + payload)

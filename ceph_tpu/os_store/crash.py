@@ -25,6 +25,13 @@ Crash points, in pipeline order (what stable storage keeps at each):
 - ``mid_compaction``        — cut after the checkpoint temp file is
                               written but before the rename: the old
                               log must remain authoritative.
+- ``kill9``                 — process death, not power loss: in procs
+                              mode the store SIGKILLs its own process
+                              before the next append, so the page
+                              cache (every appended record) survives
+                              and only in-memory state is lost; in
+                              threaded mode it degrades to the
+                              pre_append power cut.
 """
 
 from __future__ import annotations
@@ -39,6 +46,7 @@ CRASH_POINTS = (
     "post_append_pre_fsync",
     "post_fsync_pre_apply",
     "mid_compaction",
+    "kill9",
 )
 
 

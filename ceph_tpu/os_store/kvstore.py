@@ -217,6 +217,30 @@ class WALStore(MemStore):
             self._drop_tracking()
         self.finisher.shutdown()
 
+    def process_death(self):
+        """``kill -9`` teardown (the threaded stand-in for true
+        process death): the process dies with no chance to truncate,
+        fsync, or unmark dirty — but unlike :meth:`power_loss` the OS
+        survives, so the page cache keeps EVERY appended record (the
+        write path flushes per append).  Stable storage is the full
+        appended log; only in-memory state is lost.  The caller
+        forgets this object and cold-remounts from the path."""
+        with self.lock:
+            if self._failed is None:
+                self._failed = SimulatedPowerLoss(
+                    f"{self.name}: process killed")
+            wal, self._wal = self._wal, None
+            self._mounted = False
+        if wal is not None:
+            try:
+                wal.close()     # close flushes; nothing is truncated
+            except OSError:
+                pass
+        self._stop_commit_thread(drain=False)
+        with self.lock:
+            self._drop_tracking()
+        self.finisher.shutdown()
+
     @staticmethod
     def _unlink(path: str):
         try:
@@ -251,6 +275,7 @@ class WALStore(MemStore):
                 rec = walog.encode_record(
                     json.dumps(txn.to_dict(),
                                separators=(",", ":")).encode())
+                self._crash_point("kill9")
                 self._crash_point("pre_append")
                 self._crash_point("mid_record", rec)
                 self._wal.write(rec)
@@ -292,6 +317,13 @@ class WALStore(MemStore):
         inj = self.crash
         if inj is None or not inj.decide(point):
             return
+        if point == "kill9" and os.environ.get("CEPH_TPU_PROC_DAEMON"):
+            # real process death: no truncation, no exception — the
+            # page cache (every appended record, flushed per append)
+            # survives; only unsynced-but-unappended state is lost
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(60)          # SIGKILL is not synchronous
         torn = b""
         if point == "mid_record" and rec:
             # the power cut lands partway through the kernel's write:

@@ -447,6 +447,10 @@ class OSDaemon(Dispatcher):
             "num_pgs": len(self.pgs),
             "state": "active" if self.running else "stopped"},
             "daemon status")
+        a.register("dump_replay_stats", lambda c: {
+            "replay_stats": getattr(self.store, "replay_stats", None),
+            "wal_stats": dict(getattr(self.store, "wal_stats", {}))},
+            "WAL mount-replay summary + append/sync counters")
         # fault fabric controls (handlers bind self.msgr lazily — the
         # messenger is constructed after this registration)
         _FAULT_KNOBS = ("drop", "delay", "delay_ms", "dup", "reorder",

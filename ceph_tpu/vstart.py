@@ -25,6 +25,7 @@ from .msg.fault import site_pairs
 from .os_store import CrashInjector, WALStore
 from .osd.daemon import OSDaemon
 from .osdc.librados import Rados
+from .procs import DaemonSpec, ProcSpawnError, spawn_daemon
 
 
 def health_event(code: str, state: str):
@@ -114,10 +115,33 @@ class MiniCluster:
                  stretch_sites: dict[str, list[int]] | None = None,
                  mon_sites: dict[int, str] | None = None,
                  tiebreaker_mon: int = -1,
-                 fault_seed: int | None = None):
+                 fault_seed: int | None = None,
+                 procs: bool = False,
+                 crash_probs: dict[str, float] | None = None):
         # option overrides applied to every OSD BEFORE construction
         # (some, e.g. osd_op_queue, are consumed in the ctor)
         self._osd_config = dict(osd_config or {})
+        # procs=True: every daemon is its own OS process, spawned from
+        # a serializable boot spec and joined over the (already-TCP)
+        # messenger.  Threaded mode stays the fast tier-1 default.
+        self.procs = bool(procs)
+        # per-point crash probabilities applied to every OSD's
+        # CrashInjector (threaded AND procs — the seed makes the
+        # schedule identical either way)
+        self.crash_probs = {k: float(v)
+                            for k, v in (crash_probs or {}).items()}
+        if self.procs:
+            if secure:
+                raise ValueError("procs=True does not support secure "
+                                 "mode (no keyring distribution yet)")
+            if stretch_sites:
+                raise ValueError("procs=True does not support stretch"
+                                 " sites (fault fabric is in-process)")
+            if osd_stores is not None or mon_stores is not None:
+                raise ValueError("procs=True boots daemons from "
+                                 "serializable specs; live store "
+                                 "objects cannot cross a process "
+                                 "boundary")
         # secure=True: one ClusterAuth (the deployed-keyring analog)
         # shared by every daemon and client; all messengers run
         # ms_mode=secure (AES-GCM frames) — reference ProtocolV2
@@ -150,10 +174,11 @@ class MiniCluster:
                                    for r in range(n_mons)},
                              sites=dict(mon_sites or {}),
                              tiebreaker=tiebreaker_mon)
-        self.mons = [Monitor(r, self.monmap,
-                             store=mon_stores[r] if mon_stores else None,
-                             auth=self.auth)
-                     for r in range(n_mons)]
+        self.mons = [] if self.procs else \
+            [Monitor(r, self.monmap,
+                     store=mon_stores[r] if mon_stores else None,
+                     auth=self.auth)
+             for r in range(n_mons)]
         self._osd_stores = osd_stores
         # durable backing (osd_objectstore=walstore, the default):
         # per-OSD WAL files in a throwaway dir, paths remembered so a
@@ -170,9 +195,25 @@ class MiniCluster:
         # (injector, src, dst) triples the site primitives installed,
         # so heal_sites removes exactly what it added
         self._site_rules: list[tuple] = []
+        # procs-mode state: process handles, pre-assigned admin
+        # sockets (Unix sockets cross the process boundary), sticky
+        # spawn failures (the OSD_STORE_ERROR degradation pattern: an
+        # entity that exhausted its spawn retries stays failed instead
+        # of flapping), and a cached admin rados client
+        self._run_dir: str | None = None
+        self._mon_handles: dict[int, object] = {}
+        self._osd_handles: dict[int, object] = {}
+        self._mgr_handles: dict[str, object] = {}
+        self._mon_asoks: dict[int, str] = {}
+        self._osd_asoks: dict[int, str] = {}
+        self._mgr_asoks: dict[str, str] = {}
+        self.spawn_failures: dict[str, str] = {}
+        self._admin: Rados | None = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, timeout: float = 30.0) -> "MiniCluster":
+        if self.procs:
+            return self._start_procs(timeout=timeout)
         if self.fault_seed is not None:
             # one logged seed reseeds every daemon injector: verdicts
             # are pure functions of (seed, src, dst, n), so a whole
@@ -192,6 +233,129 @@ class MiniCluster:
         for i in range(self.n_osds):
             self.start_osd(i)
         return self
+
+    # -- procs runtime -----------------------------------------------------
+    def _procs_run_dir(self) -> str:
+        if self._run_dir is None:
+            self._run_dir = tempfile.mkdtemp(prefix="ceph-tpu-procs-")
+        return self._run_dir
+
+    def _start_procs(self, timeout: float) -> "MiniCluster":
+        """Boot every daemon as its own OS process from a boot spec;
+        quorum is observed from outside via the mons' admin sockets."""
+        from .core.admin_socket import admin_command
+        for r in self.monmap.ranks():
+            asok = os.path.join(self._procs_run_dir(),
+                                f"mon.{r}.asok")
+            self._mon_asoks[r] = asok
+            spec = DaemonSpec(kind="mon", ident=str(r),
+                              monmap=self.monmap.to_dict(),
+                              fault_seed=self.fault_seed,
+                              asok_path=asok)
+            self._mon_handles[r] = spawn_daemon(
+                spec, timeout=timeout,
+                run_dir=self._procs_run_dir())
+        deadline = time.monotonic() + timeout
+        while True:
+            leader = None
+            for asok in self._mon_asoks.values():
+                try:
+                    st = admin_command(asok, "quorum_status",
+                                       timeout=2.0)
+                except OSError:
+                    continue
+                if st.get("state") == "leader":
+                    leader = st
+                    break
+            if leader is not None:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("no mon leader (procs)")
+            time.sleep(0.05)
+        for i in range(self.n_osds):
+            self.start_osd(i)
+        return self
+
+    def _start_osd_proc(self, i: int, timeout: float):
+        ent = f"osd.{i}"
+        if ent in self.spawn_failures:
+            # sticky failure: exhausting the retry budget degrades the
+            # entity (as OSD_STORE_ERROR degrades a store) — it stays
+            # down until the operator clears spawn_failures
+            raise ProcSpawnError(
+                f"{ent}: sticky spawn failure: "
+                f"{self.spawn_failures[ent]}")
+        stale = self._osd_handles.pop(i, None)
+        if stale is not None:
+            stale.kill9()   # reap a dead prior incarnation's zombie
+        asok = self._osd_asoks.setdefault(
+            i, os.path.join(self._procs_run_dir(), f"osd.{i}.asok"))
+        extra = {"boot_timeout": timeout}
+        if self.crash_probs:
+            extra["crash_probs"] = dict(self.crash_probs)
+        spec = DaemonSpec(kind="osd", ident=str(i),
+                          monmap=self.monmap.to_dict(),
+                          wal_path=self._wal_path(i),
+                          osd_config=dict(self._osd_config),
+                          fault_seed=self.fault_seed,
+                          asok_path=asok, extra=extra)
+        try:
+            h = spawn_daemon(spec, timeout=timeout + 10.0,
+                             run_dir=self._procs_run_dir())
+        except ProcSpawnError as e:
+            self.spawn_failures[ent] = str(e)
+            raise
+        self._osd_handles[i] = h
+        return h
+
+    def _admin_rados(self) -> Rados:
+        """Cached mon-command client (procs-mode introspection runs
+        entirely over the wire, like a real operator's `ceph` CLI)."""
+        if self._admin is None:
+            self._admin = self.rados(name="client.vstart-admin")
+        return self._admin
+
+    def _mon_cmd(self, cmd: dict):
+        rc, outs, out = self._admin_rados().mon_command(cmd)
+        if rc != 0:
+            raise RuntimeError(
+                f"mon command {cmd.get('prefix')!r} failed "
+                f"rc={rc}: {outs}")
+        return out
+
+    def _osdmap_from_mon(self):
+        from .tools.osdmaptool import osdmap_from_dict
+        return osdmap_from_dict(self._mon_cmd({"prefix": "osd dump"}))
+
+    def _pg_dump(self) -> dict:
+        return self._mon_cmd({"prefix": "pg dump"}) or {}
+
+    def osd_replay_stats(self, i: int) -> dict:
+        """The WAL cold-remount damage report of a (revived) OSD —
+        threaded reads the store, procs asks the daemon's asok."""
+        if self.procs:
+            from .core.admin_socket import admin_command
+            out = admin_command(self._osd_asoks[i],
+                                "dump_replay_stats")
+            return dict(out.get("replay_stats") or {})
+        return dict(getattr(self.osds[i].store, "replay_stats",
+                            None) or {})
+
+    def pg_primary(self, pgid) -> int:
+        """Acting-primary OSD id for one PG (procs: authoritative map
+        via `osd dump`; threaded: the live daemons)."""
+        from .osd.osdmap import PGid
+        if isinstance(pgid, str):
+            pgid = PGid.parse(pgid)
+        if self.procs:
+            return self._osdmap_from_mon(
+                ).pg_to_up_acting_osds(pgid)[3]
+        for osd in self.osds.values():
+            with osd.lock:
+                pg = osd.pgs.get(pgid)
+                if pg is not None and pg.is_primary:
+                    return osd.whoami
+        raise KeyError(f"no live primary for {pgid}")
 
     def _wal_path(self, i: int) -> str:
         p = self._wal_paths.get(i)
@@ -217,17 +381,22 @@ class MiniCluster:
         if self._osd_config.get("osd_objectstore",
                                 "walstore") != "walstore":
             return None     # OSDaemon defaults to MemStore
+        inj = CrashInjector(seed=int(self.fault_seed or 0),
+                            osd=f"osd.{i}")
+        for point, prob in (self.crash_probs or {}).items():
+            inj.set_prob(point, prob)
         return WALStore(
             self._wal_path(i),
             sync_mode=self._osd_config.get("osd_wal_sync_mode",
                                            "batch"),
             name=f"osd.{i}",
-            crash=CrashInjector(seed=int(self.fault_seed or 0),
-                                osd=f"osd.{i}"),
+            crash=inj,
             compact_min_records=int(self._osd_config.get(
                 "osd_wal_compact_min_records", 0)))
 
-    def start_osd(self, i: int, timeout: float = 30.0) -> OSDaemon:
+    def start_osd(self, i: int, timeout: float = 30.0):
+        if self.procs:
+            return self._start_osd_proc(i, timeout=timeout)
         store = None
         if self._osd_stores:
             store = (self._osd_stores.get(i)
@@ -253,6 +422,9 @@ class MiniCluster:
 
     def kill_osd(self, i: int):
         """Hard-stop an OSD (keeps its store object for a revive)."""
+        if self.procs:
+            self._osd_handles.pop(i).stop()
+            return
         osd = self.osds.pop(i)
         osd.running = False
         osd.op_queue.close()
@@ -271,13 +443,23 @@ class MiniCluster:
     def revive_osd(self, i: int, timeout: float = 30.0) -> OSDaemon:
         return self.start_osd(i, timeout=timeout)
 
-    def crash_osd(self, i: int):
-        """Power-loss an OSD: hard-stop the daemon AND destroy its
-        in-memory store — stable storage keeps only the fsynced WAL
-        prefix (plus any torn fragment an injected crash left).  The
-        store object is forgotten, so ``revive_osd`` cold-remounts
-        from the WAL path alone: the true power-cycle ``kill_osd``
-        deliberately is not."""
+    def crash_osd(self, i: int, hard: bool = False):
+        """Crash one OSD so ``revive_osd`` must cold-remount from the
+        WAL path alone (``kill_osd`` deliberately keeps the store).
+
+        ``hard=False`` is a power cut: stable storage keeps only the
+        fsynced WAL prefix (plus any torn fragment an injected crash
+        left).  ``hard=True`` is process death (``kill -9``): the OS
+        survives, so the page cache — every appended record, fsynced
+        or not — is still there on remount; only in-memory daemon
+        state is lost.  In procs mode every crash IS process death
+        (SIGKILL to a real pid), so ``hard`` is implied: the parent
+        cannot reach into the child to truncate an unsynced suffix,
+        which is why fsynced-prefix power-cut drills stay
+        threaded-only."""
+        if self.procs:
+            self._osd_handles.pop(i).kill9()
+            return
         osd = self.osds.pop(i)
         osd.running = False
         osd.op_queue.close()
@@ -289,7 +471,8 @@ class MiniCluster:
         path = getattr(store, "_path", None)
         if path is not None:
             self._wal_paths[i] = path
-        pl = getattr(store, "power_loss", None)
+        pl = getattr(store,
+                     "process_death" if hard else "power_loss", None)
         if pl is not None:
             pl()
         else:
@@ -307,20 +490,25 @@ class MiniCluster:
                    timeout: float = 60.0) -> dict:
         """Whole-cluster power-loss drill: cut power to every running
         OSD at once, then (by default) cold-restart each from its WAL
-        path.  → {osd: replay_stats} for the revived OSDs."""
-        crashed = sorted(self.osds)
+        path.  → {osd: replay_stats} for the revived OSDs.  Routed
+        through crash_osd/revive_osd, so in procs mode each OSD's
+        process is SIGKILLed and the revive cold-remounts the same
+        WAL in a fresh process."""
+        crashed = sorted(self._osd_handles if self.procs
+                         else self.osds)
         for i in crashed:
             self.crash_osd(i)
         report: dict[int, dict] = {}
         if revive:
             for i in crashed:
-                osd = self.revive_osd(i, timeout=timeout)
-                report[i] = dict(
-                    getattr(osd.store, "replay_stats", None) or {})
+                self.revive_osd(i, timeout=timeout)
+                report[i] = self.osd_replay_stats(i)
         return report
 
     # -- mgr ---------------------------------------------------------------
     def start_mgr(self, name: str, **kw):
+        if self.procs:
+            return self._start_mgr_proc(name, **kw)
         from .mgr.daemon import MgrDaemon
         from .mgr.orchestrator import MiniClusterBackend
         kw.setdefault("auth", self.auth)
@@ -341,11 +529,51 @@ class MiniCluster:
         self.mgrs[name] = mgr
         return mgr
 
+    def _start_mgr_proc(self, name: str, **kw):
+        modules = kw.pop("modules", None)
+        if kw:
+            raise ValueError(
+                f"procs=True start_mgr supports only modules=, "
+                f"got {sorted(kw)}")
+        asok = self._mgr_asoks.setdefault(
+            name, os.path.join(self._procs_run_dir(),
+                               f"mgr.{name}.asok"))
+        extra: dict = {"asok_paths": {f"osd.{i}": p for i, p
+                                      in self._osd_asoks.items()}}
+        if modules is not None:
+            extra["modules"] = [f"{m.__module__}:{m.__name__}"
+                                for m in modules]
+        spec = DaemonSpec(kind="mgr", ident=name,
+                          monmap=self.monmap.to_dict(),
+                          fault_seed=self.fault_seed,
+                          asok_path=asok, extra=extra)
+        h = spawn_daemon(spec, run_dir=self._procs_run_dir())
+        self._mgr_handles[name] = h
+        return h
+
     def kill_mgr(self, name: str):
+        if self.procs:
+            self._mgr_handles.pop(name).kill9()
+            return
         self.mgrs.pop(name).kill()
 
     def wait_for_active_mgr(self, timeout: float = 20.0):
         deadline = time.monotonic() + timeout
+        if self.procs:
+            from .core.admin_socket import admin_command
+            while time.monotonic() < deadline:
+                for name, asok in self._mgr_asoks.items():
+                    if name not in self._mgr_handles:
+                        continue
+                    try:
+                        st = admin_command(asok, "status",
+                                           timeout=2.0)
+                    except OSError:
+                        continue
+                    if st.get("state") == "active":
+                        return name
+                time.sleep(0.05)
+            raise TimeoutError("no active mgr (procs)")
         while time.monotonic() < deadline:
             for name, mgr in self.mgrs.items():
                 if mgr.state == "active":
@@ -400,6 +628,8 @@ class MiniCluster:
         fingerprint's refcount must equal its live manifest references
         and zero-ref chunks must be gone (deletes balance to zero).
         Engages only on stores that ever ingested a chunk."""
+        if self.procs:
+            return []   # stores live in child processes
         from .compress import dedup as dd
         problems = []
         for i, osd in sorted(self.osds.items()):
@@ -414,6 +644,9 @@ class MiniCluster:
         return problems
 
     def stop(self):
+        if self.procs:
+            self._stop_procs()
+            return
         try:
             dedup_problems = self.dedup_leak_check()
         except Exception:
@@ -465,6 +698,29 @@ class MiniCluster:
         if dedup_problems:
             raise AssertionError("dedup refcount leak at teardown: "
                                  + "; ".join(dedup_problems))
+
+    def _stop_procs(self):
+        for c in self._clients:
+            try:
+                c.shutdown()
+            except Exception:
+                pass
+        self._admin = None
+        # mgrs before osds before mons — daemons deregister downward
+        for handles in (self._mgr_handles, self._osd_handles,
+                        self._mon_handles):
+            for h in list(handles.values()):
+                try:
+                    h.stop()
+                except Exception:
+                    pass
+            handles.clear()
+        if self._run_dir is not None:
+            shutil.rmtree(self._run_dir, ignore_errors=True)
+            self._run_dir = None
+        if self._wal_dir is not None:
+            shutil.rmtree(self._wal_dir, ignore_errors=True)
+            self._wal_dir = None
 
     def __enter__(self):
         return self.start()
@@ -690,6 +946,21 @@ class MiniCluster:
         """Wait until every PG on every live OSD is active (+clean when
         it owns recovery state)."""
         deadline = time.monotonic() + timeout
+        if self.procs:
+            states: list[str] = []
+            while time.monotonic() < deadline:
+                try:
+                    stats = self._pg_dump().get("pg_stats") or {}
+                except Exception:
+                    stats = {}
+                states = [st.get("state", "")
+                          for st in stats.values()]
+                if states and all(s in ("active", "active+clean")
+                                  for s in states):
+                    return
+                time.sleep(0.1)
+            raise TimeoutError(
+                f"cluster never went clean (procs): {states}")
         while time.monotonic() < deadline:
             states = []
             for osd in self.osds.values():
@@ -708,6 +979,8 @@ class MiniCluster:
         subsequent repair to settle.  Returns the error count the
         scrub found (0 = clean).  deep=False runs a shallow scrub
         (metadata only — no payload digests, no parity recheck)."""
+        if self.procs:
+            return self._scrub_pg_procs(pgid, timeout, deep=deep)
         primary = None
         for osd in self.osds.values():
             with osd.lock:
@@ -730,6 +1003,31 @@ class MiniCluster:
                     return pg.scrub_errors
             time.sleep(0.05)
         raise TimeoutError(f"scrub of {pgid} never finished")
+
+    def _scrub_pg_procs(self, pgid, timeout: float, *,
+                        deep: bool) -> int:
+        """Drive a scrub over the wire: re-issue the mon command
+        (the primary refuses while writes are in flight; the command
+        is idempotent) and poll `pg dump` until the scrub stamp moves
+        past its pre-command value and the PG left `+scrubbing`."""
+        pgid = str(pgid)
+        stamp_key = "last_deep_scrub" if deep else "last_scrub"
+        prefix = "pg deep-scrub" if deep else "pg scrub"
+        st0 = (self._pg_dump().get("pg_stats") or {}).get(pgid) or {}
+        before = st0.get(stamp_key, 0)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                self._mon_cmd({"prefix": prefix, "pgid": pgid})
+            except RuntimeError:
+                pass        # no live primary yet / refused — retry
+            st = (self._pg_dump().get("pg_stats") or {}
+                  ).get(pgid) or {}
+            if st.get(stamp_key, 0) > before and \
+                    "scrubbing" not in st.get("state", ""):
+                return int(st.get("scrub_errors", 0))
+            time.sleep(0.1)
+        raise TimeoutError(f"scrub of {pgid} never finished (procs)")
 
     # -- tracing -----------------------------------------------------------
     def collect_trace(self, trace_id: str,
@@ -764,6 +1062,18 @@ class MiniCluster:
 
     def wait_for_osd_down(self, i: int, timeout: float = 20.0):
         deadline = time.monotonic() + timeout
+        if self.procs:
+            while time.monotonic() < deadline:
+                try:
+                    m = self._osdmap_from_mon()
+                except Exception:
+                    m = None
+                if m is not None and m.max_osd > i \
+                        and not m.is_up(i):
+                    return
+                time.sleep(0.1)
+            raise TimeoutError(
+                f"osd.{i} never marked down (procs)")
         while time.monotonic() < deadline:
             for osd in self.osds.values():
                 with osd.lock:

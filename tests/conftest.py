@@ -43,3 +43,20 @@ assert not jax.config.jax_platforms or jax.config.jax_platforms == "cpu"
 from ceph_tpu.core.lockdep import lockdep_enable  # noqa: E402
 
 lockdep_enable()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _reap_daemon_processes():
+    """Orphan-reaper contract for the procs runtime: any daemon process
+    spawned through ceph_tpu.procs and still alive at session teardown
+    is a leak — SIGKILL it so nothing outlives the test run, then fail
+    loudly.  (The module's own atexit sweep is the silent backstop for
+    interpreter crashes; this fixture is the audible one.)"""
+    from ceph_tpu import procs
+    yield
+    leaked = procs.live_pids()
+    procs.reap_orphans()
+    assert not leaked, (
+        f"daemon processes leaked past test teardown: {leaked}")

@@ -201,3 +201,53 @@ class TestLargePayload:
         con.send_message(MBigBlob(payload))
         assert wait_for(lambda: col.got)
         assert col.got[0].blob == payload
+
+
+class TestAbruptPeerDeath:
+    """The accepting end dies for real — SIGKILL to its process, not a
+    simulated fault verdict — and a fresh incarnation binds the same
+    address.  The survivor must fault the transport cleanly (no
+    unhandled reader/sender exception), resume with replay, and rebase
+    its stream onto the new incarnation (detected by the changed peer
+    nonce) so every message lands in one incarnation or the other."""
+
+    def test_kill9_accepting_end_mid_stream(self, tmp_path):
+        from ceph_tpu.msg import EntityAddr
+        from ceph_tpu.procs import DaemonSpec, spawn_daemon
+
+        with __import__("socket").socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        out_path = tmp_path / "victim.out"
+        spec = DaemonSpec(kind="msgr_victim", ident="0",
+                          extra={"port": port,
+                                 "out_path": str(out_path)})
+        h = spawn_daemon(spec, run_dir=str(tmp_path), timeout=20)
+        client = Messenger("client.t")
+        try:
+            con = client.connect_to(EntityAddr("127.0.0.1", port))
+            total = 60
+            for i in range(total):
+                con.send_message(MGenericReply("n", i))
+                time.sleep(0.002)
+                if i == total // 2:
+                    h.kill9()            # mid-stream, no goodbye
+                    h = spawn_daemon(spec, run_dir=str(tmp_path),
+                                     timeout=20)
+            def recorded():
+                try:
+                    return {int(x) for x in
+                            out_path.read_text().split()}
+                except (OSError, ValueError):
+                    return set()
+            assert wait_for(
+                lambda: recorded() >= set(range(total)), timeout=30), \
+                f"missing: {sorted(set(range(total)) - recorded())}"
+            # the death registered as a clean transport fault...
+            assert client.transport_faults > 0
+            # ...and the connection object is still live and usable
+            con.send_message(MGenericReply("n", total))
+            assert wait_for(lambda: total in recorded(), timeout=10)
+        finally:
+            client.shutdown()
+            h.stop()

@@ -1,0 +1,186 @@
+"""Process-parallel cluster runtime — the real-daemon tier.
+
+Every daemon here is its own OS process, spawned from a serializable
+boot spec (``ceph_tpu.procs.DaemonSpec``) and joined over the TCP
+messenger; the parent observes the cluster only through what a real
+operator has (mon commands over the wire, Unix admin sockets, signals,
+readiness files).  Crashes are genuine ``kill -9``: nothing in the
+dying daemon flushes, truncates, or tidies up.
+
+Slow tier only — threaded mode remains the tier-1 default and its
+runtime must not move.
+"""
+
+import os
+import time
+
+import pytest
+
+from ceph_tpu.os_store import CrashInjector
+from ceph_tpu.procs import ProcSpawnError
+from ceph_tpu.vstart import MiniCluster
+
+from test_thrash import RadosModel
+
+pytestmark = pytest.mark.slow
+
+
+class TestKill9Primary:
+    """The acceptance drill: SIGKILL the acting primary mid-workload,
+    watch the mon down-mark it, keep writing at min_size, revive into
+    a fresh process that cold-remounts the same WAL, and deep-scrub
+    byte-verify everything."""
+
+    def test_kill9_primary_mid_write(self):
+        cluster = MiniCluster(n_mons=1, n_osds=3, fault_seed=7,
+                              procs=True)
+        with cluster:
+            r = cluster.rados()
+            r.create_pool("p", pg_num=4, size=2)
+            io = r.open_ioctx("p")
+            model = RadosModel(io, seed=42)
+            for _ in range(20):
+                model.step()
+            cluster.wait_for_clean(timeout=60)
+            victim = cluster.pg_primary("0.0")
+            cluster.crash_osd(victim, hard=True)   # real SIGKILL
+            cluster.wait_for_osd_down(victim, timeout=60)
+            # writes keep completing at min_size while it's down
+            for _ in range(20):
+                model.step()
+            cluster.revive_osd(victim, timeout=60)
+            # the fresh process cold-remounted the same WAL: an
+            # unclean-shutdown replay, not an empty store
+            stats = cluster.osd_replay_stats(victim)
+            assert stats.get("records", 0) > 0
+            assert stats.get("clean_shutdown") is False
+            cluster.wait_for_clean(timeout=120)
+            for pg in range(4):
+                assert cluster.scrub_pg(f"0.{pg:x}", timeout=120,
+                                        deep=True) == 0
+            model.verify_all()
+
+
+class TestSeededKill9:
+    """kill9 is a seeded crash point like the other five: the damage a
+    drill inflicts replays exactly from (seed, osd, point, n), so the
+    parent predicts the surviving record count — CrashInjector
+    .preview() — before ever spawning the process."""
+
+    SEED, PROB = 1234, 0.2
+
+    def test_drill_matches_preview(self):
+        inj = CrashInjector(seed=self.SEED, osd="osd.0")
+        inj.set_prob("kill9", self.PROB)
+        k = inj.preview("kill9", 64).index(True)
+        cluster = MiniCluster(n_mons=1, n_osds=1,
+                              fault_seed=self.SEED, procs=True,
+                              crash_probs={"kill9": self.PROB})
+        with cluster:
+            r = cluster.rados()
+            r.create_pool("p", pg_num=1, size=1)
+            io = r.open_ioctx("p")
+            died = False
+            for i in range(64):
+                try:
+                    io.write_full(f"o{i}", b"x" * 512)
+                except Exception:   # noqa: BLE001 — op timeout = death
+                    died = True
+                    break
+            assert died, "seeded kill9 never fired in 64 writes"
+            handle = cluster._osd_handles[0]
+            assert not handle.alive(), \
+                "store reported failure but the process survived"
+            # reap the corpse, then revive WITHOUT the crash prob (the
+            # injector counter restarts per process, so the same seed
+            # would kill the revived OSD at the same occurrence)
+            cluster.crash_osd(0, hard=True)
+            cluster.crash_probs = {}
+            cluster.revive_osd(0, timeout=60)
+            stats = cluster.osd_replay_stats(0)
+            # SIGKILL loses process state, not written state: exactly
+            # the k appends that happened before the verdict fired are
+            # all there after the cold replay — same damage report the
+            # parent computed from the seed alone
+            assert stats.get("records") == k
+            assert stats.get("clean_shutdown") is False
+
+
+class TestSpawnFailure:
+    """Spawn retry-with-timeout and the sticky-failure degradation:
+    an OSD that exhausts its retry budget stays failed (the
+    OSD_STORE_ERROR pattern) instead of flapping forever."""
+
+    def test_unspawnable_osd_goes_sticky(self):
+        cluster = MiniCluster(n_mons=1, n_osds=1, procs=True)
+        # an unopenable WAL path: the child dies at store mount on
+        # every attempt
+        cluster._wal_paths[0] = "/nonexistent-dir/osd.0.wal"
+        try:
+            cluster.start(timeout=60)
+            pytest.fail("spawn should have failed")
+        except ProcSpawnError as e:
+            assert "osd.0" in str(e)
+        assert "osd.0" in cluster.spawn_failures
+        # second attempt fails FAST from the sticky record — no fresh
+        # retry storm against a store that cannot mount
+        t0 = time.monotonic()
+        with pytest.raises(ProcSpawnError, match="sticky"):
+            cluster.start_osd(0)
+        assert time.monotonic() - t0 < 1.0
+        cluster.stop()
+
+
+class TestPowerLossRoutesThroughCrash:
+    """MiniCluster.power_loss() in procs mode is N real process
+    deaths + N fresh-process cold remounts — one code path with
+    crash_osd/revive_osd, not a parallel implementation."""
+
+    def test_cluster_power_loss_procs(self):
+        cluster = MiniCluster(n_mons=1, n_osds=2, fault_seed=3,
+                              procs=True)
+        with cluster:
+            r = cluster.rados()
+            r.create_pool("p", pg_num=2, size=2)
+            io = r.open_ioctx("p")
+            for i in range(8):
+                io.write_full(f"o{i}", bytes([i]) * 2048)
+            cluster.wait_for_clean(timeout=60)
+            old_pids = {i: h.pid
+                        for i, h in cluster._osd_handles.items()}
+            report = cluster.power_loss(revive=True, timeout=60)
+            assert set(report) == {0, 1}
+            for i, stats in report.items():
+                assert stats.get("records", 0) > 0, \
+                    f"osd.{i} replayed nothing"
+                assert stats.get("clean_shutdown") is False
+                # genuinely fresh processes, not warm revives
+                assert cluster._osd_handles[i].pid != old_pids[i]
+            cluster.wait_for_clean(timeout=120)
+            for i in range(8):
+                assert io.read(f"o{i}") == bytes([i]) * 2048
+
+
+class TestOrphanReaper:
+    """The always-on reaper contract: a cluster that is never stopped
+    still leaves zero processes behind once reap_orphans runs — and
+    live_pids() is the audit the conftest session fixture asserts on."""
+
+    def test_reap_orphans_kills_strays(self):
+        from ceph_tpu import procs
+        cluster = MiniCluster(n_mons=1, n_osds=1, procs=True)
+        cluster.start(timeout=60)
+        pids = [h.pid for h in cluster._mon_handles.values()]
+        pids += [h.pid for h in cluster._osd_handles.values()]
+        assert pids and all(p in procs.live_pids() for p in pids)
+        # simulate an abandoned cluster: no stop(), just the sweep
+        reaped = procs.reap_orphans()
+        assert set(pids) <= set(reaped)
+        for p in pids:
+            with pytest.raises(OSError):
+                os.kill(p, 0)   # gone, not zombie: reaped by wait()
+        # bookkeeping is clean for the session fixture's assert
+        assert procs.live_pids() == []
+        cluster._mon_handles.clear()
+        cluster._osd_handles.clear()
+        cluster.stop()
