@@ -1142,6 +1142,45 @@ def _dataplane_leg(on_tpu: bool):
                 else off.submit_encode(ec, p)).result()
         assert comps[j].result() == want, "batched result diverged"
 
+    # flight-recorder tax: same interleaved A/B scheme as the profiler
+    # leg — per-op note() marks plus a per-round snap() through the
+    # live engine, recorder enabled vs disabled.  note() is one deque
+    # append and snap() one framed write per round, so the always-on
+    # acceptance bar is <2%.
+    import tempfile
+
+    from ceph_tpu.core.flight_recorder import FlightRecorder
+    with tempfile.TemporaryDirectory() as td:
+        fr = FlightRecorder(os.path.join(td, "bench.bbox"),
+                            daemon="bench")
+        fr.open()
+        bb_batch, bb_rounds = 16, 10
+        bb_elapsed = {False: 0.0, True: 0.0}
+        for rnd in range(bb_rounds):
+            order = (False, True) if rnd % 2 == 0 else (True, False)
+            for recorded in order:
+                fr.enabled = recorded
+                t0 = time.monotonic()
+                # submit the round, flush once, then collect: this
+                # bench engine has no deadline timer (the OSD's tick
+                # provides one in vivo), so a lone op would otherwise
+                # sit pending forever
+                round_comps = []
+                for j in range(bb_batch):
+                    p = payloads[j % len(payloads)]
+                    fr.note("op", j=j, b=len(p))
+                    round_comps.append(eng.submit_encode(ec, p))
+                eng.flush(reason="manual")
+                for comp in round_comps:
+                    comp.result()
+                fr.snap(profiler=prof.aggregate())
+                bb_elapsed[recorded] += time.monotonic() - t0
+        fr.enabled = True
+        fr.close()
+    bb_overhead = 100.0 * (bb_elapsed[True] - bb_elapsed[False]) \
+        / bb_elapsed[False]
+    assert bb_overhead < 2.0, f"black-box overhead {bb_overhead:.2f}%"
+
     eng.stop()
     return {
         "cluster_sustained_GBps": round(sustained, 3),
@@ -1154,6 +1193,7 @@ def _dataplane_leg(on_tpu: bool):
         "megabatch_byte_occupancy_pct": round(
             100.0 * agg["byte_occupancy_ratio"], 1),
         "idle_gap_avg_us": round(1e6 * agg["idle_gap_avg_s"], 1),
+        "blackbox_overhead_pct": round(max(0.0, bb_overhead), 2),
         "flushes": {r: eng.stats[r] for r in
                     ("flush_deadline", "flush_max_ops",
                      "flush_max_bytes") if eng.stats.get(r)},

@@ -282,4 +282,14 @@ def build_options() -> list[Option]:
                "split, bytes, occupancy)"),
         Option("device_profiler_ring_size", int, 1024,
                "launch samples kept per daemon", min=1),
+        # -- black-box flight recorder ------------------------------------
+        Option("osd_blackbox_enable", bool, True,
+               "journal a crash-surviving per-daemon black box next "
+               "to the WAL (spans/clog/perf/profiler tails)"),
+        Option("osd_blackbox_max_bytes", int, 1 << 20,
+               "rotate the black-box sidecar past this size",
+               min=4096),
+        Option("osd_blackbox_tail_events", int, 64,
+               "timeline entries kept per snapshot and carried into "
+               "crash reports", min=1),
     ]

@@ -243,7 +243,14 @@ class MgrDaemon:
         self.admin_socket.register(
             "status", lambda c: {
                 "name": self.name, "state": self.state,
-                "modules": sorted(self.modules)},
+                "modules": sorted(self.modules),
+                # real TCP port of the active exporter (procs-mode
+                # parents discover the /metrics endpoint here) + the
+                # clock pair for cross-process timeline alignment
+                "prometheus_port": getattr(
+                    self.modules.get("prometheus"), "port", None),
+                "clock": {"wall": time.time(),
+                          "mono": time.monotonic()}},
             "daemon status")
         self.admin_socket.register(
             "mgr module ls", lambda c: sorted(self.modules),
@@ -324,8 +331,13 @@ class MgrDaemon:
                 if not self.running:
                     return
                 if self._want_active and self.state != "active":
-                    self.state = "active"
+                    # modules first, THEN announce: the command
+                    # server answers -11 (retryable "not active")
+                    # until the module table is fully built, instead
+                    # of -22 "unknown command" for a module that is
+                    # mid-construction
                     self._start_modules()
+                    self.state = "active"
                 elif not self._want_active and self.state == "active":
                     self.state = "standby"
                     self._stop_modules()

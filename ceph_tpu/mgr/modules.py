@@ -23,6 +23,7 @@ import hashlib
 import json
 import time
 
+from ..core.flight_recorder import CRASH_KEY_PREFIX, crash_id_for
 from .daemon import MgrModule
 
 
@@ -116,21 +117,22 @@ class IostatModule(MgrModule):
 class CrashModule(MgrModule):
     """Crash-report archive (reference ``pybind/mgr/crash``): posts
     are keyed by crash id (timestamp + entity hash), persisted through
-    the mon's config-key store so they survive mgr failover."""
+    the mon's config-key store so they survive mgr failover.  Daemons
+    post directly (an OSD revive writes the config-key itself — the
+    ceph-crash agent path), so the store, not this module, is the
+    source of truth; archiving stamps ``archived`` into the stored
+    JSON, which the mon-side RECENT_CRASH evaluator honors."""
 
     NAME = "crash"
     TICK = 30.0
-    _PREFIX = "mgr/crash/"
+    _PREFIX = CRASH_KEY_PREFIX
 
     def post(self, report: dict) -> str:
         """`ceph crash post` — report must carry entity + backtrace."""
         if "entity" not in report:
             raise ValueError("crash report requires 'entity'")
         stamp = report.setdefault("timestamp", time.time())
-        crash_id = "%s_%s" % (
-            time.strftime("%Y-%m-%d_%H:%M:%S", time.gmtime(stamp)),
-            hashlib.sha1(
-                f"{report['entity']}{stamp}".encode()).hexdigest()[:12])
+        crash_id = crash_id_for(report["entity"], stamp)
         report["crash_id"] = crash_id
         self.ctx.mon_command({
             "prefix": "config-key put",
@@ -145,16 +147,25 @@ class CrashModule(MgrModule):
             return []
         return sorted(k for k in keys if k.startswith(self._PREFIX))
 
-    def ls(self) -> list[dict]:
+    def ls(self, new_only: bool = False) -> list[dict]:
         out = []
         for k in self._keys():
             rc, _, val = self.ctx.mon_command({
                 "prefix": "config-key get", "key": k})
-            if rc == 0 and val:
-                rep = json.loads(val)
-                out.append({"crash_id": rep["crash_id"],
-                            "entity": rep["entity"],
-                            "timestamp": rep["timestamp"]})
+            if rc != 0 or not val:
+                continue
+            rep = json.loads(val)
+            if new_only and rep.get("archived"):
+                continue
+            out.append({
+                # daemon-posted reports carry no crash_id field; the
+                # key suffix IS the id either way
+                "crash_id": rep.get("crash_id",
+                                    k[len(self._PREFIX):]),
+                "entity": rep.get("entity", "?"),
+                "timestamp": rep.get("timestamp"),
+                "crash_point": rep.get("crash_point"),
+                "archived": rep.get("archived")})
         return out
 
     def info(self, crash_id: str) -> dict | None:
@@ -166,6 +177,52 @@ class CrashModule(MgrModule):
     def rm(self, crash_id: str):
         self.ctx.mon_command({
             "prefix": "config-key del", "key": self._PREFIX + crash_id})
+
+    def archive(self, crash_id: str) -> bool:
+        """Silence one report: RECENT_CRASH skips archived entries."""
+        rep = self.info(crash_id)
+        if rep is None:
+            return False
+        rep["archived"] = time.time()
+        self.ctx.mon_command({
+            "prefix": "config-key put",
+            "key": self._PREFIX + crash_id,
+            "val": json.dumps(rep)})
+        return True
+
+    def archive_all(self) -> int:
+        n = 0
+        for row in self.ls(new_only=True):
+            if self.archive(row["crash_id"]):
+                n += 1
+        return n
+
+    def handle_command(self, cmd: dict):
+        """`ceph crash ls|ls-new|info|post|rm|archive|archive-all`."""
+        prefix = cmd.get("prefix", "")
+        if prefix in ("crash ls", "crash ls-new"):
+            return 0, "", self.ls(new_only=prefix.endswith("-new"))
+        if prefix == "crash info":
+            rep = self.info(str(cmd.get("id", "")))
+            if rep is None:
+                return -2, f"no crash {cmd.get('id')!r}", None
+            return 0, "", rep
+        if prefix == "crash post":
+            try:
+                cid = self.post(dict(cmd.get("report") or {}))
+            except ValueError as e:
+                return -22, str(e), None
+            return 0, cid, {"crash_id": cid}
+        if prefix == "crash rm":
+            self.rm(str(cmd.get("id", "")))
+            return 0, "", {"removed": cmd.get("id")}
+        if prefix == "crash archive":
+            if not self.archive(str(cmd.get("id", ""))):
+                return -2, f"no crash {cmd.get('id')!r}", None
+            return 0, "", {"archived": cmd.get("id")}
+        if prefix == "crash archive-all":
+            return 0, "", {"archived": self.archive_all()}
+        return None
 
 
 class TelemetryModule(MgrModule):

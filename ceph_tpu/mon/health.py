@@ -37,6 +37,9 @@ from .service import PaxosService
 # the pure evaluators.
 SCRUB_WARN_INTERVAL = 1.5 * 86400.0
 NEARFULL_RATIO = 0.85    # OSD_NEARFULL: bytes_used / bytes_total
+# RECENT_CRASH: unarchived crash reports younger than this warn
+# (reference mgr/crash warn_recent_interval: two weeks)
+RECENT_CRASH_AGE = 14 * 86400.0
 
 
 # -- evaluators --------------------------------------------------------------
@@ -47,11 +50,14 @@ class HealthContext:
     scale)."""
 
     def __init__(self, *, osdmap, pgmap: PGMap, monmap_ranks=(),
-                 quorum=(), now: float | None = None):
+                 quorum=(), crashes=(), now: float | None = None):
         self.osdmap = osdmap
         self.pgmap = pgmap
         self.monmap_ranks = list(monmap_ranks)
         self.quorum = list(quorum)
+        # crash-report summaries from the mgr/crash config-key
+        # namespace: {"entity", "timestamp", "archived"} each
+        self.crashes = list(crashes)
         self.now = time.time() if now is None else now
         self.total_pgs = sum(p.pg_num for p in osdmap.pools.values())
         self.states = pgmap.states(total_expected=self.total_pgs,
@@ -292,6 +298,26 @@ def _osd_nearfull(ctx):
         [f"osd.{o} is near full ({r:.0%} used)" for o, r in near])
 
 
+@health_check
+def _recent_crash(ctx):
+    # RECENT_CRASH (reference mgr/crash health check): unarchived
+    # crash reports younger than the warn window.  `ceph crash
+    # archive`/`archive-all` stamps them silent; old reports age out.
+    recent = [c for c in getattr(ctx, "crashes", ())
+              if not c.get("archived")
+              and ctx.now - float(c.get("timestamp") or 0.0)
+              < RECENT_CRASH_AGE]
+    if not recent:
+        return None
+    entities = sorted({c.get("entity", "?") for c in recent})
+    return _check(
+        "RECENT_CRASH", "WARN",
+        f"{len(recent)} daemon crash(es) in recent history",
+        [f"{c.get('entity', '?')} crashed at "
+         f"{c.get('timestamp')}" for c in recent],
+        count=len(entities))
+
+
 def evaluate_checks(ctx: HealthContext) -> list[dict]:
     """Run every registered evaluator; order is registration order
     (stable, so reports diff cleanly)."""
@@ -405,7 +431,31 @@ class HealthMonitor(PaxosService):
         return HealthContext(
             osdmap=osdmap, pgmap=mon.pgmap,
             monmap_ranks=mon.monmap.ranks(),
-            quorum=mon.elector.quorum or [], now=now)
+            quorum=mon.elector.quorum or [],
+            crashes=self._crash_summaries(), now=now)
+
+    def _crash_summaries(self) -> list[dict]:
+        """Crash-report summaries straight off the committed
+        config-key store (the mgr crash module's namespace) — the
+        RECENT_CRASH feed needs no mgr round-trip."""
+        from ..core.flight_recorder import CRASH_KEY_PREFIX
+        cfg = self.mon.services.get("config")
+        if cfg is None:
+            return []
+        out = []
+        for key in self.mon.store.keys(cfg.prefix):
+            if not key.startswith(CRASH_KEY_PREFIX):
+                continue
+            blob = self.mon.store.get_str(cfg.prefix, key)
+            try:
+                rep = json.loads(blob or "")
+            except ValueError:
+                continue
+            if isinstance(rep, dict):
+                out.append({"entity": rep.get("entity"),
+                            "timestamp": rep.get("timestamp"),
+                            "archived": rep.get("archived")})
+        return out
 
     def _compose(self, checks: list[dict]) -> dict:
         active, muted = [], []

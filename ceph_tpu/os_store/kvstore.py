@@ -66,6 +66,9 @@ class WALStore(MemStore):
         self._sync_mode = sync_mode
         self.crash = crash
         self.compact_min_records = int(compact_min_records)
+        # optional black box (core.flight_recorder.FlightRecorder):
+        # crash points announce themselves to it before the verdict
+        self.flight_recorder = None
         self.on_error: Callable | None = None
         self.replay_stats: dict | None = None
         self.wal_stats = collections.Counter()
@@ -284,6 +287,9 @@ class WALStore(MemStore):
                 self._records += 1
                 self.wal_stats["records"] += 1
                 self.wal_stats["bytes"] += len(rec)
+                fr = self.flight_recorder
+                if fr is not None:
+                    fr.note("txn", seq=self._records, b=len(rec))
                 self._crash_point("post_append_pre_fsync")
                 if self._sync_mode == "always":
                     os.fsync(self._wal.fileno())
@@ -315,7 +321,23 @@ class WALStore(MemStore):
 
     def _crash_point(self, point: str, rec: bytes = b""):
         inj = self.crash
-        if inj is None or not inj.decide(point):
+        if inj is None:
+            return
+        fr = self.flight_recorder
+        if fr is not None and fr.enabled:
+            # preview the pure verdict BEFORE consuming it: when this
+            # occurrence will fire, the black box gets a flushed
+            # crash-imminent event the post-mortem can match against
+            # CrashInjector.preview().  Unconfigured points
+            # short-circuit without touching the RNG, so the always-on
+            # cost is one attribute check per crash point.
+            try:
+                if inj.preview(point, 1)[0]:
+                    fr.event("crash_point", point=point,
+                             n=inj.counters.get(point, 0))
+            except Exception:   # noqa: BLE001 — never fail a write
+                pass            # over black-box bookkeeping
+        if not inj.decide(point):
             return
         if point == "kill9" and os.environ.get("CEPH_TPU_PROC_DAEMON"):
             # real process death: no truncation, no exception — the

@@ -292,6 +292,25 @@ class OSDaemon(Dispatcher):
                 "osd_wal_compact_min_records",
                 lambda _n, v: setattr(self.store,
                                       "compact_min_records", int(v)))
+        # black-box flight recorder: a crash-surviving sidecar next to
+        # the WAL journaling the observability tails (spans/clog/perf/
+        # profiler/injector), readable offline from a dead process
+        self.flight_recorder = None
+        self._crash_report_id: str | None = None
+        store_path = getattr(self.store, "_path", None)
+        if store_path and bool(self.config.get("osd_blackbox_enable")):
+            from ..core.flight_recorder import FlightRecorder
+            self.flight_recorder = FlightRecorder(
+                store_path + ".bbox", daemon=f"osd.{whoami}",
+                max_bytes=int(
+                    self.config.get("osd_blackbox_max_bytes")),
+                tail_events=int(
+                    self.config.get("osd_blackbox_tail_events")))
+            self.store.flight_recorder = self.flight_recorder
+            self.config.add_observer(
+                "osd_blackbox_enable",
+                lambda _n, v: setattr(self.flight_recorder,
+                                      "enabled", bool(v)))
         self.auth = auth
         # fault fabric: the messenger's injector is built from the
         # ms_inject_* options and stays retunable while the daemon
@@ -388,6 +407,13 @@ class OSDaemon(Dispatcher):
         # op tracing surface (reference `dump_tracing` / blkin):
         # `trace start|stop` rides one registration — the dispatcher
         # hands the full prefix through, so parse the verb here
+        # clock header for cross-process merging: span starts and
+        # black-box stamps are this process's monotonic clock; readers
+        # rebase them onto the wall clock with this pair (the same
+        # alignment procs.write_ready stamps into readiness files)
+        def _clock():
+            return {"wall": time.time(), "mono": time.monotonic()}
+
         def _dump_tracing(c):
             spans = self.tracer.dump()
             if c.get("format") == "otlp":
@@ -395,6 +421,7 @@ class OSDaemon(Dispatcher):
                 return otlp_trace(spans)
             return {"enabled": self.tracer.enabled,
                     "num_spans": len(self.tracer),
+                    "clock": _clock(),
                     "spans": spans}
         a.register("dump_tracing", _dump_tracing,
                    "collected spans (format=otlp for OTLP JSON)")
@@ -416,13 +443,28 @@ class OSDaemon(Dispatcher):
         def _profiler_ctl(c):
             verb = c.get("prefix", "").split()[-1]
             if verb == "dump":
-                return self.profiler.dump()
+                d = self.profiler.dump()
+                d["clock"] = _clock()
+                return d
             if verb == "reset":
                 self.profiler.reset()
                 return {"success": "profiler reset"}
             return {"error": "usage: profiler dump|reset"}
         a.register("profiler", _profiler_ctl,
                    "profiler dump|reset — per-launch device profiles")
+
+        def _blackbox(c):
+            verb = c.get("prefix", "").split()[-1]
+            fr = self.flight_recorder
+            if fr is None:
+                return {"enabled": False,
+                        "error": "no black box (RAM store)"}
+            if verb == "snap":
+                self._blackbox_snap()
+            return {"clock": _clock(), **fr.stats()}
+        a.register("blackbox", _blackbox,
+                   "blackbox dump|snap — flight-recorder state "
+                   "(snap forces a snapshot now)")
         a.register("dump_batch_engine",
                    lambda c: self.batch_engine.dump(),
                    "coalescing data-plane counters + flush config")
@@ -494,6 +536,14 @@ class OSDaemon(Dispatcher):
             self.clog.info(
                 f"osd.{self.whoami} unclean shutdown detected: "
                 f"replayed {rs.get('records', 0)} WAL records{note}")
+        prior_crash = None
+        if self.flight_recorder is not None:
+            try:
+                prior_crash = self.flight_recorder.open()
+            except OSError:
+                # an unwritable sidecar must not stop the daemon
+                self.store.flight_recorder = None
+                self.flight_recorder = None
         self.admin_socket.start()
         self.addr = self.msgr.bind()
         self.running = True
@@ -521,8 +571,66 @@ class OSDaemon(Dispatcher):
                 time.sleep(0.02)
             else:
                 raise TimeoutError(f"osd.{self.whoami} never came up")
+        if prior_crash is not None:
+            # the previous incarnation died with its black box dirty:
+            # post the synthesized report now that the mon is
+            # reachable (reference: the ceph-crash agent posts on the
+            # next boot, not at the moment of death)
+            self._post_crash_report(prior_crash)
         self._tick_token = self.timer.add_event_after(
             self._hb_interval, self._tick)
+
+    # -- black box / crash post-mortem -------------------------------------
+    def _blackbox_snap(self):
+        """One flight-recorder snapshot: the observability tails this
+        daemon would want read back from its corpse."""
+        fr = self.flight_recorder
+        if fr is None or not fr.enabled:
+            return
+        try:
+            inj = getattr(self.store, "crash", None)
+            fr.snap(
+                spans=self.tracer.dump()[-fr.tail_spans:],
+                clog=self.clog.last(fr.tail_clog),
+                perf=self.perf.dump(),
+                profiler=self.profiler.aggregate(),
+                crash=inj.describe() if inj is not None else None)
+        except Exception:   # noqa: BLE001 — the black box must never
+            pass            # take the daemon down
+
+    def _post_crash_report(self, info: dict):
+        """Synthesize a crash report from the dead incarnation's black
+        box and post it into the mgr crash module's config-key
+        namespace (reference ceph-crash agent → `ceph crash post`)."""
+        from ..core.flight_recorder import (CRASH_KEY_PREFIX,
+                                            crash_id_for)
+        entity = f"osd.{self.whoami}"
+        stamp = time.time()
+        tail_n = int(self.config.get("osd_blackbox_tail_events"))
+        report = {
+            "entity": entity,
+            "timestamp": stamp,
+            "boot_nonce": info.get("nonce"),
+            "crash_pid": info.get("pid"),
+            "crash_point": info.get("crash_point"),
+            "timeline": (info.get("events") or [])[-tail_n:],
+            "replay_stats": getattr(self.store, "replay_stats", None),
+            "blackbox_tail": info.get("tail"),
+        }
+        crash_id = crash_id_for(entity, stamp)
+        try:
+            rc, _outs, _ = self.monc.command(
+                {"prefix": "config-key put",
+                 "key": CRASH_KEY_PREFIX + crash_id,
+                 "val": json.dumps(report, default=str)},
+                timeout=5.0)
+        except Exception:   # noqa: BLE001 — the post-mortem is
+            return          # advisory; boot continues without it
+        if rc == 0:
+            self._crash_report_id = crash_id
+            self.clog.warn(
+                f"{entity} previous instance crashed uncleanly; "
+                f"posted crash report {crash_id}")
 
     # -- cache-tier agent --------------------------------------------------
     def _tier_rados(self):
@@ -641,6 +749,12 @@ class OSDaemon(Dispatcher):
             self._tier_client = None
         self.monc.shutdown()
         self.msgr.shutdown()
+        if self.flight_recorder is not None:
+            try:
+                self._blackbox_snap()
+                self.flight_recorder.close()
+            except OSError:
+                pass
         self.store.umount()
 
     def _on_store_error(self, exc):
@@ -1058,6 +1172,7 @@ class OSDaemon(Dispatcher):
                 self._stats_last = now
                 self._report_pg_stats()
                 self._maybe_clog_health()
+                self._blackbox_snap()
                 self.clog.flush()
         if self.running:
             self._tick_token = self.timer.add_event_after(

@@ -291,13 +291,19 @@ def spawn_daemon(spec: DaemonSpec, *, retries: int = 2,
 
 def write_ready(spec: DaemonSpec) -> None:
     """Atomic readiness handshake (child side): tmp + rename so the
-    parent never reads a torn file."""
+    parent never reads a torn file.  The wall/mono clock pair is this
+    process's monotonic-to-wall alignment — the parent rebases the
+    child's span starts and black-box stamps with it when merging
+    cross-process timelines (asok dump headers carry the same pair,
+    fresher; the readiness file is the fallback that survives the
+    daemon's death)."""
     if not spec.ready_path:
         return
     tmp = spec.ready_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump({"pid": os.getpid(), "ident": spec.ident,
-                   "kind": spec.kind}, f)
+                   "kind": spec.kind, "wall": time.time(),
+                   "mono": time.monotonic()}, f)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, spec.ready_path)

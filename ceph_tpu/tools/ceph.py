@@ -6,6 +6,8 @@
     ceph -m ... health detail | health history
     ceph -m ... health mute CODE [TTL_SECONDS] [--sticky]
     ceph -m ... health unmute CODE
+    ceph -m ... crash ls|ls-new|archive-all | crash info|rm|archive ID
+        (mgr crash archive — post-mortems from revived daemons)
     ceph -m ... progress [json]   (mgr progress events)
     ceph -m ... iostat [json]     (live rates from the telemetry spine)
     ceph -m ... osd perf [json]   (commit latency + device launches)
@@ -123,6 +125,22 @@ def _dispatch(args, rest) -> int:
             cmd = {"prefix": f"device {rest[1]}"}
             if rest[1] == "info" and len(rest) > 2:
                 cmd["devid"] = rest[2]
+            return _run_mgr_command(mc, cmd)
+        if rest[0] == "crash":
+            # mgr-hosted crash archive (reference `ceph crash ...`)
+            usage = ("usage: ceph crash ls|ls-new|archive-all | "
+                     "crash info|rm|archive ID")
+            verb = rest[1] if len(rest) > 1 else "ls"
+            if verb not in ("ls", "ls-new", "info", "rm", "archive",
+                            "archive-all"):
+                print(usage, file=sys.stderr)
+                return 1
+            cmd = {"prefix": f"crash {verb}"}
+            if verb in ("info", "rm", "archive"):
+                if len(rest) < 3:
+                    print(usage, file=sys.stderr)
+                    return 1
+                cmd["id"] = rest[2]
             return _run_mgr_command(mc, cmd)
         if rest[0] == "orch":
             # mgr-hosted orchestrator commands (reference `ceph orch`

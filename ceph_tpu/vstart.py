@@ -341,6 +341,64 @@ class MiniCluster:
         return dict(getattr(self.osds[i].store, "replay_stats",
                             None) or {})
 
+    def _mgr_cmd(self, cmd: dict):
+        """Active-mgr command over the wire (both modes — threaded
+        mgrs serve the same messenger a procs-mode parent talks to)."""
+        rc, outs, out = self._admin_rados().mgr_command(cmd)
+        if rc != 0:
+            raise RuntimeError(
+                f"mgr command {cmd.get('prefix')!r} failed "
+                f"rc={rc}: {outs}")
+        return out
+
+    def profiler_dump(self, i: int) -> dict:
+        """One OSD's device-profiler dump — same asok command in both
+        modes; threaded just short-circuits the socket."""
+        if self.procs:
+            from .core.admin_socket import admin_command
+            return admin_command(self._osd_asoks[i], "profiler dump")
+        d = self.osds[i].profiler.dump()
+        d["clock"] = {"wall": time.time(), "mono": time.monotonic()}
+        return d
+
+    def telemetry_series(self, daemon: str | None = None) -> dict:
+        """TelemetrySpine ring dump via the active mgr's command
+        server (`ceph telemetry series`) — identical over threaded
+        and procs clusters."""
+        cmd: dict = {"prefix": "telemetry series"}
+        if daemon is not None:
+            cmd["daemon"] = daemon
+        return self._mgr_cmd(cmd) or {}
+
+    def prometheus_port(self) -> int | None:
+        """TCP port of the active mgr's /metrics exporter (procs
+        parents discover it through the mgr asok)."""
+        if self.procs:
+            from .core.admin_socket import admin_command
+            for name, asok in self._mgr_asoks.items():
+                if name not in self._mgr_handles:
+                    continue
+                try:
+                    st = admin_command(asok, "status", timeout=2.0)
+                except OSError:
+                    continue
+                if st.get("state") == "active" \
+                        and st.get("prometheus_port"):
+                    return int(st["prometheus_port"])
+            return None
+        for mgr in self.mgrs.values():
+            if mgr.state == "active":
+                mod = mgr.modules.get("prometheus")
+                if mod is not None:
+                    return mod.port
+        return None
+
+    def blackbox_path(self, i: int) -> str:
+        """Flight-recorder sidecar path for one OSD — readable
+        offline (tools/blackbox_tool.py) even while the daemon is a
+        corpse, since WAL paths persist across crash/revive."""
+        return self._wal_path(i) + ".bbox"
+
     def pg_primary(self, pgid) -> int:
         """Acting-primary OSD id for one PG (procs: authoritative map
         via `osd dump`; threaded: the live daemons)."""
@@ -432,6 +490,15 @@ class MiniCluster:
         osd.admin_socket.shutdown()
         osd.monc.shutdown()
         osd.msgr.shutdown()
+        # a kill is the harness's controlled hard-stop, not a crash
+        # drill (that's crash_osd) — close the black box cleanly so
+        # the revive doesn't synthesize a crash report and trip
+        # RECENT_CRASH
+        if osd.flight_recorder is not None:
+            try:
+                osd.flight_recorder.close()
+            except Exception:   # noqa: BLE001 — recorder never
+                pass            # blocks a kill
         # deliberately NOT umounting: a revive remounts the same store
         if self._osd_stores is None:
             self._osd_stores = {}
@@ -1033,16 +1100,45 @@ class MiniCluster:
     def collect_trace(self, trace_id: str,
                       format: str = "spans"):
         """Merge one trace's spans from every daemon and client ring,
-        ordered by start time (all daemons share this process, so the
-        monotonic starts are directly comparable).
+        ordered by start time.
+
+        Threaded mode reads the in-process rings directly (one shared
+        monotonic clock).  Procs mode fetches ``dump_tracing`` over
+        each OSD's Unix asok and rebases every child's monotonic span
+        starts onto THIS process's monotonic clock using the wall/mono
+        pair in the dump header — so spans from N real processes merge
+        into one chronologically consistent trace and the downstream
+        formatters apply the same single wall-clock offset either way.
 
         ``format="spans"`` (default) returns the raw span dicts —
         feed them to ``core.tracer.chrome_trace`` for chrome://tracing;
         ``format="otlp"`` returns the OTLP/JSON resource/scope/span
         shape; ``format="chrome"`` the Chrome trace_event JSON."""
         spans: list[dict] = []
-        for osd in self.osds.values():
-            spans.extend(osd.tracer.spans_for(trace_id))
+        if self.procs:
+            from .core.admin_socket import admin_command
+            local_off = time.time() - time.monotonic()
+            for i, asok in sorted(self._osd_asoks.items()):
+                if i not in self._osd_handles:
+                    continue
+                try:
+                    out = admin_command(asok, "dump_tracing",
+                                        timeout=5.0)
+                except OSError:
+                    continue    # mid-crash daemon: skip, don't fail
+                clk = out.get("clock") or {}
+                child_off = (float(clk.get("wall", 0.0))
+                             - float(clk.get("mono", 0.0)))
+                for s in out.get("spans") or []:
+                    if s.get("trace_id") != trace_id:
+                        continue
+                    s = dict(s)
+                    s["start"] = (s["start"] + child_off
+                                  - local_off)
+                    spans.append(s)
+        else:
+            for osd in self.osds.values():
+                spans.extend(osd.tracer.spans_for(trace_id))
         for r in self._clients:
             if r.objecter is not None:
                 spans.extend(r.objecter.tracer.spans_for(trace_id))

@@ -1,0 +1,307 @@
+"""Black-box flight recorder + crash post-mortem pipeline.
+
+The observability contract under test: every daemon journals what it
+was doing to a crash-surviving sidecar, a parent (or the offline
+tool) reconstructs a dead daemon's last seconds from the raw bytes
+alone, and a revived daemon turns that corpse into a `ceph crash`
+report the mon's RECENT_CRASH health check surfaces until archived
+(reference ``pybind/mgr/crash`` + the ceph-crash agent; the sidecar
+framing is the WAL's own tolerate-corrupted-tail CRC scheme).
+"""
+
+import contextlib
+import io
+import json
+import os
+import time
+
+import pytest
+
+from ceph_tpu.core import flight_recorder
+from ceph_tpu.core.flight_recorder import (FlightRecorder, _perf_delta,
+                                           crash_id_for)
+from ceph_tpu.os_store import CrashInjector, walog
+from ceph_tpu.tools import blackbox_tool
+from ceph_tpu.vstart import MiniCluster
+
+
+# ---------------------------------------------------------------------------
+# unit: recorder lifecycle, framing, crash detection
+# ---------------------------------------------------------------------------
+class TestFlightRecorderUnit:
+    def test_clean_lifecycle_roundtrip(self, tmp_path):
+        p = str(tmp_path / "d.bbox")
+        fr = FlightRecorder(p, daemon="osd.9")
+        assert fr.open() is None
+        fr.note("txn", seq=1)
+        fr.note("txn", seq=2)
+        fr.event("marker", why="test")
+        fr.snap(clog=[{"message": "hello"}],
+                perf={"osd": {"op": 3}})
+        fr.close()
+        # dirty marker gone after a clean close
+        assert not os.path.exists(p + ".dirty")
+        tl = flight_recorder.timeline(p)
+        kinds = [e["type"] for e in tl]
+        assert kinds[0] == "boot" and kinds[-1] == "close"
+        assert kinds.count("mark") == 2
+        assert any(e["type"] == "event" and e["name"] == "marker"
+                   for e in tl)
+        info = flight_recorder.crash_info(p)
+        assert info["clean_close"] is True
+        assert info["daemon"] == "osd.9"
+        assert info["crash_point"] is None
+
+    def test_note_is_memory_only_until_snap(self, tmp_path):
+        fr = FlightRecorder(str(tmp_path / "d.bbox"))
+        fr.open()
+        before = fr.stats()["records"]
+        for i in range(100):
+            fr.note("op", i=i)
+        assert fr.stats()["records"] == before      # no I/O yet
+        assert fr.stats()["pending_marks"] == 100
+        fr.snap()
+        assert fr.stats()["pending_marks"] == 0
+        fr.close()
+
+    def test_disabled_recorder_is_inert(self, tmp_path):
+        p = str(tmp_path / "d.bbox")
+        fr = FlightRecorder(p, enabled=False)
+        fr.note("x")
+        fr.event("y")
+        fr.snap()
+        assert fr.stats()["records"] == 0
+        assert fr.stats()["pending_marks"] == 0
+
+    def test_unclean_death_detected_and_corpse_preserved(
+            self, tmp_path):
+        p = str(tmp_path / "d.bbox")
+        fr = FlightRecorder(p, daemon="osd.3")
+        fr.open()
+        fr.event("crash_point", point="kill9", n=7)
+        # no close(): the dirty marker survives like after SIGKILL
+        fr2 = FlightRecorder(p, daemon="osd.3")
+        prior = fr2.open()
+        assert prior is not None
+        assert prior["daemon"] == "osd.3"
+        assert prior["crash_point"] == {"point": "kill9", "n": 7}
+        assert prior["clean_close"] is False
+        # dead incarnation parked for offline autopsy; new file fresh
+        assert os.path.exists(p + ".crash")
+        info = flight_recorder.crash_info(p + ".crash")
+        assert info["crash_point"] == {"point": "kill9", "n": 7}
+        fr2.close()
+        assert flight_recorder.crash_info(p)["clean_close"] is True
+
+    def test_torn_tail_tolerated_not_fatal(self, tmp_path):
+        p = str(tmp_path / "d.bbox")
+        fr = FlightRecorder(p)
+        fr.open()
+        fr.event("before_tear")
+        fr.close()
+        with open(p, "ab") as f:      # half a record: torn by power
+            f.write(walog.MAGIC + b"\x40\x00")
+        tl = flight_recorder.timeline(p)
+        assert tl[-1]["type"] == "torn_tail"
+        assert tl[-1]["tail"]["status"] != "clean"
+        assert any(e["type"] == "event"
+                   and e["name"] == "before_tear" for e in tl)
+        assert flight_recorder.crash_info(p)["tail"]["status"] \
+            != "clean"
+
+    def test_rotation_stitches_generations(self, tmp_path):
+        p = str(tmp_path / "d.bbox")
+        fr = FlightRecorder(p, max_bytes=512)
+        fr.open()
+        for i in range(40):
+            fr.event("e", i=i)
+            fr.snap()
+        fr.close()
+        assert os.path.exists(p + ".old")
+        tl = flight_recorder.timeline(p)
+        assert any(e["type"] == "boot" and e.get("rotated")
+                   for e in tl)
+        # readers stitch .old + current: recent events all present
+        seen = [e["i"] for e in tl if e["type"] == "event"]
+        assert seen == sorted(seen) and seen[-1] == 39
+
+    def test_timeline_stamps_are_wall_clock(self, tmp_path):
+        p = str(tmp_path / "d.bbox")
+        fr = FlightRecorder(p)
+        t0 = time.time()
+        fr.open()
+        fr.event("now")
+        fr.close()
+        tl = flight_recorder.timeline(p)
+        for e in tl:
+            assert abs(e["stamp"] - t0) < 60.0, e
+
+    def test_perf_delta_shapes(self):
+        prev = {"osd": {"op": 5, "lat": {"avgcount": 2, "sum": 1.0}}}
+        cur = {"osd": {"op": 9, "lat": {"avgcount": 5, "sum": 2.5},
+                       "hist": {"axes": []}},
+               "new_section": {"x": 1}}
+        d = _perf_delta(prev, cur)
+        assert d["osd"]["op"] == 4
+        assert d["osd"]["lat"] == {"avgcount": 3, "sum": 1.5}
+        assert "hist" not in d["osd"]       # non-counter skipped
+        assert d["new_section"] == {"x": 1}
+        assert _perf_delta(cur, cur) == {}  # no movement, no noise
+
+    def test_crash_id_scheme(self):
+        a = crash_id_for("osd.1", 1700000000.0)
+        b = crash_id_for("osd.1", 1700000000.0)
+        c = crash_id_for("osd.2", 1700000000.0)
+        assert a == b and a != c
+        assert a.startswith("2023-11-14_")
+
+
+# ---------------------------------------------------------------------------
+# offline tool
+# ---------------------------------------------------------------------------
+class TestBlackboxTool:
+    def _dead_box(self, tmp_path):
+        p = str(tmp_path / "w.bbox")
+        fr = FlightRecorder(p, daemon="osd.5")
+        fr.open()
+        fr.note("txn", seq=1)
+        fr.snap()
+        fr.event("crash_point", point="pre_append", n=4)
+        return p                      # never closed: died dirty
+
+    def _run(self, argv):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = blackbox_tool.main(argv)
+        return rc, buf.getvalue()
+
+    def test_timeline_json(self, tmp_path):
+        p = self._dead_box(tmp_path)
+        rc, out = self._run(["--path", p, "--op", "timeline",
+                             "--json"])
+        assert rc == 0
+        entries = json.loads(out)
+        ev = [e for e in entries if e["type"] == "event"]
+        assert ev[-1]["name"] == "crash_point"
+        assert ev[-1]["point"] == "pre_append" and ev[-1]["n"] == 4
+
+    def test_timeline_human_and_tail(self, tmp_path):
+        p = self._dead_box(tmp_path)
+        rc, out = self._run(["--path", p, "--op", "timeline",
+                             "--tail", "1"])
+        assert rc == 0
+        lines = out.strip().splitlines()
+        assert len(lines) == 1 and "crash_point" in lines[0]
+
+    def test_info(self, tmp_path):
+        p = self._dead_box(tmp_path)
+        rc, out = self._run(["--path", p, "--op", "info", "--json"])
+        assert rc == 0
+        info = json.loads(out)
+        assert info["daemon"] == "osd.5"
+        assert info["clean_close"] is False
+        assert info["crash_point"]["point"] == "pre_append"
+
+    def test_missing_box_errors(self, tmp_path):
+        rc, _ = self._run(["--path", str(tmp_path / "nope.bbox")])
+        assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# cluster: seeded drill → offline post-mortem → crash report → health
+# ---------------------------------------------------------------------------
+class TestSeededCrashPostMortem:
+    """The tier-1 (threaded) variant of the procs kill9 drill: a
+    seeded crash point fires mid-workload, the parent autopsies the
+    black box offline and finds the exact armed occurrence the
+    injector schedule predicted, and the revive turns the corpse into
+    a `ceph crash` report that RECENT_CRASH surfaces until archived.
+    Threaded kill9 degrades to a simulated power cut at the same
+    seeded occurrence, so the predicted schedule is identical."""
+
+    SEED, PROB = 4321, 0.15
+
+    def test_drill_post_mortem_and_crash_pipeline(self):
+        inj = CrashInjector(seed=self.SEED, osd="osd.0")
+        inj.set_prob("kill9", self.PROB)
+        k = inj.preview("kill9", 256).index(True)
+        c = MiniCluster(n_mons=1, n_osds=1, fault_seed=self.SEED,
+                        crash_probs={"kill9": self.PROB})
+        c.start()
+        try:
+            r = c.rados()
+            r.create_pool("p", pg_num=1, size=1)
+            io_ = r.open_ioctx("p")
+            live = c.osds[0].store.crash
+            deadline = time.monotonic() + 60
+            i = 0
+            while not live.fired:
+                assert time.monotonic() < deadline, \
+                    "seeded kill9 never fired"
+                try:
+                    io_.write_full(f"o{i}", b"x" * 256)
+                except Exception:   # noqa: BLE001 — victim died
+                    break           # mid-op; no ack, no claim
+                i += 1
+            c.crash_osd(0, hard=True)
+
+            # -- offline post-mortem, daemon is a corpse ----------
+            bbox = c.blackbox_path(0)
+            info = flight_recorder.crash_info(bbox)
+            assert info["clean_close"] is False
+            # the final recorded *event* is the armed crash point at
+            # exactly the occurrence the parent predicted from the
+            # seed alone (ticker snaps may trail it in threaded mode)
+            assert info["crash_point"] == {"point": "kill9", "n": k}
+            events = [e for e in flight_recorder.timeline(bbox)
+                      if e["type"] == "event"]
+            assert events[-1]["name"] == "crash_point"
+            assert events[-1]["point"] == "kill9"
+            assert events[-1]["n"] == k
+
+            # -- revive: boot detects the dirty box, posts a report
+            c.crash_probs = {}      # same seed would re-kill at k
+            osd = c.revive_osd(0, timeout=60)
+            assert os.path.exists(bbox + ".crash")
+            assert osd._crash_report_id is not None
+
+            # -- `ceph crash` surface over the mgr ----------------
+            c.start_mgr("x")
+            c.wait_for_active_mgr()
+            rc, _, ls = r.mgr_command({"prefix": "crash ls"})
+            assert rc == 0
+            row = next(e for e in ls
+                       if e["crash_id"] == osd._crash_report_id)
+            assert row["entity"] == "osd.0"
+            assert row["crash_point"] == {"point": "kill9", "n": k}
+            assert not row["archived"]
+            rc, _, rep = r.mgr_command(
+                {"prefix": "crash info",
+                 "id": osd._crash_report_id})
+            assert rc == 0
+            assert rep["boot_nonce"] == info["nonce"]
+            assert rep["timeline"], "report carries no timeline"
+            assert rep["replay_stats"]["clean_shutdown"] is False
+
+            # -- RECENT_CRASH raises, archive-all clears ----------
+            def health_codes():
+                rc2, _, h = r.mon_command(
+                    {"prefix": "health detail"})
+                assert rc2 == 0
+                return {chk["code"] for chk in h.get("checks", [])}
+            deadline = time.monotonic() + 30
+            while "RECENT_CRASH" not in health_codes():
+                assert time.monotonic() < deadline, health_codes()
+                time.sleep(0.2)
+            rc, _, out = r.mgr_command(
+                {"prefix": "crash archive-all"})
+            assert rc == 0 and out["archived"] >= 1
+            deadline = time.monotonic() + 30
+            while "RECENT_CRASH" in health_codes():
+                assert time.monotonic() < deadline, \
+                    "RECENT_CRASH never cleared after archive-all"
+                time.sleep(0.2)
+            rc, _, ls = r.mgr_command({"prefix": "crash ls-new"})
+            assert rc == 0 and ls == []
+        finally:
+            c.stop()

@@ -147,3 +147,41 @@ class TestCephCLI:
             assert "audit" not in out
         finally:
             r.mon_command({"prefix": "osd unset", "key": "noout"})
+
+
+class TestCrashCLI:
+    """`ceph crash ...` drives the mgr crash archive end to end."""
+
+    def test_crash_archive_lifecycle(self, cluster):
+        c = cluster
+        c.start_mgr("cli")
+        c.wait_for_active_mgr()
+        r = c.rados(name="client.crash-cli")
+        rc, cid, _ = r.mgr_command({
+            "prefix": "crash post",
+            "report": {"entity": "osd.2",
+                       "crash_point": {"point": "kill9", "n": 5}}})
+        assert rc == 0 and cid
+
+        rc, out = _run(c, "crash", "ls")
+        assert rc == 0
+        rows = json.loads(out)
+        assert any(e["crash_id"] == cid and e["entity"] == "osd.2"
+                   for e in rows)
+        rc, out = _run(c, "crash", "info", cid)
+        assert rc == 0
+        assert json.loads(out)["crash_point"]["point"] == "kill9"
+        rc, out = _run(c, "crash", "archive", cid)
+        assert rc == 0
+        rc, out = _run(c, "crash", "ls-new")
+        assert rc == 0 and json.loads(out) == []
+        rc, out = _run(c, "crash", "rm", cid)
+        assert rc == 0
+        rc, out = _run(c, "crash", "ls")
+        assert rc == 0 and json.loads(out) == []
+        # bad verb and missing id are usage errors, not tracebacks
+        rc, _ = _run(c, "crash", "bogus")
+        assert rc != 0
+        rc, _ = _run(c, "crash", "info")
+        assert rc != 0
+        r.shutdown()

@@ -1,0 +1,137 @@
+"""Observability-surface lint: every introspection output is machine-
+readable.
+
+Two conventions, enforced over a live cluster rather than by reading
+source, so new surfaces are linted the day they appear:
+
+- **asok JSON contract** — every registered admin-socket command on
+  every daemon kind returns a payload that round-trips ``json.dumps``
+  (the socket protocol serializes replies as JSON; a handler leaking
+  a non-serializable object would work in-process and explode only
+  over a real procs-mode socket);
+- **exposition format** — the mgr exporter's /metrics text parses
+  line-by-line under the Prometheus exposition rules: valid metric
+  and label names, float-parseable values, ``# TYPE``/``# HELP`` at
+  most once per family.
+
+Commands that require arguments get them from ``ARGS``; the entry is
+checked for staleness — an ARGS key for a command that no longer
+exists fails the lint, so the table can't rot.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from ceph_tpu.vstart import MiniCluster
+
+# arguments for asok commands that cannot run bare
+ARGS = {
+    "config set": {"key": "osd_blackbox_tail_events", "value": "64"},
+    "config help": {"key": "osd_blackbox_enable"},
+    "fault partition": {"dst": "osd.99"},
+}
+
+_METRIC_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # family name
+    r"(?:\{([^}]*)\})?"                     # optional label set
+    r" (\S+)$")                             # value
+_LABEL = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_COMMENT = re.compile(r"^# (TYPE|HELP) ([a-zA-Z_:][a-zA-Z0-9_:]*) .")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_mons=1, n_osds=1)
+    c.start()
+    r = c.rados()
+    r.create_pool("lint", pg_num=1, size=1)
+    io = r.open_ioctx("lint")
+    for i in range(4):      # some traffic so counters are non-zero
+        io.write_full(f"o{i}", b"x" * 512)
+    c.start_mgr("lint")
+    c.wait_for_active_mgr()
+    yield c
+    c.stop()
+
+
+def _lint_asok(asok, label):
+    exercised = []
+    for prefix, (handler, _desc) in sorted(
+            asok._handlers.items()):
+        cmd = {"prefix": prefix, **ARGS.get(prefix, {})}
+        out = handler(cmd)
+        try:
+            json.dumps(out)
+        except (TypeError, ValueError) as e:
+            raise AssertionError(
+                f"{label} asok {prefix!r} output does not "
+                f"round-trip JSON: {e}") from e
+        exercised.append(prefix)
+    return exercised
+
+
+def test_every_asok_command_round_trips_json(cluster):
+    c = cluster
+    surfaces = []
+    surfaces += _lint_asok(c.osds[0].admin_socket, "osd.0")
+    surfaces += _lint_asok(c.mons[0].admin_socket, "mon.0")
+    mgr = next(iter(c.mgrs.values()))
+    surfaces += _lint_asok(mgr.admin_socket, "mgr")
+    # the lint has teeth only while it walks a real surface
+    assert len(surfaces) >= 25, sorted(surfaces)
+    # args-table staleness: every ARGS entry must still be a live
+    # command somewhere, or the table is rotting
+    for key in ARGS:
+        assert key in surfaces, f"ARGS entry {key!r} is stale"
+    # mutation cleanup (fault partition armed a blackhole rule)
+    c.osds[0].msgr.faults.heal()
+
+
+def test_blackbox_asok_reports_recorder_state(cluster):
+    out = cluster.osds[0].admin_socket._handlers["blackbox"][0](
+        {"prefix": "blackbox dump"})
+    assert out["enabled"] is True
+    assert out["records"] >= 1          # boot record at minimum
+    assert {"wall", "mono"} <= set(out["clock"])
+    before = out["records"]
+    out = cluster.osds[0].admin_socket._handlers["blackbox"][0](
+        {"prefix": "blackbox snap"})
+    assert out["records"] > before      # snap forced a framed append
+
+
+def test_exporter_text_passes_exposition_rules(cluster):
+    port = cluster.prometheus_port()
+    assert port
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+        text = resp.read().decode()
+    families_typed = []
+    samples = 0
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _COMMENT.match(line)
+            assert m, f"malformed comment line: {line!r}"
+            if m.group(1) == "TYPE":
+                families_typed.append(m.group(2))
+            continue
+        m = _METRIC_LINE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        float(m.group(3))               # value must be a number
+        labels = m.group(2)
+        if labels:
+            rebuilt = ",".join(
+                f'{k}="{v}"' for k, v in _LABEL.findall(labels))
+            assert rebuilt == labels, \
+                f"bad label syntax in: {line!r}"
+        samples += 1
+    assert samples >= 20, f"only {samples} samples scraped"
+    # TYPE at most once per family
+    assert len(families_typed) == len(set(families_typed)), \
+        sorted(f for f in families_typed
+               if families_typed.count(f) > 1)

@@ -184,3 +184,198 @@ class TestOrphanReaper:
         cluster._mon_handles.clear()
         cluster._osd_handles.clear()
         cluster.stop()
+
+
+class TestBlackBoxPostMortem:
+    """The flight-recorder acceptance drill, procs edition: a real
+    SIGKILL mid-transaction leaves a corpse whose black box the
+    parent reads offline — the final recorded event IS the armed
+    crash point the injector schedule predicted — and the revived
+    process turns that corpse into a `ceph crash` report surfaced by
+    RECENT_CRASH until archived over the wire."""
+
+    SEED, PROB = 1234, 0.2
+
+    def test_kill9_black_box_and_crash_pipeline(self):
+        import json
+
+        from ceph_tpu.core import flight_recorder
+
+        inj = CrashInjector(seed=self.SEED, osd="osd.0")
+        inj.set_prob("kill9", self.PROB)
+        k = inj.preview("kill9", 64).index(True)
+        cluster = MiniCluster(n_mons=1, n_osds=1,
+                              fault_seed=self.SEED, procs=True,
+                              crash_probs={"kill9": self.PROB})
+        with cluster:
+            r = cluster.rados()
+            r.create_pool("p", pg_num=1, size=1)
+            io = r.open_ioctx("p")
+            died = False
+            for i in range(64):
+                try:
+                    io.write_full(f"o{i}", b"x" * 512)
+                except Exception:   # noqa: BLE001 — op timeout
+                    died = True
+                    break
+            assert died, "seeded kill9 never fired in 64 writes"
+            cluster.crash_osd(0, hard=True)     # reap the corpse
+
+            # -- offline autopsy of a real SIGKILLed process ------
+            bbox = cluster.blackbox_path(0)
+            info = flight_recorder.crash_info(bbox)
+            assert info["clean_close"] is False
+            assert info["crash_point"] == {"point": "kill9", "n": k}
+            tl = flight_recorder.timeline(bbox)
+            # SIGKILL is instant: the flushed crash-imminent event is
+            # literally the last record — nothing trails it, and the
+            # page cache kept the file tail intact
+            assert tl[-1]["type"] == "event"
+            assert tl[-1]["name"] == "crash_point"
+            assert tl[-1]["point"] == "kill9" and tl[-1]["n"] == k
+            assert info["tail"]["status"] == "clean"
+
+            # -- revive posts the report; pipeline over the wire --
+            cluster.crash_probs = {}
+            cluster.revive_osd(0, timeout=60)
+            assert os.path.exists(bbox + ".crash")
+            cluster.start_mgr("m")
+            cluster.wait_for_active_mgr()
+            rc, _, ls = r.mgr_command({"prefix": "crash ls"})
+            assert rc == 0 and len(ls) == 1
+            row = ls[0]
+            assert row["entity"] == "osd.0"
+            assert row["crash_point"] == {"point": "kill9", "n": k}
+            rc, _, rep = r.mgr_command(
+                {"prefix": "crash info", "id": row["crash_id"]})
+            assert rc == 0
+            assert rep["boot_nonce"] == info["nonce"]
+            assert rep["crash_pid"] == info["pid"]
+            # SIGKILL loses no appended record: the replay found all k
+            assert rep["replay_stats"]["records"] == k
+            assert rep["replay_stats"]["clean_shutdown"] is False
+            json.dumps(rep)     # report is a clean JSON document
+
+            def health_codes():
+                rc2, _, h = r.mon_command({"prefix": "health detail"})
+                assert rc2 == 0
+                return {c["code"] for c in h.get("checks", [])}
+            deadline = time.monotonic() + 30
+            while "RECENT_CRASH" not in health_codes():
+                assert time.monotonic() < deadline, health_codes()
+                time.sleep(0.2)
+            rc, _, out = r.mgr_command({"prefix": "crash archive-all"})
+            assert rc == 0 and out["archived"] == 1
+            deadline = time.monotonic() + 30
+            while "RECENT_CRASH" in health_codes():
+                assert time.monotonic() < deadline
+                time.sleep(0.2)
+
+
+class TestObservabilityParity:
+    """Tentpole parity: the observability surfaces tier-1 asserts on
+    in-process — collect_trace, profiler dump, telemetry series, the
+    /metrics exporter — must read identically when every daemon is
+    its own OS process with its own monotonic clock."""
+
+    def test_trace_merge_across_three_processes(self):
+        from ceph_tpu.core.config import ConfigProxy
+        from ceph_tpu.core.options import build_options
+        from ceph_tpu.core.tracer import chrome_trace
+
+        cluster = MiniCluster(
+            n_mons=1, n_osds=3, procs=True,
+            osd_config={"jaeger_tracing_enable": True})
+        with cluster:
+            cfg = ConfigProxy(build_options())
+            cfg.set("jaeger_tracing_enable", True)
+            r = cluster.rados(config=cfg)
+            r.create_pool("tr", pg_num=4, size=3)
+            io = r.open_ioctx("tr")
+            cluster.wait_for_clean(timeout=60)
+            io.write_full("obj", b"traced payload" * 64)
+            roots = [s for s in r.objecter.tracer.dump()
+                     if s["name"] == "objecter_op:obj"]
+            assert roots, "no client root span"
+            tid = roots[-1]["trace_id"]
+            # replica spans finish asynchronously in other processes
+            deadline = time.monotonic() + 15
+            spans = []
+            while time.monotonic() < deadline:
+                spans = cluster.collect_trace(tid)
+                daemons = {s["daemon"] for s in spans
+                           if s["daemon"].startswith("osd.")}
+                if len(daemons) >= 3:
+                    break
+                time.sleep(0.2)
+            assert len(daemons) >= 3, \
+                f"spans from {sorted(daemons)} only"
+            assert all(s["trace_id"] == tid for s in spans)
+            # chronological consistency across 4 monotonic clocks:
+            # the merge is sorted, and every rebased start lands
+            # within the test's own lifetime (a failed rebase is off
+            # by the process's boot-to-epoch offset, i.e. hours)
+            starts = [s["start"] for s in spans]
+            assert starts == sorted(starts)
+            local_now = time.monotonic()
+            assert all(local_now - 300 < t <= local_now + 1
+                       for t in starts), starts
+            # and the wall-clock export stays one coherent trace
+            # (ph="M" rows are per-process name metadata, not spans)
+            events = chrome_trace(spans)["traceEvents"]
+            assert len([e for e in events
+                        if e.get("ph") == "X"]) == len(spans)
+
+    def test_profiler_dump_and_telemetry_over_the_wire(self):
+        cluster = MiniCluster(n_mons=1, n_osds=1, procs=True)
+        with cluster:
+            r = cluster.rados()
+            r.create_pool("p", pg_num=1, size=1)
+            io = r.open_ioctx("p")
+            for i in range(4):
+                io.write_full(f"o{i}", b"z" * 1024)
+            d = cluster.profiler_dump(0)
+            clk = d.get("clock") or {}
+            assert {"wall", "mono"} <= set(clk)
+            assert abs(clk["wall"] - time.time()) < 60
+            cluster.start_mgr("m")
+            cluster.wait_for_active_mgr()
+            deadline = time.monotonic() + 20
+            series = {}
+            while time.monotonic() < deadline and not series:
+                series = cluster.telemetry_series() or {}
+                time.sleep(0.25)
+            assert series, "telemetry series empty over the wire"
+
+    def test_metrics_scraped_over_http(self):
+        import urllib.request
+
+        cluster = MiniCluster(n_mons=1, n_osds=2, procs=True)
+        with cluster:
+            r = cluster.rados()
+            r.create_pool("p", pg_num=2, size=2)
+            io = r.open_ioctx("p")
+            for i in range(8):
+                io.write_full(f"m{i}", b"q" * 512)
+            cluster.start_mgr("m")
+            cluster.wait_for_active_mgr()
+            port = cluster.prometheus_port()
+            assert port, "active mgr exposes no exporter port"
+            deadline = time.monotonic() + 20
+            text = ""
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=5) as resp:
+                    assert resp.status == 200
+                    text = resp.read().decode()
+                if "ceph_osd_up" in text \
+                        and 'ceph_daemon="osd.0"' in text:
+                    break
+                time.sleep(0.5)
+            # cluster aggregates from the mon, per-daemon series
+            # scraped over each child's real Unix asok
+            assert "# TYPE ceph_osd_up gauge" in text
+            assert "ceph_osd_up 2" in text
+            assert 'ceph_osd_op{ceph_daemon="osd.0"}' in text
+            assert 'ceph_osd_op{ceph_daemon="osd.1"}' in text
