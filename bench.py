@@ -933,6 +933,38 @@ def _observability_leg():
         res["trace_overhead_pct"] = round(
             100.0 * (elapsed[True] - elapsed[False]) / elapsed[False],
             1)
+
+        # attribution tax: the workload-attribution observatory's
+        # op-path cost — per-op space-saving sketch updates (client/
+        # pool/PG keys) with the mgr's alert evaluator ticking in the
+        # background — toggled live, same interleaved A/B scheme.
+        # Tracing stays off in both arms so the exemplar path costs
+        # only its no-trace branch, as in an untraced production run.
+        set_tracing(False)
+        c.start_mgr("obs")
+        c.wait_for_active_mgr()
+
+        def set_topk(on: bool):
+            for osd in c.osds.values():
+                osd.topk.enabled = on
+
+        att = {False: 0.0, True: 0.0}
+        for rnd in range(rounds):
+            order = (False, True) if rnd % 2 == 0 else (True, False)
+            for attributed in order:
+                set_topk(attributed)
+                t0 = time.monotonic()
+                for i in range(batch):
+                    io.write_full(f"o{i % 64}", payload)
+                att[attributed] += time.monotonic() - t0
+        set_topk(True)
+        overhead = 100.0 * (att[True] - att[False]) / att[False]
+        assert overhead < 2.0, \
+            f"attribution overhead {overhead:.2f}%"
+        res["attribution_overhead_pct"] = round(overhead, 2)
+        res["topk_keys_tracked"] = sum(
+            len(o.topk.dump()["clients"]["entries"])
+            for o in c.osds.values())
         r.shutdown()
 
     res.update(_profiler_leg())
@@ -1588,6 +1620,8 @@ def _frontdoor_leg():
             break
     assert nn["p99_ratio"] <= 1.5, \
         f"victim p99 blew up {nn['p99_ratio']:.2f}x under aggressor"
+    assert nn["top1_is_culprit"], \
+        f"sketch blamed {nn['top1_client']!r}, not the aggressor"
     return {
         "slo_p99_ms": slo_p99_ms,
         "offered_ops_per_sec": rate,
@@ -1602,6 +1636,8 @@ def _frontdoor_leg():
             "victim_duo_p99_ms": round(nn["duo_p99_ms"], 2),
             "p99_ratio": round(nn["p99_ratio"], 3),
             "aggressor_limit_ops": nn["aggressor_limit"],
+            "top1_client": nn["top1_client"],
+            "top1_is_culprit": nn["top1_is_culprit"],
         },
     }
 

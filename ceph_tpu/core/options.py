@@ -282,6 +282,46 @@ def build_options() -> list[Option]:
                "split, bytes, occupancy)"),
         Option("device_profiler_ring_size", int, 1024,
                "launch samples kept per daemon", min=1),
+        # -- workload attribution (core/topk.py) --------------------------
+        Option("osd_topk_enable", bool, True,
+               "track heavy-hitter clients/pools/PGs with per-OSD "
+               "space-saving sketches (`ceph osd top`)"),
+        Option("osd_topk_k", int, 16,
+               "tracked keys per attribution dimension (error bound "
+               "shrinks as k grows)", min=1, max=1024),
+        Option("osd_exemplar_window_s", float, 60.0,
+               "metric→trace exemplar window: the slowest-op trace id "
+               "kept per histogram bucket resets this often (s)",
+               min=0.1),
+        # -- mgr alerts (mgr/alerts.py) -----------------------------------
+        Option("mgr_alerts_enable", bool, True,
+               "evaluate burn-rate + anomaly alert rules each mgr "
+               "tick and post them into mon health"),
+        Option("mgr_alerts_slo_budget", float, 0.01,
+               "SLO error budget: tolerated fraction of wall time in "
+               "violation (burn rate 1.0 = spending exactly this)",
+               min=1e-6, max=1.0),
+        Option("mgr_alerts_fast_window_s", float, 300.0,
+               "fast burn-rate window (SRE 5m); its long "
+               "confirmation window is 12x this", min=1.0),
+        Option("mgr_alerts_slow_window_s", float, 1800.0,
+               "slow burn-rate window (SRE 30m); its long "
+               "confirmation window is 12x this", min=1.0),
+        Option("mgr_alerts_fast_burn", float, 14.4,
+               "burn-rate threshold for the fast (page) rule",
+               min=0.0),
+        Option("mgr_alerts_slow_burn", float, 6.0,
+               "burn-rate threshold for the slow (ticket) rule",
+               min=0.0),
+        Option("mgr_alerts_anomaly_z", float, 6.0,
+               "MAD z-score above which a device-plane rate is "
+               "anomalous", min=0.1),
+        Option("mgr_alerts_anomaly_min_samples", int, 8,
+               "rate samples required before the anomaly detector "
+               "judges a series", min=3),
+        Option("mgr_alerts_history_size", int, 256,
+               "fired/cleared alert transitions kept in the history "
+               "ring", min=1),
         # -- black-box flight recorder ------------------------------------
         Option("osd_blackbox_enable", bool, True,
                "journal a crash-surviving per-daemon black box next "

@@ -132,7 +132,8 @@ class PrometheusModule(MgrModule):
             Exporter(ctx._d.monc, ctx._d.asok_paths,
                      progress_events=self._progress_events,
                      telemetry=self._telemetry,
-                     autotune=self._autotune)).start()
+                     autotune=self._autotune,
+                     alerts=self._alerts)).start()
         self.port = self.service.port
 
     def _progress_events(self):
@@ -149,12 +150,17 @@ class PrometheusModule(MgrModule):
         mod = self.ctx._d.modules.get("autotune")
         return mod.export_view() if mod is not None else {}
 
+    def _alerts(self):
+        mod = self.ctx._d.modules.get("alerts")
+        return mod.export_view() if mod is not None else {}
+
     def shutdown(self):
         self.service.shutdown()
 
 
 def _default_modules():
     # late import: modules.py subclasses MgrModule from this file
+    from .alerts import AlertsModule
     from .autotune import AutotuneModule
     from .dashboard import DashboardModule
     from .modules import (CrashModule, IostatModule, StatusModule,
@@ -168,8 +174,8 @@ def _default_modules():
     return (BalancerModule, PgAutoscalerModule, PrometheusModule,
             ProgressModule, StatusModule, IostatModule, CrashModule,
             TelemetryModule, TelemetrySpine, AutotuneModule,
-            DashboardModule, VolumesModule, OrchestratorModule,
-            DeviceHealthModule, RbdSupportModule)
+            AlertsModule, DashboardModule, VolumesModule,
+            OrchestratorModule, DeviceHealthModule, RbdSupportModule)
 
 
 class _MgrCommandServer(Dispatcher):

@@ -32,19 +32,23 @@ def _esc_label(v) -> str:
 
 class Exporter:
     def __init__(self, monc, asok_paths: dict[str, str] | None = None,
-                 progress_events=None, telemetry=None, autotune=None):
+                 progress_events=None, telemetry=None, autotune=None,
+                 alerts=None):
         """monc: a MonClient; asok_paths: daemon name → admin socket
         (scraped for perf counters); progress_events: nullary callable
         → open mgr progress events (ceph_progress_event gauge);
         telemetry: nullary callable → the telemetry spine's export
-        view (device-plane series + derived byte rates); autotune:
-        nullary callable → the autotune module's export view
-        (decision counters + current knob values)."""
+        view (device-plane series + derived byte rates + merged
+        attribution top-K); autotune: nullary callable → the autotune
+        module's export view (decision counters + current knob
+        values); alerts: nullary callable → the alerts module's
+        export view (firing alerts + fire/clear counters)."""
         self.monc = monc
         self.asok_paths = dict(asok_paths or {})
         self.progress_events = progress_events
         self.telemetry = telemetry
         self.autotune = autotune
+        self.alerts = alerts
 
     def collect(self) -> str:
         lines: list[str] = []
@@ -58,7 +62,8 @@ class Exporter:
                 typed.add(name)
                 lines.append(f"# TYPE {name} {typ}")
 
-        def emit(name, value, labels=None, help_=None, typ="gauge"):
+        def emit(name, value, labels=None, help_=None, typ="gauge",
+                 exemplar=None):
             if help_ and name not in helped:
                 helped.add(name)
                 lines.append(f"# HELP {name} {help_}")
@@ -68,7 +73,15 @@ class Exporter:
                 lab = "{" + ",".join(
                     f'{k}="{_esc_label(v)}"'
                     for k, v in labels.items()) + "}"
-            lines.append(f"{name}{lab} {value}")
+            line = f"{name}{lab} {value}"
+            if exemplar:
+                # OpenMetrics exemplar suffix on _bucket lines: the
+                # trace id of the slowest op that landed in the bucket
+                line += (' # {trace_id="'
+                         f'{_esc_label(exemplar.get("trace_id", ""))}'
+                         f'"}} {exemplar.get("value", 0)}'
+                         f' {exemplar.get("ts", 0)}')
+            lines.append(line)
 
         try:
             rc, _, st = self.monc.command({"prefix": "status"})
@@ -244,6 +257,15 @@ class Exporter:
                 view = {}
             self._emit_device_series(emit, emit_type, view)
             self._emit_slo_series(emit, view)
+            self._emit_topk(emit, view)
+
+        # firing alerts + fire/clear counters
+        if self.alerts is not None:
+            try:
+                alview = self.alerts() or {}
+            except Exception:
+                alview = {}
+            self._emit_alerts(emit, alview)
 
         # autotuner decision counters + actuated knob values
         if self.autotune is not None:
@@ -351,6 +373,8 @@ class Exporter:
             first = False
         first = True
         for daemon in sorted(rates):
+            if daemon.startswith("slo."):
+                continue    # slo pseudo-daemons: _emit_slo_series
             r = rates[daemon] or {}
             emit("ceph_osd_bytes_rate",
                  round(float(r.get("bytes_per_sec", 0.0)), 3),
@@ -403,6 +427,74 @@ class Exporter:
                          round(float(lane.get("violation_s", 0.0)),
                                3), labels=lab)
             first = False
+        # windowed per-second numbers off the slo.* rings — the same
+        # values `telemetry series` and daemon_rates report
+        first = True
+        for daemon in sorted(view.get("rates") or {}):
+            if not daemon.startswith("slo."):
+                continue
+            scenario = daemon.split(".", 1)[1]
+            for counter, v in sorted(
+                    (view["rates"][daemon] or {}).items()):
+                emit("ceph_slo_rate", round(float(v), 6),
+                     labels={"scenario": scenario,
+                             "counter": counter},
+                     help_="windowed per-second rate of an SLO "
+                     "harness aggregate" if first else None)
+                first = False
+
+    @staticmethod
+    def _emit_topk(emit, view):
+        """Merged attribution top-K → ceph_topk_* gauges: one series
+        per (dimension, key) for ops (with its space-saving error
+        bound), bytes and p99 latency."""
+        topk = view.get("topk") or {}
+        firsts = {}
+        for dim in sorted(topk):
+            for row in topk[dim] or []:
+                lab = {"dim": dim, "key": str(row.get("key", ""))}
+                for fam, field, help_ in (
+                        ("ceph_topk_ops", "ops",
+                         "ops attributed to a heavy-hitter key "
+                         "(space-saving sketch, overestimate)"),
+                        ("ceph_topk_ops_err", "err",
+                         "overestimation bound on ceph_topk_ops"),
+                        ("ceph_topk_bytes", "bytes",
+                         "bytes attributed to a heavy-hitter key"),
+                        ("ceph_topk_p99_ms", "p99_ms",
+                         "p99 op latency of a heavy-hitter key")):
+                    emit(fam, row.get(field, 0), labels=lab,
+                         help_=help_ if not firsts.get(fam) else None)
+                    firsts[fam] = True
+
+    @staticmethod
+    def _emit_alerts(emit, view):
+        """Alerts export view → ceph_alert_* families: an armed
+        flag, fire/clear counters, and one series per firing alert
+        valued by its measured burn rate / z-score."""
+        if not view:
+            return
+        emit("ceph_alerts_enabled", int(bool(view.get("enabled"))),
+             help_="alert rules evaluated each mgr tick (1=yes)")
+        emit("ceph_alerts_fired_total",
+             int(view.get("fired_total", 0)),
+             help_="alert fire transitions since mgr start",
+             typ="counter")
+        emit("ceph_alerts_cleared_total",
+             int(view.get("cleared_total", 0)),
+             help_="alert clear transitions since mgr start",
+             typ="counter")
+        first = True
+        for name in sorted(view.get("firing") or {}):
+            rec = view["firing"][name] or {}
+            emit("ceph_alert_firing",
+                 round(float(rec.get("value", 1.0)), 6),
+                 labels={"name": name,
+                         "check": str(rec.get("check", "")),
+                         "severity": str(rec.get("severity", ""))},
+                 help_="firing alerts, valued by the measured "
+                 "burn rate / z-score" if first else None)
+            first = False
 
     @staticmethod
     def _emit_autotune(emit, view):
@@ -449,10 +541,12 @@ class Exporter:
         observations v with int(log2(v+1)) == i, so its upper bound
         is 2^(i+1)-1 (the last bucket is +Inf).  `_sum` is
         approximated from bucket lower bounds — the source histogram
-        stores counts only."""
+        stores counts only.  Buckets that kept a metric→trace
+        exemplar carry it as an OpenMetrics exemplar suffix."""
         rows = val.get("values") or []
         if not rows:
             return
+        exemplars = val.get("exemplars") or {}
         nx = len(rows[0])
         per_x = [sum(r[i] for r in rows) for i in range(nx)]
         emit_type(base, "histogram")
@@ -462,7 +556,8 @@ class Exporter:
             cum += n
             approx_sum += n * float(2 ** i - 1)
             le = "+Inf" if i == nx - 1 else f"{float(2 ** (i + 1) - 1):g}"
-            emit(base + "_bucket", cum, labels={**lab, "le": le})
+            emit(base + "_bucket", cum, labels={**lab, "le": le},
+                 exemplar=exemplars.get(str(i)))
         emit(base + "_sum", approx_sum, labels=lab)
         emit(base + "_count", cum, labels=lab)
 

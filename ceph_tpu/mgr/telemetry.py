@@ -21,6 +21,12 @@ trade) — and derives
 ``ceph iostat`` and ``ceph osd perf`` are served from here
 (reference: the mgr's ``iostat`` module and ``osd perf`` reading
 osd_stat_t fields the OSDs beacon via MPGStats).
+
+Workload attribution rides the same beacon: each OSD ships its
+space-saving top-K sketches (clients/pools/pgs) and the slowest-op
+trace exemplars per latency bucket; the spine merges sketches
+cluster-wide for ``ceph osd top`` and serves ``ceph tracing
+exemplar`` lookups straight off the ingested state.
 """
 
 from __future__ import annotations
@@ -30,6 +36,7 @@ import time
 
 import numpy as np
 
+from ..core import topk as _topk
 from .daemon import MgrModule
 
 # counters lifted verbatim off each osd_stats beacon into rings
@@ -144,6 +151,9 @@ class TelemetrySpine(MgrModule):
         self._lat_count: dict[str, SeriesRing] = {}
         # latest SLO-harness report per scenario ("slo ingest")
         self.slo: dict[str, dict] = {}
+        # latest attribution sketches / trace exemplars per daemon
+        self.topk: dict[str, dict] = {}
+        self.exemplars: dict[str, dict] = {}
 
     # -- ingest ------------------------------------------------------------
 
@@ -201,11 +211,23 @@ class TelemetrySpine(MgrModule):
                         daemon,
                         collections.deque(maxlen=self.HIST_WINDOW))
                     dq.append((now, list(hist)))
+            tk = st.get("topk")
+            if isinstance(tk, dict):
+                self.topk[daemon] = tk
+            ex = st.get("exemplars")
+            if isinstance(ex, dict):
+                self.exemplars[daemon] = ex
 
     # -- derived views -----------------------------------------------------
 
     def daemon_rates(self, daemon: str) -> dict:
         rings = self.series.get(daemon, {})
+        if daemon.startswith("slo."):
+            # SLO pseudo-daemons carry cumulative harness aggregates;
+            # their rate view is one windowed per-second number per
+            # ring — the same numbers ``telemetry series`` reports
+            return {f"{c}_per_s": ring.rate()
+                    for c, ring in sorted(rings.items())}
 
         def r(c):
             ring = rings.get(c)
@@ -357,23 +379,84 @@ class TelemetrySpine(MgrModule):
             "scenarios": per,
         }
 
+    @staticmethod
+    def _windowed(ring: SeriesRing) -> list[tuple[float, float]]:
+        """Cumulative ring → per-second windowed samples (successive
+        deltas, clamped at zero; the first sample has no window).  The
+        tail equals ``ring.rate()`` so every surface derived from this
+        ring reports the same number."""
+        out: list[tuple[float, float]] = []
+        prev = None
+        for t, v in ring.array():
+            if prev is None or t <= prev[0]:
+                out.append((float(t), 0.0))
+            else:
+                out.append((float(t),
+                            max(0.0, float(v - prev[1])
+                                / float(t - prev[0]))))
+            prev = (t, v)
+        return out
+
     def series_dump(self, daemon: str | None = None) -> dict:
-        """Raw rings (history surface for tests/tools)."""
+        """History surface for tests/tools: raw (t, value) samples —
+        except slo.* rings, which surface as the windowed per-second
+        numbers ``daemon_rates`` reports (raw cumulative
+        harness aggregates were a trap: the two surfaces disagreed)."""
         src = (self.series if daemon is None
                else {daemon: self.series.get(daemon, {})})
-        return {d: {c: list(r.samples) for c, r in rings.items()}
-                for d, rings in src.items()}
+        out = {}
+        for d, rings in src.items():
+            if d.startswith("slo."):
+                out[d] = {f"{c}_per_s": self._windowed(r)
+                          for c, r in rings.items()}
+            else:
+                out[d] = {c: list(r.samples) for c, r in rings.items()}
+        return out
+
+    def osd_top(self, dim: str = "clients", by: str = "ops",
+                count: int = 10) -> dict:
+        """``ceph osd top``: merge every OSD's sketch for one
+        dimension into a cluster-wide top-K with error bounds."""
+        dumps = [t[dim] for t in self.topk.values()
+                 if isinstance(t.get(dim), dict)]
+        merged = _topk.merge_sketches(dumps)
+        return {"dim": dim, "by": by,
+                "osds": sorted(self.topk),
+                "err_floor": int(merged.get("min", 0)),
+                "rows": _topk.rank(merged, by=by, n=count)}
+
+    def exemplar_lookup(self, metric: str | None = None,
+                        bucket: int | None = None) -> list[dict]:
+        """Ingested trace exemplars, filtered by metric/bucket, worst
+        (largest observed value) first — each row names the daemon
+        whose histogram kept the trace."""
+        rows = []
+        for daemon in sorted(self.exemplars):
+            for counter, buckets in sorted(
+                    self.exemplars[daemon].items()):
+                if metric is not None and counter != metric:
+                    continue
+                for b, ex in (buckets or {}).items():
+                    if bucket is not None and int(b) != int(bucket):
+                        continue
+                    rows.append({"daemon": daemon, "metric": counter,
+                                 "bucket": int(b), **dict(ex)})
+        rows.sort(key=lambda r: (-float(r.get("value", 0.0)),
+                                 r["daemon"], r["bucket"]))
+        return rows
 
     def export_view(self) -> dict:
         """What the prometheus exporter consumes: latest profiler
-        aggregate + derived rates per daemon + the last SLO-harness
-        reports."""
+        aggregate + derived rates per daemon (slo.* included, as
+        windowed per-second numbers) + the last SLO-harness reports
+        + the merged attribution top-K."""
         return {"profiler": dict(self.profiler),
                 "rates": {d: self.daemon_rates(d)
-                          for d in self.series
-                          if not d.startswith("slo.")},
+                          for d in self.series},
                 "slo": dict(self.slo),
-                "slo_pressure": self.slo_pressure()}
+                "slo_pressure": self.slo_pressure(),
+                "topk": {dim: self.osd_top(dim)["rows"]
+                         for dim in _topk.TopKSet.DIMS}}
 
     def handle_command(self, cmd: dict):
         prefix = cmd.get("prefix", "")
@@ -383,6 +466,23 @@ class TelemetrySpine(MgrModule):
             return 0, "", self.osd_perf()
         if prefix == "telemetry series":
             return 0, "", self.series_dump(cmd.get("daemon"))
+        if prefix == "osd top":
+            dim = str(cmd.get("dim") or "clients")
+            if dim not in _topk.TopKSet.DIMS:
+                return (-22, "osd top: dim must be one of "
+                        + "|".join(_topk.TopKSet.DIMS), None)
+            by = str(cmd.get("by") or "ops")
+            if by not in ("ops", "bytes", "p99"):
+                return -22, "osd top: --by ops|bytes|p99", None
+            return 0, "", self.osd_top(
+                dim, by, int(cmd.get("count") or 10))
+        if prefix == "tracing exemplar":
+            metric = cmd.get("metric")
+            bucket = cmd.get("bucket")
+            rows = self.exemplar_lookup(
+                str(metric) if metric is not None else None,
+                int(bucket) if bucket is not None else None)
+            return 0, "", {"exemplars": rows}
         if prefix == "slo ingest":
             report = cmd.get("report")
             if not isinstance(report, dict):

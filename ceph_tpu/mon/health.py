@@ -40,6 +40,11 @@ NEARFULL_RATIO = 0.85    # OSD_NEARFULL: bytes_used / bytes_total
 # RECENT_CRASH: unarchived crash reports younger than this warn
 # (reference mgr/crash warn_recent_interval: two weeks)
 RECENT_CRASH_AGE = 14 * 86400.0
+# SLO_BURN_RATE / TELEMETRY_ANOMALY: the mgr alerts module posts
+# firing alerts into this config-key namespace (the crash-report
+# pattern) and the evaluators below read them back — so alerts get
+# mutes, TTLs, `ceph -w` transitions and history for free
+ALERT_KEY_PREFIX = "alerts/"
 
 
 # -- evaluators --------------------------------------------------------------
@@ -50,7 +55,8 @@ class HealthContext:
     scale)."""
 
     def __init__(self, *, osdmap, pgmap: PGMap, monmap_ranks=(),
-                 quorum=(), crashes=(), now: float | None = None):
+                 quorum=(), crashes=(), alerts=(),
+                 now: float | None = None):
         self.osdmap = osdmap
         self.pgmap = pgmap
         self.monmap_ranks = list(monmap_ranks)
@@ -58,6 +64,9 @@ class HealthContext:
         # crash-report summaries from the mgr/crash config-key
         # namespace: {"entity", "timestamp", "archived"} each
         self.crashes = list(crashes)
+        # firing mgr alerts from the alerts/ config-key namespace:
+        # {"name", "check", "severity", "summary", "firing"} each
+        self.alerts = list(alerts)
         self.now = time.time() if now is None else now
         self.total_pgs = sum(p.pg_num for p in osdmap.pools.values())
         self.states = pgmap.states(total_expected=self.total_pgs,
@@ -318,6 +327,33 @@ def _recent_crash(ctx):
         count=len(entities))
 
 
+def _alert_check(ctx, code: str, what: str):
+    """Shared evaluator for the mgr-alert-fed checks: group the
+    firing alerts of one check code into a single health check whose
+    severity is the worst member's."""
+    firing = [a for a in getattr(ctx, "alerts", ())
+              if a.get("firing") and a.get("check") == code]
+    if not firing:
+        return None
+    severity = ("ERR" if any(a.get("severity") == "ERR"
+                             for a in firing) else "WARN")
+    return _check(
+        code, severity,
+        f"{len(firing)} {what} alert(s) firing",
+        [f"{a.get('name', '?')}: {a.get('summary', '')}"
+         for a in sorted(firing, key=lambda a: a.get("name", ""))])
+
+
+@health_check
+def _slo_burn_rate(ctx):
+    return _alert_check(ctx, "SLO_BURN_RATE", "SLO burn-rate")
+
+
+@health_check
+def _telemetry_anomaly(ctx):
+    return _alert_check(ctx, "TELEMETRY_ANOMALY", "telemetry-anomaly")
+
+
 def evaluate_checks(ctx: HealthContext) -> list[dict]:
     """Run every registered evaluator; order is registration order
     (stable, so reports diff cleanly)."""
@@ -432,7 +468,8 @@ class HealthMonitor(PaxosService):
             osdmap=osdmap, pgmap=mon.pgmap,
             monmap_ranks=mon.monmap.ranks(),
             quorum=mon.elector.quorum or [],
-            crashes=self._crash_summaries(), now=now)
+            crashes=self._crash_summaries(),
+            alerts=self._alert_summaries(), now=now)
 
     def _crash_summaries(self) -> list[dict]:
         """Crash-report summaries straight off the committed
@@ -455,6 +492,26 @@ class HealthMonitor(PaxosService):
                 out.append({"entity": rep.get("entity"),
                             "timestamp": rep.get("timestamp"),
                             "archived": rep.get("archived")})
+        return out
+
+    def _alert_summaries(self) -> list[dict]:
+        """Firing mgr alerts off the committed config-key store (the
+        alerts module's namespace) — the SLO_BURN_RATE /
+        TELEMETRY_ANOMALY feed needs no mgr round-trip."""
+        cfg = self.mon.services.get("config")
+        if cfg is None:
+            return []
+        out = []
+        for key in self.mon.store.keys(cfg.prefix):
+            if not key.startswith(ALERT_KEY_PREFIX):
+                continue
+            blob = self.mon.store.get_str(cfg.prefix, key)
+            try:
+                rep = json.loads(blob or "")
+            except ValueError:
+                continue
+            if isinstance(rep, dict):
+                out.append(rep)
         return out
 
     def _compose(self, checks: list[dict]) -> dict:

@@ -181,6 +181,27 @@ class OSDaemon(Dispatcher):
                                   float(v) / 1000.0))
         self.tracer.tail_slow_s = float(
             self.config.get("tracer_tail_slow_ms") or 0.0) / 1000.0
+        # workload attribution: client/pool/pg space-saving top-K
+        # sketches fed from the op-reply path; dumps ride the
+        # osd_stats beacon and merge cluster-wide in the mgr
+        # (`ceph osd top`)
+        from ..core.topk import TopKSet
+        self.topk = TopKSet(
+            k=int(self.config.get("osd_topk_k") or 16),
+            enabled=bool(self.config.get("osd_topk_enable")))
+        self.config.add_observer(
+            "osd_topk_enable",
+            lambda _n, v: setattr(self.topk, "enabled", bool(v)))
+        self.config.add_observer(
+            "osd_topk_k", lambda _n, v: self.topk.set_k(int(v)))
+        # metric→trace exemplar window on the op-latency histogram
+        _lat_hist = self.perf._counters["op_latency_histogram"].hist
+        _lat_hist.exemplar_window = float(
+            self.config.get("osd_exemplar_window_s") or 60.0)
+        self.config.add_observer(
+            "osd_exemplar_window_s",
+            lambda _n, v: setattr(_lat_hist, "exemplar_window",
+                                  float(v)))
         # device-plane launch profiler: PG device call sites bind() it
         # so launches attribute to this daemon; aggregates ride the
         # osd_stats beacon into the mgr telemetry spine
@@ -468,6 +489,22 @@ class OSDaemon(Dispatcher):
         a.register("dump_batch_engine",
                    lambda c: self.batch_engine.dump(),
                    "coalescing data-plane counters + flush config")
+
+        # workload attribution: per-OSD heavy-hitter sketches + the
+        # metric→trace exemplars the mgr exporter attaches to
+        # `_bucket` lines — both carry the clock pair so procs-mode
+        # readers can rebase
+        def _topk_dump(c):
+            return {"enabled": self.topk.enabled,
+                    "clock": _clock(), **self.topk.dump()}
+        a.register("topk", _topk_dump,
+                   "heavy-hitter sketches (clients/pools/pgs)")
+
+        def _exemplar_dump(c):
+            return {"clock": _clock(),
+                    "exemplars": self._histogram_exemplars()}
+        a.register("dump_exemplars", _exemplar_dump,
+                   "slowest-op trace exemplars per histogram bucket")
         a.register("config show", lambda c: {
             k: self.config.get(k) for k in self.config.keys()},
             "effective configuration")
@@ -1361,7 +1398,24 @@ class OSDaemon(Dispatcher):
                            # health check and the exporter gauges are
                            # fed from here (reference osd_stat_t
                            # num_slow_ops via the mgr report)
-                           "slow_ops": self.op_tracker.slow_summary()}))
+                           "slow_ops": self.op_tracker.slow_summary(),
+                           # heavy-hitter sketches + slowest-op trace
+                           # exemplars: the telemetry spine merges the
+                           # sketches cluster-wide (`ceph osd top`)
+                           # and serves `tracing exemplar` from these
+                           "topk": self.topk.dump(),
+                           "exemplars":
+                               self._histogram_exemplars()}))
+
+    def _histogram_exemplars(self) -> dict:
+        """{counter: {bucket: {trace_id, value, ts}}} for every
+        histogram counter carrying live exemplars."""
+        out = {}
+        for c in self.perf._counters.values():
+            if c.hist is not None and c.hist.exemplars:
+                out[c.name] = {str(b): dict(ex)
+                               for b, ex in c.hist.exemplars.items()}
+        return out
 
     # -- dispatch ----------------------------------------------------------
     def ms_dispatch(self, msg) -> bool:
@@ -1545,9 +1599,14 @@ class OSDaemon(Dispatcher):
         self.perf.inc("op_w" if is_write else "op_r")
         if is_write:
             # payload rides as hex text: 2 chars per byte
-            self.perf.inc("op_in_bytes", sum(
+            in_bytes = sum(
                 len(op.get("data", "")) // 2 for op in (msg.ops or [])
-                if op.get("op") in _WRITE_OPS))
+                if op.get("op") in _WRITE_OPS)
+            self.perf.inc("op_in_bytes", in_bytes)
+            # stash for the reply-path attribution sketch (reads
+            # account ops + latency only; write bytes are what the
+            # heavy-hitter byte ranking attributes)
+            msg._acct_bytes = in_bytes
         msg.tracked = self.op_tracker.create_request(
             f"osd_op({msg.client}.{msg.tid} {msg.pgid} {msg.oid} "
             f"{'+'.join(sorted(k for k in kinds if k))})")

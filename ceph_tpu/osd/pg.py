@@ -1233,12 +1233,27 @@ class PG:
         tracked = self.finish_tracked(msg, "replied")
         if tracked is not None:
             self.daemon.perf.tinc("op_latency", tracked.age)
-            # log2 distribution in µs (perf histogram dump / exporter)
+            # log2 distribution in µs (perf histogram dump / exporter);
+            # the span's trace id rides along as the per-bucket
+            # slowest-op exemplar (OpenMetrics `_bucket` # {...})
             try:
-                self.daemon.perf.hinc("op_latency_histogram",
-                                      tracked.age * 1e6)
+                self.daemon.perf.hinc(
+                    "op_latency_histogram", tracked.age * 1e6,
+                    trace_id=span.trace_id if span is not None
+                    else None)
             except KeyError:
                 pass
+            # heavy-hitter attribution: client/pool/pg space-saving
+            # sketches (`ceph osd top`), fed only on the primary's
+            # client-op reply path — subops never misattribute here
+            topk = getattr(self.daemon, "topk", None)
+            if topk is not None and topk.enabled:
+                topk.update(
+                    client=(getattr(msg, "qos_client", None)
+                            or getattr(msg, "client", None) or "?"),
+                    pool=str(self.pgid.pool), pg=str(self.pgid),
+                    nbytes=int(getattr(msg, "_acct_bytes", 0)),
+                    lat_s=tracked.age)
         try:
             msg.connection.send_message(M.MOSDOpReply(
                 tid=msg.tid, rc=rc, outs=outs, results=results,

@@ -1,0 +1,203 @@
+"""bench_compare — diff two bench result files, metric by metric.
+
+``bench.py`` appends a ``BENCH_r0N.json`` per run; until now the perf
+trajectory between runs was eyeball-only.  This tool walks the
+``parsed`` trees of two result files (explicit paths, or the latest
+pair found in a directory), pairs every numeric leaf by its dotted
+path, and prints the relative change::
+
+    bench_compare OLD.json NEW.json [--threshold-pct 5] [--check]
+    bench_compare --dir . [--check]          # latest two BENCH_r0N
+
+Direction matters: most metrics are higher-is-better (GB/s, ops/sec,
+occupancy), but latency/overhead families are lower-is-better.  The
+classifier is a name heuristic (``LOWER_IS_BETTER``); a metric whose
+suffix matches is graded inverted.  ``--check`` exits non-zero when
+any metric regressed past the threshold — the verify skill's perf
+gate.  Counters that merely describe the run (seeds, sizes, counts of
+work attempted) are noise, not performance; ``IGNORE`` drops them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+# dotted-path substrings that mark a metric as lower-is-better
+LOWER_IS_BETTER = (
+    "_ms", "_us", "_s", "_sec", "latency", "p99", "p50", "drift",
+    "overhead", "compile", "err", "idle", "violation", "ratio",
+    "tax",
+)
+# run descriptors, not performance: never graded
+IGNORE = (
+    "seed", "fingerprint", "osds", "pgs", "numrep", "stripes",
+    "bytes", "workers", "duration", "offered", "limit", "port",
+    "epoch", "records", "keys_tracked", "launches", "spans",
+    "samples", "n_ops", "size", "count", "rounds", "batch",
+)
+
+
+def _is_lower_better(path: str) -> bool:
+    leaf = path.rsplit(".", 1)[-1]
+    return any(tok in leaf for tok in LOWER_IS_BETTER)
+
+
+def _is_ignored(path: str) -> bool:
+    leaf = path.rsplit(".", 1)[-1]
+    return any(tok in leaf for tok in IGNORE)
+
+
+def flatten(node, prefix="") -> dict[str, float]:
+    """Numeric leaves of a nested dict, keyed by dotted path.
+    Booleans pass through as 0/1 so flags like ``top1_is_culprit``
+    are diffable; strings and lists are descriptive, not metrics."""
+    out: dict[str, float] = {}
+    if isinstance(node, dict):
+        for k, v in node.items():
+            p = f"{prefix}.{k}" if prefix else str(k)
+            out.update(flatten(v, p))
+    elif isinstance(node, bool):
+        out[prefix] = 1.0 if node else 0.0
+    elif isinstance(node, (int, float)):
+        out[prefix] = float(node)
+    return out
+
+
+def compare(old: dict, new: dict,
+            threshold_pct: float = 5.0) -> dict:
+    """Pair numeric leaves of two ``parsed`` trees and grade each
+    change.  Returns ``{rows, regressions, added, removed}`` where a
+    row is ``(path, old, new, delta_pct, verdict)`` and verdict is
+    one of ``ok``/``regressed``/``improved``/``flat``."""
+    a, b = flatten(old), flatten(new)
+    rows, regressions = [], []
+    for path in sorted(set(a) & set(b)):
+        if _is_ignored(path):
+            continue
+        va, vb = a[path], b[path]
+        if va == vb:
+            rows.append((path, va, vb, 0.0, "flat"))
+            continue
+        if va == 0.0:
+            delta = float("inf") if vb > 0 else float("-inf")
+        else:
+            delta = 100.0 * (vb - va) / abs(va)
+        worse = delta > 0 if _is_lower_better(path) else delta < 0
+        if worse and abs(delta) > threshold_pct:
+            verdict = "regressed"
+            regressions.append(path)
+        elif not worse and abs(delta) > threshold_pct:
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        rows.append((path, va, vb, delta, verdict))
+    return {
+        "rows": rows,
+        "regressions": regressions,
+        "added": sorted(k for k in b if k not in a),
+        "removed": sorted(k for k in a if k not in b),
+    }
+
+
+def latest_pair(directory: str) -> tuple[str, str]:
+    """The two highest-numbered ``BENCH_r0N.json`` files."""
+    pat = re.compile(r"^BENCH_r(\d+)\.json$")
+    runs = sorted(
+        (int(m.group(1)), os.path.join(directory, f))
+        for f in os.listdir(directory)
+        if (m := pat.match(f)))
+    if len(runs) < 2:
+        raise FileNotFoundError(
+            f"need two BENCH_rNN.json files in {directory!r}, "
+            f"found {len(runs)}")
+    return runs[-2][1], runs[-1][1]
+
+
+def _load_parsed(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    return doc.get("parsed") or doc
+
+
+def _fmt(v: float) -> str:
+    if v in (float("inf"), float("-inf")):
+        return "inf"
+    return f"{v:.4g}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="diff two bench result files metric-by-metric")
+    ap.add_argument("old", nargs="?", help="older BENCH_rNN.json")
+    ap.add_argument("new", nargs="?", help="newer BENCH_rNN.json")
+    ap.add_argument("--dir", default=None,
+                    help="compare the latest two BENCH_rNN.json here")
+    ap.add_argument("--threshold-pct", type=float, default=5.0,
+                    help="relative change that counts as movement")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when any metric regressed past the "
+                         "threshold")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.dir is not None:
+            old_path, new_path = latest_pair(args.dir)
+        elif args.old and args.new:
+            old_path, new_path = args.old, args.new
+        else:
+            ap.error("give OLD and NEW paths, or --dir")
+        rep = compare(_load_parsed(old_path), _load_parsed(new_path),
+                      threshold_pct=args.threshold_pct)
+    except (OSError, ValueError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "old": old_path, "new": new_path,
+            "threshold_pct": args.threshold_pct,
+            "regressions": rep["regressions"],
+            "added": rep["added"], "removed": rep["removed"],
+            "rows": [
+                {"metric": p, "old": a, "new": b,
+                 "delta_pct": (None if d in (float("inf"),
+                                             float("-inf"))
+                               else round(d, 2)),
+                 "verdict": v}
+                for p, a, b, d, v in rep["rows"]],
+        }, indent=1, sort_keys=True))
+    else:
+        print(f"# {old_path} -> {new_path} "
+              f"(threshold {args.threshold_pct:g}%)")
+        width = max((len(p) for p, *_ in rep["rows"]), default=6)
+        for path, va, vb, delta, verdict in rep["rows"]:
+            if verdict == "flat":
+                continue
+            arrow = {"regressed": "!!", "improved": "++"}.get(
+                verdict, "  ")
+            print(f"{arrow} {path:<{width}}  "
+                  f"{_fmt(va)} -> {_fmt(vb)}  "
+                  f"({delta:+.1f}%)")
+        for path in rep["removed"]:
+            print(f"-- {path} (metric gone)")
+        for path in rep["added"]:
+            print(f"** {path} (new metric)")
+        n = len(rep["regressions"])
+        print(f"# {n} regression(s) past threshold")
+        for path in rep["regressions"]:
+            print(f"#   {path}")
+
+    if args.check and rep["regressions"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,12 @@
     ceph -m ... progress [json]   (mgr progress events)
     ceph -m ... iostat [json]     (live rates from the telemetry spine)
     ceph -m ... osd perf [json]   (commit latency + device launches)
+    ceph -m ... osd top [clients|pools|pgs] [--by ops|bytes|p99]
+        [--count N] [json]   (cluster-merged heavy hitters)
+    ceph -m ... alerts [status|history|rules [KNOB [VAL]]|
+        silence NAME [TTL|--off]|enable [SEED]|disable]
+    ceph -m ... tracing exemplar [METRIC [BUCKET]]
+        (slowest-op trace id per latency histogram bucket)
     ceph -m ... pg stat | pg dump
     ceph -m ... osd tree | osd dump | osd stat | osd pool ls
     ceph -m ... osd pool create NAME [--pg-num N] [--size N] [--type T]
@@ -310,6 +316,75 @@ def _dispatch(args, rest) -> int:
             if outs:
                 print(outs, file=sys.stderr)
             return 0 if rc == 0 else 1
+        elif rest[0] == "osd" and rest[1:2] == ["top"]:
+            # `ceph osd top [clients|pools|pgs] [--by ops|bytes|p99]
+            #  [--count N] [json]` — cluster-merged heavy hitters
+            sub = argparse.ArgumentParser(prog="ceph osd top")
+            sub.add_argument("dim", nargs="?", default="clients",
+                             choices=("clients", "pools", "pgs"))
+            sub.add_argument("--by", default="ops",
+                             choices=("ops", "bytes", "p99"))
+            sub.add_argument("--count", type=int, default=10)
+            # "json" is a bare token, not a positional — argparse
+            # refuses positionals after interleaved optionals
+            json_out = "json" in rest[2:]
+            a = sub.parse_args([t for t in rest[2:] if t != "json"])
+            rc, outs, outb = mc.mgr_command(
+                {"prefix": "osd top", "dim": a.dim, "by": a.by,
+                 "count": a.count})
+            if rc == 0 and outb is not None and not json_out:
+                print(_render_osd_top(outb))
+                return 0
+            if outb is not None:
+                print(json.dumps(outb, indent=2, default=str))
+            if outs:
+                print(outs, file=sys.stderr)
+            return 0 if rc == 0 else 1
+        elif rest[0] == "tracing" and rest[1:2] == ["exemplar"]:
+            # `ceph tracing exemplar [METRIC [BUCKET]]` — metric→trace
+            # lookup: the slowest-op trace id per histogram bucket
+            cmd = {"prefix": "tracing exemplar"}
+            if len(rest) > 2:
+                cmd["metric"] = rest[2]
+            if len(rest) > 3:
+                cmd["bucket"] = int(rest[3])
+            return _run_mgr_command(mc, cmd)
+        elif rest[0] == "alerts":
+            # mgr alert rules: status|history|rules|silence|enable|
+            # disable
+            verb = rest[1] if len(rest) > 1 else "status"
+            cmd = {"prefix": f"alerts {verb}"}
+            json_out = False
+            pos = []
+            for tok in rest[2:]:
+                if tok == "json":
+                    json_out = True
+                elif tok == "--off":
+                    cmd["off"] = True
+                elif "=" in tok:
+                    k, v = tok.split("=", 1)
+                    cmd[k] = int(v) if v.lstrip("-").isdigit() else v
+                else:
+                    pos.append(tok)
+            if verb == "silence" and pos:
+                cmd["name"] = pos[0]
+                if len(pos) > 1:
+                    cmd["ttl"] = float(pos[1])
+            elif verb == "rules" and pos:
+                cmd["knob"] = pos[0]
+                if len(pos) > 1:
+                    cmd["value"] = pos[1]
+            elif verb == "enable" and pos:
+                cmd["seed"] = int(pos[0])
+            rc, outs, outb = mc.mgr_command(cmd)
+            if rc == 0 and verb == "status" and outb and not json_out:
+                print(_render_alerts(outb))
+                return 0
+            if outb is not None:
+                print(json.dumps(outb, indent=2, default=str))
+            if outs:
+                print(outs, file=sys.stderr)
+            return 0 if rc == 0 else 1
         elif rest[0] == "osd" and rest[1:2] == ["perf"]:
             # commit latency + device-launch breakdown per OSD
             rc, outs, outb = mc.mgr_command({"prefix": "osd perf"})
@@ -484,6 +559,53 @@ def _render_autotune(out: dict) -> str:
             f"{'*' if k.get('pinned') else '':>5}"
             f"{k.get('cooldown_ticks', 0):>6}"
             f"{str(k.get('last_action') or '-'):>10}")
+    return "\n".join(lines)
+
+
+def _render_osd_top(out: dict) -> str:
+    """`ceph osd top` panel: cluster-merged heavy hitters for one
+    attribution dimension, with the sketch's error bound."""
+    lines = [
+        f"top {out.get('dim')} by {out.get('by')} "
+        f"(merged from {len(out.get('osds') or [])} osds, "
+        f"err floor {out.get('err_floor', 0)})",
+        f"{'KEY':<28}{'OPS':>10}{'±ERR':>8}{'BYTES':>14}"
+        f"{'AVG(MS)':>10}{'P99(MS)':>10}",
+    ]
+    for r in out.get("rows") or []:
+        lines.append(
+            f"{str(r.get('key', '')):<28}{r.get('ops', 0):>10}"
+            f"{r.get('err', 0):>8}{r.get('bytes', 0):>14}"
+            f"{r.get('lat_avg_ms', 0.0):>10.2f}"
+            f"{r.get('p99_ms', 0.0):>10.2f}")
+    return "\n".join(lines)
+
+
+def _render_alerts(out: dict) -> str:
+    """`ceph alerts status` panel: engine header + one row per
+    firing alert / active silence."""
+    state = "enabled" if out.get("enabled") else "disabled"
+    lines = [
+        f"alerts: {state} seed={out.get('seed')} "
+        f"tick={out.get('tick', 0)} "
+        f"fired={out.get('fired_total', 0)} "
+        f"cleared={out.get('cleared_total', 0)} "
+        f"digest={str(out.get('journal_digest', ''))[:12]}",
+    ]
+    firing = out.get("firing") or {}
+    if not firing:
+        lines.append("no alerts firing")
+    else:
+        lines.append(f"{'ALERT':<36}{'CHECK':<20}{'SEV':>5}"
+                     f"{'VALUE':>10}")
+        for name in sorted(firing):
+            r = firing[name] or {}
+            lines.append(
+                f"{name:<36}{str(r.get('check', '')):<20}"
+                f"{str(r.get('severity', '')):>5}"
+                f"{float(r.get('value', 0.0)):>10.2f}")
+    for name in sorted(out.get("silences") or {}):
+        lines.append(f"silenced: {name}")
     return "\n".join(lines)
 
 

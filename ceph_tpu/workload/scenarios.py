@@ -272,6 +272,28 @@ def ramp_to_collapse(*, start_rate: float = 40.0,
         rig.close()
 
 
+def _topk_top1_client(cluster):
+    """Merge the per-OSD heavy-hitter sketches (client dimension) and
+    return the cluster-wide #1 key by BYTES, or None when the
+    sketches are off or empty (procs-mode handles expose no
+    in-process OSD).  Bytes, not ops: the aggressor's execution is
+    mClock-capped, so by executed-op count a well-behaved GET tenant
+    can legitimately outrank it — the damage it offers the cluster is
+    its write volume, which the cap cannot disguise."""
+    from ..core import topk as _topk
+    dumps = []
+    for osd in getattr(cluster, "osds", {}).values():
+        tk = getattr(osd, "topk", None)
+        if tk is not None and tk.enabled:
+            d = tk.dump().get("clients")
+            if d and d.get("entries"):
+                dumps.append(d)
+    if not dumps:
+        return None
+    rows = _topk.rank(_topk.merge_sketches(dumps), by="bytes", n=1)
+    return rows[0]["key"] if rows else None
+
+
 def noisy_neighbor(*, victim_rate: float = 30.0,
                    aggressor_rate: float = 200.0,
                    duration: float = 3.0, seed: int = 23,
@@ -338,6 +360,13 @@ def noisy_neighbor(*, victim_rate: float = 30.0,
         solo_p99 = _exact_p99_ms("solo")
 
         phase["cur"] = "duo"
+        # attribution accuracy rides this drill: clear the per-OSD
+        # top-K sketches so the duo window alone decides whether the
+        # sketch's #1 client is the injected aggressor tenant
+        for osd in getattr(rig.cluster, "osds", {}).values():
+            tk = getattr(osd, "topk", None)
+            if tk is not None:
+                tk.reset()
         tracker_duo = SLOTracker(DEFAULT_SLO_MS)
         aggressor = TenantProfile("aggressor", aggressor_rate,
                                   kind="poisson", mix=amix,
@@ -368,6 +397,7 @@ def noisy_neighbor(*, victim_rate: float = 30.0,
         duo["open_loop_aggressor"] = agg_out
         duo_p99 = _exact_p99_ms("duo")
         agg = duo["slo"]["tenants"]["aggressor"][S3_PUT]
+        top1 = _topk_top1_client(rig.cluster)
         return {
             "solo_p99_ms": solo_p99,
             "duo_p99_ms": duo_p99,
@@ -378,6 +408,10 @@ def noisy_neighbor(*, victim_rate: float = 30.0,
             "aggressor_goodput_ops": agg["goodput_ops"],
             "aggressor_offered": aggressor_rate,
             "aggressor_limit": aggressor_limit,
+            # workload attribution: did the space-saving sketch's
+            # heaviest client match the tenant we know flooded?
+            "top1_client": top1,
+            "top1_is_culprit": top1 == "rgw:aggressor",
             "solo": solo, "duo": duo, "seed": seed,
         }
     finally:

@@ -1,0 +1,352 @@
+"""Alerting plane: burn-rate/anomaly engine determinism (seeded
+replay is bit-identical), the multi-window pairing semantics, and the
+module end-to-end — a ramp-to-collapse fires ``SLO_BURN_RATE`` into
+mon health BEFORE the harness reports its first hard violation,
+clears once the spend stops, and round-trips ``ceph alerts
+history``."""
+
+import json
+import time
+
+import pytest
+
+from ceph_tpu.mgr.alerts import (AlertEngine, AlertsModule,
+                                 _Z_SATURATED, default_rules, mad_z,
+                                 window_burn)
+from ceph_tpu.mgr.telemetry import TelemetrySpine
+
+
+def _sig(*, fast=0.0, fast_long=0.0, slow=0.0, slow_long=0.0,
+         series=None, scenario="s"):
+    return {"slo": {scenario: {"burn": {
+                "fast": fast, "fast_long": fast_long,
+                "slow": slow, "slow_long": slow_long}}},
+            "series": series or {}}
+
+
+class TestMath:
+    def test_mad_z_flat_series_scores_zero(self):
+        assert mad_z([5.0] * 10) == 0.0
+        assert mad_z([1.0]) == 0.0
+
+    def test_mad_z_spike_on_noisy_baseline(self):
+        base = [10.0, 11.0, 9.0, 10.5, 9.5, 10.2, 9.8]
+        assert mad_z(base + [10.1]) < 1.0
+        assert mad_z(base + [300.0]) > 6.0
+
+    def test_mad_z_zero_mad_saturates_not_infs(self):
+        # constant baseline + any deviation: z must stay finite so
+        # journals remain strict JSON
+        z = mad_z([4.0, 4.0, 4.0, 4.0, 9.0])
+        assert z == _Z_SATURATED
+        assert json.loads(json.dumps(z)) == z
+
+    def test_window_burn_divides_by_full_window(self):
+        # only 2s of history against a 10s window: the delta still
+        # divides by 10 — partial data under-reports, never inflates
+        samples = [(100.0, 0.0), (102.0, 0.5)]
+        assert window_burn(samples, 10.0, 0.01) == \
+            pytest.approx(0.5 / 10.0 / 0.01)
+
+    def test_window_burn_picks_sample_at_window_edge(self):
+        samples = [(0.0, 0.0), (5.0, 1.0), (9.0, 1.2), (10.0, 2.0)]
+        # 4s lookback from t=10 → v0 is the t=5 sample (last ≤ 6)
+        assert window_burn(samples, 4.0, 1.0) == \
+            pytest.approx((2.0 - 1.0) / 4.0)
+        assert window_burn([], 4.0, 1.0) == 0.0
+        assert window_burn(samples, 0.0, 1.0) == 0.0
+
+
+class TestEngine:
+    def test_pair_requires_both_windows(self):
+        eng = AlertEngine(seed=1)
+        # short window hot, long window cold: a blip — no fire
+        assert eng.step(_sig(fast=100.0, fast_long=0.1)) == []
+        assert eng.firing == {}
+        # both hot: fires once, refreshes (not re-fires) while hot
+        ev = eng.step(_sig(fast=20.0, fast_long=15.0))
+        assert [e["event"] for e in ev] == ["fire"]
+        assert ev[0]["name"] == "slo-burn-fast:s"
+        assert ev[0]["severity"] == "ERR"
+        assert eng.step(_sig(fast=21.0, fast_long=15.5)) == []
+        assert eng.firing["slo-burn-fast:s"]["value"] == 21.0
+        # spend stops: clears
+        ev = eng.step(_sig())
+        assert [e["event"] for e in ev] == ["clear"]
+        assert eng.firing == {}
+        assert (eng.fired_total, eng.cleared_total) == (1, 1)
+
+    def test_slow_pair_is_a_warn_ticket(self):
+        eng = AlertEngine(seed=1)
+        ev = eng.step(_sig(slow=7.0, slow_long=6.5))
+        assert ev[0]["name"] == "slo-burn-slow:s"
+        assert ev[0]["severity"] == "WARN"
+        assert ev[0]["check"] == "SLO_BURN_RATE"
+
+    def test_anomaly_needs_min_samples_then_fires(self):
+        eng = AlertEngine(seed=2)
+        short = {"osd.0": {"op": [10.0, 10.0, 900.0]}}
+        assert eng.step(_sig(series=short)) == []      # < min_samples
+        base = [10.0, 11.0, 9.0, 10.5, 9.5, 10.2, 9.8]
+        hot = {"osd.0": {"op": base + [900.0]}}
+        ev = eng.step(_sig(series=hot))
+        assert [e["name"] for e in ev] == ["anomaly:osd.0:op"]
+        assert ev[0]["check"] == "TELEMETRY_ANOMALY"
+        calm = {"osd.0": {"op": base + [10.0]}}
+        ev = eng.step(_sig(series=calm))
+        assert [e["event"] for e in ev] == ["clear"]
+
+    def test_seeded_replay_is_bit_identical(self):
+        """The acceptance bar: burn + anomaly decisions over a messy
+        float trace replay to the byte-identical journal."""
+        base = [10.0 + 0.1 * ((i * 7) % 13) for i in range(12)]
+        trace = []
+        for i in range(30):
+            series = {"osd.0": {"op": base + [900.0 / 7.0 if
+                                              10 <= i < 14 else
+                                              10.0 + 1e-9 * i]},
+                      "osd.1": {"device_bytes": base}}
+            trace.append(_sig(
+                fast=(29.7 / 1.9 if 5 <= i < 12 else 0.03),
+                fast_long=(31.4 / 2.1 if 5 <= i < 12 else 0.01),
+                slow=6.7, slow_long=(6.1 if i < 20 else 0.2),
+                series=series))
+        a = AlertEngine(seed=0xBEEF)
+        for sig in trace:
+            a.step(sig)
+        assert a.journal, "trace produced no transitions"
+        kinds = {e["name"] for e in a.journal}
+        assert "anomaly:osd.0:op" in kinds
+        assert "slo-burn-fast:s" in kinds
+        b = AlertEngine.replay(0xBEEF, a.trace)
+        assert json.dumps(b.journal, sort_keys=True) == \
+            json.dumps(a.journal, sort_keys=True)
+        assert b.journal_digest() == a.journal_digest()
+        # journal entries are ordered and tick-stamped
+        assert [e["seq"] for e in a.journal] == \
+            list(range(len(a.journal)))
+
+    def test_rules_override_changes_thresholds(self):
+        eng = AlertEngine(seed=3, rules={"fast_burn": 2.0})
+        ev = eng.step(_sig(fast=3.0, fast_long=2.5))
+        assert ev and ev[0]["name"] == "slo-burn-fast:s"
+        assert eng.rules["slow_burn"] == default_rules()["slow_burn"]
+
+
+def _mgr_cmd(r, **cmd):
+    rc, outs, out = r.mgr_command(cmd)
+    assert rc == 0, (cmd, outs, out)
+    return out
+
+
+class TestAlertsEndToEnd:
+    @pytest.fixture(scope="class")
+    def rig(self):
+        from ceph_tpu.vstart import MiniCluster
+        with MiniCluster(n_mons=1, n_osds=2) as c:
+            r = c.rados()
+            r.create_pool("alerts", pg_num=4)
+            io = r.open_ioctx("alerts")
+            for i in range(8):
+                io.write_full(f"o{i}", b"x" * 512)
+            c.start_mgr("al")
+            c.wait_for_active_mgr()
+            yield c, r
+            r.shutdown()
+
+    def _ingest(self, r, violation_s, *, hard=False, goodput=50.0):
+        _mgr_cmd(r, prefix="slo ingest", scenario="ramp",
+                 report={"goodput_ops": goodput, "offered_rate": 60.0,
+                         "tenants": {"t": {"s3_put": {
+                             "violation_s": violation_s,
+                             "in_violation": hard,
+                             "p99_ms": 80.0}}}})
+
+    def test_ramp_fires_before_hard_violation_then_clears(self, rig):
+        c, r = rig
+        st = _mgr_cmd(r, prefix="alerts status")
+        assert st["enabled"] is True
+        assert st["rules"] == default_rules()
+        # shrink the windows so the SRE pairing plays out in seconds
+        # (wall-clock rings; the defaults are production-scale)
+        for knob, val in (("fast_window_s", 0.5),
+                          ("slow_window_s", 0.5)):
+            out = _mgr_cmd(r, prefix="alerts rules", knob=knob,
+                           value=str(val))
+            assert out[knob] == val
+
+        def firing():
+            return _mgr_cmd(r, prefix="alerts status")["firing"]
+
+        # ramp-to-collapse: cumulative violation seconds accelerate,
+        # but every report is still SOFT (in_violation False) — the
+        # burn alert must beat the tracker's own hard verdict
+        fired_during_soft_ramp = False
+        v = 0.0
+        for i in range(40):
+            v += 0.02 * i
+            self._ingest(r, v)
+            if "slo-burn-fast:ramp" in firing():
+                fired_during_soft_ramp = True
+                break
+            time.sleep(0.15)
+        assert fired_during_soft_ramp, \
+            "burn-rate alert never fired during the soft ramp"
+        rec = firing()["slo-burn-fast:ramp"]
+        assert rec["severity"] == "ERR"
+        assert rec["value"] > default_rules()["fast_burn"]
+        # ... and it reaches mon health as SLO_BURN_RATE
+        def health_checks():
+            rc, _, rep = c._clients[0].mon_command(
+                {"prefix": "health detail"})
+            return {ch["code"]: ch
+                    for ch in (rep.get("checks") or [])}
+
+        deadline = time.monotonic() + 15.0
+        checks = {}
+        while time.monotonic() < deadline:
+            checks = health_checks()
+            if "SLO_BURN_RATE" in checks:
+                break
+            time.sleep(0.2)
+        assert "SLO_BURN_RATE" in checks, checks
+        assert checks["SLO_BURN_RATE"]["severity"] == "ERR"
+        assert any("ramp" in d for d in
+                   checks["SLO_BURN_RATE"]["detail"])
+        # only NOW does the tracker report a hard violation
+        self._ingest(r, v + 0.5, hard=True)
+
+        # load drops: the spend flatlines, the alert clears, health
+        # returns to rest
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            self._ingest(r, v + 0.5)     # flat cumulative spend
+            if "slo-burn-fast:ramp" not in firing():
+                break
+            time.sleep(0.2)
+        assert "slo-burn-fast:ramp" not in firing(), \
+            "burn alert never cleared after the ramp stopped"
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if "SLO_BURN_RATE" not in health_checks():
+                break
+            time.sleep(0.2)
+        assert "SLO_BURN_RATE" not in health_checks()
+
+        # history round-trips: fire + clear are journaled, and the
+        # recorded trace replays to the recorder's own digest under
+        # the rules that were live
+        hist = _mgr_cmd(r, prefix="alerts history", trace=True)
+        events = [(e["event"], e["name"]) for e in hist["events"]]
+        assert ("fire", "slo-burn-fast:ramp") in events
+        assert ("clear", "slo-burn-fast:ramp") in events
+        st = _mgr_cmd(r, prefix="alerts status")
+        rep_eng = AlertEngine.replay(hist["seed"], hist["trace"],
+                                     rules=st["rules"])
+        assert rep_eng.journal_digest() == hist["journal_digest"]
+        # count-limited history returns the tail
+        tail = _mgr_cmd(r, prefix="alerts history", count=1)
+        assert len(tail["events"]) == 1
+        assert tail["events"][0] == hist["events"][-1]
+
+    def test_silence_suppresses_health_not_engine(self, rig):
+        c, r = rig
+        # refire by ramping again (windows already shrunk)
+        v = 100.0
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            v += 0.4
+            self._ingest(r, v)
+            if "slo-burn-fast:ramp" in _mgr_cmd(
+                    r, prefix="alerts status")["firing"]:
+                break
+            time.sleep(0.15)
+        # both pair members post into the same check code — silence
+        # the pair, or the slow ticket keeps the code raised
+        for name in ("slo-burn-fast:ramp", "slo-burn-slow:ramp"):
+            out = _mgr_cmd(r, prefix="alerts silence", name=name,
+                           ttl=60.0)
+            assert out["silenced"] is True
+        def health_codes():
+            rc, _, rep = c._clients[0].mon_command(
+                {"prefix": "health detail"})
+            return {ch["code"] for ch in (rep.get("checks") or [])}
+
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            v += 0.4
+            self._ingest(r, v)
+            if "SLO_BURN_RATE" not in health_codes():
+                break
+            time.sleep(0.2)
+        assert "SLO_BURN_RATE" not in health_codes(), \
+            "silence did not pull the alert out of mon health"
+        st = _mgr_cmd(r, prefix="alerts status")
+        # the engine still sees it firing — silence is presentation
+        assert "slo-burn-fast:ramp" in st["firing"]
+        assert "slo-burn-fast:ramp" in st["silences"]
+        for name in ("slo-burn-fast:ramp", "slo-burn-slow:ramp"):
+            _mgr_cmd(r, prefix="alerts silence", name=name, off=True)
+
+    def test_ceph_cli_renders_alerts_panel(self, rig, capsys):
+        from ceph_tpu.tools import ceph as ceph_cli
+        c, r = rig
+        m = ["-m", f"127.0.0.1:{c.monmap.mons[0].port}"]
+        assert ceph_cli.main(m + ["alerts"]) == 0
+        out = capsys.readouterr().out
+        assert "alerts: enabled" in out
+        assert "digest=" in out
+        assert ceph_cli.main(m + ["alerts", "rules"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["rules"]["fast_burn"] == \
+            default_rules()["fast_burn"]
+        assert doc["options"]["slo_budget"] == \
+            "mgr_alerts_slo_budget"
+        assert ceph_cli.main(m + ["alerts", "history",
+                                  "count=2"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["events"]) <= 2
+
+    def test_disable_unposts_and_bad_knob_rejected(self, rig):
+        c, r = rig
+        out = _mgr_cmd(r, prefix="alerts disable")
+        assert out["enabled"] is False
+        rc, _, msg = r.mgr_command(
+            {"prefix": "alerts rules", "knob": "nope"})
+        assert rc == -22, msg
+        out = _mgr_cmd(r, prefix="alerts enable", seed=99)
+        assert out == {"enabled": True, "seed": 99}
+        # fresh engine under the new seed
+        assert _mgr_cmd(r, prefix="alerts status")["tick"] == 0
+
+
+class TestModuleGather:
+    """Signal derivation without a cluster: the module computes the
+    four burn numbers from the spine's rings."""
+
+    class _Ctx:
+        def __init__(self, spine):
+            class _D:
+                modules = {"telemetry_spine": spine}
+            self._d = _D()
+
+        def mon_command(self, cmd):
+            return 0, "", ""
+
+    def test_gather_computes_burn_pairs_from_rings(self):
+        spine = TelemetrySpine(None)
+        mod = AlertsModule.__new__(AlertsModule)
+        mod.ctx = self._Ctx(spine)
+        mod.engine = AlertEngine(rules={"fast_window_s": 1.0,
+                                        "slow_window_s": 2.0,
+                                        "slo_budget": 0.01})
+        ring = spine._ring("slo.unit", "violation_s")
+        for i in range(6):
+            ring.append(100.0 + i * 0.2, 0.3 * i)
+        sig = mod._gather()
+        burn = sig["slo"]["unit"]["burn"]
+        # Δ over the 1s window is 0.3/0.2s·1s = 1.5 → /1/0.01 = 150
+        assert burn["fast"] > burn["fast_long"] > 0.0
+        assert burn["slow"] > 0.0
+        assert set(burn) == {"fast", "fast_long", "slow",
+                             "slow_long"}

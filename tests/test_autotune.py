@@ -7,6 +7,8 @@ CPU-lenient regime-shift parity smoke (the strict parity bar rides in
 import json
 import time
 
+import pytest
+
 from ceph_tpu.mgr.autotune import (KNOBS, AutotuneEngine,
                                    AutotuneModule)
 from ceph_tpu.mgr.telemetry import TelemetrySpine
@@ -153,14 +155,20 @@ def test_slo_pressure_rings_accumulate_history():
     ingest(0.8, 30.0)
     dump = spine.series_dump()
     assert "slo.unit" in dump, sorted(dump)
-    assert len(dump["slo.unit"]["violation_s"]) == 2
+    # slo rings surface windowed per-second numbers, not raw sums
+    win = dump["slo.unit"]["violation_s_per_s"]
+    assert len(win) == 2
     p = spine.slo_pressure()
     assert p["pressure"] > 0.0
     assert p["scenarios"]["unit"]["goodput_ops"] == 30.0
     assert p["worst_p99_ms"] == 80.0
-    # pressure history must NOT leak into the per-OSD rates view
+    # the rates view and the series dump agree on the same windowed
+    # numbers (slo rings used to be excluded from one, raw in the
+    # other)
     view = spine.export_view()
-    assert "slo.unit" not in view["rates"]
+    rates = view["rates"]["slo.unit"]
+    assert rates["violation_s_per_s"] == pytest.approx(win[-1][1])
+    assert rates["violation_s_per_s"] > 0.0
     assert view["slo_pressure"]["pressure"] > 0.0
 
 

@@ -173,3 +173,62 @@ class TestCephDf:
             assert "health:" in capsys.readouterr().out
         finally:
             r.shutdown()
+
+
+class TestBenchCompare:
+    """tools/bench_compare — the perf-trajectory gate (pure files,
+    no cluster)."""
+
+    def _write(self, tmp_path, name, parsed):
+        p = tmp_path / name
+        p.write_text(json.dumps({"n": 1, "parsed": parsed}))
+        return str(p)
+
+    def test_direction_aware_regressions_and_check(self, tmp_path,
+                                                   capsys):
+        from ceph_tpu.tools import bench_compare
+        old = self._write(tmp_path, "BENCH_r01.json", {
+            "encode_GBps": 100.0,       # higher-is-better: drops
+            "p99_ms": 10.0,             # lower-is-better: rises
+            "trace_overhead_pct": 8.0,  # lower-is-better: improves
+            "goodput_ops": 50.0,        # small move: inside threshold
+        })
+        new = self._write(tmp_path, "BENCH_r02.json", {
+            "encode_GBps": 80.0,
+            "p99_ms": 14.0,
+            "trace_overhead_pct": 2.0,
+            "goodput_ops": 51.0,
+        })
+        assert bench_compare.main([old, new, "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert sorted(rep["regressions"]) == ["encode_GBps",
+                                              "p99_ms"]
+        verdicts = {r["metric"]: r["verdict"] for r in rep["rows"]}
+        assert verdicts["trace_overhead_pct"] == "improved"
+        assert verdicts["goodput_ops"] == "ok"
+        # --check turns regressions into a non-zero exit
+        assert bench_compare.main([old, new, "--check"]) == 1
+        capsys.readouterr()
+        # latest-pair discovery walks the directory
+        assert bench_compare.main(
+            ["--dir", str(tmp_path), "--check"]) == 1
+        head = capsys.readouterr().out.splitlines()[0]
+        assert "BENCH_r01.json" in head and "BENCH_r02.json" in head
+
+    def test_clean_diff_passes_check(self, tmp_path, capsys):
+        from ceph_tpu.tools import bench_compare
+        old = self._write(tmp_path, "BENCH_r01.json",
+                          {"encode_GBps": 100.0, "p99_ms": 10.0})
+        new = self._write(tmp_path, "BENCH_r02.json",
+                          {"encode_GBps": 103.0, "p99_ms": 9.8,
+                           "attribution_overhead_pct": 0.4})
+        assert bench_compare.main([old, new, "--check"]) == 0
+        out = capsys.readouterr().out
+        assert "0 regression(s)" in out
+        assert "attribution_overhead_pct (new metric)" in out
+
+    def test_missing_inputs_fail_cleanly(self, tmp_path, capsys):
+        from ceph_tpu.tools import bench_compare
+        assert bench_compare.main(
+            ["--dir", str(tmp_path)]) == 2
+        assert "bench_compare:" in capsys.readouterr().err

@@ -12,7 +12,16 @@ source, so new surfaces are linted the day they appear:
 - **exposition format** — the mgr exporter's /metrics text parses
   line-by-line under the Prometheus exposition rules: valid metric
   and label names, float-parseable values, ``# TYPE``/``# HELP`` at
-  most once per family.
+  most once per family, and OpenMetrics exemplar suffixes
+  (``# {trace_id="..."} value ts``) only on ``_bucket`` samples with
+  well-formed labels and numeric value/timestamp;
+- **counter coverage** — every counter a daemon registers in its
+  ``perf schema`` is reachable from the exporter text under the
+  reference family naming (``ceph_<kind>_<name>`` with
+  ``_sum``/``_count``/``_bucket`` expansions).  Known-unreachable
+  counters live in ``COVERAGE_ALLOW``; each entry is staleness-
+  checked both ways (must still exist in a schema AND still be
+  absent from the text), so the allowlist can't rot either.
 
 Commands that require arguments get them from ``ARGS``; the entry is
 checked for staleness — an ARGS key for a command that no longer
@@ -37,15 +46,23 @@ ARGS = {
 _METRIC_LINE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # family name
     r"(?:\{([^}]*)\})?"                     # optional label set
-    r" (\S+)$")                             # value
+    r" (\S+?)"                              # value
+    r"(?: # \{([^}]*)\} (\S+) (\S+))?$")    # OpenMetrics exemplar
 _LABEL = re.compile(
     r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+# perf counters a daemon registers but the exporter knowingly does
+# not surface ("<daemon-kind>:<counter>"); staleness-checked below
+COVERAGE_ALLOW: set[str] = set()
 _COMMENT = re.compile(r"^# (TYPE|HELP) ([a-zA-Z_:][a-zA-Z0-9_:]*) .")
 
 
 @pytest.fixture(scope="module")
 def cluster():
-    c = MiniCluster(n_mons=1, n_osds=1)
+    # tracing on so op-latency buckets carry exemplar suffixes and
+    # the exposition lint exercises the OpenMetrics syntax path
+    c = MiniCluster(n_mons=1, n_osds=1,
+                    osd_config={"jaeger_tracing_enable": True})
     c.start()
     r = c.rados()
     r.create_pool("lint", pg_num=1, size=1)
@@ -110,7 +127,7 @@ def test_exporter_text_passes_exposition_rules(cluster):
             f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
         text = resp.read().decode()
     families_typed = []
-    samples = 0
+    samples = exemplars = 0
     for line in text.splitlines():
         if not line:
             continue
@@ -123,15 +140,111 @@ def test_exporter_text_passes_exposition_rules(cluster):
         m = _METRIC_LINE.match(line)
         assert m, f"unparseable sample line: {line!r}"
         float(m.group(3))               # value must be a number
-        labels = m.group(2)
-        if labels:
-            rebuilt = ",".join(
-                f'{k}="{v}"' for k, v in _LABEL.findall(labels))
-            assert rebuilt == labels, \
-                f"bad label syntax in: {line!r}"
+        for labels in (m.group(2), m.group(4)):
+            if labels:
+                rebuilt = ",".join(
+                    f'{k}="{v}"' for k, v in _LABEL.findall(labels))
+                assert rebuilt == labels, \
+                    f"bad label syntax in: {line!r}"
+        if m.group(4) is not None:      # exemplar suffix present
+            assert m.group(1).endswith("_bucket"), \
+                f"exemplar on a non-bucket sample: {line!r}"
+            float(m.group(5))           # exemplar value
+            float(m.group(6))           # exemplar timestamp
+            exemplars += 1
         samples += 1
     assert samples >= 20, f"only {samples} samples scraped"
+    # tracing is on in this fixture, so the op-latency buckets must
+    # carry at least one metric→trace exemplar for the lint to bite
+    assert exemplars >= 1, "no exemplar suffix on any _bucket line"
     # TYPE at most once per family
     assert len(families_typed) == len(set(families_typed)), \
         sorted(f for f in families_typed
                if families_typed.count(f) > 1)
+
+
+def _scraped_families(cluster):
+    port = cluster.prometheus_port()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+        text = resp.read().decode()
+    fams = set()
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            m = _METRIC_LINE.match(line)
+            if m:
+                fams.add(m.group(1))
+    return fams
+
+
+def test_every_perf_counter_reaches_the_exporter(cluster):
+    """Counter coverage: each counter in each daemon's ``perf
+    schema`` must surface in /metrics under the reference family
+    naming, unless allowlisted — and allowlist entries must stay both
+    real (still registered) and unreachable (still absent)."""
+    from ceph_tpu.core.admin_socket import admin_command
+    from ceph_tpu.mgr.exporter import _san
+
+    fams = _scraped_families(cluster)
+    mgr = next(iter(cluster.mgrs.values()))
+    checked, missing, allow_seen = 0, [], set()
+    for daemon, path in sorted(mgr.asok_paths.items()):
+        try:
+            schema = admin_command(path, "perf schema")
+            dump = admin_command(path, "perf dump")
+        except Exception:
+            continue            # daemon has no perf surface
+        dtype = _san(daemon.split(".", 1)[0])
+        for pcname, counters in (schema or {}).items():
+            for cname in counters:
+                val = (dump.get(pcname) or {}).get(cname)
+                base = f"ceph_{dtype}_{_san(cname)}"
+                if isinstance(val, dict) and "avgcount" in val:
+                    need = {base + "_sum", base + "_count"}
+                elif isinstance(val, dict) and "values" in val:
+                    if not val["values"]:
+                        continue    # hist never fed: nothing to emit
+                    need = {base + "_bucket", base + "_sum",
+                            base + "_count"}
+                else:
+                    need = {base}
+                checked += 1
+                reachable = need <= fams
+                key = f"{dtype}:{cname}"
+                if key in COVERAGE_ALLOW:
+                    allow_seen.add(key)
+                    assert not reachable, \
+                        f"stale allowlist entry {key!r}: now reachable"
+                    continue
+                if not reachable:
+                    missing.append((key, sorted(need - fams)))
+    assert checked >= 10, "coverage lint walked no real schema"
+    assert not missing, \
+        f"perf counters unreachable from exporter: {missing}"
+    # the other staleness direction: allowlisted counters must still
+    # exist in some daemon's schema
+    gone = COVERAGE_ALLOW - allow_seen
+    assert not gone, f"allowlist names unregistered counters: {gone}"
+
+
+def test_alert_rule_knobs_are_declared_options():
+    """Every `ceph alerts rules` knob maps to a declared Option and
+    the hardcoded engine default matches the Option default (mgr
+    modules don't read ConfigProxy — this lint is the tie)."""
+    from ceph_tpu.core.options import build_options
+    from ceph_tpu.mgr.alerts import RULES, AlertEngine, default_rules
+
+    opts = {o.name: o for o in build_options()}
+    for knob, (opt_name, default) in RULES.items():
+        assert opt_name in opts, \
+            f"alert knob {knob!r} names undeclared option {opt_name!r}"
+        opt = opts[opt_name]
+        assert opt.default == default, \
+            f"{knob}: engine default {default!r} != " \
+            f"Option default {opt.default!r}"
+        if opt.min is not None:
+            assert default >= opt.min
+        if opt.max is not None:
+            assert default <= opt.max
+    assert AlertEngine().rules == default_rules()
+    assert opts["mgr_alerts_enable"].default is True

@@ -379,3 +379,104 @@ class TestObservabilityParity:
             assert "ceph_osd_up 2" in text
             assert 'ceph_osd_op{ceph_daemon="osd.0"}' in text
             assert 'ceph_osd_op{ceph_daemon="osd.1"}' in text
+
+    def test_osd_top_alerts_and_exemplars_over_the_wire(self):
+        """PR-20 surfaces in procs mode: heavy-hitter sketches ride
+        the beacon from real child processes into `osd top`, every
+        ingested exemplar's trace id resolves through the clock-
+        rebasing collect_trace path, and a burn-rate ramp fires into
+        mon health over the wire."""
+        cluster = MiniCluster(
+            n_mons=1, n_osds=2, procs=True,
+            osd_config={"jaeger_tracing_enable": True})
+        with cluster:
+            r = cluster.rados()
+            r.create_pool("attr", pg_num=4, size=2)
+            io = r.open_ioctx("attr")
+            for i in range(16):
+                io.write_full(f"o{i}", b"y" * 1024)
+            cluster.start_mgr("m")
+            cluster.wait_for_active_mgr()
+
+            def mgr_ok(**cmd):
+                rc, outs, out = r.mgr_command(cmd)
+                assert rc == 0, (cmd, outs, out)
+                return out
+
+            # sketches merge across both child processes
+            deadline = time.monotonic() + 30
+            top = {}
+            while time.monotonic() < deadline:
+                top = mgr_ok(prefix="osd top", dim="clients")
+                if top["rows"] and len(top["osds"]) >= 2:
+                    break
+                time.sleep(0.3)
+            assert top["rows"], "osd top empty over the wire"
+            assert len(top["osds"]) >= 2, top["osds"]
+            assert sum(row["ops"] for row in top["rows"]) >= 16
+
+            # exemplars: beacon-shipped trace ids must resolve via
+            # the asok dump_tracing + clock-rebase merge
+            deadline = time.monotonic() + 30
+            rows = []
+            while time.monotonic() < deadline:
+                rows = mgr_ok(
+                    prefix="tracing exemplar")["exemplars"]
+                if rows:
+                    break
+                time.sleep(0.3)
+            assert rows, "no exemplars over the wire"
+            local_now = time.monotonic()
+            for ex in rows:
+                spans = cluster.collect_trace(ex["trace_id"])
+                assert spans, f"unresolvable exemplar: {ex}"
+                assert all(s["trace_id"] == ex["trace_id"]
+                           for s in spans)
+                # rebased spans land in this process's lifetime
+                assert all(local_now - 300 < s["start"]
+                           <= local_now + 1 for s in spans)
+
+            # burn-rate ramp fires SLO_BURN_RATE into the real mon
+            for knob in ("fast_window_s", "slow_window_s"):
+                mgr_ok(prefix="alerts rules", knob=knob,
+                       value="0.5")
+            v, fired = 0.0, False
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                v += 0.4
+                mgr_ok(prefix="slo ingest", scenario="ramp",
+                       report={"goodput_ops": 10.0,
+                               "offered_rate": 50.0,
+                               "tenants": {"t": {"s3_put": {
+                                   "violation_s": v,
+                                   "in_violation": False,
+                                   "p99_ms": 90.0}}}})
+                st = mgr_ok(prefix="alerts status")
+                if "slo-burn-fast:ramp" in st["firing"]:
+                    fired = True
+                    break
+                time.sleep(0.2)
+            assert fired, "burn alert never fired over the wire"
+
+            def health_codes():
+                rc, _, h = r.mon_command(
+                    {"prefix": "health detail"})
+                assert rc == 0
+                return {c["code"] for c in h.get("checks", [])}
+
+            deadline = time.monotonic() + 30
+            while "SLO_BURN_RATE" not in health_codes():
+                assert time.monotonic() < deadline, health_codes()
+                v += 0.4
+                mgr_ok(prefix="slo ingest", scenario="ramp",
+                       report={"goodput_ops": 10.0,
+                               "offered_rate": 50.0,
+                               "tenants": {"t": {"s3_put": {
+                                   "violation_s": v,
+                                   "in_violation": False,
+                                   "p99_ms": 90.0}}}})
+                time.sleep(0.2)
+            hist = mgr_ok(prefix="alerts history")
+            assert any(e["event"] == "fire" and
+                       e["name"] == "slo-burn-fast:ramp"
+                       for e in hist["events"])

@@ -240,6 +240,10 @@ class TestScenariosSlow:
         agg = out["duo"]["slo"]["tenants"]["aggressor"][S3_PUT]
         assert agg["p99_ms"] > out["duo"]["slo"]["tenants"][
             "victim"][S3_GET]["p99_ms"]
+        # attribution: the heavy-hitter sketches blame the flooding
+        # tenant, not the capped-but-chatty victim
+        assert out["top1_client"] == "rgw:aggressor"
+        assert out["top1_is_culprit"] is True
 
     def test_game_day_under_load(self):
         """PR 6 site-loss drill with the SLO tracker live: blackout,
