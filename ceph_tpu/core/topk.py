@@ -22,8 +22,10 @@ contract the autotune/alert engines keep).
 
 Each entry also carries rider aggregates (``bytes``, ``lat_sum_us``,
 and a log2 latency histogram) so the mgr can rank by bytes or p99,
-not just op count.  Riders inherit on eviction along with the count —
-the ``err`` bound is the uncertainty statement for all of them.
+not just op count.  Only the COUNT inherits on eviction (that is what
+the guarantee above needs); riders reset to zero, so bytes/latency
+are exact-but-possibly-partial for keys that churned through the
+eviction floor — a byte ranking never shows another tenant's load.
 
 Cluster merge: summing per-OSD sketches key-wise is the standard
 mergeable-summary construction; a key missing from one saturated
@@ -74,11 +76,16 @@ class SpaceSaving:
         if e is None:
             if len(self.entries) >= self.k:
                 # evict the minimum (deterministic tie-break by key):
-                # the newcomer inherits its count as the error bound
+                # the newcomer inherits the COUNT as its error bound
+                # (the space-saving guarantee needs it), but the
+                # riders reset — bytes/latency only ever attribute
+                # traffic observed while the key was tracked, so a
+                # byte or p99 ranking never carries another tenant's
+                # load under a new key's name
                 mkey = min(self.entries,
                            key=lambda x: (self.entries[x][0], x))
-                e = self.entries.pop(mkey)
-                e[1] = e[0]             # err := inherited count
+                mcount = self.entries.pop(mkey)[0]
+                e = [mcount, mcount, 0, 0.0, [0] * HIST_BUCKETS]
             else:
                 e = [0, 0, 0, 0.0, [0] * HIST_BUCKETS]
             self.entries[key] = e

@@ -137,6 +137,7 @@ class AlertEngine:
         self.tick = 0
         self.trace: list[dict] = []
         self.journal: list[dict] = []
+        self._seq = 0           # monotonic across journal trimming
         # alert name -> {"check","severity","summary","since_tick",
         #                "value"}
         self.firing: dict[str, dict] = {}
@@ -184,9 +185,15 @@ class AlertEngine:
         return out
 
     def _journal(self, entry: dict) -> dict:
-        entry["seq"] = len(self.journal)
+        entry["seq"] = self._seq
+        self._seq += 1
         entry["tick"] = self.tick
         self.journal.append(entry)
+        # the history_size rule is the journal's ring bound; seq
+        # stays monotonic so trimming is visible in the record
+        cap = int(self.rules.get("history_size") or 0)
+        if cap > 0 and len(self.journal) > cap:
+            del self.journal[:len(self.journal) - cap]
         return entry
 
     def _eval_burn(self, sig: dict, want: dict):
@@ -277,13 +284,16 @@ class AlertsModule(MgrModule):
     def _spine(self):
         return self.ctx._d.modules.get("telemetry_spine")
 
-    def _gather(self) -> dict | None:
-        spine = self._spine()
-        if spine is None:
-            return None
-        rules = self.engine.rules
+    def _gather(self) -> dict:
+        """Always returns a full signal dict — empty when the spine
+        is missing or its rings are, so the engine still steps and
+        alerts whose signal vanished clear instead of sticking."""
         slo: dict[str, dict] = {}
         series: dict[str, dict] = {}
+        spine = self._spine()
+        if spine is None:
+            return {"slo": slo, "series": series}
+        rules = self.engine.rules
         for daemon, rings in sorted(spine.series.items()):
             if daemon.startswith("slo."):
                 ring = rings.get("violation_s")
@@ -315,8 +325,6 @@ class AlertsModule(MgrModule):
                 per[counter] = rates[1:][-self.ANOMALY_TAIL:]
             if per:
                 series[daemon] = per
-        if not slo and not series:
-            return None
         return {"slo": slo, "series": series}
 
     # -- mon health reconciliation -------------------------------------------
@@ -372,8 +380,7 @@ class AlertsModule(MgrModule):
         signals = self._gather()
         now = time.time()
         self._reap_silences(now)
-        if signals is not None:
-            self.engine.step(signals)
+        self.engine.step(signals)
         self._reconcile(now)
 
     # -- surfaces ------------------------------------------------------------

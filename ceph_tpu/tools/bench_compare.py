@@ -11,8 +11,10 @@ path, and prints the relative change::
 
 Direction matters: most metrics are higher-is-better (GB/s, ops/sec,
 occupancy), but latency/overhead families are lower-is-better.  The
-classifier is a name heuristic (``LOWER_IS_BETTER``); a metric whose
-suffix matches is graded inverted.  ``--check`` exits non-zero when
+classifier is a name heuristic: throughput families
+(``HIGHER_IS_BETTER``) are checked first so ``*_ops_per_sec`` never
+falls into the time-suffix rule, then lower-is-better words and exact
+time-unit suffixes invert the grade.  ``--check`` exits non-zero when
 any metric regressed past the threshold — the verify skill's perf
 gate.  Counters that merely describe the run (seeds, sizes, counts of
 work attempted) are noise, not performance; ``IGNORE`` drops them.
@@ -26,12 +28,20 @@ import os
 import re
 import sys
 
-# dotted-path substrings that mark a metric as lower-is-better
-LOWER_IS_BETTER = (
-    "_ms", "_us", "_s", "_sec", "latency", "p99", "p50", "drift",
-    "overhead", "compile", "err", "idle", "violation", "ratio",
-    "tax",
+# throughput families whose names END in a time unit
+# ("sustained_ops_per_sec", "scrub_digest_mb_per_sec", ...): these
+# are higher-is-better and must win over the time-suffix rule below
+HIGHER_IS_BETTER = (
+    "per_sec", "per_s", "gbps", "tops", "goodput", "occupancy",
 )
+# lower-is-better words, matched anywhere in the leaf name
+LOWER_IS_BETTER = (
+    "latency", "p99", "p50", "drift", "overhead", "compile", "err",
+    "idle", "violation", "ratio", "tax", "slow_ops",
+)
+# lower-is-better time units, matched as exact leaf suffixes only —
+# substring matching here would swallow every "*_ops_per_sec"
+LOWER_IS_BETTER_SUFFIXES = ("_ms", "_us", "_s", "_sec")
 # run descriptors, not performance: never graded
 IGNORE = (
     "seed", "fingerprint", "osds", "pgs", "numrep", "stripes",
@@ -42,8 +52,12 @@ IGNORE = (
 
 
 def _is_lower_better(path: str) -> bool:
-    leaf = path.rsplit(".", 1)[-1]
-    return any(tok in leaf for tok in LOWER_IS_BETTER)
+    leaf = path.rsplit(".", 1)[-1].lower()
+    if any(tok in leaf for tok in HIGHER_IS_BETTER):
+        return False
+    if any(tok in leaf for tok in LOWER_IS_BETTER):
+        return True
+    return leaf.endswith(LOWER_IS_BETTER_SUFFIXES)
 
 
 def _is_ignored(path: str) -> bool:

@@ -132,6 +132,24 @@ class TestEngine:
         assert ev and ev[0]["name"] == "slo-burn-fast:s"
         assert eng.rules["slow_burn"] == default_rules()["slow_burn"]
 
+    def test_history_size_bounds_journal_seq_stays_monotonic(self):
+        """The declared knob is live: the journal is a ring of
+        ``history_size`` transitions, trimmed oldest-first, and
+        ``seq`` keeps counting across the trim."""
+        eng = AlertEngine(seed=4, rules={"history_size": 4})
+        for i in range(6):      # each iteration: one fire + one clear
+            eng.step(_sig(fast=20.0, fast_long=15.0,
+                          scenario=f"s{i}"))
+            eng.step(_sig(scenario=f"s{i}"))
+        assert len(eng.journal) == 4
+        assert (eng.fired_total, eng.cleared_total) == (6, 6)
+        seqs = [e["seq"] for e in eng.journal]
+        assert seqs == list(range(8, 12))       # 12 events, last 4
+        # replay over the retained trace reproduces the trimmed ring
+        rep = AlertEngine.replay(4, eng.trace,
+                                 rules={"history_size": 4})
+        assert rep.journal_digest() == eng.journal_digest()
+
 
 def _mgr_cmd(r, **cmd):
     rc, outs, out = r.mgr_command(cmd)
@@ -350,3 +368,27 @@ class TestModuleGather:
         assert burn["slow"] > 0.0
         assert set(burn) == {"fast", "fast_long", "slow",
                              "slow_long"}
+
+    def test_empty_spine_still_steps_so_stale_alerts_clear(self):
+        """A firing alert must clear even when the spine stops
+        yielding signal entirely (rings emptied, module reloaded):
+        serve_tick steps the engine with an empty signal dict rather
+        than freezing the firing set."""
+        spine = TelemetrySpine(None)        # no rings at all
+        mod = AlertsModule.__new__(AlertsModule)
+        mod.ctx = self._Ctx(spine)
+        mod.engine = AlertEngine(seed=7)
+        mod.enabled = True
+        mod.silences = {}
+        mod._posted = set()
+        mod.post_errors = 0
+        mod.engine.step(_sig(fast=20.0, fast_long=15.0))
+        assert "slo-burn-fast:s" in mod.engine.firing
+        assert mod._gather() == {"slo": {}, "series": {}}
+        mod.serve_tick()
+        assert mod.engine.firing == {}
+        # spine missing outright behaves the same
+        mod.ctx._d.modules.clear()
+        mod.engine.step(_sig(fast=20.0, fast_long=15.0))
+        mod.serve_tick()
+        assert mod.engine.firing == {}

@@ -215,6 +215,42 @@ class TestBenchCompare:
         head = capsys.readouterr().out.splitlines()[0]
         assert "BENCH_r01.json" in head and "BENCH_r02.json" in head
 
+    def test_throughput_suffix_is_higher_is_better(self, tmp_path,
+                                                   capsys):
+        """``*_ops_per_sec``/``*_mb_per_sec`` end in a time unit but
+        are throughput: halving is a regression, doubling is an
+        improvement — not the other way around."""
+        from ceph_tpu.tools import bench_compare
+        old = self._write(tmp_path, "BENCH_r01.json", {
+            "sustained_ops_per_sec": 1000.0,      # halves: regressed
+            "scrub_digest_mb_per_sec": 50.0,      # doubles: improved
+            "knee_ops_per_sec_threaded": 400.0,   # rises: improved
+            "heal_s": 4.0,                        # time suffix: rises
+        })
+        new = self._write(tmp_path, "BENCH_r02.json", {
+            "sustained_ops_per_sec": 500.0,
+            "scrub_digest_mb_per_sec": 100.0,
+            "knee_ops_per_sec_threaded": 480.0,
+            "heal_s": 8.0,
+        })
+        assert bench_compare.main([old, new, "--json",
+                                   "--check"]) == 1
+        rep = json.loads(capsys.readouterr().out)
+        verdicts = {r["metric"]: r["verdict"] for r in rep["rows"]}
+        assert verdicts["sustained_ops_per_sec"] == "regressed"
+        assert verdicts["scrub_digest_mb_per_sec"] == "improved"
+        assert verdicts["knee_ops_per_sec_threaded"] == "improved"
+        assert verdicts["heal_s"] == "regressed"
+        assert sorted(rep["regressions"]) == [
+            "heal_s", "sustained_ops_per_sec"]
+        # the throughput doubling alone must PASS --check
+        old2 = self._write(tmp_path, "BENCH_r03.json",
+                           {"sustained_ops_per_sec": 500.0})
+        new2 = self._write(tmp_path, "BENCH_r04.json",
+                           {"sustained_ops_per_sec": 1000.0})
+        assert bench_compare.main([old2, new2, "--check"]) == 0
+        capsys.readouterr()
+
     def test_clean_diff_passes_check(self, tmp_path, capsys):
         from ceph_tpu.tools import bench_compare
         old = self._write(tmp_path, "BENCH_r01.json",

@@ -37,6 +37,22 @@ class TestSpaceSaving:
         # invariant: true count (1) ≥ ops − err
         assert e["ops"] - e["err"] <= 1
 
+    def test_eviction_resets_riders_to_newcomer_only(self):
+        """Only the count inherits on eviction; bytes/latency start
+        at zero so a byte or p99 ranking never shows the evicted
+        key's traffic under the newcomer's name."""
+        sk = SpaceSaving(k=2)
+        sk.update("a", nbytes=100)
+        sk.update("a", nbytes=100)
+        sk.update("b", nbytes=7000, lat_us=90000.0)
+        sk.update("c", nbytes=64, lat_us=100.0)   # evicts b
+        e = sk.dump()["entries"]["c"]
+        assert (e["ops"], e["err"]) == (2, 1)     # count inherits
+        assert e["bytes"] == 64                   # b's 7000 gone
+        assert e["lat_sum_us"] == 100.0
+        assert sum(e["hist"]) == 1                # only c's own op
+        assert rank(sk.dump(), by="bytes")[0]["key"] == "a"
+
     def test_eviction_tie_breaks_by_key_deterministically(self):
         a, b = SpaceSaving(k=2), SpaceSaving(k=2)
         for sk in (a, b):
